@@ -1,0 +1,18 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework with the
+capability surface of Deeplearning4j 0.9.x, redesigned for JAX/XLA/Pallas.
+
+Architecture (vs the reference's layer map, SURVEY.md §1):
+- L0/L1 (DataVec/ND4J)   -> ``data/`` iterators + ``ops/`` on jax.numpy/XLA
+- L2 (cuDNN helpers)     -> XLA fusion + ``runtime/`` Pallas kernels
+- L3 (nn model)          -> ``nn/`` config-as-data layers + Sequential/Graph
+- L4 (training loop)     -> ``train/`` jitted steps, listeners, early stopping
+- L5 (scaleout)          -> ``parallel/`` Mesh + pjit/shard_map collectives
+- L6 (import/UI)         -> ``keras_import/``, ``train/listeners`` stats
+- L7 (apps)              -> ``models/`` zoo, ``nlp/``, ``graph/``, ``knn/``
+"""
+
+__version__ = "0.1.0"
+
+from . import ops
+
+__all__ = ["ops"]

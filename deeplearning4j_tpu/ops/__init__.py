@@ -1,0 +1,7 @@
+"""TPU-native op foundation: activations, losses, initializers, updaters,
+schedules, regularization — the replacement for DL4J's external ND4J surface
+(SURVEY.md §2.11)."""
+
+from . import activations, initializers, losses, regularization, schedules, updaters
+
+__all__ = ["activations", "initializers", "losses", "regularization", "schedules", "updaters"]

@@ -1,0 +1,98 @@
+"""Activation functions — TPU-native equivalent of ND4J ``IActivation``.
+
+Reference parity: DL4J exposes ~21 activations through the
+``org.nd4j.linalg.activations.Activation`` enum (used 118x across
+deeplearning4j-nn; see reference ``nn/conf/layers/*`` configs). Here each
+activation is a pure ``jnp``-traced function registered by canonical name so
+that configs serialize to JSON the same way DL4J's enum names do.
+
+Unlike DL4J — where each activation is a separate JNI-dispatched kernel — all
+of these fuse into surrounding matmuls/convs under XLA, so there is no
+"activation layer kernel" cost on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_REGISTRY: Dict[str, Callable[[Array], Array]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+def get(name_or_fn) -> Callable[[Array], Array]:
+    """Resolve an activation by canonical name (case-insensitive) or pass through callables."""
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"Unknown activation '{name_or_fn}'. Known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[key]
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+# --- the catalogue (parity with Activation enum) ---
+
+register("identity")(lambda x: x)
+register("linear")(lambda x: x)
+register("relu")(jax.nn.relu)
+register("relu6")(jax.nn.relu6)
+register("sigmoid")(jax.nn.sigmoid)
+register("tanh")(jnp.tanh)
+register("softmax")(lambda x: jax.nn.softmax(x, axis=-1))
+register("softplus")(jax.nn.softplus)
+register("softsign")(jax.nn.soft_sign)
+register("elu")(jax.nn.elu)
+register("selu")(jax.nn.selu)
+register("gelu")(jax.nn.gelu)
+register("swish")(jax.nn.swish)
+register("silu")(jax.nn.silu)
+register("mish")(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+register("hardsigmoid")(jax.nn.hard_sigmoid)
+register("hardtanh")(lambda x: jnp.clip(x, -1.0, 1.0))
+register("cube")(lambda x: x * x * x)
+register("rational_tanh")(
+    # DL4J RationalTanh: 1.7159 * tanh(2x/3) approximated rationally; we use the
+    # exact scaled tanh, which is the function it approximates.
+    lambda x: 1.7159 * jnp.tanh(2.0 / 3.0 * x)
+)
+register("rectified_tanh")(lambda x: jnp.maximum(0.0, jnp.tanh(x)))
+register("sin")(jnp.sin)
+register("exp")(jnp.exp)
+
+
+@register("leakyrelu")
+def leaky_relu(x: Array, alpha: float = 0.01) -> Array:
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+@register("rrelu")
+def rrelu(x: Array, lower: float = 1.0 / 8, upper: float = 1.0 / 3) -> Array:
+    # Deterministic (inference-mode) RReLU: slope = mean of the range.
+    return jax.nn.leaky_relu(x, negative_slope=(lower + upper) / 2.0)
+
+
+@register("thresholdedrelu")
+def thresholded_relu(x: Array, theta: float = 1.0) -> Array:
+    return jnp.where(x > theta, x, 0.0)
+
+
+def softmax_stable(x: Array, axis: int = -1) -> Array:
+    """Numerically-stable softmax used by loss layers (log-sum-exp shifted)."""
+    return jax.nn.softmax(x, axis=axis)

@@ -1,0 +1,195 @@
+"""Loss functions — parity with ND4J ``ILossFunction`` (~15 losses).
+
+Reference: ``org.nd4j.linalg.lossfunctions.LossFunctions`` (86 imports across
+deeplearning4j-nn): MCXENT, NEGATIVELOGLIKELIHOOD, XENT, MSE, L1, L2, MAE,
+RMSE_XENT, HINGE, SQUARED_HINGE, KL_DIVERGENCE, MEAN_ABSOLUTE_PERCENTAGE_ERROR,
+MEAN_SQUARED_LOGARITHMIC_ERROR, POISSON, COSINE_PROXIMITY + CenterLoss
+(nn/conf/layers/CenterLossOutputLayer.java).
+
+Each loss is ``fn(predictions, labels, mask=None, weights=None) -> scalar``
+computing the *mean over examples* of the *sum over output units* — DL4J's
+``computeScore(average=True)`` convention. ``predictions`` are
+post-activation values (the Output layer applies its activation first), except
+the ``*_logits`` variants which fuse activation+loss for numerical stability —
+the preferred TPU path, fused by XLA into one kernel.
+
+Masks broadcast against the per-example score: shape (B,) or (B, T) for time
+series (DL4J per-timestep masking, see MaskedReductionUtil).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+_EPS = 1e-7
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown loss '{name_or_fn}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+def _reduce(per_unit: Array, mask: Optional[Array], weights: Optional[Array]) -> Array:
+    """Sum over the feature axis, mask per example/timestep, mean over the rest."""
+    if weights is not None:
+        per_unit = per_unit * weights
+    per_example = jnp.sum(per_unit, axis=-1)
+    if mask is None:
+        return jnp.mean(per_example)
+    mask = mask.astype(per_example.dtype)
+    mask = jnp.broadcast_to(mask.reshape(mask.shape + (1,) * (per_example.ndim - mask.ndim)), per_example.shape)
+    total = jnp.sum(mask)
+    return jnp.sum(per_example * mask) / jnp.maximum(total, 1.0)
+
+
+@register("mse")
+@register("squared_loss")
+def mse(p, y, mask=None, weights=None):
+    return _reduce(jnp.square(p - y), mask, weights)
+
+
+@register("l2")
+def l2(p, y, mask=None, weights=None):
+    # DL4J L2 = sum of squared diffs (no 1/n over outputs) — same as our MSE
+    # reduction since we sum over features and mean over examples.
+    return _reduce(jnp.square(p - y), mask, weights)
+
+
+@register("l1")
+def l1(p, y, mask=None, weights=None):
+    return _reduce(jnp.abs(p - y), mask, weights)
+
+
+@register("mae")
+def mae(p, y, mask=None, weights=None):
+    return _reduce(jnp.abs(p - y), mask, weights)
+
+
+@register("mcxent")
+@register("negativeloglikelihood")
+def mcxent(p, y, mask=None, weights=None):
+    """Multi-class cross-entropy on probabilities (post-softmax)."""
+    return _reduce(-y * jnp.log(jnp.clip(p, _EPS, 1.0)), mask, weights)
+
+
+@register("mcxent_logits")
+@register("softmax_cross_entropy_logits")
+def mcxent_logits(logits, y, mask=None, weights=None):
+    """Fused softmax+CE on raw logits — numerically stable, XLA-fused."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return _reduce(-y * logp, mask, weights)
+
+
+@register("xent")
+@register("binary_crossentropy")
+def xent(p, y, mask=None, weights=None):
+    p = jnp.clip(p, _EPS, 1.0 - _EPS)
+    return _reduce(-(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p)), mask, weights)
+
+
+@register("xent_logits")
+@register("sigmoid_cross_entropy_logits")
+def xent_logits(logits, y, mask=None, weights=None):
+    # log(1+exp(-|x|)) formulation.
+    per = jnp.maximum(logits, 0.0) - logits * y + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _reduce(per, mask, weights)
+
+
+@register("rmse_xent")
+def rmse_xent(p, y, mask=None, weights=None):
+    # DL4J legacy: sqrt of squared diff per unit.
+    return _reduce(jnp.sqrt(jnp.square(p - y) + _EPS), mask, weights)
+
+
+@register("hinge")
+def hinge(p, y, mask=None, weights=None):
+    # labels in {-1, +1} or {0,1} mapped to +-1.
+    y_pm = jnp.where(y > 0.5, 1.0, -1.0) if jnp.issubdtype(y.dtype, jnp.floating) else y
+    return _reduce(jnp.maximum(0.0, 1.0 - y_pm * p), mask, weights)
+
+
+@register("squared_hinge")
+def squared_hinge(p, y, mask=None, weights=None):
+    y_pm = jnp.where(y > 0.5, 1.0, -1.0) if jnp.issubdtype(y.dtype, jnp.floating) else y
+    return _reduce(jnp.square(jnp.maximum(0.0, 1.0 - y_pm * p)), mask, weights)
+
+
+@register("kl_divergence")
+@register("reconstruction_crossentropy")
+def kl_divergence(p, y, mask=None, weights=None):
+    p = jnp.clip(p, _EPS, 1.0)
+    y_c = jnp.clip(y, _EPS, 1.0)
+    return _reduce(y_c * (jnp.log(y_c) - jnp.log(p)), mask, weights)
+
+
+@register("mean_absolute_percentage_error")
+@register("mape")
+def mape(p, y, mask=None, weights=None):
+    return _reduce(100.0 * jnp.abs((p - y) / jnp.where(jnp.abs(y) < _EPS, _EPS, y)), mask, weights)
+
+
+@register("mean_squared_logarithmic_error")
+@register("msle")
+def msle(p, y, mask=None, weights=None):
+    return _reduce(jnp.square(jnp.log1p(jnp.maximum(p, -1 + _EPS)) - jnp.log1p(jnp.maximum(y, -1 + _EPS))), mask, weights)
+
+
+@register("poisson")
+def poisson(p, y, mask=None, weights=None):
+    return _reduce(p - y * jnp.log(jnp.clip(p, _EPS, None)), mask, weights)
+
+
+@register("cosine_proximity")
+def cosine_proximity(p, y, mask=None, weights=None):
+    pn = p / jnp.maximum(jnp.linalg.norm(p, axis=-1, keepdims=True), _EPS)
+    yn = y / jnp.maximum(jnp.linalg.norm(y, axis=-1, keepdims=True), _EPS)
+    per_example = -jnp.sum(pn * yn, axis=-1)
+    if mask is not None:
+        m = mask.astype(per_example.dtype)
+        m = jnp.broadcast_to(m.reshape(m.shape + (1,) * (per_example.ndim - m.ndim)), per_example.shape)
+        return jnp.sum(per_example * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return jnp.mean(per_example)
+
+
+@register("wasserstein")
+def wasserstein(p, y, mask=None, weights=None):
+    return _reduce(p * y, mask, weights)
+
+
+def center_loss(features: Array, label_idx: Array, centers: Array, alpha: float = 0.05):
+    """CenterLoss (CenterLossOutputLayer): pull features toward per-class centers.
+
+    Returns (loss, updated_centers). Centers update is an EMA toward the class
+    mean — done with segment ops (static shapes, TPU-friendly).
+    """
+    num_classes = centers.shape[0]
+    picked = centers[label_idx]
+    loss = 0.5 * jnp.mean(jnp.sum(jnp.square(features - picked), axis=-1))
+    onehot = jax.nn.one_hot(label_idx, num_classes, dtype=features.dtype)
+    counts = jnp.maximum(onehot.sum(axis=0), 1.0)
+    class_mean = (onehot.T @ features) / counts[:, None]
+    seen = (onehot.sum(axis=0) > 0)[:, None]
+    new_centers = jnp.where(seen, centers + alpha * (class_mean - centers), centers)
+    return loss, new_centers

@@ -1,0 +1,162 @@
+"""Dropout variants, weight noise, and weight constraints.
+
+Reference parity:
+- ``nn/conf/dropout/`` (5): Dropout, AlphaDropout, GaussianDropout,
+  GaussianNoise, SpatialDropout.
+- ``nn/conf/weightnoise/`` (3): WeightNoise (additive/multiplicative),
+  DropConnect.
+- ``nn/conf/constraint/`` (5): MaxNormConstraint, MinMaxNormConstraint,
+  NonNegativeConstraint, UnitNormConstraint (applied post-update).
+
+All dropout ops are pure functions of an explicit PRNG key (JAX functional
+randomness replaces ND4J's stateful RNG); constraints are pytree maps applied
+after the optax update, matching DL4J's ``applyConstraints`` at
+``StochasticGradientDescent.java:96``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+# --- dropout (activation noise) ---
+
+def dropout(key, x: Array, rate: float, training: bool = True) -> Array:
+    """Inverted dropout. DL4J configs give *retain* prob; callers convert (rate = 1-p)."""
+    if not training or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+def spatial_dropout(key, x: Array, rate: float, training: bool = True) -> Array:
+    """Drop whole feature maps (NHWC: mask over channel axis only)."""
+    if not training or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask_shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+    mask = jax.random.bernoulli(key, keep, mask_shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+def alpha_dropout(key, x: Array, rate: float, training: bool = True) -> Array:
+    """SELU-compatible dropout (Klambauer et al.) — keeps self-normalizing stats."""
+    if not training or rate <= 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    return a * jnp.where(mask, x, alpha_p) + b
+
+
+def gaussian_dropout(key, x: Array, rate: float, training: bool = True) -> Array:
+    """Multiplicative N(1, rate/(1-rate)) noise."""
+    if not training or rate <= 0.0:
+        return x
+    std = math.sqrt(rate / (1.0 - rate))
+    return x * (1.0 + std * jax.random.normal(key, x.shape, x.dtype))
+
+
+def gaussian_noise(key, x: Array, stddev: float, training: bool = True) -> Array:
+    if not training or stddev <= 0.0:
+        return x
+    return x + stddev * jax.random.normal(key, x.shape, x.dtype)
+
+
+DROPOUTS: Dict[str, Callable] = {
+    "dropout": dropout,
+    "spatial": spatial_dropout,
+    "alpha": alpha_dropout,
+    "gaussian_dropout": gaussian_dropout,
+    "gaussian_noise": gaussian_noise,
+}
+
+
+def apply_dropout_config(key, x: Array, cfg, training: bool) -> Array:
+    """cfg: float (dropout rate) or {"type": name, ...kwargs}."""
+    if cfg is None:
+        return x
+    if isinstance(cfg, (int, float)):
+        return dropout(key, x, float(cfg), training)
+    cfg = dict(cfg)
+    kind = cfg.pop("type")
+    return DROPOUTS[kind](key, x, training=training, **cfg)
+
+
+# --- weight noise (applied to params before forward) ---
+
+def weight_noise(key, params, stddev: float = 0.01, additive: bool = True, training: bool = True):
+    """WeightNoise: perturb params for one forward pass (not persisted)."""
+    if not training or stddev <= 0.0:
+        return params
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(key, len(leaves))
+    if additive:
+        noised = [p + stddev * jax.random.normal(k, p.shape, p.dtype) for p, k in zip(leaves, keys)]
+    else:
+        noised = [p * (1.0 + stddev * jax.random.normal(k, p.shape, p.dtype)) for p, k in zip(leaves, keys)]
+    return jax.tree_util.tree_unflatten(treedef, noised)
+
+
+def drop_connect(key, params, rate: float = 0.5, training: bool = True):
+    """DropConnect: bernoulli-mask weights for one forward pass."""
+    if not training or rate <= 0.0:
+        return params
+    keep = 1.0 - rate
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(key, len(leaves))
+    dropped = [jnp.where(jax.random.bernoulli(k, keep, p.shape), p / keep, 0.0)
+               for p, k in zip(leaves, keys)]
+    return jax.tree_util.tree_unflatten(treedef, dropped)
+
+
+# --- weight constraints (post-update projections) ---
+
+def max_norm(w: Array, max_val: float = 2.0, axis=0) -> Array:
+    norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=axis, keepdims=True))
+    return w * jnp.minimum(1.0, max_val / jnp.maximum(norms, 1e-8))
+
+
+def min_max_norm(w: Array, min_val: float = 0.0, max_val: float = 1.0, rate: float = 1.0, axis=0) -> Array:
+    norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=axis, keepdims=True))
+    clipped = jnp.clip(norms, min_val, max_val)
+    target = rate * clipped + (1.0 - rate) * norms
+    return w * (target / jnp.maximum(norms, 1e-8))
+
+
+def unit_norm(w: Array, axis=0) -> Array:
+    norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=axis, keepdims=True))
+    return w / jnp.maximum(norms, 1e-8)
+
+
+def non_negative(w: Array) -> Array:
+    return jnp.maximum(w, 0.0)
+
+
+CONSTRAINTS: Dict[str, Callable] = {
+    "max_norm": max_norm,
+    "min_max_norm": min_max_norm,
+    "unit_norm": unit_norm,
+    "non_negative": non_negative,
+}
+
+
+def apply_constraint_config(w: Array, cfg) -> Array:
+    if cfg is None:
+        return w
+    if isinstance(cfg, str):
+        return CONSTRAINTS[cfg](w)
+    cfg = dict(cfg)
+    kind = cfg.pop("type")
+    return CONSTRAINTS[kind](w, **cfg)

@@ -1,0 +1,111 @@
+"""Learning-rate / value schedules — parity with ND4J ``ISchedule``.
+
+Reference: ``org.nd4j.linalg.schedule.*`` (Exponential, Inverse, Map, Poly,
+Sigmoid, Step schedules) consumed by layer configs via
+``.learningRateSchedule(...)``. On TPU these are pure functions of the step
+counter evaluated inside the jitted update (optax-compatible: ``f(count) ->
+scalar``), so schedule changes never trigger recompilation.
+
+DL4J schedules take a ``ScheduleType`` of ITERATION or EPOCH; we express
+everything in iterations and provide ``per_epoch(steps_per_epoch)`` wrapping.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+Schedule = Callable  # (count) -> value
+ScalarOrSchedule = Union[float, Schedule]
+
+
+def constant(value: float) -> Schedule:
+    return lambda count: jnp.asarray(value, jnp.float32)
+
+
+def exponential(initial: float, gamma: float) -> Schedule:
+    """value = initial * gamma^iter (ExponentialSchedule)."""
+    return lambda count: initial * jnp.power(gamma, count.astype(jnp.float32) if hasattr(count, "astype") else float(count))
+
+
+def inverse(initial: float, gamma: float, power: float) -> Schedule:
+    """value = initial / (1 + gamma*iter)^power (InverseSchedule)."""
+    return lambda count: initial / jnp.power(1.0 + gamma * jnp.asarray(count, jnp.float32), power)
+
+
+def poly(initial: float, power: float, max_iter: int) -> Schedule:
+    """value = initial * (1 - iter/maxIter)^power (PolySchedule)."""
+
+    def fn(count):
+        frac = jnp.clip(jnp.asarray(count, jnp.float32) / max_iter, 0.0, 1.0)
+        return initial * jnp.power(1.0 - frac, power)
+
+    return fn
+
+
+def sigmoid_schedule(initial: float, gamma: float, step_size: int) -> Schedule:
+    """value = initial / (1 + exp(-gamma*(iter - stepSize))) (SigmoidSchedule)."""
+    return lambda count: initial / (1.0 + jnp.exp(-gamma * (jnp.asarray(count, jnp.float32) - step_size)))
+
+
+def step_schedule(initial: float, decay_rate: float, step_size: int) -> Schedule:
+    """value = initial * decayRate^floor(iter/step) (StepSchedule)."""
+    return lambda count: initial * jnp.power(decay_rate, jnp.floor(jnp.asarray(count, jnp.float32) / step_size))
+
+
+def map_schedule(values: Dict[int, float]) -> Schedule:
+    """Piecewise-constant from {iteration: value} (MapSchedule). Jit-safe."""
+    boundaries = sorted(values)
+    vals = [values[b] for b in boundaries]
+
+    def fn(count):
+        c = jnp.asarray(count, jnp.float32)
+        out = jnp.asarray(vals[0], jnp.float32)
+        for b, v in zip(boundaries, vals):
+            out = jnp.where(c >= b, v, out)
+        return out
+
+    return fn
+
+
+def warmup_cosine(peak: float, warmup_steps: int, total_steps: int, end_value: float = 0.0) -> Schedule:
+    """TPU-native extra: linear warmup + cosine decay (not in DL4J 0.9 but the
+    modern default for the transformer/long-context models we add)."""
+
+    def fn(count):
+        c = jnp.asarray(count, jnp.float32)
+        warm = peak * c / jnp.maximum(warmup_steps, 1)
+        frac = jnp.clip((c - warmup_steps) / jnp.maximum(total_steps - warmup_steps, 1), 0.0, 1.0)
+        cos = end_value + 0.5 * (peak - end_value) * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(c < warmup_steps, warm, cos)
+
+    return fn
+
+
+def per_epoch(schedule: Schedule, steps_per_epoch: int) -> Schedule:
+    """Evaluate an epoch-based schedule from the iteration counter (ScheduleType.EPOCH)."""
+    return lambda count: schedule(jnp.asarray(count) // steps_per_epoch)
+
+
+_BUILDERS = {
+    "constant": constant,
+    "exponential": exponential,
+    "inverse": inverse,
+    "poly": poly,
+    "sigmoid": sigmoid_schedule,
+    "step": step_schedule,
+    "map": map_schedule,
+    "warmup_cosine": warmup_cosine,
+}
+
+
+def from_config(cfg: Union[float, dict, Schedule]) -> Schedule:
+    """Build a schedule from JSON-able config: {"type": "step", "initial": .1, ...}."""
+    if callable(cfg):
+        return cfg
+    if isinstance(cfg, (int, float)):
+        return constant(float(cfg))
+    cfg = dict(cfg)
+    kind = cfg.pop("type")
+    return _BUILDERS[kind](**cfg)

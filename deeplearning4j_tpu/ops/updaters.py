@@ -1,0 +1,213 @@
+"""Updaters — parity with the 10 ND4J ``IUpdater`` implementations, on optax.
+
+Reference: ``org.nd4j.linalg.learning.config.*`` — Sgd (36 uses), Adam (13),
+AMSGrad, Nesterovs, RmsProp, AdaGrad, AdaDelta, AdaMax, Nadam, NoOp — applied
+block-wise by ``nn/updater/BaseMultiLayerUpdater.java`` over views of the
+flattened gradient. The TPU design replaces the mutable flattened-view model
+with optax GradientTransformations over the param pytree; XLA fuses the whole
+update into a handful of kernels, and per-layer updater overrides become an
+``optax.multi_transform`` over a label pytree (see build_multi).
+
+Gradient normalization (GradientNormalization enum in layer configs:
+RenormalizeL2PerLayer/PerParamType, ClipElementWiseAbsoluteValue,
+ClipL2PerLayer, ClipL2PerParamType) maps to chained transforms here.
+
+All hyperparameters accept either a float or a schedule (ops/schedules.py),
+mirroring DL4J's ``ISchedule`` support on learning rate / momentum.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from . import schedules as sched
+
+ScalarOrSchedule = Union[float, Callable]
+
+_REGISTRY: Dict[str, Callable[..., optax.GradientTransformation]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+def _lr(learning_rate: ScalarOrSchedule):
+    return sched.from_config(learning_rate) if not callable(learning_rate) else learning_rate
+
+
+@register("sgd")
+def sgd(learning_rate: ScalarOrSchedule = 1e-1, **_):
+    return optax.sgd(_lr(learning_rate))
+
+
+@register("nesterovs")
+def nesterovs(learning_rate: ScalarOrSchedule = 1e-1, momentum: float = 0.9, **_):
+    return optax.sgd(_lr(learning_rate), momentum=momentum, nesterov=True)
+
+
+@register("adam")
+def adam(learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.9, beta2: float = 0.999,
+         epsilon: float = 1e-8, **_):
+    return optax.adam(_lr(learning_rate), b1=beta1, b2=beta2, eps=epsilon)
+
+
+@register("adamw")
+def adamw(learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.9, beta2: float = 0.999,
+          epsilon: float = 1e-8, weight_decay: float = 1e-2, **_):
+    # Not in DL4J 0.9; standard for the transformer models this framework adds.
+    return optax.adamw(_lr(learning_rate), b1=beta1, b2=beta2, eps=epsilon, weight_decay=weight_decay)
+
+
+@register("amsgrad")
+def amsgrad(learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.9, beta2: float = 0.999,
+            epsilon: float = 1e-8, **_):
+    return optax.amsgrad(_lr(learning_rate), b1=beta1, b2=beta2, eps=epsilon)
+
+
+@register("adamax")
+def adamax(learning_rate: ScalarOrSchedule = 2e-3, beta1: float = 0.9, beta2: float = 0.999,
+           epsilon: float = 1e-8, **_):
+    return optax.adamax(_lr(learning_rate), b1=beta1, b2=beta2, eps=epsilon)
+
+
+@register("nadam")
+def nadam(learning_rate: ScalarOrSchedule = 1e-3, beta1: float = 0.9, beta2: float = 0.999,
+          epsilon: float = 1e-8, **_):
+    return optax.nadam(_lr(learning_rate), b1=beta1, b2=beta2, eps=epsilon)
+
+
+@register("adagrad")
+def adagrad(learning_rate: ScalarOrSchedule = 1e-1, epsilon: float = 1e-6, **_):
+    return optax.adagrad(_lr(learning_rate), eps=epsilon)
+
+
+@register("adadelta")
+def adadelta(rho: float = 0.95, epsilon: float = 1e-6, **_):
+    return optax.adadelta(learning_rate=1.0, rho=rho, eps=epsilon)
+
+
+@register("rmsprop")
+def rmsprop(learning_rate: ScalarOrSchedule = 1e-1, rms_decay: float = 0.95,
+            epsilon: float = 1e-8, **_):
+    return optax.rmsprop(_lr(learning_rate), decay=rms_decay, eps=epsilon)
+
+
+@register("noop")
+def noop(**_):
+    return optax.set_to_zero()
+
+
+# --- gradient normalization (GradientNormalization enum) ---
+
+def renormalize_l2_per_layer() -> optax.GradientTransformation:
+    """Divide each layer's gradients by the layer-wide L2 norm."""
+
+    def update(updates, state, params=None):
+        def norm_layer(layer):
+            leaves = jax.tree_util.tree_leaves(layer)
+            n = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+            return jax.tree.map(lambda g: g / jnp.maximum(n, 1e-8), layer)
+
+        # "layer" = top-level entry of the params dict.
+        if isinstance(updates, dict):
+            return {k: norm_layer(v) for k, v in updates.items()}, state
+        return norm_layer(updates), state
+
+    return optax.GradientTransformation(lambda params: optax.EmptyState(), update)
+
+
+def renormalize_l2_per_param() -> optax.GradientTransformation:
+    def update(updates, state, params=None):
+        return jax.tree.map(lambda g: g / jnp.maximum(jnp.linalg.norm(g.ravel()), 1e-8), updates), state
+
+    return optax.GradientTransformation(lambda params: optax.EmptyState(), update)
+
+
+def clip_elementwise(threshold: float) -> optax.GradientTransformation:
+    return optax.clip(threshold)
+
+
+def clip_l2_per_layer(threshold: float) -> optax.GradientTransformation:
+    def update(updates, state, params=None):
+        def clip_layer(layer):
+            leaves = jax.tree_util.tree_leaves(layer)
+            n = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+            scale = jnp.minimum(1.0, threshold / jnp.maximum(n, 1e-8))
+            return jax.tree.map(lambda g: g * scale, layer)
+
+        if isinstance(updates, dict):
+            return {k: clip_layer(v) for k, v in updates.items()}, state
+        return clip_layer(updates), state
+
+    return optax.GradientTransformation(lambda params: optax.EmptyState(), update)
+
+
+def clip_l2_per_param(threshold: float) -> optax.GradientTransformation:
+    def update(updates, state, params=None):
+        def clip(g):
+            n = jnp.linalg.norm(g.ravel())
+            return g * jnp.minimum(1.0, threshold / jnp.maximum(n, 1e-8))
+
+        return jax.tree.map(clip, updates), state
+
+    return optax.GradientTransformation(lambda params: optax.EmptyState(), update)
+
+
+_GRADNORM = {
+    "renormalizel2perlayer": lambda t: renormalize_l2_per_layer(),
+    "renormalizel2perparamtype": lambda t: renormalize_l2_per_param(),
+    "clipelementwiseabsolutevalue": clip_elementwise,
+    "clipl2perlayer": clip_l2_per_layer,
+    "clipl2perparamtype": clip_l2_per_param,
+}
+
+
+def build(config: Union[str, dict, optax.GradientTransformation],
+          gradient_normalization: Optional[str] = None,
+          gradient_normalization_threshold: float = 1.0,
+          l1: float = 0.0, l2: float = 0.0) -> optax.GradientTransformation:
+    """Build the full update pipeline from a JSON-able updater config.
+
+    Order (parity with BaseMultiLayerUpdater.preApply + regularization):
+    L1/L2 penalty gradients -> gradient normalization -> updater math.
+    """
+    chain = []
+    if l2:
+        chain.append(optax.add_decayed_weights(l2))
+    if l1:
+        def add_l1(updates, state, params=None):
+            return jax.tree.map(lambda g, p: g + l1 * jnp.sign(p), updates, params), state
+
+        chain.append(optax.GradientTransformation(lambda p: optax.EmptyState(), add_l1))
+    if gradient_normalization and gradient_normalization.lower() != "none":
+        key = gradient_normalization.lower().replace("_", "")
+        if key not in _GRADNORM:
+            raise ValueError(f"Unknown gradient normalization '{gradient_normalization}'")
+        chain.append(_GRADNORM[key](gradient_normalization_threshold))
+
+    if isinstance(config, optax.GradientTransformation):
+        chain.append(config)
+    elif isinstance(config, str):
+        chain.append(_REGISTRY[config.lower()]())
+    else:
+        cfg = dict(config)
+        kind = cfg.pop("type")
+        chain.append(_REGISTRY[kind.lower()](**cfg))
+    return optax.chain(*chain) if len(chain) > 1 else chain[0]
+
+
+def build_multi(label_fn: Callable[[Any], Any], transforms: Dict[str, optax.GradientTransformation]):
+    """Per-layer updater overrides (DL4J allows a different IUpdater per layer)."""
+    return optax.multi_transform(transforms, label_fn)

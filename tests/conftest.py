@@ -1,0 +1,13 @@
+"""Test harness: force an 8-device virtual CPU platform so all sharding /
+multi-chip tests run without TPU hardware — the TPU-native equivalent of the
+reference's Spark `local[N]` simulated clusters
+(dl4j-spark BaseSparkTest.java:89)."""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

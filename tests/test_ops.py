@@ -1,0 +1,249 @@
+"""Tests for the ops foundation (activations, losses, inits, updaters, schedules).
+
+Mirrors the reference's config/serde + small-tensor assertion style
+(deeplearning4j-core src/test .../nn/conf & layers, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeplearning4j_tpu.ops import activations, initializers, losses, regularization, schedules, updaters
+
+
+class TestActivations:
+    def test_catalogue_size(self):
+        assert len(activations.names()) >= 21  # parity with WeightInit's Activation enum
+
+    @pytest.mark.parametrize("name", activations.names())
+    def test_finite(self, name):
+        x = jnp.linspace(-3, 3, 32).reshape(4, 8)
+        y = activations.get(name)(x)
+        assert y.shape == x.shape
+        assert bool(jnp.all(jnp.isfinite(y)))
+
+    def test_softmax_normalizes(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 10))
+        s = activations.get("softmax")(x)
+        np.testing.assert_allclose(np.asarray(s.sum(-1)), 1.0, rtol=1e-5)
+
+    def test_relu_values(self):
+        x = jnp.array([-1.0, 0.0, 2.0])
+        np.testing.assert_array_equal(np.asarray(activations.get("relu")(x)), [0.0, 0.0, 2.0])
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            activations.get("nope")
+
+
+class TestInitializers:
+    def test_catalogue_size(self):
+        assert len(initializers.names()) >= 21  # WeightInit.java has 21 schemes
+
+    @pytest.mark.parametrize("name", [n for n in initializers.names() if n != "identity"])
+    def test_shapes(self, name):
+        key = jax.random.PRNGKey(0)
+        w = initializers.init_param(key, name, (64, 32))
+        assert w.shape == (64, 32)
+        assert bool(jnp.all(jnp.isfinite(w)))
+
+    def test_xavier_stats(self):
+        key = jax.random.PRNGKey(1)
+        w = initializers.init_param(key, "xavier", (512, 512))
+        expected_std = np.sqrt(2.0 / 1024)
+        assert abs(float(w.std()) - expected_std) < expected_std * 0.1
+
+    def test_relu_he_stats(self):
+        key = jax.random.PRNGKey(2)
+        w = initializers.init_param(key, "relu", (1024, 256))
+        expected_std = np.sqrt(2.0 / 1024)
+        assert abs(float(w.std()) - expected_std) < expected_std * 0.1
+
+    def test_conv_fans(self):
+        fi, fo = initializers.compute_fans((3, 3, 16, 32))
+        assert fi == 9 * 16 and fo == 9 * 32
+
+    def test_identity(self):
+        w = initializers.init_param(jax.random.PRNGKey(0), "identity", (8, 8))
+        np.testing.assert_array_equal(np.asarray(w), np.eye(8))
+
+    def test_distribution(self):
+        fn = initializers.distribution("normal", mean=1.0, std=0.01)
+        w = fn(jax.random.PRNGKey(0), (1000,), 1000, 1000)
+        assert abs(float(w.mean()) - 1.0) < 0.01
+
+
+class TestLosses:
+    def test_catalogue_size(self):
+        assert len(losses.names()) >= 15
+
+    def test_mse_zero_when_equal(self):
+        p = jnp.ones((4, 3))
+        assert float(losses.get("mse")(p, p)) == 0.0
+
+    def test_mcxent_matches_manual(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (8, 5))
+        y = jax.nn.one_hot(jnp.arange(8) % 5, 5)
+        probs = jax.nn.softmax(logits)
+        a = losses.get("mcxent")(probs, y)
+        b = losses.get("mcxent_logits")(logits, y)
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
+
+    def test_xent_logits_stable(self):
+        logits = jnp.array([[100.0, -100.0]])
+        y = jnp.array([[1.0, 0.0]])
+        v = float(losses.get("xent_logits")(logits, y))
+        assert np.isfinite(v) and v < 1e-3
+
+    def test_masking(self):
+        p = jnp.array([[1.0], [100.0]])
+        y = jnp.array([[1.0], [0.0]])
+        mask = jnp.array([1.0, 0.0])
+        assert float(losses.get("mse")(p, y, mask=mask)) == 0.0
+
+    def test_timeseries_mask(self):
+        # (B, T, F) with per-timestep mask (B, T)
+        p = jnp.zeros((2, 3, 4))
+        y = jnp.ones((2, 3, 4))
+        mask = jnp.array([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+        v = float(losses.get("mse")(p, y, mask=mask))
+        np.testing.assert_allclose(v, 4.0, rtol=1e-6)  # each masked-in step: sum over 4 units of 1
+
+    def test_gradients_flow(self):
+        for name in losses.names():
+            fn = losses.get(name)
+            p = jnp.abs(jax.random.normal(jax.random.PRNGKey(3), (4, 3))) * 0.5 + 0.1
+            y = jnp.abs(jax.random.normal(jax.random.PRNGKey(4), (4, 3))) * 0.5 + 0.1
+            g = jax.grad(lambda p_: fn(p_, y))(p)
+            assert bool(jnp.all(jnp.isfinite(g))), name
+
+    def test_center_loss(self):
+        feats = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+        labels = jnp.arange(8) % 4
+        centers = jnp.zeros((4, 16))
+        loss, new_centers = losses.center_loss(feats, labels, centers)
+        assert float(loss) > 0
+        assert not bool(jnp.allclose(new_centers, centers))
+
+
+class TestUpdaters:
+    def test_catalogue(self):
+        # parity: 10 IUpdaters (Sgd, Nesterovs, Adam, AMSGrad, AdaMax, Nadam,
+        # AdaGrad, AdaDelta, RmsProp, NoOp)
+        for n in ["sgd", "nesterovs", "adam", "amsgrad", "adamax", "nadam",
+                  "adagrad", "adadelta", "rmsprop", "noop"]:
+            assert n in updaters.names()
+
+    @pytest.mark.parametrize("name", ["sgd", "nesterovs", "adam", "amsgrad", "adamax",
+                                      "nadam", "adagrad", "adadelta", "rmsprop"])
+    def test_descends(self, name):
+        tx = updaters.build({"type": name})
+        params = {"w": jnp.array([1.0, -2.0, 3.0])}
+        opt_state = tx.init(params)
+
+        def loss(p):
+            return jnp.sum(jnp.square(p["w"]))
+
+        for _ in range(50):
+            g = jax.grad(loss)(params)
+            upd, opt_state = tx.update(g, opt_state, params)
+            params = optax.apply_updates(params, upd)
+        assert float(loss(params)) < 13.99  # descended from initial 14.0
+
+    def test_noop_freezes(self):
+        tx = updaters.build("noop")
+        params = {"w": jnp.ones(3)}
+        st = tx.init(params)
+        upd, _ = tx.update({"w": jnp.ones(3)}, st, params)
+        np.testing.assert_array_equal(np.asarray(upd["w"]), 0.0)
+
+    def test_schedule_lr(self):
+        tx = updaters.build({"type": "sgd", "learning_rate": {"type": "step", "initial": 0.1, "decay_rate": 0.5, "step_size": 10}})
+        params = {"w": jnp.ones(2)}
+        st = tx.init(params)
+        upd, _ = tx.update({"w": jnp.ones(2)}, st, params)
+        np.testing.assert_allclose(np.asarray(upd["w"]), -0.1, rtol=1e-6)
+
+    def test_grad_clipping(self):
+        tx = updaters.build({"type": "sgd", "learning_rate": 1.0},
+                            gradient_normalization="ClipL2PerLayer",
+                            gradient_normalization_threshold=1.0)
+        params = {"layer0": {"w": jnp.ones(4) * 100.0}}
+        st = tx.init(params)
+        upd, _ = tx.update({"layer0": {"w": jnp.ones(4) * 100.0}}, st, params)
+        n = float(jnp.linalg.norm(upd["layer0"]["w"]))
+        assert n <= 1.0 + 1e-5
+
+    def test_l2_decay(self):
+        tx = updaters.build({"type": "sgd", "learning_rate": 1.0}, l2=0.1)
+        params = {"w": jnp.array([10.0])}
+        st = tx.init(params)
+        upd, _ = tx.update({"w": jnp.array([0.0])}, st, params)
+        np.testing.assert_allclose(np.asarray(upd["w"]), -1.0, rtol=1e-5)
+
+
+class TestSchedules:
+    def test_step(self):
+        s = schedules.step_schedule(0.1, 0.5, 10)
+        assert abs(float(s(0)) - 0.1) < 1e-7
+        assert abs(float(s(10)) - 0.05) < 1e-7
+        assert abs(float(s(25)) - 0.025) < 1e-7
+
+    def test_poly(self):
+        s = schedules.poly(1.0, 2.0, 100)
+        assert abs(float(s(0)) - 1.0) < 1e-6
+        assert float(s(100)) == 0.0
+
+    def test_exponential(self):
+        s = schedules.exponential(1.0, 0.9)
+        np.testing.assert_allclose(float(s(jnp.asarray(2))), 0.81, rtol=1e-5)
+
+    def test_map(self):
+        s = schedules.map_schedule({0: 0.1, 100: 0.01})
+        assert abs(float(s(50)) - 0.1) < 1e-7
+        assert abs(float(s(150)) - 0.01) < 1e-7
+
+    def test_warmup_cosine(self):
+        s = schedules.warmup_cosine(1.0, 10, 100)
+        assert float(s(5)) == 0.5
+        np.testing.assert_allclose(float(s(10)), 1.0, rtol=1e-5)
+        assert float(s(100)) < 1e-6
+
+    def test_from_config(self):
+        s = schedules.from_config({"type": "inverse", "initial": 0.5, "gamma": 0.1, "power": 1.0})
+        np.testing.assert_allclose(float(s(0)), 0.5, rtol=1e-6)
+
+
+class TestRegularization:
+    def test_dropout_train_vs_eval(self):
+        x = jnp.ones((100, 100))
+        key = jax.random.PRNGKey(0)
+        y_train = regularization.dropout(key, x, 0.5, training=True)
+        y_eval = regularization.dropout(key, x, 0.5, training=False)
+        np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+        # inverted dropout preserves expectation
+        assert abs(float(y_train.mean()) - 1.0) < 0.05
+        assert float((y_train == 0).mean()) > 0.4
+
+    def test_spatial_dropout_drops_whole_channels(self):
+        x = jnp.ones((2, 8, 8, 32))
+        y = regularization.spatial_dropout(jax.random.PRNGKey(1), x, 0.5)
+        per_channel = np.asarray(y).reshape(2, 64, 32)
+        for b in range(2):
+            for c in range(32):
+                col = per_channel[b, :, c]
+                assert (col == 0).all() or (col > 0).all()
+
+    def test_constraints(self):
+        w = jnp.ones((4, 4)) * 10
+        wn = regularization.max_norm(w, 1.0)
+        assert float(jnp.linalg.norm(wn[:, 0])) <= 1.0 + 1e-5
+        assert float(regularization.non_negative(jnp.array([-1.0]))[0]) == 0.0
+        wu = regularization.unit_norm(w)
+        np.testing.assert_allclose(float(jnp.linalg.norm(wu[:, 0])), 1.0, rtol=1e-5)
+
+    def test_drop_connect(self):
+        params = {"w": jnp.ones((50, 50))}
+        out = regularization.drop_connect(jax.random.PRNGKey(0), params, 0.5)
+        assert float((out["w"] == 0).mean()) > 0.4
