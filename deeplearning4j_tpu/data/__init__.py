@@ -1,0 +1,18 @@
+"""Data plumbing (L0): iterators, normalizers, canned datasets — replaces
+DataVec + DL4J dataset iterator stack with a pure-Python pipeline feeding
+device-prefetched numpy batches."""
+
+from .datasets import (char_rnn_corpus, load_cifar10, load_iris, load_mnist,
+                       mnist_iterator)
+from .iterators import (ArrayIterator, AsyncIterator, BenchmarkIterator,
+                        DataSet, DataSetIterator, EarlyTerminationIterator,
+                        MultiDataSet, MultipleEpochsIterator, split_iterator)
+from .normalizers import (ImageScaler, MinMaxScaler, Normalizer, Standardize,
+                          VGG16Preprocessor)
+
+__all__ = ["ArrayIterator", "AsyncIterator", "BenchmarkIterator", "DataSet",
+           "DataSetIterator", "EarlyTerminationIterator", "ImageScaler",
+           "MinMaxScaler", "MultiDataSet", "MultipleEpochsIterator",
+           "Normalizer", "Standardize", "VGG16Preprocessor", "char_rnn_corpus",
+           "load_cifar10", "load_iris", "load_mnist", "mnist_iterator",
+           "split_iterator"]

@@ -1,0 +1,113 @@
+"""Canned datasets — parity with deeplearning4j-core fetchers (MNIST, EMNIST,
+Iris, CIFAR, ...; SURVEY.md §2.2). Zero-egress environment: loaders read
+local files when present (IDX/NumPy formats) and otherwise fall back to a
+deterministic synthetic replica with the same shapes/classes, so every example
+and test runs hermetically (the reference's fetchers download+cache;
+MnistDataFetcher.java)."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .iterators import ArrayIterator
+
+DATA_DIR = Path(os.environ.get("DL4J_TPU_DATA", Path.home() / ".deeplearning4j_tpu" / "data"))
+
+
+def _read_idx(path: Path) -> np.ndarray:
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+def _synthetic_images(n: int, h: int, w: int, c: int, num_classes: int, seed: int):
+    """Deterministic class-structured synthetic images: each class k gets a
+    distinct frequency pattern + noise, so models can actually learn."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, n)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    base = np.stack([np.sin(xx * (k + 1) * np.pi / w) * np.cos(yy * (k % 3 + 1) * np.pi / h)
+                     for k in range(num_classes)])  # (K, h, w)
+    imgs = base[labels][..., None] * 0.5 + rng.standard_normal((n, h, w, 1)).astype(np.float32) * 0.25
+    if c > 1:
+        imgs = np.repeat(imgs, c, axis=-1)
+    onehot = np.eye(num_classes, dtype=np.float32)[labels]
+    return imgs.astype(np.float32), onehot
+
+
+def load_mnist(train: bool = True, num_examples: Optional[int] = None,
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """MNIST as (N, 28, 28, 1) float [0,1] + one-hot labels.
+
+    Looks for IDX files under $DL4J_TPU_DATA/mnist/ (standard names);
+    synthesizes a replica otherwise.
+    """
+    split = "train" if train else "t10k"
+    d = DATA_DIR / "mnist"
+    img_p = next((p for p in [d / f"{split}-images-idx3-ubyte", d / f"{split}-images-idx3-ubyte.gz"] if p.exists()), None)
+    lab_p = next((p for p in [d / f"{split}-labels-idx1-ubyte", d / f"{split}-labels-idx1-ubyte.gz"] if p.exists()), None)
+    if img_p and lab_p:
+        imgs = _read_idx(img_p).astype(np.float32)[..., None] / 255.0
+        labels = np.eye(10, dtype=np.float32)[_read_idx(lab_p)]
+    else:
+        n = 8192 if train else 1024
+        imgs, labels = _synthetic_images(n, 28, 28, 1, 10, seed=0 if train else 1)
+        imgs = (imgs - imgs.min()) / (imgs.max() - imgs.min())
+    if num_examples:
+        imgs, labels = imgs[:num_examples], labels[:num_examples]
+    return imgs, labels
+
+
+def mnist_iterator(batch_size: int = 128, train: bool = True,
+                   num_examples: Optional[int] = None, seed: int = 0) -> ArrayIterator:
+    """MnistDataSetIterator parity."""
+    f, l = load_mnist(train, num_examples)
+    return ArrayIterator(f, l, batch_size, shuffle=train, seed=seed)
+
+
+def load_iris() -> Tuple[np.ndarray, np.ndarray]:
+    """IrisDataSetIterator parity — the classic 150x4; generated from the
+    published per-class statistics when no local copy exists."""
+    p = DATA_DIR / "iris.npy"
+    if p.exists():
+        d = np.load(p, allow_pickle=True).item()
+        return d["x"], d["y"]
+    rng = np.random.default_rng(42)
+    means = np.array([[5.01, 3.43, 1.46, 0.25], [5.94, 2.77, 4.26, 1.33], [6.59, 2.97, 5.55, 2.03]])
+    stds = np.array([[0.35, 0.38, 0.17, 0.11], [0.52, 0.31, 0.47, 0.20], [0.64, 0.32, 0.55, 0.27]])
+    xs, ys = [], []
+    for k in range(3):
+        xs.append(rng.standard_normal((50, 4)) * stds[k] + means[k])
+        ys.append(np.full(50, k))
+    x = np.concatenate(xs).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.concatenate(ys)]
+    return x, y
+
+
+def load_cifar10(train: bool = True, num_examples: Optional[int] = None):
+    """CifarDataSetIterator parity — (N, 32, 32, 3); synthetic fallback."""
+    n = num_examples or (4096 if train else 512)
+    imgs, labels = _synthetic_images(n, 32, 32, 3, 10, seed=2 if train else 3)
+    return imgs, labels
+
+
+def char_rnn_corpus(length: int = 100_000, seed: int = 0) -> Tuple[np.ndarray, dict]:
+    """Synthetic character corpus for the GravesLSTM char-RNN baseline config
+    (BASELINE.md #3) — Markov-structured text so an LSTM has signal to learn."""
+    rng = np.random.default_rng(seed)
+    words = ["the", "quick", "brown", "fox", "jumps", "over", "lazy", "dog",
+             "neural", "network", "tensor", "gradient", "descent", "learning"]
+    text = " ".join(rng.choice(words, size=length // 6))[:length]
+    vocab = sorted(set(text))
+    ch2id = {c: i for i, c in enumerate(vocab)}
+    ids = np.array([ch2id[c] for c in text], np.int32)
+    return ids, ch2id

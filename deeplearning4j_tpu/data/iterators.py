@@ -1,0 +1,232 @@
+"""Dataset iterators — parity with DL4J's DataSetIterator stack (SURVEY.md §2.1).
+
+Reference: ``datasets/iterator/AsyncDataSetIterator.java`` (background prefetch
+thread + device buffers), ``DataSetIteratorSplitter``, ``EarlyTermination*``,
+``impl/BenchmarkDataSetIterator.java:20`` (synthetic perf fixture),
+``MultipleEpochsIterator``, plus the ND4J ``DataSet``/``MultiDataSet`` records.
+
+TPU design: a ``DataSet`` is a (features, labels, masks) record of numpy/JAX
+arrays; iterators are plain Python iterables. ``AsyncIterator`` prefetches on
+a background thread and moves batches to device with ``jax.device_put`` so
+host->HBM transfer overlaps compute — the same double-buffering
+AsyncDataSetIterator does with its ETL thread, without the JVM queue machinery.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    """ND4J DataSet equivalent: features, labels, optional masks."""
+
+    features: Any
+    labels: Any
+    features_mask: Optional[Any] = None
+    labels_mask: Optional[Any] = None
+
+    @property
+    def num_examples(self) -> int:
+        return int(np.asarray(self.features).shape[0])
+
+    def to_device(self, device=None):
+        put = (lambda a: jax.device_put(a, device)) if device else jax.device_put
+        return DataSet(
+            put(self.features), put(self.labels),
+            put(self.features_mask) if self.features_mask is not None else None,
+            put(self.labels_mask) if self.labels_mask is not None else None,
+        )
+
+
+@dataclass
+class MultiDataSet:
+    """ND4J MultiDataSet: multiple feature/label arrays (ComputationGraph fit)."""
+
+    features: List[Any]
+    labels: List[Any]
+    features_masks: Optional[List[Any]] = None
+    labels_masks: Optional[List[Any]] = None
+
+
+class DataSetIterator:
+    """Base protocol; DL4J DataSetIterator parity (reset/batch/totalExamples)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    @property
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+
+class ArrayIterator(DataSetIterator):
+    """Iterate minibatches over in-memory arrays (ListDataSetIterator parity)."""
+
+    def __init__(self, features, labels, batch_size: int = 32, shuffle: bool = False,
+                 seed: int = 0, features_mask=None, labels_mask=None, drop_last: bool = False):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = np.asarray(features_mask) if features_mask is not None else None
+        self.labels_mask = np.asarray(labels_mask) if labels_mask is not None else None
+        self._batch = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def batch_size(self):
+        return self._batch
+
+    def __len__(self):
+        n = self.features.shape[0]
+        return n // self._batch if self.drop_last else -(-n // self._batch)
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        idx = np.arange(n)
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        end = n - n % self._batch if self.drop_last else n
+        for i in range(0, end, self._batch):
+            sl = idx[i : i + self._batch]
+            yield DataSet(
+                self.features[sl], self.labels[sl],
+                self.features_mask[sl] if self.features_mask is not None else None,
+                self.labels_mask[sl] if self.labels_mask is not None else None,
+            )
+
+
+class AsyncIterator(DataSetIterator):
+    """AsyncDataSetIterator.java equivalent: background-thread prefetch with a
+    bounded queue; batches are device_put on the worker so H2D transfer
+    overlaps the training step."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: Iterable[DataSet], queue_size: int = 4, device=None,
+                 to_device: bool = True):
+        self.base = base
+        self.queue_size = queue_size
+        self.device = device
+        self.to_device = to_device
+
+    @property
+    def batch_size(self):
+        return getattr(self.base, "batch_size", -1)
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for ds in self.base:
+                    q.put(ds.to_device(self.device) if self.to_device else ds)
+            except BaseException as e:  # propagate into consumer
+                err.append(e)
+            finally:
+                q.put(self._SENTINEL)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._SENTINEL:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]
+
+
+class BenchmarkIterator(DataSetIterator):
+    """BenchmarkDataSetIterator.java:20 — serves the SAME random batch
+    repeatedly, isolating compute from ETL for perf measurement."""
+
+    def __init__(self, feature_shape: Sequence[int], num_classes: int, batch_size: int,
+                 num_batches: int, seed: int = 0, dtype=np.float32):
+        rng = np.random.default_rng(seed)
+        self._features = rng.standard_normal((batch_size, *feature_shape)).astype(dtype)
+        labels = np.zeros((batch_size, num_classes), dtype)
+        labels[np.arange(batch_size), rng.integers(0, num_classes, batch_size)] = 1
+        self._labels = labels
+        self._batch = batch_size
+        self.num_batches = num_batches
+
+    @property
+    def batch_size(self):
+        return self._batch
+
+    def __len__(self):
+        return self.num_batches
+
+    def __iter__(self):
+        ds = DataSet(self._features, self._labels)
+        for _ in range(self.num_batches):
+            yield ds
+
+
+class EarlyTerminationIterator(DataSetIterator):
+    """EarlyTerminationDataSetIterator.java — cap the number of batches."""
+
+    def __init__(self, base: DataSetIterator, max_batches: int):
+        self.base = base
+        self.max_batches = max_batches
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+    def reset(self):
+        self.base.reset()
+
+    def __iter__(self):
+        for i, ds in enumerate(self.base):
+            if i >= self.max_batches:
+                break
+            yield ds
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """MultipleEpochsIterator.java — loop the base iterator N times."""
+
+    def __init__(self, base: DataSetIterator, epochs: int):
+        self.base = base
+        self.epochs = epochs
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            if hasattr(self.base, "reset"):
+                self.base.reset()
+            yield from self.base
+
+
+def split_iterator(features, labels, fraction_train: float, batch_size: int = 32,
+                   seed: int = 0, shuffle: bool = True) -> Tuple[ArrayIterator, ArrayIterator]:
+    """DataSetIteratorSplitter / SplitTestAndTrain parity."""
+    n = np.asarray(features).shape[0]
+    idx = np.arange(n)
+    np.random.default_rng(seed).shuffle(idx)
+    cut = int(n * fraction_train)
+    tr, te = idx[:cut], idx[cut:]
+    f, l = np.asarray(features), np.asarray(labels)
+    return (ArrayIterator(f[tr], l[tr], batch_size, shuffle=shuffle, seed=seed),
+            ArrayIterator(f[te], l[te], batch_size))

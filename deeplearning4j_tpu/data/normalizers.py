@@ -1,0 +1,136 @@
+"""Data normalizers — parity with ND4J's DataNormalization implementations
+(NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler,
+VGG16ImagePreProcessor) used throughout deeplearning4j-core datasets and
+saved into model zips as ``normalizer.bin`` (ModelSerializer.java:40)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+class Normalizer:
+    def fit(self, features: np.ndarray):
+        return self
+
+    def transform(self, features):
+        raise NotImplementedError
+
+    def revert(self, features):
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d: dict) -> "Normalizer":
+        kind = d["type"]
+        cls = {"standardize": Standardize, "minmax": MinMaxScaler,
+               "image_scaler": ImageScaler, "vgg16": VGG16Preprocessor}[kind]
+        return cls._from_dict(d)
+
+
+@dataclass
+class Standardize(Normalizer):
+    """NormalizerStandardize: (x - mean) / std per feature."""
+
+    mean: Optional[np.ndarray] = None
+    std: Optional[np.ndarray] = None
+
+    def fit(self, features):
+        f = np.asarray(features, np.float64)
+        axes = tuple(range(f.ndim - 1))
+        self.mean = f.mean(axis=axes).astype(np.float32)
+        self.std = np.maximum(f.std(axis=axes), 1e-6).astype(np.float32)
+        return self
+
+    def transform(self, features):
+        return (np.asarray(features) - self.mean) / self.std
+
+    def revert(self, features):
+        return np.asarray(features) * self.std + self.mean
+
+    def to_dict(self):
+        return {"type": "standardize", "mean": self.mean.tolist(), "std": self.std.tolist()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(np.asarray(d["mean"], np.float32), np.asarray(d["std"], np.float32))
+
+
+@dataclass
+class MinMaxScaler(Normalizer):
+    """NormalizerMinMaxScaler: scale to [lo, hi]."""
+
+    lo: float = 0.0
+    hi: float = 1.0
+    data_min: Optional[np.ndarray] = None
+    data_max: Optional[np.ndarray] = None
+
+    def fit(self, features):
+        f = np.asarray(features, np.float64)
+        axes = tuple(range(f.ndim - 1))
+        self.data_min = f.min(axis=axes).astype(np.float32)
+        self.data_max = f.max(axis=axes).astype(np.float32)
+        return self
+
+    def transform(self, features):
+        rng = np.maximum(self.data_max - self.data_min, 1e-8)
+        return (np.asarray(features) - self.data_min) / rng * (self.hi - self.lo) + self.lo
+
+    def revert(self, features):
+        rng = np.maximum(self.data_max - self.data_min, 1e-8)
+        return (np.asarray(features) - self.lo) / (self.hi - self.lo) * rng + self.data_min
+
+    def to_dict(self):
+        return {"type": "minmax", "lo": self.lo, "hi": self.hi,
+                "data_min": self.data_min.tolist(), "data_max": self.data_max.tolist()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["lo"], d["hi"], np.asarray(d["data_min"], np.float32),
+                   np.asarray(d["data_max"], np.float32))
+
+
+@dataclass
+class ImageScaler(Normalizer):
+    """ImagePreProcessingScaler: pixel [0, maxval] -> [lo, hi] (default [0,1])."""
+
+    lo: float = 0.0
+    hi: float = 1.0
+    max_pixel: float = 255.0
+
+    def transform(self, features):
+        return np.asarray(features, np.float32) / self.max_pixel * (self.hi - self.lo) + self.lo
+
+    def revert(self, features):
+        return (np.asarray(features) - self.lo) / (self.hi - self.lo) * self.max_pixel
+
+    def to_dict(self):
+        return {"type": "image_scaler", "lo": self.lo, "hi": self.hi, "max_pixel": self.max_pixel}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["lo"], d["hi"], d["max_pixel"])
+
+
+@dataclass
+class VGG16Preprocessor(Normalizer):
+    """VGG16ImagePreProcessor: subtract ImageNet BGR means (NHWC, RGB order here)."""
+
+    means: tuple = (123.68, 116.779, 103.939)
+
+    def transform(self, features):
+        return np.asarray(features, np.float32) - np.asarray(self.means, np.float32)
+
+    def revert(self, features):
+        return np.asarray(features) + np.asarray(self.means, np.float32)
+
+    def to_dict(self):
+        return {"type": "vgg16", "means": list(self.means)}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(tuple(d["means"]))

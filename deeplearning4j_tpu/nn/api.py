@@ -1,0 +1,129 @@
+"""Layer API — the TPU-native contract replacing DL4J's ``nn/api/Layer.java``.
+
+DL4J's Layer is a stateful object with ``activate`` (Layer.java:124) and
+``backpropGradient`` (Layer.java:88) methods mutating internal buffers. The
+TPU-native contract is *config-as-data + pure functions*:
+
+- A ``Layer`` subclass is a frozen dataclass of hyperparameters — JSON
+  serializable, like DL4J's ``nn/conf/layers/*`` Builder products.
+- ``init(key, input_shape)`` returns ``(params, state)`` pytrees (state =
+  non-trained variables such as batch-norm running stats; empty dict if none).
+- ``apply(params, state, x, *, training, rng, mask)`` returns
+  ``(y, new_state, out_mask)`` — a pure function, so ``jax.grad`` replaces
+  ``backpropGradient`` entirely and XLA fuses across layer boundaries
+  (the reference dispatches one JNI kernel per op — SURVEY.md §3.1).
+- Mask propagation mirrors ``Layer.feedForwardMaskArray`` (Layer.java:288).
+
+Serde: ``layer.to_dict()`` / ``layer_from_dict`` round-trips through JSON with
+a ``"@type"`` tag — parity with DL4J's Jackson-polymorphic config JSON
+(``nn/conf/serde/``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+Params = Dict[str, Any]
+State = Dict[str, Any]
+Shape = Tuple[int, ...]
+
+LAYER_REGISTRY: Dict[str, Type["Layer"]] = {}
+
+
+def register_layer(cls: Type["Layer"]) -> Type["Layer"]:
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclass(frozen=True)
+class Layer:
+    """Base hyperparameter record for all layers.
+
+    Subclasses are frozen dataclasses; every field must be JSON-serializable
+    (strings/numbers/lists/dicts) so configs round-trip like DL4J's JSON.
+    """
+
+    name: Optional[str] = None
+    # Per-layer overrides (DL4J: every layer conf can override the global
+    # updater / regularization; None = inherit from NetConfig).
+    updater: Optional[dict] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[Any] = None  # float rate or {"type": ...} (applied to *input*, DL4J semantics)
+    weight_init: Optional[str] = None
+    constraint: Optional[Any] = None
+
+    # --- shape/param contract ---
+    def output_shape(self, input_shape: Shape) -> Shape:
+        """Feature shape (without batch dim) given input feature shape."""
+        return input_shape
+
+    def init(self, key: Array, input_shape: Shape, dtype=jnp.float32) -> Tuple[Params, State]:
+        return {}, {}
+
+    def apply(self, params: Params, state: State, x: Array, *, training: bool = False,
+              rng: Optional[Array] = None, mask: Optional[Array] = None,
+              ) -> Tuple[Array, State, Optional[Array]]:
+        raise NotImplementedError
+
+    # --- convenience ---
+    def has_params(self) -> bool:
+        return True
+
+    def param_count(self, input_shape: Shape) -> int:
+        p, _ = self.init(jax.random.PRNGKey(0), input_shape)
+        return sum(int(jnp.size(v)) for v in jax.tree_util.tree_leaves(p))
+
+    # --- serde ---
+    def to_dict(self) -> dict:
+        def norm(v):
+            if isinstance(v, tuple):
+                return [norm(x) for x in v]
+            if isinstance(v, list):
+                return [norm(x) for x in v]
+            if isinstance(v, dict):
+                return {k: norm(x) for k, x in v.items()}
+            return v
+
+        d = {"@type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is not None and v != f.default:
+                d[f.name] = norm(v)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Layer":
+        d = dict(d)
+        d.pop("@type", None)
+        return cls(**d)
+
+
+def layer_from_dict(d: dict) -> Layer:
+    kind = d.get("@type")
+    if kind not in LAYER_REGISTRY:
+        raise ValueError(f"Unknown layer type '{kind}'. Known: {sorted(LAYER_REGISTRY)}")
+    return LAYER_REGISTRY[kind].from_dict(d)
+
+
+def split_rng(rng: Optional[Array], n: int):
+    if rng is None:
+        return [None] * n
+    return list(jax.random.split(rng, n))
+
+
+def apply_input_dropout(layer: Layer, x: Array, rng: Optional[Array], training: bool) -> Array:
+    """DL4J applies a layer's dropout to its *input* activations."""
+    if layer.dropout is None or not training:
+        return x
+    from ..ops.regularization import apply_dropout_config
+
+    if rng is None:
+        raise ValueError(f"Layer {layer.name or type(layer).__name__} has dropout but no rng was provided")
+    return apply_dropout_config(rng, x, layer.dropout, training)

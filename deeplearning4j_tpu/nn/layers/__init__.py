@@ -1,0 +1,30 @@
+"""Layer catalog — parity with DL4J's ~45 layer types (SURVEY.md §2.1 layer
+configs) plus TPU-first attention/transformer layers."""
+
+from .attention import (MultiHeadAttention, PositionalEmbedding,
+                        TransformerEncoderBlock, dot_product_attention)
+from .conv import (Conv1D, Conv2D, Cropping2D, Deconv2D, DepthwiseConv2D,
+                   SeparableConv2D, SpaceToBatch, SpaceToDepth, Subsampling1D,
+                   Subsampling2D, Upsampling1D, Upsampling2D, ZeroPadding1D,
+                   ZeroPadding2D)
+from .core import (ActivationLayer, CenterLossOutput, CnnLossLayer, Dense,
+                   DropoutLayer, ElementWiseMultiplication, Embedding,
+                   EmbeddingSequence, LossLayer, Output, PReLU, RnnOutput)
+from .norm import LRN, BatchNorm, LayerNorm, RMSNorm
+from .pooling import Flatten, GlobalPooling, Reshape
+from .recurrent import (GRU, LSTM, Bidirectional, GravesLSTM, LastTimeStep,
+                        RecurrentLayer, SimpleRnn)
+from .special import VAE, AutoEncoder, Frozen, Yolo2Output
+
+__all__ = [
+    "ActivationLayer", "AutoEncoder", "BatchNorm", "Bidirectional",
+    "CenterLossOutput", "CnnLossLayer", "Conv1D", "Conv2D", "Cropping2D",
+    "Deconv2D", "Dense", "DepthwiseConv2D", "DropoutLayer",
+    "ElementWiseMultiplication", "Embedding", "EmbeddingSequence", "Flatten",
+    "Frozen", "GRU", "GlobalPooling", "GravesLSTM", "LRN", "LSTM", "LastTimeStep",
+    "LayerNorm", "LossLayer", "MultiHeadAttention", "Output", "PReLU",
+    "PositionalEmbedding", "RMSNorm", "RecurrentLayer", "Reshape", "RnnOutput",
+    "SeparableConv2D", "SimpleRnn", "SpaceToBatch", "SpaceToDepth",
+    "Subsampling1D", "Subsampling2D", "TransformerEncoderBlock", "Upsampling1D",
+    "Upsampling2D", "VAE", "Yolo2Output", "ZeroPadding1D", "ZeroPadding2D",
+]

@@ -1,0 +1,139 @@
+"""Attention layers — the modern sequence stack (BERT-import target + long-context).
+
+DL4J 0.9.x predates attention entirely (SURVEY.md §5: "no attention layers at
+all"); the driver's stretch config is a Keras-imported BERT-base, and
+long-context support is first-class in this framework. These layers are
+designed TPU-first:
+
+- one fused QKV projection (a single MXU matmul),
+- scores computed in fp32 regardless of input dtype (bf16-safe softmax),
+- optional blockwise computation compatible with ring attention over a
+  sequence-parallel mesh axis (parallel/ring_attention.py wires the
+  collective-permute loop around ``attend_blockwise``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import activations, initializers
+from ..api import Array, Layer, Shape, apply_input_dropout, register_layer
+
+
+def dot_product_attention(q, k, v, *, mask=None, scale=None):
+    """(B, T, Hd, D) attention with fp32 accumulation. mask: (B, 1|H, Tq, Tk) additive or bool."""
+    *_, D = q.shape
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -1e30)
+        else:
+            scores = scores + mask
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+@register_layer
+@dataclass(frozen=True)
+class MultiHeadAttention(Layer):
+    """Fused-QKV multi-head self-attention. Input (B, T, D) -> (B, T, D)."""
+
+    num_heads: int = 8
+    causal: bool = False
+    attn_dropout: float = 0.0
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        d = input_shape[-1]
+        k1, k2 = jax.random.split(key)
+        wqkv = initializers.init_param(k1, self.weight_init or "xavier", (d, 3 * d), dtype=dtype)
+        wo = initializers.init_param(k2, self.weight_init or "xavier", (d, d), dtype=dtype)
+        return {"w_qkv": wqkv, "b_qkv": jnp.zeros((3 * d,), dtype),
+                "w_o": wo, "b_o": jnp.zeros((d,), dtype)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        B, T, D = x.shape
+        H = self.num_heads
+        qkv = x @ params["w_qkv"] + params["b_qkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D // H)
+        k = k.reshape(B, T, H, D // H)
+        v = v.reshape(B, T, H, D // H)
+        attn_mask = None
+        if self.causal:
+            causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+            attn_mask = causal[None, None]
+        if mask is not None:
+            key_mask = mask[:, None, None, :].astype(jnp.bool_)  # (B,1,1,Tk)
+            attn_mask = key_mask if attn_mask is None else (attn_mask & key_mask)
+        y = dot_product_attention(q, k, v, mask=attn_mask)
+        y = y.reshape(B, T, D) @ params["w_o"] + params["b_o"]
+        return y, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class TransformerEncoderBlock(Layer):
+    """Pre-LN transformer block: LN -> MHA -> +res -> LN -> MLP -> +res."""
+
+    num_heads: int = 8
+    mlp_ratio: int = 4
+    activation: str = "gelu"
+    causal: bool = False
+    dropout_rate: float = 0.0
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        d = input_shape[-1]
+        k1, k2, k3 = jax.random.split(key, 3)
+        mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal)
+        attn_params, _ = mha.init(k1, input_shape, dtype)
+        h = d * self.mlp_ratio
+        return {
+            "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
+            "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
+            "attn": attn_params,
+            "w_up": initializers.init_param(k2, "xavier", (d, h), dtype=dtype),
+            "b_up": jnp.zeros((h,), dtype),
+            "w_down": initializers.init_param(k3, "xavier", (h, d), dtype=dtype),
+            "b_down": jnp.zeros((d,), dtype),
+        }, {}
+
+    @staticmethod
+    def _ln(x, g, b, eps=1e-6):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal)
+        h = self._ln(x, params["ln1_g"], params["ln1_b"])
+        a, _, _ = mha.apply(params["attn"], {}, h, training=training, rng=rng, mask=mask)
+        x = x + a
+        h = self._ln(x, params["ln2_g"], params["ln2_b"])
+        act = activations.get(self.activation)
+        m = act(h @ params["w_up"] + params["b_up"]) @ params["w_down"] + params["b_down"]
+        if training and self.dropout_rate > 0 and rng is not None:
+            from ...ops.regularization import dropout as do
+
+            m = do(rng, m, self.dropout_rate, True)
+        return x + m, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class PositionalEmbedding(Layer):
+    """Learned positional embedding added to (B, T, D) inputs."""
+
+    max_len: int = 512
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        d = input_shape[-1]
+        return {"pos": 0.02 * jax.random.normal(key, (self.max_len, d), dtype)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        T = x.shape[1]
+        return x + params["pos"][:T], state, mask

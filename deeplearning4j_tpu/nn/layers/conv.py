@@ -1,0 +1,522 @@
+"""Convolution / pooling / spatial layers — NHWC, XLA-native.
+
+Reference parity: ``nn/conf/layers/ConvolutionLayer.java`` (+1D),
+``Deconvolution2D``, ``SeparableConvolution2D``, ``DepthwiseConvolution2D``,
+``SubsamplingLayer`` (+1D), ``Upsampling1D/2D``, ``ZeroPadding1D/2D``,
+``Cropping1D/2D``, ``SpaceToBatchLayer``, ``SpaceToDepthLayer``.
+
+TPU design: the reference lowers conv to im2col+GEMM per call
+(``ConvolutionLayer.java:204-213``) or cuDNN (§2.3). Here every conv is one
+``lax.conv_general_dilated`` that XLA tiles directly onto the MXU — the entire
+"helper" layer of the reference (deeplearning4j-cuda) is subsumed by the
+compiler. Layout is NHWC (TPU-preferred; channels-last vectorizes the 128-lane
+VPU and feeds the MXU without transposes). Weights are HWIO.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops import activations, initializers
+from ..api import Array, Layer, Shape, apply_input_dropout, register_layer
+
+IntPair = Union[int, Sequence[int]]
+
+
+def _pair(v: IntPair) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    return tuple(int(x) for x in v)  # type: ignore
+
+
+def _conv_out(size, k, s, pad):
+    if pad == "same":
+        return -(-size // s)
+    return (size - k) // s + 1
+
+
+def _padding(pad, kernel) -> Union[str, Sequence[Tuple[int, int]]]:
+    """DL4J ConvolutionMode {Same, Truncate, Strict} + explicit padding."""
+    if isinstance(pad, str):
+        return pad.upper()
+    p = _pair(pad)
+    return [(p[0], p[0]), (p[1], p[1])]
+
+
+@register_layer
+@dataclass(frozen=True)
+class Conv2D(Layer):
+    """ConvolutionLayer.java — 2D conv, NHWC, one XLA HLO op onto the MXU."""
+
+    n_out: int = 0
+    kernel: IntPair = (3, 3)
+    stride: IntPair = (1, 1)
+    padding: Union[str, IntPair] = "same"  # "same" | "valid" | explicit (ph, pw)
+    dilation: IntPair = (1, 1)
+    activation: str = "identity"
+    use_bias: bool = True
+    groups: int = 1
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        h, w, _ = input_shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+        if self.padding == "same":
+            oh, ow = -(-h // sh), -(-w // sw)
+        elif self.padding == "valid":
+            oh, ow = (h - ekh) // sh + 1, (w - ekw) // sw + 1
+        else:
+            ph, pw = _pair(self.padding)  # type: ignore
+            oh, ow = (h + 2 * ph - ekh) // sh + 1, (w + 2 * pw - ekw) // sw + 1
+        return (oh, ow, self.n_out)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        wk, _ = jax.random.split(key)
+        w = initializers.init_param(wk, self.weight_init or "relu", (kh, kw, c_in // self.groups, self.n_out),
+                                    kind="conv", dtype=dtype)
+        params = {"w": w}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = apply_input_dropout(self, x, rng, training)
+        y = lax.conv_general_dilated(
+            x, params["w"],
+            window_strides=_pair(self.stride),
+            padding=_padding(self.padding, self.kernel),
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+        )
+        if self.use_bias:
+            y = y + params["b"]
+        return activations.get(self.activation)(y), state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class Conv1D(Layer):
+    """Convolution1DLayer.java — over (B, T, C); lowered as a width-1 2D conv."""
+
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    padding: Union[str, int] = "same"
+    dilation: int = 1
+    activation: str = "identity"
+    use_bias: bool = True
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        t, _ = input_shape
+        ek = (self.kernel - 1) * self.dilation + 1
+        if self.padding == "same":
+            ot = -(-t // self.stride)
+        elif self.padding == "valid":
+            ot = (t - ek) // self.stride + 1
+        else:
+            ot = (t + 2 * int(self.padding) - ek) // self.stride + 1
+        return (ot, self.n_out)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = input_shape[-1]
+        w = initializers.init_param(key, self.weight_init or "relu", (self.kernel, c_in, self.n_out),
+                                    kind="conv", dtype=dtype)
+        params = {"w": w}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = apply_input_dropout(self, x, rng, training)
+        pad = self.padding if isinstance(self.padding, str) else [(self.padding, self.padding)]
+        if isinstance(pad, str):
+            pad = pad.upper()
+        y = lax.conv_general_dilated(
+            x, params["w"], window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(self.dilation,), dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.use_bias:
+            y = y + params["b"]
+        out_mask = None
+        if mask is not None:
+            # stride shrinks the time axis; subsample the mask (DL4J Convolution1DUtils)
+            out_mask = mask[:, :: self.stride] if self.stride > 1 else mask
+        return activations.get(self.activation)(y), state, out_mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class Deconv2D(Layer):
+    """Deconvolution2D.java — transposed conv via lax.conv_transpose."""
+
+    n_out: int = 0
+    kernel: IntPair = (2, 2)
+    stride: IntPair = (2, 2)
+    padding: Union[str, IntPair] = "valid"
+    activation: str = "identity"
+    use_bias: bool = True
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        h, w, _ = input_shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        if self.padding == "same":
+            oh, ow = h * sh, w * sw
+        elif self.padding == "valid":
+            oh, ow = (h - 1) * sh + kh, (w - 1) * sw + kw
+        else:
+            ph, pw = _pair(self.padding)  # type: ignore
+            oh, ow = (h - 1) * sh + kh - 2 * ph, (w - 1) * sw + kw - 2 * pw
+        return (oh, ow, self.n_out)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        w = initializers.init_param(key, self.weight_init or "relu", (kh, kw, c_in, self.n_out),
+                                    kind="conv", dtype=dtype)
+        params = {"w": w}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        if isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            p = _pair(self.padding)
+            pad = [(p[0], p[0]), (p[1], p[1])]
+        y = lax.conv_transpose(x, params["w"], strides=_pair(self.stride), padding=pad,
+                               dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["b"]
+        return activations.get(self.activation)(y), state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class DepthwiseConv2D(Layer):
+    """DepthwiseConvolution2D.java — per-channel conv (feature_group_count=C)."""
+
+    depth_multiplier: int = 1
+    kernel: IntPair = (3, 3)
+    stride: IntPair = (1, 1)
+    padding: Union[str, IntPair] = "same"
+    activation: str = "identity"
+    use_bias: bool = True
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        h, w, c = input_shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        if self.padding == "same":
+            oh, ow = -(-h // sh), -(-w // sw)
+        elif self.padding == "valid":
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        else:
+            ph, pw = _pair(self.padding)  # type: ignore
+            oh, ow = (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+        return (oh, ow, c * self.depth_multiplier)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        w = initializers.init_param(key, self.weight_init or "relu",
+                                    (kh, kw, 1, c_in * self.depth_multiplier), kind="conv", dtype=dtype)
+        params = {"w": w}
+        if self.use_bias:
+            params["b"] = jnp.zeros((c_in * self.depth_multiplier,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        c_in = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x, params["w"], window_strides=_pair(self.stride),
+            padding=_padding(self.padding, self.kernel),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c_in)
+        if self.use_bias:
+            y = y + params["b"]
+        return activations.get(self.activation)(y), state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class SeparableConv2D(Layer):
+    """SeparableConvolution2D.java — depthwise + 1x1 pointwise."""
+
+    n_out: int = 0
+    kernel: IntPair = (3, 3)
+    stride: IntPair = (1, 1)
+    padding: Union[str, IntPair] = "same"
+    depth_multiplier: int = 1
+    activation: str = "identity"
+    use_bias: bool = True
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        dw = DepthwiseConv2D(kernel=self.kernel, stride=self.stride, padding=self.padding,
+                             depth_multiplier=self.depth_multiplier)
+        h, w, _ = dw.output_shape(input_shape)
+        return (h, w, self.n_out)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        k1, k2 = jax.random.split(key)
+        wd = initializers.init_param(k1, self.weight_init or "relu",
+                                     (kh, kw, 1, c_in * self.depth_multiplier), kind="conv", dtype=dtype)
+        wp = initializers.init_param(k2, self.weight_init or "relu",
+                                     (1, 1, c_in * self.depth_multiplier, self.n_out), kind="conv", dtype=dtype)
+        params = {"w_depth": wd, "w_point": wp}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        c_in = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x, params["w_depth"], window_strides=_pair(self.stride),
+            padding=_padding(self.padding, self.kernel),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c_in)
+        y = lax.conv_general_dilated(
+            y, params["w_point"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["b"]
+        return activations.get(self.activation)(y), state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class Subsampling2D(Layer):
+    """SubsamplingLayer.java — MAX / AVG / SUM / PNORM pooling via reduce_window."""
+
+    kernel: IntPair = (2, 2)
+    stride: IntPair = (2, 2)
+    padding: Union[str, IntPair] = "valid"
+    mode: str = "max"  # max | avg | sum | pnorm
+    pnorm: int = 2
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        h, w, c = input_shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        if self.padding == "same":
+            oh, ow = -(-h // sh), -(-w // sw)
+        elif self.padding == "valid":
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        else:
+            ph, pw = _pair(self.padding)  # type: ignore
+            oh, ow = (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+        return (oh, ow, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        if isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            ph, pw = _pair(self.padding)
+            pad = [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+        dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+        if self.mode == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        elif self.mode in ("avg", "sum"):
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            if self.mode == "avg":
+                y = y / (kh * kw)
+        elif self.mode == "pnorm":
+            y = lax.reduce_window(jnp.abs(x) ** self.pnorm, 0.0, lax.add, dims, strides, pad)
+            y = y ** (1.0 / self.pnorm)
+        else:
+            raise ValueError(f"Unknown pooling mode {self.mode}")
+        return y, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class Subsampling1D(Layer):
+    """Subsampling1DLayer.java over (B, T, C)."""
+
+    kernel: int = 2
+    stride: int = 2
+    padding: Union[str, int] = "valid"
+    mode: str = "max"
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        t, c = input_shape
+        if self.padding == "same":
+            ot = -(-t // self.stride)
+        elif self.padding == "valid":
+            ot = (t - self.kernel) // self.stride + 1
+        else:
+            ot = (t + 2 * int(self.padding) - self.kernel) // self.stride + 1
+        return (ot, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        if isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            pad = [(0, 0), (int(self.padding), int(self.padding)), (0, 0)]
+        dims, strides = (1, self.kernel, 1), (1, self.stride, 1)
+        if self.mode == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            if self.mode == "avg":
+                y = y / self.kernel
+        out_mask = mask[:, :: self.stride] if (mask is not None and self.stride > 1) else mask
+        return y, state, out_mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class Upsampling2D(Layer):
+    """Upsampling2D.java — nearest-neighbor repeat."""
+
+    size: IntPair = (2, 2)
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        h, w, c = input_shape
+        sh, sw = _pair(self.size)
+        return (h * sh, w * sw, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        sh, sw = _pair(self.size)
+        y = jnp.repeat(jnp.repeat(x, sh, axis=1), sw, axis=2)
+        return y, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class Upsampling1D(Layer):
+    size: int = 2
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        t, c = input_shape
+        return (t * self.size, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        return jnp.repeat(x, self.size, axis=1), state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class ZeroPadding2D(Layer):
+    """ZeroPaddingLayer.java — (top, bottom, left, right)."""
+
+    padding: Sequence[int] = (1, 1, 1, 1)
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        h, w, c = input_shape
+        t, b, l, r = self.padding
+        return (h + t + b, w + l + r, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class ZeroPadding1D(Layer):
+    padding: Sequence[int] = (1, 1)
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        t, c = input_shape
+        l, r = self.padding
+        return (t + l + r, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        l, r = self.padding
+        return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class Cropping2D(Layer):
+    """Cropping2D.java — (top, bottom, left, right)."""
+
+    cropping: Sequence[int] = (0, 0, 0, 0)
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        h, w, c = input_shape
+        t, b, l, r = self.cropping
+        return (h - t - b, w - l - r, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        t, b, l, r = self.cropping
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t : h - b, l : w - r, :], state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class SpaceToDepth(Layer):
+    """SpaceToDepthLayer.java — rearrange (H*b, W*b, C) -> (H, W, C*b*b)."""
+
+    block_size: int = 2
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        h, w, c = input_shape
+        b = self.block_size
+        return (h // b, w // b, c * b * b)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        B, H, W, C = x.shape
+        b = self.block_size
+        y = x.reshape(B, H // b, b, W // b, b, C).transpose(0, 1, 3, 2, 4, 5).reshape(B, H // b, W // b, b * b * C)
+        return y, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class SpaceToBatch(Layer):
+    """SpaceToBatchLayer.java — move spatial blocks into the batch dim."""
+
+    block_size: int = 2
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        h, w, c = input_shape
+        b = self.block_size
+        return (h // b, w // b, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        B, H, W, C = x.shape
+        b = self.block_size
+        y = x.reshape(B, H // b, b, W // b, b, C).transpose(2, 4, 0, 1, 3, 5).reshape(B * b * b, H // b, W // b, C)
+        return y, state, mask

@@ -1,0 +1,128 @@
+"""Normalization layers: BatchNorm, LRN, LayerNorm, RMSNorm.
+
+Reference parity: ``nn/conf/layers/BatchNormalization.java`` (running stats as
+mutable state, gamma/beta params, lockGammaBeta option) and
+``LocalResponseNormalization.java``. LayerNorm/RMSNorm are TPU-first additions
+required by the transformer/long-context model families (absent from DL4J 0.9,
+which predates attention).
+
+BatchNorm state follows the functional-state convention: running mean/var live
+in the ``state`` pytree; ``apply`` in training mode returns the EMA-updated
+state (the caller threads it), replacing DL4J's in-place helper mutation
+(CudnnBatchNormalizationHelper — SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops import activations
+from ..api import Array, Layer, Shape, register_layer
+
+
+@register_layer
+@dataclass(frozen=True)
+class BatchNorm(Layer):
+    """BatchNormalization.java — normalizes over all axes but the last (feature)."""
+
+    decay: float = 0.9  # EMA decay for running stats (DL4J `decay`)
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False  # DL4J lockGammaBeta: fixed gamma=1, beta=0
+    activation: str = "identity"
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n = input_shape[-1]
+        params = {}
+        if not self.lock_gamma_beta:
+            params = {"gamma": jnp.ones((n,), dtype), "beta": jnp.zeros((n,), dtype)}
+        state = {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+        return params, state
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if not self.lock_gamma_beta:
+            y = y * params["gamma"] + params["beta"]
+        return activations.get(self.activation)(y), new_state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class LRN(Layer):
+    """LocalResponseNormalization.java — cross-channel (AlexNet-era).
+
+    y = x / (k + alpha/n * sum_{j in window} x_j^2)^beta over the channel axis.
+    Implemented as a reduce_window over channels; XLA fuses the whole thing.
+    """
+
+    n: int = 5
+    k: float = 2.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        half = self.n // 2
+        sq = jnp.square(x)
+        window = (1,) * (x.ndim - 1) + (self.n,)
+        pad = [(0, 0)] * (x.ndim - 1) + [(half, self.n - 1 - half)]
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window, (1,) * x.ndim, pad)
+        denom = jnp.power(self.k + (self.alpha / self.n) * ssum, self.beta)
+        return x / denom, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class LayerNorm(Layer):
+    """Per-example normalization over the feature axis (transformer standard)."""
+
+    eps: float = 1e-6
+    use_bias: bool = True
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n = input_shape[-1]
+        params = {"gamma": jnp.ones((n,), dtype)}
+        if self.use_bias:
+            params["beta"] = jnp.zeros((n,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * lax.rsqrt(var + self.eps) * params["gamma"]
+        if self.use_bias:
+            y = y + params["beta"]
+        return y, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class RMSNorm(Layer):
+    """RMS normalization (LLaMA-style) — cheaper than LayerNorm on the VPU."""
+
+    eps: float = 1e-6
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return {"gamma": jnp.ones((input_shape[-1],), dtype)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return x * lax.rsqrt(ms + self.eps) * params["gamma"], state, mask

@@ -1,0 +1,99 @@
+"""Global pooling + reshaping glue layers.
+
+Reference parity: ``nn/conf/layers/GlobalPoolingLayer.java`` (PoolingType MAX,
+AVG, SUM, PNORM, with mask-aware time-series reduction — see
+MaskedReductionUtil.java) and the flatten/reshape preprocessors
+(``nn/conf/preprocessor/CnnToFeedForwardPreProcessor.java`` etc. — in the
+TPU design these are just layers, since layout transforms are free under XLA).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..api import Array, Layer, Shape, register_layer
+
+
+@register_layer
+@dataclass(frozen=True)
+class GlobalPooling(Layer):
+    """GlobalPoolingLayer.java — reduce all non-batch, non-feature axes.
+
+    For (B, T, F) inputs with a (B, T) mask, reduction honors the mask exactly
+    as MaskedReductionUtil does (masked steps excluded from max/avg/sum).
+    """
+
+    mode: str = "avg"  # max | avg | sum | pnorm
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return (input_shape[-1],)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        axes = tuple(range(1, x.ndim - 1))
+        if mask is not None and x.ndim == 3:
+            m = mask.astype(x.dtype)[..., None]  # (B, T, 1)
+            if self.mode == "max":
+                y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+            elif self.mode == "sum":
+                y = jnp.sum(x * m, axis=1)
+            elif self.mode == "avg":
+                y = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            elif self.mode == "pnorm":
+                y = jnp.sum(jnp.abs(x * m) ** self.pnorm, axis=1) ** (1.0 / self.pnorm)
+            else:
+                raise ValueError(self.mode)
+            return y, state, None
+        if self.mode == "max":
+            y = jnp.max(x, axis=axes)
+        elif self.mode == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif self.mode == "avg":
+            y = jnp.mean(x, axis=axes)
+        elif self.mode == "pnorm":
+            y = jnp.sum(jnp.abs(x) ** self.pnorm, axis=axes) ** (1.0 / self.pnorm)
+        else:
+            raise ValueError(self.mode)
+        return y, state, None
+
+
+@register_layer
+@dataclass(frozen=True)
+class Flatten(Layer):
+    """CnnToFeedForwardPreProcessor equivalent — (B, ...) -> (B, prod)."""
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        n = 1
+        for s in input_shape:
+            n *= s
+        return (n,)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        return x.reshape(x.shape[0], -1), state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class Reshape(Layer):
+    """ReshapeVertex equivalent as a layer; target shape excludes batch dim."""
+
+    shape: Sequence[int] = ()
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(self.shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        return x.reshape((x.shape[0],) + tuple(self.shape)), state, mask

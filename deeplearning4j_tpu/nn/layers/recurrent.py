@@ -1,0 +1,353 @@
+"""Recurrent layers: LSTM, GravesLSTM (peepholes), SimpleRnn, Bidirectional.
+
+Reference parity: ``nn/layers/recurrent/LSTMHelpers.java`` (785 LoC shared
+fwd/bwd math for LSTM + GravesLSTM + bidirectional; activateHelper at :68),
+``nn/conf/layers/{LSTM,GravesLSTM,GravesBidirectionalLSTM,SimpleRnn}.java``,
+``Bidirectional.java`` (Mode ADD/MUL/AVERAGE/CONCAT), ``LastTimeStep.java``,
+and the RecurrentLayer interface (rnnTimeStep / rnnGetPreviousState /
+tBPTT state, ``nn/api/layers/RecurrentLayer.java``).
+
+TPU design: the reference hand-writes backprop through time in Java; here the
+recurrence is ``lax.scan`` (XLA compiles one fused loop; ``jax.grad``
+differentiates through it, replacing backpropGradientHelper at :392). The
+input projection x@W_ih for ALL timesteps is hoisted out of the scan into a
+single (B*T, n_in)x(n_in, 4H) MXU matmul — the same restructuring cuDNN's
+fused RNN does (CudnnLSTMHelper, SURVEY.md §2.3), but done once at trace time.
+
+Data layout: batch-major (B, T, F) at the API; scan runs time-major
+internally. Masks are (B, T); masked steps hold the previous carry, so
+variable-length batches behave exactly like DL4J's masked tBPTT.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops import activations, initializers
+from ..api import Array, Layer, Shape, apply_input_dropout, register_layer
+
+Carry = Any
+
+
+class RecurrentLayer(Layer):
+    """Marker + carry API (parity: nn/api/layers/RecurrentLayer.java)."""
+
+    def init_carry(self, batch: int, input_shape: Shape, dtype=jnp.float32) -> Carry:
+        raise NotImplementedError
+
+    def apply_sequence(self, params, x, carry, *, mask=None):
+        """(B,T,F), carry -> (B,T,H), final_carry. Core scan; no dropout."""
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        x = apply_input_dropout(self, x, rng, training)
+        carry = self.init_carry(x.shape[0], x.shape[2:], x.dtype)
+        y, _ = self.apply_sequence(params, x, carry, mask=mask)
+        return y, state, mask
+
+    def step(self, params, x_t: Array, carry: Carry) -> Tuple[Array, Carry]:
+        """Single-timestep inference (rnnTimeStep parity)."""
+        y, new_carry = self.apply_sequence(params, x_t[:, None, :], carry)
+        return y[:, 0], new_carry
+
+
+def _mask_carry(new, old, m_t):
+    """Hold previous carry at masked steps; m_t: (B,)"""
+    m = m_t[:, None]
+    return jax.tree.map(lambda n, o: jnp.where(m > 0, n, o), new, old)
+
+
+@register_layer
+@dataclass(frozen=True)
+class LSTM(RecurrentLayer):
+    """LSTM.java — no peepholes. Gate order [i, f, g, o] in the fused 4H matmul."""
+
+    n_out: int = 0
+    activation: str = "tanh"
+    gate_activation: str = "sigmoid"
+    forget_gate_bias_init: float = 1.0  # DL4J default biasInit for forget gate
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return (input_shape[0], self.n_out)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = input_shape[-1]
+        H = self.n_out
+        k1, k2 = jax.random.split(key)
+        w_ih = initializers.init_param(k1, self.weight_init or "xavier", (n_in, 4 * H), dtype=dtype)
+        w_hh = initializers.init_param(k2, self.weight_init or "xavier", (H, 4 * H), dtype=dtype)
+        b = jnp.zeros((4 * H,), dtype).at[H : 2 * H].set(self.forget_gate_bias_init)
+        return {"w_ih": w_ih, "w_hh": w_hh, "b": b}, {}
+
+    def init_carry(self, batch, input_shape, dtype=jnp.float32):
+        H = self.n_out
+        return (jnp.zeros((batch, H), dtype), jnp.zeros((batch, H), dtype))
+
+    def apply_sequence(self, params, x, carry, *, mask=None):
+        B, T, _ = x.shape
+        H = self.n_out
+        act = activations.get(self.activation)
+        gate = activations.get(self.gate_activation)
+        # Hoist the input projection out of the scan: one big MXU matmul.
+        xw = (x.reshape(B * T, -1) @ params["w_ih"] + params["b"]).reshape(B, T, 4 * H)
+        xw_t = jnp.swapaxes(xw, 0, 1)  # (T, B, 4H)
+        m_t = jnp.swapaxes(mask, 0, 1).astype(x.dtype) if mask is not None else None
+        w_hh = params["w_hh"]
+
+        def cell(c, inp):
+            h_prev, c_prev = c
+            if m_t is None:
+                z = inp
+            else:
+                z, m = inp
+            z = z + h_prev @ w_hh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = gate(i), gate(f), gate(o)
+            c_new = f * c_prev + i * act(g)
+            h_new = o * act(c_new)
+            if m_t is not None:
+                h_new, c_new = _mask_carry((h_new, c_new), (h_prev, c_prev), m)
+            return (h_new, c_new), h_new
+
+        xs = xw_t if m_t is None else (xw_t, m_t)
+        final, ys = lax.scan(cell, carry, xs)
+        return jnp.swapaxes(ys, 0, 1), final
+
+
+@register_layer
+@dataclass(frozen=True)
+class GravesLSTM(LSTM):
+    """GravesLSTM.java — LSTM with peephole connections (Graves 2013):
+    i,f gates see c_{t-1}; o gate sees c_t. Extra diag params w_ci/w_cf/w_co."""
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        params, st = super().init(key, input_shape, dtype)
+        H = self.n_out
+        params.update({
+            "w_ci": jnp.zeros((H,), dtype),
+            "w_cf": jnp.zeros((H,), dtype),
+            "w_co": jnp.zeros((H,), dtype),
+        })
+        return params, st
+
+    def apply_sequence(self, params, x, carry, *, mask=None):
+        B, T, _ = x.shape
+        H = self.n_out
+        act = activations.get(self.activation)
+        gate = activations.get(self.gate_activation)
+        xw = (x.reshape(B * T, -1) @ params["w_ih"] + params["b"]).reshape(B, T, 4 * H)
+        xw_t = jnp.swapaxes(xw, 0, 1)
+        m_t = jnp.swapaxes(mask, 0, 1).astype(x.dtype) if mask is not None else None
+        w_hh, w_ci, w_cf, w_co = params["w_hh"], params["w_ci"], params["w_cf"], params["w_co"]
+
+        def cell(c, inp):
+            h_prev, c_prev = c
+            if m_t is None:
+                z = inp
+            else:
+                z, m = inp
+            z = z + h_prev @ w_hh
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i = gate(i + c_prev * w_ci)
+            f = gate(f + c_prev * w_cf)
+            c_new = f * c_prev + i * act(g)
+            o = gate(o + c_new * w_co)
+            h_new = o * act(c_new)
+            if m_t is not None:
+                h_new, c_new = _mask_carry((h_new, c_new), (h_prev, c_prev), m)
+            return (h_new, c_new), h_new
+
+        xs = xw_t if m_t is None else (xw_t, m_t)
+        final, ys = lax.scan(cell, carry, xs)
+        return jnp.swapaxes(ys, 0, 1), final
+
+
+@register_layer
+@dataclass(frozen=True)
+class GRU(RecurrentLayer):
+    """GRU — standard gated recurrent unit (DL4J has a legacy GRU config)."""
+
+    n_out: int = 0
+    activation: str = "tanh"
+    gate_activation: str = "sigmoid"
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return (input_shape[0], self.n_out)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = input_shape[-1]
+        H = self.n_out
+        k1, k2 = jax.random.split(key)
+        w_ih = initializers.init_param(k1, self.weight_init or "xavier", (n_in, 3 * H), dtype=dtype)
+        w_hh = initializers.init_param(k2, self.weight_init or "xavier", (H, 3 * H), dtype=dtype)
+        return {"w_ih": w_ih, "w_hh": w_hh, "b": jnp.zeros((3 * H,), dtype)}, {}
+
+    def init_carry(self, batch, input_shape, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_sequence(self, params, x, carry, *, mask=None):
+        B, T, _ = x.shape
+        H = self.n_out
+        act = activations.get(self.activation)
+        gate = activations.get(self.gate_activation)
+        xw = (x.reshape(B * T, -1) @ params["w_ih"] + params["b"]).reshape(B, T, 3 * H)
+        xw_t = jnp.swapaxes(xw, 0, 1)
+        m_t = jnp.swapaxes(mask, 0, 1).astype(x.dtype) if mask is not None else None
+        w_hh = params["w_hh"]
+
+        def cell(h_prev, inp):
+            if m_t is None:
+                z = inp
+            else:
+                z, m = inp
+            hz = h_prev @ w_hh
+            xr, xu, xn = jnp.split(z, 3, axis=-1)
+            hr, hu, hn = jnp.split(hz, 3, axis=-1)
+            r = gate(xr + hr)
+            u = gate(xu + hu)
+            n = act(xn + r * hn)
+            h_new = (1 - u) * n + u * h_prev
+            if m_t is not None:
+                h_new = jnp.where(m[:, None] > 0, h_new, h_prev)
+            return h_new, h_new
+
+        xs = xw_t if m_t is None else (xw_t, m_t)
+        final, ys = lax.scan(cell, carry, xs)
+        return jnp.swapaxes(ys, 0, 1), final
+
+
+@register_layer
+@dataclass(frozen=True)
+class SimpleRnn(RecurrentLayer):
+    """SimpleRnn.java — h_t = act(x_t @ W + h_{t-1} @ R + b)."""
+
+    n_out: int = 0
+    activation: str = "tanh"
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return (input_shape[0], self.n_out)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = input_shape[-1]
+        H = self.n_out
+        k1, k2 = jax.random.split(key)
+        w = initializers.init_param(k1, self.weight_init or "xavier", (n_in, H), dtype=dtype)
+        r = initializers.init_param(k2, self.weight_init or "xavier", (H, H), dtype=dtype)
+        return {"w": w, "r": r, "b": jnp.zeros((H,), dtype)}, {}
+
+    def init_carry(self, batch, input_shape, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_sequence(self, params, x, carry, *, mask=None):
+        B, T, _ = x.shape
+        act = activations.get(self.activation)
+        xw = (x.reshape(B * T, -1) @ params["w"] + params["b"]).reshape(B, T, self.n_out)
+        xw_t = jnp.swapaxes(xw, 0, 1)
+        m_t = jnp.swapaxes(mask, 0, 1).astype(x.dtype) if mask is not None else None
+        r = params["r"]
+
+        def cell(h_prev, inp):
+            if m_t is None:
+                z = inp
+            else:
+                z, m = inp
+            h_new = act(z + h_prev @ r)
+            if m_t is not None:
+                h_new = jnp.where(m[:, None] > 0, h_new, h_prev)
+            return h_new, h_new
+
+        xs = xw_t if m_t is None else (xw_t, m_t)
+        final, ys = lax.scan(cell, carry, xs)
+        return jnp.swapaxes(ys, 0, 1), final
+
+
+@register_layer
+@dataclass(frozen=True)
+class Bidirectional(Layer):
+    """Bidirectional.java wrapper — Mode CONCAT/ADD/MUL/AVERAGE.
+
+    ``fwd`` is the wrapped layer's config dict (JSON-serializable, like DL4J's
+    nested layer conf). GravesBidirectionalLSTM == Bidirectional(GravesLSTM).
+    """
+
+    fwd: Optional[dict] = None
+    mode: str = "concat"  # concat | add | mul | average
+
+    def _sub(self) -> RecurrentLayer:
+        from ..api import layer_from_dict
+
+        layer = layer_from_dict(self.fwd)
+        assert isinstance(layer, RecurrentLayer), "Bidirectional wraps recurrent layers"
+        return layer
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        t, h = self._sub().output_shape(input_shape)
+        return (t, 2 * h) if self.mode == "concat" else (t, h)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        sub = self._sub()
+        pf, _ = sub.init(k1, input_shape, dtype)
+        pb, _ = sub.init(k2, input_shape, dtype)
+        return {"fwd": pf, "bwd": pb}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        sub = self._sub()
+        x = apply_input_dropout(self, x, rng, training)
+        carry_f = sub.init_carry(x.shape[0], x.shape[2:], x.dtype)
+        carry_b = sub.init_carry(x.shape[0], x.shape[2:], x.dtype)
+        yf, _ = sub.apply_sequence(params["fwd"], x, carry_f, mask=mask)
+        x_rev = jnp.flip(x, axis=1)
+        mask_rev = jnp.flip(mask, axis=1) if mask is not None else None
+        yb, _ = sub.apply_sequence(params["bwd"], x_rev, carry_b, mask=mask_rev)
+        yb = jnp.flip(yb, axis=1)
+        if self.mode == "concat":
+            y = jnp.concatenate([yf, yb], axis=-1)
+        elif self.mode == "add":
+            y = yf + yb
+        elif self.mode == "mul":
+            y = yf * yb
+        elif self.mode == "average":
+            y = 0.5 * (yf + yb)
+        else:
+            raise ValueError(self.mode)
+        return y, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class LastTimeStep(Layer):
+    """LastTimeStep.java — wrap an RNN layer, emit only the last (unmasked) step."""
+
+    fwd: Optional[dict] = None
+
+    def _sub(self) -> RecurrentLayer:
+        from ..api import layer_from_dict
+
+        layer = layer_from_dict(self.fwd)
+        assert isinstance(layer, RecurrentLayer)
+        return layer
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        t, h = self._sub().output_shape(input_shape)
+        return (h,)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return self._sub().init(key, input_shape, dtype)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        sub = self._sub()
+        x = apply_input_dropout(self, x, rng, training)
+        carry = sub.init_carry(x.shape[0], x.shape[2:], x.dtype)
+        y, _ = sub.apply_sequence(params, x, carry, mask=mask)
+        if mask is not None:
+            idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+            y_last = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0]
+        else:
+            y_last = y[:, -1]
+        return y_last, state, None

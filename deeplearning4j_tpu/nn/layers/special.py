@@ -1,0 +1,230 @@
+"""Special layers: AutoEncoder, VariationalAutoencoder, YOLO2 output, Frozen.
+
+Reference parity: ``nn/conf/layers/AutoEncoder.java`` (denoising AE with
+corruption), ``nn/conf/layers/variational/VariationalAutoencoder.java`` +
+``nn/layers/variational/VariationalAutoencoder.java`` (1171 LoC: encoder/
+decoder MLPs, reparameterization, pluggable reconstruction distributions),
+``nn/conf/layers/objdetect/Yolo2OutputLayer.java`` + impl (615 LoC),
+``nn/layers/FrozenLayer.java``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import activations, initializers, losses
+from ..api import Array, Layer, Shape, layer_from_dict, register_layer
+
+
+def _mlp_init(key, sizes, weight_init, dtype):
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = initializers.init_param(keys[i], weight_init, (a, b), dtype=dtype)
+        params[f"b{i}"] = jnp.zeros((b,), dtype)
+    return params
+
+
+def _mlp_apply(params, x, act, n_layers, final_act=None):
+    for i in range(n_layers):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            x = act(x)
+        elif final_act is not None:
+            x = final_act(x)
+    return x
+
+
+@register_layer
+@dataclass(frozen=True)
+class AutoEncoder(Layer):
+    """AutoEncoder.java — denoising autoencoder; ``corruption_level`` masks inputs.
+
+    ``apply`` produces the hidden encoding (DL4J layerwise-pretrain semantics);
+    ``reconstruct`` and ``pretrain_loss`` expose the decode path.
+    """
+
+    n_out: int = 0
+    activation: str = "sigmoid"
+    corruption_level: float = 0.3
+    loss: str = "mse"
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return input_shape[:-1] + (self.n_out,)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = input_shape[-1]
+        k1, k2 = jax.random.split(key)
+        w = initializers.init_param(k1, self.weight_init or "xavier", (n_in, self.n_out), dtype=dtype)
+        return {"w": w, "b": jnp.zeros((self.n_out,), dtype), "vb": jnp.zeros((n_in,), dtype)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        act = activations.get(self.activation)
+        return act(x @ params["w"] + params["b"]), state, mask
+
+    def reconstruct(self, params, h):
+        act = activations.get(self.activation)
+        return act(h @ params["w"].T + params["vb"])
+
+    def pretrain_loss(self, params, x, rng=None):
+        corrupted = x
+        if rng is not None and self.corruption_level > 0:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        act = activations.get(self.activation)
+        h = act(corrupted @ params["w"] + params["b"])
+        recon = self.reconstruct(params, h)
+        return losses.get(self.loss)(recon, x)
+
+
+@register_layer
+@dataclass(frozen=True)
+class VAE(Layer):
+    """VariationalAutoencoder — encoder MLP -> (mu, logvar) -> z -> decoder MLP.
+
+    Reconstruction distributions (nn/conf/layers/variational/*Distribution):
+    "gaussian" (diagonal), "bernoulli". ``apply`` emits the latent mean (DL4J
+    uses the VAE feed-forward as an encoder for downstream layers);
+    ``pretrain_loss`` is the negative ELBO used for unsupervised fit.
+    """
+
+    n_out: int = 0  # latent size
+    encoder_sizes: Sequence[int] = (256,)
+    decoder_sizes: Sequence[int] = (256,)
+    activation: str = "relu"
+    reconstruction: str = "gaussian"  # gaussian | bernoulli
+    num_samples: int = 1
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return (self.n_out,)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = input_shape[-1]
+        ke, kd = jax.random.split(key)
+        enc_sizes = [n_in, *self.encoder_sizes, 2 * self.n_out]
+        out_mult = 2 if self.reconstruction == "gaussian" else 1
+        dec_sizes = [self.n_out, *self.decoder_sizes, out_mult * n_in]
+        return {
+            "enc": _mlp_init(ke, enc_sizes, self.weight_init or "xavier", dtype),
+            "dec": _mlp_init(kd, dec_sizes, self.weight_init or "xavier", dtype),
+        }, {}
+
+    def encode(self, params, x):
+        act = activations.get(self.activation)
+        out = _mlp_apply(params["enc"], x, act, len(self.encoder_sizes) + 1)
+        mu, logvar = jnp.split(out, 2, axis=-1)
+        return mu, logvar
+
+    def decode(self, params, z):
+        act = activations.get(self.activation)
+        return _mlp_apply(params["dec"], z, act, len(self.decoder_sizes) + 1)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        mu, _ = self.encode(params, x)
+        return mu, state, mask
+
+    def pretrain_loss(self, params, x, rng):
+        mu, logvar = self.encode(params, x)
+        kl = -0.5 * jnp.sum(1 + logvar - jnp.square(mu) - jnp.exp(logvar), axis=-1)
+
+        def one_sample(key):
+            eps = jax.random.normal(key, mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            out = self.decode(params, z)
+            if self.reconstruction == "gaussian":
+                rec_mu, rec_logvar = jnp.split(out, 2, axis=-1)
+                # negative log-likelihood of diagonal gaussian
+                nll = 0.5 * jnp.sum(
+                    rec_logvar + jnp.square(x - rec_mu) / jnp.exp(rec_logvar) + jnp.log(2 * jnp.pi), axis=-1)
+            else:
+                p = jax.nn.sigmoid(out)
+                p = jnp.clip(p, 1e-7, 1 - 1e-7)
+                nll = -jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
+            return nll
+
+        keys = jax.random.split(rng, self.num_samples)
+        nll = jnp.mean(jax.vmap(one_sample)(keys), axis=0)
+        return jnp.mean(nll + kl)
+
+    def generate(self, params, z):
+        out = self.decode(params, z)
+        if self.reconstruction == "gaussian":
+            mu, _ = jnp.split(out, 2, axis=-1)
+            return mu
+        return jax.nn.sigmoid(out)
+
+
+@register_layer
+@dataclass(frozen=True)
+class Yolo2Output(Layer):
+    """Yolo2OutputLayer — YOLOv2 detection loss over (B, H, W, A*(5+C)).
+
+    Parity with nn/layers/objdetect/Yolo2OutputLayer.java: per-cell anchors,
+    sigmoid xy + exp wh box encoding, IoU-based responsibility, weighted
+    position/size/confidence/class terms. Labels: (B, H, W, A, 5+C) with
+    [x, y, w, h, obj, class-onehot] in grid units.
+    """
+
+    anchors: Sequence[Sequence[float]] = ((1.0, 1.0),)
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        B, H, W, _ = x.shape
+        A = len(self.anchors)
+        y = x.reshape(B, H, W, A, -1)
+        xy = jax.nn.sigmoid(y[..., 0:2])
+        wh = jnp.exp(jnp.clip(y[..., 2:4], -10, 10)) * jnp.asarray(self.anchors, x.dtype)
+        conf = jax.nn.sigmoid(y[..., 4:5])
+        cls = jax.nn.softmax(y[..., 5:], axis=-1)
+        return jnp.concatenate([xy, wh, conf, cls], axis=-1).reshape(B, H, W, -1), state, mask
+
+    def score(self, params, state, x, labels, *, mask=None):
+        B, H, W, _ = x.shape
+        A = len(self.anchors)
+        pred = self.apply(params, state, x)[0].reshape(B, H, W, A, -1)
+        lab = labels.reshape(B, H, W, A, -1)
+        obj = lab[..., 4:5]
+        pos_loss = jnp.sum(obj * jnp.square(pred[..., 0:2] - lab[..., 0:2]))
+        size_loss = jnp.sum(obj * jnp.square(jnp.sqrt(pred[..., 2:4] + 1e-8) - jnp.sqrt(jnp.abs(lab[..., 2:4]) + 1e-8)))
+        conf_loss = jnp.sum(obj * jnp.square(pred[..., 4:5] - 1.0)) + \
+            self.lambda_noobj * jnp.sum((1 - obj) * jnp.square(pred[..., 4:5]))
+        cls_loss = jnp.sum(obj * jnp.square(pred[..., 5:] - lab[..., 5:]))
+        return (self.lambda_coord * (pos_loss + size_loss) + conf_loss + cls_loss) / B
+
+
+@register_layer
+@dataclass(frozen=True)
+class Frozen(Layer):
+    """FrozenLayer.java — wrapper: forward normally, zero gradient contribution.
+
+    Implemented with ``lax.stop_gradient`` on the wrapped params, so the
+    optimizer state for them never moves — plus containers exclude frozen
+    params from the trainable label set (see train/trainer.py).
+    """
+
+    inner: Optional[dict] = None
+
+    def _sub(self) -> Layer:
+        return layer_from_dict(self.inner)
+
+    def has_params(self):
+        return self._sub().has_params()
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return self._sub().output_shape(input_shape)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return self._sub().init(key, input_shape, dtype)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        frozen_params = jax.lax.stop_gradient(params)
+        # Frozen layers run in inference mode (DL4J: no dropout on frozen layers).
+        return self._sub().apply(frozen_params, state, x, training=False, rng=rng, mask=mask)

@@ -1,0 +1,538 @@
+"""Network containers: Sequential (=MultiLayerNetwork) and Graph (=ComputationGraph).
+
+Reference parity:
+- ``nn/multilayer/MultiLayerNetwork.java`` (3539 LoC): init :549,
+  fit :1262, backprop :1357, output :2006, computeGradientAndScore :2354.
+- ``nn/graph/ComputationGraph.java`` (3899 LoC): topologicalSortOrder :1211,
+  fit :1010, calcBackpropGradients :1942; vertices ``nn/graph/vertex/impl/``.
+
+TPU redesign: DL4J containers own a *mutable flattened param vector* with
+per-layer views and hand-rolled backprop over a layer loop dispatching one JNI
+kernel per op. Here a container is a *pure function factory*: ``init`` builds
+a params/state pytree keyed by layer name; ``forward``/``score`` are pure and
+jit-compiled once — XLA sees the whole network and fuses across layer
+boundaries, which is exactly the fusion the reference's cuDNN "helpers" try to
+approximate per-layer. ``jax.grad(score)`` replaces calcBackpropGradients.
+
+Masking: per-timestep masks thread through layers exactly like
+``feedForwardMaskArray`` (Layer.java:288). tBPTT: ``forward_with_carry``
+exposes RNN carries so the trainer can scan over sequence chunks
+(BackpropType.TruncatedBPTT, MultiLayerNetwork.java:1309).
+
+Serde: ``to_json``/``from_json`` round-trip the full architecture, parity with
+``MultiLayerConfiguration.fromJson`` / ``ComputationGraphConfiguration``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from .api import Array, Layer, Params, Shape, State, layer_from_dict
+from .layers.core import CenterLossOutput, LossLayer, Output, _LossMixin
+from .layers.recurrent import RecurrentLayer
+from .vertices import GraphVertex, vertex_from_dict
+
+DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16,
+          "float64": jnp.float64}
+
+
+@dataclass
+class NetConfig:
+    """Global training config — NeuralNetConfiguration.Builder equivalent.
+
+    Per-layer overrides (updater/l1/l2/weight_init on each Layer) win over
+    these globals, matching DL4J's config inheritance.
+    """
+
+    seed: int = 12345
+    dtype: str = "float32"
+    updater: Union[str, dict] = field(default_factory=lambda: {"type": "sgd", "learning_rate": 1e-1})
+    l1: float = 0.0
+    l2: float = 0.0
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    tbptt_length: int = 0  # 0 = full BPTT
+    compute_dtype: Optional[str] = None  # e.g. "bfloat16" for MXU-native mixed precision
+
+    def to_dict(self):
+        import dataclasses
+
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+def _layer_key(i: int, layer: Layer) -> str:
+    return layer.name or f"layer_{i}"
+
+
+class Sequential:
+    """MultiLayerNetwork equivalent: an ordered stack of layers ending (usually)
+    in an Output/Loss layer. Construct via ``Sequential(config, layers, input_shape)``
+    or the ``SequentialBuilder`` fluent API (DL4J ListBuilder parity)."""
+
+    def __init__(self, config: NetConfig, layers: Sequence[Layer], input_shape: Shape):
+        self.config = config
+        self.layers = list(layers)
+        self.input_shape = tuple(input_shape)
+        self.dtype = DTYPES[config.dtype]
+        self._shapes = self._infer_shapes()
+        # populated by init():
+        self.params: Optional[Params] = None
+        self.state: Optional[State] = None
+
+    # --- shape inference (MultiLayerConfiguration setInputType equivalent) ---
+    def _infer_shapes(self) -> List[Shape]:
+        shapes = [self.input_shape]
+        for layer in self.layers:
+            shapes.append(tuple(layer.output_shape(shapes[-1])))
+        return shapes
+
+    @property
+    def output_shape(self) -> Shape:
+        return self._shapes[-1]
+
+    def layer_input_shape(self, i: int) -> Shape:
+        return self._shapes[i]
+
+    # --- init (MultiLayerNetwork.init :549) ---
+    def init(self, seed: Optional[int] = None) -> Tuple[Params, State]:
+        key = jax.random.PRNGKey(self.config.seed if seed is None else seed)
+        params: Params = {}
+        state: State = {}
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        for i, layer in enumerate(self.layers):
+            p, s = layer.init(keys[i], self._shapes[i], self.dtype)
+            k = _layer_key(i, layer)
+            if p:
+                params[k] = p
+            if s:
+                state[k] = s
+        self.params, self.state = params, state
+        return params, state
+
+    def param_count(self) -> int:
+        assert self.params is not None, "call init() first"
+        return sum(int(v.size) for v in jax.tree_util.tree_leaves(self.params))
+
+    # --- pure forward (feedForward, MultiLayerNetwork.java:2388) ---
+    def forward(self, params: Params, state: State, x: Array, *, training: bool = False,
+                rng: Optional[Array] = None, mask: Optional[Array] = None,
+                up_to: Optional[int] = None) -> Tuple[Array, State]:
+        n = len(self.layers) if up_to is None else up_to
+        rngs = jax.random.split(rng, n) if rng is not None else [None] * n
+        new_state = dict(state)
+        cdt = DTYPES[self.config.compute_dtype] if self.config.compute_dtype else None
+        if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(cdt)
+        for i in range(n):
+            layer = self.layers[i]
+            k = _layer_key(i, layer)
+            p = params.get(k, {})
+            if cdt is not None:
+                p = jax.tree.map(lambda a: a.astype(cdt) if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+            s = state.get(k, {})
+            x, s_out, mask = layer.apply(p, s, x, training=training, rng=rngs[i], mask=mask)
+            if s_out:
+                new_state[k] = s_out
+        if cdt is not None:
+            x = x.astype(self.dtype)
+        return x, new_state
+
+    def activations(self, params, state, x, **kw) -> List[Array]:
+        """Per-layer activations (feedForward list) — for listeners/debugging."""
+        outs = []
+        mask = kw.pop("mask", None)
+        rng = kw.pop("rng", None)
+        rngs = jax.random.split(rng, len(self.layers)) if rng is not None else [None] * len(self.layers)
+        for i, layer in enumerate(self.layers):
+            k = _layer_key(i, layer)
+            x, _, mask = layer.apply(params.get(k, {}), state.get(k, {}), x,
+                                     rng=rngs[i], mask=mask, **kw)
+            outs.append(x)
+        return outs
+
+    # --- score (computeGradientAndScore :2354) ---
+    def score(self, params: Params, state: State, x: Array, labels: Array, *,
+              training: bool = True, rng: Optional[Array] = None,
+              mask: Optional[Array] = None, label_mask: Optional[Array] = None,
+              ) -> Tuple[Array, State]:
+        out_layer = self.layers[-1]
+        if not isinstance(out_layer, _LossMixin):
+            raise ValueError("Last layer must be an Output/Loss layer to compute score")
+        feats, new_state = self.forward(params, state, x, training=training, rng=rng,
+                                        mask=mask, up_to=len(self.layers) - 1)
+        k = _layer_key(len(self.layers) - 1, out_layer)
+        loss = out_layer.score(params.get(k, {}), state.get(k, {}), feats, labels,
+                               mask=label_mask if label_mask is not None else mask)
+        # L1/L2 regularization score term (BaseOptimizer scoring parity) is
+        # applied through the updater (optax add_decayed_weights), not here —
+        # DL4J adds it to the reported score; we report pure data loss.
+        return loss, new_state
+
+    # --- inference (output :2006) ---
+    def output(self, x: Array, params: Optional[Params] = None, state: Optional[State] = None,
+               mask: Optional[Array] = None) -> Array:
+        p = params if params is not None else self.params
+        s = state if state is not None else self.state
+        assert p is not None, "call init() first"
+        y, _ = self.forward(p, s, x, training=False, mask=mask)
+        return y
+
+    # --- tBPTT support ---
+    def rnn_layers(self) -> List[Tuple[str, RecurrentLayer]]:
+        return [(_layer_key(i, l), l) for i, l in enumerate(self.layers) if isinstance(l, RecurrentLayer)]
+
+    def init_carries(self, batch: int) -> Dict[str, Any]:
+        out = {}
+        for i, layer in enumerate(self.layers):
+            if isinstance(layer, RecurrentLayer):
+                out[_layer_key(i, layer)] = layer.init_carry(batch, self._shapes[i], self.dtype)
+        return out
+
+    def forward_with_carry(self, params, state, x, carries: Dict[str, Any], *,
+                           training=False, rng=None, mask=None):
+        """Forward threading explicit RNN carries (rnnTimeStep / tBPTT parity)."""
+        n = len(self.layers)
+        rngs = jax.random.split(rng, n) if rng is not None else [None] * n
+        new_state = dict(state)
+        new_carries = dict(carries)
+        for i, layer in enumerate(self.layers):
+            k = _layer_key(i, layer)
+            p, s = params.get(k, {}), state.get(k, {})
+            if isinstance(layer, RecurrentLayer):
+                from .api import apply_input_dropout
+
+                x2 = apply_input_dropout(layer, x, rngs[i], training)
+                x, carry = layer.apply_sequence(p, x2, carries[k], mask=mask)
+                new_carries[k] = carry
+            else:
+                x, s_out, mask = layer.apply(p, s, x, training=training, rng=rngs[i], mask=mask)
+                if s_out:
+                    new_state[k] = s_out
+        return x, new_state, new_carries
+
+    def score_with_carry(self, params, state, x, labels, carries, *, training=True,
+                         rng=None, mask=None):
+        out_layer = self.layers[-1]
+        n = len(self.layers)
+        rngs = jax.random.split(rng, n) if rng is not None else [None] * n
+        new_state = dict(state)
+        new_carries = dict(carries)
+        h = x
+        m = mask
+        for i in range(n - 1):
+            layer = self.layers[i]
+            k = _layer_key(i, layer)
+            p, s = params.get(k, {}), state.get(k, {})
+            if isinstance(layer, RecurrentLayer):
+                h, carry = layer.apply_sequence(p, h, carries[k], mask=m)
+                new_carries[k] = carry
+            else:
+                h, s_out, m = layer.apply(p, s, h, training=training, rng=rngs[i], mask=m)
+                if s_out:
+                    new_state[k] = s_out
+        k = _layer_key(n - 1, out_layer)
+        loss = out_layer.score(params.get(k, {}), state.get(k, {}), h, labels, mask=m)
+        return loss, new_state, new_carries
+
+    # --- serde (MultiLayerConfiguration.toJson/fromJson) ---
+    def to_json(self) -> str:
+        return json.dumps({
+            "format": "deeplearning4j_tpu/sequential/v1",
+            "config": self.config.to_dict(),
+            "input_shape": list(self.input_shape),
+            "layers": [l.to_dict() for l in self.layers],
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Sequential":
+        d = json.loads(s)
+        return cls(NetConfig.from_dict(d["config"]),
+                   [layer_from_dict(ld) for ld in d["layers"]],
+                   tuple(d["input_shape"]))
+
+    def summary(self) -> str:
+        """MultiLayerNetwork.summary() parity."""
+        lines = [f"{'idx':<4}{'name':<24}{'type':<26}{'in':<18}{'out':<18}{'params':<10}"]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            n = layer.param_count(self._shapes[i]) if layer.has_params() else 0
+            total += n
+            lines.append(f"{i:<4}{_layer_key(i, layer):<24}{type(layer).__name__:<26}"
+                         f"{str(self._shapes[i]):<18}{str(self._shapes[i + 1]):<18}{n:<10}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)
+
+
+@dataclass(frozen=True)
+class GraphNode:
+    """One node of a Graph config: a Layer or GraphVertex + its input names."""
+
+    spec: Union[Layer, GraphVertex]
+    inputs: Tuple[str, ...]
+
+    def is_layer(self) -> bool:
+        return isinstance(self.spec, Layer)
+
+
+class Graph:
+    """ComputationGraph equivalent: DAG of layers and vertices.
+
+    ``nodes``: dict name -> GraphNode; ``inputs``: external input names;
+    ``outputs``: output node names (order defines label order in fit/score).
+    """
+
+    def __init__(self, config: NetConfig, inputs: Sequence[str],
+                 input_shapes: Dict[str, Shape], nodes: Dict[str, GraphNode],
+                 outputs: Sequence[str]):
+        self.config = config
+        self.inputs = list(inputs)
+        self.input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        self.nodes = dict(nodes)
+        self.outputs = list(outputs)
+        self.dtype = DTYPES[config.dtype]
+        self.topo_order = self._topo_sort()
+        self._shapes = self._infer_shapes()
+        self.params: Optional[Params] = None
+        self.state: Optional[State] = None
+
+    # --- topological sort (ComputationGraph.topologicalSortOrder :1211) ---
+    def _topo_sort(self) -> List[str]:
+        indeg = {name: 0 for name in self.nodes}
+        children: Dict[str, List[str]] = {name: [] for name in self.nodes}
+        for name, node in self.nodes.items():
+            for inp in node.inputs:
+                if inp in self.nodes:
+                    indeg[name] += 1
+                    children[inp].append(name)
+                elif inp not in self.inputs:
+                    raise ValueError(f"Node '{name}' references unknown input '{inp}'")
+        queue = sorted([n for n, d in indeg.items() if d == 0])
+        order = []
+        while queue:
+            n = queue.pop(0)
+            order.append(n)
+            for c in children[n]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    queue.append(c)
+            queue.sort()
+        if len(order) != len(self.nodes):
+            cyc = set(self.nodes) - set(order)
+            raise ValueError(f"Graph has a cycle involving: {sorted(cyc)}")
+        return order
+
+    def _infer_shapes(self) -> Dict[str, Shape]:
+        shapes: Dict[str, Shape] = dict(self.input_shapes)
+        for name in self.topo_order:
+            node = self.nodes[name]
+            in_shapes = [shapes[i] for i in node.inputs]
+            if node.is_layer():
+                shapes[name] = tuple(node.spec.output_shape(in_shapes[0]))
+            else:
+                shapes[name] = tuple(node.spec.output_shape(in_shapes))
+        return shapes
+
+    @property
+    def output_shapes(self) -> List[Shape]:
+        return [self._shapes[o] for o in self.outputs]
+
+    # --- init (ComputationGraph init :426-470) ---
+    def init(self, seed: Optional[int] = None) -> Tuple[Params, State]:
+        key = jax.random.PRNGKey(self.config.seed if seed is None else seed)
+        params: Params = {}
+        state: State = {}
+        layer_nodes = [n for n in self.topo_order if self.nodes[n].is_layer()]
+        keys = jax.random.split(key, max(len(layer_nodes), 1))
+        for k_i, name in enumerate(layer_nodes):
+            node = self.nodes[name]
+            in_shape = self._shapes[node.inputs[0]]
+            p, s = node.spec.init(keys[k_i], in_shape, self.dtype)
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        self.params, self.state = params, state
+        return params, state
+
+    def param_count(self) -> int:
+        assert self.params is not None
+        return sum(int(v.size) for v in jax.tree_util.tree_leaves(self.params))
+
+    # --- pure forward over topo order ---
+    def forward(self, params: Params, state: State, inputs: Union[Array, Dict[str, Array]],
+                *, training: bool = False, rng: Optional[Array] = None,
+                masks: Optional[Dict[str, Array]] = None,
+                ) -> Tuple[List[Array], State]:
+        if not isinstance(inputs, dict):
+            inputs = {self.inputs[0]: inputs}
+        acts: Dict[str, Array] = dict(inputs)
+        act_masks: Dict[str, Optional[Array]] = {k: (masks or {}).get(k) for k in inputs}
+        new_state = dict(state)
+        layer_names = [n for n in self.topo_order if self.nodes[n].is_layer()]
+        rngs = dict(zip(layer_names, jax.random.split(rng, max(len(layer_names), 1)))) if rng is not None else {}
+        for name in self.topo_order:
+            node = self.nodes[name]
+            ins = [acts[i] for i in node.inputs]
+            if node.is_layer():
+                m = act_masks.get(node.inputs[0])
+                y, s_out, m_out = node.spec.apply(
+                    params.get(name, {}), state.get(name, {}), ins[0],
+                    training=training, rng=rngs.get(name), mask=m)
+                acts[name] = y
+                act_masks[name] = m_out
+                if s_out:
+                    new_state[name] = s_out
+            else:
+                acts[name] = node.spec.apply(ins)
+                act_masks[name] = act_masks.get(node.inputs[0])
+        return [acts[o] for o in self.outputs], new_state
+
+    def score(self, params, state, inputs, labels, *, training=True, rng=None,
+              masks=None, label_masks=None) -> Tuple[Array, State]:
+        """Sum of losses over all output layers (ComputationGraph multi-output)."""
+        if not isinstance(inputs, dict):
+            inputs = {self.inputs[0]: inputs}
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        acts: Dict[str, Array] = dict(inputs)
+        act_masks: Dict[str, Optional[Array]] = {k: (masks or {}).get(k) for k in inputs}
+        new_state = dict(state)
+        layer_names = [n for n in self.topo_order if self.nodes[n].is_layer()]
+        rngs = dict(zip(layer_names, jax.random.split(rng, max(len(layer_names), 1)))) if rng is not None else {}
+        total = jnp.asarray(0.0, jnp.float32)
+        out_idx = {o: i for i, o in enumerate(self.outputs)}
+        for name in self.topo_order:
+            node = self.nodes[name]
+            ins = [acts[i] for i in node.inputs]
+            if node.is_layer() and name in out_idx and isinstance(node.spec, _LossMixin):
+                li = out_idx[name]
+                lm = None
+                if label_masks is not None:
+                    lm = label_masks[li] if isinstance(label_masks, (list, tuple)) else label_masks
+                if lm is None:
+                    lm = act_masks.get(node.inputs[0])
+                total = total + node.spec.score(params.get(name, {}), state.get(name, {}),
+                                               ins[0], labels[li], mask=lm)
+                # still produce activation for downstream vertices if any
+                y, s_out, m_out = node.spec.apply(params.get(name, {}), state.get(name, {}),
+                                                  ins[0], training=training, rng=rngs.get(name),
+                                                  mask=act_masks.get(node.inputs[0]))
+                acts[name], act_masks[name] = y, m_out
+                if s_out:
+                    new_state[name] = s_out
+            elif node.is_layer():
+                y, s_out, m_out = node.spec.apply(params.get(name, {}), state.get(name, {}),
+                                                  ins[0], training=training, rng=rngs.get(name),
+                                                  mask=act_masks.get(node.inputs[0]))
+                acts[name], act_masks[name] = y, m_out
+                if s_out:
+                    new_state[name] = s_out
+            else:
+                acts[name] = node.spec.apply(ins)
+                act_masks[name] = act_masks.get(node.inputs[0])
+        return total, new_state
+
+    def output(self, inputs, params=None, state=None, masks=None) -> List[Array]:
+        p = params if params is not None else self.params
+        s = state if state is not None else self.state
+        assert p is not None, "call init() first"
+        ys, _ = self.forward(p, s, inputs, training=False, masks=masks)
+        return ys
+
+    # --- serde ---
+    def to_json(self) -> str:
+        nodes = {}
+        for name, node in self.nodes.items():
+            nodes[name] = {
+                "kind": "layer" if node.is_layer() else "vertex",
+                "spec": node.spec.to_dict(),
+                "inputs": list(node.inputs),
+            }
+        return json.dumps({
+            "format": "deeplearning4j_tpu/graph/v1",
+            "config": self.config.to_dict(),
+            "inputs": self.inputs,
+            "input_shapes": {k: list(v) for k, v in self.input_shapes.items()},
+            "nodes": nodes,
+            "outputs": self.outputs,
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Graph":
+        d = json.loads(s)
+        nodes = {}
+        for name, nd in d["nodes"].items():
+            spec = layer_from_dict(nd["spec"]) if nd["kind"] == "layer" else vertex_from_dict(nd["spec"])
+            nodes[name] = GraphNode(spec, tuple(nd["inputs"]))
+        return cls(NetConfig.from_dict(d["config"]), d["inputs"],
+                   {k: tuple(v) for k, v in d["input_shapes"].items()},
+                   nodes, d["outputs"])
+
+    def summary(self) -> str:
+        lines = [f"{'name':<28}{'type':<26}{'inputs':<36}{'out shape':<18}"]
+        for name in self.topo_order:
+            node = self.nodes[name]
+            lines.append(f"{name:<28}{type(node.spec).__name__:<26}"
+                         f"{','.join(node.inputs):<36}{str(self._shapes[name]):<18}")
+        return "\n".join(lines)
+
+
+class GraphBuilder:
+    """Fluent builder — ComputationGraphConfiguration.GraphBuilder parity."""
+
+    def __init__(self, config: Optional[NetConfig] = None):
+        self.config = config or NetConfig()
+        self._inputs: List[str] = []
+        self._input_shapes: Dict[str, Shape] = {}
+        self._nodes: Dict[str, GraphNode] = {}
+        self._outputs: List[str] = []
+
+    def add_input(self, name: str, shape: Shape) -> "GraphBuilder":
+        self._inputs.append(name)
+        self._input_shapes[name] = tuple(shape)
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str) -> "GraphBuilder":
+        self._nodes[name] = GraphNode(layer, tuple(inputs))
+        return self
+
+    def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str) -> "GraphBuilder":
+        self._nodes[name] = GraphNode(vertex, tuple(inputs))
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def build(self) -> Graph:
+        return Graph(self.config, self._inputs, self._input_shapes, self._nodes, self._outputs)
+
+
+class SequentialBuilder:
+    """NeuralNetConfiguration.Builder().list() fluent equivalent."""
+
+    def __init__(self, config: Optional[NetConfig] = None):
+        self.config = config or NetConfig()
+        self._layers: List[Layer] = []
+        self._input_shape: Optional[Shape] = None
+
+    def input_shape(self, *shape: int) -> "SequentialBuilder":
+        self._input_shape = tuple(shape)
+        return self
+
+    def layer(self, layer: Layer) -> "SequentialBuilder":
+        self._layers.append(layer)
+        return self
+
+    def build(self) -> Sequential:
+        assert self._input_shape is not None, "set input_shape first"
+        return Sequential(self.config, self._layers, self._input_shape)

@@ -1,0 +1,252 @@
+"""Graph vertices — parity with DL4J's 14 vertex types (``nn/conf/graph/``:
+Merge, ElementWise, L2, L2Normalize, Scale, Shift, Stack, Unstack, Subset,
+Reshape, Preprocessor, PoolHelper, rnn/LastTimeStepVertex,
+rnn/DuplicateToTimeSeriesVertex, rnn/ReverseTimeSeriesVertex).
+
+A vertex is a parameterless (or lightly-parameterized) multi-input op inside a
+Graph network. Like layers, vertices are frozen-dataclass configs with pure
+``apply(inputs) -> output``; under XLA they all fuse into neighbors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+import jax.numpy as jnp
+
+from .api import Array, Shape
+
+VERTEX_REGISTRY: Dict[str, Type["GraphVertex"]] = {}
+
+
+def register_vertex(cls):
+    VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclass(frozen=True)
+class GraphVertex:
+    def apply(self, inputs: List[Array]) -> Array:
+        raise NotImplementedError
+
+    def output_shape(self, input_shapes: List[Shape]) -> Shape:
+        return input_shapes[0]
+
+    def to_dict(self) -> dict:
+        import dataclasses
+
+        d = {"@type": type(self).__name__}
+        for f in dataclasses.fields(self):
+            d[f.name] = getattr(self, f.name)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict):
+        d = dict(d)
+        d.pop("@type", None)
+        return cls(**d)
+
+
+def vertex_from_dict(d: dict) -> GraphVertex:
+    kind = d.get("@type")
+    if kind not in VERTEX_REGISTRY:
+        raise ValueError(f"Unknown vertex type '{kind}'")
+    return VERTEX_REGISTRY[kind].from_dict(d)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class Merge(GraphVertex):
+    """MergeVertex.java — concatenate along the feature (last) axis."""
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def output_shape(self, input_shapes):
+        base = input_shapes[0]
+        total = sum(s[-1] for s in input_shapes)
+        return base[:-1] + (total,)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class ElementWise(GraphVertex):
+    """ElementWiseVertex.java — Op: Add, Subtract, Product, Average, Max."""
+
+    op: str = "add"
+
+    def apply(self, inputs):
+        if self.op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if self.op == "subtract":
+            assert len(inputs) == 2
+            return inputs[0] - inputs[1]
+        if self.op == "product":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if self.op == "average":
+            return sum(inputs) / len(inputs)
+        if self.op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(self.op)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class L2Norm(GraphVertex):
+    """L2NormalizeVertex.java — x / ||x||_2 along last axis."""
+
+    eps: float = 1e-8
+
+    def apply(self, inputs):
+        (x,) = inputs
+        return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), self.eps)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class L2Distance(GraphVertex):
+    """L2Vertex.java — pairwise L2 distance between two inputs -> (B, 1)."""
+
+    def apply(self, inputs):
+        a, b = inputs
+        return jnp.sqrt(jnp.sum(jnp.square(a - b), axis=-1, keepdims=True) + 1e-12)
+
+    def output_shape(self, input_shapes):
+        return (1,)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class Scale(GraphVertex):
+    """ScaleVertex.java — multiply by a fixed scalar."""
+
+    factor: float = 1.0
+
+    def apply(self, inputs):
+        return inputs[0] * self.factor
+
+
+@register_vertex
+@dataclass(frozen=True)
+class Shift(GraphVertex):
+    """ShiftVertex.java — add a fixed scalar."""
+
+    amount: float = 0.0
+
+    def apply(self, inputs):
+        return inputs[0] + self.amount
+
+
+@register_vertex
+@dataclass(frozen=True)
+class Stack(GraphVertex):
+    """StackVertex.java — stack inputs along the batch axis (axis 0)."""
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class Unstack(GraphVertex):
+    """UnstackVertex.java — take slice ``index`` of ``num`` along batch axis."""
+
+    index: int = 0
+    num: int = 1
+
+    def apply(self, inputs):
+        (x,) = inputs
+        n = x.shape[0] // self.num
+        return x[self.index * n : (self.index + 1) * n]
+
+
+@register_vertex
+@dataclass(frozen=True)
+class Subset(GraphVertex):
+    """SubsetVertex.java — feature slice [low, high] inclusive (DL4J semantics)."""
+
+    low: int = 0
+    high: int = 0
+
+    def apply(self, inputs):
+        (x,) = inputs
+        return x[..., self.low : self.high + 1]
+
+    def output_shape(self, input_shapes):
+        return input_shapes[0][:-1] + (self.high - self.low + 1,)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class ReshapeVertex(GraphVertex):
+    """ReshapeVertex.java — reshape (excluding batch dim)."""
+
+    shape: Sequence[int] = ()
+
+    def apply(self, inputs):
+        (x,) = inputs
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+    def output_shape(self, input_shapes):
+        return tuple(self.shape)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class PoolHelper(GraphVertex):
+    """PoolHelperVertex.java — strips the first row/col (GoogLeNet padding quirk)."""
+
+    def apply(self, inputs):
+        (x,) = inputs
+        return x[:, 1:, 1:, :]
+
+    def output_shape(self, input_shapes):
+        h, w, c = input_shapes[0]
+        return (h - 1, w - 1, c)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class LastTimeStepVertex(GraphVertex):
+    """rnn/LastTimeStepVertex.java — (B, T, F) -> (B, F) last step (mask-aware
+    variants handled by the container passing pre-masked input)."""
+
+    def apply(self, inputs):
+        (x,) = inputs
+        return x[:, -1]
+
+    def output_shape(self, input_shapes):
+        return (input_shapes[0][-1],)
+
+
+@register_vertex
+@dataclass(frozen=True)
+class DuplicateToTimeSeries(GraphVertex):
+    """rnn/DuplicateToTimeSeriesVertex.java — (B, F) -> (B, T, F); T from ref input."""
+
+    def apply(self, inputs):
+        x, time_ref = inputs
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], time_ref.shape[1], x.shape[-1]))
+
+    def output_shape(self, input_shapes):
+        return (input_shapes[1][0], input_shapes[0][-1])
+
+
+@register_vertex
+@dataclass(frozen=True)
+class ReverseTimeSeries(GraphVertex):
+    """rnn/ReverseTimeSeriesVertex.java — flip the time axis."""
+
+    def apply(self, inputs):
+        (x,) = inputs
+        return jnp.flip(x, axis=1)

@@ -1,0 +1,27 @@
+"""Distributed training (L5) — mesh collectives replace the reference's
+Spark/Aeron substrate (SURVEY.md §2.4): ParallelWrapper -> sharded jit /
+shard_map; gradient sharing -> ICI all-reduce (+ threshold compression for
+DCN); ParallelInference -> dynamic-batching server; plus the model/sequence
+parallelism DL4J lacks (GSPMD sharding rules, ring attention)."""
+
+from .compression import (EncodedGradientsAccumulator, SparseUpdate,
+                          bitmap_decode, bitmap_encode, threshold_decode,
+                          threshold_encode)
+from .inference import ParallelInference
+from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+                   cpu_test_mesh, distributed_init, make_mesh, replicate,
+                   shard_batch)
+from .ring_attention import (reference_attention, ring_attention,
+                             ring_attention_local)
+from .sharding import (CNN_RULES, TRANSFORMER_RULES, constrain_activations,
+                       shard_params, sharding_tree)
+from .wrapper import ParallelWrapper
+
+__all__ = ["CNN_RULES", "DATA_AXIS", "EXPERT_AXIS", "EncodedGradientsAccumulator",
+           "MODEL_AXIS", "PIPE_AXIS", "ParallelInference", "ParallelWrapper",
+           "SEQ_AXIS", "SparseUpdate", "TRANSFORMER_RULES", "bitmap_decode",
+           "bitmap_encode", "constrain_activations", "cpu_test_mesh",
+           "distributed_init", "make_mesh", "reference_attention", "replicate",
+           "ring_attention", "ring_attention_local", "shard_batch",
+           "shard_params", "sharding_tree", "threshold_decode",
+           "threshold_encode"]

@@ -1,0 +1,111 @@
+"""Gradient compression — parity with ND4J threshold/bitmap encoding
+(EncodingHandler.java:139 thresholdEncode, EncodedGradientsAccumulator.java:
+256-259 decode; SURVEY.md §2.1 gradient accumulators).
+
+On ICI, dense bf16 all-reduce beats compression (the collectives ride a
+~100GB/s+ mesh), so the sync path never uses this. These ops exist for the
+DCN/cross-slice path — the moral successor of the reference's Aeron UDP update
+plane — where sparse quantized updates still pay off.
+
+Encoding semantics (Strom-style, matching ND4J):
+- thresholdEncode(g, t): entries with |g| >= t are quantized to +-t, emitted as
+  sparse (index, sign); the residual g - decode(enc) stays in an accumulator.
+- bitmapEncode: dense 2-bit map {0, +t, -t} — chosen when >~1/16 of entries
+  exceed t (ND4J switches encodings by density; FLEXIBLE vs BITMAP).
+
+TPU-native design: fixed-capacity index buffers (static shapes for jit);
+``top_k``-based selection keeps the hot path on the VPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseUpdate(NamedTuple):
+    """Fixed-capacity sparse encoding: indices (k,), signs (k,), count, threshold."""
+
+    indices: jax.Array
+    signs: jax.Array
+    count: jax.Array
+    threshold: jax.Array
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def threshold_encode(grad: jax.Array, threshold: float, capacity: int,
+                     residual: jax.Array) -> Tuple[SparseUpdate, jax.Array]:
+    """Encode flat ``grad + residual``; returns (update, new_residual).
+
+    Takes the ``capacity`` largest-|.| entries over threshold (ND4J caps the
+    message size the same way); everything else accumulates in the residual.
+    """
+    g = grad.ravel() + residual
+    absg = jnp.abs(g)
+    vals, idx = jax.lax.top_k(absg, capacity)
+    over = vals >= threshold
+    count = jnp.sum(over)
+    signs = jnp.sign(g[idx]) * over
+    # residual: subtract what we transmitted (+-threshold at selected slots)
+    transmitted = jnp.zeros_like(g).at[idx].add(signs * threshold)
+    new_residual = g - transmitted
+    return SparseUpdate(idx, signs.astype(jnp.int8), count,
+                        jnp.asarray(threshold, g.dtype)), new_residual
+
+
+@partial(jax.jit, static_argnames=("size",))
+def threshold_decode(update: SparseUpdate, size: int | None = None, out=None) -> jax.Array:
+    """Decode into a dense flat vector (thresholdDecode parity)."""
+    if out is None:
+        assert size is not None
+        out = jnp.zeros((size,), jnp.float32)
+    contrib = update.signs.astype(out.dtype) * update.threshold
+    return out.at[update.indices].add(contrib)
+
+
+@jax.jit
+def bitmap_encode(grad: jax.Array, threshold: float, residual: jax.Array):
+    """Dense 2-bit encoding: int8 in {-1, 0, +1} per entry (bitmapEncode parity;
+    the wire format packs 4/byte — packing is IO-layer concern, not compute)."""
+    g = grad.ravel() + residual
+    code = jnp.where(g >= threshold, 1, jnp.where(g <= -threshold, -1, 0)).astype(jnp.int8)
+    new_residual = g - code.astype(g.dtype) * threshold
+    return code, new_residual
+
+
+@jax.jit
+def bitmap_decode(code: jax.Array, threshold: float) -> jax.Array:
+    return code.astype(jnp.float32) * threshold
+
+
+class EncodedGradientsAccumulator:
+    """Host-side accumulator mirroring EncodedGradientsAccumulator.java:33 —
+    workers ``store_update`` encoded grads; ``apply_updates`` folds all pending
+    updates into a parameter-sized dense buffer. Used by the DCN gradient-
+    sharing path; within a slice the sync all-reduce path bypasses this."""
+
+    def __init__(self, size: int, threshold: float = 1e-3, capacity_frac: float = 0.05):
+        self.size = size
+        self.threshold = threshold
+        self.capacity = max(1, int(size * capacity_frac))
+        self.residuals = {}
+        self.pending = []
+
+    def store_update(self, worker_id, grad_flat: jax.Array):
+        res = self.residuals.get(worker_id)
+        if res is None:
+            res = jnp.zeros((self.size,), jnp.float32)
+        enc, new_res = threshold_encode(grad_flat, self.threshold, self.capacity, res)
+        self.residuals[worker_id] = new_res
+        self.pending.append(enc)
+        return enc
+
+    def apply_updates(self) -> jax.Array:
+        out = jnp.zeros((self.size,), jnp.float32)
+        for enc in self.pending:
+            out = threshold_decode(enc, out=out)
+        self.pending.clear()
+        return out

@@ -1,0 +1,117 @@
+"""Device mesh + distributed bootstrap — the TPU-native communication backend.
+
+Replaces the reference's ENTIRE distributed substrate (SURVEY.md §2.4): Spark
+control plane + Aeron UDP parameter server (VoidParameterServer/
+RoutedTransport) collapse into ``jax.distributed.initialize`` + a named
+``jax.sharding.Mesh``. The update plane (threshold-compressed async UDP
+unicast) becomes XLA dense collectives over ICI — psum/all_gather/
+reduce_scatter scheduled by the compiler, overlapping compute.
+
+Axis-name conventions used throughout the framework:
+- ``"data"``  — data parallelism (ParallelWrapper / Spark parity)
+- ``"model"`` — tensor parallelism (absent in DL4J; GSPMD-native here)
+- ``"seq"``   — sequence/context parallelism for long-context (ring attention)
+- ``"pipe"``  — pipeline stages
+- ``"expert"``— MoE expert parallelism
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+def distributed_init(coordinator: Optional[str] = None, num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None):
+    """Multi-host bootstrap (jax.distributed) — replaces the Spark driver's
+    VoidParameterServer.init + executor shard bootstrapping
+    (SharedTrainingMaster.java:457-475). Safe no-op when single-process or
+    already initialized; env vars (COORDINATOR_ADDRESS etc.) also work.
+    """
+    if num_processes in (None, 1) and coordinator is None:
+        return False
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes, process_id=process_id)
+        return True
+    except RuntimeError:
+        return False  # already initialized
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
+    """Build a named mesh. ``axes`` maps axis name -> size; -1 once to absorb
+    the remaining devices. Default: all devices on the data axis (the
+    ParallelWrapper topology)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {DATA_AXIS: n}
+    axes = dict(axes)
+    known = int(np.prod([v for v in axes.values() if v != -1]))
+    for k, v in axes.items():
+        if v == -1:
+            axes[k] = n // known
+    total = int(np.prod(list(axes.values())))
+    if total != n:
+        raise ValueError(f"Mesh {axes} needs {total} devices, have {n}")
+    arr = np.asarray(devices).reshape(*axes.values())
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS, ndim_hint: int = 0) -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``; rest replicated."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = DATA_AXIS):
+    """Place host arrays on the mesh with the batch dim split over ``axis``."""
+    sh = NamedSharding(mesh, PartitionSpec(axis))
+    return jax.tree.map(lambda a: jax.device_put(a, sh) if a is not None else None, batch)
+
+
+def replicate(tree, mesh: Mesh):
+    sh = replicated(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+@contextmanager
+def maybe_mesh(mesh: Optional[Mesh]):
+    if mesh is None:
+        yield
+    else:
+        with mesh:
+            yield
+
+
+def cpu_test_mesh(n: int = 8, axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Mesh over forced-CPU virtual devices — the test-time substitute for a
+    pod slice (parity with the reference's Spark local[N] tests; SURVEY.md §4).
+    Requires XLA_FLAGS=--xla_force_host_platform_device_count=N."""
+    devs = [d for d in jax.devices() if d.platform == "cpu"][:n]
+    if len(devs) < n:
+        raise RuntimeError(
+            f"Need {n} CPU devices; set XLA_FLAGS=--xla_force_host_platform_device_count={n}")
+    return make_mesh(axes or {DATA_AXIS: n}, devs)

@@ -1,0 +1,111 @@
+"""Ring attention — sequence/context parallelism for long sequences.
+
+Absent from the reference (DL4J 0.9 predates attention; its only long-sequence
+tool is truncated BPTT — SURVEY.md §5). First-class here: sequences shard over
+the ``seq`` mesh axis; each device holds a (B, T/n, H, D) slice of Q/K/V and
+K/V blocks rotate around the ring via ``lax.ppermute`` while a flash-style
+online softmax (running max + normalizer) accumulates exact attention — O(T/n)
+memory per device, compute/communication overlapped by XLA.
+
+Layout: inputs are per-device blocks inside ``shard_map`` over ``seq``.
+Causal masking uses global positions derived from ``axis_index``; the scan is
+``lax.scan`` (static trip count = ring size) so the whole ring compiles into
+one program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import SEQ_AXIS
+
+
+def _block_attend(q, k, v, *, scale, q_pos, k_pos, causal, m, l, o):
+    """One block of online-softmax attention accumulation.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D); m/l running max/denominator
+    (B, H, Tq); o running unnormalized output (B, Tq, H, D).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        s = jnp.where(mask, s, -jnp.inf)
+    m_block = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_block)
+    # guard fully-masked rows (all -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = False,
+                         scale: Optional[float] = None):
+    """Per-device body (call inside shard_map over ``axis_name``).
+
+    q, k, v: (B, T_local, H, D) — this device's sequence block.
+    Returns (B, T_local, H, D) exact attention over the full sequence.
+    """
+    B, T, H, D = q.shape
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(D)
+    q_pos = idx * T + jnp.arange(T)
+
+    # pvary: mark the fresh accumulators as device-varying over the ring axis
+    # so the scan carry types match (shard_map manual-axes typing rule).
+    m0 = lax.pvary(jnp.full((B, H, T), -jnp.inf, jnp.float32), (axis_name,))
+    l0 = lax.pvary(jnp.zeros((B, H, T), jnp.float32), (axis_name,))
+    o0 = lax.pvary(jnp.zeros((B, T, H, D), jnp.float32), (axis_name,))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(carry, step):
+        m, l, o, k_cur, v_cur = carry
+        src = (idx - step) % n  # which block's K/V we hold this step
+        k_pos = src * T + jnp.arange(T)
+        m, l, o = _block_attend(q, k_cur, v_cur, scale=scale, q_pos=q_pos,
+                                k_pos=k_pos, causal=causal, m=m, l=l, o=o)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, o, k_next, v_next), None
+
+    (m, l, o, _, _), _ = lax.scan(body, (m0, l0, o0, k, v), jnp.arange(n))
+    l_safe = jnp.maximum(l, 1e-20)
+    return (o / l_safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
+                   seq_axis: str = SEQ_AXIS):
+    """Convenience wrapper: (B, T, H, D) global arrays -> sharded ring attention.
+
+    T must divide by mesh.shape[seq_axis]. Batch stays replicated here; compose
+    with a data axis by sharding B outside.
+    """
+    fn = jax.shard_map(
+        partial(ring_attention_local, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, seq_axis, None, None),) * 3,
+        out_specs=P(None, seq_axis, None, None))
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Dense single-device reference for equivalence tests."""
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) / jnp.sqrt(D)
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)

@@ -1,0 +1,111 @@
+"""Parameter/activation sharding rules — GSPMD tensor parallelism.
+
+DL4J 0.9 has NO model parallelism (SURVEY.md §2.4.5: params must fit on one
+device). This module is the TPU-native capability that replaces that gap:
+declarative rules map param tree paths to ``PartitionSpec``s; ``jit`` with
+NamedSharding-placed params lets GSPMD insert all-gather/reduce-scatter over
+the ``model`` axis. Megatron-style conventions:
+
+- column-parallel (split output dim):  matmul -> local, activations carry the
+  shard; row-parallel (split input dim): matmul -> psum.
+- pairs (up/down, qkv/out) are arranged column-then-row so each block needs
+  ONE all-reduce, fused by XLA into the surrounding computation.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+Rules = Sequence[Tuple[str, P]]
+
+# Default rules for the transformer layer family (attention.py param names).
+TRANSFORMER_RULES: Rules = (
+    (r"(.*/)?w_qkv", P(None, MODEL_AXIS)),  # column parallel
+    (r"(.*/)?b_qkv", P(MODEL_AXIS)),
+    (r"(.*/)?w_o", P(MODEL_AXIS, None)),    # row parallel
+    (r"(.*/)?w_up", P(None, MODEL_AXIS)),
+    (r"(.*/)?b_up", P(MODEL_AXIS)),
+    (r"(.*/)?w_down", P(MODEL_AXIS, None)),
+    (r".*embedding.*/w", P(None, MODEL_AXIS)),
+    (r"(.*/)?pos", P()),
+)
+
+# Dense/conv stacks (zoo CNNs): shard the widest dim of big kernels.
+CNN_RULES: Rules = (
+    (r".*/w$", P(None, None, None, MODEL_AXIS)),  # HWIO: split output channels
+    (r".*/b$", P(MODEL_AXIS)),
+)
+
+
+def _tree_paths(tree, prefix=""):
+    out = []
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.extend(_tree_paths(v, f"{prefix}{k}/"))
+    else:
+        out.append((prefix.rstrip("/"), tree))
+    return out
+
+
+def spec_for(path: str, leaf, rules: Rules, mesh: Mesh) -> P:
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path):
+            # drop axes that don't divide the dim (fallback to replication)
+            dims = np.asarray(leaf).shape
+            fixed = []
+            for i, ax in enumerate(spec):
+                if ax is None or i >= len(dims):
+                    fixed.append(None)
+                    continue
+                size = mesh.shape[ax] if isinstance(ax, str) else 1
+                fixed.append(ax if dims[i] % max(size, 1) == 0 else None)
+            return P(*fixed)
+    return P()
+
+
+def shard_params(params, mesh: Mesh, rules: Rules = TRANSFORMER_RULES):
+    """Place a params pytree on the mesh according to rules."""
+
+    def place(path, leaf):
+        return jax.device_put(leaf, NamedSharding(mesh, spec_for(path, leaf, rules, mesh)))
+
+    flat = _tree_paths(params)
+    placed = {p: place(p, l) for p, l in flat}
+
+    def rebuild(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: rebuild(v, f"{prefix}{k}/") for k, v in tree.items()}
+        return placed[prefix.rstrip("/")]
+
+    return rebuild(params)
+
+
+def sharding_tree(params, mesh: Mesh, rules: Rules = TRANSFORMER_RULES):
+    """NamedSharding pytree (for jit in_shardings/out_shardings)."""
+
+    def build(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {k: build(v, f"{prefix}{k}/") for k, v in tree.items()}
+        return NamedSharding(mesh, spec_for(prefix.rstrip("/"), tree, rules, mesh))
+
+    return build(params)
+
+
+def constrain_activations(x, mesh: Mesh, *, batch_axis: str = DATA_AXIS,
+                          seq_axis: Optional[str] = None):
+    """with_sharding_constraint for (B, T, D) activations: batch over data,
+    optionally sequence over seq (context parallelism)."""
+    if x.ndim == 3:
+        spec = P(batch_axis, seq_axis, None)
+    elif x.ndim == 2:
+        spec = P(batch_axis, None)
+    else:
+        spec = P(batch_axis)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

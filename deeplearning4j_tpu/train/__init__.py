@@ -1,0 +1,30 @@
+"""Training loop & optimization (L4) — Solver, listeners, early stopping,
+checkpointing (SURVEY.md §2.1 optimize/, earlystopping/)."""
+
+from .earlystopping import (BestScoreEpochTermination,
+                            ClassificationScoreCalculator,
+                            DataSetLossCalculator, EarlyStoppingConfiguration,
+                            EarlyStoppingResult, EarlyStoppingTrainer,
+                            InMemoryModelSaver, InvalidScoreIterationTermination,
+                            LocalFileModelSaver, MaxEpochsTermination,
+                            MaxScoreIterationTermination,
+                            MaxTimeIterationTermination, ROCScoreCalculator,
+                            ScoreImprovementEpochTermination)
+from .listeners import (CheckpointListener, CollectScoresListener,
+                        EvaluativeListener, PerformanceListener,
+                        ScoreIterationListener, SleepyTrainingListener,
+                        TimeIterationListener, TrainingListener)
+from .serialization import load_model, save_model
+from .trainer import Trainer, build_updater
+
+__all__ = ["BestScoreEpochTermination", "CheckpointListener",
+           "ClassificationScoreCalculator", "CollectScoresListener",
+           "DataSetLossCalculator", "EarlyStoppingConfiguration",
+           "EarlyStoppingResult", "EarlyStoppingTrainer", "EvaluativeListener",
+           "InMemoryModelSaver", "InvalidScoreIterationTermination",
+           "LocalFileModelSaver", "MaxEpochsTermination",
+           "MaxScoreIterationTermination", "MaxTimeIterationTermination",
+           "PerformanceListener", "ROCScoreCalculator", "ScoreIterationListener",
+           "ScoreImprovementEpochTermination", "SleepyTrainingListener",
+           "TimeIterationListener", "Trainer", "TrainingListener",
+           "build_updater", "load_model", "save_model"]

@@ -1,0 +1,254 @@
+"""Early stopping — parity with ``earlystopping/`` (SURVEY.md §2.1):
+EarlyStoppingConfiguration, 7 termination conditions (MaxEpochs, MaxTime,
+ScoreImprovementEpochs, BestScore, MaxScore, InvalidScore), score calculators
+(loss / classification-error / ROC-AUC on a held-out iterator), model savers
+(in-memory, local file), and EarlyStoppingTrainer driving a Trainer.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+# --- termination conditions (earlystopping/termination/) ---
+
+class EpochTerminationCondition:
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def terminate(self, loss: float) -> bool:
+        raise NotImplementedError
+
+
+@dataclass
+class MaxEpochsTermination(EpochTerminationCondition):
+    max_epochs: int
+
+    def terminate(self, epoch, score):
+        return epoch >= self.max_epochs - 1
+
+
+@dataclass
+class ScoreImprovementEpochTermination(EpochTerminationCondition):
+    """Stop if no improvement for N epochs (minimum improvement optional)."""
+
+    max_epochs_without_improvement: int
+    min_improvement: float = 0.0
+    _best: float = field(default=np.inf, repr=False)
+    _since: int = field(default=0, repr=False)
+
+    def terminate(self, epoch, score):
+        if score < self._best - self.min_improvement:
+            self._best = score
+            self._since = 0
+        else:
+            self._since += 1
+        return self._since > self.max_epochs_without_improvement
+
+
+@dataclass
+class BestScoreEpochTermination(EpochTerminationCondition):
+    """Stop once score is at least this good."""
+
+    target_score: float
+
+    def terminate(self, epoch, score):
+        return score < self.target_score
+
+
+@dataclass
+class MaxTimeIterationTermination(IterationTerminationCondition):
+    max_seconds: float
+    _start: Optional[float] = field(default=None, repr=False)
+
+    def terminate(self, loss):
+        if self._start is None:
+            self._start = time.monotonic()
+        return (time.monotonic() - self._start) > self.max_seconds
+
+
+@dataclass
+class MaxScoreIterationTermination(IterationTerminationCondition):
+    """Kill runs whose loss explodes past a bound."""
+
+    max_score: float
+
+    def terminate(self, loss):
+        return loss > self.max_score
+
+
+@dataclass
+class InvalidScoreIterationTermination(IterationTerminationCondition):
+    """InvalidScoreIterationTerminationCondition — NaN/Inf guard."""
+
+    def terminate(self, loss):
+        return not np.isfinite(loss)
+
+
+# --- score calculators (earlystopping/scorecalc/) ---
+
+class ScoreCalculator:
+    def score(self, trainer) -> float:
+        raise NotImplementedError
+
+
+@dataclass
+class DataSetLossCalculator(ScoreCalculator):
+    """Average loss on a held-out iterator."""
+
+    iterator: Any
+
+    def score(self, trainer):
+        return trainer.score_iterator(self.iterator)
+
+
+@dataclass
+class ClassificationScoreCalculator(ScoreCalculator):
+    """1 - accuracy (lower is better, consistent with loss-style scores)."""
+
+    iterator: Any
+
+    def score(self, trainer):
+        ev = trainer.evaluate(self.iterator)
+        return 1.0 - ev.accuracy()
+
+
+@dataclass
+class ROCScoreCalculator(ScoreCalculator):
+    """1 - AUC on a held-out iterator."""
+
+    iterator: Any
+    num_classes: int = 2
+
+    def score(self, trainer):
+        from ..eval import ROCMultiClass
+
+        roc = ROCMultiClass(self.num_classes)
+
+        for ds in self.iterator:
+            preds = trainer.model.output(ds.features, trainer.params, trainer.state)
+            if isinstance(preds, list):
+                preds = preds[0]
+            roc.eval(ds.labels, np.asarray(preds))
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        return 1.0 - roc.average_auc()
+
+
+# --- model savers (earlystopping/saver/) ---
+
+class ModelSaver:
+    def save_best(self, trainer, score: float):
+        raise NotImplementedError
+
+    def get_best(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(ModelSaver):
+    def __init__(self):
+        self.best = None
+
+    def save_best(self, trainer, score):
+        self.best = (jax.tree.map(lambda a: a, trainer.params),
+                     jax.tree.map(lambda a: a, trainer.state), score)
+
+    def get_best(self):
+        return self.best
+
+
+@dataclass
+class LocalFileModelSaver(ModelSaver):
+    directory: str
+
+    def save_best(self, trainer, score):
+        import os
+
+        os.makedirs(self.directory, exist_ok=True)
+        trainer.save(os.path.join(self.directory, "bestModel.zip"))
+
+    def get_best(self):
+        import os
+
+        from .serialization import load_model
+
+        return load_model(os.path.join(self.directory, "bestModel.zip"))
+
+
+# --- configuration + trainer (earlystopping/EarlyStoppingConfiguration, trainer/) ---
+
+@dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: ScoreCalculator
+    epoch_terminations: List[EpochTerminationCondition] = field(default_factory=list)
+    iteration_terminations: List[IterationTerminationCondition] = field(default_factory=list)
+    model_saver: ModelSaver = field(default_factory=InMemoryModelSaver)
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+
+@dataclass
+class EarlyStoppingResult:
+    termination_reason: str
+    termination_details: str
+    best_epoch: int
+    best_score: float
+    total_epochs: int
+    score_vs_epoch: Dict[int, float]
+
+
+class EarlyStoppingTrainer:
+    """earlystopping/trainer/EarlyStoppingTrainer.java equivalent."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, trainer):
+        self.config = config
+        self.trainer = trainer
+
+    def fit(self, train_iterator, max_epochs: int = 10_000) -> EarlyStoppingResult:
+        from .listeners import TrainingListener
+
+        cfg = self.config
+        best_score, best_epoch = np.inf, -1
+        scores: Dict[int, float] = {}
+        reason, details = "MaxEpochs", f"reached {max_epochs}"
+
+        stop_iter = {"flag": False, "why": ""}
+
+        class _IterGuard(TrainingListener):
+            def iteration_done(self, trainer, iteration, epoch, loss):
+                for cond in cfg.iteration_terminations:
+                    if cond.terminate(loss):
+                        stop_iter["flag"] = True
+                        stop_iter["why"] = f"{type(cond).__name__} at loss {loss:.4g}"
+
+        guard = _IterGuard()
+        epoch = 0
+        for epoch in range(max_epochs):
+            self.trainer.fit(train_iterator, epochs=1, listeners=[guard])
+            if stop_iter["flag"]:
+                reason, details = "IterationTermination", stop_iter["why"]
+                break
+            if (epoch + 1) % cfg.evaluate_every_n_epochs == 0:
+                s = cfg.score_calculator.score(self.trainer)
+                scores[epoch] = s
+                if s < best_score:
+                    best_score, best_epoch = s, epoch
+                    cfg.model_saver.save_best(self.trainer, s)
+                terminated = False
+                for cond in cfg.epoch_terminations:
+                    if cond.terminate(epoch, s):
+                        reason, details = "EpochTermination", type(cond).__name__
+                        terminated = True
+                        break
+                if terminated:
+                    break
+        return EarlyStoppingResult(reason, details, best_epoch, float(best_score),
+                                   epoch + 1, scores)

@@ -1,0 +1,197 @@
+"""Training listeners — parity with ``optimize/api/TrainingListener.java`` and
+``optimize/listeners/*`` (Score, Performance, Evaluative, CollectScores,
+TimeIteration, Sleepy, Checkpoint — SURVEY.md §2.1).
+
+The jit boundary changes the hook surface: DL4J's onForwardPass /
+onGradientCalculation fire inside the step; under XLA the whole step is one
+fused program, so listeners observe *between* steps (iteration_done) and at
+epoch edges — which is also where DL4J listeners do their real work.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    """Hook contract (TrainingListener.java)."""
+
+    def on_epoch_start(self, trainer, epoch: int):
+        pass
+
+    def on_epoch_end(self, trainer, epoch: int):
+        pass
+
+    def iteration_done(self, trainer, iteration: int, epoch: int, loss: float):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """ScoreIterationListener.java — log loss every N iterations."""
+
+    def __init__(self, print_every: int = 10, log_fn: Optional[Callable[[str], None]] = None):
+        self.print_every = print_every
+        self.log = log_fn or (lambda s: logger.info(s))
+        self.history: List[float] = []
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        if iteration % self.print_every == 0:
+            self.log(f"iter {iteration} epoch {epoch} score {loss:.6f}")
+
+
+class CollectScoresListener(TrainingListener):
+    """CollectScoresIterationListener.java — record (iteration, score) pairs."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = frequency
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, loss))
+
+
+class PerformanceListener(TrainingListener):
+    """PerformanceListener.java:87-112 — samples/sec, batches/sec, ETL time.
+
+    ETL time = gap between step end and next step start (host-side input cost),
+    the same quantity DL4J threads through setLastEtlTime.
+    """
+
+    def __init__(self, frequency: int = 10, log_fn=None):
+        self.frequency = frequency
+        self.log = log_fn or (lambda s: logger.info(s))
+        self._last_end: Optional[float] = None
+        self._step_start: Optional[float] = None
+        self.samples_per_sec: float = 0.0
+        self.batches_per_sec: float = 0.0
+        self.last_etl_ms: float = 0.0
+        self._window_start = None
+        self._window_iters = 0
+        self._window_samples = 0
+
+    def step_begin(self, batch_size: int):
+        now = time.perf_counter()
+        self._step_start = now
+        if self._last_end is not None:
+            self.last_etl_ms = (now - self._last_end) * 1e3
+        if self._window_start is None:
+            self._window_start = now
+        self._window_samples += batch_size
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        now = time.perf_counter()
+        self._last_end = now
+        self._window_iters += 1
+        if self._window_iters >= self.frequency:
+            dt = now - self._window_start
+            self.batches_per_sec = self._window_iters / dt
+            self.samples_per_sec = self._window_samples / dt
+            self.log(f"iter {iteration}: {self.samples_per_sec:.1f} samples/sec, "
+                     f"{self.batches_per_sec:.2f} batches/sec, ETL {self.last_etl_ms:.2f} ms")
+            self._window_start, self._window_iters, self._window_samples = now, 0, 0
+
+
+class EvaluativeListener(TrainingListener):
+    """EvaluativeListener.java:49 — run evaluation every N iterations/epochs.
+
+    invocation: "epoch_end" | "iteration" (InvocationType parity).
+    """
+
+    def __init__(self, test_iterator, frequency: int = 1, invocation: str = "epoch_end",
+                 evaluation_factory=None, log_fn=None):
+        from ..eval import Evaluation
+
+        self.test_iterator = test_iterator
+        self.frequency = frequency
+        self.invocation = invocation
+        self.evaluation_factory = evaluation_factory
+        self.log = log_fn or (lambda s: logger.info(s))
+        self.last_evaluation = None
+
+    def _run(self, trainer):
+        ev = trainer.evaluate(self.test_iterator, evaluation=self.evaluation_factory() if self.evaluation_factory else None)
+        self.last_evaluation = ev
+        self.log(f"eval accuracy={ev.accuracy():.4f} f1={ev.f1():.4f}")
+
+    def on_epoch_end(self, trainer, epoch):
+        if self.invocation == "epoch_end" and (epoch + 1) % self.frequency == 0:
+            self._run(trainer)
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        if self.invocation == "iteration" and iteration > 0 and iteration % self.frequency == 0:
+            self._run(trainer)
+
+
+class TimeIterationListener(TrainingListener):
+    """TimeIterationListener.java — ETA logging."""
+
+    def __init__(self, total_iterations: int, frequency: int = 100, log_fn=None):
+        self.total = total_iterations
+        self.frequency = frequency
+        self.log = log_fn or (lambda s: logger.info(s))
+        self.start = time.perf_counter()
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        if iteration and iteration % self.frequency == 0:
+            elapsed = time.perf_counter() - self.start
+            rate = iteration / elapsed
+            remaining = (self.total - iteration) / max(rate, 1e-9)
+            self.log(f"iter {iteration}/{self.total} ETA {remaining:.0f}s")
+
+
+class SleepyTrainingListener(TrainingListener):
+    """SleepyTrainingListener.java — throttle (debug/thermal tool)."""
+
+    def __init__(self, sleep_ms: float = 0.0):
+        self.sleep_ms = sleep_ms
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        if self.sleep_ms > 0:
+            time.sleep(self.sleep_ms / 1e3)
+
+
+class CheckpointListener(TrainingListener):
+    """checkpoint/CheckpointListener.java:72 — periodic checkpoints with
+    keep-last/keep-every retention."""
+
+    def __init__(self, directory: str, every_n_iterations: Optional[int] = None,
+                 every_n_epochs: Optional[int] = None, keep_last: Optional[int] = None,
+                 save_updater: bool = True):
+        import os
+
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.every_n_iterations = every_n_iterations
+        self.every_n_epochs = every_n_epochs
+        self.keep_last = keep_last
+        self.save_updater = save_updater
+        self.saved: List[str] = []
+
+    def _save(self, trainer, tag: str):
+        import os
+
+        from .serialization import save_model
+
+        path = os.path.join(self.directory, f"checkpoint_{tag}.zip")
+        save_model(path, trainer.model, params=trainer.params, state=trainer.state,
+                   opt_state=trainer.opt_state if self.save_updater else None)
+        self.saved.append(path)
+        if self.keep_last and len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        if self.every_n_iterations and iteration > 0 and iteration % self.every_n_iterations == 0:
+            self._save(trainer, f"iter{iteration}")
+
+    def on_epoch_end(self, trainer, epoch):
+        if self.every_n_epochs and (epoch + 1) % self.every_n_epochs == 0:
+            self._save(trainer, f"epoch{epoch + 1}")

@@ -1,0 +1,114 @@
+"""Model serialization — parity with ``util/ModelSerializer.java``.
+
+The reference zip layout (ModelSerializer.java:40): ``configuration.json`` +
+``coefficients.bin`` (flattened params) + ``updaterState.bin`` +
+``normalizer.bin``. Here the same zip container holds:
+
+- ``configuration.json``  — full architecture (Sequential/Graph to_json)
+- ``params.npz``          — params pytree (flattened key paths -> arrays)
+- ``state.npz``           — non-trained state (batchnorm stats, centers)
+- ``updater_state.npz``   — optax optimizer state (parity: DL4J saves updater
+                             state so training resumes exactly)
+- ``normalizer.json``     — data normalizer, if any
+
+Pytrees are flattened to ``/``-joined key paths; optax states flatten via
+jax.tree_util with a stored treedef-free index scheme (arrays only; structure
+is rebuilt from a template at load).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten_dict(d: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out = {}
+    if isinstance(d, dict):
+        for k, v in d.items():
+            out.update(_flatten_dict(v, f"{prefix}{k}/"))
+    elif isinstance(d, (list, tuple)):
+        for i, v in enumerate(d):
+            out.update(_flatten_dict(v, f"{prefix}{i}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(d)
+    return out
+
+
+def _unflatten_dict(flat: Dict[str, np.ndarray]) -> dict:
+    root: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(v)
+    return root
+
+
+def _save_npz(zf: zipfile.ZipFile, name: str, tree: Any):
+    flat = _flatten_dict(tree)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    zf.writestr(name, buf.getvalue())
+
+
+def _load_npz(zf: zipfile.ZipFile, name: str) -> Optional[dict]:
+    if name not in zf.namelist():
+        return None
+    with zf.open(name) as f:
+        data = np.load(io.BytesIO(f.read()))
+        return _unflatten_dict({k: data[k] for k in data.files})
+
+
+def save_model(path: str, model, *, params=None, state=None, opt_state=None,
+               normalizer=None):
+    """writeModel (ModelSerializer.java:109-169) equivalent."""
+    params = params if params is not None else model.params
+    state = state if state is not None else model.state
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", model.to_json())
+        _save_npz(zf, "params.npz", params or {})
+        if state:
+            _save_npz(zf, "state.npz", state)
+        if opt_state is not None:
+            leaves = jax.tree_util.tree_leaves(opt_state)
+            _save_npz(zf, "updater_state.npz", {str(i): l for i, l in enumerate(leaves)})
+        if normalizer is not None:
+            zf.writestr("normalizer.json", json.dumps(normalizer.to_dict()))
+
+
+def load_model(path: str, opt_state_template=None):
+    """restoreMultiLayerNetwork / restoreComputationGraph equivalent.
+
+    Returns (model, params, state, opt_state, normalizer); model.params/state
+    are populated. opt_state needs a template (from Trainer.init) to rebuild
+    its exact optax structure — pass None to skip.
+    """
+    from ..nn.model import Graph, Sequential
+
+    with zipfile.ZipFile(path) as zf:
+        cfg = zf.read("configuration.json").decode()
+        fmt = json.loads(cfg).get("format", "")
+        model = Sequential.from_json(cfg) if "sequential" in fmt else Graph.from_json(cfg)
+        params = _load_npz(zf, "params.npz") or {}
+        state = _load_npz(zf, "state.npz") or {}
+        opt_state = None
+        raw_opt = _load_npz(zf, "updater_state.npz")
+        if raw_opt is not None and opt_state_template is not None:
+            leaves_t, treedef = jax.tree_util.tree_flatten(opt_state_template)
+            leaves = [jnp.asarray(raw_opt[str(i)]) for i in range(len(leaves_t))]
+            opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
+        normalizer = None
+        if "normalizer.json" in zf.namelist():
+            from ..data.normalizers import Normalizer
+
+            normalizer = Normalizer.from_dict(json.loads(zf.read("normalizer.json").decode()))
+    model.params, model.state = params, state
+    return model, params, state, opt_state, normalizer

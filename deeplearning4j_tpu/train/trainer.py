@@ -1,0 +1,308 @@
+"""Trainer — the L4 training loop (Solver/ConvexOptimizer/fit equivalents).
+
+Reference call stack (SURVEY.md §3.1): MultiLayerNetwork.fit ->
+Solver.optimize -> StochasticGradientDescent -> computeGradientAndScore ->
+updater -> step. The TPU redesign collapses that stack into ONE jit-compiled
+pure function::
+
+    (params, opt_state, net_state, batch, rng) -> (params', opt_state', net_state', loss)
+
+with buffer donation on (params, opt_state, net_state) — the functional
+equivalent of DL4J's in-place flattened-param update (MultiLayerNetwork
+flattenedParams :114) without the mutable aliasing. XLA compiles the entire
+network + optimizer into a single fused program per batch shape; there is no
+per-op dispatch (the reference's main perf weakness, SURVEY.md §3.1 note).
+
+Per-layer updater overrides and Frozen layers map to optax.multi_transform
+over a layer-name label tree (parity: per-layer IUpdater configs and
+FrozenLayer's no-op updater).
+
+tBPTT (BackpropType.TruncatedBPTT, MultiLayerNetwork.java:1309): sequences are
+split into fixed chunks; RNN carries thread between chunk steps, gradients
+stop at chunk boundaries — same semantics, expressed with explicit carries.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..nn.layers.special import Frozen
+from ..nn.model import Graph, NetConfig, Sequential, _layer_key
+from ..ops import updaters as upd
+from .listeners import PerformanceListener, TrainingListener
+
+
+def build_updater(model) -> optax.GradientTransformation:
+    """Build the optax pipeline from NetConfig + per-layer overrides."""
+    cfg: NetConfig = model.config
+
+    def base_tx(updater_cfg):
+        return upd.build(updater_cfg,
+                         gradient_normalization=cfg.gradient_normalization,
+                         gradient_normalization_threshold=cfg.gradient_normalization_threshold,
+                         l1=cfg.l1, l2=cfg.l2)
+
+    # collect per-layer overrides / frozen layers
+    overrides: Dict[str, Any] = {}
+    if isinstance(model, Sequential):
+        named = [(_layer_key(i, l), l) for i, l in enumerate(model.layers)]
+    else:
+        named = [(n, model.nodes[n].spec) for n in model.topo_order if model.nodes[n].is_layer()]
+    for name, layer in named:
+        if isinstance(layer, Frozen):
+            overrides[name] = "noop"
+        elif getattr(layer, "updater", None) is not None:
+            overrides[name] = layer.updater
+
+    if not overrides:
+        return base_tx(cfg.updater)
+
+    transforms = {"__default__": base_tx(cfg.updater)}
+    labels_by_name = {}
+    for name, ov in overrides.items():
+        if ov == "noop":
+            transforms.setdefault("noop", optax.set_to_zero())
+            labels_by_name[name] = "noop"
+        else:
+            lbl = f"override_{name}"
+            transforms[lbl] = base_tx(ov)
+            labels_by_name[name] = lbl
+
+    def label_fn(params):
+        return {k: jax.tree.map(lambda _: labels_by_name.get(k, "__default__"), v)
+                for k, v in params.items()}
+
+    return optax.multi_transform(transforms, label_fn)
+
+
+class Trainer:
+    """Owns (params, state, opt_state) and the jitted step — Solver parity."""
+
+    def __init__(self, model, updater: Optional[optax.GradientTransformation] = None,
+                 seed: int = 0):
+        self.model = model
+        self.tx = updater if updater is not None else build_updater(model)
+        if model.params is None:
+            model.init()
+        self.params = model.params
+        self.state = model.state
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = 0
+        self.epoch = 0
+        self._rng = jax.random.PRNGKey(seed)
+        self._step_fn = None
+        self._tbptt_step_fn = None
+
+    # --- the jitted train step ---
+    def _make_step(self):
+        tx, model = self.tx, self.model
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, net_state, x, y, rng, mask=None, label_mask=None):
+            def loss_fn(p):
+                loss, new_state = model.score(p, net_state, x, y, training=True,
+                                              rng=rng, mask=mask,
+                                              **({"label_mask": label_mask}
+                                                 if isinstance(model, Sequential) else {}))
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, loss
+
+        return step
+
+    def _make_tbptt_step(self, chunk: int):
+        tx, model = self.tx, self.model
+        assert isinstance(model, Sequential), "tBPTT fit targets Sequential RNNs"
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=())
+        def step(params, opt_state, net_state, x, y, rng, carries, mask=None):
+            """One tBPTT chunk: grads flow within the chunk; carries are
+            stop-gradient at the boundary (DL4J doTruncatedBPTT parity)."""
+            carries = jax.lax.stop_gradient(carries)
+
+            def loss_fn(p):
+                loss, new_state, new_carries = model.score_with_carry(
+                    p, net_state, x, y, carries, training=True, rng=rng, mask=mask)
+                return loss, (new_state, new_carries)
+
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, new_carries, loss
+
+        return step
+
+    def next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    # --- fit (MultiLayerNetwork.fit :1262 / ComputationGraph.fit :1010) ---
+    def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = (),
+            prefetch: bool = True) -> "Trainer":
+        from ..data.iterators import AsyncIterator
+
+        if self._step_fn is None:
+            self._step_fn = self._make_step()
+        tbptt = getattr(self.model.config, "tbptt_length", 0)
+        for epoch in range(epochs):
+            self.epoch = epoch
+            for lst in listeners:
+                lst.on_epoch_start(self, epoch)
+            it = AsyncIterator(iterator) if prefetch else iterator
+            for ds in it:
+                bs = int(np.asarray(ds.features).shape[0])
+                for lst in listeners:
+                    if isinstance(lst, PerformanceListener):
+                        lst.step_begin(bs)
+                if tbptt and np.asarray(ds.features).ndim >= 3:
+                    loss = self._fit_tbptt_batch(ds, tbptt)
+                else:
+                    self.params, self.opt_state, self.state, loss = self._step_fn(
+                        self.params, self.opt_state, self.state,
+                        ds.features, ds.labels, self.next_rng(),
+                        ds.features_mask, ds.labels_mask)
+                lossf = float(loss)
+                for lst in listeners:
+                    lst.iteration_done(self, self.iteration, epoch, lossf)
+                self.iteration += 1
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for lst in listeners:
+                lst.on_epoch_end(self, epoch)
+        self.model.params, self.model.state = self.params, self.state
+        return self
+
+    def _fit_tbptt_batch(self, ds, chunk: int):
+        if self._tbptt_step_fn is None:
+            self._tbptt_step_fn = self._make_tbptt_step(chunk)
+        x = np.asarray(ds.features)
+        y = np.asarray(ds.labels)
+        B, T = x.shape[0], x.shape[1]
+        carries = self.model.init_carries(B)
+        loss = 0.0
+        n_chunks = 0
+        for t0 in range(0, T, chunk):
+            xc, yc = x[:, t0 : t0 + chunk], y[:, t0 : t0 + chunk]
+            mc = np.asarray(ds.features_mask)[:, t0 : t0 + chunk] if ds.features_mask is not None else None
+            if xc.shape[1] < chunk:  # ragged tail: pad + mask (static shapes for jit)
+                pad = chunk - xc.shape[1]
+                xc = np.pad(xc, [(0, 0), (0, pad)] + [(0, 0)] * (xc.ndim - 2))
+                yc = np.pad(yc, [(0, 0), (0, pad)] + [(0, 0)] * (yc.ndim - 2))
+                mc = np.pad(mc if mc is not None else np.ones((B, chunk - pad), np.float32),
+                            [(0, 0), (0, pad)])
+            self.params, self.opt_state, self.state, carries, l = self._tbptt_step_fn(
+                self.params, self.opt_state, self.state, xc, yc, self.next_rng(), carries, mc)
+            loss += float(l)
+            n_chunks += 1
+        return loss / max(n_chunks, 1)
+
+    # --- pretraining (layerwise, AutoEncoder/VAE pretrain parity) ---
+    def pretrain_layer(self, layer_index: int, iterator, epochs: int = 1,
+                       learning_rate: float = 1e-2):
+        """MultiLayerNetwork.pretrainLayer: unsupervised fit of one layer on the
+        activations of the layers below it."""
+        model = self.model
+        assert isinstance(model, Sequential)
+        layer = model.layers[layer_index]
+        assert hasattr(layer, "pretrain_loss"), f"{type(layer).__name__} is not pretrainable"
+        key = _layer_key(layer_index, layer)
+        tx = optax.adam(learning_rate)
+        lp = self.params[key]
+        opt = tx.init(lp)
+
+        @jax.jit
+        def pstep(lp, opt, x, rng):
+            def loss_fn(p):
+                feats, _ = model.forward({**self.params, key: p}, self.state, x,
+                                         training=False, up_to=layer_index)
+                try:
+                    return layer.pretrain_loss(p, feats, rng)
+                except TypeError:
+                    return layer.pretrain_loss(p, feats)
+
+            loss, g = jax.value_and_grad(loss_fn)(lp)
+            updates, opt = tx.update(g, opt, lp)
+            return optax.apply_updates(lp, updates), opt, loss
+
+        for _ in range(epochs):
+            for ds in iterator:
+                lp, opt, loss = pstep(lp, opt, ds.features, self.next_rng())
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        self.params = {**self.params, key: lp}
+        self.model.params = self.params
+        return float(loss)
+
+    # --- evaluation (streaming, Evaluation parity) ---
+    def evaluate(self, iterator, evaluation=None):
+        from ..eval import Evaluation
+
+        model = self.model
+        if evaluation is None:
+            n_out = model.output_shape[-1] if isinstance(model, Sequential) else model.output_shapes[0][-1]
+            evaluation = Evaluation(n_out)
+
+        @jax.jit
+        def infer(params, state, x, mask=None):
+            if isinstance(model, Sequential):
+                y, _ = model.forward(params, state, x, training=False, mask=mask)
+                return y
+            ys, _ = model.forward(params, state, x, training=False)
+            return ys[0]
+
+        for ds in iterator:
+            preds = infer(self.params, self.state, ds.features, ds.features_mask)
+            evaluation.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return evaluation
+
+    def score_iterator(self, iterator) -> float:
+        """Average loss over an iterator (model.score(DataSetIterator) parity)."""
+        model = self.model
+
+        @jax.jit
+        def score(params, state, x, y, mask=None):
+            l, _ = model.score(params, state, x, y, training=False, mask=mask)
+            return l
+
+        total, n = 0.0, 0
+        for ds in iterator:
+            total += float(score(self.params, self.state, ds.features, ds.labels, ds.features_mask))
+            n += 1
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return total / max(n, 1)
+
+    # --- checkpointing ---
+    def save(self, path: str, normalizer=None):
+        from .serialization import save_model
+
+        save_model(path, self.model, params=self.params, state=self.state,
+                   opt_state=self.opt_state, normalizer=normalizer)
+
+    @classmethod
+    def load(cls, path: str, seed: int = 0) -> "Trainer":
+        from .serialization import load_model
+
+        model, params, state, _, _ = load_model(path)
+        t = cls(model, seed=seed)
+        t.params, t.state = params, state
+        # rebuild opt state with exact structure, then fill from file
+        from .serialization import load_model as _lm
+
+        _, _, _, opt_state, _ = _lm(path, opt_state_template=t.opt_state)
+        if opt_state is not None:
+            t.opt_state = opt_state
+        model.params, model.state = params, state
+        return t

@@ -6,8 +6,14 @@ reference's Spark `local[N]` simulated clusters
 import os
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# The hosting environment pre-configures jax_platforms to "axon,cpu"; both
+# knobs are needed to actually land on the virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+assert jax.devices()[0].platform == "cpu", f"tests must run on CPU, got {jax.devices()}"

@@ -1,0 +1,155 @@
+"""Evaluation metric tests — exact-value assertions mirroring the reference's
+eval suite (Evaluation/ROC/RegressionEvaluation numerics, SURVEY.md §2.1)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (ROC, Evaluation, EvaluationBinary,
+                                     EvaluationCalibration, ROCMultiClass,
+                                     RegressionEvaluation)
+
+
+class TestEvaluation:
+    def test_perfect(self):
+        y = np.eye(3)[[0, 1, 2, 0]]
+        ev = Evaluation(3).eval(y, y)
+        assert ev.accuracy() == 1.0
+        assert ev.precision() == 1.0
+        assert ev.recall() == 1.0
+        assert ev.f1() == 1.0
+
+    def test_known_confusion(self):
+        # actual: 0,0,1,1 ; predicted: 0,1,1,1
+        labels = np.eye(2)[[0, 0, 1, 1]]
+        preds = np.eye(2)[[0, 1, 1, 1]]
+        ev = Evaluation(2).eval(labels, preds)
+        assert ev.accuracy() == 0.75
+        np.testing.assert_array_equal(ev.confusion, [[1, 1], [0, 2]])
+        assert ev.precision(1) == 2 / 3
+        assert ev.recall(0) == 0.5
+        assert ev.recall(1) == 1.0
+
+    def test_streaming_merge_equals_batch(self):
+        rng = np.random.default_rng(0)
+        y = np.eye(4)[rng.integers(0, 4, 100)]
+        p = rng.random((100, 4))
+        ev_all = Evaluation(4).eval(y, p)
+        ev_a = Evaluation(4).eval(y[:50], p[:50])
+        ev_b = Evaluation(4).eval(y[50:], p[50:])
+        ev_a.merge(ev_b)
+        np.testing.assert_array_equal(ev_all.confusion, ev_a.confusion)
+
+    def test_timeseries_mask(self):
+        # (B=1, T=3, K=2); mask hides the wrong prediction at t=2
+        y = np.array([[[1, 0], [0, 1], [1, 0]]], np.float32)
+        p = np.array([[[0.9, 0.1], [0.2, 0.8], [0.1, 0.9]]], np.float32)
+        ev = Evaluation(2).eval(y, p, mask=np.array([[1, 1, 0]]))
+        assert ev.accuracy() == 1.0
+        assert ev.num_examples == 2
+
+    def test_top_n(self):
+        y = np.eye(3)[[0, 1]]
+        p = np.array([[0.3, 0.4, 0.3], [0.2, 0.3, 0.5]])
+        ev = Evaluation(3, top_n=2).eval(y, p)
+        assert ev.accuracy() == 0.0
+        assert ev.top_n_accuracy() == 1.0
+
+    def test_mcc_binary(self):
+        labels = np.eye(2)[[0, 0, 1, 1]]
+        preds = np.eye(2)[[0, 1, 1, 1]]
+        ev = Evaluation(2).eval(labels, preds)
+        # TP=2 TN=1 FP=1 FN=0 -> MCC = (2*1-1*0)/sqrt(3*2*1*2)
+        expected = 2 / np.sqrt(12)
+        np.testing.assert_allclose(ev.matthews_correlation(), expected, rtol=1e-9)
+
+
+class TestBinary:
+    def test_per_output(self):
+        y = np.array([[1, 0], [1, 1], [0, 1], [0, 0]], np.float32)
+        p = np.array([[0.9, 0.2], [0.8, 0.4], [0.3, 0.9], [0.1, 0.1]], np.float32)
+        ev = EvaluationBinary(2).eval(y, p)
+        assert ev.accuracy(0) == 1.0
+        assert ev.recall(1) == 0.5
+        assert ev.precision(1) == 1.0
+
+
+class TestRegression:
+    def test_known_values(self):
+        y = np.array([[1.0], [2.0], [3.0]])
+        p = np.array([[1.5], [2.0], [2.5]])
+        ev = RegressionEvaluation(1).eval(y, p)
+        np.testing.assert_allclose(ev.mse(), (0.25 + 0 + 0.25) / 3)
+        np.testing.assert_allclose(ev.mae(), (0.5 + 0 + 0.5) / 3)
+        np.testing.assert_allclose(ev.rmse(), np.sqrt(1 / 6))
+
+    def test_r2_perfect(self):
+        y = np.array([[1.0], [2.0], [3.0]])
+        ev = RegressionEvaluation(1).eval(y, y)
+        np.testing.assert_allclose(ev.r2(), 1.0)
+        np.testing.assert_allclose(ev.pearson(), 1.0)
+
+    def test_streaming(self):
+        rng = np.random.default_rng(1)
+        y = rng.standard_normal((100, 2))
+        p = y + rng.standard_normal((100, 2)) * 0.1
+        ev1 = RegressionEvaluation(2).eval(y, p)
+        ev2 = RegressionEvaluation(2)
+        ev2.eval(y[:30], p[:30]).eval(y[30:], p[30:])
+        np.testing.assert_allclose(ev1.mse(0), ev2.mse(0))
+        np.testing.assert_allclose(ev1.r2(1), ev2.r2(1))
+
+
+class TestROC:
+    def test_perfect_separation(self):
+        y = np.array([0, 0, 1, 1], np.float32)
+        p = np.array([0.1, 0.2, 0.8, 0.9], np.float32)
+        roc = ROC(num_thresholds=0).eval(y, p)
+        np.testing.assert_allclose(roc.auc(), 1.0)
+
+    def test_random_is_half(self):
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 2, 20000).astype(np.float32)
+        p = rng.random(20000).astype(np.float32)
+        roc = ROC(num_thresholds=0).eval(y, p)
+        assert abs(roc.auc() - 0.5) < 0.02
+
+    def test_exact_auc_value(self):
+        # hand-computable: y=[1,0,1,0], p=[.9,.8,.7,.1] -> AUC = 3/4
+        y = np.array([1, 0, 1, 0], np.float32)
+        p = np.array([0.9, 0.8, 0.7, 0.1], np.float32)
+        roc = ROC(num_thresholds=0).eval(y, p)
+        np.testing.assert_allclose(roc.auc(), 0.75)
+
+    def test_histogram_mode_close_to_exact(self):
+        rng = np.random.default_rng(2)
+        y = rng.integers(0, 2, 5000).astype(np.float32)
+        p = np.clip(y * 0.4 + rng.random(5000) * 0.6, 0, 1).astype(np.float32)
+        exact = ROC(num_thresholds=0).eval(y, p).auc()
+        hist = ROC(num_thresholds=500).eval(y, p).auc()
+        assert abs(exact - hist) < 0.01
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(3)
+        idx = rng.integers(0, 3, 1000)
+        y = np.eye(3)[idx].astype(np.float32)
+        logits = rng.standard_normal((1000, 3)) + 2.5 * y
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        roc = ROCMultiClass(3).eval(y, p)
+        assert roc.average_auc() > 0.85
+        for k in range(3):
+            assert roc.auc(k) > 0.8
+
+
+class TestCalibration:
+    def test_well_calibrated(self):
+        rng = np.random.default_rng(4)
+        p = rng.random(20000)
+        y = (rng.random(20000) < p).astype(np.float32)
+        cal = EvaluationCalibration(10).eval(y, p)
+        assert cal.expected_calibration_error() < 0.02
+
+    def test_overconfident_flagged(self):
+        y = np.zeros(1000, np.float32)
+        p = np.full(1000, 0.9, np.float32)
+        cal = EvaluationCalibration(10).eval(y, p)
+        assert cal.expected_calibration_error() > 0.8

@@ -1,0 +1,301 @@
+"""Layer-behavior tests + gradient checks — mirrors the reference's
+deterministic small-tensor layer tests and gradient-check suites
+(deeplearning4j-core .../gradientcheck/, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn.api import layer_from_dict
+from deeplearning4j_tpu.utils.gradient_check import check_gradients
+
+KEY = jax.random.PRNGKey(42)
+
+
+def run_layer(layer, x, training=False, rng=None, mask=None):
+    params, state = layer.init(KEY, x.shape[1:])
+    y, new_state, out_mask = layer.apply(params, state, x, training=training, rng=rng, mask=mask)
+    return y, params, state, out_mask
+
+
+class TestShapeInference:
+    """output_shape() must agree with the actual computation for every layer."""
+
+    CASES = [
+        (L.Dense(n_out=7), (5,)),
+        (L.Conv2D(n_out=4, kernel=(3, 3), padding="same"), (8, 8, 3)),
+        (L.Conv2D(n_out=4, kernel=(3, 3), padding="valid", stride=(2, 2)), (9, 9, 3)),
+        (L.Conv2D(n_out=4, kernel=(3, 3), padding=(1, 1), stride=(1, 1)), (8, 8, 3)),
+        (L.Conv2D(n_out=4, kernel=(3, 3), dilation=(2, 2), padding="valid"), (9, 9, 3)),
+        (L.Conv1D(n_out=6, kernel=3, padding="same"), (10, 4)),
+        (L.Conv1D(n_out=6, kernel=3, padding="valid", stride=2), (11, 4)),
+        (L.Deconv2D(n_out=2, kernel=(2, 2), stride=(2, 2)), (5, 5, 3)),
+        (L.DepthwiseConv2D(depth_multiplier=2, kernel=(3, 3)), (8, 8, 3)),
+        (L.SeparableConv2D(n_out=5, kernel=(3, 3)), (8, 8, 3)),
+        (L.Subsampling2D(kernel=(2, 2), stride=(2, 2)), (8, 8, 3)),
+        (L.Subsampling2D(kernel=(3, 3), stride=(1, 1), padding="same", mode="avg"), (8, 8, 3)),
+        (L.Subsampling1D(kernel=2, stride=2), (10, 4)),
+        (L.Upsampling2D(size=(2, 2)), (4, 4, 3)),
+        (L.Upsampling1D(size=3), (4, 2)),
+        (L.ZeroPadding2D(padding=(1, 2, 3, 4)), (5, 5, 2)),
+        (L.ZeroPadding1D(padding=(2, 1)), (5, 2)),
+        (L.Cropping2D(cropping=(1, 1, 1, 1)), (6, 6, 2)),
+        (L.SpaceToDepth(block_size=2), (6, 6, 4)),
+        (L.GlobalPooling(mode="avg"), (6, 6, 4)),
+        (L.Flatten(), (3, 4, 5)),
+        (L.Reshape(shape=(2, 6)), (12,)),
+        (L.BatchNorm(), (5,)),
+        (L.LayerNorm(), (5,)),
+        (L.RMSNorm(), (5,)),
+        (L.LSTM(n_out=6), (7, 3)),
+        (L.GravesLSTM(n_out=6), (7, 3)),
+        (L.GRU(n_out=6), (7, 3)),
+        (L.SimpleRnn(n_out=6), (7, 3)),
+        (L.MultiHeadAttention(num_heads=2), (6, 8)),
+        (L.TransformerEncoderBlock(num_heads=2), (6, 8)),
+        (L.Output(n_out=3), (5,)),
+        (L.AutoEncoder(n_out=4), (6,)),
+        (L.VAE(n_out=3, encoder_sizes=[8], decoder_sizes=[8]), (6,)),
+    ]
+
+    @pytest.mark.parametrize("layer,in_shape", CASES, ids=lambda c: type(c).__name__ if hasattr(c, "apply") else str(c))
+    def test_shape_matches(self, layer, in_shape):
+        x = jax.random.normal(KEY, (2,) + tuple(in_shape))
+        y, *_ = run_layer(layer, x)
+        expected = layer.output_shape(tuple(in_shape))
+        sb = y.shape[0]
+        assert tuple(y.shape[1:]) == tuple(expected), f"{type(layer).__name__}: {y.shape[1:]} != {expected}"
+        if not isinstance(layer, L.SpaceToBatch):
+            assert sb == 2
+
+    @pytest.mark.parametrize("layer,in_shape", CASES, ids=lambda c: type(c).__name__ if hasattr(c, "apply") else str(c))
+    def test_serde_roundtrip(self, layer, in_shape):
+        d = layer.to_dict()
+        import json
+
+        layer2 = layer_from_dict(json.loads(json.dumps(d)))
+        # tuples become lists through JSON; compare canonical serialized forms
+        assert layer2.to_dict() == layer.to_dict()
+        # and behavior must match exactly
+        x = jax.random.normal(KEY, (2,) + tuple(in_shape))
+        y1, p, s, _ = run_layer(layer, x)
+        y2, _, _ = layer2.apply(p, s, x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+
+class TestLayerSemantics:
+    def test_dense_manual(self):
+        layer = L.Dense(n_out=2, activation="identity")
+        x = jnp.array([[1.0, 2.0]])
+        params = {"w": jnp.array([[1.0, 0.0], [0.0, 1.0]]), "b": jnp.array([1.0, -1.0])}
+        y, _, _ = layer.apply(params, {}, x)
+        np.testing.assert_allclose(np.asarray(y), [[2.0, 1.0]])
+
+    def test_conv_identity_kernel(self):
+        layer = L.Conv2D(n_out=1, kernel=(1, 1), padding="valid", use_bias=False)
+        x = jax.random.normal(KEY, (1, 4, 4, 1))
+        params = {"w": jnp.ones((1, 1, 1, 1))}
+        y, _, _ = layer.apply(params, {}, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
+
+    def test_maxpool_manual(self):
+        layer = L.Subsampling2D(kernel=(2, 2), stride=(2, 2), mode="max")
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        y, *_ = run_layer(layer, x)
+        np.testing.assert_array_equal(np.asarray(y[0, :, :, 0]), [[5, 7], [13, 15]])
+
+    def test_avgpool_manual(self):
+        layer = L.Subsampling2D(kernel=(2, 2), stride=(2, 2), mode="avg")
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        y, *_ = run_layer(layer, x)
+        np.testing.assert_allclose(np.asarray(y[0, :, :, 0]), [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_batchnorm_normalizes(self):
+        layer = L.BatchNorm()
+        x = jax.random.normal(KEY, (64, 8)) * 5 + 3
+        params, state = layer.init(KEY, (8,))
+        y, new_state, _ = layer.apply(params, state, x, training=True)
+        assert abs(float(y.mean())) < 0.1
+        assert abs(float(y.std()) - 1.0) < 0.1
+        # running stats moved toward batch stats
+        assert float(jnp.abs(new_state["mean"]).sum()) > 0
+
+    def test_batchnorm_inference_uses_running_stats(self):
+        layer = L.BatchNorm(decay=0.0)  # running stats = batch stats immediately
+        x = jax.random.normal(KEY, (256, 4)) * 2 + 1
+        params, state = layer.init(KEY, (4,))
+        _, state1, _ = layer.apply(params, state, x, training=True)
+        y, _, _ = layer.apply(params, state1, x, training=False)
+        assert abs(float(y.mean())) < 0.05
+
+    def test_lrn_shape_and_value(self):
+        layer = L.LRN()
+        x = jnp.ones((1, 2, 2, 8))
+        y, *_ = run_layer(layer, x)
+        assert y.shape == x.shape
+        assert float(y.max()) < 1.0  # denominator > 1
+
+    def test_embedding_lookup(self):
+        layer = L.Embedding(n_in=10, n_out=4)
+        params, state = layer.init(KEY, (1,))
+        ids = jnp.array([0, 3, 9])
+        y, _, _ = layer.apply(params, state, ids)
+        np.testing.assert_allclose(np.asarray(y[1]), np.asarray(params["w"][3]))
+
+    def test_embedding_onehot_matmul_equiv(self):
+        l1 = L.Embedding(n_in=10, n_out=4)
+        l2 = L.Embedding(n_in=10, n_out=4, one_hot_matmul=True)
+        params, _ = l1.init(KEY, (1,))
+        ids = jnp.array([1, 5])
+        y1, _, _ = l1.apply(params, {}, ids)
+        y2, _, _ = l2.apply(params, {}, ids)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+    def test_space_to_depth_roundtrip_count(self):
+        layer = L.SpaceToDepth(block_size=2)
+        x = jax.random.normal(KEY, (2, 4, 4, 3))
+        y, *_ = run_layer(layer, x)
+        assert y.shape == (2, 2, 2, 12)
+        np.testing.assert_allclose(float(jnp.sum(jnp.square(y))), float(jnp.sum(jnp.square(x))), rtol=1e-5)
+
+    def test_frozen_stops_gradient(self):
+        inner = L.Dense(n_out=3, activation="tanh").to_dict()
+        layer = L.Frozen(inner=inner)
+        x = jax.random.normal(KEY, (2, 4))
+        params, state = layer.init(KEY, (4,))
+
+        def loss(p):
+            y, _, _ = layer.apply(p, state, x)
+            return jnp.sum(jnp.square(y))
+
+        g = jax.grad(loss)(params)
+        assert all(float(jnp.abs(v).sum()) == 0.0 for v in jax.tree_util.tree_leaves(g))
+
+
+class TestRecurrent:
+    def test_lstm_carry_consistency(self):
+        """Full-sequence scan == two half-sequence scans with carried state (tBPTT)."""
+        layer = L.LSTM(n_out=5)
+        x = jax.random.normal(KEY, (3, 8, 4))
+        params, _ = layer.init(KEY, (8, 4))
+        c0 = layer.init_carry(3, (8, 4))
+        y_full, _ = layer.apply_sequence(params, x, c0)
+        y1, c1 = layer.apply_sequence(params, x[:, :4], c0)
+        y2, _ = layer.apply_sequence(params, x[:, 4:], c1)
+        np.testing.assert_allclose(np.asarray(y_full), np.asarray(jnp.concatenate([y1, y2], 1)), rtol=2e-5, atol=1e-6)
+
+    def test_step_matches_sequence(self):
+        """rnnTimeStep parity: stepping one-by-one == full scan."""
+        layer = L.GravesLSTM(n_out=4)
+        x = jax.random.normal(KEY, (2, 5, 3))
+        params, _ = layer.init(KEY, (5, 3))
+        carry = layer.init_carry(2, (5, 3))
+        outs = []
+        for t in range(5):
+            y_t, carry = layer.step(params, x[:, t], carry)
+            outs.append(y_t)
+        y_seq, _ = layer.apply_sequence(params, x, layer.init_carry(2, (5, 3)))
+        np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)), np.asarray(y_seq), rtol=2e-5, atol=1e-6)
+
+    def test_mask_holds_state(self):
+        """Masked steps must not advance the hidden state."""
+        layer = L.LSTM(n_out=4)
+        params, _ = layer.init(KEY, (6, 3))
+        x = jax.random.normal(KEY, (1, 6, 3))
+        mask = jnp.array([[1.0, 1.0, 1.0, 0.0, 0.0, 0.0]])
+        c0 = layer.init_carry(1, (6, 3))
+        _, final_masked = layer.apply_sequence(params, x, c0, mask=mask)
+        _, final_3 = layer.apply_sequence(params, x[:, :3], c0)
+        for a, b in zip(final_masked, final_3):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+    def test_bidirectional_concat(self):
+        sub = L.LSTM(n_out=4).to_dict()
+        layer = L.Bidirectional(fwd=sub, mode="concat")
+        x = jax.random.normal(KEY, (2, 6, 3))
+        y, *_ = run_layer(layer, x)
+        assert y.shape == (2, 6, 8)
+
+    def test_bidirectional_modes(self):
+        sub = L.SimpleRnn(n_out=4).to_dict()
+        for mode in ["add", "mul", "average"]:
+            layer = L.Bidirectional(fwd=sub, mode=mode)
+            x = jax.random.normal(KEY, (2, 5, 3))
+            y, *_ = run_layer(layer, x)
+            assert y.shape == (2, 5, 4), mode
+
+    def test_last_time_step_masked(self):
+        sub = L.SimpleRnn(n_out=3).to_dict()
+        layer = L.LastTimeStep(fwd=sub)
+        x = jax.random.normal(KEY, (2, 5, 2))
+        mask = jnp.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+        params, state = layer.init(KEY, (5, 2))
+        y, _, _ = layer.apply(params, state, x, mask=mask)
+        # row 0 should equal output at t=2
+        inner = L.SimpleRnn(n_out=3)
+        full, _ = inner.apply_sequence(params, x, inner.init_carry(2, (5, 2)), mask=mask)
+        np.testing.assert_allclose(np.asarray(y[0]), np.asarray(full[0, 2]), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(y[1]), np.asarray(full[1, 4]), rtol=1e-5)
+
+
+class TestGradients:
+    """Numerical-vs-analytic gradient checks — the reference's core oracle."""
+
+    GRAD_CASES = [
+        (L.Dense(n_out=4, activation="tanh"), (5,)),
+        (L.Conv2D(n_out=3, kernel=(3, 3), activation="tanh", padding="same"), (6, 6, 2)),
+        (L.Conv1D(n_out=3, kernel=3, activation="tanh"), (7, 2)),
+        (L.Deconv2D(n_out=2, kernel=(2, 2), stride=(2, 2), activation="tanh"), (4, 4, 2)),
+        (L.SeparableConv2D(n_out=3, kernel=(3, 3), activation="tanh"), (5, 5, 2)),
+        (L.DepthwiseConv2D(depth_multiplier=2, kernel=(3, 3), activation="tanh"), (5, 5, 2)),
+        (L.BatchNorm(), (4,)),
+        (L.LayerNorm(), (4,)),
+        (L.LSTM(n_out=3), (6, 2)),
+        (L.GravesLSTM(n_out=3), (6, 2)),
+        (L.GRU(n_out=3), (6, 2)),
+        (L.SimpleRnn(n_out=3), (6, 2)),
+        (L.MultiHeadAttention(num_heads=2), (4, 6)),
+        (L.PReLU(), (5,)),
+        (L.ElementWiseMultiplication(), (5,)),
+    ]
+
+    @pytest.mark.parametrize("layer,in_shape", GRAD_CASES, ids=lambda c: type(c).__name__ if hasattr(c, "apply") else str(c))
+    def test_gradcheck(self, layer, in_shape):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            x = jax.random.normal(KEY, (2,) + tuple(in_shape), jnp.float64)
+            params, state = layer.init(KEY, tuple(in_shape), jnp.float64)
+            target = jax.random.normal(jax.random.PRNGKey(7), (2,) + tuple(layer.output_shape(tuple(in_shape))), jnp.float64)
+
+            def loss(p):
+                y, _, _ = layer.apply(p, state, x, training=False)
+                return jnp.mean(jnp.square(y - target))
+
+            assert check_gradients(loss, params, max_checks_per_param=8, verbose=True)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    def test_vae_pretrain_gradcheck(self):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            layer = L.VAE(n_out=3, encoder_sizes=[6], decoder_sizes=[6], reconstruction="gaussian")
+            x = jax.random.normal(KEY, (4, 5), jnp.float64)
+            params, _ = layer.init(KEY, (5,), jnp.float64)
+            rng = jax.random.PRNGKey(3)
+            assert check_gradients(lambda p: layer.pretrain_loss(p, x, rng), params,
+                                   max_checks_per_param=6, verbose=True)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    def test_autoencoder_pretrain_gradcheck(self):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            layer = L.AutoEncoder(n_out=4, corruption_level=0.0)
+            x = jax.random.normal(KEY, (4, 6), jnp.float64)
+            params, _ = layer.init(KEY, (6,), jnp.float64)
+            assert check_gradients(lambda p: layer.pretrain_loss(p, x), params,
+                                   max_checks_per_param=8, verbose=True)
+        finally:
+            jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,243 @@
+"""Container tests: Sequential + Graph — config serde, topo sort, vertices,
+score/grad, masking, tBPTT carry. Mirrors the reference's
+nn/conf JSON round-trip suites and ComputationGraph tests (SURVEY.md §4)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (Graph, GraphBuilder, NetConfig, Sequential,
+                                   SequentialBuilder)
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import vertices as V
+from deeplearning4j_tpu.utils.gradient_check import check_model_gradients
+
+KEY = jax.random.PRNGKey(0)
+
+
+def mlp(seed=0):
+    return (SequentialBuilder(NetConfig(seed=seed))
+            .input_shape(4)
+            .layer(L.Dense(n_out=8, activation="tanh"))
+            .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+
+
+class TestSequential:
+    def test_init_shapes(self):
+        net = mlp()
+        params, state = net.init()
+        assert params["layer_0"]["w"].shape == (4, 8)
+        assert params["layer_1"]["w"].shape == (8, 3)
+        assert net.param_count() == 4 * 8 + 8 + 8 * 3 + 3
+
+    def test_output_softmax(self):
+        net = mlp()
+        net.init()
+        x = jax.random.normal(KEY, (5, 4))
+        y = net.output(x)
+        np.testing.assert_allclose(np.asarray(y.sum(-1)), 1.0, rtol=1e-5)
+
+    def test_score_decreases_with_sgd(self):
+        net = mlp()
+        params, state = net.init()
+        x = jax.random.normal(KEY, (16, 4))
+        y = jax.nn.one_hot(jnp.arange(16) % 3, 3)
+
+        def loss(p):
+            return net.score(p, state, x, y, training=False)[0]
+
+        l0 = float(loss(params))
+        for _ in range(20):
+            g = jax.grad(loss)(params)
+            params = jax.tree.map(lambda p, gg: p - 0.5 * gg, params, g)
+        assert float(loss(params)) < l0 * 0.9
+
+    def test_json_roundtrip_identical_outputs(self):
+        net = mlp(seed=7)
+        p, s = net.init()
+        net2 = Sequential.from_json(net.to_json())
+        p2, s2 = net2.init()
+        x = jax.random.normal(KEY, (3, 4))
+        np.testing.assert_allclose(np.asarray(net.output(x, p, s)),
+                                   np.asarray(net2.output(x, p2, s2)), rtol=1e-6)
+
+    def test_gradient_check_full_net(self):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            net = (SequentialBuilder(NetConfig(seed=1, dtype="float64"))
+                   .input_shape(6, 6, 1)
+                   .layer(L.Conv2D(n_out=2, kernel=(3, 3), activation="tanh"))
+                   .layer(L.Subsampling2D(kernel=(2, 2), stride=(2, 2)))
+                   .layer(L.Flatten())
+                   .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+                   .build())
+            params, state = net.init()
+            x = jax.random.normal(KEY, (3, 6, 6, 1), jnp.float64)
+            y = jax.nn.one_hot(jnp.arange(3) % 3, 3, dtype=jnp.float64)
+            assert check_model_gradients(net, params, state, x, y, max_checks_per_param=6, verbose=True)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    def test_rnn_net_with_tbptt_carry(self):
+        net = (SequentialBuilder(NetConfig(seed=3))
+               .input_shape(8, 5)
+               .layer(L.LSTM(n_out=6))
+               .layer(L.RnnOutput(n_out=2, activation="softmax", loss="mcxent"))
+               .build())
+        params, state = net.init()
+        x = jax.random.normal(KEY, (2, 8, 5))
+        carries = net.init_carries(2)
+        y, _, new_carries = net.forward_with_carry(params, state, x, carries)
+        assert y.shape == (2, 8, 2)
+        # chunked == full
+        y1, _, c1 = net.forward_with_carry(params, state, x[:, :4], carries)
+        y2, _, _ = net.forward_with_carry(params, state, x[:, 4:], c1)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(jnp.concatenate([y1, y2], 1)), rtol=2e-5, atol=1e-6)
+
+    def test_mask_flows_to_loss(self):
+        net = (SequentialBuilder(NetConfig(seed=3))
+               .input_shape(4, 3)
+               .layer(L.SimpleRnn(n_out=5))
+               .layer(L.RnnOutput(n_out=2, activation="softmax", loss="mcxent"))
+               .build())
+        params, state = net.init()
+        x = jax.random.normal(KEY, (2, 4, 3))
+        y = jnp.zeros((2, 4, 2)).at[..., 0].set(1.0)
+        mask = jnp.array([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+        l_masked, _ = net.score(params, state, x, y, mask=mask)
+        l_full, _ = net.score(params, state, x, y)
+        assert not np.isclose(float(l_masked), float(l_full))
+
+    def test_compute_dtype_bf16(self):
+        net = Sequential(NetConfig(seed=0, compute_dtype="bfloat16"),
+                         [L.Dense(n_out=8, activation="relu"), L.Output(n_out=2, loss="mcxent")],
+                         (4,))
+        params, state = net.init()
+        x = jax.random.normal(KEY, (2, 4))
+        y = net.output(x)
+        assert y.dtype == jnp.float32  # cast back at the boundary
+
+
+class TestGraph:
+    def build_branchy(self):
+        return (GraphBuilder(NetConfig(seed=5))
+                .add_input("in", (6,))
+                .add_layer("fc1", L.Dense(n_out=8, activation="relu"), "in")
+                .add_layer("fc2a", L.Dense(n_out=4, activation="tanh"), "fc1")
+                .add_layer("fc2b", L.Dense(n_out=4, activation="sigmoid"), "fc1")
+                .add_vertex("merged", V.Merge(), "fc2a", "fc2b")
+                .add_layer("out", L.Output(n_out=3, activation="softmax", loss="mcxent"), "merged")
+                .set_outputs("out")
+                .build())
+
+    def test_topo_and_shapes(self):
+        g = self.build_branchy()
+        assert g.topo_order.index("fc1") < g.topo_order.index("fc2a")
+        assert g.topo_order.index("merged") < g.topo_order.index("out")
+        assert g._shapes["merged"] == (8,)
+        assert g.output_shapes == [(3,)]
+
+    def test_forward_and_score(self):
+        g = self.build_branchy()
+        params, state = g.init()
+        x = jax.random.normal(KEY, (4, 6))
+        (y,), _ = g.forward(params, state, x)
+        assert y.shape == (4, 3)
+        labels = jax.nn.one_hot(jnp.arange(4) % 3, 3)
+        loss, _ = g.score(params, state, x, labels)
+        assert float(loss) > 0
+
+    def test_cycle_detection(self):
+        from deeplearning4j_tpu.nn.model import GraphNode
+
+        with pytest.raises(ValueError, match="cycle"):
+            Graph(NetConfig(), ["in"], {"in": (4,)},
+                  {"a": GraphNode(L.Dense(n_out=4), ("b",)),
+                   "b": GraphNode(L.Dense(n_out=4), ("a",))},
+                  ["a"])
+
+    def test_multi_input_multi_output(self):
+        g = (GraphBuilder(NetConfig(seed=2))
+             .add_input("x1", (4,))
+             .add_input("x2", (4,))
+             .add_vertex("sum", V.ElementWise(op="add"), "x1", "x2")
+             .add_layer("h", L.Dense(n_out=6, activation="relu"), "sum")
+             .add_layer("out1", L.Output(n_out=2, loss="mcxent"), "h")
+             .add_layer("out2", L.Output(n_out=1, activation="identity", loss="mse"), "h")
+             .set_outputs("out1", "out2")
+             .build())
+        params, state = g.init()
+        ins = {"x1": jnp.ones((3, 4)), "x2": jnp.ones((3, 4))}
+        outs, _ = g.forward(params, state, ins)
+        assert outs[0].shape == (3, 2) and outs[1].shape == (3, 1)
+        loss, _ = g.score(params, state, ins, [jax.nn.one_hot(jnp.zeros(3, jnp.int32), 2), jnp.zeros((3, 1))])
+        assert np.isfinite(float(loss))
+
+    def test_graph_json_roundtrip(self):
+        g = self.build_branchy()
+        p, s = g.init()
+        g2 = Graph.from_json(g.to_json())
+        p2, s2 = g2.init()
+        x = jax.random.normal(KEY, (2, 6))
+        np.testing.assert_allclose(np.asarray(g.output(x, p, s)[0]),
+                                   np.asarray(g2.output(x, p2, s2)[0]), rtol=1e-6)
+
+    def test_graph_gradcheck(self):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            g = (GraphBuilder(NetConfig(seed=9, dtype="float64"))
+                 .add_input("in", (5,))
+                 .add_layer("a", L.Dense(n_out=4, activation="tanh"), "in")
+                 .add_layer("b", L.Dense(n_out=4, activation="sigmoid"), "in")
+                 .add_vertex("m", V.ElementWise(op="product"), "a", "b")
+                 .add_layer("out", L.Output(n_out=2, activation="softmax", loss="mcxent"), "m")
+                 .set_outputs("out")
+                 .build())
+            params, state = g.init()
+            x = jax.random.normal(KEY, (3, 5), jnp.float64)
+            y = jax.nn.one_hot(jnp.arange(3) % 2, 2, dtype=jnp.float64)
+            assert check_model_gradients(g, params, state, x, y, max_checks_per_param=6, verbose=True)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+
+class TestVertices:
+    def test_all_vertex_semantics(self):
+        a = jnp.array([[1.0, 2.0]])
+        b = jnp.array([[3.0, 4.0]])
+        assert np.allclose(V.Merge().apply([a, b]), [[1, 2, 3, 4]])
+        assert np.allclose(V.ElementWise("add").apply([a, b]), [[4, 6]])
+        assert np.allclose(V.ElementWise("subtract").apply([a, b]), [[-2, -2]])
+        assert np.allclose(V.ElementWise("product").apply([a, b]), [[3, 8]])
+        assert np.allclose(V.ElementWise("max").apply([a, b]), [[3, 4]])
+        assert np.allclose(V.ElementWise("average").apply([a, b]), [[2, 3]])
+        assert np.allclose(V.Scale(2.0).apply([a]), [[2, 4]])
+        assert np.allclose(V.Shift(1.0).apply([a]), [[2, 3]])
+        n = V.L2Norm().apply([a])
+        assert np.isclose(float(jnp.linalg.norm(n)), 1.0)
+        d = V.L2Distance().apply([a, b])
+        assert np.isclose(float(d[0, 0]), np.sqrt(8))
+        s = V.Stack().apply([a, b])
+        assert s.shape == (2, 2)
+        u = V.Unstack(index=1, num=2).apply([s])
+        assert np.allclose(u, b)
+        sub = V.Subset(low=0, high=0).apply([a])
+        assert np.allclose(sub, [[1.0]])
+        x3 = jnp.arange(6.0).reshape(1, 3, 2)
+        assert np.allclose(V.ReverseTimeSeries().apply([x3])[0, 0], [4, 5])
+        assert V.LastTimeStepVertex().apply([x3]).shape == (1, 2)
+        dup = V.DuplicateToTimeSeries().apply([a, x3])
+        assert dup.shape == (1, 3, 2)
+
+    def test_vertex_serde(self):
+        from deeplearning4j_tpu.nn.vertices import vertex_from_dict
+
+        for v in [V.Merge(), V.ElementWise("max"), V.Scale(3.0), V.Subset(1, 4),
+                  V.Unstack(0, 2), V.ReshapeVertex((2, 3))]:
+            d = json.loads(json.dumps(v.to_dict()))
+            v2 = vertex_from_dict(d)
+            assert type(v2) is type(v)

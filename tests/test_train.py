@@ -1,0 +1,224 @@
+"""Trainer / listeners / early stopping / serialization tests — mirrors
+DL4J's fit-loop, listener and early-stopping suites (SURVEY.md §4)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import ArrayIterator, BenchmarkIterator, DataSet
+from deeplearning4j_tpu.data.datasets import load_iris
+from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.train import (CheckpointListener, CollectScoresListener,
+                                      DataSetLossCalculator,
+                                      EarlyStoppingConfiguration,
+                                      EarlyStoppingTrainer,
+                                      InvalidScoreIterationTermination,
+                                      MaxEpochsTermination, PerformanceListener,
+                                      ScoreImprovementEpochTermination, Trainer,
+                                      load_model)
+
+
+def iris_net(seed=0, lr=5e-2):
+    return (SequentialBuilder(NetConfig(seed=seed, updater={"type": "adam", "learning_rate": lr}))
+            .input_shape(4)
+            .layer(L.Dense(n_out=16, activation="relu"))
+            .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+
+
+@pytest.fixture(scope="module")
+def iris():
+    return load_iris()
+
+
+class TestTrainer:
+    def test_fit_learns_iris(self, iris):
+        x, y = iris
+        tr = Trainer(iris_net())
+        tr.fit(ArrayIterator(x, y, 32, shuffle=True), epochs=30)
+        assert tr.evaluate(ArrayIterator(x, y, 64)).accuracy() > 0.9
+
+    def test_loss_decreases(self, iris):
+        x, y = iris
+        tr = Trainer(iris_net())
+        col = CollectScoresListener()
+        tr.fit(ArrayIterator(x, y, 32), epochs=20, listeners=[col])
+        first = np.mean([s for _, s in col.scores[:5]])
+        last = np.mean([s for _, s in col.scores[-5:]])
+        assert last < first * 0.7
+
+    def test_listeners_fire(self, iris):
+        x, y = iris
+        tr = Trainer(iris_net())
+        events = []
+
+        from deeplearning4j_tpu.train import TrainingListener
+
+        class Probe(TrainingListener):
+            def on_epoch_start(self, t, e):
+                events.append(("start", e))
+
+            def on_epoch_end(self, t, e):
+                events.append(("end", e))
+
+            def iteration_done(self, t, i, e, l):
+                events.append(("iter", i))
+
+        tr.fit(ArrayIterator(x, y, 75), epochs=2, listeners=[Probe()])
+        kinds = [e[0] for e in events]
+        assert kinds.count("start") == 2 and kinds.count("end") == 2
+        assert kinds.count("iter") == 4  # 150/75 = 2 per epoch
+
+    def test_performance_listener(self, iris):
+        x, y = iris
+        tr = Trainer(iris_net())
+        perf = PerformanceListener(frequency=2, log_fn=lambda s: None)
+        tr.fit(ArrayIterator(x, y, 50), epochs=2, listeners=[perf])
+        assert perf.samples_per_sec > 0
+
+    def test_frozen_layer_params_unchanged(self, iris):
+        x, y = iris
+        inner = L.Dense(n_out=16, activation="relu").to_dict()
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "sgd", "learning_rate": 0.5}))
+               .input_shape(4)
+               .layer(L.Frozen(inner=inner))
+               .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+               .build())
+        tr = Trainer(net)
+        before = np.asarray(tr.params["layer_0"]["w"]).copy()
+        out_before = np.asarray(tr.params["layer_1"]["w"]).copy()
+        tr.fit(ArrayIterator(x, y, 32), epochs=3)
+        np.testing.assert_array_equal(before, np.asarray(tr.params["layer_0"]["w"]))
+        assert not np.allclose(out_before, np.asarray(tr.params["layer_1"]["w"]))
+
+    def test_per_layer_updater_override(self, iris):
+        x, y = iris
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "sgd", "learning_rate": 0.1}))
+               .input_shape(4)
+               .layer(L.Dense(n_out=8, activation="relu", updater={"type": "noop"}))
+               .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+               .build())
+        tr = Trainer(net)
+        before = np.asarray(tr.params["layer_0"]["w"]).copy()
+        tr.fit(ArrayIterator(x, y, 32), epochs=2)
+        np.testing.assert_array_equal(before, np.asarray(tr.params["layer_0"]["w"]))
+
+    def test_tbptt_runs(self):
+        T, B = 12, 4
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((B * 4, T, 3)).astype(np.float32)
+        y = np.zeros((B * 4, T, 2), np.float32)
+        y[..., 0] = 1
+        net = (SequentialBuilder(NetConfig(seed=0, tbptt_length=4,
+                                           updater={"type": "adam", "learning_rate": 1e-2}))
+               .input_shape(T, 3)
+               .layer(L.LSTM(n_out=6))
+               .layer(L.RnnOutput(n_out=2, activation="softmax", loss="mcxent"))
+               .build())
+        tr = Trainer(net)
+        col = CollectScoresListener()
+        tr.fit(ArrayIterator(x, y, B), epochs=3, listeners=[col])
+        assert col.scores[-1][1] < col.scores[0][1]
+
+    def test_pretrain_autoencoder(self, iris):
+        x, y = iris
+        net = (SequentialBuilder(NetConfig(seed=0))
+               .input_shape(4)
+               .layer(L.AutoEncoder(n_out=3, corruption_level=0.0))
+               .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+               .build())
+        tr = Trainer(net)
+        it = ArrayIterator((x - x.mean(0)) / x.std(0), y, 32)
+        l0 = tr.pretrain_layer(0, it, epochs=1)
+        l1 = tr.pretrain_layer(0, it, epochs=10)
+        assert l1 < l0
+
+
+class TestSerialization:
+    def test_zip_roundtrip(self, iris, tmp_path):
+        x, y = iris
+        tr = Trainer(iris_net())
+        tr.fit(ArrayIterator(x, y, 32), epochs=5)
+        p = str(tmp_path / "model.zip")
+        tr.save(p)
+        model, params, state, _, _ = load_model(p)
+        np.testing.assert_allclose(np.asarray(model.output(x[:8], params, state)),
+                                   np.asarray(tr.model.output(x[:8], tr.params, tr.state)),
+                                   rtol=1e-6)
+
+    def test_updater_state_resumes(self, iris, tmp_path):
+        """DL4J parity: saving updater state makes resume bit-exact."""
+        x, y = iris
+        it = lambda: ArrayIterator(x, y, 50, shuffle=False)
+        tr = Trainer(iris_net())
+        tr.fit(it(), epochs=3, prefetch=False)
+        p = str(tmp_path / "resume.zip")
+        tr.save(p)
+        tr.fit(it(), epochs=2, prefetch=False)
+
+        tr2 = Trainer.load(p)
+        tr2._rng = jax.random.PRNGKey(0)
+        tr_direct = Trainer(iris_net())
+        tr_direct.params = tr2.params  # same start
+        tr2.fit(it(), epochs=2, prefetch=False)
+        for k in tr.params:
+            for pk in tr.params[k]:
+                np.testing.assert_allclose(np.asarray(tr.params[k][pk]),
+                                           np.asarray(tr2.params[k][pk]), rtol=1e-5,
+                                           err_msg=f"{k}/{pk} diverged after resume")
+
+    def test_checkpoint_listener_retention(self, iris, tmp_path):
+        x, y = iris
+        tr = Trainer(iris_net())
+        ck = CheckpointListener(str(tmp_path), every_n_epochs=1, keep_last=2)
+        tr.fit(ArrayIterator(x, y, 50), epochs=5, listeners=[ck])
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".zip")]
+        assert len(files) == 2
+
+
+class TestEarlyStopping:
+    def test_max_epochs(self, iris):
+        x, y = iris
+        tr = Trainer(iris_net())
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ArrayIterator(x, y, 64)),
+            epoch_terminations=[MaxEpochsTermination(3)])
+        res = EarlyStoppingTrainer(cfg, tr).fit(ArrayIterator(x, y, 32), max_epochs=50)
+        assert res.total_epochs == 3
+        assert res.best_epoch >= 0
+
+    def test_score_improvement_stops(self, iris):
+        x, y = iris
+        # lr=0 -> no improvement -> should stop after patience
+        tr = Trainer(iris_net(lr=0.0))
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ArrayIterator(x, y, 64)),
+            epoch_terminations=[ScoreImprovementEpochTermination(2, 1e-8)])
+        res = EarlyStoppingTrainer(cfg, tr).fit(ArrayIterator(x, y, 64), max_epochs=50)
+        assert res.total_epochs <= 6
+        assert res.termination_reason == "EpochTermination"
+
+    def test_invalid_score_guard(self, iris):
+        x, y = iris
+        xb = x.copy()
+        xb[0, 0] = np.nan
+        tr = Trainer(iris_net())
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ArrayIterator(x, y, 64)),
+            iteration_terminations=[InvalidScoreIterationTermination()])
+        res = EarlyStoppingTrainer(cfg, tr).fit(ArrayIterator(xb, y, 150), max_epochs=5)
+        assert res.termination_reason == "IterationTermination"
+
+    def test_best_model_restored(self, iris):
+        x, y = iris
+        tr = Trainer(iris_net())
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ArrayIterator(x, y, 64)),
+            epoch_terminations=[MaxEpochsTermination(5)])
+        res = EarlyStoppingTrainer(cfg, tr).fit(ArrayIterator(x, y, 32), max_epochs=10)
+        best = cfg.model_saver.get_best()
+        assert best is not None and np.isfinite(best[2])
