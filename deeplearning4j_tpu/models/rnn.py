@@ -1,0 +1,47 @@
+"""Sequence zoo models: TextGenerationLSTM (zoo parity) + GravesLSTM char-RNN
+(the reference baseline config, BASELINE.md #3)."""
+
+from __future__ import annotations
+
+from ..nn import layers as L
+from ..nn.model import NetConfig, Sequential, SequentialBuilder
+from .zoo import ZooModel, register_model
+
+
+@register_model
+class TextGenerationLSTM(ZooModel):
+    """zoo/model/TextGenerationLSTM.java — 2x LSTM(256) char model."""
+
+    input_shape = (64, 77)  # (T, vocab) one-hot input like the reference default
+    num_classes = 77
+
+    def build(self) -> Sequential:
+        T, V = self.input_shape
+        return (SequentialBuilder(NetConfig(seed=self.seed, tbptt_length=self.kwargs.get("tbptt", 0),
+                                            updater={"type": "adam", "learning_rate": 1e-3}))
+                .input_shape(T, V)
+                .layer(L.LSTM(n_out=256))
+                .layer(L.LSTM(n_out=256))
+                .layer(L.RnnOutput(n_out=self.num_classes, activation="softmax", loss="mcxent"))
+                .build())
+
+
+@register_model
+class GravesLSTMCharRNN(ZooModel):
+    """BASELINE.md config #3: GravesLSTM char-RNN (dl4j-examples
+    GravesLSTMCharModellingExample) — peephole LSTM path, the reference's
+    CudnnLSTMHelper benchmark surface."""
+
+    input_shape = (64, 98)
+    num_classes = 98
+    hidden = 200
+
+    def build(self) -> Sequential:
+        T, V = self.input_shape
+        return (SequentialBuilder(NetConfig(seed=self.seed, tbptt_length=self.kwargs.get("tbptt", 50),
+                                            updater={"type": "rmsprop", "learning_rate": 1e-1}))
+                .input_shape(T, V)
+                .layer(L.GravesLSTM(n_out=self.hidden))
+                .layer(L.GravesLSTM(n_out=self.hidden))
+                .layer(L.RnnOutput(n_out=self.num_classes, activation="softmax", loss="mcxent"))
+                .build())

@@ -1,0 +1,155 @@
+"""Transformer model family — the modern sequence stack.
+
+BERT-base is the driver's stretch import target (BASELINE.md #5); long-context
+causal LMs are where the framework's sequence parallelism earns its keep.
+These models are plain Sequential stacks of TransformerEncoderBlock, so they
+serialize/train/evaluate through the same machinery as every zoo CNN — plus
+``sharded_lm`` builds the fully-sharded (dp x tp x sp) training step used by
+``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn import layers as L
+from ..nn.model import NetConfig, Sequential, SequentialBuilder
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..parallel.ring_attention import ring_attention_local
+from ..parallel.sharding import TRANSFORMER_RULES, sharding_tree
+from .zoo import ZooModel, register_model
+
+
+@register_model
+class BertBase(ZooModel):
+    """BERT-base-uncased shape: 12 layers, d=768, h=12, vocab 30522.
+
+    Built from the generic layer catalog; the Keras/HF import path
+    (keras_import/) targets this architecture.
+    """
+
+    num_layers = 12
+    d_model = 768
+    num_heads = 12
+    vocab = 30522
+    max_len = 512
+    input_shape = (128,)  # (T,) int token ids
+    num_classes = 2  # default classification head
+
+    def __init__(self, num_classes=None, seed=12345, input_shape=None, *, small=False, **kw):
+        super().__init__(num_classes, seed, input_shape, **kw)
+        if small:  # test-sized variant
+            self.num_layers, self.d_model, self.num_heads, self.vocab, self.max_len = 2, 64, 4, 1000, 128
+
+    def build(self) -> Sequential:
+        T = self.input_shape[0]
+        b = (SequentialBuilder(NetConfig(seed=self.seed,
+                                         updater={"type": "adamw", "learning_rate": 1e-4}))
+             .input_shape(T)
+             .layer(L.EmbeddingSequence(n_in=self.vocab, n_out=self.d_model))
+             .layer(L.PositionalEmbedding(max_len=self.max_len)))
+        for _ in range(self.num_layers):
+            b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=False))
+        return (b.layer(L.LayerNorm())
+                .layer(L.GlobalPooling(mode="avg"))
+                .layer(L.Output(n_out=self.num_classes, activation="softmax", loss="mcxent"))
+                .build())
+
+
+@register_model
+class CausalLM(ZooModel):
+    """GPT-style causal LM — the long-context flagship."""
+
+    num_layers = 4
+    d_model = 256
+    num_heads = 8
+    vocab = 512
+    input_shape = (256,)
+
+    def __init__(self, num_classes=None, seed=12345, input_shape=None, *,
+                 num_layers=None, d_model=None, num_heads=None, vocab=None, **kw):
+        super().__init__(num_classes, seed, input_shape, **kw)
+        self.num_layers = num_layers or self.num_layers
+        self.d_model = d_model or self.d_model
+        self.num_heads = num_heads or self.num_heads
+        self.vocab = vocab or self.vocab
+        self.num_classes = self.vocab
+
+    def build(self) -> Sequential:
+        T = self.input_shape[0]
+        b = (SequentialBuilder(NetConfig(seed=self.seed,
+                                         updater={"type": "adamw", "learning_rate": 3e-4}))
+             .input_shape(T)
+             .layer(L.EmbeddingSequence(n_in=self.vocab, n_out=self.d_model))
+             .layer(L.PositionalEmbedding(max_len=max(T, 512))))
+        for _ in range(self.num_layers):
+            b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=True))
+        b.layer(L.LayerNorm())
+        b.layer(L.RnnOutput(n_out=self.vocab, activation="softmax", loss="mcxent"))
+        return b.build()
+
+
+# ---------------------------------------------------------------------------
+# Fully-sharded training step: dp x tp x sp over one mesh.
+# ---------------------------------------------------------------------------
+
+def _shard_specs_params(params, mesh):
+    return sharding_tree(params, mesh, TRANSFORMER_RULES)
+
+
+def sharded_lm_step(model: Sequential, mesh: Mesh, tx: optax.GradientTransformation):
+    """Build a jit-compiled train step with:
+
+    - params sharded per TRANSFORMER_RULES over the ``model`` axis (TP),
+    - batch sharded over ``data`` (DP),
+    - activations sequence-sharded over ``seq`` (SP) via sharding constraints —
+      GSPMD decomposes the attention einsums into collective-permuted blocks.
+
+    Returns (step_fn, placed_params, opt_state, placement helpers).
+    """
+    assert model.params is not None, "init() the model first"
+    p_spec = _shard_specs_params(model.params, mesh)
+    repl = NamedSharding(mesh, P())
+    params = jax.tree.map(lambda a, s: jax.device_put(a, s), model.params, p_spec)
+    opt_state = jax.tree.map(lambda a: jax.device_put(a, repl), tx.init(params))
+    batch_sh = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+
+    def constrain(x):
+        if x.ndim == 3:
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None)))
+        return x
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, targets, rng):
+        def loss_fn(p):
+            # token/positional embed + blocks with activation constraints
+            x = tokens
+            state: dict = {}
+            for i, layer in enumerate(model.layers[:-1]):
+                key = f"layer_{i}"
+                x, _, _ = layer.apply(p.get(key, {}), state.get(key, {}), x,
+                                      training=True, rng=None)
+                if hasattr(x, "ndim") and x.ndim == 3:
+                    x = constrain(x)
+            out_layer = model.layers[-1]
+            key = f"layer_{len(model.layers) - 1}"
+            return out_layer.score(p.get(key, {}), {}, x, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def place_batch(tokens, targets):
+        return (jax.device_put(tokens, batch_sh),
+                jax.device_put(targets, NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None))))
+
+    return step, params, opt_state, place_batch

@@ -374,6 +374,8 @@ class Graph:
                 ) -> Tuple[List[Array], State]:
         if not isinstance(inputs, dict):
             inputs = {self.inputs[0]: inputs}
+        if masks is not None and not isinstance(masks, dict):
+            masks = {self.inputs[0]: masks}
         acts: Dict[str, Array] = dict(inputs)
         act_masks: Dict[str, Optional[Array]] = {k: (masks or {}).get(k) for k in inputs}
         new_state = dict(state)
@@ -401,6 +403,8 @@ class Graph:
         """Sum of losses over all output layers (ComputationGraph multi-output)."""
         if not isinstance(inputs, dict):
             inputs = {self.inputs[0]: inputs}
+        if masks is not None and not isinstance(masks, dict):
+            masks = {self.inputs[0]: masks}
         if not isinstance(labels, (list, tuple)):
             labels = [labels]
         acts: Dict[str, Array] = dict(inputs)

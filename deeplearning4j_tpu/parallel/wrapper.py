@@ -78,12 +78,16 @@ class ParallelWrapper:
         self.opt_state = jax.device_put(tx.init(self.params), repl)
         self._batch_sharding = batch_sh
 
+        seq = isinstance(model, Sequential)
+
         @partial(jax.jit, donate_argnums=(0, 1, 2),
                  out_shardings=(repl, repl, repl, repl))
         def step(params, opt_state, net_state, x, y, rng, mask=None):
+            mask_kw = {"mask": mask} if seq else {"masks": mask}
+
             def loss_fn(p):
                 loss, new_state = model.score(p, net_state, x, y, training=True,
-                                              rng=rng, mask=mask)
+                                              rng=rng, **mask_kw)
                 return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)

@@ -104,13 +104,18 @@ class Trainer:
     def _make_step(self):
         tx, model = self.tx, self.model
 
+        seq = isinstance(model, Sequential)
+
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def step(params, opt_state, net_state, x, y, rng, mask=None, label_mask=None):
+            if seq:
+                mask_kw = {"mask": mask, "label_mask": label_mask}
+            else:  # Graph: per-input mask dict / per-output label masks
+                mask_kw = {"masks": mask, "label_masks": label_mask}
+
             def loss_fn(p):
                 loss, new_state = model.score(p, net_state, x, y, training=True,
-                                              rng=rng, mask=mask,
-                                              **({"label_mask": label_mask}
-                                                 if isinstance(model, Sequential) else {}))
+                                              rng=rng, **mask_kw)
                 return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -271,9 +276,12 @@ class Trainer:
         """Average loss over an iterator (model.score(DataSetIterator) parity)."""
         model = self.model
 
+        seq = isinstance(model, Sequential)
+
         @jax.jit
         def score(params, state, x, y, mask=None):
-            l, _ = model.score(params, state, x, y, training=False, mask=mask)
+            l, _ = model.score(params, state, x, y, training=False,
+                               **({"mask": mask} if seq else {"masks": mask}))
             return l
 
         total, n = 0.0, 0

@@ -66,9 +66,14 @@ def check_gradients(loss_fn: Callable, params, *, eps: float = 1e-4,
 
 def check_model_gradients(model, params, state, x, y, *, mask=None, **kw) -> bool:
     """Gradient-check a Sequential/Graph score function at (x, y)."""
+    from ..nn.model import Sequential
+
+    mask_kw = {}
+    if mask is not None:
+        mask_kw = {"mask": mask} if isinstance(model, Sequential) else {"masks": mask}
 
     def loss(p):
-        l, _ = model.score(p, state, x, y, training=False, mask=mask)
+        l, _ = model.score(p, state, x, y, training=False, **mask_kw)
         return l
 
     return check_gradients(loss, params, **kw)
