@@ -5,7 +5,11 @@ from . import layers, vertices
 from .api import Layer, layer_from_dict, register_layer
 from .model import (Graph, GraphBuilder, GraphNode, NetConfig, Sequential,
                     SequentialBuilder)
+from .transfer import (FineTuneConfiguration, TransferGraphBuilder,
+                       TransferLearningBuilder, TransferLearningHelper)
 
-__all__ = ["Graph", "GraphBuilder", "GraphNode", "Layer", "NetConfig",
-           "Sequential", "SequentialBuilder", "layer_from_dict", "layers",
+__all__ = ["FineTuneConfiguration", "Graph", "GraphBuilder", "GraphNode",
+           "Layer", "NetConfig", "Sequential", "SequentialBuilder",
+           "TransferGraphBuilder", "TransferLearningBuilder",
+           "TransferLearningHelper", "layer_from_dict", "layers",
            "register_layer", "vertices"]

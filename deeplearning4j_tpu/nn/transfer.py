@@ -1,0 +1,323 @@
+"""Transfer learning: graph/stack surgery on trained networks.
+
+Reference parity: ``nn/transferlearning/TransferLearning.java:32`` (Builder:
+``setFeatureExtractor`` :84, ``nOutReplace`` :98, ``removeOutputLayer`` /
+``removeLayersFromOutput`` :191-207, ``addLayer``), the Graph builder variant
+(:499-518, ``removeVertexAndConnections``), ``FineTuneConfiguration.java`` and
+``TransferLearningHelper.java`` (featurize + fit of the unfrozen sub-net).
+
+TPU redesign: DL4J mutates a copied network and its flattened param vector in
+place. Here surgery is *config surgery* — we produce a brand-new Sequential /
+Graph config plus a params pytree that carries over the surviving trained
+entries; frozen layers become ``Frozen`` wrapper configs whose params are
+``stop_gradient``-ed and excluded from the optimizer label tree, so the whole
+fine-tune step still jit-compiles into a single fused XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .api import Layer, layer_from_dict
+from .layers.special import Frozen
+from .model import Graph, GraphNode, NetConfig, Sequential, _layer_key
+
+
+@dataclass
+class FineTuneConfiguration:
+    """FineTuneConfiguration.java — global-config overrides applied on build.
+
+    Any field left ``None`` inherits from the source network's NetConfig.
+    """
+
+    updater: Optional[Any] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    seed: Optional[int] = None
+    dtype: Optional[str] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+    tbptt_length: Optional[int] = None
+    compute_dtype: Optional[str] = None
+
+    def apply_to(self, cfg: NetConfig) -> NetConfig:
+        d = cfg.to_dict()
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is not None:
+                d[f.name] = v
+        return NetConfig.from_dict(d)
+
+
+def _freeze(layer: Layer) -> Layer:
+    if isinstance(layer, Frozen):
+        return layer
+    return Frozen(name=layer.name, inner=layer.to_dict())
+
+
+def _replace_n_out(layer: Layer, n_out: int, weight_init: Optional[str]) -> Layer:
+    d = layer.to_dict()
+    if "n_out" not in {f.name for f in dataclasses.fields(layer)}:
+        raise ValueError(f"nOutReplace target {type(layer).__name__} has no n_out")
+    d["n_out"] = n_out
+    if weight_init is not None:
+        d["weight_init"] = weight_init
+    return layer_from_dict(d)
+
+
+class TransferLearningBuilder:
+    """TransferLearning.Builder equivalent for Sequential networks.
+
+    Usage::
+
+        new_net, params, state = (TransferLearningBuilder(net, params, state)
+            .fine_tune_configuration(FineTuneConfiguration(updater={"type": "adam", "learning_rate": 1e-4}))
+            .set_feature_extractor(3)          # freeze layers 0..3 inclusive
+            .n_out_replace(5, 10, "xavier")    # new head width
+            .build())
+    """
+
+    def __init__(self, model: Sequential, params: Optional[dict] = None,
+                 state: Optional[dict] = None):
+        self.model = model
+        src_params = params if params is not None else model.params
+        src_state = state if state is not None else model.state
+        if src_params is None:
+            raise ValueError("source network has no params — call init()/load first")
+        # working list: (layer, carried_params|None, carried_state|None)
+        self._entries: List[Tuple[Layer, Optional[dict], Optional[dict]]] = []
+        for i, layer in enumerate(model.layers):
+            k = _layer_key(i, layer)
+            self._entries.append((layer, src_params.get(k), (src_state or {}).get(k)))
+        self._ftc: Optional[FineTuneConfiguration] = None
+        self._input_shape = model.input_shape
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration) -> "TransferLearningBuilder":
+        self._ftc = ftc
+        return self
+
+    def set_feature_extractor(self, layer_index: int) -> "TransferLearningBuilder":
+        """Freeze layers [0, layer_index] (TransferLearning.java:84)."""
+        for i in range(layer_index + 1):
+            layer, p, s = self._entries[i]
+            self._entries[i] = (_freeze(layer), p, s)
+        return self
+
+    def n_out_replace(self, layer_index: int, n_out: int,
+                      weight_init: Optional[str] = None,
+                      weight_init_next: Optional[str] = None) -> "TransferLearningBuilder":
+        """Replace nOut of a layer; its params AND the next parametric layer's
+        params are re-initialized (shapes change — TransferLearning.java:374)."""
+        layer, _, _ = self._entries[layer_index]
+        self._entries[layer_index] = (_replace_n_out(layer, n_out, weight_init), None, None)
+        for j in range(layer_index + 1, len(self._entries)):
+            nxt, _, _ = self._entries[j]
+            inner = nxt._sub() if isinstance(nxt, Frozen) else nxt
+            if inner.has_params():
+                d = inner.to_dict()
+                if weight_init_next is not None:
+                    d["weight_init"] = weight_init_next
+                self._entries[j] = (layer_from_dict(d), None, None)
+                break
+        return self
+
+    def remove_output_layer(self) -> "TransferLearningBuilder":
+        self._entries.pop()
+        return self
+
+    def remove_layers_from_output(self, n: int) -> "TransferLearningBuilder":
+        """Remove the last n layers (TransferLearning.java:207)."""
+        del self._entries[len(self._entries) - n:]
+        return self
+
+    def add_layer(self, layer: Layer) -> "TransferLearningBuilder":
+        self._entries.append((layer, None, None))
+        return self
+
+    def build(self) -> Tuple[Sequential, dict, dict]:
+        cfg = self.model.config
+        if self._ftc is not None:
+            cfg = self._ftc.apply_to(cfg)
+        layers = [e[0] for e in self._entries]
+        net = Sequential(cfg, layers, self._input_shape)
+        params, state = net.init(cfg.seed)
+        for i, (layer, p, s) in enumerate(self._entries):
+            k = _layer_key(i, layer)
+            if p is not None:
+                fresh = params.get(k)
+                if fresh is not None and jax.tree_util.tree_structure(fresh) == jax.tree_util.tree_structure(p) \
+                        and all(a.shape == b.shape for a, b in
+                                zip(jax.tree_util.tree_leaves(fresh), jax.tree_util.tree_leaves(p))):
+                    params[k] = p
+            if s is not None and k in state:
+                state[k] = s
+        net.params, net.state = params, state
+        return net, params, state
+
+
+class TransferGraphBuilder:
+    """TransferLearning.GraphBuilder equivalent for Graph (DAG) networks."""
+
+    def __init__(self, model: Graph, params: Optional[dict] = None,
+                 state: Optional[dict] = None):
+        self.model = model
+        self._params = dict(params if params is not None else (model.params or {}))
+        self._state = dict(state if state is not None else (model.state or {}))
+        if not self._params:
+            raise ValueError("source network has no params — call init()/load first")
+        self._nodes: Dict[str, GraphNode] = dict(model.nodes)
+        self._inputs = list(model.inputs)
+        self._input_shapes = dict(model.input_shapes)
+        self._outputs = list(model.outputs)
+        self._ftc: Optional[FineTuneConfiguration] = None
+        self._reinit: set = set()  # node names whose params must NOT carry over
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration) -> "TransferGraphBuilder":
+        self._ftc = ftc
+        return self
+
+    def set_feature_extractor(self, *names: str) -> "TransferGraphBuilder":
+        """Freeze the named vertices and every ancestor of them
+        (TransferLearning.java:499 — 'specified layer and the layers preceding')."""
+        to_freeze = set()
+        stack = list(names)
+        while stack:
+            n = stack.pop()
+            if n in to_freeze or n not in self._nodes:
+                continue
+            to_freeze.add(n)
+            stack.extend(self._nodes[n].inputs)
+        for n in to_freeze:
+            node = self._nodes[n]
+            if node.is_layer() and node.spec.has_params():
+                self._nodes[n] = GraphNode(_freeze(node.spec), node.inputs)
+        return self
+
+    def n_out_replace(self, name: str, n_out: int, weight_init: Optional[str] = None,
+                      weight_init_next: Optional[str] = None) -> "TransferGraphBuilder":
+        node = self._nodes[name]
+        self._nodes[name] = GraphNode(_replace_n_out(node.spec, n_out, weight_init), node.inputs)
+        self._reinit.add(name)
+        # consumers' input shapes change -> re-init their params too
+        for cname, cnode in self._nodes.items():
+            if name in cnode.inputs and cnode.is_layer() and cnode.spec.has_params():
+                if weight_init_next is not None:
+                    inner = cnode.spec._sub() if isinstance(cnode.spec, Frozen) else cnode.spec
+                    d = inner.to_dict()
+                    d["weight_init"] = weight_init_next
+                    self._nodes[cname] = GraphNode(layer_from_dict(d), cnode.inputs)
+                self._reinit.add(cname)
+        return self
+
+    def remove_vertex(self, name: str, remove_connections: bool = False) -> "TransferGraphBuilder":
+        """removeVertexAndConnections: drop a node (and optionally everything
+        that consumed it, transitively)."""
+        removed = {name}
+        self._nodes.pop(name, None)
+        if remove_connections:
+            changed = True
+            while changed:
+                changed = False
+                for n, node in list(self._nodes.items()):
+                    if any(i in removed for i in node.inputs):
+                        removed.add(n)
+                        del self._nodes[n]
+                        changed = True
+        self._outputs = [o for o in self._outputs if o not in removed]
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str) -> "TransferGraphBuilder":
+        self._nodes[name] = GraphNode(layer, tuple(inputs))
+        self._reinit.add(name)
+        return self
+
+    def add_vertex(self, name: str, vertex, *inputs: str) -> "TransferGraphBuilder":
+        self._nodes[name] = GraphNode(vertex, tuple(inputs))
+        return self
+
+    def set_outputs(self, *names: str) -> "TransferGraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def build(self) -> Tuple[Graph, dict, dict]:
+        cfg = self.model.config
+        if self._ftc is not None:
+            cfg = self._ftc.apply_to(cfg)
+        net = Graph(cfg, self._inputs, self._input_shapes, self._nodes, self._outputs)
+        params, state = net.init(cfg.seed)
+        for name in net.topo_order:
+            if name in self._reinit:
+                continue
+            old_p = self._params.get(name)
+            if old_p is not None and name in params:
+                fresh = params[name]
+                if jax.tree_util.tree_structure(fresh) == jax.tree_util.tree_structure(old_p) \
+                        and all(a.shape == b.shape for a, b in
+                                zip(jax.tree_util.tree_leaves(fresh), jax.tree_util.tree_leaves(old_p))):
+                    params[name] = old_p
+            if name in self._state and name in state:
+                state[name] = self._state[name]
+        net.params, net.state = params, state
+        return net, params, state
+
+
+class TransferLearningHelper:
+    """TransferLearningHelper.java — featurize inputs through the frozen prefix
+    ONCE, then train only the unfrozen suffix (saves recomputing the frozen
+    forward every epoch)."""
+
+    def __init__(self, model: Sequential, params: Optional[dict] = None,
+                 state: Optional[dict] = None):
+        assert isinstance(model, Sequential), "helper supports Sequential nets"
+        self.model = model
+        self.params = params if params is not None else model.params
+        self.state = state if state is not None else model.state
+        # frozen prefix = longest prefix of Frozen layers
+        self.split = 0
+        for layer in model.layers:
+            if isinstance(layer, Frozen):
+                self.split += 1
+            else:
+                break
+        if self.split == 0:
+            raise ValueError("no frozen prefix — call set_feature_extractor first")
+        self._featurize_fn = jax.jit(
+            lambda p, s, x: model.forward(p, s, x, training=False, up_to=self.split)[0])
+        # build unfrozen sub-network sharing the suffix layer configs
+        suffix = model.layers[self.split:]
+        feat_shape = model.layer_input_shape(self.split)
+        self.unfrozen = Sequential(model.config, suffix, feat_shape)
+        up, us = {}, {}
+        for j, layer in enumerate(suffix):
+            old_k = _layer_key(self.split + j, model.layers[self.split + j])
+            new_k = _layer_key(j, layer)
+            if old_k in self.params:
+                up[new_k] = self.params[old_k]
+            if old_k in (self.state or {}):
+                us[new_k] = self.state[old_k]
+        self.unfrozen.params, self.unfrozen.state = up, us
+
+    def featurize(self, x):
+        """Forward through the frozen prefix (featurize(DataSet) parity)."""
+        return self._featurize_fn(self.params, self.state, x)
+
+    def unfrozen_network(self) -> Sequential:
+        return self.unfrozen
+
+    def merge_back(self) -> dict:
+        """Write trained suffix params back into the full network's pytree
+        (unfrozenMLN -> original network sync)."""
+        params = dict(self.params)
+        for j, layer in enumerate(self.unfrozen.layers):
+            old_k = _layer_key(self.split + j, self.model.layers[self.split + j])
+            new_k = _layer_key(j, layer)
+            if new_k in self.unfrozen.params:
+                params[old_k] = self.unfrozen.params[new_k]
+        self.params = params
+        self.model.params = params
+        return params

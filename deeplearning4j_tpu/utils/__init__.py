@@ -1,0 +1,9 @@
+"""Utilities: gradient checking (the universal layer oracle, SURVEY.md §4)
+and memory reports (nn/conf/memory parity)."""
+
+from .gradient_check import check_model_gradients
+from .memory import (LayerMemoryReport, NetworkMemoryReport,
+                     compiled_memory_report, memory_report)
+
+__all__ = ["LayerMemoryReport", "NetworkMemoryReport", "check_model_gradients",
+           "compiled_memory_report", "memory_report"]

@@ -1,0 +1,134 @@
+"""Memory reports — analytical per-layer memory estimation before running.
+
+Reference parity: ``nn/conf/memory/LayerMemoryReport.java`` /
+``NetworkMemoryReport.java`` / ``MemoryReport.java`` (SURVEY.md §2.1): DL4J
+estimates params + activations + workspace bytes per layer analytically.
+
+TPU redesign: the analytical path is the same arithmetic over our shape
+inference; on top of it, ``compiled_memory_report`` asks XLA itself
+(``jax.stages.Compiled.memory_analysis()``) for the *true* compiled footprint
+— temp buffers, fused intermediates, and rematerialisation included, which
+the reference could never see through its per-op dispatch.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.model import Graph, Sequential, _layer_key
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8}
+
+
+@dataclass
+class LayerMemoryReport:
+    """LayerMemoryReport.java equivalent — one layer's analytic footprint."""
+
+    name: str
+    layer_type: str
+    input_shape: tuple
+    output_shape: tuple
+    param_count: int
+    param_bytes: int
+    activation_bytes_per_example: int
+
+    def total_bytes(self, batch_size: int, training: bool = True) -> int:
+        act = self.activation_bytes_per_example * batch_size
+        # training keeps params + grads + activations for backward
+        mult = 2 if training else 1
+        return self.param_bytes * mult + act * mult
+
+
+@dataclass
+class NetworkMemoryReport:
+    """NetworkMemoryReport.java equivalent."""
+
+    layers: List[LayerMemoryReport]
+    model_name: str = "network"
+    dtype: str = "float32"
+
+    @property
+    def total_param_count(self) -> int:
+        return sum(l.param_count for l in self.layers)
+
+    @property
+    def total_param_bytes(self) -> int:
+        return sum(l.param_bytes for l in self.layers)
+
+    def total_bytes(self, batch_size: int, training: bool = True,
+                    optimizer_state_multiplier: int = 2) -> int:
+        """Estimated bytes for one step. ``optimizer_state_multiplier``: Adam
+        keeps 2 extra param-sized buffers, SGD+momentum 1, plain SGD 0."""
+        layer_total = sum(l.total_bytes(batch_size, training) for l in self.layers)
+        opt = self.total_param_bytes * optimizer_state_multiplier if training else 0
+        return layer_total + opt
+
+    def to_string(self, batch_size: int = 32) -> str:
+        lines = [f"Memory report: {self.model_name} (dtype={self.dtype}, batch={batch_size})",
+                 f"{'layer':<24}{'type':<24}{'params':>12}{'param MB':>10}{'act KB/ex':>11}"]
+        for l in self.layers:
+            lines.append(f"{l.name:<24}{l.layer_type:<24}{l.param_count:>12}"
+                         f"{l.param_bytes / 1e6:>10.2f}{l.activation_bytes_per_example / 1e3:>11.1f}")
+        lines.append(f"Total params: {self.total_param_count} "
+                     f"({self.total_param_bytes / 1e6:.1f} MB); "
+                     f"est. training step: {self.total_bytes(batch_size) / 1e6:.1f} MB")
+        return "\n".join(lines)
+
+
+def memory_report(model) -> NetworkMemoryReport:
+    """Analytic report from config shape inference (getMemoryReport parity)."""
+    bpe = _DTYPE_BYTES.get(model.config.dtype, 4)
+    reports = []
+    if isinstance(model, Sequential):
+        for i, layer in enumerate(model.layers):
+            in_s = model.layer_input_shape(i)
+            out_s = layer.output_shape(in_s)
+            n = layer.param_count(in_s) if layer.has_params() else 0
+            reports.append(LayerMemoryReport(
+                name=_layer_key(i, layer), layer_type=type(layer).__name__,
+                input_shape=tuple(in_s), output_shape=tuple(out_s),
+                param_count=n, param_bytes=n * bpe,
+                activation_bytes_per_example=int(np.prod(out_s)) * bpe))
+    elif isinstance(model, Graph):
+        for name in model.topo_order:
+            node = model.nodes[name]
+            out_s = model._shapes[name]
+            if node.is_layer():
+                in_s = model._shapes[node.inputs[0]]
+                n = node.spec.param_count(in_s) if node.spec.has_params() else 0
+            else:
+                in_s = model._shapes[node.inputs[0]]
+                n = 0
+            reports.append(LayerMemoryReport(
+                name=name, layer_type=type(node.spec).__name__,
+                input_shape=tuple(in_s), output_shape=tuple(out_s),
+                param_count=n, param_bytes=n * bpe,
+                activation_bytes_per_example=int(np.prod(out_s)) * bpe))
+    else:
+        raise TypeError(f"unsupported model type {type(model)}")
+    return NetworkMemoryReport(reports, model_name=type(model).__name__,
+                               dtype=model.config.dtype)
+
+
+def compiled_memory_report(fn, *example_args) -> Dict[str, Any]:
+    """True XLA-compiled footprint of a jitted function — what the reference's
+    analytic estimate approximates. Returns bytes by category."""
+    lowered = jax.jit(fn).lower(*example_args)
+    compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return {"available": False}
+    return {
+        "available": True,
+        "generated_code_bytes": getattr(ma, "generated_code_size_in_bytes", 0),
+        "argument_bytes": getattr(ma, "argument_size_in_bytes", 0),
+        "output_bytes": getattr(ma, "output_size_in_bytes", 0),
+        "temp_bytes": getattr(ma, "temp_size_in_bytes", 0),
+        "alias_bytes": getattr(ma, "alias_size_in_bytes", 0),
+    }

@@ -1,0 +1,201 @@
+"""Transfer learning + memory report tests — mirrors the reference's
+TransferLearning test suites (freeze, nOutReplace, add/remove layers,
+helper featurize) and MemoryReport tests (SURVEY.md §2.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.nn import (GraphBuilder, NetConfig, SequentialBuilder)
+from deeplearning4j_tpu.nn.layers.special import Frozen
+from deeplearning4j_tpu.nn.transfer import (FineTuneConfiguration,
+                                            TransferGraphBuilder,
+                                            TransferLearningBuilder,
+                                            TransferLearningHelper)
+from deeplearning4j_tpu.train import Trainer, build_updater
+from deeplearning4j_tpu.utils.memory import (compiled_memory_report,
+                                             memory_report)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def make_net(seed=0):
+    net = (SequentialBuilder(NetConfig(seed=seed, updater={"type": "sgd", "learning_rate": 0.1}))
+           .input_shape(6)
+           .layer(L.Dense(n_out=10, activation="tanh"))
+           .layer(L.Dense(n_out=8, activation="relu"))
+           .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+           .build())
+    net.init()
+    return net
+
+
+class TestTransferSequential:
+    def test_freeze_keeps_params_fixed(self):
+        net = make_net()
+        new_net, params, state = (TransferLearningBuilder(net)
+                                  .set_feature_extractor(1)
+                                  .build())
+        assert isinstance(new_net.layers[0], Frozen)
+        assert isinstance(new_net.layers[1], Frozen)
+        assert not isinstance(new_net.layers[2], Frozen)
+        # carried params equal source
+        np.testing.assert_array_equal(np.asarray(params["layer_0"]["w"]),
+                                      np.asarray(net.params["layer_0"]["w"]))
+        # train a few steps; frozen params must not move
+        t = Trainer(new_net)
+        x = jax.random.normal(KEY, (16, 6))
+        y = jax.nn.one_hot(jnp.arange(16) % 3, 3)
+        before = np.asarray(t.params["layer_0"]["w"]).copy()
+        head_before = np.asarray(t.params["layer_2"]["w"]).copy()
+        step = t._make_step()
+        p, o, s, _ = step(t.params, t.opt_state, t.state, x, y, KEY)
+        np.testing.assert_array_equal(np.asarray(p["layer_0"]["w"]), before)
+        assert not np.allclose(np.asarray(p["layer_2"]["w"]), head_before)
+
+    def test_n_out_replace(self):
+        net = make_net()
+        new_net, params, _ = (TransferLearningBuilder(net)
+                              .n_out_replace(2, 5, "xavier")
+                              .build())
+        assert params["layer_2"]["w"].shape == (8, 5)
+        assert new_net.output_shape[-1] == 5
+        # earlier layers carried over
+        np.testing.assert_array_equal(np.asarray(params["layer_0"]["w"]),
+                                      np.asarray(net.params["layer_0"]["w"]))
+
+    def test_n_out_replace_reinits_next_layer(self):
+        net = make_net()
+        new_net, params, _ = (TransferLearningBuilder(net)
+                              .n_out_replace(0, 12, "xavier", "xavier")
+                              .build())
+        assert params["layer_0"]["w"].shape == (6, 12)
+        assert params["layer_1"]["w"].shape == (12, 8)
+
+    def test_remove_and_add_layers(self):
+        net = make_net()
+        new_net, params, _ = (TransferLearningBuilder(net)
+                              .remove_output_layer()
+                              .add_layer(L.Dense(n_out=4, activation="relu"))
+                              .add_layer(L.Output(n_out=2, activation="softmax", loss="mcxent"))
+                              .build())
+        assert len(new_net.layers) == 4
+        assert new_net.output_shape[-1] == 2
+        y = new_net.output(jnp.zeros((2, 6)))
+        assert y.shape == (2, 2)
+
+    def test_fine_tune_configuration_override(self):
+        net = make_net()
+        ftc = FineTuneConfiguration(updater={"type": "adam", "learning_rate": 1e-3}, l2=1e-4)
+        new_net, _, _ = (TransferLearningBuilder(net)
+                         .fine_tune_configuration(ftc)
+                         .build())
+        assert new_net.config.updater["type"] == "adam"
+        assert new_net.config.l2 == 1e-4
+
+    def test_helper_featurize_matches_full_forward(self):
+        net = make_net()
+        new_net, params, state = (TransferLearningBuilder(net)
+                                  .set_feature_extractor(0)
+                                  .build())
+        helper = TransferLearningHelper(new_net, params, state)
+        x = jax.random.normal(KEY, (4, 6))
+        feats = helper.featurize(x)
+        assert feats.shape == (4, 10)
+        sub = helper.unfrozen_network()
+        y_sub = sub.output(feats)
+        y_full = new_net.output(x, params, state)
+        np.testing.assert_allclose(np.asarray(y_sub), np.asarray(y_full), rtol=1e-6)
+
+    def test_helper_merge_back(self):
+        net = make_net()
+        new_net, params, state = (TransferLearningBuilder(net)
+                                  .set_feature_extractor(0)
+                                  .build())
+        helper = TransferLearningHelper(new_net, params, state)
+        sub = helper.unfrozen_network()
+        # perturb suffix params and merge back
+        sub.params = jax.tree.map(lambda a: a + 1.0, sub.params)
+        merged = helper.merge_back()
+        np.testing.assert_allclose(
+            np.asarray(merged["layer_1"]["w"]),
+            np.asarray(params["layer_1"]["w"]) + 1.0)
+
+
+class TestTransferGraph:
+    def make_graph(self):
+        g = (GraphBuilder(NetConfig(seed=3))
+             .add_input("in", (5,))
+             .add_layer("d1", L.Dense(n_out=7, activation="tanh"), "in")
+             .add_layer("d2", L.Dense(n_out=6, activation="relu"), "d1")
+             .add_layer("out", L.Output(n_out=3, activation="softmax", loss="mcxent"), "d2")
+             .set_outputs("out")
+             .build())
+        g.init()
+        return g
+
+    def test_freeze_ancestors(self):
+        g = self.make_graph()
+        new_g, params, _ = (TransferGraphBuilder(g)
+                            .set_feature_extractor("d2")
+                            .build())
+        assert isinstance(new_g.nodes["d1"].spec, Frozen)
+        assert isinstance(new_g.nodes["d2"].spec, Frozen)
+        assert not isinstance(new_g.nodes["out"].spec, Frozen)
+        np.testing.assert_array_equal(np.asarray(params["d1"]["w"]),
+                                      np.asarray(g.params["d1"]["w"]))
+
+    def test_n_out_replace_graph(self):
+        g = self.make_graph()
+        new_g, params, _ = (TransferGraphBuilder(g)
+                            .n_out_replace("d2", 9, "xavier", "xavier")
+                            .build())
+        assert params["d2"]["w"].shape == (7, 9)
+        assert params["out"]["w"].shape == (9, 3)
+        np.testing.assert_array_equal(np.asarray(params["d1"]["w"]),
+                                      np.asarray(g.params["d1"]["w"]))
+
+    def test_remove_vertex_and_replace_head(self):
+        g = self.make_graph()
+        new_g, params, _ = (TransferGraphBuilder(g)
+                            .remove_vertex("out")
+                            .add_layer("new_out", L.Output(n_out=5, activation="softmax",
+                                                           loss="mcxent"), "d2")
+                            .set_outputs("new_out")
+                            .build())
+        ys = new_g.output(jnp.zeros((2, 5)))
+        assert ys[0].shape == (2, 5)
+
+    def test_remove_vertex_with_connections(self):
+        g = self.make_graph()
+        b = TransferGraphBuilder(g).remove_vertex("d2", remove_connections=True)
+        assert "out" not in b._nodes
+        new_g, _, _ = (b.add_layer("head", L.Output(n_out=2, activation="softmax",
+                                                    loss="mcxent"), "d1")
+                       .set_outputs("head").build())
+        assert new_g.output_shapes[0][-1] == 2
+
+
+class TestMemoryReport:
+    def test_analytic_report(self):
+        net = make_net()
+        rep = memory_report(net)
+        assert rep.total_param_count == net.param_count()
+        assert rep.total_param_bytes == rep.total_param_count * 4
+        s = rep.to_string(batch_size=8)
+        assert "Total params" in s
+        assert rep.total_bytes(8) > rep.total_param_bytes
+
+    def test_compiled_report(self):
+        net = make_net()
+
+        def fwd(p, x):
+            y, _ = net.forward(p, net.state, x)
+            return y
+
+        rep = compiled_memory_report(fwd, net.params, jnp.zeros((4, 6)))
+        if rep["available"]:
+            assert rep["output_bytes"] >= 0
