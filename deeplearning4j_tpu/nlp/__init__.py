@@ -1,0 +1,44 @@
+"""NLP / embeddings — capability parity with ``deeplearning4j-nlp-parent``
+(SURVEY.md §2.5), redesigned TPU-first.
+
+The reference trains embeddings word-at-a-time through native ``AggregateSkipGram``
+/ ``AggregateCBOW`` ops (CBOW.java:166). Here training is *batched index
+arrays through one jitted update step* — gather rows, compute the
+negative-sampling / hierarchical-softmax objective, scatter-add sparse updates
+— so the whole inner loop is a single XLA program on the MXU.
+"""
+
+from .tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    LabelledDocument,
+    CollectionLabelledIterator,
+)
+from .vocab import VocabWord, VocabCache, VocabConstructor, build_huffman
+from .sequencevectors import SequenceVectors, SkipGram, CBOW
+from .word2vec import Word2Vec
+from .paragraphvectors import ParagraphVectors
+from .glove import Glove, CoOccurrences
+from .serializer import (
+    write_word_vectors,
+    read_word_vectors,
+    write_word2vec_binary,
+    read_word2vec_binary,
+)
+from .bagofwords import BagOfWordsVectorizer, TfidfVectorizer
+from .iterator import CnnSentenceIterator
+
+__all__ = [
+    "CommonPreprocessor", "DefaultTokenizerFactory", "NGramTokenizerFactory",
+    "BasicLineIterator", "CollectionSentenceIterator", "LabelledDocument",
+    "CollectionLabelledIterator",
+    "VocabWord", "VocabCache", "VocabConstructor", "build_huffman",
+    "SequenceVectors", "SkipGram", "CBOW",
+    "Word2Vec", "ParagraphVectors", "Glove", "CoOccurrences",
+    "write_word_vectors", "read_word_vectors",
+    "write_word2vec_binary", "read_word2vec_binary",
+    "BagOfWordsVectorizer", "TfidfVectorizer", "CnnSentenceIterator",
+]

@@ -1,0 +1,156 @@
+"""ParagraphVectors (doc2vec) — parity with
+``models/paragraphvectors/ParagraphVectors.java`` (1461 LoC) and the sequence
+learning algorithms ``learning/impl/sequence/{DBOW,DM}.java``.
+
+PV-DBOW: the document label's vector predicts each word of the document
+(skip-gram with the label as the center). PV-DM: label vector + context
+window mean predicts the target word (CBOW with the label mixed into the
+window). Labels live in the same table as words (the reference stores them in
+one lookup table too), prefixed to the vocab as special tokens.
+
+Inference of unseen docs (``inferVector``) freezes syn1 and trains only a
+fresh label row — same jitted steps with a 1-row table update.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .sequencevectors import (SequenceVectors, _cbow_ns_step,
+                              _skipgram_ns_infer_step, _skipgram_ns_step)
+from .tokenization import (DefaultTokenizerFactory, LabelledDocument,
+                           TokenizerFactory)
+from .vocab import VocabConstructor, unigram_table
+
+
+class ParagraphVectors:
+    def __init__(self, min_word_frequency: int = 1, layer_size: int = 100,
+                 window_size: int = 5, negative_sample: int = 5,
+                 learning_rate: float = 0.025, epochs: int = 1,
+                 batch_size: int = 2048, seed: int = 42, dm: bool = False,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.min_word_frequency = min_word_frequency
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.negative_sample = max(negative_sample, 1)  # NS only (DL4J default path)
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.dm = dm
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
+        self.labels: List[str] = []
+        self.vocab = None
+        self.sv: Optional[SequenceVectors] = None
+
+    def fit(self, docs: Iterable[LabelledDocument]) -> List[float]:
+        docs = list(docs)
+        token_lists = [self.tokenizer.create(d.content).get_tokens() for d in docs]
+        self.labels = sorted({lab for d in docs for lab in d.labels})
+        label_tokens = [f"__label__{l}" for l in self.labels]
+        self.vocab = VocabConstructor(
+            min_word_frequency=self.min_word_frequency,
+            build_huffman_tree=False).build(token_lists, special_tokens=label_tokens)
+        self.sv = SequenceVectors(
+            self.vocab, layer_size=self.layer_size, window=self.window_size,
+            negative=self.negative_sample, learning_rate=self.learning_rate,
+            epochs=1, batch_size=self.batch_size, seed=self.seed)
+        rng = np.random.default_rng(self.seed)
+        losses = []
+        for _ in range(self.epochs):
+            centers, contexts = [], []
+            cb_tgt, cb_ctx, cb_msk = [], [], []
+            W = 2 * self.window_size + 1
+            for d, toks in zip(docs, token_lists):
+                widx = np.array([self.vocab.index_of(t) for t in toks
+                                 if t in self.vocab], dtype=np.int64)
+                if not len(widx):
+                    continue
+                for lab in d.labels:
+                    li = self.vocab.index_of(f"__label__{lab}")
+                    if self.dm:
+                        # PV-DM: window + label -> target
+                        for i in range(len(widx)):
+                            lo = max(0, i - self.window_size)
+                            hi = min(len(widx), i + self.window_size + 1)
+                            c = np.concatenate([widx[lo:i], widx[i + 1:hi], [li]])[:W]
+                            pad = np.zeros(W, np.int64); m = np.zeros(W, np.float32)
+                            pad[:len(c)] = c; m[:len(c)] = 1.0
+                            cb_tgt.append(widx[i]); cb_ctx.append(pad); cb_msk.append(m)
+                    else:
+                        # PV-DBOW: label -> every word
+                        centers.append(np.full(len(widx), li))
+                        contexts.append(widx)
+            ep_loss, nb = 0.0, 0
+            if self.dm:
+                tgt = np.asarray(cb_tgt); ctx = np.stack(cb_ctx); msk = np.stack(cb_msk)
+                order = rng.permutation(len(tgt))
+                tgt, ctx, msk = tgt[order], ctx[order], msk[order]
+                for s in range(0, len(tgt), self.batch_size):
+                    bt, bc, bm = self.sv._pad_batch3(
+                        tgt[s:s + self.batch_size], ctx[s:s + self.batch_size],
+                        msk[s:s + self.batch_size])
+                    neg = rng.choice(len(self.vocab), size=(len(bt), self.negative_sample),
+                                     p=self.sv._neg_probs)
+                    self.sv.syn0, self.sv.syn1, loss = _cbow_ns_step(
+                        self.sv.syn0, self.sv.syn1, jnp.asarray(bc), jnp.asarray(bm),
+                        jnp.asarray(bt), jnp.asarray(neg), self.learning_rate)
+                    ep_loss += float(loss); nb += 1
+            else:
+                cen = np.concatenate(centers); con = np.concatenate(contexts)
+                order = rng.permutation(len(cen))
+                cen, con = cen[order], con[order]
+                for s in range(0, len(cen), self.batch_size):
+                    bc = self.sv._pad_batch(cen[s:s + self.batch_size])
+                    bx = self.sv._pad_batch(con[s:s + self.batch_size])
+                    neg = rng.choice(len(self.vocab), size=(len(bc), self.negative_sample),
+                                     p=self.sv._neg_probs)
+                    self.sv.syn0, self.sv.syn1, loss = _skipgram_ns_step(
+                        self.sv.syn0, self.sv.syn1, jnp.asarray(bc), jnp.asarray(bx),
+                        jnp.asarray(neg), self.learning_rate)
+                    ep_loss += float(loss); nb += 1
+            losses.append(ep_loss / max(nb, 1))
+        return losses
+
+    # -- lookup ------------------------------------------------------------
+
+    def get_label_vector(self, label: str) -> Optional[np.ndarray]:
+        idx = self.vocab.index_of(f"__label__{label}")
+        return None if idx < 0 else self.sv.vector(idx)
+
+    def similarity(self, label_a: str, label_b: str) -> float:
+        ia = self.vocab.index_of(f"__label__{label_a}")
+        ib = self.vocab.index_of(f"__label__{label_b}")
+        return self.sv.similarity(ia, ib)
+
+    def infer_vector(self, text: str, steps: int = 20,
+                     learning_rate: float = 0.025) -> np.ndarray:
+        """``ParagraphVectors.inferVector`` — train a fresh doc vector against
+        the frozen tables."""
+        toks = self.tokenizer.create(text).get_tokens()
+        widx = np.array([self.vocab.index_of(t) for t in toks if t in self.vocab],
+                        dtype=np.int64)
+        rng = np.random.default_rng(self.seed)
+        D = self.layer_size
+        vec = jnp.asarray((rng.random((1, D), dtype=np.float32) - 0.5) / D)
+        if not len(widx):
+            return np.asarray(vec[0])
+        for _ in range(steps):
+            neg = rng.choice(len(self.vocab), size=(len(widx), self.negative_sample),
+                             p=self.sv._neg_probs)
+            vec = _skipgram_ns_infer_step(
+                vec, self.sv.syn1, jnp.asarray(widx), jnp.asarray(neg),
+                learning_rate)
+        return np.asarray(vec[0])
+
+    def nearest_labels(self, text: str, top_n: int = 5) -> List[Tuple[str, float]]:
+        v = self.infer_vector(text)
+        out = []
+        for lab in self.labels:
+            lv = self.get_label_vector(lab)
+            den = np.linalg.norm(v) * np.linalg.norm(lv)
+            out.append((lab, float(v @ lv / den) if den > 0 else 0.0))
+        return sorted(out, key=lambda t: -t[1])[:top_n]

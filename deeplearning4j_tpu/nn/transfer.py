@@ -61,13 +61,28 @@ def _freeze(layer: Layer) -> Layer:
 
 
 def _replace_n_out(layer: Layer, n_out: int, weight_init: Optional[str]) -> Layer:
-    d = layer.to_dict()
-    if "n_out" not in {f.name for f in dataclasses.fields(layer)}:
-        raise ValueError(f"nOutReplace target {type(layer).__name__} has no n_out")
+    # DL4J's builder composes setFeatureExtractor/nOutReplace in either order
+    # (frozenTill is applied at build) — unwrap Frozen so we do too.
+    was_frozen = isinstance(layer, Frozen)
+    inner = layer._sub() if was_frozen else layer
+    d = inner.to_dict()
+    if "n_out" not in {f.name for f in dataclasses.fields(inner)}:
+        raise ValueError(f"nOutReplace target {type(inner).__name__} has no n_out")
     d["n_out"] = n_out
     if weight_init is not None:
         d["weight_init"] = weight_init
-    return layer_from_dict(d)
+    new = layer_from_dict(d)
+    return _freeze(new) if was_frozen else new
+
+
+def _shapes_match(fresh, old) -> bool:
+    """True when two pytrees have identical structure and leaf shapes — the
+    gate for carrying trained params/state into a surgically-edited net."""
+    if jax.tree_util.tree_structure(fresh) != jax.tree_util.tree_structure(old):
+        return False
+    return all(getattr(a, "shape", None) == getattr(b, "shape", None)
+               for a, b in zip(jax.tree_util.tree_leaves(fresh),
+                               jax.tree_util.tree_leaves(old)))
 
 
 class TransferLearningBuilder:
@@ -132,6 +147,9 @@ class TransferLearningBuilder:
 
     def remove_layers_from_output(self, n: int) -> "TransferLearningBuilder":
         """Remove the last n layers (TransferLearning.java:207)."""
+        if not 0 <= n <= len(self._entries):
+            raise ValueError(
+                f"cannot remove {n} layers from a {len(self._entries)}-layer network")
         del self._entries[len(self._entries) - n:]
         return self
 
@@ -150,11 +168,9 @@ class TransferLearningBuilder:
             k = _layer_key(i, layer)
             if p is not None:
                 fresh = params.get(k)
-                if fresh is not None and jax.tree_util.tree_structure(fresh) == jax.tree_util.tree_structure(p) \
-                        and all(a.shape == b.shape for a, b in
-                                zip(jax.tree_util.tree_leaves(fresh), jax.tree_util.tree_leaves(p))):
+                if fresh is not None and _shapes_match(fresh, p):
                     params[k] = p
-            if s is not None and k in state:
+            if s is not None and k in state and _shapes_match(state[k], s):
                 state[k] = s
         net.params, net.state = params, state
         return net, params, state
@@ -203,15 +219,27 @@ class TransferGraphBuilder:
         node = self._nodes[name]
         self._nodes[name] = GraphNode(_replace_n_out(node.spec, n_out, weight_init), node.inputs)
         self._reinit.add(name)
-        # consumers' input shapes change -> re-init their params too
-        for cname, cnode in self._nodes.items():
-            if name in cnode.inputs and cnode.is_layer() and cnode.spec.has_params():
-                if weight_init_next is not None:
-                    inner = cnode.spec._sub() if isinstance(cnode.spec, Frozen) else cnode.spec
-                    d = inner.to_dict()
-                    d["weight_init"] = weight_init_next
-                    self._nodes[cname] = GraphNode(layer_from_dict(d), cnode.inputs)
-                self._reinit.add(cname)
+        # Downstream widths change: walk consumers transitively THROUGH
+        # non-parametric nodes (activation, merge, ...) until a parametric
+        # consumer absorbs the new width — mirror of the Sequential walk and
+        # of TransferLearning.java:374's next-layer re-init.
+        frontier = {name}
+        seen = set()
+        while frontier:
+            cur = frontier.pop()
+            for cname, cnode in self._nodes.items():
+                if cname in seen or cur not in cnode.inputs:
+                    continue
+                seen.add(cname)
+                if cnode.is_layer() and cnode.spec.has_params():
+                    if weight_init_next is not None:
+                        inner = cnode.spec._sub() if isinstance(cnode.spec, Frozen) else cnode.spec
+                        d = inner.to_dict()
+                        d["weight_init"] = weight_init_next
+                        self._nodes[cname] = GraphNode(layer_from_dict(d), cnode.inputs)
+                    self._reinit.add(cname)
+                else:
+                    frontier.add(cname)  # width flows through; keep walking
         return self
 
     def remove_vertex(self, name: str, remove_connections: bool = False) -> "TransferGraphBuilder":
@@ -254,13 +282,10 @@ class TransferGraphBuilder:
             if name in self._reinit:
                 continue
             old_p = self._params.get(name)
-            if old_p is not None and name in params:
-                fresh = params[name]
-                if jax.tree_util.tree_structure(fresh) == jax.tree_util.tree_structure(old_p) \
-                        and all(a.shape == b.shape for a, b in
-                                zip(jax.tree_util.tree_leaves(fresh), jax.tree_util.tree_leaves(old_p))):
-                    params[name] = old_p
-            if name in self._state and name in state:
+            if old_p is not None and name in params and _shapes_match(params[name], old_p):
+                params[name] = old_p
+            if name in self._state and name in state \
+                    and _shapes_match(state[name], self._state[name]):
                 state[name] = self._state[name]
         net.params, net.state = params, state
         return net, params, state
@@ -277,6 +302,8 @@ class TransferLearningHelper:
         self.model = model
         self.params = params if params is not None else model.params
         self.state = state if state is not None else model.state
+        if self.params is None:
+            raise ValueError("source network has no params — call init()/load first")
         # frozen prefix = longest prefix of Frozen layers
         self.split = 0
         for layer in model.layers:

@@ -13,12 +13,10 @@ the reference could never see through its per-op dispatch.
 
 from __future__ import annotations
 
-import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..nn.model import Graph, Sequential, _layer_key
@@ -99,12 +97,8 @@ def memory_report(model) -> NetworkMemoryReport:
         for name in model.topo_order:
             node = model.nodes[name]
             out_s = model._shapes[name]
-            if node.is_layer():
-                in_s = model._shapes[node.inputs[0]]
-                n = node.spec.param_count(in_s) if node.spec.has_params() else 0
-            else:
-                in_s = model._shapes[node.inputs[0]]
-                n = 0
+            in_s = model._shapes[node.inputs[0]]
+            n = node.spec.param_count(in_s) if node.is_layer() and node.spec.has_params() else 0
             reports.append(LayerMemoryReport(
                 name=name, layer_type=type(node.spec).__name__,
                 input_shape=tuple(in_s), output_shape=tuple(out_s),

@@ -1,0 +1,187 @@
+"""NLP / embeddings tests — mirrors the reference's word2vec/glove/
+paragraphvectors functional tests (deeplearning4j-nlp src/test) at unit scale.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (BagOfWordsVectorizer, CBOW,
+                                    CnnSentenceIterator,
+                                    CollectionLabelledIterator,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory, Glove,
+                                    LabelledDocument, NGramTokenizerFactory,
+                                    ParagraphVectors, SequenceVectors,
+                                    TfidfVectorizer, VocabConstructor,
+                                    Word2Vec, build_huffman,
+                                    read_word2vec_binary, read_word_vectors,
+                                    write_word2vec_binary, write_word_vectors)
+from deeplearning4j_tpu.nlp.vocab import huffman_tensors
+
+
+def _topic_corpus(n=150, seed=0):
+    """Two disjoint-vocab topics => within-topic co-occurrence structure."""
+    rng = np.random.default_rng(seed)
+    topic_a = [f"alpha{i}" for i in range(8)]
+    topic_b = [f"beta{i}" for i in range(8)]
+    sents = []
+    for _ in range(n):
+        words = topic_a if rng.random() < 0.5 else topic_b
+        sents.append(" ".join(rng.choice(words, size=8)))
+    return sents, topic_a, topic_b
+
+
+class TestTokenization:
+    def test_default_tokenizer_and_preprocessor(self):
+        tf = DefaultTokenizerFactory().set_token_preprocessor(CommonPreprocessor())
+        toks = tf.create("The Quick, Brown FOX!! 123").get_tokens()
+        assert toks == ["the", "quick", "brown", "fox"]
+
+    def test_ngram_tokenizer(self):
+        tf = NGramTokenizerFactory(min_n=1, max_n=2)
+        toks = tf.create("a b c").get_tokens()
+        assert toks == ["a", "b", "c", "a_b", "b_c"]
+
+
+class TestVocab:
+    def test_min_frequency_and_order(self):
+        vc = VocabConstructor(min_word_frequency=2).build(
+            [["a", "a", "a", "b", "b", "c"]])
+        assert len(vc) == 2  # c pruned
+        assert vc.word_for(0) == "a" and vc.word_for(1) == "b"
+
+    def test_huffman_prefix_free(self):
+        vc = VocabConstructor(min_word_frequency=1).build(
+            [["w%d" % i] * (i + 1) for i in range(10)])
+        build_huffman(vc)
+        codes = {"".join(map(str, w.codes)) for w in vc.words}
+        assert len(codes) == len(vc)  # unique
+        for c1 in codes:
+            for c2 in codes:
+                if c1 != c2:
+                    assert not c2.startswith(c1)
+        # most frequent word gets one of the shortest codes
+        lens = {w.word: len(w.codes) for w in vc.words}
+        assert lens["w9"] == min(lens.values())
+
+    def test_huffman_tensors_shapes(self):
+        vc = VocabConstructor().build([["a", "b", "c", "a", "b", "a"]])
+        codes, points, mask = huffman_tensors(vc)
+        assert codes.shape == points.shape == mask.shape
+        assert mask.sum(axis=1).min() >= 1
+
+
+class TestWord2Vec:
+    def test_skipgram_learns_topics(self):
+        sents, ta, tb = _topic_corpus()
+        w2v = Word2Vec(min_word_frequency=1, layer_size=24, window_size=4,
+                       negative_sample=4, epochs=3, batch_size=512, seed=1,
+                       learning_rate=0.05)
+        losses = w2v.fit(sents)
+        assert losses[-1] < losses[0]
+        within = np.mean([w2v.similarity(ta[0], w) for w in ta[1:4]])
+        across = np.mean([w2v.similarity(ta[0], w) for w in tb[:3]])
+        assert within > across
+        near = [w for w, _ in w2v.words_nearest(ta[0], 5)]
+        assert sum(w in ta for w in near) >= 3
+
+    def test_cbow_smoke(self):
+        sents, ta, tb = _topic_corpus(60)
+        w2v = Word2Vec(min_word_frequency=1, layer_size=16, window_size=3,
+                       negative_sample=3, epochs=2, batch_size=256, seed=2,
+                       use_cbow=True)
+        losses = w2v.fit(sents)
+        assert np.isfinite(losses).all()
+        assert w2v.get_word_vector(ta[0]).shape == (16,)
+
+    def test_hierarchical_softmax(self):
+        sents, ta, tb = _topic_corpus(60)
+        w2v = Word2Vec(min_word_frequency=1, layer_size=16, window_size=3,
+                       negative_sample=0, epochs=2, batch_size=256, seed=3)
+        losses = w2v.fit(sents)
+        assert losses[-1] < losses[0]
+
+
+class TestParagraphVectors:
+    def test_dbow_labels(self):
+        sents, ta, tb = _topic_corpus(80)
+        docs = [LabelledDocument(s, ["A" if s.split()[0].startswith("alpha")
+                                     else "B"]) for s in sents]
+        pv = ParagraphVectors(layer_size=16, negative_sample=4, epochs=3,
+                              batch_size=512, seed=4, learning_rate=0.05)
+        losses = pv.fit(CollectionLabelledIterator(docs))
+        assert losses[-1] < losses[0]
+        assert pv.get_label_vector("A").shape == (16,)
+        v = pv.infer_vector("alpha0 alpha1 alpha2 alpha3")
+        assert np.isfinite(v).all()
+
+    def test_dm_smoke(self):
+        sents, *_ = _topic_corpus(40)
+        docs = [LabelledDocument(s, ["D%d" % (i % 4)]) for i, s in enumerate(sents)]
+        pv = ParagraphVectors(layer_size=12, epochs=1, batch_size=256, seed=5,
+                              dm=True)
+        losses = pv.fit(docs)
+        assert np.isfinite(losses).all()
+
+
+class TestGlove:
+    def test_glove_learns(self):
+        sents, ta, tb = _topic_corpus(120)
+        gl = Glove(layer_size=16, window_size=4, epochs=8, batch_size=1024,
+                   seed=6)
+        losses = gl.fit(sents)
+        assert losses[-1] < losses[0]
+        within = np.mean([gl.similarity(ta[0], w) for w in ta[1:4]])
+        across = np.mean([gl.similarity(ta[0], w) for w in tb[:3]])
+        assert within > across
+
+
+class TestSerializer:
+    def test_text_roundtrip(self, tmp_path):
+        words = ["hello", "world", "naïve"]
+        vecs = np.random.default_rng(0).random((3, 5)).astype(np.float32)
+        p = str(tmp_path / "w.txt")
+        write_word_vectors(p, words, vecs)
+        w2, v2 = read_word_vectors(p)
+        assert w2 == words
+        np.testing.assert_allclose(v2, vecs, rtol=1e-4)
+
+    def test_binary_roundtrip(self, tmp_path):
+        words = ["a", "b", "c"]
+        vecs = np.random.default_rng(1).random((3, 7)).astype(np.float32)
+        p = str(tmp_path / "w.bin")
+        write_word2vec_binary(p, words, vecs)
+        w2, v2 = read_word2vec_binary(p)
+        assert w2 == words
+        np.testing.assert_array_equal(v2, vecs)
+
+
+class TestVectorizers:
+    def test_bow_counts(self):
+        bow = BagOfWordsVectorizer()
+        X = bow.fit_transform(["a a b", "b c"])
+        ia, ib, ic = (bow.vocab.index_of(w) for w in "abc")
+        assert X[0, ia] == 2 and X[0, ib] == 1 and X[0, ic] == 0
+        assert X[1, ib] == 1 and X[1, ic] == 1
+
+    def test_tfidf_downweights_common(self):
+        tf = TfidfVectorizer(smooth=False)
+        X = tf.fit_transform(["common rare1", "common rare2", "common rare3"])
+        ic = tf.vocab.index_of("common")
+        ir = tf.vocab.index_of("rare1")
+        assert X[0, ic] < X[0, ir]  # idf(common)=log(1)=0 < idf(rare)
+
+
+class TestCnnSentenceIterator:
+    def test_batch_shapes(self):
+        sents, ta, tb = _topic_corpus(30)
+        w2v = Word2Vec(min_word_frequency=1, layer_size=8, epochs=1,
+                       batch_size=256, seed=7)
+        w2v.fit(sents)
+        docs = [LabelledDocument(s, ["A" if "alpha" in s else "B"])
+                for s in sents]
+        it = CnnSentenceIterator(docs, w2v, batch_size=8, max_length=10)
+        x, y, mask = next(iter(it))
+        assert x.shape == (8, 10, 8) and y.shape == (8, 2) and mask.shape == (8, 10)
+        assert y.sum(axis=1).min() == 1.0
+        assert mask.sum() > 0

@@ -199,3 +199,50 @@ class TestMemoryReport:
         rep = compiled_memory_report(fwd, net.params, jnp.zeros((4, 6)))
         if rep["available"]:
             assert rep["output_bytes"] >= 0
+
+
+class TestTransferRegressions:
+    """Regressions from review: frozen-target nOutReplace, stale state behind
+    non-parametric hops, bounds checks, uninitialized-model guard."""
+
+    def test_n_out_replace_on_frozen_layer(self):
+        net = make_net()
+        new_net, params, _ = (TransferLearningBuilder(net)
+                              .set_feature_extractor(1)
+                              .n_out_replace(1, 20, "xavier")
+                              .build())
+        assert isinstance(new_net.layers[1], Frozen)
+        ys = new_net.output(jnp.zeros((2, 6)))
+        assert ys.shape == (2, 3)
+
+    def test_graph_n_out_replace_through_nonparametric(self):
+        cfg = NetConfig(seed=0, updater={"type": "sgd", "learning_rate": 0.1})
+        g = (GraphBuilder(cfg)
+             .add_input("in", (6,))
+             .add_layer("fc", L.Dense(n_out=10, activation="identity"), "in")
+             .add_layer("act", L.ActivationLayer(activation="relu"), "fc")
+             .add_layer("bn", L.BatchNorm(), "act")
+             .add_layer("out", L.Output(n_out=3, activation="softmax",
+                                        loss="mcxent"), "bn")
+             .set_outputs("out").build())
+        g.init()
+        new_g, params, state = (TransferGraphBuilder(g)
+                                .n_out_replace("fc", 20).build())
+        # bn sits behind a non-parametric hop; it must get fresh 20-wide
+        # params/state, and the forward must not crash on stale widths.
+        ys = new_g.output(jnp.zeros((2, 6)))
+        assert ys[0].shape == (2, 3)
+
+    def test_remove_layers_bounds(self):
+        net = make_net()
+        with pytest.raises(ValueError):
+            TransferLearningBuilder(net).remove_layers_from_output(5)
+
+    def test_helper_requires_params(self):
+        net = (SequentialBuilder(NetConfig(seed=0))
+               .input_shape(6)
+               .layer(L.Dense(n_out=4, activation="tanh"))
+               .layer(L.Output(n_out=2, activation="softmax", loss="mcxent"))
+               .build())  # no init()
+        with pytest.raises(ValueError):
+            TransferLearningHelper(net)
