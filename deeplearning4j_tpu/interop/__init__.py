@@ -1,0 +1,15 @@
+"""Interop — model import/export (deeplearning4j-modelimport equivalent)."""
+
+from .keras_import import (InvalidKerasConfigurationException,
+                           KerasHdf5Archive,
+                           UnsupportedKerasConfigurationException,
+                           import_keras_model_and_weights,
+                           import_keras_sequential_model_and_weights)
+from .guesser import guess_model_format, load_model_guess
+
+__all__ = [
+    "InvalidKerasConfigurationException", "KerasHdf5Archive",
+    "UnsupportedKerasConfigurationException", "import_keras_model_and_weights",
+    "import_keras_sequential_model_and_weights", "guess_model_format",
+    "load_model_guess",
+]

@@ -1,0 +1,51 @@
+"""ModelGuesser — heuristic model-file loader.
+
+Reference parity: ``deeplearning4j-core/.../util/ModelGuesser.java`` — guess
+whether a file is a DL4J zip, a Keras HDF5 file, or a bare config JSON, and
+load it with the right importer.
+"""
+
+from __future__ import annotations
+
+import json
+import zipfile
+
+
+def guess_model_format(path: str) -> str:
+    """Return one of: 'native-zip', 'keras-h5', 'config-json', 'unknown'."""
+    try:
+        if zipfile.is_zipfile(path):
+            with zipfile.ZipFile(path) as zf:
+                if "configuration.json" in zf.namelist():
+                    return "native-zip"
+            return "unknown"
+        with open(path, "rb") as f:
+            magic = f.read(8)
+        if magic.startswith(b"\x89HDF\r\n\x1a\n"):
+            return "keras-h5"
+        with open(path, "r", encoding="utf-8", errors="strict") as f:
+            json.load(f)
+        return "config-json"
+    except (OSError, ValueError, UnicodeDecodeError):
+        return "unknown"
+
+
+def load_model_guess(path: str):
+    """Load a model file of any supported format (ModelGuesser.loadModelGuess)."""
+    fmt = guess_model_format(path)
+    if fmt == "native-zip":
+        from ..train.serialization import load_model
+
+        return load_model(path)[0]
+    if fmt == "keras-h5":
+        from .keras_import import import_keras_model_and_weights
+
+        return import_keras_model_and_weights(path)
+    if fmt == "config-json":
+        from ..nn.model import Graph, Sequential
+
+        with open(path) as f:
+            cfg = f.read()
+        fmt_tag = json.loads(cfg).get("format", "")
+        return Sequential.from_json(cfg) if "sequential" in fmt_tag else Graph.from_json(cfg)
+    raise ValueError(f"Cannot determine model format of {path}")

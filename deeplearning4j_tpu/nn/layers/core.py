@@ -126,10 +126,15 @@ class Embedding(Layer):
 @register_layer
 @dataclass(frozen=True)
 class EmbeddingSequence(Layer):
-    """EmbeddingSequenceLayer: (B, T) int ids -> (B, T, n_out)."""
+    """EmbeddingSequenceLayer: (B, T) int ids -> (B, T, n_out).
+
+    ``mask_zero=True`` emits a (B, T) padding mask (ids != 0) downstream —
+    Keras Embedding(mask_zero=True) parity for model import.
+    """
 
     n_in: int = 0
     n_out: int = 0
+    mask_zero: bool = False
 
     def output_shape(self, input_shape: Shape) -> Shape:
         return input_shape + (self.n_out,)
@@ -140,6 +145,8 @@ class EmbeddingSequence(Layer):
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         ids = x.astype(jnp.int32)
+        if self.mask_zero and mask is None:
+            mask = (ids != 0).astype(jnp.float32)
         return jnp.take(params["w"], ids, axis=0), state, mask
 
 

@@ -170,11 +170,19 @@ class GravesLSTM(LSTM):
 @register_layer
 @dataclass(frozen=True)
 class GRU(RecurrentLayer):
-    """GRU — standard gated recurrent unit (DL4J has a legacy GRU config)."""
+    """GRU — gated recurrent unit (DL4J has a legacy GRU config).
+
+    ``reset_after=False`` (default) is the classic Cho et al. 2014 cell: the
+    reset gate multiplies ``h_prev`` *before* the candidate's recurrent matmul.
+    ``reset_after=True`` is the CuDNN/Keras-v3 variant: reset applied after the
+    matmul, with a separate recurrent bias ``b_hh`` — needed for exact Keras
+    GRU weight import (KerasLayer parity). Gate block order is [r, u, n].
+    """
 
     n_out: int = 0
     activation: str = "tanh"
     gate_activation: str = "sigmoid"
+    reset_after: bool = False
 
     def output_shape(self, input_shape: Shape) -> Shape:
         return (input_shape[0], self.n_out)
@@ -185,7 +193,10 @@ class GRU(RecurrentLayer):
         k1, k2 = jax.random.split(key)
         w_ih = initializers.init_param(k1, self.weight_init or "xavier", (n_in, 3 * H), dtype=dtype)
         w_hh = initializers.init_param(k2, self.weight_init or "xavier", (H, 3 * H), dtype=dtype)
-        return {"w_ih": w_ih, "w_hh": w_hh, "b": jnp.zeros((3 * H,), dtype)}, {}
+        params = {"w_ih": w_ih, "w_hh": w_hh, "b": jnp.zeros((3 * H,), dtype)}
+        if self.reset_after:
+            params["b_hh"] = jnp.zeros((3 * H,), dtype)
+        return params, {}
 
     def init_carry(self, batch, input_shape, dtype=jnp.float32):
         return jnp.zeros((batch, self.n_out), dtype)
@@ -205,12 +216,18 @@ class GRU(RecurrentLayer):
                 z = inp
             else:
                 z, m = inp
-            hz = h_prev @ w_hh
             xr, xu, xn = jnp.split(z, 3, axis=-1)
-            hr, hu, hn = jnp.split(hz, 3, axis=-1)
-            r = gate(xr + hr)
-            u = gate(xu + hu)
-            n = act(xn + r * hn)
+            if self.reset_after:
+                hz = h_prev @ w_hh + params["b_hh"]
+                hr, hu, hn = jnp.split(hz, 3, axis=-1)
+                r = gate(xr + hr)
+                u = gate(xu + hu)
+                n = act(xn + r * hn)
+            else:
+                hz = h_prev @ w_hh[:, : 2 * H]
+                r = gate(xr + hz[:, :H])
+                u = gate(xu + hz[:, H:])
+                n = act(xn + (r * h_prev) @ w_hh[:, 2 * H :])
             h_new = (1 - u) * n + u * h_prev
             if m_t is not None:
                 h_new = jnp.where(m[:, None] > 0, h_new, h_prev)
