@@ -1,0 +1,14 @@
+"""Graph embeddings — deeplearning4j-graph equivalent (SURVEY.md §2.9).
+
+In-memory graph API, random-walk iterators, and DeepWalk built on the shared
+SequenceVectors skip-gram machinery (hierarchical softmax over a degree-based
+Huffman tree, GraphHuffman parity).
+"""
+
+from .graph import Edge, Graph, load_delimited_edges, load_weighted_edges
+from .walks import RandomWalkIterator, WeightedRandomWalkIterator
+from .deepwalk import DeepWalk
+
+__all__ = ["Edge", "Graph", "DeepWalk", "RandomWalkIterator",
+           "WeightedRandomWalkIterator", "load_delimited_edges",
+           "load_weighted_edges"]

@@ -1,0 +1,15 @@
+"""Nearest neighbors & clustering — deeplearning4j-nearestneighbors-parent
+equivalent (SURVEY.md §2.10): device brute-force scan (the TPU fast path),
+VPTree/KDTree host structures, k-means, random-projection LSH, and the k-NN
+REST server/client."""
+
+from .brute import BruteForceKNN
+from .client import NearestNeighborsClient
+from .kdtree import KDTree
+from .kmeans import KMeans
+from .lsh import RandomProjectionLSH
+from .server import NearestNeighborsServer
+from .vptree import VPTree
+
+__all__ = ["BruteForceKNN", "KDTree", "KMeans", "NearestNeighborsClient",
+           "NearestNeighborsServer", "RandomProjectionLSH", "VPTree"]

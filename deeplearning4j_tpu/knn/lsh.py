@@ -1,0 +1,57 @@
+"""Random-projection LSH — ``clustering/lsh/RandomProjectionLSH.java`` parity.
+
+Signed random projections hash points into buckets; candidate buckets are
+re-ranked exactly. Hashing and re-ranking are both jitted device ops (the
+reference computes per-point on CPU via ND4J)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=())
+def _signatures(points, planes):
+    bits = (points @ planes.T) > 0
+    weights = 2 ** jnp.arange(planes.shape[0], dtype=jnp.uint32)
+    return jnp.sum(bits.astype(jnp.uint32) * weights, axis=-1)
+
+
+class RandomProjectionLSH:
+    def __init__(self, points, hash_length: int = 12, seed: int = 12345):
+        if not (1 <= hash_length <= 32):
+            raise ValueError(
+                f"hash_length must be in [1, 32] (uint32 signature packing), "
+                f"got {hash_length}")
+        self.points = jnp.asarray(points, jnp.float32)
+        rng = np.random.default_rng(seed)
+        dim = self.points.shape[1]
+        self.planes = jnp.asarray(rng.standard_normal((hash_length, dim)),
+                                  jnp.float32)
+        self.signatures = np.asarray(_signatures(self.points, self.planes))
+        # bucket -> point indices
+        self._buckets = {}
+        for i, s in enumerate(self.signatures):
+            self._buckets.setdefault(int(s), []).append(i)
+
+    def bucket(self, query) -> np.ndarray:
+        q = jnp.asarray(query, jnp.float32)[None]
+        sig = int(np.asarray(_signatures(q, self.planes))[0])
+        return np.asarray(self._buckets.get(sig, []), np.int64)
+
+    def search(self, query, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Approximate k-NN: exact re-rank within the query's bucket; falls
+        back to full scan when the bucket is smaller than k."""
+        cand = self.bucket(query)
+        if len(cand) < k:
+            cand = np.arange(self.points.shape[0])
+        sub = self.points[cand]
+        q = jnp.asarray(query, jnp.float32)
+        d = jnp.linalg.norm(sub - q[None, :], axis=-1)
+        k = min(k, len(cand))
+        top = jnp.argsort(d)[:k]
+        return np.asarray(cand)[np.asarray(top)], np.asarray(d)[np.asarray(top)]

@@ -1,0 +1,103 @@
+"""k-NN REST server — ``nearestneighbor/server/NearestNeighborsServer.java``
+equivalent (the reference boots a Play-framework HTTP daemon; here it's a
+stdlib ``http.server`` — zero extra deps, same endpoints).
+
+Endpoints (JSON):
+- POST /knn     {"ndarray": <row index int>, "k": int}   — neighbors of an
+  indexed point (self excluded), parity with NearestNeighbor.java
+- POST /knnnew  {"ndarray": [[...floats...]], "k": int}  — neighbors of new
+  vectors (Base64NDArrayBody in the reference; plain JSON arrays here)
+- GET  /health
+
+A ``NearestNeighborsClient`` mirror lives in ``client.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .brute import BruteForceKNN
+
+
+class NearestNeighborsServer:
+    def __init__(self, points, distance: str = "euclidean", port: int = 9000,
+                 default_k: int = 5):
+        self.index = BruteForceKNN(points, distance=distance)
+        self.port = port
+        self.default_k = default_k
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply(200, {"status": "ok",
+                                      "points": int(server.index.points.shape[0])})
+                else:
+                    self._reply(404, {"error": "unknown endpoint"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    k = int(req.get("k", server.default_k))
+                    if self.path == "/knn":
+                        row = int(req["ndarray"])
+                        idx, d = server.index.search_excluding_self(row, k)
+                        self._reply(200, {"results": [
+                            {"index": int(i), "distance": float(x)}
+                            for i, x in zip(idx, d)]})
+                    elif self.path == "/knnnew":
+                        arr = np.asarray(req["ndarray"], np.float32)
+                        if arr.ndim == 1:
+                            arr = arr[None]
+                        idx, d = server.index.search(arr, k)
+                        self._reply(200, {"results": [[
+                            {"index": int(i), "distance": float(x)}
+                            for i, x in zip(row_i, row_d)]
+                            for row_i, row_d in zip(idx, d)]})
+                    else:
+                        self._reply(404, {"error": "unknown endpoint"})
+                except (KeyError, ValueError, IndexError, TypeError,
+                        AttributeError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # unexpected: surface as 500, keep serving
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        return Handler
+
+    def start(self, background: bool = True):
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), self._handler())
+        self.port = self._httpd.server_address[1]  # resolves port=0
+        if background:
+            self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.serve_forever()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,5 @@
+"""Visualization helpers — deeplearning4j-core ``plot/`` equivalent."""
+
+from .tsne import BarnesHutTsne, Tsne
+
+__all__ = ["BarnesHutTsne", "Tsne"]

@@ -1,0 +1,22 @@
+"""Shared pairwise-distance kernels (used by knn, kmeans, t-SNE).
+
+One implementation of the MXU-friendly squared-euclidean identity
+``||a-b||^2 = ||a||^2 - 2ab + ||b||^2`` so clamp/precision behavior stays
+consistent across every consumer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pairwise_sq_dists(a, b=None):
+    """Squared euclidean distances (N,M) between rows of a (N,D) and b (M,D);
+    b=None means b=a. Clamped at 0 (the identity can go slightly negative in
+    float32)."""
+    if b is None:
+        b = a
+    cross = a @ b.T
+    d2 = (jnp.sum(a * a, -1, keepdims=True) - 2.0 * cross
+          + jnp.sum(b * b, -1)[None, :])
+    return jnp.maximum(d2, 0.0)

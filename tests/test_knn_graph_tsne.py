@@ -1,0 +1,298 @@
+"""Tests for knn (§2.10), graph/DeepWalk (§2.9), and t-SNE (§2.2 BarnesHutTsne).
+
+Oracle pattern follows the reference test strategy: exact structures
+(VPTree/KDTree/brute) must agree with a numpy linear scan; DeepWalk must
+embed community-structured graphs so that intra-community similarity exceeds
+inter-community; t-SNE must reduce KL and separate well-separated clusters.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (DeepWalk, Edge, Graph,
+                                      RandomWalkIterator,
+                                      WeightedRandomWalkIterator,
+                                      load_delimited_edges)
+from deeplearning4j_tpu.graph.graph import NoEdgesException
+from deeplearning4j_tpu.knn import (BruteForceKNN, KDTree, KMeans,
+                                    NearestNeighborsClient,
+                                    NearestNeighborsServer,
+                                    RandomProjectionLSH, VPTree)
+from deeplearning4j_tpu.plot import Tsne
+
+
+def _linear_scan(points, q, k):
+    d = np.linalg.norm(points - q[None], axis=1)
+    idx = np.argsort(d)[:k]
+    return idx, d[idx]
+
+
+class TestBruteForce:
+    def test_matches_linear_scan(self):
+        rng = np.random.RandomState(0)
+        pts = rng.randn(200, 16).astype(np.float32)
+        index = BruteForceKNN(pts, distance="euclidean")
+        q = rng.randn(16).astype(np.float32)
+        idx, d = index.search(q, 5)
+        want_idx, want_d = _linear_scan(pts, q, 5)
+        np.testing.assert_array_equal(np.sort(idx), np.sort(want_idx))
+        np.testing.assert_allclose(np.sort(d), np.sort(want_d), rtol=1e-4)
+
+    def test_batched_queries(self):
+        rng = np.random.RandomState(1)
+        pts = rng.randn(100, 8).astype(np.float32)
+        index = BruteForceKNN(pts)
+        qs = rng.randn(7, 8).astype(np.float32)
+        idx, d = index.search(qs, 3)
+        assert idx.shape == (7, 3) and d.shape == (7, 3)
+        for i in range(7):
+            want_idx, _ = _linear_scan(pts, qs[i], 3)
+            np.testing.assert_array_equal(np.sort(idx[i]), np.sort(want_idx))
+
+    def test_cosine_and_dot(self):
+        rng = np.random.RandomState(2)
+        pts = rng.randn(50, 4).astype(np.float32)
+        q = rng.randn(4).astype(np.float32)
+        for dist in ("cosinesimilarity", "dot", "manhattan"):
+            idx, d = BruteForceKNN(pts, distance=dist).search(q, 5)
+            assert len(idx) == 5
+        # cosine top-1 equals numpy argmax of cosine sim
+        idx, _ = BruteForceKNN(pts, distance="cosinesimilarity").search(q, 1)
+        cs = (pts @ q) / (np.linalg.norm(pts, axis=1) * np.linalg.norm(q))
+        assert idx[0] == np.argmax(cs)
+
+    def test_exclude_self(self):
+        pts = np.random.RandomState(3).randn(30, 5).astype(np.float32)
+        idx, _ = BruteForceKNN(pts).search_excluding_self(7, 4)
+        assert 7 not in idx and len(idx) == 4
+
+
+class TestTrees:
+    def test_vptree_matches_scan(self):
+        rng = np.random.RandomState(4)
+        pts = rng.randn(300, 10)
+        tree = VPTree(pts)
+        for _ in range(5):
+            q = rng.randn(10)
+            idx, d = tree.search(q, 7)
+            want_idx, want_d = _linear_scan(pts, q, 7)
+            np.testing.assert_array_equal(np.sort(idx), np.sort(want_idx))
+            np.testing.assert_allclose(sorted(d), sorted(want_d), rtol=1e-9)
+
+    def test_vptree_radius(self):
+        rng = np.random.RandomState(5)
+        pts = rng.randn(200, 3)
+        tree = VPTree(pts)
+        q = pts[0]
+        idx, d = tree.search(q, k=0, max_distance=1.0)
+        all_d = np.linalg.norm(pts - q[None], axis=1)
+        want = set(np.nonzero(all_d <= 1.0)[0])
+        assert set(idx) == want
+
+    def test_kdtree_matches_scan(self):
+        rng = np.random.RandomState(6)
+        pts = rng.randn(250, 6)
+        tree = KDTree(pts)
+        for _ in range(5):
+            q = rng.randn(6)
+            idx, d = tree.knn(q, 5)
+            want_idx, _ = _linear_scan(pts, q, 5)
+            np.testing.assert_array_equal(np.sort(idx), np.sort(want_idx))
+
+    def test_kdtree_range(self):
+        rng = np.random.RandomState(7)
+        pts = rng.rand(100, 2)
+        tree = KDTree(pts)
+        got = set(tree.range_search([0.2, 0.2], [0.6, 0.6]))
+        want = set(np.nonzero(np.all((pts >= 0.2) & (pts <= 0.6), axis=1))[0])
+        assert got == want
+
+
+class TestReviewRegressions:
+    def test_vptree_cosine_matches_brute_ranking(self):
+        rng = np.random.RandomState(40)
+        pts = rng.randn(300, 8)
+        tree = VPTree(pts, distance="cosinesimilarity")
+        bf = BruteForceKNN(pts.astype(np.float32), distance="cosinesimilarity")
+        for _ in range(5):
+            q = rng.randn(8)
+            vi, _ = tree.search(q, 6)
+            bi, _ = bf.search(q.astype(np.float32), 6)
+            assert set(vi) == set(bi.tolist())
+
+    def test_negative_index_rejected(self):
+        pts = np.random.RandomState(41).randn(20, 4).astype(np.float32)
+        with pytest.raises(IndexError):
+            BruteForceKNN(pts).search_excluding_self(-1, 3)
+
+    def test_lsh_hash_length_bound(self):
+        pts = np.random.RandomState(42).randn(10, 4).astype(np.float32)
+        with pytest.raises(ValueError):
+            RandomProjectionLSH(pts, hash_length=40)
+
+    def test_server_non_dict_body_400(self):
+        import urllib.error
+        import urllib.request
+
+        pts = np.random.RandomState(43).randn(10, 4).astype(np.float32)
+        server = NearestNeighborsServer(pts, port=0).start()
+        try:
+            for body in (b"[1,2]", b'{"ndarray": -1, "k": 2}'):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}/knn", data=body,
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req)
+                assert ei.value.code == 400
+        finally:
+            server.stop()
+
+    def test_tsne_kl_is_true_divergence(self):
+        # with exaggeration still active at the end, kl_ must report the
+        # un-exaggerated KL (a proper divergence, modest magnitude)
+        rng = np.random.RandomState(44)
+        x = np.concatenate([rng.randn(20, 5) + 6, rng.randn(20, 5) - 6]) \
+            .astype(np.float32)
+        ts = Tsne(perplexity=8, max_iter=100, exaggeration_iters=250, seed=1)
+        ts.fit_transform(x)
+        assert 0 <= ts.kl_ < 10, ts.kl_
+
+
+class TestKMeans:
+    def test_separates_blobs(self):
+        rng = np.random.RandomState(8)
+        blobs = np.concatenate([
+            rng.randn(50, 4) + 10, rng.randn(50, 4) - 10,
+            rng.randn(50, 4) + np.array([10, -10, 10, -10])])
+        km = KMeans(k=3, max_iterations=50).fit(blobs)
+        labels = km.predict(blobs)
+        # each blob maps to a single cluster
+        for s in range(0, 150, 50):
+            assert len(set(labels[s:s + 50].tolist())) == 1
+        assert len(set(labels.tolist())) == 3
+
+    def test_convergence_cost_decreases(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(200, 5)
+        km = KMeans(k=4, max_iterations=30, variation_tolerance=None).fit(x)
+        assert km.cost_ is not None and np.isfinite(km.cost_)
+
+
+class TestLSH:
+    def test_bucket_recall(self):
+        rng = np.random.RandomState(10)
+        pts = rng.randn(500, 16).astype(np.float32)
+        lsh = RandomProjectionLSH(pts, hash_length=8)
+        q = pts[42] + 0.001 * rng.randn(16).astype(np.float32)
+        idx, d = lsh.search(q, 5)
+        assert 42 in idx  # near-duplicate must be found
+        assert np.all(np.diff(d) >= -1e-6)
+
+
+class TestServer:
+    def test_roundtrip(self):
+        rng = np.random.RandomState(11)
+        pts = rng.randn(60, 8).astype(np.float32)
+        server = NearestNeighborsServer(pts, port=0).start()
+        try:
+            client = NearestNeighborsClient(port=server.port)
+            assert client.health()["points"] == 60
+            res = client.knn(3, 4)
+            assert len(res) == 4 and all(r["index"] != 3 for r in res)
+            want_idx, _ = _linear_scan(pts, pts[3], 5)
+            got = {r["index"] for r in res}
+            assert got <= set(want_idx.tolist())
+            res2 = client.knn_new(pts[5].tolist(), 1)
+            assert res2[0]["index"] == 5
+        finally:
+            server.stop()
+
+
+class TestGraphWalks:
+    def _ring(self, n=10):
+        return Graph(n, [Edge(i, (i + 1) % n) for i in range(n)])
+
+    def test_csr_construction(self):
+        g = self._ring(6)
+        assert g.num_vertices() == 6
+        assert g.degree(0) == 2
+        assert set(g.neighbors(0).tolist()) == {1, 5}
+
+    def test_random_walks_valid(self):
+        g = self._ring(12)
+        walks = list(RandomWalkIterator(g, walk_length=8, seed=1))
+        assert len(walks) == 12
+        for w in walks:
+            assert len(w) == 9
+            for a, b in zip(w[:-1], w[1:]):
+                assert b in g.neighbors(a)
+
+    def test_disconnected_self_loop_and_exception(self):
+        g = Graph(3, [Edge(0, 1)])  # vertex 2 isolated
+        walks = {w[0]: w for w in RandomWalkIterator(g, 5, seed=2)}
+        assert np.all(walks[2] == 2)
+        with pytest.raises(NoEdgesException):
+            list(RandomWalkIterator(g, 5, seed=2, no_edge_handling="exception"))
+
+    def test_weighted_walks_favor_heavy_edges(self):
+        g = Graph(3, [Edge(0, 1, weight=1000.0, directed=True),
+                      Edge(0, 2, weight=0.001, directed=True),
+                      Edge(1, 0, directed=True), Edge(2, 0, directed=True)])
+        firsts = [w[1] for w in WeightedRandomWalkIterator(g, 1, seed=3)
+                  if w[0] == 0]
+        assert firsts[0] == 1
+
+    def test_edge_list_loader(self, tmp_path):
+        p = tmp_path / "edges.csv"
+        p.write_text("// comment\n0,1\n1,2\n2,0\n")
+        g = load_delimited_edges(str(p), 3)
+        assert g.num_edges() == 6  # undirected: both directions
+
+
+class TestDeepWalk:
+    def test_two_communities(self):
+        # two dense cliques joined by one bridge edge
+        rng = np.random.RandomState(12)
+        edges = []
+        for c, base in ((0, 0), (1, 8)):
+            for i in range(8):
+                for jj in range(i + 1, 8):
+                    edges.append(Edge(base + i, base + jj))
+        edges.append(Edge(0, 8))
+        g = Graph(16, edges)
+        dw = DeepWalk(vector_size=16, window_size=4, learning_rate=0.05,
+                      epochs=3, batch_size=256, seed=7)
+        dw.fit(g, walk_length=20)
+        intra = np.mean([dw.similarity(1, j) for j in range(2, 8)])
+        inter = np.mean([dw.similarity(1, j) for j in range(9, 16)])
+        assert intra > inter, (intra, inter)
+        near = [i for i, _ in dw.vertices_nearest(1, 5)]
+        assert sum(1 for i in near if i < 8) >= 3
+
+    def test_vector_shapes(self):
+        g = Graph(5, [Edge(i, (i + 1) % 5) for i in range(5)])
+        dw = DeepWalk(vector_size=8, epochs=1, seed=1)
+        dw.fit(g, walk_length=6)
+        assert dw.get_vertex_vector(0).shape == (8,)
+        assert dw.vectors.shape == (5, 8)
+
+
+class TestTsne:
+    def test_separates_clusters_and_reduces_kl(self):
+        rng = np.random.RandomState(13)
+        x = np.concatenate([rng.randn(40, 10) + 12, rng.randn(40, 10) - 12]) \
+            .astype(np.float32)
+        ts = Tsne(n_components=2, perplexity=15.0, max_iter=300,
+                  learning_rate=100.0, seed=3)
+        y = ts.fit_transform(x)
+        assert y.shape == (80, 2)
+        a, b = y[:40], y[40:]
+        centroid_gap = np.linalg.norm(a.mean(0) - b.mean(0))
+        spread = max(a.std(), b.std())
+        assert centroid_gap > 2 * spread, (centroid_gap, spread)
+        assert np.isfinite(ts.kl_)
+
+    def test_tiny_input_passthrough(self):
+        x = np.random.RandomState(14).randn(2, 5).astype(np.float32)
+        y = Tsne(n_components=2).fit_transform(x)
+        assert y.shape == (2, 2)
