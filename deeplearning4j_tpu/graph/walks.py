@@ -61,18 +61,27 @@ class RandomWalkIterator:
 
 class WeightedRandomWalkIterator(RandomWalkIterator):
     """``WeightedRandomWalkIterator.java`` — transition probability
-    proportional to edge weight."""
+    proportional to edge weight.
+
+    Vectorized like the uniform walker: one prefix-sum of all edge weights is
+    built lazily, then each step is a single ``searchsorted`` over the whole
+    batch (inverse-CDF sampling within each vertex's CSR slice)."""
 
     def _step(self, current: np.ndarray, rng: np.random.Generator) -> np.ndarray:
         g = self.graph
-        out = np.empty_like(current)
-        for i, v in enumerate(current):
-            w = g.neighbor_weights(v)
-            if len(w) == 0:
-                if self.no_edge_handling == "exception":
-                    raise NoEdgesException(f"Vertex {int(v)} has no edges")
-                out[i] = v
-                continue
-            p = w / w.sum()
-            out[i] = rng.choice(g.neighbors(v), p=p)
-        return out
+        if not hasattr(self, "_prefix"):
+            self._prefix = np.concatenate([[0.0], np.cumsum(g.weights)])
+        deg = g.offsets[current + 1] - g.offsets[current]
+        if self.no_edge_handling == "exception" and np.any(deg == 0):
+            raise NoEdgesException(
+                f"Vertex {int(current[np.argmax(deg == 0)])} has no edges")
+        if len(g.targets) == 0:
+            return current
+        lo = self._prefix[g.offsets[current]]
+        hi = self._prefix[g.offsets[current + 1]]
+        target = lo + rng.random(len(current)) * (hi - lo)
+        pos = np.searchsorted(self._prefix, target, side="right") - 1
+        pos = np.clip(pos, g.offsets[current],
+                      np.maximum(g.offsets[current + 1] - 1, g.offsets[current]))
+        return np.where(deg > 0, g.targets[np.minimum(pos, len(g.targets) - 1)],
+                        current)

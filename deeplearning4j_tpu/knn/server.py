@@ -26,9 +26,10 @@ from .brute import BruteForceKNN
 
 class NearestNeighborsServer:
     def __init__(self, points, distance: str = "euclidean", port: int = 9000,
-                 default_k: int = 5):
+                 default_k: int = 5, host: str = "127.0.0.1"):
         self.index = BruteForceKNN(points, distance=distance)
         self.port = port
+        self.host = host  # bind 0.0.0.0 to serve other hosts
         self.default_k = default_k
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -86,7 +87,7 @@ class NearestNeighborsServer:
         return Handler
 
     def start(self, background: bool = True):
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), self._handler())
+        self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler())
         self.port = self._httpd.server_address[1]  # resolves port=0
         if background:
             self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -64,6 +64,13 @@ class VPTree:
         median = float(np.median(d))
         inner = [i for i, di in zip(rest, d) if di < median]
         outer = [i for i, di in zip(rest, d) if di >= median]
+        if not inner or not outer:
+            # all distances tied at the median (duplicates / equidistant
+            # points): an empty side would recurse once per point and blow the
+            # stack. Any balanced split is valid — left holds d <= threshold,
+            # right d >= threshold, both true when every d == median.
+            mid = len(rest) // 2
+            inner, outer = rest[:mid], rest[mid:]
         return _Node(vp, median, self._build(inner), self._build(outer))
 
     def search(self, query, k: int, max_distance: Optional[float] = None

@@ -1,0 +1,10 @@
+"""Training UI & stats — deeplearning4j-ui-parent equivalent (SURVEY.md §2.6):
+StatsListener collection → StatsStorage (memory / sqlite file) → web dashboard
+with a remote-receiver endpoint for cluster jobs."""
+
+from .stats import StatsListener
+from .storage import BaseStatsStorage, FileStatsStorage, InMemoryStatsStorage
+from .server import RemoteStatsRouter, UIServer
+
+__all__ = ["BaseStatsStorage", "FileStatsStorage", "InMemoryStatsStorage",
+           "RemoteStatsRouter", "StatsListener", "UIServer"]

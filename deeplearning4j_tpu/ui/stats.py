@@ -1,0 +1,157 @@
+"""StatsListener — ``ui/stats/BaseStatsListener.java`` (783 LoC) equivalent.
+
+Collects per-iteration score + timing and (each ``frequency`` iterations)
+per-layer parameter/update statistics — mean magnitude, stddev, histogram —
+plus host memory and device info. Records go to a ``BaseStatsStorage`` via
+the router API, which the dashboard server subscribes to.
+
+TPU redesign: DL4J hooks onGradientCalculation inside its backprop loop.
+Our train step is one fused XLA program, so gradients aren't observable
+mid-step; updates are recovered from param deltas between reports, normalized
+to mean per-step magnitude (each entry records ``averaged_over_iterations``).
+The stats math runs as a jitted reduction per tensor — device programs per
+report, not one JNI call per layer per iteration like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..train.listeners import TrainingListener
+from .storage import BaseStatsStorage
+
+_HIST_BINS = 20
+
+
+def _flatten_names(tree, prefix="") -> Dict[str, jnp.ndarray]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_names(v, f"{prefix}{k}/"))
+    else:
+        out[prefix.rstrip("/")] = tree
+    return out
+
+
+def _histogram(x: np.ndarray) -> dict:
+    counts, edges = np.histogram(x, bins=_HIST_BINS)
+    return {"counts": counts.tolist(), "min": float(edges[0]),
+            "max": float(edges[-1])}
+
+
+class StatsListener(TrainingListener):
+    """Attach to ``Trainer.fit(listeners=[...])``; routes stats into storage.
+
+    Parity knobs (StatsUpdateConfiguration): collect histograms / mean
+    magnitudes for params and updates, reporting frequency.
+    """
+
+    def __init__(self, storage: BaseStatsStorage, session_id: Optional[str] = None,
+                 worker_id: str = "worker_0", frequency: int = 10,
+                 collect_histograms: bool = True):
+        self.storage = storage
+        self.session_id = session_id or f"session_{uuid.uuid4().hex[:8]}"
+        self.worker_id = worker_id
+        self.frequency = max(int(frequency), 1)
+        self.collect_histograms = collect_histograms
+        self._prev_params = None
+        self._last_time = None
+        self._initialized = False
+
+    # --- static (once): system/model info (BaseStatsListener initial report) ---
+    def _post_static(self, trainer):
+        devs = jax.devices()
+        model = trainer.model
+        record = {
+            "software": {
+                "python": platform.python_version(),
+                "jax": jax.__version__,
+                "backend": devs[0].platform if devs else "unknown",
+                "hostname": platform.node(),
+                "pid": os.getpid(),
+            },
+            "hardware": {
+                "device_count": len(devs),
+                "devices": [str(d) for d in devs],
+                "cpu_count": os.cpu_count(),
+            },
+            "model": {
+                "class": type(model).__name__,
+                "param_count": int(model.param_count()),
+                "config": json.loads(model.to_json()),
+            },
+            "start_time": time.time(),
+        }
+        self.storage.put_static_info(self.session_id, "StatsListener",
+                                     self.worker_id, record)
+        self._initialized = True
+
+    def iteration_done(self, trainer, iteration: int, epoch: int, loss: float):
+        if not self._initialized:
+            self._post_static(trainer)
+        now = time.time()
+        record = {
+            "iteration": iteration,
+            "epoch": epoch,
+            "score": float(loss),
+            "iteration_ms": None if self._last_time is None
+            else (now - self._last_time) * 1e3,
+        }
+        self._last_time = now
+        if iteration % self.frequency == 0:
+            self._cur_iteration = iteration
+            record.update(self._detail_stats(trainer))
+        self.storage.put_update(self.session_id, "StatsListener",
+                                self.worker_id, now, record)
+
+    def _detail_stats(self, trainer) -> dict:
+        params = trainer.params
+        flat = _flatten_names(params)
+        param_stats = {}
+        for name, leaf in flat.items():
+            mm, sd, mn, mx = (float(v) for v in jax.tree.leaves(_stat4(leaf)))
+            entry = {"mean_magnitude": mm, "std": sd, "min": mn, "max": mx}
+            if self.collect_histograms:
+                entry["histogram"] = _histogram(np.asarray(leaf).ravel())
+            param_stats[name] = entry
+        update_stats = {}
+        if self._prev_params is not None:
+            prev, prev_iter = self._prev_params
+            gap = max(self._cur_iteration - prev_iter, 1)
+            # delta spans `gap` iterations; normalize so the reported numbers
+            # are MEAN PER-STEP update magnitudes regardless of frequency
+            upd = jax.tree.map(lambda a, b: (np.asarray(a) - b) / gap, params, prev)
+            for name, leaf in _flatten_names(upd).items():
+                mm, sd, mn, mx = (float(v) for v in jax.tree.leaves(_stat4(leaf)))
+                entry = {"mean_magnitude": mm, "std": sd, "min": mn, "max": mx,
+                         "averaged_over_iterations": gap}
+                if self.collect_histograms:
+                    entry["histogram"] = _histogram(np.asarray(leaf).ravel())
+                update_stats[name] = entry
+        # snapshot to host numpy: the trainer's jitted step DONATES the param
+        # buffers, so holding device arrays across iterations would leave
+        # deleted arrays in our hands
+        self._prev_params = (jax.tree.map(np.asarray, params), self._cur_iteration)
+        mem = {}
+        try:
+            import resource
+
+            mem["max_rss_mb"] = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0
+        except ImportError:  # non-POSIX
+            pass
+        return {"params": param_stats, "updates": update_stats, "memory": mem}
+
+
+@jax.jit
+def _stat4(x):
+    return (jnp.mean(jnp.abs(x)), jnp.std(x), jnp.min(x), jnp.max(x))

@@ -1,0 +1,220 @@
+"""Stats storage — parity with the reference StatsStorage stack
+(``api/storage/StatsStorage.java`` in deeplearning4j-core, implementations in
+``deeplearning4j-ui-model/ui/storage/``).
+
+The reference persists SBE-encoded binary reports into MapDB/SQLite and
+exposes a pub/sub listener API the UI server subscribes to. Here records are
+JSON dicts keyed the same way — (session_id, type_id, worker_id, timestamp) —
+with an in-memory impl and a stdlib-sqlite3 impl (J7FileStatsStorage parity).
+JSON replaces SBE: stats records are small and off the training hot path, so
+wire compactness buys nothing on a TPU host.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class StatsStorageEvent:
+    def __init__(self, kind: str, session_id: str, type_id: str, worker_id: str,
+                 timestamp: float):
+        self.kind = kind  # new_session | new_worker | post_static | post_update
+        self.session_id = session_id
+        self.type_id = type_id
+        self.worker_id = worker_id
+        self.timestamp = timestamp
+
+
+class BaseStatsStorage:
+    """StatsStorage + StatsStorageRouter surface: put static/update records,
+    enumerate sessions/workers, subscribe to change events."""
+
+    def __init__(self):
+        self._listeners: List[Callable[[StatsStorageEvent], None]] = []
+        self._lock = threading.Lock()
+
+    # --- router (write) side ---
+    def put_static_info(self, session_id: str, type_id: str, worker_id: str,
+                        record: dict) -> None:
+        first = self._store_static(session_id, type_id, worker_id, record)
+        self._emit(StatsStorageEvent("new_session" if first else "post_static",
+                                     session_id, type_id, worker_id, time.time()))
+
+    def put_update(self, session_id: str, type_id: str, worker_id: str,
+                   timestamp: float, record: dict) -> None:
+        self._store_update(session_id, type_id, worker_id, timestamp, record)
+        self._emit(StatsStorageEvent("post_update", session_id, type_id,
+                                     worker_id, timestamp))
+
+    # --- read side ---
+    def list_sessions(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_workers(self, session_id: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_static_info(self, session_id: str, worker_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def get_updates(self, session_id: str, worker_id: str,
+                    since: float = 0.0) -> List[Tuple[float, dict]]:
+        raise NotImplementedError
+
+    def get_updates_desc(self, session_id: str, worker_id: str,
+                         limit: int = 50) -> List[dict]:
+        """Most-recent-first records, bounded — lets readers find the latest
+        detailed report without parsing the whole history."""
+        raise NotImplementedError
+
+    def latest_update(self, session_id: str, worker_id: str) -> Optional[dict]:
+        ups = self.get_updates_desc(session_id, worker_id, limit=1)
+        return ups[0] if ups else None
+
+    # --- pub/sub ---
+    def register_listener(self, fn: Callable[[StatsStorageEvent], None]) -> None:
+        self._listeners.append(fn)
+
+    def _emit(self, ev: StatsStorageEvent) -> None:
+        for fn in list(self._listeners):
+            fn(ev)
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStatsStorage(BaseStatsStorage):
+    """``ui/storage/InMemoryStatsStorage.java``."""
+
+    def __init__(self):
+        super().__init__()
+        self._static: Dict[Tuple[str, str], dict] = {}
+        self._updates: Dict[Tuple[str, str], List[Tuple[float, dict]]] = \
+            defaultdict(list)
+        self._sessions: List[str] = []
+
+    def _store_static(self, sid, tid, wid, record) -> bool:
+        with self._lock:
+            first = sid not in self._sessions
+            if first:
+                self._sessions.append(sid)
+            # record stored verbatim (no injected keys) — keeps the two
+            # storage backends byte-identical for the same puts
+            self._static[(sid, wid)] = dict(record)
+            return first
+
+    def _store_update(self, sid, tid, wid, ts, record):
+        with self._lock:
+            if sid not in self._sessions:
+                self._sessions.append(sid)
+            self._updates[(sid, wid)].append((ts, record))
+
+    def list_sessions(self):
+        with self._lock:
+            return list(self._sessions)
+
+    def list_workers(self, session_id):
+        with self._lock:
+            return sorted({w for (s, w) in
+                           set(self._static) | set(self._updates) if s == session_id})
+
+    def get_static_info(self, session_id, worker_id):
+        with self._lock:
+            return self._static.get((session_id, worker_id))
+
+    def get_updates(self, session_id, worker_id, since=0.0):
+        with self._lock:
+            return [(t, r) for t, r in self._updates.get((session_id, worker_id), [])
+                    if t >= since]
+
+    def get_updates_desc(self, session_id, worker_id, limit=50):
+        with self._lock:
+            ups = self._updates.get((session_id, worker_id), [])
+            return [r for _, r in sorted(ups, key=lambda p: -p[0])[:limit]]
+
+
+class FileStatsStorage(BaseStatsStorage):
+    """``ui/storage/sqlite/J7FileStatsStorage.java`` — sqlite3-backed,
+    survives process restarts; safe for one writer + many readers."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock, self._conn:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS static_info ("
+                "session_id TEXT, type_id TEXT, worker_id TEXT, record TEXT, "
+                "PRIMARY KEY (session_id, worker_id))")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS updates ("
+                "session_id TEXT, type_id TEXT, worker_id TEXT, "
+                "timestamp REAL, record TEXT)")
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_updates ON updates "
+                "(session_id, worker_id, timestamp)")
+
+    def _store_static(self, sid, tid, wid, record) -> bool:
+        with self._lock, self._conn:
+            # "first" means never seen in EITHER table (matches InMemory: an
+            # update-only session is already known, so no new_session event)
+            cur = self._conn.execute(
+                "SELECT 1 FROM static_info WHERE session_id=? "
+                "UNION SELECT 1 FROM updates WHERE session_id=? LIMIT 1",
+                (sid, sid))
+            first = cur.fetchone() is None
+            self._conn.execute(
+                "INSERT OR REPLACE INTO static_info VALUES (?,?,?,?)",
+                (sid, tid, wid, json.dumps(record)))
+            return first
+
+    def _store_update(self, sid, tid, wid, ts, record):
+        with self._lock, self._conn:
+            self._conn.execute("INSERT INTO updates VALUES (?,?,?,?,?)",
+                               (sid, tid, wid, ts, json.dumps(record)))
+
+    def list_sessions(self):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT session_id FROM static_info "
+                "UNION SELECT DISTINCT session_id FROM updates").fetchall()
+            return [r[0] for r in rows]
+
+    def list_workers(self, session_id):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT worker_id FROM updates WHERE session_id=? "
+                "UNION SELECT DISTINCT worker_id FROM static_info "
+                "WHERE session_id=?", (session_id, session_id)).fetchall()
+            return sorted(r[0] for r in rows)
+
+    def get_static_info(self, session_id, worker_id):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT record FROM static_info WHERE session_id=? AND worker_id=?",
+                (session_id, worker_id)).fetchone()
+            return json.loads(row[0]) if row else None
+
+    def get_updates(self, session_id, worker_id, since=0.0):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT timestamp, record FROM updates WHERE session_id=? AND "
+                "worker_id=? AND timestamp>=? ORDER BY timestamp",
+                (session_id, worker_id, since)).fetchall()
+            return [(t, json.loads(r)) for t, r in rows]
+
+    def get_updates_desc(self, session_id, worker_id, limit=50):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT record FROM updates WHERE session_id=? AND worker_id=? "
+                "ORDER BY timestamp DESC LIMIT ?",
+                (session_id, worker_id, limit)).fetchall()
+            return [json.loads(r[0]) for r in rows]
+
+    def close(self):
+        with self._lock:
+            self._conn.close()

@@ -120,6 +120,28 @@ class TestReviewRegressions:
             bi, _ = bf.search(q.astype(np.float32), 6)
             assert set(vi) == set(bi.tolist())
 
+    def test_vptree_many_duplicates_no_recursion_blowup(self):
+        # equidistant/duplicate points used to recurse once per point
+        pts = np.tile(np.eye(4), (500, 1))  # 2000 one-hot rows, all equidistant
+        tree = VPTree(pts)
+        idx, d = tree.search(np.array([1.0, 0, 0, 0]), 3)
+        assert len(idx) == 3
+        assert min(d) == 0.0
+
+    def test_weighted_walks_match_distribution(self):
+        # vectorized inverse-CDF sampling must follow edge weights
+        g = Graph(3, [Edge(0, 1, weight=3.0, directed=True),
+                      Edge(0, 2, weight=1.0, directed=True),
+                      Edge(1, 0, directed=True), Edge(2, 0, directed=True)])
+        from collections import Counter
+        counts = Counter()
+        for seed in range(300):
+            for w in WeightedRandomWalkIterator(g, 1, seed=seed):
+                if w[0] == 0:
+                    counts[int(w[1])] += 1
+        frac = counts[1] / (counts[1] + counts[2])
+        assert 0.65 < frac < 0.85, frac
+
     def test_negative_index_rejected(self):
         pts = np.random.RandomState(41).randn(20, 4).astype(np.float32)
         with pytest.raises(IndexError):

@@ -1,0 +1,148 @@
+"""UI/stats subsystem tests (SURVEY.md §2.6 parity: BaseStatsListener →
+StatsStorage → dashboard server, incl. the remote receiver path)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.iterators import ArrayIterator
+from deeplearning4j_tpu.nn.layers import Dense, Output
+from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+from deeplearning4j_tpu.train.trainer import Trainer
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   RemoteStatsRouter, StatsListener, UIServer)
+
+
+def _toy_trainer():
+    m = Sequential(NetConfig(updater={"type": "sgd", "learning_rate": 0.1}),
+                   [Dense(n_out=8, activation="relu"),
+                    Output(n_out=3, loss="mcxent", activation="softmax")], (5,))
+    m.init()
+    return Trainer(m)
+
+
+def _toy_data(n=32):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 5).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return ArrayIterator(x, y, batch_size=16)
+
+
+class TestStatsListener:
+    def test_collects_and_stores(self):
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, session_id="s1", frequency=2)
+        tr = _toy_trainer()
+        tr.fit(_toy_data(), epochs=2, listeners=[lst], prefetch=False)
+        assert storage.list_sessions() == ["s1"]
+        assert storage.list_workers("s1") == ["worker_0"]
+        static = storage.get_static_info("s1", "worker_0")
+        assert static["model"]["class"] == "Sequential"
+        assert static["model"]["param_count"] > 0
+        ups = storage.get_updates("s1", "worker_0")
+        assert len(ups) == 4  # 2 epochs x 2 batches
+        assert all("score" in r for _, r in ups)
+        detailed = [r for _, r in ups if "params" in r]
+        assert detailed, "frequency=2 must produce detailed reports"
+        d0 = detailed[0]
+        assert any(k.endswith("/w") for k in d0["params"])
+        some = next(iter(d0["params"].values()))
+        assert {"mean_magnitude", "std", "min", "max", "histogram"} <= set(some)
+        assert sum(some["histogram"]["counts"]) > 0
+        # updates recovered from param deltas appear from the 2nd report on
+        assert any(r["updates"] for r in detailed[1:]) or len(detailed) == 1
+
+    def test_events_emitted(self):
+        storage = InMemoryStatsStorage()
+        events = []
+        storage.register_listener(lambda ev: events.append(ev.kind))
+        lst = StatsListener(storage, session_id="s2", frequency=1)
+        tr = _toy_trainer()
+        tr.fit(_toy_data(), epochs=1, listeners=[lst], prefetch=False)
+        assert "new_session" in events and "post_update" in events
+
+
+class TestFileStorage:
+    def test_persists_across_reopen(self, tmp_path):
+        p = str(tmp_path / "stats.db")
+        st = FileStatsStorage(p)
+        st.put_static_info("sess", "T", "w0", {"a": 1})
+        st.put_update("sess", "T", "w0", 1.5, {"score": 0.5})
+        st.put_update("sess", "T", "w0", 2.5, {"score": 0.25})
+        st.close()
+        st2 = FileStatsStorage(p)
+        assert st2.list_sessions() == ["sess"]
+        assert st2.get_static_info("sess", "w0") == {"a": 1}
+        ups = st2.get_updates("sess", "w0")
+        assert [t for t, _ in ups] == [1.5, 2.5]
+        assert st2.get_updates("sess", "w0", since=2.0)[0][1]["score"] == 0.25
+        assert st2.latest_update("sess", "w0")["score"] == 0.25
+        st2.close()
+
+
+class TestUIServer:
+    def _get(self, port, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=10) as r:
+            ctype = r.headers.get("Content-Type", "")
+            body = r.read()
+            return json.loads(body) if "json" in ctype else body.decode()
+
+    def test_endpoints(self):
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, session_id="ui_sess", frequency=1)
+        tr = _toy_trainer()
+        tr.fit(_toy_data(), epochs=1, listeners=[lst], prefetch=False)
+        server = UIServer(storage, port=0).start()
+        try:
+            html = self._get(server.port, "/")
+            assert "Training sessions" in html
+            assert self._get(server.port, "/train/sessions") == ["ui_sess"]
+            ov = self._get(server.port, "/train/ui_sess/overview")
+            assert len(ov["scores"]) == 2
+            model = self._get(server.port, "/train/ui_sess/model")
+            assert model["static"]["model"]["class"] == "Sequential"
+            assert model["latest"]["params"]
+        finally:
+            server.stop()
+
+    def test_remote_receiver(self):
+        server = UIServer(port=0).start()
+        try:
+            router = RemoteStatsRouter(port=server.port)
+            router.put_static_info("remote_sess", "T", "rw", {"model": {"class": "X"}})
+            router.put_update("remote_sess", "T", "rw", 1.0,
+                              {"iteration": 0, "score": 1.25})
+            assert self._get(server.port, "/train/sessions") == ["remote_sess"]
+            ov = self._get(server.port, "/train/remote_sess/overview")
+            assert ov["scores"] == [1.25]
+        finally:
+            server.stop()
+
+    def test_remote_listener_end_to_end(self):
+        # StatsListener writing THROUGH the remote router into a live server —
+        # the Spark-job → dashboard path of the reference
+        server = UIServer(port=0).start()
+        try:
+            router = RemoteStatsRouter(port=server.port)
+            lst = StatsListener(router, session_id="r2", frequency=5)
+            tr = _toy_trainer()
+            tr.fit(_toy_data(), epochs=1, listeners=[lst], prefetch=False)
+            ov = self._get(server.port, "/train/r2/overview")
+            assert len(ov["scores"]) == 2
+        finally:
+            server.stop()
+
+    def test_bad_remote_payload_400(self):
+        server = UIServer(port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/remote", data=b"[]",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 400
+        finally:
+            server.stop()
