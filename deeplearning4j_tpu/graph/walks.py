@@ -72,16 +72,19 @@ class WeightedRandomWalkIterator(RandomWalkIterator):
         if not hasattr(self, "_prefix"):
             self._prefix = np.concatenate([[0.0], np.cumsum(g.weights)])
         deg = g.offsets[current + 1] - g.offsets[current]
-        if self.no_edge_handling == "exception" and np.any(deg == 0):
+        lo = self._prefix[g.offsets[current]] if len(g.targets) else np.zeros(0)
+        hi = self._prefix[g.offsets[current + 1]] if len(g.targets) else lo
+        # zero total weight is as stuck as zero degree: same handling
+        stuck = (deg == 0) if len(g.targets) == 0 else (hi - lo <= 0)
+        if self.no_edge_handling == "exception" and np.any(stuck):
             raise NoEdgesException(
-                f"Vertex {int(current[np.argmax(deg == 0)])} has no edges")
+                f"Vertex {int(current[np.argmax(stuck)])} has no traversable "
+                f"edges (zero degree or zero total weight)")
         if len(g.targets) == 0:
             return current
-        lo = self._prefix[g.offsets[current]]
-        hi = self._prefix[g.offsets[current + 1]]
         target = lo + rng.random(len(current)) * (hi - lo)
         pos = np.searchsorted(self._prefix, target, side="right") - 1
         pos = np.clip(pos, g.offsets[current],
                       np.maximum(g.offsets[current + 1] - 1, g.offsets[current]))
-        return np.where(deg > 0, g.targets[np.minimum(pos, len(g.targets) - 1)],
+        return np.where(~stuck, g.targets[np.minimum(pos, len(g.targets) - 1)],
                         current)

@@ -15,56 +15,42 @@ A ``NearestNeighborsClient`` mirror lives in ``client.py``.
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
 
 import numpy as np
 
+from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
 from .brute import BruteForceKNN
 
 
-class NearestNeighborsServer:
+class NearestNeighborsServer(JsonHTTPServerMixin):
     def __init__(self, points, distance: str = "euclidean", port: int = 9000,
                  default_k: int = 5, host: str = "127.0.0.1"):
         self.index = BruteForceKNN(points, distance=distance)
         self.port = port
         self.host = host  # bind 0.0.0.0 to serve other hosts
         self.default_k = default_k
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
 
     def _handler(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
-
-            def _reply(self, code: int, payload: dict):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+        class Handler(JsonRequestHandler):
+            owner = server
 
             def do_GET(self):
                 if self.path == "/health":
-                    self._reply(200, {"status": "ok",
-                                      "points": int(server.index.points.shape[0])})
+                    self.reply(200, {"status": "ok",
+                                     "points": int(server.index.points.shape[0])})
                 else:
-                    self._reply(404, {"error": "unknown endpoint"})
+                    self.reply(404, {"error": "unknown endpoint"})
 
             def do_POST(self):
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
+                    req = self.read_json()
                     k = int(req.get("k", server.default_k))
                     if self.path == "/knn":
                         row = int(req["ndarray"])
                         idx, d = server.index.search_excluding_self(row, k)
-                        self._reply(200, {"results": [
+                        self.reply(200, {"results": [
                             {"index": int(i), "distance": float(x)}
                             for i, x in zip(idx, d)]})
                     elif self.path == "/knnnew":
@@ -72,33 +58,16 @@ class NearestNeighborsServer:
                         if arr.ndim == 1:
                             arr = arr[None]
                         idx, d = server.index.search(arr, k)
-                        self._reply(200, {"results": [[
+                        self.reply(200, {"results": [[
                             {"index": int(i), "distance": float(x)}
                             for i, x in zip(row_i, row_d)]
                             for row_i, row_d in zip(idx, d)]})
                     else:
-                        self._reply(404, {"error": "unknown endpoint"})
+                        self.reply(404, {"error": "unknown endpoint"})
                 except (KeyError, ValueError, IndexError, TypeError,
                         AttributeError, json.JSONDecodeError) as e:
-                    self._reply(400, {"error": str(e)})
+                    self.reply(400, {"error": str(e)})
                 except Exception as e:  # unexpected: surface as 500, keep serving
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    self.reply(500, {"error": f"{type(e).__name__}: {e}"})
 
         return Handler
-
-    def start(self, background: bool = True):
-        self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler())
-        self.port = self._httpd.server_address[1]  # resolves port=0
-        if background:
-            self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                            daemon=True)
-            self._thread.start()
-        else:
-            self._httpd.serve_forever()
-        return self
-
-    def stop(self):
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None

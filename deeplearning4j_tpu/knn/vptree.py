@@ -64,11 +64,15 @@ class VPTree:
         median = float(np.median(d))
         inner = [i for i, di in zip(rest, d) if di < median]
         outer = [i for i, di in zip(rest, d) if di >= median]
+        if not inner:
+            # median == min (ties at the bottom): move ties left so both
+            # invariants still hold (left d <= threshold, right d >= threshold)
+            inner = [i for i, di in zip(rest, d) if di <= median]
+            outer = [i for i, di in zip(rest, d) if di > median]
         if not inner or not outer:
-            # all distances tied at the median (duplicates / equidistant
-            # points): an empty side would recurse once per point and blow the
-            # stack. Any balanced split is valid — left holds d <= threshold,
-            # right d >= threshold, both true when every d == median.
+            # every distance equals the median (duplicate/equidistant points):
+            # an empty side would recurse once per point and blow the stack;
+            # any balanced split keeps both invariants since all d == threshold
             mid = len(rest) // 2
             inner, outer = rest[:mid], rest[mid:]
         return _Node(vp, median, self._build(inner), self._build(outer))

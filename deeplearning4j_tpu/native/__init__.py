@@ -1,0 +1,8 @@
+"""Native C++ runtime — IO/ETL off the Python GIL (SURVEY.md §2.11: the
+reference's native layer is libnd4j/JavaCPP artifacts; compute maps to
+XLA, but the host-side data plumbing is re-implemented here in C++17)."""
+
+from .build import available, build
+from .io import NativeBatchIterator, read_csv, read_idx
+
+__all__ = ["available", "build", "NativeBatchIterator", "read_csv", "read_idx"]

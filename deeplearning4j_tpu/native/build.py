@@ -1,0 +1,47 @@
+"""Build the native IO library (g++ → shared object), cached by source mtime.
+
+The reference ships its native layer as prebuilt Maven artifacts (libnd4j via
+JavaCPP); here the single-TU C++17 library compiles in ~2s on first use and
+is cached beside the package (or in $DL4J_TPU_CACHE)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).parent / "csrc" / "dl4j_io.cpp"
+
+
+def _lib_path() -> Path:
+    cache = os.environ.get("DL4J_TPU_CACHE")
+    base = Path(cache) if cache else Path(__file__).parent / "_build"
+    return base / "libdl4j_io.so"
+
+
+def build(force: bool = False) -> Optional[Path]:
+    """Compile if stale; returns the .so path or None when no toolchain."""
+    lib = _lib_path()
+    if not force and lib.exists() and lib.stat().st_mtime >= _SRC.stat().st_mtime:
+        return lib
+    lib.parent.mkdir(parents=True, exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           str(_SRC), "-o", str(lib)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        err = getattr(e, "stderr", b"")
+        raise RuntimeError(
+            f"native build failed: {' '.join(cmd)}\n"
+            f"{err.decode() if isinstance(err, bytes) else err}") from e
+    return lib
+
+
+def available() -> bool:
+    try:
+        return build() is not None
+    except RuntimeError:
+        return False

@@ -1,0 +1,324 @@
+// Native IO/ETL runtime — C++ equivalent of the reference's native-backed
+// data plumbing (SURVEY.md §2.1 dataset iterators, §2.11 DataVec):
+//  - Batcher: background-thread shuffled batch assembly with a bounded
+//    buffer ring == AsyncDataSetIterator (deeplearning4j-nn
+//    datasets/iterator/AsyncDataSetIterator.java) + the multi-consumer
+//    FancyBlockingQueue idea, off the Python GIL.
+//  - CSV reader == DataVec CSVRecordReader fast path.
+//  - IDX reader == deeplearning4j-core datasets/mnist/MnistDbFile custom
+//    binary reader.
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread dl4j_io.cpp -o libdl4j_io.so
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<float> feats;
+  std::vector<float> labels;
+  int64_t rows;
+};
+
+struct Batcher {
+  // immutable after construction
+  std::vector<float> feats;   // (n, feat_dim) row-major copy
+  std::vector<float> labels;  // (n, label_dim)
+  int64_t n, feat_dim, label_dim, batch_size;
+  bool shuffle, drop_last;
+  size_t queue_depth;
+
+  // worker state
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::deque<Batch> queue;
+  bool epoch_done = false;   // producer finished current epoch
+  bool stop = false;
+  uint64_t seed = 0;
+  uint64_t epoch_counter = 0;  // bumped by reset(); producer runs one epoch per bump
+  uint64_t produced_epochs = 0;
+
+  // Produces one epoch of batches. Aborts early (returning false) when the
+  // consumer reset() mid-epoch (epoch_counter moved past my_gen) so stale
+  // old-epoch batches never land in the freshly cleared queue.
+  bool produce_epoch(uint64_t ep_seed, uint64_t my_gen) {
+    std::vector<int64_t> order(n);
+    for (int64_t i = 0; i < n; ++i) order[i] = i;
+    if (shuffle) {
+      std::mt19937_64 rng(ep_seed);
+      for (int64_t i = n - 1; i > 0; --i) {
+        std::uniform_int_distribution<int64_t> d(0, i);
+        std::swap(order[i], order[d(rng)]);
+      }
+    }
+    for (int64_t start = 0; start < n; start += batch_size) {
+      int64_t rows = std::min(batch_size, n - start);
+      if (rows < batch_size && drop_last) break;
+      Batch b;
+      b.rows = rows;
+      b.feats.resize(static_cast<size_t>(rows) * feat_dim);
+      b.labels.resize(static_cast<size_t>(rows) * label_dim);
+      for (int64_t r = 0; r < rows; ++r) {
+        int64_t src = order[start + r];
+        std::memcpy(b.feats.data() + r * feat_dim, feats.data() + src * feat_dim,
+                    sizeof(float) * feat_dim);
+        std::memcpy(b.labels.data() + r * label_dim,
+                    labels.data() + src * label_dim, sizeof(float) * label_dim);
+      }
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] {
+          return queue.size() < queue_depth || stop || epoch_counter != my_gen;
+        });
+        if (stop) return false;
+        if (epoch_counter != my_gen) return false;  // reset() superseded us
+        queue.push_back(std::move(b));
+      }
+      cv_get.notify_one();
+    }
+    return true;
+  }
+
+  void run() {
+    for (;;) {
+      uint64_t my_epoch, my_seed, my_gen;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] { return stop || produced_epochs < epoch_counter; });
+        if (stop) return;
+        // always produce the NEWEST requested epoch; intermediate requests
+        // (rapid reset() calls) are skipped, matching the consumer's intent
+        my_epoch = epoch_counter - 1;
+        my_gen = epoch_counter;
+        my_seed = seed + my_epoch;
+      }
+      bool completed = produce_epoch(my_seed, my_gen);
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        if (stop) return;
+        // an aborted epoch is abandoned; catch produced_epochs up to the
+        // generation we were producing so the next wait starts the new one
+        produced_epochs = my_epoch + 1;
+        if (completed && produced_epochs == epoch_counter) epoch_done = true;
+      }
+      cv_get.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* batcher_create(const float* feats, const float* labels, int64_t n,
+                     int64_t feat_dim, int64_t label_dim, int64_t batch_size,
+                     int shuffle, uint64_t seed, int queue_depth,
+                     int drop_last) {
+  if (n <= 0 || feat_dim <= 0 || label_dim <= 0 || batch_size <= 0)
+    return nullptr;
+  auto* b = new Batcher();
+  b->feats.assign(feats, feats + n * feat_dim);
+  b->labels.assign(labels, labels + n * label_dim);
+  b->n = n;
+  b->feat_dim = feat_dim;
+  b->label_dim = label_dim;
+  b->batch_size = batch_size;
+  b->shuffle = shuffle != 0;
+  b->drop_last = drop_last != 0;
+  b->queue_depth = queue_depth > 0 ? static_cast<size_t>(queue_depth) : 4;
+  b->seed = seed;
+  b->epoch_counter = 1;  // start producing the first epoch immediately
+  b->worker = std::thread([b] { b->run(); });
+  return b;
+}
+
+// Returns rows copied (>0), or 0 when the current epoch is exhausted.
+int64_t batcher_next(void* h, float* feat_out, float* label_out) {
+  auto* b = static_cast<Batcher*>(h);
+  Batch batch;
+  {
+    std::unique_lock<std::mutex> lk(b->mu);
+    b->cv_get.wait(lk, [&] {
+      return !b->queue.empty() ||
+             (b->epoch_done && b->produced_epochs == b->epoch_counter) ||
+             b->stop;
+    });
+    if (b->stop) return -1;
+    if (b->queue.empty()) return 0;  // epoch exhausted
+    batch = std::move(b->queue.front());
+    b->queue.pop_front();
+  }
+  b->cv_put.notify_one();
+  std::memcpy(feat_out, batch.feats.data(), batch.feats.size() * sizeof(float));
+  std::memcpy(label_out, batch.labels.data(),
+              batch.labels.size() * sizeof(float));
+  return batch.rows;
+}
+
+// Begin a new epoch (optionally reshuffled with seed+epoch).
+void batcher_reset(void* h) {
+  auto* b = static_cast<Batcher*>(h);
+  {
+    std::unique_lock<std::mutex> lk(b->mu);
+    b->queue.clear();
+    b->epoch_done = false;
+    b->epoch_counter += 1;
+  }
+  b->cv_put.notify_all();
+}
+
+int64_t batcher_num_batches(void* h) {
+  auto* b = static_cast<Batcher*>(h);
+  return b->drop_last ? b->n / b->batch_size
+                      : (b->n + b->batch_size - 1) / b->batch_size;
+}
+
+void batcher_destroy(void* h) {
+  auto* b = static_cast<Batcher*>(h);
+  {
+    std::unique_lock<std::mutex> lk(b->mu);
+    b->stop = true;
+  }
+  b->cv_put.notify_all();
+  b->cv_get.notify_all();
+  if (b->worker.joinable()) b->worker.join();
+  delete b;
+}
+
+// ---------- CSV (DataVec CSVRecordReader fast path) ----------
+
+// Count data rows (excluding skipped header). Returns -1 on open failure.
+int64_t csv_count_rows(const char* path, int skip_header) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t rows = 0;
+  int c, prev = '\n';
+  while ((c = std::fgetc(f)) != EOF) {
+    if (c == '\n') ++rows;
+    prev = c;
+  }
+  if (prev != '\n') ++rows;  // unterminated last line
+  std::fclose(f);
+  return rows - (skip_header ? 1 : 0);
+}
+
+// Parse into out (rows*cols float32, row-major). Returns rows parsed, <0 on error.
+int64_t csv_read(const char* path, char delim, int skip_header, float* out,
+                 int64_t max_rows, int64_t cols) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<char> buf(static_cast<size_t>(size) + 1);
+  size_t rd = std::fread(buf.data(), 1, static_cast<size_t>(size), f);
+  std::fclose(f);
+  buf[rd] = '\0';
+
+  char* p = buf.data();
+  char* end = buf.data() + rd;
+  if (skip_header) {
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;
+  }
+  int64_t row = 0;
+  while (p < end && row < max_rows) {
+    // skip blank lines
+    if (*p == '\n' || *p == '\r') {
+      ++p;
+      continue;
+    }
+    for (int64_t c = 0; c < cols; ++c) {
+      char* next = nullptr;
+      out[row * cols + c] = std::strtof(p, &next);
+      if (next == p) return -2;  // parse failure
+      p = next;
+      if (c + 1 < cols) {
+        if (*p != delim) return -3;  // wrong column count
+        ++p;
+      }
+    }
+    while (p < end && *p != '\n') ++p;  // tolerate trailing \r / spaces
+    if (p < end) ++p;
+    ++row;
+  }
+  return row;
+}
+
+// ---------- IDX / MNIST binary (MnistDbFile parity) ----------
+
+static uint32_t be32(const unsigned char* b) {
+  return (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) |
+         (uint32_t(b[2]) << 8) | uint32_t(b[3]);
+}
+
+// Reads header: dims_out[0]=ndim, dims_out[1..ndim]=sizes (caller provides
+// >= 5 slots; IDX ndim is validated to <= 4). Returns 0 ok.
+int idx_read_header(const char* path, int64_t* dims_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char hdr[4];
+  if (std::fread(hdr, 1, 4, f) != 4 || hdr[0] != 0 || hdr[1] != 0) {
+    std::fclose(f);
+    return -2;
+  }
+  int ndim = hdr[3];
+  if (ndim < 1 || ndim > 4) {  // bounds-check the file-supplied byte: the
+    std::fclose(f);            // caller's buffer is fixed-size
+    return -4;
+  }
+  dims_out[0] = ndim;
+  for (int i = 0; i < ndim; ++i) {
+    unsigned char d[4];
+    if (std::fread(d, 1, 4, f) != 4) {
+      std::fclose(f);
+      return -3;
+    }
+    dims_out[1 + i] = be32(d);
+  }
+  std::fclose(f);
+  return 0;
+}
+
+// Read count u8 elements into float32 out; normalize divides by 255.
+int idx_read_f32(const char* path, float* out, int64_t count, int normalize) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char hdr[4];
+  if (std::fread(hdr, 1, 4, f) != 4) {
+    std::fclose(f);
+    return -2;
+  }
+  int ndim = hdr[3];
+  std::fseek(f, 4 + 4 * ndim, SEEK_SET);
+  const int64_t CHUNK = 1 << 20;
+  std::vector<unsigned char> buf(CHUNK);
+  int64_t done = 0;
+  float scale = normalize ? 1.0f / 255.0f : 1.0f;
+  while (done < count) {
+    int64_t want = std::min(CHUNK, count - done);
+    size_t got = std::fread(buf.data(), 1, static_cast<size_t>(want), f);
+    if (got == 0) {
+      std::fclose(f);
+      return -3;
+    }
+    for (size_t i = 0; i < got; ++i) out[done + i] = buf[i] * scale;
+    done += static_cast<int64_t>(got);
+  }
+  std::fclose(f);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,163 @@
+"""ctypes bindings over the native IO runtime (csrc/dl4j_io.cpp).
+
+- ``NativeBatchIterator`` — AsyncDataSetIterator equivalent: a C++ worker
+  thread assembles shuffled batches into a bounded ring off the Python GIL
+  (the reference uses a Java prefetch thread,
+  ``datasets/iterator/AsyncDataSetIterator.java``).
+- ``read_csv`` — DataVec CSVRecordReader fast path.
+- ``read_idx`` — MNIST/EMNIST IDX binary reader (``datasets/mnist/MnistDbFile``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.iterators import DataSet, DataSetIterator
+from .build import build
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        path = build()
+        lib = ctypes.CDLL(str(path))
+        lib.batcher_create.restype = ctypes.c_void_p
+        lib.batcher_create.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+        lib.batcher_next.restype = ctypes.c_int64
+        lib.batcher_next.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.POINTER(ctypes.c_float)]
+        lib.batcher_reset.argtypes = [ctypes.c_void_p]
+        lib.batcher_num_batches.restype = ctypes.c_int64
+        lib.batcher_num_batches.argtypes = [ctypes.c_void_p]
+        lib.batcher_destroy.argtypes = [ctypes.c_void_p]
+        lib.csv_count_rows.restype = ctypes.c_int64
+        lib.csv_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.csv_read.restype = ctypes.c_int64
+        lib.csv_read.argtypes = [ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                                 ctypes.POINTER(ctypes.c_float),
+                                 ctypes.c_int64, ctypes.c_int64]
+        lib.idx_read_header.restype = ctypes.c_int
+        lib.idx_read_header.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_int64)]
+        lib.idx_read_f32.restype = ctypes.c_int
+        lib.idx_read_f32.argtypes = [ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.c_int64, ctypes.c_int]
+        _lib = lib
+    return _lib
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class NativeBatchIterator(DataSetIterator):
+    """Shuffled minibatch iterator whose batch assembly runs on a C++ thread.
+
+    Epoch semantics match ArrayIterator: one pass per ``__iter__``; ``reset``
+    (or re-iterating) starts a reshuffled epoch with seed+epoch.
+    """
+
+    def __init__(self, features, labels, batch_size: int = 32,
+                 shuffle: bool = True, seed: int = 12345, queue_depth: int = 4,
+                 drop_last: bool = False):
+        lib = _load()
+        f = np.ascontiguousarray(features, np.float32)
+        l = np.ascontiguousarray(labels, np.float32)
+        assert f.shape[0] == l.shape[0], "feature/label row mismatch"
+        self._feat_shape = f.shape[1:]
+        self._label_shape = l.shape[1:]
+        n = f.shape[0]
+        self._feat_dim = int(np.prod(self._feat_shape)) if self._feat_shape else 1
+        self._label_dim = int(np.prod(self._label_shape)) if self._label_shape else 1
+        self._bs = batch_size
+        self._h = lib.batcher_create(
+            _fptr(f.reshape(n, -1)), _fptr(l.reshape(n, -1)),
+            n, self._feat_dim, self._label_dim, batch_size,
+            1 if shuffle else 0, seed, queue_depth, 1 if drop_last else 0)
+        if not self._h:
+            raise ValueError("batcher_create failed (empty input?)")
+        self._lib = lib
+        self._fresh = True  # epoch 1 is produced eagerly at create
+
+    @property
+    def batch_size(self):
+        return self._bs
+
+    def __len__(self):
+        return int(self._lib.batcher_num_batches(self._h))
+
+    def reset(self):
+        self._lib.batcher_reset(self._h)
+        self._fresh = True
+
+    def __iter__(self):
+        if not self._fresh:
+            self.reset()
+        self._fresh = False
+        fbuf = np.empty((self._bs, self._feat_dim), np.float32)
+        lbuf = np.empty((self._bs, self._label_dim), np.float32)
+        while True:
+            rows = self._lib.batcher_next(self._h, _fptr(fbuf), _fptr(lbuf))
+            if rows <= 0:
+                return
+            f = fbuf[:rows].reshape((rows,) + self._feat_shape).copy()
+            l = lbuf[:rows].reshape((rows,) + self._label_shape).copy()
+            yield DataSet(f, l)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.batcher_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def read_csv(path: str, delim: str = ",", skip_header: bool = False,
+             cols: Optional[int] = None) -> np.ndarray:
+    """Parse a numeric CSV into (rows, cols) float32 via the native reader."""
+    lib = _load()
+    rows = lib.csv_count_rows(path.encode(), 1 if skip_header else 0)
+    if rows < 0:
+        raise FileNotFoundError(path)
+    if cols is None:
+        with open(path) as f:
+            if skip_header:
+                f.readline()
+            first = f.readline()
+        cols = first.count(delim) + 1
+    out = np.empty((rows, cols), np.float32)
+    got = lib.csv_read(path.encode(), delim.encode(),
+                       1 if skip_header else 0, _fptr(out), rows, cols)
+    if got < 0:
+        raise ValueError(f"csv parse error {got} in {path}")
+    return out[:got]
+
+
+def read_idx(path: str, normalize: bool = True) -> np.ndarray:
+    """Read an IDX (MNIST-format) file into float32, optionally /255."""
+    lib = _load()
+    dims = (ctypes.c_int64 * 5)()
+    rc = lib.idx_read_header(path.encode(), dims)
+    if rc != 0:
+        raise ValueError(f"bad idx file {path} (rc={rc})")
+    shape = tuple(int(dims[1 + i]) for i in range(int(dims[0])))
+    out = np.empty(int(np.prod(shape)), np.float32)
+    rc = lib.idx_read_f32(path.encode(), _fptr(out), out.size,
+                          1 if normalize else 0)
+    if rc != 0:
+        raise ValueError(f"idx read error {rc} in {path}")
+    return out.reshape(shape)

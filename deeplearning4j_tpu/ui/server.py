@@ -4,9 +4,10 @@ equivalent on stdlib ``http.server`` (no Play framework, no extra deps).
 
 Endpoints:
 - GET  /                              — dashboard (inline HTML+SVG charts:
-  score curve, iteration timing, per-layer param/update mean-magnitudes)
+  per-worker score curve, iteration timing, layer param tables)
 - GET  /train/sessions                — JSON session ids
-- GET  /train/<sid>/overview          — score + timing series
+- GET  /train/<sid>/overview?since=T  — per-worker score + timing series,
+  incremental (only records with timestamp >= T)
 - GET  /train/<sid>/model             — static info + latest per-layer stats
 - POST /remote                        — remote stats receiver: JSON
   {"kind": "static"|"update", "session_id", "worker_id", ...} pushed from
@@ -16,11 +17,10 @@ Endpoints:
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
+from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
 from .storage import BaseStatsStorage, InMemoryStatsStorage
 
 _DASH_HTML = """<!DOCTYPE html>
@@ -28,7 +28,7 @@ _DASH_HTML = """<!DOCTYPE html>
 <style>
 body{font-family:sans-serif;margin:20px;background:#fafafa}
 .chart{background:#fff;border:1px solid #ddd;margin:10px;padding:10px;display:inline-block}
-h3{margin:4px}
+h3,h4{margin:4px}
 </style></head>
 <body>
 <h2>Training sessions</h2><div id="root"></div>
@@ -37,7 +37,7 @@ async function j(u){const r=await fetch(u);return r.json()}
 function esc(s){return String(s).replace(/[&<>"']/g,
   c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function poly(xs,ys,w,h,color){
-  if(ys.length<2)return '';
+  if(ys.length<2)return '<i>collecting…</i>';
   const xmin=Math.min(...xs),xmax=Math.max(...xs),ymin=Math.min(...ys),ymax=Math.max(...ys);
   const sx=x=>(x-xmin)/Math.max(xmax-xmin,1e-9)*(w-40)+30;
   const sy=y=>h-20-(y-ymin)/Math.max(ymax-ymin,1e-9)*(h-40);
@@ -45,29 +45,40 @@ function poly(xs,ys,w,h,color){
   return `<svg width=${w} height=${h}><polyline fill=none stroke=${color} stroke-width=1.5 points="${pts}"/>`+
     `<text x=2 y=12 font-size=10>${ymax.toPrecision(4)}</text><text x=2 y=${h-8} font-size=10>${ymin.toPrecision(4)}</text></svg>`;
 }
-const state={};  // sid -> {since, iterations, scores, ms} incremental caches
+const state={};  // sid -> wid -> {since, iters, scores, ms} incremental caches
 async function render(){
   const sessions=await j('/train/sessions');const root=document.getElementById('root');
   let html='';
   for(const sid of sessions){
-    if(!state[sid])state[sid]={since:0,iterations:[],scores:[],ms:[]};
-    const st=state[sid];
-    const ov=await j('/train/'+encodeURIComponent(sid)+'/overview?since='+st.since);
-    st.iterations.push(...ov.iterations);st.scores.push(...ov.scores);
-    st.ms.push(...ov.iteration_ms);
-    if(ov.last_timestamp)st.since=ov.last_timestamp+1e-6;
+    if(!state[sid])state[sid]={};
+    const ws=state[sid];
+    const mins=Object.values(ws).map(w=>w.since);
+    const since=mins.length?Math.min(...mins):0;
+    const ov=await j('/train/'+encodeURIComponent(sid)+'/overview?since='+since);
+    for(const[wid,series]of Object.entries(ov.workers)){
+      if(!ws[wid])ws[wid]={since:0,iters:[],scores:[],ms:[]};
+      const st=ws[wid];
+      series.timestamps.forEach((t,i)=>{
+        if(t>st.since){st.iters.push(series.iterations[i]);
+          st.scores.push(series.scores[i]);st.ms.push(series.iteration_ms[i]);}
+      });
+      if(series.timestamps.length)
+        st.since=Math.max(st.since,series.timestamps[series.timestamps.length-1]);
+    }
     html+=`<h3>${esc(sid)}</h3>`;
-    html+=`<div class=chart><h3>score</h3>${poly(st.iterations,st.scores,420,200,'#d62728')}</div>`;
-    if(st.ms.some(v=>v!=null)){
-      const it=st.iterations.filter((_,i)=>st.ms[i]!=null);
-      const ms=st.ms.filter(v=>v!=null);
-      html+=`<div class=chart><h3>iteration ms</h3>${poly(it,ms,420,200,'#1f77b4')}</div>`;
+    for(const[wid,st]of Object.entries(ws)){
+      html+=`<div class=chart><h4>${esc(wid)} score</h4>${poly(st.iters,st.scores,420,180,'#d62728')}</div>`;
+      const it=st.iters.filter((_,i)=>st.ms[i]!=null), ms=st.ms.filter(v=>v!=null);
+      if(ms.length>1)
+        html+=`<div class=chart><h4>${esc(wid)} iteration ms</h4>${poly(it,ms,420,180,'#1f77b4')}</div>`;
     }
     const model=await j('/train/'+encodeURIComponent(sid)+'/model');
     if(model.latest&&model.latest.params){
-      html+=`<div class=chart><h3>param mean magnitude (latest)</h3><table border=0>`;
-      for(const[k,v]of Object.entries(model.latest.params))
-        html+=`<tr><td>${esc(k)}</td><td>${esc(v.mean_magnitude.toExponential(3))}</td></tr>`;
+      html+=`<div class=chart><h4>param mean magnitude (latest)</h4><table border=0>`;
+      for(const[k,v]of Object.entries(model.latest.params)){
+        const mm=v.mean_magnitude==null?'n/a (non-finite)':v.mean_magnitude.toExponential(3);
+        html+=`<tr><td>${esc(k)}</td><td>${esc(mm)}</td></tr>`;
+      }
       html+='</table></div>';
     }
   }
@@ -77,7 +88,7 @@ render();setInterval(render,5000);
 </script></body></html>"""
 
 
-class UIServer:
+class UIServer(JsonHTTPServerMixin):
     """``UIServer.getInstance().attach(storage)`` parity."""
 
     def __init__(self, storage: Optional[BaseStatsStorage] = None, port: int = 9001,
@@ -85,27 +96,27 @@ class UIServer:
         self.storage = storage or InMemoryStatsStorage()
         self.port = port
         self.host = host  # bind 0.0.0.0 for the cross-host remote-receiver path
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
 
     def attach(self, storage: BaseStatsStorage) -> "UIServer":
         self.storage = storage
         return self
 
     def _overview(self, sid: str, since: float = 0.0) -> dict:
-        """Score/timing series; ``since`` makes polling incremental —
-        O(new records), not O(history)."""
-        iters, scores, ms = [], [], []
-        last_t = None
+        """Per-worker score/timing series (workers are separate runs and must
+        not be interleaved into one line); ``since`` keeps polling O(new)."""
+        workers = {}
         for wid in self.storage.list_workers(sid):
+            ts, iters, scores, ms = [], [], [], []
             for t, rec in self.storage.get_updates(sid, wid, since=since):
                 if "score" in rec:
+                    ts.append(t)
                     iters.append(rec.get("iteration", len(iters)))
                     scores.append(rec["score"])
                     ms.append(rec.get("iteration_ms"))
-                    last_t = t if last_t is None else max(last_t, t)
-        return {"iterations": iters, "scores": scores, "iteration_ms": ms,
-                "last_timestamp": last_t}
+            workers[wid] = {"timestamps": ts, "iterations": iters,
+                            "scores": scores, "iteration_ms": ms,
+                            "last_timestamp": ts[-1] if ts else None}
+        return {"workers": workers}
 
     def _model(self, sid: str) -> dict:
         workers = self.storage.list_workers(sid)
@@ -123,45 +134,34 @@ class UIServer:
     def _handler(self):
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _reply(self, code, payload, ctype="application/json"):
-                body = payload.encode() if isinstance(payload, str) \
-                    else json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+        class Handler(JsonRequestHandler):
+            owner = server
 
             def do_GET(self):
                 parsed = urlparse(self.path)
                 path = parsed.path
                 try:
                     if path in ("/", "/train", "/train/"):
-                        self._reply(200, _DASH_HTML, "text/html")
+                        self.reply(200, _DASH_HTML, "text/html")
                     elif path == "/train/sessions":
-                        self._reply(200, server.storage.list_sessions())
+                        self.reply(200, server.storage.list_sessions())
                     elif path.startswith("/train/") and path.endswith("/overview"):
                         sid = unquote(path.split("/")[2])
                         qs = parse_qs(parsed.query)
                         since = float(qs.get("since", ["0"])[0])
-                        self._reply(200, server._overview(sid, since))
+                        self.reply(200, server._overview(sid, since))
                     elif path.startswith("/train/") and path.endswith("/model"):
                         sid = unquote(path.split("/")[2])
-                        self._reply(200, server._model(sid))
+                        self.reply(200, server._model(sid))
                     else:
-                        self._reply(404, {"error": "unknown endpoint"})
+                        self.reply(404, {"error": "unknown endpoint"})
                 except Exception as e:
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    self.reply(500, {"error": f"{type(e).__name__}: {e}"})
 
             def do_POST(self):
                 path = urlparse(self.path).path
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
+                    req = self.read_json()
                     if path == "/remote":
                         kind = req.get("kind", "update")
                         sid = req["session_id"]
@@ -174,33 +174,16 @@ class UIServer:
                             server.storage.put_update(
                                 sid, tid, wid, float(req.get("timestamp", 0.0)),
                                 req.get("record", {}))
-                        self._reply(200, {"status": "ok"})
+                        self.reply(200, {"status": "ok"})
                     else:
-                        self._reply(404, {"error": "unknown endpoint"})
+                        self.reply(404, {"error": "unknown endpoint"})
                 except (KeyError, ValueError, TypeError, AttributeError,
                         json.JSONDecodeError) as e:
-                    self._reply(400, {"error": str(e)})
+                    self.reply(400, {"error": str(e)})
                 except Exception as e:
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    self.reply(500, {"error": f"{type(e).__name__}: {e}"})
 
         return Handler
-
-    def start(self, background: bool = True) -> "UIServer":
-        self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler())
-        self.port = self._httpd.server_address[1]
-        if background:
-            self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                            daemon=True)
-            self._thread.start()
-        else:
-            self._httpd.serve_forever()
-        return self
-
-    def stop(self):
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
 
 
 class RemoteStatsRouter:

@@ -43,9 +43,19 @@ def _flatten_names(tree, prefix="") -> Dict[str, jnp.ndarray]:
 
 
 def _histogram(x: np.ndarray) -> dict:
-    counts, edges = np.histogram(x, bins=_HIST_BINS)
-    return {"counts": counts.tolist(), "min": float(edges[0]),
-            "max": float(edges[-1])}
+    finite = x[np.isfinite(x)]
+    nonfinite = int(x.size - finite.size)
+    if finite.size == 0:
+        # diverged tensor: report an empty histogram instead of crashing the
+        # training loop from inside the monitoring path
+        return {"counts": [0] * _HIST_BINS, "min": 0.0, "max": 0.0,
+                "nonfinite": nonfinite}
+    counts, edges = np.histogram(finite, bins=_HIST_BINS)
+    out = {"counts": counts.tolist(), "min": float(edges[0]),
+           "max": float(edges[-1])}
+    if nonfinite:
+        out["nonfinite"] = nonfinite
+    return out
 
 
 class StatsListener(TrainingListener):
@@ -118,7 +128,7 @@ class StatsListener(TrainingListener):
         flat = _flatten_names(params)
         param_stats = {}
         for name, leaf in flat.items():
-            mm, sd, mn, mx = (float(v) for v in jax.tree.leaves(_stat4(leaf)))
+            mm, sd, mn, mx = (_finite_or_none(v) for v in jax.tree.leaves(_stat4(leaf)))
             entry = {"mean_magnitude": mm, "std": sd, "min": mn, "max": mx}
             if self.collect_histograms:
                 entry["histogram"] = _histogram(np.asarray(leaf).ravel())
@@ -131,7 +141,8 @@ class StatsListener(TrainingListener):
             # are MEAN PER-STEP update magnitudes regardless of frequency
             upd = jax.tree.map(lambda a, b: (np.asarray(a) - b) / gap, params, prev)
             for name, leaf in _flatten_names(upd).items():
-                mm, sd, mn, mx = (float(v) for v in jax.tree.leaves(_stat4(leaf)))
+                mm, sd, mn, mx = (_finite_or_none(v)
+                                  for v in jax.tree.leaves(_stat4(leaf)))
                 entry = {"mean_magnitude": mm, "std": sd, "min": mn, "max": mx,
                          "averaged_over_iterations": gap}
                 if self.collect_histograms:
@@ -155,3 +166,10 @@ class StatsListener(TrainingListener):
 @jax.jit
 def _stat4(x):
     return (jnp.mean(jnp.abs(x)), jnp.std(x), jnp.min(x), jnp.max(x))
+
+
+def _finite_or_none(v) -> Optional[float]:
+    """NaN/inf → None: keeps the stored records strict-JSON (browser fetch()
+    rejects bare NaN) while still flagging divergence to the dashboard."""
+    f = float(v)
+    return f if np.isfinite(f) else None

@@ -134,7 +134,10 @@ class InMemoryStatsStorage(BaseStatsStorage):
     def get_updates_desc(self, session_id, worker_id, limit=50):
         with self._lock:
             ups = self._updates.get((session_id, worker_id), [])
-            return [r for _, r in sorted(ups, key=lambda p: -p[0])[:limit]]
+            # appended ~in timestamp order: tail slice is O(limit), then a
+            # small sort corrects any out-of-order remote-receiver stamps
+            tail = ups[-limit:]
+            return [r for _, r in sorted(tail, key=lambda p: -p[0])]
 
 
 class FileStatsStorage(BaseStatsStorage):

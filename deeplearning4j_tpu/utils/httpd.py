@@ -1,0 +1,61 @@
+"""Shared JSON-over-http.server scaffolding for the kNN and UI daemons.
+
+One place for the handler factory plumbing: reply encoding, port-0
+resolution, background-thread serve loop, and shutdown ordering.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Quiet handler with JSON helpers; subclasses implement do_GET/do_POST
+    and reach their server object via ``self.owner``."""
+
+    owner = None  # set by the subclass closure
+
+    def log_message(self, *a):
+        pass
+
+    def reply(self, code: int, payload, ctype: str = "application/json"):
+        body = payload.encode() if isinstance(payload, str) \
+            else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def read_json(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+
+class JsonHTTPServerMixin:
+    """start()/stop() lifecycle shared by NearestNeighborsServer & UIServer.
+    Subclasses set ``self.host``/``self.port`` and implement ``_handler()``
+    returning a JsonRequestHandler subclass."""
+
+    _httpd: Optional[ThreadingHTTPServer] = None
+    _thread: Optional[threading.Thread] = None
+
+    def start(self, background: bool = True):
+        self._httpd = ThreadingHTTPServer((self.host, self.port), self._handler())
+        self.port = self._httpd.server_address[1]  # resolves port=0
+        if background:
+            self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+        else:
+            self._httpd.serve_forever()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

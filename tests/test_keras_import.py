@@ -274,7 +274,7 @@ class TestModelGuesser:
         from deeplearning4j_tpu.train.serialization import save_model
 
         m = OurSeq(NetConfig(), [OurDense(n_out=3, activation="relu"),
-                                 Output(n_out=2, loss="mse")], (4,))
+                                 Output(n_out=2, loss="mse", activation="identity")], (4,))
         m.init()
         p = str(tmp_path / "native.zip")
         save_model(p, m, params=m.params, state=m.state)

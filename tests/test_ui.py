@@ -101,7 +101,7 @@ class TestUIServer:
             assert "Training sessions" in html
             assert self._get(server.port, "/train/sessions") == ["ui_sess"]
             ov = self._get(server.port, "/train/ui_sess/overview")
-            assert len(ov["scores"]) == 2
+            assert len(ov["workers"]["worker_0"]["scores"]) == 2
             model = self._get(server.port, "/train/ui_sess/model")
             assert model["static"]["model"]["class"] == "Sequential"
             assert model["latest"]["params"]
@@ -117,7 +117,7 @@ class TestUIServer:
                               {"iteration": 0, "score": 1.25})
             assert self._get(server.port, "/train/sessions") == ["remote_sess"]
             ov = self._get(server.port, "/train/remote_sess/overview")
-            assert ov["scores"] == [1.25]
+            assert ov["workers"]["rw"]["scores"] == [1.25]
         finally:
             server.stop()
 
@@ -131,7 +131,7 @@ class TestUIServer:
             tr = _toy_trainer()
             tr.fit(_toy_data(), epochs=1, listeners=[lst], prefetch=False)
             ov = self._get(server.port, "/train/r2/overview")
-            assert len(ov["scores"]) == 2
+            assert len(ov["workers"]["worker_0"]["scores"]) == 2
         finally:
             server.stop()
 
