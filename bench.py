@@ -43,7 +43,7 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    batch = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 4))
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
     img = int(os.environ.get("BENCH_IMG", 224 if on_tpu else 32))
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
 

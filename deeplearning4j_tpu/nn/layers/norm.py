@@ -46,19 +46,23 @@ class BatchNorm(Layer):
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))
         if training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # accumulate statistics in f32 even under bf16 compute: batch
+            # moments are precision-sensitive; running stats stay f32
+            mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            sdt = state["mean"].dtype
             new_state = {
-                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
-                "var": self.decay * state["var"] + (1 - self.decay) * var,
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean.astype(sdt),
+                "var": self.decay * state["var"] + (1 - self.decay) * var.astype(sdt),
             }
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        inv = lax.rsqrt(var + self.eps)
-        y = (x - mean) * inv
+        inv = lax.rsqrt(var.astype(jnp.float32) + self.eps)
+        # normalize in the compute dtype so bf16 stays bf16 through the layer
+        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
         if not self.lock_gamma_beta:
-            y = y * params["gamma"] + params["beta"]
+            y = y * params["gamma"].astype(x.dtype) + params["beta"].astype(x.dtype)
         return activations.get(self.activation)(y), new_state, mask
 
 

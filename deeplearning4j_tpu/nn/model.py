@@ -376,6 +376,18 @@ class Graph:
             inputs = {self.inputs[0]: inputs}
         if masks is not None and not isinstance(masks, dict):
             masks = {self.inputs[0]: masks}
+        # mixed precision (MXU-native bf16): cast float inputs + params to the
+        # compute dtype; master params and running stats stay f32 (same policy
+        # as Sequential.forward)
+        cdt = DTYPES[self.config.compute_dtype] if self.config.compute_dtype else None
+
+        def _cast(t):
+            return jax.tree.map(
+                lambda a: a.astype(cdt)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+
+        if cdt is not None:
+            inputs = _cast(inputs)
         acts: Dict[str, Array] = dict(inputs)
         act_masks: Dict[str, Optional[Array]] = {k: (masks or {}).get(k) for k in inputs}
         new_state = dict(state)
@@ -386,8 +398,11 @@ class Graph:
             ins = [acts[i] for i in node.inputs]
             if node.is_layer():
                 m = act_masks.get(node.inputs[0])
+                p = params.get(name, {})
+                if cdt is not None:
+                    p = _cast(p)
                 y, s_out, m_out = node.spec.apply(
-                    params.get(name, {}), state.get(name, {}), ins[0],
+                    p, state.get(name, {}), ins[0],
                     training=training, rng=rngs.get(name), mask=m)
                 acts[name] = y
                 act_masks[name] = m_out
@@ -396,7 +411,11 @@ class Graph:
             else:
                 acts[name] = node.spec.apply(ins)
                 act_masks[name] = act_masks.get(node.inputs[0])
-        return [acts[o] for o in self.outputs], new_state
+        outs = [acts[o] for o in self.outputs]
+        if cdt is not None:
+            outs = [o.astype(self.dtype) if jnp.issubdtype(o.dtype, jnp.floating)
+                    else o for o in outs]
+        return outs, new_state
 
     def score(self, params, state, inputs, labels, *, training=True, rng=None,
               masks=None, label_masks=None) -> Tuple[Array, State]:
@@ -407,6 +426,17 @@ class Graph:
             masks = {self.inputs[0]: masks}
         if not isinstance(labels, (list, tuple)):
             labels = [labels]
+        # mixed precision on the TRAINING path too (same policy as forward):
+        # activations/params in compute dtype, loss accumulated in f32
+        cdt = DTYPES[self.config.compute_dtype] if self.config.compute_dtype else None
+
+        def _cast(t):
+            return jax.tree.map(
+                lambda a: a.astype(cdt)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+
+        if cdt is not None:
+            inputs = _cast(inputs)
         acts: Dict[str, Array] = dict(inputs)
         act_masks: Dict[str, Optional[Array]] = {k: (masks or {}).get(k) for k in inputs}
         new_state = dict(state)
@@ -417,32 +447,34 @@ class Graph:
         for name in self.topo_order:
             node = self.nodes[name]
             ins = [acts[i] for i in node.inputs]
-            if node.is_layer() and name in out_idx and isinstance(node.spec, _LossMixin):
+            if not node.is_layer():
+                acts[name] = node.spec.apply(ins)
+                act_masks[name] = act_masks.get(node.inputs[0])
+                continue
+            p = _cast(params.get(name, {})) if cdt is not None else params.get(name, {})
+            if name in out_idx and isinstance(node.spec, _LossMixin):
                 li = out_idx[name]
                 lm = None
                 if label_masks is not None:
                     lm = label_masks[li] if isinstance(label_masks, (list, tuple)) else label_masks
                 if lm is None:
                     lm = act_masks.get(node.inputs[0])
-                total = total + node.spec.score(params.get(name, {}), state.get(name, {}),
-                                               ins[0], labels[li], mask=lm)
+                loss = node.spec.score(p, state.get(name, {}), ins[0], labels[li],
+                                       mask=lm)
+                if cdt is not None:  # accumulate in f32 under bf16 compute;
+                    loss = loss.astype(jnp.float32)  # full precision otherwise
+                total = total + loss
                 # still produce activation for downstream vertices if any
-                y, s_out, m_out = node.spec.apply(params.get(name, {}), state.get(name, {}),
+                y, s_out, m_out = node.spec.apply(p, state.get(name, {}),
                                                   ins[0], training=training, rng=rngs.get(name),
                                                   mask=act_masks.get(node.inputs[0]))
-                acts[name], act_masks[name] = y, m_out
-                if s_out:
-                    new_state[name] = s_out
-            elif node.is_layer():
-                y, s_out, m_out = node.spec.apply(params.get(name, {}), state.get(name, {}),
-                                                  ins[0], training=training, rng=rngs.get(name),
-                                                  mask=act_masks.get(node.inputs[0]))
-                acts[name], act_masks[name] = y, m_out
-                if s_out:
-                    new_state[name] = s_out
             else:
-                acts[name] = node.spec.apply(ins)
-                act_masks[name] = act_masks.get(node.inputs[0])
+                y, s_out, m_out = node.spec.apply(p, state.get(name, {}),
+                                                  ins[0], training=training, rng=rngs.get(name),
+                                                  mask=act_masks.get(node.inputs[0]))
+            acts[name], act_masks[name] = y, m_out
+            if s_out:
+                new_state[name] = s_out
         return total, new_state
 
     def output(self, inputs, params=None, state=None, masks=None) -> List[Array]:

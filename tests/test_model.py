@@ -241,3 +241,56 @@ class TestVertices:
             d = json.loads(json.dumps(v.to_dict()))
             v2 = vertex_from_dict(d)
             assert type(v2) is type(v)
+
+
+class TestMixedPrecisionGraph:
+    """compute_dtype must reach BOTH Graph paths: forward (inference) and
+    score (training) — the bench trains a Graph in bf16 (review regression)."""
+
+    def _toy_graph(self, compute_dtype):
+        from deeplearning4j_tpu.nn.layers import BatchNorm, Conv2D, Dense, GlobalPooling, Output
+        from deeplearning4j_tpu.nn.model import GraphBuilder, NetConfig
+        from deeplearning4j_tpu.nn.vertices import ElementWise
+
+        cfg = NetConfig(updater={"type": "sgd", "learning_rate": 0.05})
+        cfg.compute_dtype = compute_dtype
+        g = (GraphBuilder(cfg).add_input("in", (8, 8, 3))
+             .add_layer("c1", Conv2D(n_out=4, kernel=(3, 3), use_bias=False), "in")
+             .add_layer("bn", BatchNorm(activation="relu"), "c1")
+             .add_layer("c2", Conv2D(n_out=4, kernel=(1, 1)), "bn"))
+        g.add_vertex("add", ElementWise(op="add"), "bn", "c2")
+        g.add_layer("gap", GlobalPooling(mode="avg"), "add")
+        g.add_layer("out", Output(n_out=3, loss="mcxent", activation="softmax"), "gap")
+        return g.set_outputs("out").build()
+
+    def test_bf16_flows_through_training_path(self):
+        import jax
+
+        model = self._toy_graph("bfloat16")
+        model.init()
+        x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[[0, 1]]
+        txt = jax.jit(lambda p, s: model.score(p, s, x, y, training=True)[0]) \
+            .lower(model.params, model.state).as_text()
+        assert "bf16" in txt, "training path must compute in bf16"
+        loss, _ = model.score(model.params, model.state, x, y, training=True)
+        assert np.isfinite(float(loss))
+        # grads flow and are f32 (master precision)
+        g = jax.grad(lambda p: model.score(p, model.state, x, y, training=True)[0])(model.params)
+        leaf = g["c1"]["w"]
+        assert leaf.dtype == jnp.float32
+        assert float(jnp.abs(leaf).sum()) > 0
+
+    def test_bf16_matches_f32_roughly(self):
+        m32 = self._toy_graph(None)
+        m16 = self._toy_graph("bfloat16")
+        m32.init(seed=3)
+        m16.init(seed=3)
+        x = np.random.RandomState(1).randn(2, 8, 8, 3).astype(np.float32)
+        o32 = np.asarray(m32.output(x)[0])
+        o16 = np.asarray(m16.output(x)[0])
+        np.testing.assert_allclose(o16, o32, atol=0.05)
+        # BN running stats must stay f32 under bf16 compute
+        _, st = m16.score(m16.params, m16.state, x,
+                          np.eye(3, dtype=np.float32)[[0, 1]], training=True)
+        assert st["bn"]["mean"].dtype == jnp.float32
