@@ -3,6 +3,8 @@
 from .evaluation import (ROC, Evaluation, EvaluationBinary,
                          EvaluationCalibration, ROCMultiClass,
                          RegressionEvaluation)
+from .tools import (export_evaluation_to_html, export_roc_charts_to_html)
 
 __all__ = ["Evaluation", "EvaluationBinary", "EvaluationCalibration", "ROC",
-           "ROCMultiClass", "RegressionEvaluation"]
+           "ROCMultiClass", "RegressionEvaluation",
+           "export_evaluation_to_html", "export_roc_charts_to_html"]

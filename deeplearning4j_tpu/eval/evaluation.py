@@ -274,10 +274,14 @@ class ROC:
         return self
 
     def _curve_counts(self):
+        """(tp, fp, P, N) in DESCENDING-threshold order: index 0 is the
+        above-max threshold (tp=fp=0); the last index classifies everything
+        positive. fpr/tpr derived from this are monotone non-decreasing, so
+        integration needs no re-sorting (re-sorting ties at fpr=0 is exactly
+        what mis-ordered saturated-score curves before)."""
         if self.num_thresholds:
-            # cumulative from the top bin: predictions >= threshold
-            tp = np.cumsum(self.pos_hist[::-1])[::-1]
-            fp = np.cumsum(self.neg_hist[::-1])[::-1]
+            tp = np.concatenate([[0], np.cumsum(self.pos_hist[::-1])])
+            fp = np.concatenate([[0], np.cumsum(self.neg_hist[::-1])])
             P, N = self.pos_hist.sum(), self.neg_hist.sum()
             return tp, fp, P, N
         p = np.concatenate(self._scores) if self._scores else np.zeros(0)
@@ -289,6 +293,7 @@ class ROC:
         return tp, fp, y_sorted.sum(), (~y_sorted).sum()
 
     def roc_curve(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(fpr, tpr) from (0,0) to (1,1), descending threshold."""
         tp, fp, P, N = self._curve_counts()
         tpr = tp / max(P, 1)
         fpr = fp / max(N, 1)
@@ -296,20 +301,18 @@ class ROC:
 
     def auc(self) -> float:
         fpr, tpr = self.roc_curve()
-        order = np.argsort(fpr, kind="stable")
-        return float(np.trapezoid(tpr[order], fpr[order]))
+        return float(np.trapezoid(tpr, fpr))
 
     def pr_curve(self) -> Tuple[np.ndarray, np.ndarray]:
         tp, fp, P, N = self._curve_counts()
         denom = np.maximum(tp + fp, 1)
-        precision = tp / denom
+        precision = np.where(tp + fp > 0, tp / denom, 1.0)
         recall = tp / max(P, 1)
         return recall, precision
 
     def auc_pr(self) -> float:
         r, p = self.pr_curve()
-        order = np.argsort(r, kind="stable")
-        return float(np.trapezoid(p[order], r[order]))
+        return float(np.trapezoid(p, r))
 
 
 class ROCMultiClass:

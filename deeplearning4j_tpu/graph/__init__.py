@@ -8,7 +8,8 @@ Huffman tree, GraphHuffman parity).
 from .graph import Edge, Graph, load_delimited_edges, load_weighted_edges
 from .walks import RandomWalkIterator, WeightedRandomWalkIterator
 from .deepwalk import DeepWalk
+from .node2vec import Node2Vec
 
-__all__ = ["Edge", "Graph", "DeepWalk", "RandomWalkIterator",
+__all__ = ["Edge", "Graph", "DeepWalk", "Node2Vec", "RandomWalkIterator",
            "WeightedRandomWalkIterator", "load_delimited_edges",
            "load_weighted_edges"]

@@ -1,0 +1,97 @@
+"""node2vec — second-order biased random walks + skip-gram.
+
+The reference ships only a stub (``models/node2vec/``, SURVEY.md §2.5); this
+is the full Grover & Leskovec 2016 algorithm: walk transition probability
+reweighted by return parameter ``p`` and in-out parameter ``q`` relative to
+the previous step, then the shared SequenceVectors skip-gram trainer
+(negative sampling) on the walk corpus.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..nlp.sequencevectors import SequenceVectors, SkipGram
+from ..nlp.vocab import VocabCache, VocabWord
+from .graph import Graph
+
+
+class Node2Vec:
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 walk_length: int = 40, walks_per_vertex: int = 10,
+                 p: float = 1.0, q: float = 1.0, negative: int = 5,
+                 learning_rate: float = 0.025, epochs: int = 1,
+                 batch_size: int = 2048, seed: int = 12345):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.p = p
+        self.q = q
+        self.negative = negative
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.sv: Optional[SequenceVectors] = None
+
+    def _biased_walks(self, g: Graph, rng: np.random.Generator) -> List[np.ndarray]:
+        """Second-order walks: weight * (1/p if back, 1 if neighbor-of-prev,
+        1/q otherwise). The per-step reweight is vectorized over the current
+        vertex's whole neighbor slice (sorted-neighbor ``np.isin`` membership)
+        instead of a per-edge Python loop."""
+        sorted_nbrs = [np.sort(g.neighbors(v)) for v in range(g.n)]
+        walks = []
+        for _ in range(self.walks_per_vertex):
+            for start in rng.permutation(g.n):
+                walk = [int(start)]
+                while len(walk) < self.walk_length + 1:
+                    cur = walk[-1]
+                    nbrs = g.neighbors(cur)
+                    if len(nbrs) == 0:
+                        break
+                    w = g.neighbor_weights(cur).astype(np.float64).copy()
+                    if len(walk) >= 2:
+                        prev = walk[-2]
+                        back = nbrs == prev
+                        common = np.isin(nbrs, sorted_nbrs[prev],
+                                         assume_unique=False)
+                        w[back] /= self.p
+                        w[~back & ~common] /= self.q
+                    total = w.sum()
+                    if total <= 0:
+                        break
+                    walk.append(int(nbrs[np.searchsorted(np.cumsum(w),
+                                                         rng.random() * total)]))
+                walks.append(np.asarray(walk, np.int64))
+        return walks
+
+    def fit(self, graph: Graph) -> List[float]:
+        cache = VocabCache()
+        degrees = graph.degrees()
+        for v in range(graph.n):
+            cache.add(VocabWord(word=str(v), count=max(int(degrees[v]), 1)))
+        cache.total_count = int(sum(max(int(d), 1) for d in degrees))
+        self.sv = SequenceVectors(cache, layer_size=self.vector_size,
+                                  window=self.window_size, negative=self.negative,
+                                  learning_rate=self.learning_rate,
+                                  min_learning_rate=self.learning_rate * 1e-2,
+                                  epochs=self.epochs, batch_size=self.batch_size,
+                                  seed=self.seed, algorithm=SkipGram())
+        rng = np.random.default_rng(self.seed)
+        return self.sv.fit(self._biased_walks(graph, rng))
+
+    def get_vertex_vector(self, v: int) -> np.ndarray:
+        return self.sv.vector(v)
+
+    @property
+    def vectors(self) -> np.ndarray:
+        return self.sv.vectors
+
+    def similarity(self, a: int, b: int) -> float:
+        return self.sv.similarity(a, b)
+
+    def vertices_nearest(self, v: int, top_n: int = 10) -> List[Tuple[int, float]]:
+        return self.sv.nearest(v, top_n)

@@ -1,0 +1,118 @@
+"""Pipeline estimator wrappers — dl4j-spark-ml equivalent (SURVEY.md §2.4:
+Spark ML ``Estimator``/``Model`` wrappers, ``SparkDl4jNetwork.scala``).
+
+The idiomatic Python counterpart of a Spark ML Pipeline stage is a
+scikit-learn estimator: ``fit(X, y)`` / ``predict`` / ``predict_proba`` /
+``score`` plus ``get_params``/``set_params``, so these wrappers drop into
+sklearn Pipelines, GridSearchCV, and cross_val_score without depending on
+sklearn itself (duck-typed contract).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class _BaseNetEstimator:
+    def __init__(self, model_builder=None, epochs: int = 10, batch_size: int = 32,
+                 shuffle: bool = True, seed: int = 12345, model=None):
+        self.model_builder = model_builder
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.model = model
+        self.trainer_ = None
+
+    # --- sklearn estimator protocol ---
+    def get_params(self, deep: bool = True) -> dict:
+        return {"model_builder": self.model_builder, "epochs": self.epochs,
+                "batch_size": self.batch_size, "shuffle": self.shuffle,
+                "seed": self.seed, "model": self.model}
+
+    def set_params(self, **params) -> "_BaseNetEstimator":
+        for k, v in params.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Invalid parameter {k}")
+            setattr(self, k, v)
+        return self
+
+    def _build(self, X, y):
+        if self.model is not None:
+            return self.model
+        if self.model_builder is None:
+            raise ValueError("pass model= or model_builder=(fn(input_shape, "
+                             "n_out) -> Sequential/Graph)")
+        return self.model_builder(tuple(X.shape[1:]), y.shape[-1])
+
+    def _fit_arrays(self, X, y):
+        from .data.iterators import ArrayIterator
+        from .train.trainer import Trainer
+
+        self.model = self._build(X, y)
+        if self.model.params is None:
+            self.model.init()
+        it = ArrayIterator(np.asarray(X, np.float32), np.asarray(y, np.float32),
+                           batch_size=self.batch_size, shuffle=self.shuffle,
+                           seed=self.seed)
+        self.trainer_ = Trainer(self.model)
+        self.trainer_.fit(it, epochs=self.epochs, prefetch=False)
+        return self
+
+    def _raw_output(self, X) -> np.ndarray:
+        out = self.model.output(np.asarray(X, np.float32),
+                                self.trainer_.params if self.trainer_ else None,
+                                self.trainer_.state if self.trainer_ else None)
+        return np.asarray(out[0] if isinstance(out, list) else out)
+
+
+class NeuralNetClassifier(_BaseNetEstimator):
+    """sklearn-style classifier over a Sequential/Graph model.
+
+    ``fit(X, y)`` accepts integer class labels or one-hot rows.
+    """
+
+    def fit(self, X, y) -> "NeuralNetClassifier":
+        y = np.asarray(y)
+        if y.ndim == 1:  # integer labels -> one-hot
+            self.classes_ = np.unique(y)
+            idx = np.searchsorted(self.classes_, y)
+            y = np.eye(len(self.classes_), dtype=np.float32)[idx]
+        else:
+            self.classes_ = np.arange(y.shape[-1])
+        return self._fit_arrays(np.asarray(X), y)
+
+    def predict_proba(self, X) -> np.ndarray:
+        return self._raw_output(X)
+
+    def predict(self, X) -> np.ndarray:
+        return self.classes_[np.argmax(self.predict_proba(X), axis=-1)]
+
+    def score(self, X, y) -> float:
+        """Mean accuracy (sklearn contract)."""
+        y = np.asarray(y)
+        if y.ndim > 1:
+            y = self.classes_[np.argmax(y, axis=-1)]
+        return float(np.mean(self.predict(X) == y))
+
+
+class NeuralNetRegressor(_BaseNetEstimator):
+    def fit(self, X, y) -> "NeuralNetRegressor":
+        y = np.asarray(y, np.float32)
+        if y.ndim == 1:
+            y = y[:, None]
+        return self._fit_arrays(np.asarray(X), y)
+
+    def predict(self, X) -> np.ndarray:
+        out = self._raw_output(X)
+        return out[:, 0] if out.shape[-1] == 1 else out
+
+    def score(self, X, y) -> float:
+        """R^2 (sklearn contract)."""
+        y = np.asarray(y, np.float64).reshape(len(np.asarray(X)), -1)
+        pred = self.predict(X).reshape(y.shape)
+        ss_res = float(((y - pred) ** 2).sum())
+        ss_tot = float(((y - y.mean(0)) ** 2).sum())
+        return 1.0 - ss_res / max(ss_tot, 1e-12)

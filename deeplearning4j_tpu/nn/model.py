@@ -41,6 +41,14 @@ DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float
           "float64": jnp.float64}
 
 
+def _cast_floats(tree, cdt):
+    """Cast float leaves of a pytree to the compute dtype (mixed-precision
+    policy shared by Sequential and Graph forward/score paths)."""
+    return jax.tree.map(
+        lambda a: a.astype(cdt) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        tree)
+
+
 @dataclass
 class NetConfig:
     """Global training config — NeuralNetConfiguration.Builder equivalent.
@@ -137,7 +145,7 @@ class Sequential:
             k = _layer_key(i, layer)
             p = params.get(k, {})
             if cdt is not None:
-                p = jax.tree.map(lambda a: a.astype(cdt) if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+                p = _cast_floats(p, cdt)
             s = state.get(k, {})
             x, s_out, mask = layer.apply(p, s, x, training=training, rng=rngs[i], mask=mask)
             if s_out:
@@ -381,13 +389,8 @@ class Graph:
         # as Sequential.forward)
         cdt = DTYPES[self.config.compute_dtype] if self.config.compute_dtype else None
 
-        def _cast(t):
-            return jax.tree.map(
-                lambda a: a.astype(cdt)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
-
         if cdt is not None:
-            inputs = _cast(inputs)
+            inputs = _cast_floats(inputs, cdt)
         acts: Dict[str, Array] = dict(inputs)
         act_masks: Dict[str, Optional[Array]] = {k: (masks or {}).get(k) for k in inputs}
         new_state = dict(state)
@@ -400,7 +403,7 @@ class Graph:
                 m = act_masks.get(node.inputs[0])
                 p = params.get(name, {})
                 if cdt is not None:
-                    p = _cast(p)
+                    p = _cast_floats(p, cdt)
                 y, s_out, m_out = node.spec.apply(
                     p, state.get(name, {}), ins[0],
                     training=training, rng=rngs.get(name), mask=m)
@@ -429,14 +432,8 @@ class Graph:
         # mixed precision on the TRAINING path too (same policy as forward):
         # activations/params in compute dtype, loss accumulated in f32
         cdt = DTYPES[self.config.compute_dtype] if self.config.compute_dtype else None
-
-        def _cast(t):
-            return jax.tree.map(
-                lambda a: a.astype(cdt)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
-
         if cdt is not None:
-            inputs = _cast(inputs)
+            inputs = _cast_floats(inputs, cdt)
         acts: Dict[str, Array] = dict(inputs)
         act_masks: Dict[str, Optional[Array]] = {k: (masks or {}).get(k) for k in inputs}
         new_state = dict(state)
@@ -451,7 +448,8 @@ class Graph:
                 acts[name] = node.spec.apply(ins)
                 act_masks[name] = act_masks.get(node.inputs[0])
                 continue
-            p = _cast(params.get(name, {})) if cdt is not None else params.get(name, {})
+            p = (_cast_floats(params.get(name, {}), cdt) if cdt is not None
+                 else params.get(name, {}))
             if name in out_idx and isinstance(node.spec, _LossMixin):
                 li = out_idx[name]
                 lm = None

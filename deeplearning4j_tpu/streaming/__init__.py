@@ -1,0 +1,19 @@
+"""Streaming ingest & serving — dl4j-streaming equivalent (SURVEY.md §2.4:
+Kafka+Camel NDArray pub/sub + serving route).
+
+The reference moves ND arrays over Kafka topics (``streaming/kafka/
+NDArrayPublisher.java`` / ``NDArrayConsumer.java``) and exposes a Camel
+serving route (``streaming/routes/DL4jServeRouteBuilder.java``). Here the
+transport is a pluggable interface with a stdlib TCP implementation
+(length-prefixed npy frames — no broker needed for host-to-host streams) and
+an optional Kafka binding that activates when a kafka client library is
+installed; the serving route is an HTTP inference endpoint over the shared
+http.server scaffolding.
+"""
+
+from .ndarray import (NDArrayConsumer, NDArrayPublisher, TCPTransport,
+                      kafka_available)
+from .serve import InferenceRoute
+
+__all__ = ["InferenceRoute", "NDArrayConsumer", "NDArrayPublisher",
+           "TCPTransport", "kafka_available"]

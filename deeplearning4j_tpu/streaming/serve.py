@@ -1,0 +1,76 @@
+"""Model-serving route — ``streaming/routes/DL4jServeRouteBuilder.java``
+equivalent: expose a trained model as an HTTP inference endpoint, optionally
+backed by the dynamic-batching ``ParallelInference`` worker (SURVEY.md
+§2.4.6).
+
+Endpoints:
+- POST /predict  {"ndarray": [[...]]}  → {"output": [[...]]}
+- GET  /health
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
+
+
+class InferenceRoute(JsonHTTPServerMixin):
+    def __init__(self, model, params=None, state=None, port: int = 9010,
+                 host: str = "127.0.0.1", use_parallel_inference: bool = False,
+                 batch_limit: int = 32):
+        self.model = model
+        self.params = params if params is not None else model.params
+        self.state = state if state is not None else model.state
+        self.port = port
+        self.host = host
+        self._pi = None
+        if use_parallel_inference:
+            from ..parallel.inference import ParallelInference
+
+            self._pi = ParallelInference(model, params=self.params,
+                                         state=self.state,
+                                         batch_limit=batch_limit)
+
+    def _predict(self, x: np.ndarray) -> np.ndarray:
+        if self._pi is not None:
+            return np.asarray(self._pi.output(x))
+        out = self.model.output(x, self.params, self.state)
+        return np.asarray(out[0] if isinstance(out, list) else out)
+
+    def _handler(self):
+        server = self
+
+        class Handler(JsonRequestHandler):
+            owner = server
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self.reply(200, {"status": "ok",
+                                     "model": type(server.model).__name__})
+                else:
+                    self.reply(404, {"error": "unknown endpoint"})
+
+            def do_POST(self):
+                try:
+                    req = self.read_json()
+                    if self.path == "/predict":
+                        x = np.asarray(req["ndarray"], np.float32)
+                        y = server._predict(x)
+                        self.reply(200, {"output": y.tolist()})
+                    else:
+                        self.reply(404, {"error": "unknown endpoint"})
+                except (KeyError, ValueError, TypeError, AttributeError,
+                        json.JSONDecodeError) as e:
+                    self.reply(400, {"error": str(e)})
+                except Exception as e:
+                    self.reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        return Handler
+
+    def stop(self):
+        super().stop()
+        if self._pi is not None:
+            self._pi.shutdown()

@@ -14,6 +14,7 @@ from .listeners import (CheckpointListener, CollectScoresListener,
                         EvaluativeListener, PerformanceListener,
                         ScoreIterationListener, SleepyTrainingListener,
                         TimeIterationListener, TrainingListener)
+from .profiler import PhaseTimer, ProfilerListener
 from .serialization import load_model, save_model
 from .trainer import Trainer, build_updater
 
@@ -24,7 +25,8 @@ __all__ = ["BestScoreEpochTermination", "CheckpointListener",
            "InMemoryModelSaver", "InvalidScoreIterationTermination",
            "LocalFileModelSaver", "MaxEpochsTermination",
            "MaxScoreIterationTermination", "MaxTimeIterationTermination",
-           "PerformanceListener", "ROCScoreCalculator", "ScoreIterationListener",
+           "PerformanceListener", "PhaseTimer", "ProfilerListener",
+           "ROCScoreCalculator", "ScoreIterationListener",
            "ScoreImprovementEpochTermination", "SleepyTrainingListener",
            "TimeIterationListener", "Trainer", "TrainingListener",
            "build_updater", "load_model", "save_model"]

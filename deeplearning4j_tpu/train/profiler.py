@@ -1,0 +1,102 @@
+"""Profiling hooks — SURVEY.md §5 tracing/profiling.
+
+The reference has no dedicated tracer, only PerformanceListener timings and
+Spark phase stats (``ParameterAveragingTrainingMasterStats.java``). The
+TPU-native upgrade: a listener that captures a ``jax.profiler`` device trace
+for a chosen iteration window (viewable in TensorBoard/Perfetto), plus a
+phase-timing collector with the Spark stats' export surface.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from .listeners import TrainingListener
+
+
+class ProfilerListener(TrainingListener):
+    """Capture a jax.profiler trace for iterations [start, start+count)."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 5,
+                 num_iterations: int = 3):
+        self.log_dir = log_dir
+        self.start = start_iteration
+        self.end = start_iteration + num_iterations
+        self._active = False
+
+    def _start(self):
+        import jax
+
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+
+    def on_epoch_start(self, trainer, epoch):
+        # iteration_done fires only AFTER a step, so a window starting at the
+        # current iteration (incl. 0, the compile step) must open here
+        if not self._active and trainer.iteration == self.start:
+            self._start()
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        import jax
+
+        if not self._active and iteration + 1 == self.start:
+            self._start()
+        elif self._active and iteration + 1 >= self.end:
+            jax.block_until_ready(jax.tree.leaves(trainer.params)[0])
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def on_epoch_end(self, trainer, epoch):
+        if self._active:  # trace window spilled past the epoch: close it
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+class PhaseTimer:
+    """Phase-timing collector — ParameterAveragingTrainingMasterStats parity:
+    accumulate named phase durations, export a summary dict / JSON."""
+
+    def __init__(self):
+        self._totals: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+        self._spans: List[dict] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._totals[name] += dt
+            self._counts[name] += 1
+            self._spans.append({"name": name, "start": t0, "duration_s": dt})
+
+    def summary(self) -> Dict[str, dict]:
+        return {name: {"total_s": self._totals[name],
+                       "count": self._counts[name],
+                       "mean_s": self._totals[name] / max(self._counts[name], 1)}
+                for name in self._totals}
+
+    def export_json(self, path: Optional[str] = None) -> str:
+        s = json.dumps({"summary": self.summary(), "spans": self._spans},
+                       indent=2)
+        if path:
+            with open(path, "w") as f:
+                f.write(s)
+        return s
+
+    def export_chrome_trace(self, path: str) -> None:
+        """Chrome trace-event JSON (open in chrome://tracing / Perfetto) —
+        the TPU-native version of StatsUtils' timeline HTML export."""
+        events = [{"name": s["name"], "ph": "X", "ts": s["start"] * 1e6,
+                   "dur": s["duration_s"] * 1e6, "pid": 0, "tid": 0}
+                  for s in self._spans]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)

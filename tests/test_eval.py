@@ -153,3 +153,25 @@ class TestCalibration:
         p = np.full(1000, 0.9, np.float32)
         cal = EvaluationCalibration(10).eval(y, p)
         assert cal.expected_calibration_error() > 0.8
+
+
+class TestROCSaturatedScores:
+    def test_saturated_perfect_classifier_auc_1(self):
+        # overfit-softmax scores (all ~0 or ~1) used to collapse to AUC 0.5:
+        # the re-sort of fpr ties put the (0,0) endpoint mid-curve
+        labels = np.array([0] * 50 + [1] * 50)
+        scores = np.concatenate([np.full(50, 1e-4), np.full(50, 1 - 1e-4)])
+        roc = ROC()
+        roc.eval(labels, scores)
+        assert roc.auc() == 1.0
+        fpr, tpr = roc.roc_curve()
+        assert fpr[0] == 0.0 and tpr[0] == 0.0          # starts at origin
+        assert fpr[-1] == 1.0 and tpr[-1] == 1.0        # ends at (1,1)
+        assert np.all(np.diff(fpr) >= 0) and np.all(np.diff(tpr) >= 0)
+
+    def test_inverted_classifier_auc_0(self):
+        labels = np.array([1] * 50 + [0] * 50)
+        scores = np.concatenate([np.full(50, 1e-4), np.full(50, 1 - 1e-4)])
+        roc = ROC()
+        roc.eval(labels, scores)
+        assert roc.auc() == 0.0

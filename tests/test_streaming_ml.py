@@ -1,0 +1,212 @@
+"""Tests for streaming pub/sub + serving route (§2.4 dl4j-streaming),
+sklearn-style estimators (dl4j-spark-ml), node2vec, evaluation HTML tools,
+and profiling hooks (§5)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (ROC, Evaluation,
+                                     export_evaluation_to_html,
+                                     export_roc_charts_to_html)
+from deeplearning4j_tpu.graph import Edge, Graph, Node2Vec
+from deeplearning4j_tpu.ml import NeuralNetClassifier, NeuralNetRegressor
+from deeplearning4j_tpu.nn.layers import Dense, Output
+from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+from deeplearning4j_tpu.streaming import (InferenceRoute, NDArrayConsumer,
+                                          NDArrayPublisher, TCPTransport)
+from deeplearning4j_tpu.train import PhaseTimer, Trainer
+
+
+class TestNDArrayPubSub:
+    def test_roundtrip_arrays(self):
+        server_side = TCPTransport(port=0).listen()
+        client_side = TCPTransport(port=server_side.port).connect()
+        try:
+            pub = NDArrayPublisher(client_side)
+            cons = NDArrayConsumer(server_side)
+            rng = np.random.RandomState(0)
+            arrays = [rng.randn(4, 5).astype(np.float32),
+                      rng.randint(0, 9, (2, 3, 3)).astype(np.int32),
+                      np.array(3.25, np.float64)]
+            pub.publish_batch(arrays)
+            for want in arrays:
+                got = cons.receive(timeout=10)
+                assert got.dtype == want.dtype
+                np.testing.assert_array_equal(got, want)
+        finally:
+            client_side.close()
+            server_side.close()
+
+    def test_callback_consumption(self):
+        import threading
+
+        server_side = TCPTransport(port=0).listen()
+        client_side = TCPTransport(port=server_side.port).connect()
+        got = []
+        done = threading.Event()
+        try:
+            cons = NDArrayConsumer(server_side).start(
+                lambda a: (got.append(a), done.set() if len(got) >= 3 else None))
+            pub = NDArrayPublisher(client_side)
+            for i in range(3):
+                pub.publish(np.full((2, 2), i, np.float32))
+            assert done.wait(timeout=10)
+            assert sorted(float(a[0, 0]) for a in got) == [0.0, 1.0, 2.0]
+            cons.stop()
+        finally:
+            client_side.close()
+            server_side.close()
+
+
+class TestInferenceRoute:
+    def _model(self):
+        m = Sequential(NetConfig(),
+                       [Dense(n_out=6, activation="tanh"),
+                        Output(n_out=3, loss="mcxent", activation="softmax")], (4,))
+        m.init()
+        return m
+
+    @pytest.mark.parametrize("use_pi", [False, True])
+    def test_predict(self, use_pi):
+        m = self._model()
+        route = InferenceRoute(m, port=0, use_parallel_inference=use_pi).start()
+        try:
+            x = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{route.port}/predict",
+                data=json.dumps({"ndarray": x.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=15) as r:
+                out = np.asarray(json.loads(r.read())["output"])
+            want = np.asarray(m.output(x))
+            np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+        finally:
+            route.stop()
+
+    def test_bad_payload_400(self):
+        route = InferenceRoute(self._model(), port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{route.port}/predict", data=b'{"x": 1}',
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 400
+        finally:
+            route.stop()
+
+
+class TestSklearnEstimators:
+    def test_classifier_blobs(self):
+        rng = np.random.RandomState(0)
+        X = np.concatenate([rng.randn(60, 4) + 3, rng.randn(60, 4) - 3])
+        y = np.array([0] * 60 + [1] * 60)
+
+        def builder(input_shape, n_out):
+            m = Sequential(NetConfig(updater={"type": "adam", "learning_rate": 5e-2}),
+                           [Dense(n_out=8, activation="relu"),
+                            Output(n_out=n_out, loss="mcxent", activation="softmax")],
+                           input_shape)
+            return m
+
+        clf = NeuralNetClassifier(model_builder=builder, epochs=15, batch_size=32)
+        clf.fit(X, y)
+        assert clf.score(X, y) > 0.95
+        proba = clf.predict_proba(X[:5])
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+        # sklearn protocol surface
+        params = clf.get_params()
+        assert params["epochs"] == 15
+        clf.set_params(epochs=3)
+        assert clf.epochs == 3
+        with pytest.raises(ValueError):
+            clf.set_params(bogus=1)
+
+    def test_regressor_r2(self):
+        rng = np.random.RandomState(1)
+        X = rng.randn(200, 3)
+        y = X @ np.array([1.0, -2.0, 0.5]) + 0.05 * rng.randn(200)
+
+        def builder(input_shape, n_out):
+            return Sequential(NetConfig(updater={"type": "adam", "learning_rate": 5e-2}),
+                              [Dense(n_out=16, activation="relu"),
+                               Output(n_out=n_out, loss="mse", activation="identity")],
+                              input_shape)
+
+        reg = NeuralNetRegressor(model_builder=builder, epochs=40, batch_size=32)
+        reg.fit(X, y)
+        assert reg.score(X, y) > 0.8
+
+
+class TestNode2Vec:
+    def test_communities(self):
+        rng = np.random.RandomState(2)
+        edges = []
+        for base in (0, 10):
+            for i in range(10):
+                for j in range(i + 1, 10):
+                    if rng.rand() < 0.6:
+                        edges.append(Edge(base + i, base + j))
+        edges.append(Edge(0, 10))
+        g = Graph(20, edges)
+        n2v = Node2Vec(vector_size=16, walk_length=15, walks_per_vertex=5,
+                       p=1.0, q=0.5, epochs=2, seed=3)
+        n2v.fit(g)
+        intra = np.mean([n2v.similarity(2, j) for j in range(3, 10)])
+        inter = np.mean([n2v.similarity(2, j) for j in range(11, 20)])
+        assert intra > inter
+
+    def test_p_q_bias_changes_walks(self):
+        g = Graph(4, [Edge(0, 1), Edge(1, 2), Edge(2, 3), Edge(3, 0)])
+        rng = np.random.default_rng(0)
+        w_return = Node2Vec(p=0.05, q=10.0, walk_length=10, walks_per_vertex=2,
+                            seed=1)._biased_walks(g, np.random.default_rng(1))
+        w_explore = Node2Vec(p=10.0, q=0.05, walk_length=10, walks_per_vertex=2,
+                             seed=1)._biased_walks(g, np.random.default_rng(1))
+        # low p => walks backtrack often (few distinct vertices); low q => explore
+        mean_unique_ret = np.mean([len(set(w.tolist())) for w in w_return])
+        mean_unique_exp = np.mean([len(set(w.tolist())) for w in w_explore])
+        assert mean_unique_exp > mean_unique_ret
+
+
+class TestEvalTools:
+    def test_roc_html(self, tmp_path):
+        rng = np.random.RandomState(3)
+        scores = np.concatenate([rng.beta(2, 5, 300), rng.beta(5, 2, 300)])
+        labels = np.array([0] * 300 + [1] * 300)
+        roc = ROC()
+        roc.eval(labels, scores)
+        p = str(tmp_path / "roc.html")
+        html = export_roc_charts_to_html(roc, p)
+        assert "AUC=" in html and "<svg" in html
+        assert open(p).read() == html
+
+    def test_evaluation_html(self, tmp_path):
+        ev = Evaluation(3)
+        rng = np.random.RandomState(4)
+        y = np.eye(3)[rng.randint(0, 3, 100)]
+        ev.eval(y, y + 0.1 * rng.randn(100, 3))
+        html = export_evaluation_to_html(ev, str(tmp_path / "ev.html"))
+        assert "accuracy" in html and "per-class" in html
+
+
+class TestPhaseTimer:
+    def test_summary_and_exports(self, tmp_path):
+        pt = PhaseTimer()
+        with pt.phase("fit"):
+            sum(range(1000))
+        with pt.phase("fit"):
+            sum(range(1000))
+        with pt.phase("aggregate"):
+            pass
+        s = pt.summary()
+        assert s["fit"]["count"] == 2 and s["aggregate"]["count"] == 1
+        assert s["fit"]["total_s"] >= s["fit"]["mean_s"]
+        out = json.loads(pt.export_json(str(tmp_path / "t.json")))
+        assert len(out["spans"]) == 3
+        pt.export_chrome_trace(str(tmp_path / "trace.json"))
+        tr = json.load(open(tmp_path / "trace.json"))
+        assert len(tr["traceEvents"]) == 3
