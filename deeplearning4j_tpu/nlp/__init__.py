@@ -42,3 +42,10 @@ __all__ = [
     "write_word2vec_binary", "read_word2vec_binary",
     "BagOfWordsVectorizer", "TfidfVectorizer", "CnnSentenceIterator",
 ]
+
+from .cjk import (ChineseTokenizerFactory, JapaneseTokenizerFactory,
+                  KoreanTokenizerFactory, MaxMatchTokenizerFactory,
+                  script_segment)
+__all__ += ["ChineseTokenizerFactory", "JapaneseTokenizerFactory",
+            "KoreanTokenizerFactory", "MaxMatchTokenizerFactory",
+            "script_segment"]

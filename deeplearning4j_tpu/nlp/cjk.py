@@ -1,0 +1,176 @@
+"""CJK tokenization — language packs (SURVEY.md §2.5).
+
+Reference parity: deeplearning4j-nlp-chinese (vendored ansj segmenter, 9.5k
+LoC), -japanese (Kuromoji, 6.9k), -korean (OpenKoreanText wrapper). Those
+vendor full morphological analyzers; the TPU build ships:
+
+- ``MaxMatchTokenizerFactory`` — dictionary-driven forward maximum matching
+  (the classic CJK segmentation baseline; ansj's core strategy) with a
+  user-supplied lexicon + single-char fallback,
+- ``ChineseTokenizerFactory`` / ``JapaneseTokenizerFactory`` /
+  ``KoreanTokenizerFactory`` — script-aware defaults: use jieba / fugashi /
+  an external analyzer when importable (same gating the reference applies to
+  its vendored engines), else fall back to max-match over an optional
+  lexicon, else Unicode-block segmentation (han chars split singly, kana/
+  hangul runs kept, Latin/digits as words).
+
+All produce the shared ``Tokenizer`` interface, so Word2Vec/TF-IDF pipelines
+are language-agnostic exactly like the reference's TokenizerFactory SPI.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Iterable, List, Optional, Sequence, Set
+
+from .tokenization import Tokenizer, TokenizerFactory
+
+
+def _char_block(ch: str) -> str:
+    o = ord(ch)
+    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF:
+        return "han"
+    if 0x3040 <= o <= 0x309F:
+        return "hiragana"
+    if 0x30A0 <= o <= 0x30FF or 0x31F0 <= o <= 0x31FF:
+        return "katakana"
+    if 0xAC00 <= o <= 0xD7AF:
+        return "hangul"
+    if ch.isalnum():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "punct"
+
+
+def script_segment(text: str) -> List[str]:
+    """Unicode-block segmentation: han chars emitted singly (each hanzi is a
+    morpheme-ish unit), kana/hangul/latin runs kept together, punctuation and
+    whitespace dropped."""
+    out: List[str] = []
+    run: List[str] = []
+    run_block = ""
+
+    def flush():
+        if run:
+            out.append("".join(run))
+            run.clear()
+
+    for ch in text:
+        b = _char_block(ch)
+        if b in ("space", "punct"):
+            flush()
+            run_block = ""
+        elif b == "han":
+            flush()
+            out.append(ch)
+            run_block = ""
+        else:
+            if b != run_block:
+                flush()
+                run_block = b
+            run.append(ch)
+    flush()
+    return out
+
+
+class MaxMatchTokenizerFactory(TokenizerFactory):
+    """Forward maximum matching over a lexicon; unmatched CJK chars emit
+    singly, unmatched Latin runs emit as words."""
+
+    def __init__(self, lexicon: Iterable[str], max_word_len: int = 8):
+        super().__init__()
+        self.lexicon: Set[str] = set(lexicon)
+        self.max_word_len = max(max_word_len,
+                                max((len(w) for w in self.lexicon), default=1))
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        i, n = 0, len(text)
+        while i < n:
+            ch = text[i]
+            b = _char_block(ch)
+            if b == "space" or b == "punct":
+                i += 1
+                continue
+            if b == "latin":
+                j = i
+                while j < n and _char_block(text[j]) == "latin":
+                    j += 1
+                tokens.append(text[i:j])
+                i = j
+                continue
+            matched = None
+            for L in range(min(self.max_word_len, n - i), 1, -1):
+                cand = text[i:i + L]
+                if cand in self.lexicon:
+                    matched = cand
+                    break
+            if matched:
+                tokens.append(matched)
+                i += len(matched)
+            else:
+                tokens.append(ch)
+                i += 1
+        return Tokenizer(tokens, self._pre)
+
+
+class _ScriptFallbackFactory(TokenizerFactory):
+    """Shared engine-gating: external analyzer if importable → lexicon
+    max-match → Unicode-block segmentation."""
+
+    def __init__(self, lexicon: Optional[Iterable[str]] = None):
+        super().__init__()
+        self._mm = MaxMatchTokenizerFactory(lexicon) if lexicon else None
+        self._engine = self._load_engine()
+
+    def _load_engine(self):
+        return None
+
+    def create(self, text: str) -> Tokenizer:
+        if self._engine is not None:
+            return Tokenizer(self._engine(text), self._pre)
+        if self._mm is not None:
+            t = self._mm.create(text)
+            return Tokenizer(t.get_tokens(), self._pre)
+        return Tokenizer(script_segment(text), self._pre)
+
+
+class ChineseTokenizerFactory(_ScriptFallbackFactory):
+    """deeplearning4j-nlp-chinese ``ChineseTokenizerFactory`` equivalent."""
+
+    def _load_engine(self):
+        try:
+            import jieba  # optional; not baked into the hosting image
+
+            return lambda text: [t for t in jieba.cut(text) if t.strip()]
+        except ImportError:
+            return None
+
+
+class JapaneseTokenizerFactory(_ScriptFallbackFactory):
+    """deeplearning4j-nlp-japanese (Kuromoji) equivalent."""
+
+    def _load_engine(self):
+        try:
+            import fugashi  # optional MeCab wrapper
+
+            tagger = fugashi.Tagger()
+            return lambda text: [w.surface for w in tagger(text) if w.surface.strip()]
+        except ImportError:
+            return None
+
+
+class KoreanTokenizerFactory(_ScriptFallbackFactory):
+    """deeplearning4j-nlp-korean (OpenKoreanText) equivalent. Hangul is
+    space-delimited in normal text, so the block fallback already yields
+    eojeol units; a lexicon refines them to morpheme-ish tokens."""
+
+    def _load_engine(self):
+        try:
+            import konlpy.tag  # optional
+
+            okt = konlpy.tag.Okt()
+            return lambda text: okt.morphs(text)
+        except ImportError:
+            return None

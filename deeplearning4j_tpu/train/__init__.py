@@ -14,11 +14,14 @@ from .listeners import (CheckpointListener, CollectScoresListener,
                         EvaluativeListener, PerformanceListener,
                         ScoreIterationListener, SleepyTrainingListener,
                         TimeIterationListener, TrainingListener)
+from .faults import (DivergenceListener, FaultTolerantFit,
+                     TrainingDivergedException)
 from .profiler import PhaseTimer, ProfilerListener
 from .serialization import load_model, save_model
 from .trainer import Trainer, build_updater
 
 __all__ = ["BestScoreEpochTermination", "CheckpointListener",
+           "DivergenceListener", "FaultTolerantFit", "TrainingDivergedException",
            "ClassificationScoreCalculator", "CollectScoresListener",
            "DataSetLossCalculator", "EarlyStoppingConfiguration",
            "EarlyStoppingResult", "EarlyStoppingTrainer", "EvaluativeListener",

@@ -1,0 +1,107 @@
+"""Cluster provisioning — deeplearning4j-aws equivalent (SURVEY.md §2.4:
+``aws/ec2/provision/ClusterSetup.java``, ``Ec2BoxCreator.java``).
+
+The reference shells out to the EC2 API to create boxes and rsync a
+distributed run onto them. The TPU-native counterpart provisions TPU pod
+slices: this module *generates* the gcloud commands / bootstrap scripts
+(deterministic, reviewable, no cloud credentials or egress needed at build
+time) and can execute them when a ``runner`` is injected.
+"""
+
+from __future__ import annotations
+
+import shlex
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+
+@dataclass
+class TpuPodSpec:
+    """ClusterSetup config equivalent for a TPU pod slice."""
+
+    name: str = "dl4j-tpu-pod"
+    accelerator_type: str = "v5litepod-16"   # e.g. v4-32, v5litepod-256
+    zone: str = "us-central2-b"
+    project: Optional[str] = None
+    runtime_version: str = "tpu-ubuntu2204-base"
+    preemptible: bool = False
+    network: Optional[str] = None
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+class TpuClusterSetup:
+    """Generates (and optionally runs) the provisioning command sequence.
+
+    ``runner`` is a ``fn(cmd: List[str]) -> int``; defaults to dry-run
+    (collect only), mirroring how ClusterSetup separates plan from execute.
+    """
+
+    def __init__(self, spec: TpuPodSpec,
+                 runner: Optional[Callable[[List[str]], int]] = None):
+        self.spec = spec
+        self.runner = runner
+        self.executed: List[List[str]] = []
+
+    # --- command generation (Ec2BoxCreator.create equivalent) ---
+    def create_command(self) -> List[str]:
+        s = self.spec
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "create", s.name,
+               f"--zone={s.zone}", f"--accelerator-type={s.accelerator_type}",
+               f"--version={s.runtime_version}"]
+        if s.project:
+            cmd.append(f"--project={s.project}")
+        if s.preemptible:
+            cmd.append("--preemptible")
+        if s.network:
+            cmd.append(f"--network={s.network}")
+        for k, v in s.metadata.items():
+            cmd.append(f"--metadata={k}={v}")
+        return cmd
+
+    def delete_command(self) -> List[str]:
+        s = self.spec
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "delete", s.name,
+               f"--zone={s.zone}", "--quiet"]
+        if s.project:
+            cmd.append(f"--project={s.project}")
+        return cmd
+
+    def run_on_all_workers_command(self, remote_cmd: str) -> List[str]:
+        """Distributed launch: the same command on every pod worker — the
+        moral equivalent of ClusterSetup's parallel SSH provisioning."""
+        s = self.spec
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", s.name,
+               f"--zone={s.zone}", "--worker=all",
+               f"--command={remote_cmd}"]
+        if s.project:
+            cmd.append(f"--project={s.project}")
+        return cmd
+
+    def bootstrap_script(self, repo_url: str, entrypoint: str = "python train.py",
+                         env: Optional[Dict[str, str]] = None) -> str:
+        """Worker bootstrap shell script: deps + repo + `jax.distributed`-ready
+        launch (coordinator resolution is automatic on TPU pods)."""
+        lines = ["#!/usr/bin/env bash", "set -euo pipefail",
+                 "pip install -q 'jax[tpu]' optax flax 2>/dev/null || true",
+                 f"git clone {shlex.quote(repo_url)} app || (cd app && git pull)",
+                 "cd app"]
+        for k, v in (env or {}).items():
+            lines.append(f"export {k}={shlex.quote(v)}")
+        lines.append(entrypoint)
+        return "\n".join(lines) + "\n"
+
+    def plan(self, repo_url: str, entrypoint: str = "python train.py") -> List[List[str]]:
+        boot = self.bootstrap_script(repo_url, entrypoint)
+        return [self.create_command(),
+                self.run_on_all_workers_command(f"bash -c {shlex.quote(boot)}")]
+
+    # --- execution ---
+    def execute(self, commands: Sequence[List[str]]) -> int:
+        if self.runner is None:
+            raise RuntimeError("dry-run setup: inject runner= to execute")
+        for cmd in commands:
+            self.executed.append(list(cmd))
+            rc = self.runner(list(cmd))
+            if rc != 0:
+                return rc
+        return 0
