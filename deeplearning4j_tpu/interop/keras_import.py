@@ -104,13 +104,22 @@ class KerasHdf5Archive:
             return []
         lg = g[layer_name]
         names = [self._decode(n) for n in lg.attrs.get("weight_names", [])]
+        import h5py
+
         out = []
         for n in names:
-            # names are like "dense_1/kernel:0" relative to the layer group
+            # names are like "dense_1/kernel:0" relative to the layer group;
+            # some dialects repeat the layer name as a nested group and some
+            # don't, so a missing *intermediate* component is skipped — but the
+            # final node must be a dataset or the entry is malformed
             node = lg
             for part in n.split("/"):
                 if part in node:
                     node = node[part]
+            if not isinstance(node, h5py.Dataset):
+                raise InvalidKerasConfigurationException(
+                    f"weight_names entry '{n}' for layer '{layer_name}' does not "
+                    f"resolve to a dataset (got {type(node).__name__})")
             out.append(np.asarray(node))
         return out
 
@@ -631,18 +640,20 @@ def import_keras_sequential_model_and_weights(path: str, *, input_shape=None) ->
             layer_confs = layer_confs.get("layers", [])
         layers: List[Layer] = []
         confs: Dict[str, dict] = {}
-        th = False
+        # pre-pass: is the model channels_first? (the conf holding the input
+        # shape — e.g. a Keras-3 InputLayer — may not carry data_format, so
+        # decide before converting any shape)
+        th = any(_normalize_config(lc["class_name"], lc["config"], keras_major)[1]
+                 .get("data_format") == "channels_first" for lc in layer_confs)
         in_shape = tuple(input_shape) if input_shape is not None else None
         for lc in layer_confs:
             cls, conf = _normalize_config(lc["class_name"], lc["config"], keras_major)
             if in_shape is None:
                 s = _input_shape_from_conf(conf)
                 if s is not None:
-                    df = _data_format(conf)
-                    th = th or df == "channels_first"
+                    df = conf.get("data_format") or (
+                        "channels_first" if th else "channels_last")
                     in_shape = _nhwc_shape(s, df)
-            if conf.get("data_format") == "channels_first":
-                th = True
             converted = _convert_layer(cls, conf, ctx)
             if converted is None:
                 continue
@@ -659,7 +670,8 @@ def import_keras_sequential_model_and_weights(path: str, *, input_shape=None) ->
         model = Sequential(NetConfig(), layers, in_shape)
         model.init()
         _load_weights_sequential(model, ar, keras_major, confs,
-                                 th_ordering=th and keras_major < 2)
+                                 th_ordering=th and keras_major < 2,
+                                 channels_first=th)
         return model
 
 
@@ -669,14 +681,61 @@ def dataclass_replace(layer: Layer, **kw) -> Layer:
     return dataclasses.replace(layer, **kw)
 
 
+_FLATTEN_PASSTHROUGH = (DropoutLayer, ActivationLayer)
+
+
+def _chw_flatten_feeding_dense(model: Sequential, i: int,
+                               confs: Dict[str, dict]):
+    """If layer i (a Dense) is fed — possibly through weightless passthrough
+    layers (Dropout/Activation) — by a Flatten that emitted raw CHW order,
+    return that Flatten's 3D input shape, else None."""
+    j = i - 1
+    while j > 0 and isinstance(model.layers[j], _FLATTEN_PASSTHROUGH):
+        j -= 1
+    if (j >= 0 and isinstance(model.layers[j], Flatten)
+            and len(model.layer_input_shape(j)) == 3
+            and _flatten_was_chw(confs.get(model.layers[j].name))):
+        return model.layer_input_shape(j)
+    return None
+
+
+def _flatten_was_chw(flatten_conf: Optional[dict]) -> bool:
+    """True when the Keras Flatten emitted raw CHW order. Keras 2/3 Flatten
+    with data_format='channels_first' transposes to channels_last BEFORE
+    flattening (so no fix is needed); Keras 1 'th' and a default-format
+    Flatten fed a CHW tensor flatten raw."""
+    return (flatten_conf or {}).get("data_format") != "channels_first"
+
+
+def _reorder_flatten_dense_kernel(w: np.ndarray, pre_shape_hwc) -> np.ndarray:
+    """channels_first models flatten CHW at runtime but our NHWC runtime
+    flattens HWC; reorder the first post-Flatten Dense kernel's rows so
+    Flatten->Dense CNNs import correctly (reference parity: KerasFlatten.java
+    inserts a dim-order-aware CnnToFeedForwardPreProcessor)."""
+    h, wd, c = (int(d) for d in pre_shape_hwc)
+    n_out = w.shape[-1]
+    if w.shape[0] != h * wd * c:
+        raise InvalidKerasConfigurationException(
+            f"post-Flatten Dense kernel rows {w.shape[0]} != flattened input "
+            f"{h}*{wd}*{c}")
+    return np.ascontiguousarray(
+        w.reshape(c, h, wd, n_out).transpose(1, 2, 0, 3).reshape(h * wd * c, n_out))
+
+
 def _load_weights_sequential(model: Sequential, ar: KerasHdf5Archive, keras_major: int,
-                             confs: Dict[str, dict], th_ordering: bool = False) -> None:
+                             confs: Dict[str, dict], th_ordering: bool = False,
+                             channels_first: bool = False) -> None:
     for i, layer in enumerate(model.layers):
         if layer.name is None:
             continue
         arrays = ar.layer_weights(layer.name)
         if not arrays:
             continue
+        if channels_first and isinstance(layer, Dense) and i > 0:
+            pre_shape = _chw_flatten_feeding_dense(model, i, confs)
+            if pre_shape is not None:
+                arrays = [_reorder_flatten_dense_kernel(
+                    np.asarray(arrays[0]), pre_shape)] + list(arrays[1:])
         p, s = _convert_weights(layer, arrays, keras_major=keras_major,
                                 th_ordering=th_ordering, conf=confs.get(layer.name))
         key = layer.name or f"layer_{i}"
@@ -766,18 +825,21 @@ def import_keras_model_and_weights(path: str):
         # keras_name -> [graph node name per application] (shared-layer dup)
         app_nodes: Dict[str, List[str]] = {}
         confs: Dict[str, dict] = {}
-        th = False
+        # pre-pass (same reason as the Sequential loader): InputLayer confs
+        # don't carry data_format, so detect channels_first before shapes
+        th = any(_normalize_config(lc["class_name"], lc["config"], keras_major)[1]
+                 .get("data_format") == "channels_first" for lc in mc["layers"])
         for lc in mc["layers"]:
             cls, conf = _normalize_config(lc["class_name"], lc["config"], keras_major)
             name = lc.get("name") or conf.get("name")
             apps = _inbound_refs(lc.get("inbound_nodes", []))
-            if conf.get("data_format") == "channels_first":
-                th = True
             if cls == "InputLayer":
                 s = _input_shape_from_conf(conf)
                 if s is None:
                     raise InvalidKerasConfigurationException(f"InputLayer {name} missing shape")
-                gb.add_input(name, _nhwc_shape(s, _data_format(conf)))
+                df = conf.get("data_format") or (
+                    "channels_first" if th else "channels_last")
+                gb.add_input(name, _nhwc_shape(s, df))
                 app_nodes[name] = [name]
                 continue
             converted = _convert_layer(cls, conf, ctx)
@@ -820,6 +882,23 @@ def import_keras_model_and_weights(path: str):
             arrays = ar.layer_weights(keras_name)
             if not arrays:
                 continue
+            if th and isinstance(layer, Dense):
+                # walk back through weightless passthrough layers to the
+                # Flatten (if any) feeding this Dense
+                cur = graph.nodes[node_name].inputs[0] if graph.nodes[node_name].inputs else None
+                while (cur in graph.nodes and graph.nodes[cur].is_layer()
+                       and isinstance(graph.nodes[cur].spec, _FLATTEN_PASSTHROUGH)
+                       and graph.nodes[cur].inputs):
+                    cur = graph.nodes[cur].inputs[0]
+                if cur in graph.nodes:
+                    pred = graph.nodes[cur]
+                    pre_in = pred.inputs[0] if pred.inputs else None
+                    if (pred.is_layer() and isinstance(pred.spec, Flatten)
+                            and pre_in is not None
+                            and len(graph._shapes[pre_in]) == 3
+                            and _flatten_was_chw(confs.get(cur))):
+                        arrays = [_reorder_flatten_dense_kernel(
+                            np.asarray(arrays[0]), graph._shapes[pre_in])] + list(arrays[1:])
             p, s = _convert_weights(layer, arrays, keras_major=keras_major,
                                     th_ordering=th_ordering, conf=confs.get(node_name))
             if p:

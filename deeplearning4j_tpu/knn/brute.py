@@ -32,7 +32,14 @@ def _knn(points: Array, queries: Array, k: int, distance: str) -> Tuple[Array, A
     elif distance == "dot":
         score = queries @ points.T
     elif distance == "manhattan":
-        score = -jnp.sum(jnp.abs(queries[:, None, :] - points[None, :, :]), -1)
+        # no matmul form exists for L1; lax.map (vmapped internally in blocks
+        # of batch_size) bounds peak HBM at O(block*N*D) instead of the full
+        # (Q,N,D) broadcast
+        f = lambda q: jnp.sum(jnp.abs(q[None, :] - points), -1)
+        try:
+            score = -jax.lax.map(f, queries, batch_size=32)
+        except TypeError:  # older jax without batch_size: one row at a time
+            score = -jax.lax.map(f, queries)
     else:
         raise ValueError(f"Unknown distance '{distance}'")
     top, idx = jax.lax.top_k(score, k)

@@ -441,6 +441,7 @@ class Graph:
         rngs = dict(zip(layer_names, jax.random.split(rng, max(len(layer_names), 1)))) if rng is not None else {}
         total = jnp.asarray(0.0, jnp.float32)
         out_idx = {o: i for i, o in enumerate(self.outputs)}
+        consumed = {i for node in self.nodes.values() for i in node.inputs}
         for name in self.topo_order:
             node = self.nodes[name]
             ins = [acts[i] for i in node.inputs]
@@ -462,7 +463,8 @@ class Graph:
                 if cdt is not None:  # accumulate in f32 under bf16 compute;
                     loss = loss.astype(jnp.float32)  # full precision otherwise
                 total = total + loss
-                # still produce activation for downstream vertices if any
+                if name not in consumed:  # leaf output: nothing downstream
+                    continue              # needs its activation — skip apply
                 y, s_out, m_out = node.spec.apply(p, state.get(name, {}),
                                                   ins[0], training=training, rng=rngs.get(name),
                                                   mask=act_masks.get(node.inputs[0]))

@@ -32,23 +32,38 @@ class DivergenceListener(TrainingListener):
     to the last finite-loss snapshot (action='rollback')."""
 
     def __init__(self, action: str = "raise", snapshot_every: int = 10,
-                 max_rollbacks: int = 3):
+                 max_rollbacks: int = 3, lr_backoff: float = 0.5):
         assert action in ("raise", "rollback")
         self.action = action
         self.snapshot_every = max(snapshot_every, 1)
         self.max_rollbacks = max_rollbacks
+        self.lr_backoff = lr_backoff
+        self.lr_scale = 1.0
         self.rollbacks = 0
+        # two-stage snapshot: the loss reported at iteration k was computed
+        # from the params BEFORE that step's update, so the params captured at
+        # iteration k are unvalidated until a LATER finite loss confirms them.
+        # _pending holds the newest (unvalidated) capture; _snap only ever
+        # holds a capture whose params a later step scored finite.
+        self._pending = None
         self._snap = None
 
     def iteration_done(self, trainer, iteration, epoch, loss):
         import jax
 
         if math.isfinite(loss):
+            if self._pending is not None:
+                self._snap = self._pending  # validated by this finite loss
+                self._pending = None
             if iteration % self.snapshot_every == 0:
-                # host copies: the jitted step donates the device buffers
-                self._snap = (jax.tree.map(np.asarray, trainer.params),
-                              jax.tree.map(np.asarray, trainer.opt_state))
+                # host copies: the jitted step donates the device buffers.
+                # Record whether the opt state was captured in the chained
+                # (post-rollback) structure so a later restore can re-wrap.
+                self._pending = (jax.tree.map(np.asarray, trainer.params),
+                                 jax.tree.map(np.asarray, trainer.opt_state),
+                                 getattr(trainer, "_base_tx", None) is not None)
             return
+        self._pending = None  # produced this non-finite loss: poison
         if self.action == "raise" or self._snap is None:
             raise TrainingDivergedException(
                 f"loss {loss} at iteration {iteration} (epoch {epoch})")
@@ -56,9 +71,25 @@ class DivergenceListener(TrainingListener):
             raise TrainingDivergedException(
                 f"diverged {self.rollbacks + 1}x despite rollbacks")
         self.rollbacks += 1
-        params, opt_state = self._snap
+        params, opt_state, snap_chained = self._snap
         trainer.params = jax.tree.map(lambda a: a, params)
         trainer.opt_state = jax.tree.map(lambda a: a, opt_state)
+        # shrink the learning rate so a deterministic replay of the same data
+        # order doesn't re-diverge identically: chain a (stateless) scale
+        # stage onto the optimizer and rebuild the jitted step
+        import optax
+
+        self.lr_scale *= self.lr_backoff
+        if not snap_chained:
+            # opt-state gains the scale stage's EmptyState; snapshots taken
+            # after the first rollback already carry the chained structure
+            trainer.opt_state = (trainer.opt_state,
+                                 optax.scale(1.0).init(trainer.params))
+        if getattr(trainer, "_base_tx", None) is None:
+            trainer._base_tx = trainer.tx
+        trainer.tx = optax.chain(trainer._base_tx, optax.scale(self.lr_scale))
+        trainer._step_fn = None
+        trainer._tbptt_step_fn = None
 
 
 class FaultTolerantFit:

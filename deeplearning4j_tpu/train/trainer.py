@@ -169,6 +169,8 @@ class Trainer:
                 for lst in listeners:
                     if isinstance(lst, PerformanceListener):
                         lst.step_begin(bs)
+                if self._step_fn is None:  # invalidated mid-fit (e.g. a
+                    self._step_fn = self._make_step()  # rollback listener)
                 if tbptt and np.asarray(ds.features).ndim >= 3:
                     loss = self._fit_tbptt_batch(ds, tbptt)
                 else:

@@ -62,6 +62,74 @@ class TestSequentialImport:
         got = np.asarray(model.output(x))
         np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
 
+    def test_channels_first_flatten_dense_golden(self, tmp_path):
+        """channels_first CNN with raw-CHW Flatten: the post-Flatten Dense
+        kernel rows must be reordered (ADVICE r1: silently wrong before)."""
+        km = keras.Sequential([
+            layers.Input((3, 8, 10)),  # NCHW: C=3, H=8, W=10
+            layers.Conv2D(4, 3, padding="same", activation="relu",
+                          data_format="channels_first"),
+            layers.MaxPooling2D(2, data_format="channels_first"),
+            layers.Flatten(),  # default data_format -> flattens raw CHW
+            layers.Dense(5),
+        ])
+        path = _save(tmp_path, km, "cf.h5")
+        model = import_keras_sequential_model_and_weights(path)
+        # imported model is NHWC: input shape converts (3,8,10) -> (8,10,3)
+        assert model.input_shape == (8, 10, 3)
+        x = np.random.RandomState(7).randn(2, 3, 8, 10).astype(np.float32)
+        want = np.asarray(km(x))
+        got = np.asarray(model.output(np.transpose(x, (0, 2, 3, 1))))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_channels_first_flatten_dropout_dense_golden(self, tmp_path):
+        """The reorder must fire through weightless passthrough layers
+        (Flatten -> Dropout -> Dense)."""
+        km = keras.Sequential([
+            layers.Input((3, 5, 7)),
+            layers.Conv2D(4, 3, padding="same", data_format="channels_first"),
+            layers.Flatten(),
+            layers.Dropout(0.5),
+            layers.Activation("relu"),
+            layers.Dense(6),
+        ])
+        path = _save(tmp_path, km, "cf_do.h5")
+        model = import_keras_sequential_model_and_weights(path)
+        x = np.random.RandomState(9).randn(2, 3, 5, 7).astype(np.float32)
+        want = np.asarray(km(x, training=False))
+        got = np.asarray(model.output(np.transpose(x, (0, 2, 3, 1))))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_channels_first_functional_golden(self, tmp_path):
+        inp = keras.Input((3, 6, 4))
+        h = layers.Conv2D(5, 3, padding="same", data_format="channels_first")(inp)
+        h = layers.Flatten()(h)
+        h = layers.Dropout(0.3)(h)
+        out = layers.Dense(4)(h)
+        km = keras.Model(inp, out)
+        path = _save(tmp_path, km, "cf_fn.h5")
+        model = import_keras_model_and_weights(path)
+        x = np.random.RandomState(10).randn(2, 3, 6, 4).astype(np.float32)
+        want = np.asarray(km(x, training=False))
+        got = np.asarray(model.output(np.transpose(x, (0, 2, 3, 1)))[0])
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_channels_first_transposing_flatten_golden(self, tmp_path):
+        """Flatten(data_format='channels_first') transposes to channels_last
+        before flattening — no Dense reorder must be applied."""
+        km = keras.Sequential([
+            layers.Input((3, 6, 6)),
+            layers.Conv2D(4, 3, padding="same", data_format="channels_first"),
+            layers.Flatten(data_format="channels_first"),
+            layers.Dense(4),
+        ])
+        path = _save(tmp_path, km, "cf2.h5")
+        model = import_keras_sequential_model_and_weights(path)
+        x = np.random.RandomState(8).randn(2, 3, 6, 6).astype(np.float32)
+        want = np.asarray(km(x))
+        got = np.asarray(model.output(np.transpose(x, (0, 2, 3, 1))))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
     def test_batchnorm_inference_golden(self, tmp_path):
         km = keras.Sequential([
             layers.Input((6, 6, 2)),

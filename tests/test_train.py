@@ -222,3 +222,51 @@ class TestEarlyStopping:
         res = EarlyStoppingTrainer(cfg, tr).fit(ArrayIterator(x, y, 32), max_epochs=10)
         best = cfg.model_saver.get_best()
         assert best is not None and np.isfinite(best[2])
+
+
+class TestFaults:
+    def test_divergence_rollback_scales_lr(self, iris):
+        """Rollback restores the snapshot AND shrinks the LR so a
+        deterministic replay doesn't re-diverge identically (ADVICE r1)."""
+        from deeplearning4j_tpu.train.faults import (DivergenceListener,
+                                                     TrainingDivergedException)
+
+        x, y = iris
+        tr = Trainer(iris_net())
+        lst = DivergenceListener(action="rollback", snapshot_every=1,
+                                 max_rollbacks=2, lr_backoff=0.5)
+        # run a couple of clean iterations to take a snapshot
+        tr.fit(ArrayIterator(x, y, 64), epochs=1, listeners=[lst])
+        snap_params = jax.tree.map(np.asarray, lst._snap[0])
+        # simulate a diverged iteration
+        tr.params = jax.tree.map(lambda a: jnp.asarray(a) * np.nan, tr.params)
+        lst.iteration_done(tr, iteration=99, epoch=0, loss=float("nan"))
+        assert lst.rollbacks == 1 and lst.lr_scale == 0.5
+        got = jax.tree.map(np.asarray, tr.params)
+        jax.tree.map(np.testing.assert_allclose, got, snap_params)
+        assert tr._step_fn is None  # step rebuilt with the scaled optimizer
+        # training continues with the chained (scaled) optimizer
+        tr.fit(ArrayIterator(x, y, 64), epochs=1, listeners=[lst])
+        assert all(np.isfinite(np.asarray(v)).all()
+                   for v in jax.tree_util.tree_leaves(tr.params))
+        # second divergence halves again; third raises
+        tr.params = jax.tree.map(lambda a: jnp.asarray(a) * np.nan, tr.params)
+        lst.iteration_done(tr, iteration=199, epoch=1, loss=float("nan"))
+        assert lst.lr_scale == 0.25
+        tr.params = jax.tree.map(lambda a: jnp.asarray(a) * np.nan, tr.params)
+        with pytest.raises(TrainingDivergedException):
+            lst.iteration_done(tr, iteration=299, epoch=2, loss=float("nan"))
+
+    def test_fault_tolerant_fit_resumes(self, iris, tmp_path):
+        from deeplearning4j_tpu.train.faults import FaultTolerantFit
+
+        x, y = iris
+        tr = Trainer(iris_net())
+        ftf = FaultTolerantFit(tr, str(tmp_path), segment_epochs=2)
+        ftf.fit(ArrayIterator(x, y, 64), epochs=4)
+        assert ftf.completed_epochs() == 4
+        # a "restarted" process resumes past epochs without re-running them
+        tr2 = Trainer(iris_net())
+        ftf2 = FaultTolerantFit(tr2, str(tmp_path), segment_epochs=2)
+        ftf2.fit(ArrayIterator(x, y, 64), epochs=4)  # no-op: already complete
+        assert ftf2.completed_epochs() == 4
