@@ -640,14 +640,15 @@ def import_keras_sequential_model_and_weights(path: str, *, input_shape=None) ->
             layer_confs = layer_confs.get("layers", [])
         layers: List[Layer] = []
         confs: Dict[str, dict] = {}
-        # pre-pass: is the model channels_first? (the conf holding the input
-        # shape — e.g. a Keras-3 InputLayer — may not carry data_format, so
-        # decide before converting any shape)
-        th = any(_normalize_config(lc["class_name"], lc["config"], keras_major)[1]
-                 .get("data_format") == "channels_first" for lc in layer_confs)
+        # normalize once; then decide channels_first BEFORE converting any
+        # shape (the conf holding the input shape — e.g. a Keras-3
+        # InputLayer — may not carry data_format)
+        normalized = [(_normalize_config(lc["class_name"], lc["config"], keras_major), lc)
+                      for lc in layer_confs]
+        th = any(conf.get("data_format") == "channels_first"
+                 for (_, conf), _ in normalized)
         in_shape = tuple(input_shape) if input_shape is not None else None
-        for lc in layer_confs:
-            cls, conf = _normalize_config(lc["class_name"], lc["config"], keras_major)
+        for (cls, conf), lc in normalized:
             if in_shape is None:
                 s = _input_shape_from_conf(conf)
                 if s is not None:
@@ -825,12 +826,13 @@ def import_keras_model_and_weights(path: str):
         # keras_name -> [graph node name per application] (shared-layer dup)
         app_nodes: Dict[str, List[str]] = {}
         confs: Dict[str, dict] = {}
-        # pre-pass (same reason as the Sequential loader): InputLayer confs
-        # don't carry data_format, so detect channels_first before shapes
-        th = any(_normalize_config(lc["class_name"], lc["config"], keras_major)[1]
-                 .get("data_format") == "channels_first" for lc in mc["layers"])
-        for lc in mc["layers"]:
-            cls, conf = _normalize_config(lc["class_name"], lc["config"], keras_major)
+        # normalize once; detect channels_first before any shape conversion
+        # (same reason as the Sequential loader)
+        normalized = [(_normalize_config(lc["class_name"], lc["config"], keras_major), lc)
+                      for lc in mc["layers"]]
+        th = any(conf.get("data_format") == "channels_first"
+                 for (_, conf), _ in normalized)
+        for (cls, conf), lc in normalized:
             name = lc.get("name") or conf.get("name")
             apps = _inbound_refs(lc.get("inbound_nodes", []))
             if cls == "InputLayer":

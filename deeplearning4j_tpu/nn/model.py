@@ -228,7 +228,7 @@ class Sequential:
         return x, new_state, new_carries
 
     def score_with_carry(self, params, state, x, labels, carries, *, training=True,
-                         rng=None, mask=None):
+                         rng=None, mask=None, label_mask=None):
         out_layer = self.layers[-1]
         n = len(self.layers)
         rngs = jax.random.split(rng, n) if rng is not None else [None] * n
@@ -248,7 +248,8 @@ class Sequential:
                 if s_out:
                     new_state[k] = s_out
         k = _layer_key(n - 1, out_layer)
-        loss = out_layer.score(params.get(k, {}), state.get(k, {}), h, labels, mask=m)
+        loss = out_layer.score(params.get(k, {}), state.get(k, {}), h, labels,
+                               mask=label_mask if label_mask is not None else m)
         return loss, new_state, new_carries
 
     # --- serde (MultiLayerConfiguration.toJson/fromJson) ---

@@ -11,6 +11,8 @@ from .inference import ParallelInference
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    cpu_test_mesh, distributed_init, make_mesh, replicate,
                    shard_batch)
+from .multihost import (MultiHostTrainer, ProcessShardIterator,
+                        initialize_multihost)
 from .ring_attention import (reference_attention, ring_attention,
                              ring_attention_local)
 from .sharding import (CNN_RULES, TRANSFORMER_RULES, constrain_activations,
@@ -18,7 +20,8 @@ from .sharding import (CNN_RULES, TRANSFORMER_RULES, constrain_activations,
 from .wrapper import ParallelWrapper
 
 __all__ = ["CNN_RULES", "DATA_AXIS", "EXPERT_AXIS", "EncodedGradientsAccumulator",
-           "MODEL_AXIS", "PIPE_AXIS", "ParallelInference", "ParallelWrapper",
+           "MODEL_AXIS", "MultiHostTrainer", "PIPE_AXIS", "ParallelInference",
+           "ParallelWrapper", "ProcessShardIterator", "initialize_multihost",
            "SEQ_AXIS", "SparseUpdate", "TRANSFORMER_RULES", "bitmap_decode",
            "bitmap_encode", "constrain_activations", "cpu_test_mesh",
            "distributed_init", "make_mesh", "reference_attention", "replicate",

@@ -141,7 +141,10 @@ class ParallelWrapper:
     # --- fit loop (ParallelWrapper.fit :467) ---
     def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = ()):
         from ..data.iterators import AsyncIterator
+        from ..train.listeners import DeferredScoreReporter
 
+        reporter = DeferredScoreReporter(
+            self, listeners, reduce=lambda l: float(np.mean(jax.device_get(l))))
         for epoch in range(epochs):
             self.epoch = epoch
             for lst in listeners:
@@ -158,10 +161,9 @@ class ParallelWrapper:
                     if isinstance(lst, PerformanceListener):
                         lst.step_begin(b)
                 loss = self._fit_batch(x, y, ds.features_mask)
-                lossf = float(np.mean(jax.device_get(loss)))
-                for lst in listeners:
-                    lst.iteration_done(self, self.iteration, epoch, lossf)
+                reporter.report(self.iteration, epoch, loss)
                 self.iteration += 1
+            reporter.flush()
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for lst in listeners:

@@ -31,6 +31,10 @@ class DivergenceListener(TrainingListener):
     ``TrainingDivergedException`` (action='raise') or rolls the trainer back
     to the last finite-loss snapshot (action='rollback')."""
 
+    # steers the loop from iteration_done (rollback must act before the next
+    # dispatch), so the fit loops must not defer this listener's reporting
+    requires_sync = True
+
     def __init__(self, action: str = "raise", snapshot_every: int = 10,
                  max_rollbacks: int = 3, lr_backoff: float = 0.5):
         assert action in ("raise", "rollback")

@@ -18,7 +18,16 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class TrainingListener:
-    """Hook contract (TrainingListener.java)."""
+    """Hook contract (TrainingListener.java).
+
+    ``requires_sync``: set True on listeners that steer the training loop
+    from ``iteration_done`` (rollbacks, optimizer swaps). The fit loops
+    normally defer the loss readback of iteration k until after iteration
+    k+1 has been dispatched (keeps the device busy); a sync listener forces
+    in-order reporting so its control flow acts before the next dispatch.
+    """
+
+    requires_sync: bool = False
 
     def on_epoch_start(self, trainer, epoch: int):
         pass
@@ -28,6 +37,42 @@ class TrainingListener:
 
     def iteration_done(self, trainer, iteration: int, epoch: int, loss: float):
         pass
+
+
+class DeferredScoreReporter:
+    """Shared loss-reporting pipeline for the fit loops (Trainer,
+    MultiHostTrainer, ParallelWrapper): holds the device scalar of the
+    previous iteration and reads it back only after the next step has been
+    dispatched, so dispatch overlaps compute. Degrades to synchronous
+    reporting when any listener ``requires_sync``. Every iteration is
+    reported exactly once, in order."""
+
+    def __init__(self, trainer, listeners, reduce=float):
+        self.trainer = trainer
+        self.listeners = list(listeners)
+        self.reduce = reduce  # device scalar -> float
+        self.lagged = not any(getattr(l, "requires_sync", False)
+                              for l in self.listeners)
+        self._pending = None
+
+    def flush(self):
+        if self._pending is None:
+            return
+        it_idx, epoch, loss_dev = self._pending
+        self._pending = None
+        lossf = self.reduce(loss_dev)
+        for lst in self.listeners:
+            lst.iteration_done(self.trainer, it_idx, epoch, lossf)
+
+    def report(self, iteration: int, epoch: int, loss_dev):
+        """Call right after dispatching ``iteration``'s step."""
+        if self.lagged:
+            # flush the PREVIOUS iteration (overlaps with the one in flight)
+            self.flush()
+            self._pending = (iteration, epoch, loss_dev)
+        else:
+            self._pending = (iteration, epoch, loss_dev)
+            self.flush()
 
 
 class ScoreIterationListener(TrainingListener):

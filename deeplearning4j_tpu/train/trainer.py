@@ -125,19 +125,21 @@ class Trainer:
 
         return step
 
-    def _make_tbptt_step(self, chunk: int):
+    def _make_tbptt_step(self):
         tx, model = self.tx, self.model
         assert isinstance(model, Sequential), "tBPTT fit targets Sequential RNNs"
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=())
-        def step(params, opt_state, net_state, x, y, rng, carries, mask=None):
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, opt_state, net_state, x, y, rng, carries, mask=None,
+                 label_mask=None):
             """One tBPTT chunk: grads flow within the chunk; carries are
             stop-gradient at the boundary (DL4J doTruncatedBPTT parity)."""
             carries = jax.lax.stop_gradient(carries)
 
             def loss_fn(p):
                 loss, new_state, new_carries = model.score_with_carry(
-                    p, net_state, x, y, carries, training=True, rng=rng, mask=mask)
+                    p, net_state, x, y, carries, training=True, rng=rng,
+                    mask=mask, label_mask=label_mask)
                 return loss, (new_state, new_carries)
 
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -154,11 +156,21 @@ class Trainer:
     # --- fit (MultiLayerNetwork.fit :1262 / ComputationGraph.fit :1010) ---
     def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = (),
             prefetch: bool = True) -> "Trainer":
+        """Streaming hot loop: the loss readback for iteration k happens only
+        AFTER iteration k+1 has been dispatched, so the device never idles
+        waiting on the host (the reference keeps the device busy with its
+        async prefetch thread, MultiLayerNetwork.java:1266-1268; a per-step
+        ``float(loss)`` here would serialize dispatch with compute). Every
+        iteration is still reported to listeners exactly once, in order —
+        just one step late; epoch end flushes."""
         from ..data.iterators import AsyncIterator
+        from .listeners import DeferredScoreReporter
 
         if self._step_fn is None:
             self._step_fn = self._make_step()
         tbptt = getattr(self.model.config, "tbptt_length", 0)
+        reporter = DeferredScoreReporter(self, listeners)
+
         for epoch in range(epochs):
             self.epoch = epoch
             for lst in listeners:
@@ -178,10 +190,9 @@ class Trainer:
                         self.params, self.opt_state, self.state,
                         ds.features, ds.labels, self.next_rng(),
                         ds.features_mask, ds.labels_mask)
-                lossf = float(loss)
-                for lst in listeners:
-                    lst.iteration_done(self, self.iteration, epoch, lossf)
+                reporter.report(self.iteration, epoch, loss)
                 self.iteration += 1
+            reporter.flush()
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for lst in listeners:
@@ -190,26 +201,34 @@ class Trainer:
         return self
 
     def _fit_tbptt_batch(self, ds, chunk: int):
+        """Per-batch tBPTT chunk loop. No host syncs inside: chunk losses
+        accumulate on device and the mean comes back as one device scalar."""
         if self._tbptt_step_fn is None:
-            self._tbptt_step_fn = self._make_tbptt_step(chunk)
+            self._tbptt_step_fn = self._make_tbptt_step()
         x = np.asarray(ds.features)
         y = np.asarray(ds.labels)
+        fm = np.asarray(ds.features_mask) if ds.features_mask is not None else None
+        lm = np.asarray(ds.labels_mask) if ds.labels_mask is not None else None
         B, T = x.shape[0], x.shape[1]
         carries = self.model.init_carries(B)
-        loss = 0.0
+        loss = None
         n_chunks = 0
         for t0 in range(0, T, chunk):
             xc, yc = x[:, t0 : t0 + chunk], y[:, t0 : t0 + chunk]
-            mc = np.asarray(ds.features_mask)[:, t0 : t0 + chunk] if ds.features_mask is not None else None
+            mc = fm[:, t0 : t0 + chunk] if fm is not None else None
+            lmc = lm[:, t0 : t0 + chunk] if lm is not None else None
             if xc.shape[1] < chunk:  # ragged tail: pad + mask (static shapes for jit)
                 pad = chunk - xc.shape[1]
                 xc = np.pad(xc, [(0, 0), (0, pad)] + [(0, 0)] * (xc.ndim - 2))
                 yc = np.pad(yc, [(0, 0), (0, pad)] + [(0, 0)] * (yc.ndim - 2))
                 mc = np.pad(mc if mc is not None else np.ones((B, chunk - pad), np.float32),
                             [(0, 0), (0, pad)])
+                if lmc is not None:
+                    lmc = np.pad(lmc, [(0, 0), (0, pad)])
             self.params, self.opt_state, self.state, carries, l = self._tbptt_step_fn(
-                self.params, self.opt_state, self.state, xc, yc, self.next_rng(), carries, mc)
-            loss += float(l)
+                self.params, self.opt_state, self.state, xc, yc, self.next_rng(),
+                carries, mc, lmc)
+            loss = l if loss is None else loss + l
             n_chunks += 1
         return loss / max(n_chunks, 1)
 

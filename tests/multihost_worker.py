@@ -1,0 +1,71 @@
+"""Subprocess worker for the multi-process equivalence test.
+
+Each OS process: jax.distributed bootstrap over a local coordinator (gloo CPU
+collectives — the test-time substitute for a TPU pod slice), train a fixed
+tiny MLP on its shard of a deterministic synthetic dataset via
+MultiHostTrainer, then process 0 dumps the final params + per-step losses.
+
+Usage: python multihost_worker.py <pid> <nprocs> <port> <outdir>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # exactly 1 local CPU device per process
+
+
+def main():
+    pid, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                 sys.argv[3], sys.argv[4])
+    import jax
+
+    from deeplearning4j_tpu.parallel import (MultiHostTrainer,
+                                             ProcessShardIterator,
+                                             initialize_multihost)
+
+    initialize_multihost(f"127.0.0.1:{port}", nprocs, pid,
+                         cpu_collectives="gloo")
+    assert jax.process_count() == nprocs
+    import numpy as np
+
+    from deeplearning4j_tpu.train.listeners import CollectScoresListener
+
+    x, y = make_data()
+    net = build_net()
+    tr = MultiHostTrainer(net, seed=0)
+    col = CollectScoresListener()
+    it = ProcessShardIterator(x, y, global_batch_size=16)
+    tr.fit(it, epochs=3, listeners=[col])
+    if pid == 0:
+        flat = {f"{k}/{k2}": np.asarray(v2)
+                for k, v in tr.model.params.items() for k2, v2 in v.items()}
+        np.savez(os.path.join(outdir, "multihost_params.npz"),
+                 losses=np.asarray([s for _, s in col.scores]), **flat)
+    print(f"worker {pid} done", flush=True)
+
+
+def make_data():
+    import numpy as np
+
+    rng = np.random.RandomState(42)
+    x = rng.randn(64, 6).astype(np.float32)
+    w = rng.randn(6, 3).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+def build_net():
+    from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+    from deeplearning4j_tpu.nn import layers as L
+
+    return (SequentialBuilder(NetConfig(seed=7, updater={"type": "adam",
+                                                         "learning_rate": 5e-2}))
+            .input_shape(6)
+            .layer(L.Dense(n_out=12, activation="tanh"))
+            .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+
+
+if __name__ == "__main__":
+    main()
