@@ -54,8 +54,7 @@ def _row_scale(n_rows, idx, *more_idx):
     return jnp.sqrt(jnp.maximum(c, 1.0))
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _skipgram_ns_step(syn0, syn1, centers, contexts, negatives, lr):
+def _skipgram_ns_math(syn0, syn1, centers, contexts, negatives, lr):
     """Skip-gram + negative sampling. centers/contexts: (B,), negatives: (B,K)."""
     v_in = syn0[centers]                       # (B, D)
     v_pos = syn1[contexts]                     # (B, D)
@@ -83,8 +82,10 @@ def _skipgram_ns_step(syn0, syn1, centers, contexts, negatives, lr):
     return syn0, syn1, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _skipgram_hs_step(syn0, syn1, centers, codes, points, mask, lr):
+_skipgram_ns_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_skipgram_ns_math)
+
+
+def _skipgram_hs_math(syn0, syn1, centers, codes, points, mask, lr):
     """Skip-gram + hierarchical softmax. codes/points/mask: (B, L) along the
     context word's Huffman path (padded). Inner nodes near the Huffman root
     appear on nearly every path, so path-row updates are count-normalized
@@ -108,8 +109,10 @@ def _skipgram_hs_step(syn0, syn1, centers, codes, points, mask, lr):
     return syn0, syn1, loss
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _cbow_ns_step(syn0, syn1, context_idx, context_mask, targets, negatives, lr):
+_skipgram_hs_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_skipgram_hs_math)
+
+
+def _cbow_ns_math(syn0, syn1, context_idx, context_mask, targets, negatives, lr):
     """CBOW + negative sampling. context_idx: (B, W) padded window,
     context_mask: (B, W), targets: (B,), negatives: (B, K)."""
     v_ctx = syn0[context_idx] * context_mask[..., None]       # (B, W, D)
@@ -138,6 +141,9 @@ def _cbow_ns_step(syn0, syn1, context_idx, context_mask, targets, negatives, lr)
     syn1 = syn1.at[neg_flat].add(-lr * grad_neg_flat)
     syn0 = syn0.at[ctx_flat].add(-lr * grad_ctx_flat)
     return syn0, syn1, loss
+
+
+_cbow_ns_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_cbow_ns_math)
 
 
 @jax.jit
@@ -202,6 +208,9 @@ class SequenceVectors:
         self._neg_probs = unigram_table(vocab)
         if negative == 0:
             self._codes, self._points, self._hs_mask = huffman_tensors(vocab)
+        self._step_ns = _skipgram_ns_step
+        self._step_hs = _skipgram_hs_step
+        self._step_cbow = _cbow_ns_step
 
     # ----- host-side sampling of one epoch of training pairs ---------------
 
@@ -286,7 +295,7 @@ class SequenceVectors:
                     neg = rng.choice(len(self.vocab), size=(len(bt), max(self.negative, 1)),
                                      p=self._neg_probs)
                     lr = self._lr(step, total_steps)
-                    self.syn0, self.syn1, loss = _cbow_ns_step(
+                    self.syn0, self.syn1, loss = self._step_cbow(
                         self.syn0, self.syn1, jnp.asarray(bc), jnp.asarray(bm),
                         jnp.asarray(bt), jnp.asarray(neg), lr)
                     ep_loss += float(loss); nb += 1; step += 1
@@ -304,11 +313,11 @@ class SequenceVectors:
                     if self.negative > 0:
                         neg = rng.choice(len(self.vocab), size=(len(bc), self.negative),
                                          p=self._neg_probs)
-                        self.syn0, self.syn1, loss = _skipgram_ns_step(
+                        self.syn0, self.syn1, loss = self._step_ns(
                             self.syn0, self.syn1, jnp.asarray(bc), jnp.asarray(bx),
                             jnp.asarray(neg), lr)
                     else:
-                        self.syn0, self.syn1, loss = _skipgram_hs_step(
+                        self.syn0, self.syn1, loss = self._step_hs(
                             self.syn0, self.syn1, jnp.asarray(bc),
                             jnp.asarray(self._codes[bx]), jnp.asarray(self._points[bx]),
                             jnp.asarray(self._hs_mask[bx]), lr)
@@ -353,3 +362,69 @@ class SequenceVectors:
         sims[index] = -np.inf
         top = np.argsort(-sims)[:top_n]
         return [(int(t), float(sims[t])) for t in top]
+
+
+class ShardedSequenceVectors(SequenceVectors):
+    """Distributed embedding training over a device mesh — the TPU-native
+    redesign of the reference's Spark embedding layer
+    (``dl4j-spark-nlp-java8/.../sequencevectors/SparkSequenceVectors.java:174``
+    trains with a VoidParameterServer holding sharded lookup tables;
+    ``models/embeddings/inmemory/InMemoryLookupTable.java`` is the
+    single-machine table it shards).
+
+    Design: syn0/syn1 rows (the vocab dim) are sharded over the ``model``
+    mesh axis — the parameter-server shard map, expressed as a NamedSharding;
+    batches are sharded over the ``data`` axis. The SAME update math as the
+    single-device steps runs under jit with those shardings, and GSPMD
+    inserts the gather/scatter collectives the reference routed through
+    Aeron. SPMD partitioning preserves semantics, so sharded training is
+    numerically identical to single-device training — asserted by
+    ``tests/test_nlp.py``'s equivalence test.
+
+    The vocab is padded up to a multiple of the model-axis size (padded rows
+    are never sampled: indices always come from the real vocab).
+    """
+
+    def __init__(self, vocab: VocabCache, mesh=None, **kw):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+        super().__init__(vocab, **kw)
+        if mesh is None:
+            n = len(jax.devices())
+            mesh = make_mesh({DATA_AXIS: 1, MODEL_AXIS: n})
+        self.mesh = mesh
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        mp = axes.get(MODEL_AXIS, 1)
+        dp = axes.get(DATA_AXIS, 1)
+        if self.batch_size % max(dp, 1):
+            raise ValueError(f"batch_size {self.batch_size} must divide over "
+                             f"data axis {dp}")
+        V, D = self.syn0.shape
+        pad_rows = (-V) % mp
+        if pad_rows:
+            z = jnp.zeros((pad_rows, D), self.syn0.dtype)
+            self.syn0 = jnp.concatenate([self.syn0, z])
+            self.syn1 = jnp.concatenate([self.syn1, z])
+        self._V_logical = V
+        table_sh = NamedSharding(mesh, P(MODEL_AXIS, None))
+        batch_sh = NamedSharding(mesh, P(DATA_AXIS))
+        self.syn0 = jax.device_put(self.syn0, table_sh)
+        self.syn1 = jax.device_put(self.syn1, table_sh)
+
+        def sharded(fn, n_batch_args):
+            # tables sharded over vocab rows, index batches over data, lr
+            # replicated; outputs keep the table sharding
+            in_sh = (table_sh, table_sh) + (batch_sh,) * n_batch_args + (None,)
+            return jax.jit(fn, in_shardings=in_sh,
+                           out_shardings=(table_sh, table_sh, None),
+                           donate_argnums=(0, 1))
+
+        self._step_ns = sharded(_skipgram_ns_math, 3)
+        self._step_hs = sharded(_skipgram_hs_math, 4)
+        self._step_cbow = sharded(_cbow_ns_math, 4)
+
+    @property
+    def vectors(self) -> np.ndarray:
+        return np.asarray(self.syn0)[: self._V_logical]

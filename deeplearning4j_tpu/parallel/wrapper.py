@@ -38,12 +38,24 @@ from .mesh import DATA_AXIS, make_mesh
 class ParallelWrapper:
     """Single-host multi-device data-parallel trainer (ParallelWrapper.Builder parity).
 
-    mode: "shared_gradients" (default; sync all-reduce) | "averaging".
+    mode:
+    - "shared_gradients" (default): ONE sharded jit per step; GSPMD inserts a
+      dense gradient all-reduce over ICI. The fast path.
+    - "averaging": independent replicas, params (+updater state) averaged
+      every ``averaging_frequency`` iterations (TrainingMode.AVERAGING).
+    - "encoded_gradients": per-worker threshold-compressed update exchange
+      with device-resident residuals — the bandwidth-constrained (DCN/
+      cross-slice) option, EncodedGradientsAccumulator parity. Knobs (this
+      mode only): ``threshold`` (quantization magnitude), ``capacity_frac``
+      (max fraction of params per message), ``quantize`` (True: ND4J-parity
+      ±threshold messages; False: exact top-k values — dense-equivalent as
+      threshold→0 with full capacity).
     """
 
     def __init__(self, model, mesh: Optional[Mesh] = None, mode: str = "shared_gradients",
                  averaging_frequency: int = 5, average_updater_state: bool = True,
-                 seed: int = 0):
+                 seed: int = 0, threshold: float = 1e-3,
+                 capacity_frac: float = 0.05, quantize: bool = True):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
@@ -56,11 +68,16 @@ class ParallelWrapper:
         self._rng = jax.random.PRNGKey(seed)
         self.iteration = 0
         self.epoch = 0
+        self.threshold = threshold
+        self.capacity_frac = capacity_frac
+        self.quantize = quantize
 
         if mode == "shared_gradients":
             self._init_sync()
         elif mode == "averaging":
             self._init_averaging()
+        elif mode == "encoded_gradients":
+            self._init_encoded()
         else:
             raise ValueError(f"Unknown mode '{mode}'")
 
@@ -107,27 +124,38 @@ class ParallelWrapper:
         self.opt_state = jax.device_put(stack(tx.init(model.params)), dev_sh)
         self._batch_sharding = dev_sh
 
-        def local_step(params, opt_state, net_state, x, y, rng):
-            # runs per device; leading replica axis stripped by shard_map
-            params, opt_state, net_state = (jax.tree.map(lambda a: a[0], t)
-                                            for t in (params, opt_state, net_state))
-            x, y = x[0], y[0]
+        def make_step(with_mask: bool):
+            def local_step(params, opt_state, net_state, x, y, rng, *mask):
+                # runs per device; leading replica axis stripped by shard_map
+                params, opt_state, net_state = (jax.tree.map(lambda a: a[0], t)
+                                                for t in (params, opt_state, net_state))
+                x, y = x[0], y[0]
+                m = mask[0][0] if with_mask else None
+                mask_kw = ({"mask": m} if isinstance(model, Sequential)
+                           else {"masks": m})
 
-            def loss_fn(p):
-                loss, new_state = model.score(p, net_state, x, y, training=True, rng=rng[0])
-                return loss, new_state
+                def loss_fn(p):
+                    loss, new_state = model.score(p, net_state, x, y, training=True,
+                                                  rng=rng[0], **mask_kw)
+                    return loss, new_state
 
-            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            expand = lambda t: jax.tree.map(lambda a: a[None], t)
-            return expand(params), expand(opt_state), expand(new_state), loss[None]
+                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                expand = lambda t: jax.tree.map(lambda a: a[None], t)
+                return expand(params), expand(opt_state), expand(new_state), loss[None]
 
-        sharded_step = jax.shard_map(
-            local_step, mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)))
-        self._step = jax.jit(sharded_step, donate_argnums=(0, 1, 2))
+            n_in = 7 if with_mask else 6
+            sharded_step = jax.shard_map(
+                local_step, mesh=mesh,
+                in_specs=(P(DATA_AXIS),) * n_in,
+                out_specs=(P(DATA_AXIS),) * 4,
+                check_vma=False)  # all operands are per-device; scan carries
+                                  # initialized inside would trip the check
+            return jax.jit(sharded_step, donate_argnums=(0, 1, 2))
+
+        self._steps = {False: make_step(False)}
+        self._make_masked_step = lambda: make_step(True)
 
         def avg(tree):
             def mean_one(stacked):
@@ -137,6 +165,97 @@ class ParallelWrapper:
             return jax.tree.map(mean_one, tree)
 
         self._average = jax.jit(avg, donate_argnums=(0,), out_shardings=dev_sh)
+
+    # --- encoded_gradients: per-worker threshold encoding + all-gather ---
+    def _init_encoded(self):
+        """Gradient sharing with threshold-compressed update exchange — the
+        semantic port of EncodedGradientsAccumulator.storeUpdate (:441) /
+        EncodingHandler.java:139, redesigned synchronous (XLA collectives
+        can't express the reference's staleness-tolerant async queues, and
+        don't need to: the exchange rides the fabric inside one jit).
+
+        Wire shape per step per worker: ``capacity`` indices + signs
+        (quantize=True, ND4J ±threshold parity) or values (quantize=False,
+        exact top-k — dense-equivalent at threshold→0, full capacity). This
+        mode exists for bandwidth-constrained meshes (DCN/cross-slice); on
+        ICI prefer mode='shared_gradients' (dense all-reduce is faster than
+        any codec at ICI bandwidth). Residuals accumulate per worker on
+        device, so no gradient mass is lost, only delayed.
+        """
+        from jax.flatten_util import ravel_pytree
+
+        from .compression import threshold_encode, topk_encode
+
+        mesh, tx, model, n = self.mesh, self.tx, self.model, self.n_dev
+        if self.quantize and self.threshold <= 0:
+            raise ValueError(
+                "encoded_gradients with quantize=True transmits ±threshold "
+                "messages; threshold<=0 would be an all-zero (no-op) update "
+                "stream. Use threshold>0, or quantize=False for exact top-k.")
+        flat0, unravel = ravel_pytree(model.params)
+        size = flat0.shape[0]
+        capacity = max(1, min(size, int(size * self.capacity_frac)))
+        threshold, quantize = self.threshold, self.quantize
+
+        stack = lambda t: jax.tree.map(lambda a: jnp.broadcast_to(a, (n,) + a.shape), t)
+        dev_sh = NamedSharding(mesh, P(DATA_AXIS))
+        # params/opt replicated-by-construction: every worker applies the
+        # identical decoded mean update (stacked along the worker axis like
+        # averaging mode, so shard_map needs no replication proofs)
+        self.params = jax.device_put(stack(model.params), dev_sh)
+        self.state = jax.device_put(stack(model.state), dev_sh)
+        self.opt_state = jax.device_put(stack(tx.init(model.params)), dev_sh)
+        self.residual = jax.device_put(jnp.zeros((n, size), jnp.float32), dev_sh)
+        self._batch_sharding = dev_sh
+
+        def make_step(with_mask: bool):
+            def local_step(params, opt_state, net_state, residual, x, y, rng, *mask):
+                params, opt_state, net_state = (jax.tree.map(lambda a: a[0], t)
+                                                for t in (params, opt_state, net_state))
+                residual, x, y = residual[0], x[0], y[0]
+                m = mask[0][0] if with_mask else None
+                mask_kw = ({"mask": m} if isinstance(model, Sequential)
+                           else {"masks": m})
+
+                def loss_fn(p):
+                    loss, new_state = model.score(p, net_state, x, y, training=True,
+                                                  rng=rng[0], **mask_kw)
+                    return loss, new_state
+
+                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                # reference order (StochasticGradientDescent.java:66-74): the
+                # UPDATER runs locally first, then the resulting update — not
+                # the raw gradient — is encoded and shared; each worker's
+                # updater state evolves on its own gradients
+                updates, opt_state = tx.update(grads, opt_state, params)
+                flat = ravel_pytree(updates)[0].astype(jnp.float32)
+                if quantize:  # ND4J wire format: ±threshold at top-k slots
+                    enc, new_residual = threshold_encode(flat, threshold,
+                                                         capacity, residual)
+                    values = enc.signs.astype(jnp.float32) * threshold
+                else:         # exact top-k magnitudes
+                    enc, new_residual = topk_encode(flat, threshold,
+                                                    capacity, residual)
+                    values = enc.values
+                g_idx = jax.lax.all_gather(enc.indices, DATA_AXIS)   # (n, k)
+                g_val = jax.lax.all_gather(values, DATA_AXIS)        # (n, k)
+                dense = jnp.zeros((size,), jnp.float32).at[g_idx.ravel()].add(
+                    g_val.ravel() / n)
+                params = optax.apply_updates(params, unravel(dense))
+                expand = lambda t: jax.tree.map(lambda a: a[None], t)
+                return (expand(params), expand(opt_state), expand(new_state),
+                        new_residual[None], loss[None])
+
+            n_in = 8 if with_mask else 7
+            sharded = jax.shard_map(
+                local_step, mesh=mesh,
+                in_specs=(P(DATA_AXIS),) * n_in,
+                out_specs=(P(DATA_AXIS),) * 5,
+                check_vma=False)
+            return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
+
+        self._steps = {False: make_step(False)}
+        self._make_masked_step = lambda: make_step(True)
 
     # --- fit loop (ParallelWrapper.fit :467) ---
     def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = ()):
@@ -152,15 +271,19 @@ class ParallelWrapper:
             for ds in AsyncIterator(iterator, to_device=False):
                 x = np.asarray(ds.features)
                 y = np.asarray(ds.labels)
+                mask = (np.asarray(ds.features_mask)
+                        if ds.features_mask is not None else None)
                 b = x.shape[0]
                 if b % self.n_dev:  # pad to divisible (static shapes)
                     pad = self.n_dev - b % self.n_dev
                     x = np.concatenate([x, x[:pad]])
                     y = np.concatenate([y, y[:pad]])
+                    if mask is not None:
+                        mask = np.concatenate([mask, mask[:pad]])
                 for lst in listeners:
                     if isinstance(lst, PerformanceListener):
                         lst.step_begin(b)
-                loss = self._fit_batch(x, y, ds.features_mask)
+                loss = self._fit_batch(x, y, mask)
                 reporter.report(self.iteration, epoch, loss)
                 self.iteration += 1
             reporter.flush()
@@ -178,15 +301,30 @@ class ParallelWrapper:
             self.params, self.opt_state, self.state, loss = self._step(
                 self.params, self.opt_state, self.state, xd, yd, self.next_rng(), mask)
             return loss
-        # averaging mode: reshape to (n_dev, per_dev, ...) replica batches
+        # averaging/encoded modes: reshape to (n_dev, per_dev, ...) replica batches
         n = self.n_dev
         xr = x.reshape(n, x.shape[0] // n, *x.shape[1:])
         yr = y.reshape(n, y.shape[0] // n, *y.shape[1:])
         rngs = jax.random.split(self.next_rng(), n)
-        self.params, self.opt_state, self.state, loss = self._step(
+        with_mask = mask is not None
+        if with_mask and True not in self._steps:
+            self._steps[True] = self._make_masked_step()
+        step = self._steps[with_mask]
+        extra = ()
+        if with_mask:
+            mr = np.asarray(mask).reshape(n, mask.shape[0] // n, *mask.shape[1:])
+            extra = (jax.device_put(mr, self._batch_sharding),)
+        if self.mode == "encoded_gradients":
+            (self.params, self.opt_state, self.state, self.residual,
+             loss) = step(
+                self.params, self.opt_state, self.state, self.residual,
+                jax.device_put(xr, self._batch_sharding),
+                jax.device_put(yr, self._batch_sharding), rngs, *extra)
+            return loss
+        self.params, self.opt_state, self.state, loss = step(
             self.params, self.opt_state, self.state,
             jax.device_put(xr, self._batch_sharding),
-            jax.device_put(yr, self._batch_sharding), rngs)
+            jax.device_put(yr, self._batch_sharding), rngs, *extra)
         if (self.iteration + 1) % self.averaging_frequency == 0:
             self.params = self._average(self.params)
             if self.average_updater_state:  # averageUpdatersState :338
@@ -195,7 +333,7 @@ class ParallelWrapper:
 
     def _sync_model(self):
         """Write averaged/replicated params back to the model (host copy)."""
-        if self.mode == "averaging":
+        if self.mode in ("averaging", "encoded_gradients"):
             self.model.params = jax.tree.map(lambda a: jax.device_get(a)[0], self.params)
             self.model.state = jax.tree.map(lambda a: jax.device_get(a)[0], self.state)
         else:

@@ -185,3 +185,48 @@ class TestCnnSentenceIterator:
         assert x.shape == (8, 10, 8) and y.shape == (8, 2) and mask.shape == (8, 10)
         assert y.sum(axis=1).min() == 1.0
         assert mask.sum() > 0
+
+
+class TestShardedSequenceVectors:
+    """Distributed embedding training == single-device (the port of the
+    reference's Spark-vs-local embedding expectations; SparkSequenceVectors
+    holds vocab-sharded tables in a parameter server — here the shard map is
+    a NamedSharding over the model axis and GSPMD inserts the collectives)."""
+
+    def _fit_pair(self, algorithm, negative):
+        import jax
+
+        from deeplearning4j_tpu.nlp.sequencevectors import (
+            SequenceVectors, ShardedSequenceVectors)
+        from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+        from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
+                                                      cpu_test_mesh)
+
+        sents, *_ = _topic_corpus(60)
+        toks = [s.split() for s in sents]
+        vocab = VocabConstructor(min_word_frequency=1).build(toks)
+        seqs = [[vocab.index_of(w) for w in t if vocab.index_of(w) >= 0]
+                for t in toks]
+        kw = dict(layer_size=16, window=3, negative=negative, epochs=2,
+                  batch_size=256, seed=3, algorithm=algorithm)
+        ref = SequenceVectors(vocab, **kw)
+        ref.fit(seqs)
+        mesh = cpu_test_mesh(8, {DATA_AXIS: 2, MODEL_AXIS: 4})
+        sh = ShardedSequenceVectors(vocab, mesh=mesh, **kw)
+        sh.fit(seqs)
+        np.testing.assert_allclose(sh.vectors, ref.vectors, rtol=2e-4, atol=2e-5)
+
+    def test_skipgram_ns_sharded_equivalence(self):
+        from deeplearning4j_tpu.nlp.sequencevectors import SkipGram
+
+        self._fit_pair(SkipGram(), negative=4)
+
+    def test_cbow_sharded_equivalence(self):
+        from deeplearning4j_tpu.nlp.sequencevectors import CBOW as CBOWAlg
+
+        self._fit_pair(CBOWAlg(), negative=4)
+
+    def test_skipgram_hs_sharded_equivalence(self):
+        from deeplearning4j_tpu.nlp.sequencevectors import SkipGram
+
+        self._fit_pair(SkipGram(), negative=0)

@@ -171,6 +171,111 @@ class TestCompression:
         np.testing.assert_allclose(np.asarray(dec), [0.1, -0.1, 0, 0], atol=1e-7)
         np.testing.assert_allclose(np.asarray(dec + res), np.asarray(g), atol=1e-6)
 
+    def test_topk_roundtrip_and_telescoping(self):
+        """Exact top-k codec: decoded + residual == input each step, and the
+        telescoping sum over steps recovers the full gradient mass."""
+        from deeplearning4j_tpu.parallel.compression import (topk_decode,
+                                                             topk_encode)
+
+        rng = np.random.default_rng(1)
+        g = jnp.asarray(rng.standard_normal(32).astype(np.float32))
+        res = jnp.zeros(32)
+        enc, new_res = topk_encode(g, 0.0, capacity=8, residual=res)
+        dec = topk_decode(enc, size=32)
+        np.testing.assert_allclose(np.asarray(dec + new_res), np.asarray(g), atol=1e-6)
+        # 4 steps of capacity 8 transmit all 32 entries exactly
+        res = jnp.zeros(32)
+        total = jnp.zeros(32)
+        for _ in range(4):
+            enc, res = topk_encode(g * 0, 0.0, capacity=8, residual=res + (g if _ == 0 else 0))
+            total = total + topk_decode(enc, size=32)
+        np.testing.assert_allclose(np.asarray(total + res), np.asarray(g), atol=1e-5)
+
+    def test_encoded_gradients_mode_dense_equivalence(self, iris):
+        """encoded_gradients with exact top-k, threshold=0, full capacity is
+        step-for-step identical to the dense shared_gradients mode — the
+        dense-equivalence anchor VERDICT r1 asked for (ref
+        EncodedGradientsAccumulator.java:441 wires the codec into SGD)."""
+        x, y = iris
+        x, y = x[:96], y[:96]
+        n_dev = 4
+        mesh = cpu_test_mesh(n_dev)
+        pw = ParallelWrapper(iris_net(), mesh=mesh, mode="encoded_gradients",
+                             threshold=0.0, capacity_frac=1.0, quantize=False)
+        pw.fit(ArrayIterator(x, y, 96), epochs=3)
+        ref = ParallelWrapper(iris_net(), mesh=mesh, mode="shared_gradients")
+        ref.fit(ArrayIterator(x, y, 96), epochs=3)
+        for k in ref.model.params:
+            for pk in ref.model.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(pw.model.params[k][pk]),
+                    np.asarray(ref.model.params[k][pk]),
+                    rtol=1e-4, atol=1e-5,
+                    err_msg=f"{k}/{pk} diverged (encoded vs dense)")
+
+    def test_encoded_gradients_quantized_trains(self, iris):
+        """ND4J-parity quantized mode (±threshold messages + residuals)
+        still learns: loss decreases and residuals are active."""
+        from deeplearning4j_tpu.train import CollectScoresListener
+
+        x, y = iris
+        x, y = x[:96], y[:96]
+        mesh = cpu_test_mesh(4)
+        pw = ParallelWrapper(iris_net(lr=0.1), mesh=mesh,
+                             mode="encoded_gradients", threshold=5e-3,
+                             capacity_frac=0.5, quantize=True)
+        col = CollectScoresListener()
+        pw.fit(ArrayIterator(x, y, 96), epochs=80, listeners=[col])
+        assert float(jnp.abs(pw.residual).sum()) > 0
+        first = np.mean([s for _, s in col.scores[:3]])
+        last = np.mean([s for _, s in col.scores[-3:]])
+        assert last < first * 0.9
+
+    def test_encoded_gradients_quantized_rejects_zero_threshold(self, iris):
+        mesh = cpu_test_mesh(4)
+        with pytest.raises(ValueError, match="threshold"):
+            ParallelWrapper(iris_net(), mesh=mesh, mode="encoded_gradients",
+                            threshold=0.0, quantize=True)
+
+    def test_masked_rnn_batches_in_shardmap_modes(self):
+        """averaging/encoded modes must honor feature masks (review r2):
+        masked padding timesteps must not change training vs unpadded."""
+        T, B = 6, 16
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((B, T, 3)).astype(np.float32)
+        y = np.zeros((B, T, 2), np.float32)
+        y[..., 0] = 1
+        mask = np.ones((B, T), np.float32)
+        mask[:, 4:] = 0.0
+        x_garbage = x.copy()
+        x_garbage[:, 4:] += 100.0  # masked region garbage
+
+        def run(xa, mode):
+            net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "sgd", "learning_rate": 1e-2}))
+                   .input_shape(T, 3)
+                   .layer(L.LSTM(n_out=5))
+                   .layer(L.RnnOutput(n_out=2, activation="softmax", loss="mcxent"))
+                   .build())
+            pw = ParallelWrapper(net, mesh=cpu_test_mesh(4), mode=mode,
+                                 averaging_frequency=1, threshold=1e-3)
+            from deeplearning4j_tpu.data import DataSet
+
+            class _It:
+                def __iter__(self):
+                    return iter([DataSet(xa, y, features_mask=mask)])
+
+                def reset(self):
+                    pass
+
+            pw.fit(_It(), epochs=2)
+            return jax.tree.map(np.asarray, pw.model.params)
+
+        for mode in ("averaging", "encoded_gradients"):
+            p_clean = run(x, mode)
+            p_garbage = run(x_garbage, mode)
+            jax.tree.map(lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-4, atol=1e-5), p_clean, p_garbage)
+
     def test_accumulator(self):
         acc = EncodedGradientsAccumulator(size=100, threshold=0.01)
         rng = np.random.default_rng(0)
