@@ -1,0 +1,341 @@
+"""Record readers + transform pipeline — the DataVec bridge.
+
+Reference parity (SURVEY.md §2.2 "DataVec bridge", ~126 org.datavec imports):
+- ``RecordReaderDataSetIterator.java`` — record stream -> DataSet batches
+- ``SequenceRecordReaderDataSetIterator.java`` — per-file sequences
+- DataVec ``CSVRecordReader`` / ``ImageRecordReader`` / ``TransformProcess``
+
+TPU-native design: readers produce numpy rows on the host (ETL is host-side
+by definition); the iterator assembles fixed-shape batches that feed the
+device via the async prefetch path (``native/io.py`` C++ batcher or
+``AsyncIterator``). Transforms are pure functions over column arrays, so a
+pipeline is data (a list of op descriptors) — serializable like the
+reference's JSON TransformProcess.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .iterators import DataSet, DataSetIterator
+
+
+# ---------------------------------------------------------------------------
+# Record readers
+# ---------------------------------------------------------------------------
+
+
+class RecordReader:
+    """Record stream contract (DataVec RecordReader): iterate lists of
+    values; ``reset`` restarts."""
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (CollectionRecordReader parity)."""
+
+    def __init__(self, records: Sequence[Sequence[Any]]):
+        self.records = [list(r) for r in records]
+
+    def __iter__(self):
+        return iter(self.records)
+
+
+class CSVRecordReader(RecordReader):
+    """CSVRecordReader parity: skip lines, delimiter, string cells kept as
+    strings (transforms handle categorical -> numeric)."""
+
+    def __init__(self, path: str, skip_lines: int = 0, delimiter: str = ","):
+        self.path = str(path)
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        with open(self.path, newline="") as f:
+            r = csv.reader(f, delimiter=self.delimiter)
+            for i, row in enumerate(r):
+                if i < self.skip_lines or not row:
+                    continue
+                yield [self._coerce(c) for c in row]
+
+    @staticmethod
+    def _coerce(cell: str):
+        try:
+            return float(cell)
+        except ValueError:
+            return cell.strip()
+
+
+class ImageRecordReader(RecordReader):
+    """ImageRecordReader parity: walks ``root/<label>/*.{png,jpg,...}``,
+    yields [flattened HWC float array, label_index]. Labels are the sorted
+    subdirectory names (ParentPathLabelGenerator semantics)."""
+
+    EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".gif"}
+
+    def __init__(self, root: str, height: int, width: int, channels: int = 3):
+        self.root = Path(root)
+        self.h, self.w, self.c = height, width, channels
+        self.labels = sorted(d.name for d in self.root.iterdir() if d.is_dir())
+        self._files: List[Tuple[Path, int]] = []
+        for li, lab in enumerate(self.labels):
+            for p in sorted((self.root / lab).rglob("*")):
+                if p.suffix.lower() in self.EXTS:
+                    self._files.append((p, li))
+
+    def __len__(self):
+        return len(self._files)
+
+    def load_image(self, path: Path) -> np.ndarray:
+        from PIL import Image
+
+        img = Image.open(path)
+        img = img.convert("RGB" if self.c == 3 else "L")
+        img = img.resize((self.w, self.h))
+        arr = np.asarray(img, np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr
+
+    def __iter__(self):
+        for p, li in self._files:
+            yield [self.load_image(p), li]
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """CSVSequenceRecordReader parity: each FILE is one sequence (rows =
+    timesteps). ``paths`` may be a glob pattern or an explicit list."""
+
+    def __init__(self, paths, skip_lines: int = 0, delimiter: str = ","):
+        if isinstance(paths, (str, Path)):
+            import glob as _g
+
+            self.paths = sorted(_g.glob(str(paths)))
+        else:
+            self.paths = [str(p) for p in paths]
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        for p in self.paths:
+            rows = list(CSVRecordReader(p, self.skip_lines, self.delimiter))
+            yield rows  # one record == one sequence (list of timestep rows)
+
+
+# ---------------------------------------------------------------------------
+# Transform pipeline (DataVec TransformProcess equivalent)
+# ---------------------------------------------------------------------------
+
+
+class TransformProcess:
+    """Composable per-record column transforms; a pipeline is data
+    (list of op descriptors) like the reference's JSON TransformProcess.
+
+    Ops operate on a record (list of cells) and return the new record.
+    """
+
+    def __init__(self):
+        self.ops: List[Tuple[str, dict]] = []
+
+    # --- builder API (TransformProcess.Builder parity) ---
+    def remove_columns(self, *indices: int) -> "TransformProcess":
+        self.ops.append(("remove_columns", {"indices": sorted(indices)}))
+        return self
+
+    def categorical_to_integer(self, index: int, categories: Sequence[str]) -> "TransformProcess":
+        self.ops.append(("categorical_to_integer",
+                         {"index": index, "categories": list(categories)}))
+        return self
+
+    def categorical_to_onehot(self, index: int, categories: Sequence[str]) -> "TransformProcess":
+        self.ops.append(("categorical_to_onehot",
+                         {"index": index, "categories": list(categories)}))
+        return self
+
+    def normalize_minmax(self, index: int, lo: float, hi: float) -> "TransformProcess":
+        self.ops.append(("normalize_minmax", {"index": index, "lo": lo, "hi": hi}))
+        return self
+
+    def normalize_standardize(self, index: int, mean: float, std: float) -> "TransformProcess":
+        self.ops.append(("normalize_standardize", {"index": index, "mean": mean, "std": std}))
+        return self
+
+    def map_column(self, index: int, fn: Callable[[Any], Any]) -> "TransformProcess":
+        self.ops.append(("map_column", {"index": index, "fn": fn}))
+        return self
+
+    def filter_rows(self, predicate: Callable[[Sequence[Any]], bool]) -> "TransformProcess":
+        """Keep rows where predicate(record) is True (FilterOp parity)."""
+        self.ops.append(("filter_rows", {"predicate": predicate}))
+        return self
+
+    # --- execution ---
+    def __call__(self, record: Sequence[Any]) -> Optional[List[Any]]:
+        rec = list(record)
+        for name, a in self.ops:
+            if name == "remove_columns":
+                rec = [c for i, c in enumerate(rec) if i not in a["indices"]]
+            elif name == "categorical_to_integer":
+                rec[a["index"]] = float(a["categories"].index(rec[a["index"]]))
+            elif name == "categorical_to_onehot":
+                i, cats = a["index"], a["categories"]
+                one = [0.0] * len(cats)
+                one[cats.index(rec[i])] = 1.0
+                rec = rec[:i] + one + rec[i + 1:]
+            elif name == "normalize_minmax":
+                i = a["index"]
+                rec[i] = (float(rec[i]) - a["lo"]) / max(a["hi"] - a["lo"], 1e-12)
+            elif name == "normalize_standardize":
+                i = a["index"]
+                rec[i] = (float(rec[i]) - a["mean"]) / max(a["std"], 1e-12)
+            elif name == "map_column":
+                rec[a["index"]] = a["fn"](rec[a["index"]])
+            elif name == "filter_rows":
+                if not a["predicate"](rec):
+                    return None
+        return rec
+
+    def to_list(self) -> List[Tuple[str, dict]]:
+        """Descriptor form (serializable except map/filter callables)."""
+        return list(self.ops)
+
+
+# ---------------------------------------------------------------------------
+# RecordReader -> DataSet iterators
+# ---------------------------------------------------------------------------
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """RecordReaderDataSetIterator.java parity: record stream -> DataSet
+    batches. ``label_index``: column holding the label (after transforms);
+    int labels one-hot to ``num_classes`` unless ``regression``. Feature
+    cells may be scalars or arrays (ImageRecordReader rows)."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = None, num_classes: int = 0,
+                 regression: bool = False,
+                 transform: Optional[TransformProcess] = None):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.transform = transform
+
+    def _split(self, rec: List[Any]) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        if self.label_index is None:
+            feats = rec
+            label = None
+        else:
+            li = self.label_index if self.label_index >= 0 else len(rec) + self.label_index
+            label = rec[li]
+            feats = rec[:li] + rec[li + 1:]
+        parts = [np.asarray(c, np.float32).ravel() if not np.isscalar(c)
+                 else np.asarray([c], np.float32) for c in feats]
+        x = np.concatenate(parts) if parts else np.zeros(0, np.float32)
+        if label is None:
+            return x, None
+        if self.regression:
+            return x, np.asarray([label], np.float32)
+        y = np.zeros(self.num_classes, np.float32)
+        y[int(label)] = 1.0
+        return x, y
+
+    def __iter__(self):
+        xb, yb = [], []
+        for rec in self.reader:
+            if self.transform is not None:
+                rec = self.transform(rec)
+                if rec is None:
+                    continue
+            x, y = self._split(list(rec))
+            xb.append(x)
+            if y is not None:
+                yb.append(y)
+            if len(xb) == self.batch_size:
+                yield self._emit(xb, yb)
+                xb, yb = [], []
+        if xb:
+            yield self._emit(xb, yb)
+
+    def _emit(self, xb, yb):
+        x = np.stack(xb)
+        y = np.stack(yb) if yb else np.zeros((len(xb), 0), np.float32)
+        return DataSet(x, y)
+
+    def reset(self):
+        self.reader.reset()
+
+
+class ImageRecordDataSetIterator(RecordReaderDataSetIterator):
+    """Image records keep their HWC shape (no flatten) — the CNN input path
+    of RecordReaderDataSetIterator(ImageRecordReader, ...)."""
+
+    def _split(self, rec):
+        img, label = rec[0], rec[1]
+        x = np.asarray(img, np.float32)
+        y = np.zeros(self.num_classes, np.float32)
+        y[int(label)] = 1.0
+        return x, y
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """SequenceRecordReaderDataSetIterator.java parity (single-reader mode):
+    each record is a sequence of timestep rows; the label column yields a
+    per-timestep label. Sequences in a batch are padded to the longest with
+    feature/label masks — the masking contract the reference builds for
+    ragged time series."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int = -1, num_classes: int = 0,
+                 regression: bool = False):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+
+    def __iter__(self):
+        buf = []
+        for seq in self.reader:
+            buf.append(seq)
+            if len(buf) == self.batch_size:
+                yield self._emit(buf)
+                buf = []
+        if buf:
+            yield self._emit(buf)
+
+    def _emit(self, seqs):
+        B = len(seqs)
+        T = max(len(s) for s in seqs)
+        n_feat = len(seqs[0][0]) - 1
+        x = np.zeros((B, T, n_feat), np.float32)
+        if self.regression:
+            y = np.zeros((B, T, 1), np.float32)
+        else:
+            y = np.zeros((B, T, self.num_classes), np.float32)
+        mask = np.zeros((B, T), np.float32)
+        for b, seq in enumerate(seqs):
+            for t, row in enumerate(seq):
+                li = self.label_index if self.label_index >= 0 else len(row) + self.label_index
+                feats = [float(v) for i, v in enumerate(row) if i != li]
+                x[b, t] = feats
+                if self.regression:
+                    y[b, t, 0] = float(row[li])
+                else:
+                    y[b, t, int(row[li])] = 1.0
+                mask[b, t] = 1.0
+        return DataSet(x, y, features_mask=mask, labels_mask=mask)
+
+    def reset(self):
+        self.reader.reset()
