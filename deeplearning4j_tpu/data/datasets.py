@@ -214,19 +214,39 @@ def load_tiny_imagenet(train: bool = True, num_examples: Optional[int] = None,
                        image_size: int = 64):
     """TinyImageNet-200 as (N, 64, 64, 3). Reads the standard directory
     layout under $DL4J_TPU_DATA/tiny-imagenet-200/ via ImageRecordReader."""
-    root = DATA_DIR / "tiny-imagenet-200" / ("train" if train else "val")
+    base = DATA_DIR / "tiny-imagenet-200"
+    root = base / ("train" if train else "val")
     if root.exists():
         from .records import ImageRecordReader
 
-        rr = ImageRecordReader(str(root), image_size, image_size, 3)
-        n = min(len(rr), num_examples or len(rr))
+        if train:
+            rr = ImageRecordReader(str(root), image_size, image_size, 3)
+            files = [(p, li) for p, li in rr._files]
+            n_classes = len(rr.labels)
+        else:
+            # standard val layout: val/images/*.JPEG + val_annotations.txt
+            # (no per-class subdirs); class order follows train/ (or wnids.txt)
+            wnids_p = base / "wnids.txt"
+            if wnids_p.exists():
+                wnids = sorted(wnids_p.read_text().split())
+            else:
+                wnids = sorted(d.name for d in (base / "train").iterdir() if d.is_dir())
+            idx = {w: i for i, w in enumerate(wnids)}
+            n_classes = len(wnids)
+            ann = root / "val_annotations.txt"
+            rr = ImageRecordReader.__new__(ImageRecordReader)
+            rr.h, rr.w, rr.c = image_size, image_size, 3
+            files = []
+            for line in ann.read_text().splitlines():
+                parts = line.split("\t")
+                if len(parts) >= 2 and parts[1] in idx:
+                    files.append((root / "images" / parts[0], idx[parts[1]]))
+        n = min(len(files), num_examples or len(files))
         xs = np.zeros((n, image_size, image_size, 3), np.float32)
         ys = np.zeros(n, np.int64)
-        for i, rec in enumerate(rr):
-            if i >= n:
-                break
-            xs[i], ys[i] = rec[0], rec[1]
-        labels = np.eye(len(rr.labels), dtype=np.float32)[ys]
+        for i, (p, li) in enumerate(files[:n]):
+            xs[i], ys[i] = rr.load_image(p), li
+        labels = np.eye(n_classes, dtype=np.float32)[ys]
         return xs, labels
     _synthetic_fallback("tiny-imagenet", root)
     n = num_examples or (2048 if train else 256)
@@ -246,20 +266,16 @@ def load_lfw(num_examples: Optional[int] = None, image_size: int = 64,
     if root.exists():
         from .records import ImageRecordReader
 
-        rr = ImageRecordReader(str(root), image_size, image_size, 3)
-        from collections import Counter
-
-        counts = Counter(li for _, li in rr._files)
-        keep = {li for li, c in counts.items() if c >= min_faces_per_person}
-        files = [(p, li) for p, li in rr._files if li in keep]
-        remap = {li: i for i, li in enumerate(sorted(keep))}
-        n = min(len(files), num_examples or len(files))
+        rr = ImageRecordReader(str(root), image_size, image_size, 3,
+                               min_examples_per_label=min_faces_per_person)
+        n = min(len(rr), num_examples or len(rr))
         xs = np.zeros((n, image_size, image_size, 3), np.float32)
         ys = np.zeros(n, np.int64)
-        for i, (p, li) in enumerate(files[:n]):
-            xs[i] = rr.load_image(p)
-            ys[i] = remap[li]
-        labels = np.eye(len(keep), dtype=np.float32)[ys]
+        for i, rec in enumerate(rr):
+            if i >= n:
+                break
+            xs[i], ys[i] = rec[0], rec[1]
+        labels = np.eye(len(rr.labels), dtype=np.float32)[ys]
         return xs, labels
     _synthetic_fallback("lfw", root)
     n = num_examples or 1024

@@ -64,9 +64,8 @@ class DataSetIterator:
     def reset(self):
         pass
 
-    @property
-    def batch_size(self) -> int:
-        raise NotImplementedError
+    #: minibatch size; subclasses set an instance attribute or override
+    batch_size: int = -1
 
 
 class ArrayIterator(DataSetIterator):

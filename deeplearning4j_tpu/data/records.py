@@ -83,15 +83,20 @@ class ImageRecordReader(RecordReader):
 
     EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".gif"}
 
-    def __init__(self, root: str, height: int, width: int, channels: int = 3):
+    def __init__(self, root: str, height: int, width: int, channels: int = 3,
+                 min_examples_per_label: int = 0):
         self.root = Path(root)
         self.h, self.w, self.c = height, width, channels
-        self.labels = sorted(d.name for d in self.root.iterdir() if d.is_dir())
-        self._files: List[Tuple[Path, int]] = []
-        for li, lab in enumerate(self.labels):
-            for p in sorted((self.root / lab).rglob("*")):
-                if p.suffix.lower() in self.EXTS:
-                    self._files.append((p, li))
+        labels = sorted(d.name for d in self.root.iterdir() if d.is_dir())
+        by_label: Dict[str, List[Path]] = {}
+        for lab in labels:
+            files = [p for p in sorted((self.root / lab).rglob("*"))
+                     if p.suffix.lower() in self.EXTS]
+            if len(files) >= min_examples_per_label:
+                by_label[lab] = files
+        self.labels = sorted(by_label)  # indices stay consistent post-filter
+        self._files: List[Tuple[Path, int]] = [
+            (p, li) for li, lab in enumerate(self.labels) for p in by_label[lab]]
 
     def __len__(self):
         return len(self._files)
@@ -308,6 +313,12 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
     def __iter__(self):
         buf = []
         for seq in self.reader:
+            if not seq:  # empty file / header-only sequence: skip loudly
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "SequenceRecordReaderDataSetIterator: skipping empty sequence")
+                continue
             buf.append(seq)
             if len(buf) == self.batch_size:
                 yield self._emit(buf)

@@ -9,7 +9,9 @@ from .kdtree import KDTree
 from .kmeans import KMeans
 from .lsh import RandomProjectionLSH
 from .server import NearestNeighborsServer
+from .sptree import QuadTree, SPTree
 from .vptree import VPTree
 
 __all__ = ["BruteForceKNN", "KDTree", "KMeans", "NearestNeighborsClient",
-           "NearestNeighborsServer", "RandomProjectionLSH", "VPTree"]
+           "NearestNeighborsServer", "QuadTree", "RandomProjectionLSH",
+           "SPTree", "VPTree"]

@@ -10,6 +10,7 @@ from .conv import (Conv1D, Conv2D, Cropping2D, Deconv2D, DepthwiseConv2D,
 from .core import (ActivationLayer, CenterLossOutput, CnnLossLayer, Dense,
                    DropoutLayer, ElementWiseMultiplication, Embedding,
                    EmbeddingSequence, LossLayer, Output, PReLU, RnnOutput)
+from .custom import CustomLayer, Lambda, resolve_function
 from .norm import LRN, BatchNorm, LayerNorm, RMSNorm
 from .pooling import Flatten, GlobalPooling, Reshape
 from .recurrent import (GRU, LSTM, Bidirectional, GravesLSTM, LastTimeStep,
@@ -19,9 +20,10 @@ from .special import VAE, AutoEncoder, Frozen, Yolo2Output
 __all__ = [
     "ActivationLayer", "AutoEncoder", "BatchNorm", "Bidirectional",
     "CenterLossOutput", "CnnLossLayer", "Conv1D", "Conv2D", "Cropping2D",
-    "Deconv2D", "Dense", "DepthwiseConv2D", "DropoutLayer",
+    "CustomLayer", "Deconv2D", "Dense", "DepthwiseConv2D", "DropoutLayer",
     "ElementWiseMultiplication", "Embedding", "EmbeddingSequence", "Flatten",
-    "Frozen", "GRU", "GlobalPooling", "GravesLSTM", "LRN", "LSTM", "LastTimeStep",
+    "Frozen", "GRU", "GlobalPooling", "GravesLSTM", "LRN", "LSTM", "Lambda",
+    "LastTimeStep",
     "LayerNorm", "LossLayer", "MultiHeadAttention", "Output", "PReLU",
     "PositionalEmbedding", "RMSNorm", "RecurrentLayer", "Reshape", "RnnOutput",
     "SeparableConv2D", "SimpleRnn", "SpaceToBatch", "SpaceToDepth",

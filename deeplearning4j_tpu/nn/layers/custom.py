@@ -1,0 +1,114 @@
+"""Custom-layer bridge — user-defined jax layers that participate in config
+serde, gradients, and training like any built-in layer.
+
+Reference parity: the SameDiff custom-layer API
+(``nn/conf/layers/samediff/BaseSameDiffLayer.java:50-63`` — ``defineLayer``
+defines the forward graph, ``defineParameters``/``initializeParameters``
+declare params; ``SameDiffLayer`` wraps it as a regular layer) and
+``AbstractSameDiffLayer``'s JSON round-trip.
+
+TPU redesign: SameDiff exists because DL4J needs a graph IR to autodiff a
+user-defined forward function. Here the IR *is* jax — a custom layer is just
+a pure python function that jax traces, differentiates, and XLA fuses with
+its neighbours; no bridge runtime is needed. What remains of the reference
+surface is the *packaging* contract: declare params, define forward, and
+serialize by reference. Functions are referenced by import path
+(``"pkg.mod:fn"``) so a saved config reloads anywhere the code is importable
+— the same contract as DL4J deserializing a SameDiff layer by class name.
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..api import Array, Layer, Shape, register_layer
+
+
+def resolve_function(path: str):
+    """Import ``"package.module:attr"`` (DL4J: Jackson resolving the layer
+    class by name). Raises ImportError/AttributeError with the path intact."""
+    if ":" not in path:
+        raise ValueError(f"Function reference must be 'module:attr', got {path!r}")
+    mod, _, attr = path.partition(":")
+    fn = importlib.import_module(mod)
+    for part in attr.split("."):
+        fn = getattr(fn, part)
+    return fn
+
+
+@register_layer
+@dataclass(frozen=True)
+class Lambda(Layer):
+    """Parameter-less custom layer (SameDiffLambdaLayer.java parity).
+
+    ``fn`` is an import path to ``f(x, **config) -> y`` — any jax-traceable
+    function. ``out_shape`` declares the output feature shape when it differs
+    from the input (``getOutputType`` parity); None = shape-preserving.
+    """
+
+    fn: str = ""
+    config: Optional[Dict[str, Any]] = None
+    out_shape: Optional[Sequence[int]] = None
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(self.out_shape) if self.out_shape is not None else input_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        f = resolve_function(self.fn)
+        return f(x, **(self.config or {})), state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class CustomLayer(Layer):
+    """Parameterized custom layer (BaseSameDiffLayer parity).
+
+    - ``init_fn``: import path to ``f(key, input_shape, **config) -> params``
+      (defineParameters + initializeParameters).
+    - ``fn``: import path to ``f(params, x, *, training, rng, **config) -> y``
+      (defineLayer). Extra keywords are optional — plain ``f(params, x)``
+      signatures work too.
+    - ``out_shape``: output feature shape if not shape-preserving.
+
+    Gradients need no declaration: ``jax.grad`` differentiates through ``fn``
+    exactly as it does built-ins (the entire SameDiff autodiff machinery is
+    subsumed by the tracer).
+    """
+
+    fn: str = ""
+    init_fn: str = ""
+    config: Optional[Dict[str, Any]] = None
+    out_shape: Optional[Sequence[int]] = None
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return tuple(self.out_shape) if self.out_shape is not None else input_shape
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        f = resolve_function(self.init_fn)
+        params = f(key, tuple(input_shape), **(self.config or {}))
+        params = jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        f = resolve_function(self.fn)
+        kw = dict(self.config or {})
+        # pass training/rng only if the user fn accepts them (by name or
+        # **kwargs) — never silently drop one the fn DOES declare
+        import inspect
+
+        sig = inspect.signature(f)
+        has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                         for p in sig.parameters.values())
+        if has_var_kw or "training" in sig.parameters:
+            kw["training"] = training
+        if has_var_kw or "rng" in sig.parameters:
+            kw["rng"] = rng
+        return f(params, x, **kw), state, mask

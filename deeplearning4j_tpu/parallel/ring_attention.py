@@ -62,11 +62,14 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = F
     scale = scale if scale is not None else 1.0 / jnp.sqrt(D)
     q_pos = idx * T + jnp.arange(T)
 
-    # pvary: mark the fresh accumulators as device-varying over the ring axis
+    # mark the fresh accumulators as device-varying over the ring axis
     # so the scan carry types match (shard_map manual-axes typing rule).
-    m0 = lax.pvary(jnp.full((B, H, T), -jnp.inf, jnp.float32), (axis_name,))
-    l0 = lax.pvary(jnp.zeros((B, H, T), jnp.float32), (axis_name,))
-    o0 = lax.pvary(jnp.zeros((B, T, H, D), jnp.float32), (axis_name,))
+    def _vary(a):
+        return lax.pcast(a, axis_name, to="varying")
+
+    m0 = _vary(jnp.full((B, H, T), -jnp.inf, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, T), jnp.float32))
+    o0 = _vary(jnp.zeros((B, T, H, D), jnp.float32))
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(carry, step):

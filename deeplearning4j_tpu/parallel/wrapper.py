@@ -341,22 +341,42 @@ class ParallelWrapper:
             self.model.state = jax.device_get(self.state)
 
     def evaluate(self, iterator, evaluation=None):
+        """Sharded evaluation: each batch is split over the data axis and the
+        replicated params run the forward on every device in parallel (the
+        reference round-robins eval batches over its workers; here the batch
+        sharding does the distribution and GSPMD the rest)."""
         from ..eval import Evaluation
 
         self._sync_model()
         model = self.model
+        seq = isinstance(model, Sequential)
         if evaluation is None:
-            n_out = model.output_shape[-1] if isinstance(model, Sequential) else model.output_shapes[0][-1]
+            n_out = (model.output_shape[-1] if seq
+                     else model.output_shapes[0][-1])
             evaluation = Evaluation(n_out)
-        params, state = model.params, model.state
+
+        repl = NamedSharding(self.mesh, P())
+        batch_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        params = jax.device_put(model.params, repl)
+        state = jax.device_put(model.state, repl)
 
         @jax.jit
         def infer(p, s, x):
-            y, _ = model.forward(p, s, x, training=False) if isinstance(model, Sequential) else (model.forward(p, s, x, training=False)[0][0], None)
+            if seq:
+                y, _ = model.forward(p, s, x, training=False)
+            else:  # Graph: evaluate the primary (first) output
+                ys, _ = model.forward(p, s, x, training=False)
+                y = ys[0]
             return y
 
+        n = self.n_dev
         for ds in iterator:
-            evaluation.eval(ds.labels, np.asarray(infer(params, state, ds.features)))
+            x = np.asarray(ds.features)
+            pad = (-x.shape[0]) % n  # batch must divide the data axis
+            xp = np.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+            preds = np.asarray(infer(params, state,
+                                     jax.device_put(xp, batch_sh)))[: x.shape[0]]
+            evaluation.eval(ds.labels, preds, mask=ds.labels_mask)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return evaluation

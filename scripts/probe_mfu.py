@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""MFU diagnostic probe: separate dispatch overhead from device compute.
+
+Runs the ResNet-50 train step three ways:
+  a) per-step dispatch (what bench.py does)
+  b) k steps fused in ONE jit via lax.fori_loop (zero per-step dispatch)
+  c) XLA cost_analysis FLOPs of the single step (sanity-check the MFU math)
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data import BenchmarkIterator
+from deeplearning4j_tpu.models import ResNet50
+from deeplearning4j_tpu.train import Trainer
+
+dev = jax.devices()[0]
+on_tpu = dev.platform != "cpu"
+batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
+img = int(os.environ.get("BENCH_IMG", 224 if on_tpu else 32))
+
+zm = ResNet50(num_classes=1000, seed=0, input_shape=(img, img, 3))
+model = zm.build()
+if on_tpu:
+    model.config.compute_dtype = "bfloat16"
+model.init()
+
+tr = Trainer(model)
+step = tr._make_step()
+it = BenchmarkIterator((img, img, 3), 1000, batch, 1)
+ds = next(iter(it))
+x = jax.device_put(np.asarray(ds.features))
+y = jax.device_put(np.asarray(ds.labels))
+rng = jax.random.PRNGKey(0)
+
+params, opt_state, state = tr.params, tr.opt_state, tr.state
+
+# --- c) cost analysis of the single step ---
+lowered = step.lower(params, opt_state, state, x, y, rng)
+compiled = lowered.compile()
+try:
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    flops = ca.get("flops", float("nan"))
+    print(f"cost_analysis flops/step: {flops:.3e}  "
+          f"(per image: {flops/batch:.3e}; bench.py assumes 1.227e10/img @224)")
+    for k in sorted(ca):
+        if "bytes" in k and ca[k] > 1e9:
+            print(f"  {k}: {ca[k]:.3e}")
+except Exception as e:
+    print("cost_analysis unavailable:", e)
+
+# --- a) per-step dispatch ---
+def run(k, params, opt_state, state):
+    t0 = time.perf_counter()
+    for _ in range(k):
+        params, opt_state, state, loss = step(params, opt_state, state, x, y, rng)
+    lf = float(loss)
+    return time.perf_counter() - t0, params, opt_state, state
+
+_, params, opt_state, state = run(3, params, opt_state, state)
+t1, params, opt_state, state = run(5, params, opt_state, state)
+t2, params, opt_state, state = run(20, params, opt_state, state)
+per_step_dispatch = (t2 - t1) / 15
+print(f"a) per-step dispatch: {per_step_dispatch*1e3:.2f} ms/step "
+      f"({batch/per_step_dispatch:.1f} img/s)")
+
+# --- b) fori_loop fused: k steps, one dispatch ---
+tx, mdl = tr.tx, tr.model
+
+@jax.jit
+def multi(params, opt_state, state, k):
+    def body(i, carry):
+        p, o, s, _ = carry
+        import optax
+
+        def loss_fn(pp):
+            loss, ns = mdl.score(pp, s, x, y, training=True, rng=rng)
+            return loss, ns
+
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        updates, o = tx.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return (p, o, ns, loss)
+
+    return jax.lax.fori_loop(0, k, body, (params, opt_state, state, jnp.float32(0)))
+
+r = multi(params, opt_state, state, 3)
+_ = float(r[3])  # compile + warm
+t0 = time.perf_counter()
+r = multi(params, opt_state, state, 5)
+_ = float(r[3])
+t1 = time.perf_counter() - t0
+t0 = time.perf_counter()
+r = multi(params, opt_state, state, 20)
+_ = float(r[3])
+t2 = time.perf_counter() - t0
+per_step_fused = (t2 - t1) / 15
+print(f"b) fori_loop fused:  {per_step_fused*1e3:.2f} ms/step "
+      f"({batch/per_step_fused:.1f} img/s)")
+print(f"dispatch overhead per step: {(per_step_dispatch-per_step_fused)*1e3:.2f} ms")

@@ -1,0 +1,35 @@
+"""User-defined layer functions for the custom-layer bridge tests.
+
+Plays the role of the user's SameDiff layer subclass in the reference tests
+(``deeplearning4j-nn`` samediff test layers): importable by path, pure jax.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def swish(x, beta=1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+def scaled_dense_init(key, input_shape, n_out=4):
+    k1, k2 = jax.random.split(key)
+    n_in = input_shape[-1]
+    return {
+        "w": jax.random.normal(k1, (n_in, n_out)) / jnp.sqrt(n_in),
+        "b": jnp.zeros((n_out,)),
+        "scale": jnp.ones(()),
+    }
+
+
+def scaled_dense_apply(params, x, n_out=4):
+    return jnp.tanh(x @ params["w"] + params["b"]) * params["scale"]
+
+
+def train_flag_apply(params, x, training=False):
+    """Accepts `training` but NOT `rng` — regression for kwarg filtering."""
+    return x * (2.0 if training else 1.0) + params["b"]
+
+
+def train_flag_init(key, input_shape):
+    return {"b": jnp.zeros(input_shape[-1])}

@@ -6,6 +6,7 @@ embed community-structured graphs so that intra-community similarity exceeds
 inter-community; t-SNE must reduce KL and separate well-separated clusters.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -318,3 +319,129 @@ class TestTsne:
         x = np.random.RandomState(14).randn(2, 5).astype(np.float32)
         y = Tsne(n_components=2).fit_transform(x)
         assert y.shape == (2, 2)
+
+
+class TestSPTree:
+    """clustering/sptree/SpTree.java invariants."""
+
+    def test_structure_invariants(self):
+        rng = np.random.default_rng(0)
+        pts = rng.standard_normal((200, 3))
+        from deeplearning4j_tpu.knn import SPTree
+        t = SPTree(pts)
+        assert t.is_correct()
+        assert t._count[0] == 200            # root aggregates every point
+        np.testing.assert_allclose(t._com[0], pts.mean(0), atol=1e-9)
+        assert t.depth() >= 1
+
+    def test_quadtree_requires_2d(self):
+        from deeplearning4j_tpu.knn import QuadTree
+        with pytest.raises(ValueError):
+            QuadTree(np.zeros((5, 3)))
+        t = QuadTree(np.random.default_rng(1).standard_normal((50, 2)))
+        assert t.is_correct()
+
+    def test_duplicate_points_absorbed(self):
+        pts = np.array([[0.0, 0.0], [0.0, 0.0], [1.0, 1.0]])
+        from deeplearning4j_tpu.knn import QuadTree
+        t = QuadTree(pts)
+        assert t._count[0] == 3              # all counted in aggregates
+
+    def test_bh_force_approximates_exact(self):
+        """theta-approximate repulsion within a few % of the exact O(N²) sum."""
+        rng = np.random.default_rng(2)
+        y = rng.standard_normal((300, 2))
+        from deeplearning4j_tpu.knn import SPTree
+        tree = SPTree(y)
+        i = 7
+        diff = y[i] - y                       # (N, 2)
+        d2 = (diff ** 2).sum(1)
+        num = 1.0 / (1.0 + d2)
+        num[i] = 0.0
+        exact_rep = (num[:, None] ** 2 * diff).sum(0)
+        exact_z = num.sum()
+        approx_rep, approx_z = tree.compute_non_edge_forces(y[i], theta=0.5)
+        np.testing.assert_allclose(approx_z, exact_z, rtol=0.05)
+        # per-point BH error at theta=0.5 can reach ~20% on small components;
+        # check the vector as a whole
+        assert (np.linalg.norm(approx_rep - exact_rep)
+                < 0.1 * np.linalg.norm(exact_rep) + 1e-3)
+        # theta=0 disables summarization -> exact
+        exact0_rep, exact0_z = tree.compute_non_edge_forces(y[i], theta=0.0)
+        np.testing.assert_allclose(exact0_z, exact_z, rtol=1e-9)
+        np.testing.assert_allclose(exact0_rep, exact_rep, rtol=1e-7, atol=1e-12)
+
+
+class TestBarnesHutTsne:
+    def _blobs(self, n_per=60, seed=0):
+        rng = np.random.default_rng(seed)
+        centers = np.array([[6, 0, 0, 0], [0, 6, 0, 0], [0, 0, 6, 0]], np.float64)
+        x = np.concatenate([rng.standard_normal((n_per, 4)) * 0.4 + c for c in centers])
+        labels = np.repeat(np.arange(3), n_per)
+        return x.astype(np.float32), labels
+
+    @staticmethod
+    def _separation(y, labels):
+        cents = np.stack([y[labels == k].mean(0) for k in range(3)])
+        intra = np.mean([np.linalg.norm(y[labels == k] - cents[k], axis=1).mean()
+                         for k in range(3)])
+        inter = np.mean([np.linalg.norm(cents[a] - cents[b])
+                         for a in range(3) for b in range(a + 1, 3)])
+        return inter / intra
+
+    def test_blocked_separates_blobs(self):
+        from deeplearning4j_tpu.plot import BarnesHutTsne
+        x, labels = self._blobs()
+        ts = BarnesHutTsne(max_iter=300, perplexity=15.0, block=64, seed=3)
+        y = ts.fit_transform(x)
+        assert y.shape == (180, 2)
+        assert np.isfinite(y).all()
+        assert ts.kl_ is not None and np.isfinite(ts.kl_)
+        assert self._separation(y, labels) > 2.0
+
+    def test_tree_mode_separates_blobs(self):
+        from deeplearning4j_tpu.plot import BarnesHutTsne
+        x, labels = self._blobs(n_per=40, seed=1)
+        ts = BarnesHutTsne(max_iter=150, perplexity=10.0, mode="tree",
+                           theta=0.5, seed=4)
+        y = ts.fit_transform(x)
+        assert self._separation(y, labels) > 1.5
+
+    def test_blocked_repulsion_matches_dense(self):
+        """The tiled kernel must equal the naive O(N²) computation."""
+        from deeplearning4j_tpu.plot import BarnesHutTsne
+        rng = np.random.default_rng(5)
+        y = jnp.asarray(rng.standard_normal((130, 2)), jnp.float32)
+        rep, z = BarnesHutTsne._repulsion_blocked(y, 32)
+        yn = np.asarray(y, np.float64)
+        diff = yn[:, None, :] - yn[None, :, :]
+        d2 = (diff ** 2).sum(-1)
+        num = 1.0 / (1.0 + d2)
+        np.fill_diagonal(num, 0.0)
+        np.testing.assert_allclose(float(z), num.sum(), rtol=1e-4)
+        dense = (num[:, :, None] ** 2 * diff).sum(1)
+        np.testing.assert_allclose(np.asarray(rep), dense, rtol=1e-3, atol=1e-4)
+
+    def test_invalid_mode(self):
+        from deeplearning4j_tpu.plot import BarnesHutTsne
+        with pytest.raises(ValueError):
+            BarnesHutTsne(mode="octree")
+
+    def test_near_duplicates_keep_mass(self):
+        """Regression: a point 1e-6 away must NOT be absorbed as a duplicate,
+        and absorbed exact duplicates keep their mass through subdivision."""
+        from deeplearning4j_tpu.knn import SPTree
+        pts = np.array([[1.0, 1.0], [1.0 + 1e-6, 1.0], [5.0, 5.0]])
+        t = SPTree(pts)
+        _, z = t.compute_non_edge_forces(pts[0], theta=0.0)
+        num = 1.0 / (1.0 + ((pts[0] - pts) ** 2).sum(1))
+        exact_z = num.sum() - 1.0  # exclude self
+        np.testing.assert_allclose(z, exact_z, rtol=1e-9)
+        # exact duplicates: mass survives subdivision
+        pts2 = np.array([[0.0, 0.0], [0.0, 0.0], [0.0, 0.0], [4.0, 4.0]])
+        t2 = SPTree(pts2)
+        _, z2 = t2.compute_non_edge_forces(pts2[3], theta=0.0)
+        np.testing.assert_allclose(z2, 3.0 / (1.0 + 32.0), rtol=1e-9)
+        # query at the coincident location: the other dups contribute q=1 each
+        _, z3 = t2.compute_non_edge_forces(pts2[0], theta=0.0)
+        np.testing.assert_allclose(z3, 2.0 + 1.0 / 33.0, rtol=1e-9)
