@@ -30,8 +30,11 @@ PEAK_BF16 = {
     "TPU v6 lite": 918e12,  # v6e (Trillium)
 }
 
-# ResNet-50 @224: ~4.09 GFLOPs forward per image; training ~3x forward.
-RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9
+# ResNet-50 @224 forward: 4.09e9 MACs = 8.18e9 FLOPs at the standard
+# 2-flops-per-MAC convention (the SAME convention as the peak numbers below,
+# and as XLA's cost model: compiled.cost_analysis() reports 2.248e10
+# flops/image for our train step). Training ~= 3x forward (PaLM MFU rule).
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 8.18e9
 
 
 def main():

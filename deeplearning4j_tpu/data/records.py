@@ -214,6 +214,42 @@ class TransformProcess:
         """Descriptor form (serializable except map/filter callables)."""
         return list(self.ops)
 
+    # --- JSON round-trip (TransformProcess.toJson/fromJson parity) ---
+    _CALLABLE_OPS = {"map_column", "filter_rows"}
+
+    def to_json(self) -> str:
+        """Serialize the pipeline. Ops with python callables (map_column,
+        filter_rows) cannot round-trip through JSON — same boundary as the
+        reference, whose JSON covers only its declarative op vocabulary."""
+        import json
+
+        bad = [n for n, _ in self.ops if n in self._CALLABLE_OPS]
+        if bad:
+            raise ValueError(f"Ops {bad} hold python callables and are not "
+                             f"JSON-serializable; keep pipelines declarative "
+                             f"to round-trip them")
+        return json.dumps({"ops": [{"op": n, **a} for n, a in self.ops]})
+
+    _KNOWN_OPS = {"remove_columns", "categorical_to_integer",
+                  "categorical_to_onehot", "normalize_minmax",
+                  "normalize_standardize"}
+
+    @classmethod
+    def from_json(cls, s: str) -> "TransformProcess":
+        import json
+
+        tp = cls()
+        for entry in json.loads(s)["ops"]:
+            entry = dict(entry)
+            name = entry.pop("op")
+            if name in cls._CALLABLE_OPS:
+                raise ValueError(f"Op '{name}' cannot be deserialized")
+            if name not in cls._KNOWN_OPS:  # fail fast, don't silently skip
+                raise ValueError(f"Unknown transform op '{name}' "
+                                 f"(known: {sorted(cls._KNOWN_OPS)})")
+            tp.ops.append((name, entry))
+        return tp
+
 
 # ---------------------------------------------------------------------------
 # RecordReader -> DataSet iterators

@@ -5,6 +5,12 @@ REST server/client."""
 
 from .brute import BruteForceKNN
 from .client import NearestNeighborsClient
+from .clustering import (BaseClusteringAlgorithm, ClusteringOptimizationType,
+                         ClusterSet, ClusterSetInfo, ConvergenceCondition,
+                         FixedClusterCountStrategy,
+                         FixedIterationCountCondition, IterationHistory,
+                         KMeansClustering, OptimisationStrategy,
+                         VarianceVariationCondition)
 from .kdtree import KDTree
 from .kmeans import KMeans
 from .lsh import RandomProjectionLSH
@@ -12,6 +18,11 @@ from .server import NearestNeighborsServer
 from .sptree import QuadTree, SPTree
 from .vptree import VPTree
 
-__all__ = ["BruteForceKNN", "KDTree", "KMeans", "NearestNeighborsClient",
-           "NearestNeighborsServer", "QuadTree", "RandomProjectionLSH",
-           "SPTree", "VPTree"]
+__all__ = ["BaseClusteringAlgorithm", "BruteForceKNN",
+           "ClusterSet", "ClusterSetInfo", "ClusteringOptimizationType",
+           "ConvergenceCondition", "FixedClusterCountStrategy",
+           "FixedIterationCountCondition", "IterationHistory", "KDTree",
+           "KMeans", "KMeansClustering", "NearestNeighborsClient",
+           "NearestNeighborsServer", "OptimisationStrategy", "QuadTree",
+           "RandomProjectionLSH", "SPTree", "VPTree",
+           "VarianceVariationCondition"]

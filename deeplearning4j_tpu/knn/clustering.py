@@ -1,0 +1,438 @@
+"""Clustering framework — strategies, termination conditions, iteration
+history, cluster info.
+
+Reference parity (``clustering/algorithm/BaseClusteringAlgorithm.java``,
+``strategy/{FixedClusterCountStrategy,OptimisationStrategy}``,
+``condition/{FixedIterationCountCondition,VarianceVariationCondition,
+ConvergenceCondition}``, ``info/{ClusterInfo,ClusterSetInfo}``,
+``optimisation/ClusteringOptimizationType``).
+
+TPU redesign: the reference pushes Point/Cluster object graphs through thread
+pools; one iteration here is ONE jitted device program (distance matmul on the
+MXU + segment reductions for every per-cluster statistic at once). The host
+keeps only the reference's control plane: iteration history, termination
+conditions, and the strategy actions (empty-cluster removal, spread-out
+splits, optimization splits) that change K between compiles.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.distances import pairwise_sq_dists
+
+
+# ---------------------------------------------------------------------------
+# Device kernel: one classify+refresh+stats pass
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _cluster_pass(points, centers, prev_assign, k: int):
+    """Assign points, recenter, and compute every ClusterInfo statistic in one
+    compiled program (ClusterUtils.classifyPoints + refreshClustersCenters +
+    computeClusterInfos collapsed)."""
+    d2 = pairwise_sq_dists(points, centers)
+    assign = jnp.argmin(d2, axis=1)
+    dist = jnp.sqrt(jnp.take_along_axis(d2, assign[:, None], 1)[:, 0])
+
+    one_hot = jax.nn.one_hot(assign, k, dtype=points.dtype)
+    counts = one_hot.sum(0)
+    new_centers = jnp.where(counts[:, None] > 0,
+                            (one_hot.T @ points) / jnp.maximum(counts[:, None], 1.0),
+                            centers)
+
+    sum_d = jax.ops.segment_sum(dist, assign, num_segments=k)
+    sum_d2 = jax.ops.segment_sum(dist * dist, assign, num_segments=k)
+    max_d = jax.ops.segment_max(jnp.where(counts[assign] > 0, dist, -jnp.inf),
+                                assign, num_segments=k)
+    avg = jnp.where(counts > 0, sum_d / jnp.maximum(counts, 1.0), 0.0)
+    var = jnp.where(counts > 0,
+                    sum_d2 / jnp.maximum(counts, 1.0) - avg * avg, 0.0)
+    changes = jnp.sum(assign != prev_assign)
+    return assign, new_centers, counts, avg, jnp.maximum(var, 0.0), \
+        jnp.where(jnp.isfinite(max_d), max_d, 0.0), dist, changes
+
+
+# ---------------------------------------------------------------------------
+# Info / history (ClusterInfo, ClusterSetInfo, IterationHistory)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ClusterInfo:
+    """Per-cluster statistics (info/ClusterInfo.java)."""
+
+    point_count: int
+    average_point_distance_from_center: float
+    point_distance_from_center_variance: float
+    max_point_distance_from_center: float
+
+
+@dataclass
+class ClusterSetInfo:
+    """Aggregate statistics for one iteration (info/ClusterSetInfo.java)."""
+
+    clusters: List[ClusterInfo]
+    point_location_change: int
+    points_count: int
+
+    @property
+    def point_distance_from_cluster_variance(self) -> float:
+        """Mean of per-cluster distance variances (getPointDistanceFromClusterVariance)."""
+        if not self.clusters:
+            return 0.0
+        return float(np.mean([c.point_distance_from_center_variance
+                              for c in self.clusters]))
+
+    @property
+    def average_point_distance_from_center(self) -> float:
+        n = sum(c.point_count for c in self.clusters)
+        if n == 0:
+            return 0.0
+        return float(sum(c.average_point_distance_from_center * c.point_count
+                         for c in self.clusters) / n)
+
+
+@dataclass
+class IterationInfo:
+    index: int
+    cluster_set_info: ClusterSetInfo
+    strategy_applied: bool = False
+
+
+class IterationHistory:
+    """iteration/IterationHistory.java."""
+
+    def __init__(self):
+        self.iterations: Dict[int, IterationInfo] = {}
+
+    @property
+    def iteration_count(self) -> int:
+        return len(self.iterations)
+
+    def most_recent(self) -> Optional[IterationInfo]:
+        if not self.iterations:
+            return None
+        return self.iterations[max(self.iterations)]
+
+    def get(self, i: int) -> IterationInfo:
+        return self.iterations[i]
+
+
+# ---------------------------------------------------------------------------
+# Termination / application conditions
+# ---------------------------------------------------------------------------
+
+
+class FixedIterationCountCondition:
+    """condition/FixedIterationCountCondition.java."""
+
+    def __init__(self, count: int):
+        self.count = count
+
+    @classmethod
+    def iteration_count_greater_than(cls, n: int):
+        return cls(n)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        return history.iteration_count >= self.count
+
+
+class ConvergenceCondition:
+    """condition/ConvergenceCondition.java: fraction of points that changed
+    cluster this iteration below a rate."""
+
+    def __init__(self, rate: float):
+        self.rate = rate
+
+    @classmethod
+    def distribution_variation_rate_less_than(cls, rate: float):
+        return cls(rate)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        if history.iteration_count <= 1:
+            return False
+        info = history.most_recent().cluster_set_info
+        return (info.point_location_change / max(info.points_count, 1)) < self.rate
+
+
+class VarianceVariationCondition:
+    """condition/VarianceVariationCondition.java: relative change of the
+    cluster distance variance below a threshold for `period` iterations."""
+
+    def __init__(self, variation: float, period: int):
+        self.variation = variation
+        self.period = period
+
+    @classmethod
+    def variance_variation_less_than(cls, variation: float, period: int):
+        return cls(variation, period)
+
+    def is_satisfied(self, history: IterationHistory) -> bool:
+        if history.iteration_count <= self.period:
+            return False
+        j = max(history.iterations)
+        for i in range(self.period):
+            cur = history.get(j - i).cluster_set_info.point_distance_from_cluster_variance
+            prev = history.get(j - i - 1).cluster_set_info.point_distance_from_cluster_variance
+            if prev == 0:
+                continue
+            if abs((cur - prev) / prev) >= self.variation:
+                return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Strategies
+# ---------------------------------------------------------------------------
+
+
+class ClusteringOptimizationType(Enum):
+    """optimisation/ClusteringOptimizationType.java."""
+
+    MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE = "avg_point_to_center"
+    MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE = "max_point_to_center"
+    MINIMIZE_PER_CLUSTER_POINT_COUNT = "per_cluster_point_count"
+
+
+class BaseClusteringStrategy:
+    """strategy/BaseClusteringStrategy.java: initial K, distance, termination."""
+
+    def __init__(self, initial_cluster_count: int, distance_function: str = "euclidean",
+                 allow_empty_clusters: bool = False):
+        self.initial_cluster_count = initial_cluster_count
+        self.distance_function = distance_function
+        self.allow_empty_clusters = allow_empty_clusters
+        self.termination_condition = None
+
+    # builder API (endWhenIterationCountEquals / endWhenDistributionVariationRateLessThan)
+    def end_when_iteration_count_equals(self, n: int):
+        self.termination_condition = FixedIterationCountCondition(n)
+        return self
+
+    def end_when_distribution_variation_rate_less_than(self, rate: float):
+        self.termination_condition = ConvergenceCondition(rate)
+        return self
+
+    def is_optimization_defined(self) -> bool:
+        return False
+
+    def is_optimization_applicable_now(self, history: IterationHistory) -> bool:
+        return False
+
+
+class FixedClusterCountStrategy(BaseClusteringStrategy):
+    """strategy/FixedClusterCountStrategy.java: K stays fixed; empty clusters
+    are removed and the most spread-out clusters split to restore K."""
+
+    @classmethod
+    def setup(cls, initial_cluster_count: int, distance_function: str = "euclidean",
+              allow_empty_clusters: bool = False):
+        return cls(initial_cluster_count, distance_function, allow_empty_clusters)
+
+
+class OptimisationStrategy(BaseClusteringStrategy):
+    """strategy/OptimisationStrategy.java: periodically split clusters that
+    violate the optimization target."""
+
+    def __init__(self, initial_cluster_count: int, distance_function: str = "euclidean"):
+        super().__init__(initial_cluster_count, distance_function,
+                         allow_empty_clusters=False)
+        self.optimization_type: Optional[ClusteringOptimizationType] = None
+        self.optimization_value: float = 0.0
+        self.application_condition = None
+
+    @classmethod
+    def setup(cls, initial_cluster_count: int, distance_function: str = "euclidean"):
+        return cls(initial_cluster_count, distance_function)
+
+    def optimize(self, opt_type: ClusteringOptimizationType, value: float):
+        self.optimization_type = opt_type
+        self.optimization_value = value
+        return self
+
+    def optimize_when_iteration_count_multiple_of(self, n: int):
+        self.application_condition = FixedIterationCountCondition(n)
+        return self
+
+    def optimize_when_point_distribution_variation_rate_less_than(self, rate: float):
+        self.application_condition = ConvergenceCondition(rate)
+        return self
+
+    def is_optimization_defined(self) -> bool:
+        return self.optimization_type is not None
+
+    def is_optimization_applicable_now(self, history: IterationHistory) -> bool:
+        if self.application_condition is None:
+            return True
+        return self.application_condition.is_satisfied(history)
+
+
+# ---------------------------------------------------------------------------
+# ClusterSet + the algorithm driver
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ClusterSet:
+    """cluster/ClusterSet.java: centers + assignments of the final model."""
+
+    centers: np.ndarray                  # (K, D)
+    assignments: np.ndarray              # (N,)
+    distances: np.ndarray                # (N,) distance to own center
+    info: ClusterSetInfo
+
+    @property
+    def cluster_count(self) -> int:
+        return len(self.centers)
+
+    def classify_point(self, p) -> int:
+        d = np.linalg.norm(self.centers - np.asarray(p)[None, :], axis=1)
+        return int(np.argmin(d))
+
+
+class BaseClusteringAlgorithm:
+    """algorithm/BaseClusteringAlgorithm.java: iterate classify/refresh under
+    the strategy until the termination condition is satisfied."""
+
+    #: hard backstop: the reference loops while the strategy keeps acting
+    #: (BaseClusteringAlgorithm.iterations), which can cycle forever on
+    #: degenerate data (e.g. duplicate coordinates keep producing an empty
+    #: cluster); we bound total iterations so apply_to always returns
+    MAX_TOTAL_ITERATIONS = 1000
+
+    def __init__(self, strategy: BaseClusteringStrategy, seed: int = 12345):
+        self.strategy = strategy
+        self.seed = seed
+        self.history = IterationHistory()
+
+    @classmethod
+    def setup(cls, strategy: BaseClusteringStrategy, seed: int = 12345):
+        return cls(strategy, seed)
+
+    # --- d²-weighted initial centers (initClusters :147-160, == kmeans++) ---
+    def _init_centers(self, pts: np.ndarray, k: int, rng) -> np.ndarray:
+        centers = [pts[rng.integers(len(pts))]]
+        d2 = np.full(len(pts), np.inf)
+        while len(centers) < k:
+            d2 = np.minimum(d2, ((pts - centers[-1]) ** 2).sum(-1))
+            r = rng.random() * d2.max()
+            idx = int(np.argmax(d2 >= r))
+            centers.append(pts[idx])
+        return np.stack(centers)
+
+    def apply_to(self, points) -> ClusterSet:
+        pts = np.asarray(points, np.float32)
+        n = len(pts)
+        rng = np.random.default_rng(self.seed)
+        k = min(self.strategy.initial_cluster_count, n)
+        centers = self._init_centers(pts, k, rng)
+        pts_j = jnp.asarray(pts)
+        assign = np.full(n, -1, np.int64)
+        self.history = IterationHistory()
+        it = 0
+        while True:
+            it += 1
+            k = len(centers)
+            (assign_j, centers_j, counts, avg, var, mx, dist,
+             changes) = _cluster_pass(pts_j, jnp.asarray(centers), jnp.asarray(assign), k)
+            assign = np.asarray(assign_j)
+            centers = np.asarray(centers_j)
+            counts, avg, var, mx = (np.asarray(a) for a in (counts, avg, var, mx))
+            info = ClusterSetInfo(
+                clusters=[ClusterInfo(int(counts[i]), float(avg[i]), float(var[i]),
+                                      float(mx[i])) for i in range(k)],
+                point_location_change=int(changes), points_count=n)
+            self.history.iterations[it] = IterationInfo(it, info)
+
+            strategy_applied = self._apply_strategy(pts, centers, counts, avg, mx, info)
+            self.history.iterations[it].strategy_applied = strategy_applied
+            if strategy_applied:
+                centers = self._pending_centers
+
+            cond = self.strategy.termination_condition
+            satisfied = (cond.is_satisfied(self.history) if cond is not None
+                         else it >= 100)  # defaultIterationCount
+            # reference semantics: loop again whenever the strategy acted,
+            # but ALWAYS stop at the hard backstop (see MAX_TOTAL_ITERATIONS)
+            if it >= self.MAX_TOTAL_ITERATIONS or (satisfied and not strategy_applied):
+                break
+        return ClusterSet(centers, assign, np.asarray(dist), info)
+
+    # --- strategy actions (applyClusteringStrategy :173-195) ---
+    def _apply_strategy(self, pts, centers, counts, avg, mx, info) -> bool:
+        applied = False
+        k0 = self.strategy.initial_cluster_count
+        if not self.strategy.allow_empty_clusters and (counts == 0).any():
+            keep = counts > 0
+            centers = centers[keep]
+            avg, mx, counts = avg[keep], mx[keep], counts[keep]
+            applied = True
+        # FIXED_CLUSTER_COUNT: restore K by splitting the most spread out
+        if isinstance(self.strategy, FixedClusterCountStrategy) and len(centers) < k0:
+            while len(centers) < k0:
+                centers = self._split(pts, centers, int(np.argmax(avg)))
+                avg = np.append(avg, 0.0)
+            applied = True
+        if (self.strategy.is_optimization_defined()
+                and self.history.iteration_count > 0
+                and self.strategy.is_optimization_applicable_now(self.history)):
+            split_idx = self._optimization_violations(counts, avg, mx)
+            for i in split_idx:
+                centers = self._split(pts, centers, i)
+            applied = applied or bool(split_idx)
+        self._pending_centers = centers
+        return applied
+
+    def _optimization_violations(self, counts, avg, mx) -> List[int]:
+        s: OptimisationStrategy = self.strategy  # type: ignore
+        t, v = s.optimization_type, s.optimization_value
+        T = ClusteringOptimizationType
+        if t == T.MINIMIZE_AVERAGE_POINT_TO_CENTER_DISTANCE:
+            return [int(i) for i in np.nonzero(avg > v)[0]]
+        if t == T.MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE:
+            return [int(i) for i in np.nonzero(mx > v)[0]]
+        if t == T.MINIMIZE_PER_CLUSTER_POINT_COUNT:
+            return [int(i) for i in np.nonzero(counts > v)[0]]
+        return []
+
+    def _split(self, pts, centers, cluster_idx) -> np.ndarray:
+        """ClusterUtils.splitCluster: new center = the member point farthest
+        from the split cluster's center."""
+        d = np.linalg.norm(pts - centers[cluster_idx][None, :], axis=1)
+        owner = np.argmin(
+            np.linalg.norm(pts[:, None, :] - centers[None, :, :], axis=-1), axis=1)
+        members = np.nonzero(owner == cluster_idx)[0]
+        if len(members) == 0:
+            far = int(np.argmax(d))
+        else:
+            far = int(members[np.argmax(d[members])])
+        return np.vstack([centers, pts[far]])
+
+
+class KMeansClustering(BaseClusteringAlgorithm):
+    """kmeans/KMeansClustering.java — the setup() surface of the reference."""
+
+    @classmethod
+    def setup(cls, cluster_count: int, max_iterations: int,
+              distance_function: str = "euclidean",
+              allow_empty_clusters: bool = False, seed: int = 12345):
+        strat = (FixedClusterCountStrategy
+                 .setup(cluster_count, distance_function, allow_empty_clusters)
+                 .end_when_iteration_count_equals(max_iterations))
+        return cls(strat, seed)
+
+    @classmethod
+    def setup_with_variation(cls, cluster_count: int, variation_rate: float,
+                             distance_function: str = "euclidean", seed: int = 12345):
+        strat = (FixedClusterCountStrategy.setup(cluster_count, distance_function)
+                 .end_when_distribution_variation_rate_less_than(variation_rate))
+        return cls(strat, seed)

@@ -61,8 +61,8 @@ class SPTree:
         return int(bits @ (1 << np.arange(self.dim)))
 
     def _ensure_capacity(self):
-        if self._n_nodes + self.n_children >= len(self._count):
-            grow = len(self._count)
+        while self._n_nodes + self.n_children >= len(self._count):
+            grow = max(len(self._count), self.n_children)
             for name in ("_center", "_width", "_com"):
                 arr = getattr(self, name)
                 setattr(self, name, np.vstack([arr, np.zeros((grow, self.dim))]))

@@ -50,18 +50,37 @@ class ZooModel:
         model.init()
         return model
 
+    def pretrained_path(self, pretrained_type: str = "imagenet") -> Path:
+        """THE pretrained checkpoint location: the standard model zip
+        (config + params + updater + normalizer, train/serialization.py)
+        under the cache dir — replacing the reference's CDN URL scheme
+        (ZooModel.pretrainedUrl)."""
+        return CACHE_DIR / f"{type(self).__name__.lower()}_{pretrained_type}.zip"
+
     def init_pretrained(self, pretrained_type: str = "imagenet"):
         """initPretrained(PretrainedType) — local cache only (zero egress)."""
-        path = CACHE_DIR / f"{type(self).__name__.lower()}_{pretrained_type}.zip"
+        path = self.pretrained_path(pretrained_type)
         if not path.exists():
             raise FileNotFoundError(
                 f"No cached pretrained weights at {path}. The reference downloads "
                 f"from a CDN (ZooModel.java:54-66); this environment has no egress — "
-                f"place a model zip there to use pretrained weights.")
+                f"produce the zip with save_pretrained() (e.g. from a Keras import) "
+                f"to use pretrained weights.")
         from ..train.serialization import load_model
 
-        model, *_ = load_model(str(path))
+        model, *_ = load_model(str(path))  # populates model.params/state
         return model
+
+    def save_pretrained(self, model, pretrained_type: str = "imagenet") -> Path:
+        """Publish `model`'s weights as this zoo entry's pretrained
+        checkpoint — the producer side the reference lacks locally (its zips
+        come only from the CDN). Round-trips with init_pretrained."""
+        path = self.pretrained_path(pretrained_type)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        from ..train.serialization import save_model
+
+        save_model(str(path), model, params=model.params, state=model.state)
+        return path
 
 
 def model_by_name(name: str, **kwargs) -> ZooModel:

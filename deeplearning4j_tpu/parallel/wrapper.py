@@ -114,14 +114,45 @@ class ParallelWrapper:
 
         self._step = step
 
+    def _require_pure_data_mesh(self):
+        """averaging/encoded modes stack one replica per device along the
+        data axis; a mesh with extra axes would silently replicate work and
+        drop batch rows (each worker is a full model replica — reference
+        ParallelWrapper semantics). Reject instead."""
+        axis_sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        if axis_sizes.get(DATA_AXIS, 0) != self.n_dev:
+            raise ValueError(
+                f"mode='{self.mode}' needs a pure data-parallel mesh "
+                f"({DATA_AXIS}={self.n_dev}); got axes {axis_sizes}. Use "
+                f"mode='shared_gradients' for meshes with model/seq axes.")
+
     # --- averaging: shard_map local replicas + periodic pmean ---
     def _init_averaging(self):
+        self._require_pure_data_mesh()
         mesh, tx, model, n = self.mesh, self.tx, self.model, self.n_dev
-        stack = lambda t: jax.tree.map(lambda a: jnp.broadcast_to(a, (n,) + a.shape), t)
         dev_sh = NamedSharding(mesh, P(DATA_AXIS))
-        self.params = jax.device_put(stack(model.params), dev_sh)
-        self.state = jax.device_put(stack(model.state), dev_sh)
-        self.opt_state = jax.device_put(stack(tx.init(model.params)), dev_sh)
+
+        def stack(tree):
+            """Replicas stacked over the data axis WITHOUT materializing the
+            (n, ...) array anywhere: each device's shard is built directly
+            from the single host copy (the transient n× host broadcast the
+            naive broadcast_to+device_put pays at ResNet scale)."""
+            def one(a):
+                a = np.asarray(a)
+                gshape = (n,) + a.shape
+                # rows per shard from the sharding itself: on a multi-axis
+                # mesh the data axis may hold >1 replica rows per device
+                rows = dev_sh.shard_shape(gshape)[0]
+                return jax.make_array_from_callback(
+                    gshape, dev_sh,
+                    lambda idx, _a=a, _r=rows: np.broadcast_to(
+                        _a[np.newaxis], (_r,) + _a.shape))
+
+            return jax.tree.map(one, tree)
+
+        self.params = stack(model.params)
+        self.state = stack(model.state)
+        self.opt_state = stack(tx.init(model.params))
         self._batch_sharding = dev_sh
 
         def make_step(with_mask: bool):
@@ -182,6 +213,7 @@ class ParallelWrapper:
         any codec at ICI bandwidth). Residuals accumulate per worker on
         device, so no gradient mass is lost, only delayed.
         """
+        self._require_pure_data_mesh()
         from jax.flatten_util import ravel_pytree
 
         from .compression import threshold_encode, topk_encode

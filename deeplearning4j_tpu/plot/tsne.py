@@ -29,36 +29,42 @@ from ..utils.distances import pairwise_sq_dists
 _pairwise_sq_dists = jax.jit(pairwise_sq_dists)
 
 
+def _row_bandwidth_search(d2_row, target_entropy, mask_row=None):
+    """Binary-search the Gaussian bandwidth beta matching the target entropy
+    for ONE row of squared distances; returns the normalized row of P.
+    Shared by the dense (masked-diagonal) and sparse-kNN calibrations."""
+
+    def h_beta(beta):
+        p = jnp.exp(-d2_row * beta)
+        if mask_row is not None:
+            p = jnp.where(mask_row, 0.0, p)
+        s = jnp.maximum(p.sum(), 1e-12)
+        h = jnp.log(s) + beta * jnp.sum(p * d2_row) / s
+        return h, p / s
+
+    def body(carry, _):
+        beta, lo, hi = carry
+        h, _ = h_beta(beta)
+        too_high = h > target_entropy  # entropy too high -> raise beta
+        lo = jnp.where(too_high, beta, lo)
+        hi = jnp.where(too_high, hi, beta)
+        beta = jnp.where(jnp.isinf(hi), beta * 2.0,
+                         jnp.where(jnp.isinf(lo), beta / 2.0, (lo + hi) / 2.0))
+        return (beta, lo, hi), None
+
+    init = (jnp.float32(1.0), jnp.float32(-jnp.inf), jnp.float32(jnp.inf))
+    (beta, _, _), _ = jax.lax.scan(body, init, None, length=50)
+    _, p = h_beta(beta)
+    return p
+
+
 @jax.jit
 def _calibrate_p(d2, target_entropy):
-    """Per-row binary search for the Gaussian bandwidth matching the target
-    perplexity (entropy). d2: (N,N) squared distances, diagonal excluded."""
-    n = d2.shape[0]
-    eye = jnp.eye(n, dtype=bool)
-
-    def row_search(d2_row, mask_row):
-        def h_beta(beta):
-            p = jnp.where(mask_row, 0.0, jnp.exp(-d2_row * beta))
-            s = jnp.maximum(p.sum(), 1e-12)
-            h = jnp.log(s) + beta * jnp.sum(p * d2_row) / s
-            return h, p / s
-
-        def body(carry, _):
-            beta, lo, hi = carry
-            h, _ = h_beta(beta)
-            too_high = h > target_entropy  # entropy too high -> raise beta
-            lo = jnp.where(too_high, beta, lo)
-            hi = jnp.where(too_high, hi, beta)
-            beta = jnp.where(jnp.isinf(hi), beta * 2.0,
-                             jnp.where(jnp.isinf(lo), beta / 2.0, (lo + hi) / 2.0))
-            return (beta, lo, hi), None
-
-        init = (jnp.float32(1.0), jnp.float32(-jnp.inf), jnp.float32(jnp.inf))
-        (beta, _, _), _ = jax.lax.scan(body, init, None, length=50)
-        _, p = h_beta(beta)
-        return p
-
-    return jax.vmap(row_search)(d2, eye)
+    """Per-row bandwidth calibration over the full (N,N) distance matrix,
+    diagonal excluded."""
+    eye = jnp.eye(d2.shape[0], dtype=bool)
+    return jax.vmap(partial(_row_bandwidth_search, target_entropy=target_entropy)
+                    )(d2, mask_row=eye)
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -150,38 +156,16 @@ def _knn_sparse_p(x: jnp.ndarray, perplexity: float, chunk: int = 1024):
 
     @jax.jit
     def chunk_neighbors(xc):
-        d2 = (jnp.sum(xc * xc, 1)[:, None] - 2.0 * xc @ x.T
-              + jnp.sum(x * x, 1)[None, :])
+        d2 = pairwise_sq_dists(xc, x)
         nd2, idx = jax.lax.top_k(-d2, k + 1)  # smallest distances
         return -nd2[:, 1:], idx[:, 1:]        # drop self (distance 0)
 
     @jax.jit
     def calibrate_rows(d2_rows):
-        """Binary-search beta per row over the K neighbour distances."""
-
-        def row(d2r):
-            def h_beta(beta):
-                p = jnp.exp(-d2r * beta)
-                s = jnp.maximum(p.sum(), 1e-12)
-                h = jnp.log(s) + beta * jnp.sum(p * d2r) / s
-                return h, p / s
-
-            def body(carry, _):
-                beta, lo, hi = carry
-                h, _ = h_beta(beta)
-                too_high = h > target_h
-                lo = jnp.where(too_high, beta, lo)
-                hi = jnp.where(too_high, hi, beta)
-                beta = jnp.where(jnp.isinf(hi), beta * 2.0,
-                                 jnp.where(jnp.isinf(lo), beta / 2.0, (lo + hi) / 2.0))
-                return (beta, lo, hi), None
-
-            init = (jnp.float32(1.0), jnp.float32(-jnp.inf), jnp.float32(jnp.inf))
-            (beta, _, _), _ = jax.lax.scan(body, init, None, length=50)
-            _, p = h_beta(beta)
-            return p
-
-        return jax.vmap(row)(d2_rows)
+        """Per-row bandwidth search over the K neighbour distances (same
+        kernel as the dense path, no self-mask needed)."""
+        return jax.vmap(partial(_row_bandwidth_search,
+                                target_entropy=target_h))(d2_rows)
 
     rows_l, cols_l, vals_l = [], [], []
     for s in range(0, n, chunk):
@@ -261,8 +245,7 @@ class BarnesHutTsne:
 
         def one_block(args):
             yb, vb = args  # (block, d), (block,)
-            d2 = (jnp.sum(yb * yb, 1)[:, None] - 2.0 * yb @ y.T
-                  + jnp.sum(y * y, 1)[None, :])
+            d2 = pairwise_sq_dists(yb, y)
             num = 1.0 / (1.0 + d2)
             num = jnp.where(d2 <= 1e-12, 0.0, num)  # exclude self/dups
             num = num * vb[:, None]

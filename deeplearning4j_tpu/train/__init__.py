@@ -18,6 +18,8 @@ from .faults import (DivergenceListener, FaultTolerantFit,
                      TrainingDivergedException)
 from .profiler import PhaseTimer, ProfilerListener
 from .serialization import load_model, save_model
+from .solvers import (Solver, SolverResult, backtrack_line_search,
+                      cg_minimize, lbfgs_minimize, line_gradient_descent)
 from .trainer import Trainer, build_updater
 
 __all__ = ["BestScoreEpochTermination", "CheckpointListener",
@@ -29,7 +31,9 @@ __all__ = ["BestScoreEpochTermination", "CheckpointListener",
            "LocalFileModelSaver", "MaxEpochsTermination",
            "MaxScoreIterationTermination", "MaxTimeIterationTermination",
            "PerformanceListener", "PhaseTimer", "ProfilerListener",
-           "ROCScoreCalculator", "ScoreIterationListener",
+           "ROCScoreCalculator", "ScoreIterationListener", "Solver",
+           "SolverResult", "backtrack_line_search", "cg_minimize",
+           "lbfgs_minimize", "line_gradient_descent",
            "ScoreImprovementEpochTermination", "SleepyTrainingListener",
            "TimeIterationListener", "Trainer", "TrainingListener",
            "build_updater", "load_model", "save_model"]

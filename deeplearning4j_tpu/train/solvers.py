@@ -1,0 +1,246 @@
+"""Line-search solvers — LBFGS / ConjugateGradient / BackTrackLineSearch.
+
+Reference parity: ``optimize/solvers/LBFGS.java`` (Nocedal & Wright §7.2
+two-loop recursion, history m=4), ``ConjugateGradient.java`` (Polak-Ribière
+with restart), ``LineGradientDescent.java``, and ``BackTrackLineSearch.java``
+(Armijo ALF=1e-4, stepMax=100, relTolx=1e-7, absTolx=1e-4).
+
+TPU redesign: the reference runs these as host loops of JNI ops mutating a
+flattened parameter vector. Here the ENTIRE optimization — direction
+computation, backtracking line search, convergence test — is one
+``lax.while_loop`` inside one jit: zero host round-trips until the final
+result. The LBFGS history is a fixed (m, n) ring buffer (static shapes for
+XLA), and params flow through ``ravel_pytree`` so any model pytree works.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+# BackTrackLineSearch.java constants
+ALF = 1e-4          # Armijo sufficient-decrease constant
+STEP_MAX = 100.0    # max line-search step norm
+REL_TOLX = 1e-7
+ABS_TOLX = 1e-4
+
+
+def backtrack_line_search(loss_f: Callable[[jnp.ndarray], jnp.ndarray],
+                          x: jnp.ndarray, f0: jnp.ndarray, g: jnp.ndarray,
+                          direction: jnp.ndarray, max_iterations: int = 5,
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Armijo backtracking (BackTrackLineSearch.optimize): returns
+    (step, f_at_step), where ``x + step * direction`` is the accepted point
+    in terms of the CALLER's direction (the stepMax clipping is folded into
+    the returned step). Jittable; the loop is a lax.while_loop."""
+    dnorm = jnp.linalg.norm(direction)
+    scale = jnp.where(dnorm > STEP_MAX, STEP_MAX / dnorm, 1.0)
+    direction = direction * scale
+    slope = jnp.vdot(g, direction)
+    # minimum useful step (relTolx test of the reference)
+    test = jnp.max(jnp.abs(direction) / jnp.maximum(jnp.abs(x), 1.0))
+    alamin = REL_TOLX / jnp.maximum(test, 1e-30)
+
+    def cond(carry):
+        it, alam, best_alam, best_f, done = carry
+        return (~done) & (it < max_iterations)
+
+    def body(carry):
+        it, alam, best_alam, best_f, _ = carry
+        f_new = loss_f(x + alam * direction)
+        ok = f_new <= f0 + ALF * alam * slope  # sufficient decrease
+        better = f_new < best_f
+        best_alam = jnp.where(better, alam, best_alam)
+        best_f = jnp.where(better, f_new, best_f)
+        # stop on Armijo acceptance or once steps become negligible; else halve
+        done = ok | (alam < alamin)
+        return it + 1, alam * 0.5, best_alam, best_f, done
+
+    _, _, best_alam, best_f, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), jnp.float32(1.0), jnp.float32(0.0), f0, jnp.bool_(False)))
+    # best improving step among those tested (reference keeps the best score
+    # when terminating on maxIterations); zero step if nothing improved
+    return best_alam * scale, best_f
+
+
+class SolverResult(NamedTuple):
+    params: any
+    score: float
+    iterations: int
+    converged: bool
+
+
+def _minimize(loss_fn, params0, *, algo: str, max_iterations: int,
+              history: int, line_search_iterations: int, tol: float):
+    x0, unravel = ravel_pytree(params0)
+    x0 = x0.astype(jnp.float32)
+    n = x0.shape[0]
+
+    def f(x):
+        return loss_fn(unravel(x)).astype(jnp.float32)
+
+    grad_f = jax.grad(f)
+
+    @jax.jit
+    def run(x0):
+        g0 = grad_f(x0)
+        f0 = f(x0)
+
+        if algo == "lbfgs":
+            # ring buffers: S (m,n) param diffs, Y (m,n) grad diffs, rho (m,)
+            init_hist = (jnp.zeros((history, n), jnp.float32),
+                         jnp.zeros((history, n), jnp.float32),
+                         jnp.zeros((history,), jnp.float32))
+        else:
+            init_hist = (jnp.zeros((n,), jnp.float32),)  # prev direction (CG)
+
+        def direction_lbfgs(g, hist, k):
+            S, Y, rho = hist
+            # two-loop recursion over the valid window (masked by rho != 0)
+            def loop1(i, carry):
+                q, alpha = carry
+                idx = (k - 1 - i) % history
+                a = rho[idx] * jnp.vdot(S[idx], q)
+                a = jnp.where(rho[idx] != 0, a, 0.0)
+                return q - a * Y[idx], alpha.at[idx].set(a)
+
+            q, alpha = jax.lax.fori_loop(
+                0, history, loop1, (g, jnp.zeros((history,), jnp.float32)))
+            # initial Hessian scaling gamma = s·y / y·y (Nocedal 7.20)
+            last = (k - 1) % history
+            sy = jnp.vdot(S[last], Y[last])
+            yy = jnp.vdot(Y[last], Y[last])
+            gamma = jnp.where((k > 0) & (yy > 0), sy / jnp.maximum(yy, 1e-20), 1.0)
+            r = gamma * q
+
+            def loop2(i, r):
+                idx = (k - history + i) % history
+                b = rho[idx] * jnp.vdot(Y[idx], r)
+                b = jnp.where(rho[idx] != 0, b, 0.0)
+                return r + (alpha[idx] - b) * S[idx]
+
+            r = jax.lax.fori_loop(0, history, loop2, r)
+            return -r
+
+        def direction_cg(g, g_prev, d_prev, k):
+            # Polak-Ribière beta with automatic restart (beta clipped at 0)
+            beta = jnp.vdot(g, g - g_prev) / jnp.maximum(jnp.vdot(g_prev, g_prev), 1e-20)
+            beta = jnp.where(k > 0, jnp.maximum(beta, 0.0), 0.0)
+            return -g + beta * d_prev
+
+        def cond(carry):
+            k, x, fx, g, hist, gprev, converged = carry
+            return (k < max_iterations) & (~converged)
+
+        def body(carry):
+            k, x, fx, g, hist, g_prev, _ = carry
+            if algo == "lbfgs":
+                d = direction_lbfgs(g, hist, k)
+            elif algo == "cg":
+                d = direction_cg(g, g_prev, hist[0], k)
+            else:  # line gradient descent
+                d = -g
+            # ensure descent; fall back to steepest descent
+            descent = jnp.vdot(d, g) < 0
+            d = jnp.where(descent, d, -g)
+
+            step, f_new = backtrack_line_search(
+                f, x, fx, g, d, max_iterations=line_search_iterations)
+            x_new = x + step * d
+            g_new = grad_f(x_new)
+
+            if algo == "lbfgs":
+                S, Y, rho = hist
+                s_vec = x_new - x
+                y_vec = g_new - g
+                sy = jnp.vdot(s_vec, y_vec)
+                idx = k % history
+                valid = sy > 1e-10
+                hist = (S.at[idx].set(jnp.where(valid, s_vec, 0.0)),
+                        Y.at[idx].set(jnp.where(valid, y_vec, 0.0)),
+                        rho.at[idx].set(jnp.where(valid, 1.0 / jnp.maximum(sy, 1e-20), 0.0)))
+            elif algo == "cg":
+                hist = (d,)
+
+            # EpsTermination parity: relative score improvement below tol,
+            # or the line search made no progress
+            converged = (jnp.abs(fx - f_new) <= tol * jnp.maximum(jnp.abs(fx), 1e-12)) | (step == 0.0)
+            return k + 1, x_new, f_new, g_new, hist, g, converged
+
+        k, x, fx, g, hist, gprev, converged = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), x0, f0, g0, init_hist, g0, jnp.bool_(False)))
+        return x, fx, k, converged
+
+    x, fx, k, converged = run(x0)
+    return SolverResult(unravel(x), float(fx), int(k), bool(converged))
+
+
+def lbfgs_minimize(loss_fn, params0, max_iterations: int = 100, history: int = 4,
+                   line_search_iterations: int = 5, tol: float = 1e-10):
+    """LBFGS.java — history m=4 default."""
+    return _minimize(loss_fn, params0, algo="lbfgs", max_iterations=max_iterations,
+                     history=history, line_search_iterations=line_search_iterations,
+                     tol=tol)
+
+
+def cg_minimize(loss_fn, params0, max_iterations: int = 100,
+                line_search_iterations: int = 5, tol: float = 1e-10):
+    """ConjugateGradient.java — Polak-Ribière with restart."""
+    return _minimize(loss_fn, params0, algo="cg", max_iterations=max_iterations,
+                     history=1, line_search_iterations=line_search_iterations,
+                     tol=tol)
+
+
+def line_gradient_descent(loss_fn, params0, max_iterations: int = 100,
+                          line_search_iterations: int = 5, tol: float = 1e-10):
+    """LineGradientDescent.java — steepest descent + line search."""
+    return _minimize(loss_fn, params0, algo="sd", max_iterations=max_iterations,
+                     history=1, line_search_iterations=line_search_iterations,
+                     tol=tol)
+
+
+class Solver:
+    """optimize/Solver.java surface: full-batch optimization of a model's
+    score with a second-order solver (OptimizationAlgorithm.{LBFGS,
+    CONJUGATE_GRADIENT, LINE_GRADIENT_DESCENT}).
+
+    For SGD-family training use ``Trainer`` — this class serves the
+    reference's small-data/fine-tuning use case where full-batch curvature
+    methods win.
+    """
+
+    ALGOS = {"lbfgs": lbfgs_minimize, "conjugate_gradient": cg_minimize,
+             "line_gradient_descent": line_gradient_descent}
+
+    def __init__(self, model, algo: str = "lbfgs", max_iterations: int = 100,
+                 line_search_iterations: int = 5):
+        if algo not in self.ALGOS:
+            raise ValueError(f"Unknown algo '{algo}' (choose from {sorted(self.ALGOS)})")
+        self.model = model
+        self.algo = algo
+        self.max_iterations = max_iterations
+        self.line_search_iterations = line_search_iterations
+        self.result: Optional[SolverResult] = None
+
+    def optimize(self, x, y) -> SolverResult:
+        model = self.model
+        if model.params is None:
+            model.init()
+        state = model.state
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+
+        def loss_fn(p):
+            loss, _ = model.score(p, state, xj, yj, training=False)
+            return loss
+
+        self.result = self.ALGOS[self.algo](
+            loss_fn, model.params, max_iterations=self.max_iterations,
+            line_search_iterations=self.line_search_iterations)
+        model.params = self.result.params
+        return self.result

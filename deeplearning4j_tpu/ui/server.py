@@ -80,9 +80,71 @@ async function render(){
         html+=`<tr><td>${esc(k)}</td><td>${esc(mm)}</td></tr>`;
       }
       html+='</table></div>';
+      html+=histsection('param histograms',model.latest.params);
+      if(model.latest.updates&&Object.keys(model.latest.updates).length)
+        html+=histsection('update histograms',model.latest.updates);
+      if(model.latest.activations)
+        html+=histsection('activation histograms (probe batch)',model.latest.activations);
+      if(model.latest.conv_filters)html+=filters(model.latest.conv_filters);
     }
   }
   root.innerHTML=html||'<i>no sessions yet</i>';
+}
+function bars(h,w,ht){
+  if(!h||!h.counts||!h.counts.length)return '';
+  const mx=Math.max(...h.counts,1);const bw=(w-10)/h.counts.length;
+  let s=`<svg width=${w} height=${ht}>`;
+  h.counts.forEach((c,i)=>{const bh=c/mx*(ht-22);
+    s+=`<rect x=${5+i*bw} y=${ht-16-bh} width=${Math.max(bw-1,1)} height=${bh} fill=#2ca02c />`});
+  s+=`<text x=2 y=${ht-3} font-size=9>${h.min.toPrecision(3)}</text>`+
+     `<text x=${w-48} y=${ht-3} font-size=9>${h.max.toPrecision(3)}</text></svg>`;
+  return s;
+}
+function histsection(title,stats){
+  let s=`<div class=chart><h4>${esc(title)}</h4>`;
+  for(const[k,v]of Object.entries(stats)){
+    if(!v.histogram)continue;
+    s+=`<div style="display:inline-block;margin:3px"><div style="font-size:11px">${esc(k)}</div>${bars(v.histogram,170,90)}</div>`;
+  }
+  return s+'</div>';
+}
+function filters(f){
+  const cell=8;let s=`<div class=chart><h4>conv filters: ${esc(f.layer)}</h4>`;
+  for(const g of f.filters){
+    s+=`<svg width=${f.kw*cell+2} height=${f.kh*cell+2} style="margin:2px;border:1px solid #ccc">`;
+    g.forEach((row,y)=>row.forEach((v,x)=>{
+      s+=`<rect x=${x*cell} y=${y*cell} width=${cell} height=${cell} fill=rgb(${v},${v},${v}) />`}));
+    s+='</svg>';
+  }
+  return s+'</div>';
+}
+render();setInterval(render,5000);
+</script></body></html>"""
+
+_TSNE_HTML = """<!DOCTYPE html>
+<html><head><title>t-SNE viewer</title>
+<style>body{font-family:sans-serif;margin:20px}</style></head>
+<body><h2>t-SNE embedding</h2><div id="plot"><i>no embedding uploaded</i></div>
+<script>
+const PALETTE=['#1f77b4','#ff7f0e','#2ca02c','#d62728','#9467bd','#8c564b',
+               '#e377c2','#7f7f7f','#bcbd22','#17becf'];
+function esc(s){return String(s).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
+async function render(){
+  const r=await fetch('/tsne/data');const d=await r.json();
+  if(!d.coords||!d.coords.length)return;
+  const W=760,H=560,pad=30;
+  const xs=d.coords.map(c=>c[0]),ys=d.coords.map(c=>c[1]);
+  const xmin=Math.min(...xs),xmax=Math.max(...xs),ymin=Math.min(...ys),ymax=Math.max(...ys);
+  const sx=x=>(x-xmin)/Math.max(xmax-xmin,1e-9)*(W-2*pad)+pad;
+  const sy=y=>H-pad-(y-ymin)/Math.max(ymax-ymin,1e-9)*(H-2*pad);
+  let s=`<svg width=${W} height=${H} style="border:1px solid #ddd">`;
+  d.coords.forEach((c,i)=>{
+    const lab=d.labels?d.labels[i]:0;
+    const col=typeof lab==='number'?PALETTE[lab%10]:PALETTE[Math.abs(String(lab).split('').reduce((a,ch)=>a+ch.charCodeAt(0),0))%10];
+    s+=`<circle cx=${sx(c[0])} cy=${sy(c[1])} r=2.5 fill=${col}><title>${esc(lab)}</title></circle>`;
+  });
+  document.getElementById('plot').innerHTML=s+'</svg>';
 }
 render();setInterval(render,5000);
 </script></body></html>"""
@@ -96,6 +158,19 @@ class UIServer(JsonHTTPServerMixin):
         self.storage = storage or InMemoryStatsStorage()
         self.port = port
         self.host = host  # bind 0.0.0.0 for the cross-host remote-receiver path
+        self._tsne: dict = {}  # {"coords": [[x,y],...], "labels": [...]}
+
+    def upload_tsne(self, coords, labels=None) -> "UIServer":
+        """Publish a 2-D embedding to the /tsne viewer (TsneModule parity:
+        the reference uploads t-SNE coord files to the UI)."""
+        import numpy as _np
+
+        c = _np.asarray(coords, float)
+        if c.ndim != 2 or c.shape[1] < 2:
+            raise ValueError(f"coords must be (N, 2+), got {c.shape}")
+        self._tsne = {"coords": c[:, :2].tolist(),
+                      "labels": list(labels) if labels is not None else None}
+        return self
 
     def attach(self, storage: BaseStatsStorage) -> "UIServer":
         self.storage = storage
@@ -143,6 +218,10 @@ class UIServer(JsonHTTPServerMixin):
                 try:
                     if path in ("/", "/train", "/train/"):
                         self.reply(200, _DASH_HTML, "text/html")
+                    elif path in ("/tsne", "/tsne/"):
+                        self.reply(200, _TSNE_HTML, "text/html")
+                    elif path == "/tsne/data":
+                        self.reply(200, server._tsne or {"coords": [], "labels": None})
                     elif path == "/train/sessions":
                         self.reply(200, server.storage.list_sessions())
                     elif path.startswith("/train/") and path.endswith("/overview"):
@@ -175,6 +254,10 @@ class UIServer(JsonHTTPServerMixin):
                                 sid, tid, wid, float(req.get("timestamp", 0.0)),
                                 req.get("record", {}))
                         self.reply(200, {"status": "ok"})
+                    elif path == "/tsne/upload":
+                        server.upload_tsne(req["coords"], req.get("labels"))
+                        self.reply(200, {"status": "ok",
+                                         "points": len(server._tsne["coords"])})
                     else:
                         self.reply(404, {"error": "unknown endpoint"})
                 except (KeyError, ValueError, TypeError, AttributeError,

@@ -67,12 +67,18 @@ class StatsListener(TrainingListener):
 
     def __init__(self, storage: BaseStatsStorage, session_id: Optional[str] = None,
                  worker_id: str = "worker_0", frequency: int = 10,
-                 collect_histograms: bool = True):
+                 collect_histograms: bool = True,
+                 activation_probe=None, collect_conv_filters: bool = True):
         self.storage = storage
         self.session_id = session_id or f"session_{uuid.uuid4().hex[:8]}"
         self.worker_id = worker_id
         self.frequency = max(int(frequency), 1)
         self.collect_histograms = collect_histograms
+        # fixed probe batch for per-layer activation stats (TrainModule's
+        # activations tab; DL4J hooks the live forward — our step is one
+        # fused program, so a probe forward at report time replaces it)
+        self.activation_probe = activation_probe
+        self.collect_conv_filters = collect_conv_filters
         self._prev_params = None
         self._last_time = None
         self._initialized = False
@@ -160,7 +166,67 @@ class StatsListener(TrainingListener):
                 resource.RUSAGE_SELF).ru_maxrss / 1024.0
         except ImportError:  # non-POSIX
             pass
-        return {"params": param_stats, "updates": update_stats, "memory": mem}
+        out = {"params": param_stats, "updates": update_stats, "memory": mem}
+        act = self._activation_stats(trainer)
+        if act:
+            out["activations"] = act
+        if self.collect_conv_filters:
+            filt = conv_filter_grid(params)
+            if filt:
+                out["conv_filters"] = filt
+        return out
+
+    def _activation_stats(self, trainer) -> dict:
+        """Per-layer activation mean/std/histogram on the probe batch
+        (TrainModule activations view)."""
+        if self.activation_probe is None:
+            return {}
+        model = trainer.model
+        if not hasattr(model, "activations"):
+            return {}
+        acts = model.activations(trainer.params, trainer.state,
+                                 jnp.asarray(self.activation_probe))
+        out = {}
+        for i, a in enumerate(acts):
+            mm, sd, mn, mx = (_finite_or_none(v)
+                              for v in jax.tree.leaves(_stat4(a)))
+            an = np.asarray(a)
+            entry = {"mean_magnitude": mm, "std": sd, "min": mn, "max": mx,
+                     "shape": list(an.shape)}
+            if self.collect_histograms:
+                entry["histogram"] = _histogram(an.ravel())
+            out[f"layer_{i}"] = entry
+        return out
+
+
+def _layer_sort_key(name: str):
+    """Numeric-aware ordering so layer_10 sorts after layer_2."""
+    import re
+
+    return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", name)]
+
+
+def conv_filter_grid(params, max_filters: int = 16) -> Optional[dict]:
+    """First conv layer's kernels as a JSON-safe grid of 0..255 ints
+    (TrainModule's convolutional filter visualization). Kernels are HWIO;
+    input channels are averaged, each filter min-max normalized."""
+    flat = _flatten_names(params)
+    for lname in sorted(flat, key=_layer_sort_key):
+        if not lname.endswith("/w"):
+            continue
+        w = np.asarray(flat[lname])
+        if w.ndim != 4:  # (kh, kw, cin, cout) convs only
+            continue
+        kh, kw, _, cout = w.shape
+        n = min(cout, max_filters)
+        grid = []
+        for f in range(n):
+            k = w[:, :, :, f].mean(axis=-1)
+            lo, hi = float(k.min()), float(k.max())
+            norm = (k - lo) / (hi - lo) if hi > lo else np.zeros_like(k)
+            grid.append(np.round(norm * 255).astype(int).tolist())
+        return {"layer": lname, "kh": kh, "kw": kw, "filters": grid}
+    return None
 
 
 @jax.jit

@@ -1,0 +1,89 @@
+"""Time-series / masked-reduction utilities.
+
+Reference parity: ``util/TimeSeriesUtils.java`` (mask reshaping, last-step
+extraction, time reversal) and ``util/MaskedReductionUtil.java`` (masked
+max/avg/sum/pnorm pooling). The same math lives fused inside GlobalPooling /
+LastTimeStep; these standalone functions are the public utility surface the
+reference exposes, jit-friendly (static shapes, no data-dependent control
+flow) so they compose inside any training step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+def masked_pool(x: Array, mask: Optional[Array], mode: str = "avg",
+                pnorm: int = 2) -> Array:
+    """Masked reduction over the time axis of (B, T, F) — MaskedReductionUtil
+    masked{Max,Avg,Sum,PNorm}TimeSeries. mask: (B, T) 1/0; None = all valid."""
+    if x.ndim != 3:
+        raise ValueError(f"masked_pool expects (B, T, F), got {x.shape}")
+    if mask is None:
+        m = jnp.ones(x.shape[:2], x.dtype)[..., None]
+    else:
+        m = mask.astype(x.dtype)[..., None]
+    if mode == "max":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        return jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    if mode == "sum":
+        return jnp.sum(x * m, axis=1)
+    if mode == "avg":
+        return jnp.sum(x * m, axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+    if mode == "pnorm":
+        s = jnp.sum(jnp.abs(x * m) ** pnorm, axis=1)
+        return s ** (1.0 / pnorm)
+    raise ValueError(f"Unknown pooling mode '{mode}'")
+
+
+def pull_last_time_step(x: Array, mask: Optional[Array] = None) -> Array:
+    """(B, T, F) -> (B, F): the LAST VALID step per sequence
+    (TimeSeriesUtils.pullLastTimeSteps). With no mask, step T-1."""
+    if x.ndim != 3:
+        raise ValueError(f"pull_last_time_step expects (B, T, F), got {x.shape}")
+    if mask is None:
+        return x[:, -1, :]
+    idx = last_time_step_index(mask)
+    return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :]
+
+
+def last_time_step_index(mask: Array) -> Array:
+    """(B, T) mask -> (B,) index of each sequence's last valid step
+    (TimeSeriesUtils.getLastTimeStepIndex); all-zero masks map to step 0."""
+    T = mask.shape[1]
+    has = mask > 0
+    rev_arg = jnp.argmax(has[:, ::-1].astype(jnp.int32), axis=1)
+    idx = T - 1 - rev_arg
+    return jnp.where(has.any(axis=1), idx, 0)
+
+
+def reverse_time_series(x: Array, mask: Optional[Array] = None) -> Array:
+    """Reverse the time axis; with a mask, each sequence reverses within its
+    own valid length, padding stays at the tail
+    (TimeSeriesUtils.reverseTimeSeries — the Bidirectional-RNN primitive)."""
+    if mask is None:
+        return x[:, ::-1, ...]
+    T = x.shape[1]
+    lengths = jnp.sum((mask > 0).astype(jnp.int32), axis=1)  # (B,)
+    t = jnp.arange(T)[None, :]                               # (1, T)
+    src = lengths[:, None] - 1 - t                           # reversed index
+    src = jnp.where((t < lengths[:, None]) & (src >= 0), src, t)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def expand_time_series_mask(mask: Array, features: int) -> Array:
+    """(B, T) -> (B, T, F) broadcast of a per-step mask to per-feature
+    (TimeSeriesUtils.reshapeTimeSeriesMaskToVector's inverse layout — our
+    layout is feature-last, so the expansion is a broadcast, not a reshape)."""
+    return jnp.broadcast_to(mask[..., None].astype(jnp.float32),
+                            mask.shape + (features,))
+
+
+def time_series_lengths(mask: Array) -> Array:
+    """(B, T) mask -> (B,) valid lengths."""
+    return jnp.sum((mask > 0).astype(jnp.int32), axis=1)

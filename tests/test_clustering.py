@@ -1,0 +1,147 @@
+"""Clustering framework tests (clustering/algorithm + strategy + condition).
+
+Oracle pattern: blob data with known structure; conditions checked against
+hand-computed histories; optimization strategies must actually change K."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.knn import (BaseClusteringAlgorithm,
+                                    ClusteringOptimizationType,
+                                    ConvergenceCondition,
+                                    FixedClusterCountStrategy,
+                                    FixedIterationCountCondition,
+                                    IterationHistory, KMeansClustering,
+                                    OptimisationStrategy,
+                                    VarianceVariationCondition)
+from deeplearning4j_tpu.knn.clustering import (ClusterInfo, ClusterSetInfo,
+                                               IterationInfo)
+
+
+def blobs(n_per=50, k=3, spread=0.3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((k, 4)) * 6
+    x = np.concatenate([rng.standard_normal((n_per, 4)) * spread + c
+                        for c in centers])
+    return x.astype(np.float32), np.repeat(np.arange(k), n_per)
+
+
+def _history(variances=(), changes=(), n_points=100):
+    h = IterationHistory()
+    for i, v in enumerate(variances or [0.0] * len(changes), start=1):
+        ch = changes[i - 1] if changes else 0
+        info = ClusterSetInfo(clusters=[ClusterInfo(n_points, 1.0, v, 2.0)],
+                              point_location_change=ch, points_count=n_points)
+        h.iterations[i] = IterationInfo(i, info)
+    return h
+
+
+class TestConditions:
+    def test_fixed_iteration_count(self):
+        c = FixedIterationCountCondition.iteration_count_greater_than(3)
+        assert not c.is_satisfied(_history(variances=[1, 1]))
+        assert c.is_satisfied(_history(variances=[1, 1, 1]))
+
+    def test_convergence_rate(self):
+        c = ConvergenceCondition.distribution_variation_rate_less_than(0.05)
+        assert not c.is_satisfied(_history(changes=[90, 50]))      # 50% moved
+        assert c.is_satisfied(_history(changes=[90, 2]))           # 2% moved
+        assert not c.is_satisfied(_history(changes=[90]))          # too early
+
+    def test_variance_variation(self):
+        c = VarianceVariationCondition.variance_variation_less_than(0.01, period=2)
+        # variance stable over the last 2 transitions -> satisfied
+        assert c.is_satisfied(_history(variances=[5.0, 1.0, 1.001, 1.0011]))
+        # still moving -> not satisfied
+        assert not c.is_satisfied(_history(variances=[5.0, 3.0, 2.0, 1.0]))
+        # fewer iterations than period -> never satisfied
+        assert not c.is_satisfied(_history(variances=[1.0, 1.0]))
+
+
+class TestKMeansClustering:
+    def test_recovers_blobs(self):
+        x, labels = blobs()
+        algo = KMeansClustering.setup(3, max_iterations=30, seed=1)
+        cs = algo.apply_to(x)
+        assert cs.cluster_count == 3
+        # every true blob maps to exactly one predicted cluster
+        mapping = [np.bincount(cs.assignments[labels == t], minlength=3).argmax()
+                   for t in range(3)]
+        assert len(set(mapping)) == 3
+        purity = np.mean([np.bincount(cs.assignments[labels == t]).max()
+                          / (labels == t).sum() for t in range(3)])
+        assert purity > 0.95
+        # info is populated for every cluster
+        assert all(c.point_count > 0 for c in cs.info.clusters)
+        assert cs.info.average_point_distance_from_center < 2.0
+
+    def test_variation_termination_stops_early(self):
+        x, _ = blobs(seed=2)
+        algo = KMeansClustering.setup_with_variation(3, variation_rate=0.01, seed=2)
+        algo.apply_to(x)
+        assert algo.history.iteration_count < 50
+
+    def test_classify_point(self):
+        x, _ = blobs(seed=3)
+        cs = KMeansClustering.setup(3, 20, seed=3).apply_to(x)
+        i = cs.classify_point(x[0])
+        assert i == cs.assignments[0]
+
+    def test_fixed_count_resplits_empty(self):
+        # k=4 over 3 tight blobs: some init may produce an empty cluster;
+        # strategy must keep K at 4 by splitting the most spread out
+        x, _ = blobs(n_per=30, k=3, seed=4)
+        cs = KMeansClustering.setup(4, 25, seed=4).apply_to(x)
+        assert cs.cluster_count == 4
+        assert all(c.point_count > 0 for c in cs.info.clusters)
+
+
+class TestOptimisationStrategy:
+    def test_split_on_max_distance(self):
+        """Start with K=1 over two far blobs: the optimization must split."""
+        x, _ = blobs(n_per=40, k=2, seed=5)
+        strat = (OptimisationStrategy.setup(1)
+                 .optimize(ClusteringOptimizationType
+                           .MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE, 3.0)
+                 .end_when_iteration_count_equals(15))
+        cs = BaseClusteringAlgorithm.setup(strat, seed=5).apply_to(x)
+        assert cs.cluster_count >= 2
+        assert all(c.max_point_distance_from_center < 4.0
+                   for c in cs.info.clusters)
+
+    def test_split_on_point_count(self):
+        x, _ = blobs(n_per=60, k=2, seed=6)
+        strat = (OptimisationStrategy.setup(1)
+                 .optimize(ClusteringOptimizationType
+                           .MINIMIZE_PER_CLUSTER_POINT_COUNT, 80)
+                 .end_when_iteration_count_equals(12))
+        cs = BaseClusteringAlgorithm.setup(strat, seed=6).apply_to(x)
+        assert cs.cluster_count >= 2
+        assert all(c.point_count <= 80 for c in cs.info.clusters)
+
+    def test_application_condition_gates_optimization(self):
+        x, _ = blobs(n_per=40, k=2, seed=7)
+        strat = (OptimisationStrategy.setup(1)
+                 .optimize(ClusteringOptimizationType
+                           .MINIMIZE_MAXIMUM_POINT_TO_CENTER_DISTANCE, 1e9)
+                 .optimize_when_iteration_count_multiple_of(3)
+                 .end_when_iteration_count_equals(8))
+        cs = BaseClusteringAlgorithm.setup(strat, seed=7).apply_to(x)
+        assert cs.cluster_count == 1  # threshold huge: never splits
+
+
+class TestDegenerateInputs:
+    def test_duplicate_coordinates_terminate(self):
+        """Regression: duplicate-coordinate data used to loop forever when the
+        empty-cluster remove/split cycle re-fired every iteration."""
+        pts = np.array([[0.0, 0.0]] * 5 + [[1.0, 1.0]] * 5, np.float32)
+        algo = KMeansClustering.setup(3, max_iterations=5, seed=0)
+        algo.MAX_TOTAL_ITERATIONS = 40  # keep the test fast
+        cs = algo.apply_to(pts)  # must RETURN (hang = test timeout)
+        assert cs.cluster_count >= 2
+        assert algo.history.iteration_count <= 40
+
+    def test_unknown_transform_op_rejected(self):
+        from deeplearning4j_tpu.data.records import TransformProcess
+        with pytest.raises(ValueError, match="Unknown transform op"):
+            TransformProcess.from_json('{"ops": [{"op": "remove_colums", "indices": [0]}]}')

@@ -242,3 +242,15 @@ class TestRecordsETL:
         assert x.shape == (2, 64, 64, 3)
         assert y.shape == (2, 2)  # 2 classes from train/, NOT 1 from 'images'
         np.testing.assert_array_equal(y, [[0, 1], [1, 0]])
+
+    def test_transform_json_roundtrip(self):
+        from deeplearning4j_tpu.data.records import TransformProcess
+        tp = (TransformProcess()
+              .remove_columns(0)
+              .categorical_to_integer(1, ["a", "b"])
+              .normalize_minmax(0, 0.0, 10.0))
+        tp2 = TransformProcess.from_json(tp.to_json())
+        rec = ["junk", 5.0, "b"]
+        assert tp(rec) == tp2(rec) == [0.5, 1.0]
+        with pytest.raises(ValueError, match="callables"):
+            TransformProcess().filter_rows(lambda r: True).to_json()

@@ -247,3 +247,69 @@ class TestRegularization:
         params = {"w": jnp.ones((50, 50))}
         out = regularization.drop_connect(jax.random.PRNGKey(0), params, 0.5)
         assert float((out["w"] == 0).mean()) > 0.4
+
+
+class TestTimeSeriesUtils:
+    """util/TimeSeriesUtils + MaskedReductionUtil parity (standalone)."""
+
+    def _data(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 5, 4)).astype(np.float32)
+        mask = np.array([[1, 1, 1, 0, 0],
+                         [1, 1, 1, 1, 1],
+                         [1, 0, 0, 0, 0]], np.float32)
+        return jnp.asarray(x), jnp.asarray(mask)
+
+    def test_masked_pool_modes(self):
+        from deeplearning4j_tpu.utils.timeseries import masked_pool
+        x, m = self._data()
+        xn, mn = np.asarray(x), np.asarray(m)
+        for b in range(3):
+            valid = xn[b][mn[b] > 0]
+            np.testing.assert_allclose(np.asarray(masked_pool(x, m, "max"))[b],
+                                       valid.max(0), rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(masked_pool(x, m, "avg"))[b],
+                                       valid.mean(0), rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(masked_pool(x, m, "sum"))[b],
+                                       valid.sum(0), rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(masked_pool(x, m, "pnorm"))[b],
+                                       np.sqrt((valid ** 2).sum(0)), rtol=1e-5)
+        with pytest.raises(ValueError):
+            masked_pool(x, m, "median")
+
+    def test_pull_last_time_step(self):
+        from deeplearning4j_tpu.utils.timeseries import pull_last_time_step
+        x, m = self._data()
+        got = np.asarray(pull_last_time_step(x, m))
+        np.testing.assert_allclose(got[0], np.asarray(x)[0, 2], rtol=1e-6)
+        np.testing.assert_allclose(got[1], np.asarray(x)[1, 4], rtol=1e-6)
+        np.testing.assert_allclose(got[2], np.asarray(x)[2, 0], rtol=1e-6)
+        # no mask: plain last step
+        np.testing.assert_allclose(np.asarray(pull_last_time_step(x))[0],
+                                   np.asarray(x)[0, -1], rtol=1e-6)
+
+    def test_reverse_time_series_respects_lengths(self):
+        from deeplearning4j_tpu.utils.timeseries import reverse_time_series
+        x, m = self._data()
+        r = np.asarray(reverse_time_series(x, m))
+        xn = np.asarray(x)
+        # seq 0 has length 3: reversed within [0,3), padding untouched
+        np.testing.assert_allclose(r[0, :3], xn[0, :3][::-1], rtol=1e-6)
+        np.testing.assert_allclose(r[0, 3:], xn[0, 3:], rtol=1e-6)
+        # full-length seq fully reversed
+        np.testing.assert_allclose(r[1], xn[1][::-1], rtol=1e-6)
+        # double reverse is identity
+        rr = np.asarray(reverse_time_series(jnp.asarray(r), m))
+        np.testing.assert_allclose(rr, xn, rtol=1e-6)
+
+    def test_lengths_and_expand(self):
+        from deeplearning4j_tpu.utils.timeseries import (
+            expand_time_series_mask, last_time_step_index,
+            time_series_lengths)
+        _, m = self._data()
+        np.testing.assert_array_equal(np.asarray(time_series_lengths(m)), [3, 5, 1])
+        np.testing.assert_array_equal(np.asarray(last_time_step_index(m)), [2, 4, 0])
+        zeros = jnp.zeros((2, 4))
+        np.testing.assert_array_equal(np.asarray(last_time_step_index(zeros)), [0, 0])
+        e = expand_time_series_mask(m, 7)
+        assert e.shape == (3, 5, 7)

@@ -304,3 +304,16 @@ class TestParallelInference:
                 np.testing.assert_allclose(o[0], direct[i], rtol=1e-5, atol=1e-6)
         finally:
             server.shutdown()
+
+
+class TestAveragingMultiAxisMesh:
+    def test_replica_modes_reject_multi_axis_mesh(self):
+        """averaging/encoded stack one replica per device over the data axis;
+        a model/seq axis would silently replicate work and drop batch rows —
+        must be rejected up front."""
+        from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS,
+                                                      make_mesh)
+        mesh = make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2})
+        for mode in ("averaging", "encoded_gradients"):
+            with pytest.raises(ValueError, match="pure data-parallel"):
+                ParallelWrapper(iris_net(), mesh=mesh, mode=mode)

@@ -1,0 +1,103 @@
+"""Line-search solver tests (optimize/solvers/ parity).
+
+Oracles: convex quadratic with known minimum; Rosenbrock (the standard
+curvature-method stress test — SGD crawls, LBFGS converges); a small net
+trained to near-zero loss on separable data."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.train import (Solver, backtrack_line_search,
+                                      cg_minimize, lbfgs_minimize,
+                                      line_gradient_descent)
+
+
+def quadratic(x):
+    a = jnp.arange(1, x["w"].size + 1, dtype=jnp.float32)
+    return jnp.sum(a * (x["w"] - 2.0) ** 2)
+
+
+def rosenbrock(p):
+    x, y = p["x"], p["y"]
+    return (1 - x) ** 2 + 100.0 * (y - x * x) ** 2
+
+
+class TestLineSearch:
+    def test_accepts_descent_step(self):
+        f = lambda x: jnp.sum(x * x)
+        x = jnp.asarray([3.0, -4.0])
+        g = 2 * x
+        step, f_new = backtrack_line_search(f, x, f(x), g, -g)
+        assert float(step) > 0
+        assert float(f_new) < float(f(x))
+
+    def test_no_step_uphill(self):
+        f = lambda x: jnp.sum(x * x)
+        x = jnp.asarray([1.0, 1.0])
+        g = 2 * x
+        step, f_new = backtrack_line_search(f, x, f(x), g, +g,
+                                            max_iterations=8)
+        assert float(f_new) <= float(f(x))
+
+
+class TestMinimizers:
+    def test_lbfgs_quadratic_exact(self):
+        res = lbfgs_minimize(quadratic, {"w": jnp.zeros(12)}, max_iterations=60)
+        np.testing.assert_allclose(np.asarray(res.params["w"]), 2.0, atol=1e-3)
+        assert res.score < 1e-6
+
+    def test_cg_quadratic(self):
+        res = cg_minimize(quadratic, {"w": jnp.zeros(12)}, max_iterations=150,
+                          line_search_iterations=20, tol=0.0)
+        np.testing.assert_allclose(np.asarray(res.params["w"]), 2.0, atol=1e-3)
+
+    def test_line_gd_quadratic(self):
+        res = line_gradient_descent(quadratic, {"w": jnp.zeros(6)},
+                                    max_iterations=200)
+        np.testing.assert_allclose(np.asarray(res.params["w"]), 2.0, atol=0.05)
+
+    def test_lbfgs_beats_gd_on_rosenbrock(self):
+        p0 = {"x": jnp.float32(-1.2), "y": jnp.float32(1.0)}
+        lb = lbfgs_minimize(rosenbrock, p0, max_iterations=250,
+                            line_search_iterations=12, tol=0.0)
+        gd = line_gradient_descent(rosenbrock, p0, max_iterations=250,
+                                   line_search_iterations=12, tol=0.0)
+        assert lb.score < 1e-3, lb
+        assert lb.score < gd.score
+
+    def test_history_window_is_ring_buffer(self):
+        # history smaller than iterations: still converges (ring indexing)
+        res = lbfgs_minimize(quadratic, {"w": jnp.zeros(20)}, history=2,
+                             max_iterations=80)
+        assert res.score < 1e-4
+
+
+class TestSolver:
+    def _net(self):
+        return (SequentialBuilder(NetConfig(seed=0))
+                .input_shape(4)
+                .layer(L.Dense(n_out=8, activation="tanh"))
+                .layer(L.Output(n_out=2, activation="softmax", loss="mcxent"))
+                .build())
+
+    def test_full_batch_lbfgs_trains_net(self):
+        rng = np.random.default_rng(0)
+        x = np.concatenate([rng.standard_normal((40, 4)) + 2,
+                            rng.standard_normal((40, 4)) - 2]).astype(np.float32)
+        y = np.repeat(np.eye(2, dtype=np.float32), 40, axis=0)
+        net = self._net()
+        net.init()
+        before = float(net.score(net.params, net.state, x, y, training=False)[0])
+        res = Solver(net, algo="lbfgs", max_iterations=80).optimize(x, y)
+        assert res.score < before * 0.2
+        # params written back to the model
+        after = float(net.score(net.params, net.state, x, y, training=False)[0])
+        np.testing.assert_allclose(after, res.score, rtol=1e-5)
+
+    def test_unknown_algo_rejected(self):
+        with pytest.raises(ValueError):
+            Solver(self._net(), algo="newton")

@@ -146,3 +146,68 @@ class TestUIServer:
             assert ei.value.code == 400
         finally:
             server.stop()
+
+
+class TestUIDepth:
+    """Activation views, conv filter viz, t-SNE viewer (TrainModule +
+    ui-components parity added in round 2)."""
+
+    def test_activation_stats_collected(self):
+        storage = InMemoryStatsStorage()
+        probe = np.random.RandomState(1).randn(4, 5).astype(np.float32)
+        lst = StatsListener(storage, session_id="sa", frequency=1,
+                            activation_probe=probe)
+        tr = _toy_trainer()
+        tr.fit(_toy_data(), epochs=1, listeners=[lst], prefetch=False)
+        detailed = [r for _, r in storage.get_updates("sa", "worker_0")
+                    if "activations" in r]
+        assert detailed
+        acts = detailed[0]["activations"]
+        assert set(acts) == {"layer_0", "layer_1"}
+        assert acts["layer_0"]["shape"] == [4, 8]
+        assert "histogram" in acts["layer_0"]
+
+    def test_conv_filter_grid(self):
+        from deeplearning4j_tpu.nn.layers import Conv2D, Flatten
+        from deeplearning4j_tpu.ui.stats import conv_filter_grid
+        m = Sequential(NetConfig(),
+                       [Conv2D(n_out=6, kernel=(3, 3)), Flatten(),
+                        Output(n_out=2, loss="mcxent", activation="softmax")],
+                       (8, 8, 1))
+        params, _ = m.init()
+        g = conv_filter_grid(params, max_filters=4)
+        assert g["kh"] == 3 and g["kw"] == 3
+        assert len(g["filters"]) == 4
+        flat = np.asarray(g["filters"][0])
+        assert flat.shape == (3, 3)
+        assert flat.min() >= 0 and flat.max() <= 255
+        json.dumps(g)  # JSON-safe
+
+    def test_no_conv_returns_none(self):
+        from deeplearning4j_tpu.ui.stats import conv_filter_grid
+        tr = _toy_trainer()
+        assert conv_filter_grid(tr.params) is None
+
+    def test_tsne_viewer_routes(self):
+        server = UIServer(port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            page = urllib.request.urlopen(base + "/tsne").read().decode()
+            assert "t-SNE" in page
+            # upload via HTTP (remote client path)
+            body = json.dumps({"coords": [[0.0, 1.0], [2.0, 3.0]],
+                               "labels": [0, 1]}).encode()
+            req = urllib.request.Request(base + "/tsne/upload", data=body,
+                                         headers={"Content-Type": "application/json"})
+            r = json.loads(urllib.request.urlopen(req).read())
+            assert r["points"] == 2
+            d = json.loads(urllib.request.urlopen(base + "/tsne/data").read())
+            assert d["coords"] == [[0.0, 1.0], [2.0, 3.0]]
+            assert d["labels"] == [0, 1]
+        finally:
+            server.stop()
+
+    def test_tsne_bad_coords_rejected(self):
+        server = UIServer(port=0)
+        with pytest.raises(ValueError):
+            server.upload_tsne(np.zeros((5,)))
