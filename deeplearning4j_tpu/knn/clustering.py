@@ -365,6 +365,20 @@ class BaseClusteringAlgorithm:
             # but ALWAYS stop at the hard backstop (see MAX_TOTAL_ITERATIONS)
             if it >= self.MAX_TOTAL_ITERATIONS or (satisfied and not strategy_applied):
                 break
+        if strategy_applied:
+            # backstop fired right after the strategy changed K: re-classify
+            # once against the FINAL centers so assignments/info are
+            # consistent with what we return
+            k = len(centers)
+            (assign_j, _, counts, avg, var, mx, dist,
+             changes) = _cluster_pass(pts_j, jnp.asarray(centers),
+                                      jnp.asarray(assign), k)
+            assign = np.asarray(assign_j)
+            counts, avg, var, mx = (np.asarray(a) for a in (counts, avg, var, mx))
+            info = ClusterSetInfo(
+                clusters=[ClusterInfo(int(counts[i]), float(avg[i]), float(var[i]),
+                                      float(mx[i])) for i in range(k)],
+                point_location_change=int(changes), points_count=n)
         return ClusterSet(centers, assign, np.asarray(dist), info)
 
     # --- strategy actions (applyClusteringStrategy :173-195) ---

@@ -1,0 +1,87 @@
+"""Class-label maps for zoo models — ``zoo/util/{ImageNetLabels, DarknetLabels,
+VOCLabels, COCOLabels}.java`` parity.
+
+COCO-80 and VOC-20 label sets are small enough to embed. ImageNet-1k and
+Darknet-9k are shipped by the reference as vendored resource files; here they
+load from ``$DL4J_TPU_DATA/labels/`` (standard one-label-per-line format, the
+same files the reference bundles) with a clear error when absent — consistent
+with the zero-egress dataset policy (data/datasets.py).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import List
+
+COCO_LABELS: List[str] = [
+    "person", "bicycle", "car", "motorcycle", "airplane", "bus", "train",
+    "truck", "boat", "traffic light", "fire hydrant", "stop sign",
+    "parking meter", "bench", "bird", "cat", "dog", "horse", "sheep", "cow",
+    "elephant", "bear", "zebra", "giraffe", "backpack", "umbrella", "handbag",
+    "tie", "suitcase", "frisbee", "skis", "snowboard", "sports ball", "kite",
+    "baseball bat", "baseball glove", "skateboard", "surfboard",
+    "tennis racket", "bottle", "wine glass", "cup", "fork", "knife", "spoon",
+    "bowl", "banana", "apple", "sandwich", "orange", "broccoli", "carrot",
+    "hot dog", "pizza", "donut", "cake", "chair", "couch", "potted plant",
+    "bed", "dining table", "toilet", "tv", "laptop", "mouse", "remote",
+    "keyboard", "cell phone", "microwave", "oven", "toaster", "sink",
+    "refrigerator", "book", "clock", "vase", "scissors", "teddy bear",
+    "hair drier", "toothbrush",
+]
+
+VOC_LABELS: List[str] = [
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+]
+
+_LABELS_DIR = Path(os.environ.get(
+    "DL4J_TPU_DATA", Path.home() / ".deeplearning4j_tpu" / "data")) / "labels"
+
+
+def _load_label_file(name: str, expected: int) -> List[str]:
+    p = _LABELS_DIR / name
+    if not p.exists():
+        raise FileNotFoundError(
+            f"Label file {p} not found. The reference vendors this list as a "
+            f"resource; zero-egress builds read the standard one-label-per-line "
+            f"file — place it there (expected {expected} lines).")
+    labels = [ln.strip() for ln in p.read_text().splitlines() if ln.strip()]
+    if expected and len(labels) != expected:
+        raise ValueError(f"{p} has {len(labels)} labels, expected {expected}")
+    return labels
+
+
+def imagenet_labels() -> List[str]:
+    """ImageNetLabels.java — the 1000 ILSVRC2012 class names."""
+    return _load_label_file("imagenet_labels.txt", 1000)
+
+
+def darknet_labels() -> List[str]:
+    """DarknetLabels.java — ImageNet-1k in darknet ordering."""
+    return _load_label_file("darknet_labels.txt", 1000)
+
+
+def coco_labels() -> List[str]:
+    return list(COCO_LABELS)
+
+
+def voc_labels() -> List[str]:
+    return list(VOC_LABELS)
+
+
+def decode_predictions(probs, labels: List[str], top: int = 5):
+    """Top-k (label, probability) decode for zoo classifiers
+    (TrainedModels.decodePredictions parity)."""
+    import numpy as np
+
+    probs = np.asarray(probs)
+    if probs.ndim == 1:
+        probs = probs[None]
+    out = []
+    for row in probs:
+        idx = np.argsort(row)[::-1][:top]
+        out.append([(labels[i] if i < len(labels) else str(i), float(row[i]))
+                    for i in idx])
+    return out

@@ -117,12 +117,20 @@ class MaxMatchTokenizerFactory(TokenizerFactory):
 
 class _ScriptFallbackFactory(TokenizerFactory):
     """Shared engine-gating: external analyzer if importable → lexicon
-    max-match → Unicode-block segmentation."""
+    max-match (user lexicon merged over the built-in core vocabulary,
+    cjk_lexicon.py) → Unicode-block segmentation."""
 
     def __init__(self, lexicon: Optional[Iterable[str]] = None):
         super().__init__()
-        self._mm = MaxMatchTokenizerFactory(lexicon) if lexicon else None
+        base = set(self.default_lexicon())
+        if lexicon:
+            base.update(lexicon)  # user dictionary extends the core (ansj
+            #                       user-dict mechanism)
+        self._mm = MaxMatchTokenizerFactory(base) if base else None
         self._engine = self._load_engine()
+
+    def default_lexicon(self) -> Iterable[str]:
+        return ()
 
     def _load_engine(self):
         return None
@@ -139,6 +147,11 @@ class _ScriptFallbackFactory(TokenizerFactory):
 class ChineseTokenizerFactory(_ScriptFallbackFactory):
     """deeplearning4j-nlp-chinese ``ChineseTokenizerFactory`` equivalent."""
 
+    def default_lexicon(self):
+        from .cjk_lexicon import CHINESE_CORE
+
+        return CHINESE_CORE
+
     def _load_engine(self):
         try:
             import jieba  # optional; not baked into the hosting image
@@ -150,6 +163,11 @@ class ChineseTokenizerFactory(_ScriptFallbackFactory):
 
 class JapaneseTokenizerFactory(_ScriptFallbackFactory):
     """deeplearning4j-nlp-japanese (Kuromoji) equivalent."""
+
+    def default_lexicon(self):
+        from .cjk_lexicon import JAPANESE_CORE
+
+        return JAPANESE_CORE
 
     def _load_engine(self):
         try:

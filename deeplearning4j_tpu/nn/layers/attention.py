@@ -24,8 +24,11 @@ from ...ops import activations, initializers
 from ..api import Array, Layer, Shape, apply_input_dropout, register_layer
 
 
-def dot_product_attention(q, k, v, *, mask=None, scale=None):
-    """(B, T, Hd, D) attention with fp32 accumulation. mask: (B, 1|H, Tq, Tk) additive or bool."""
+def dot_product_attention(q, k, v, *, mask=None, scale=None,
+                          dropout_rate: float = 0.0, dropout_rng=None):
+    """(B, T, Hd, D) attention with fp32 accumulation. mask: (B, 1|H, Tq, Tk)
+    additive or bool. dropout_rate > 0 with an rng applies inverted dropout
+    to the attention weights (training-time attention dropout)."""
     *_, D = q.shape
     scale = scale if scale is not None else 1.0 / jnp.sqrt(D).astype(jnp.float32)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
@@ -35,17 +38,28 @@ def dot_product_attention(q, k, v, *, mask=None, scale=None):
         else:
             scores = scores + mask
     w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", w, v)
 
 
 @register_layer
 @dataclass(frozen=True)
 class MultiHeadAttention(Layer):
-    """Fused-QKV multi-head self-attention. Input (B, T, D) -> (B, T, D)."""
+    """Fused-QKV multi-head self-attention. Input (B, T, D) -> (B, T, D).
+
+    ``flash=True`` routes the score/softmax/weighted-sum through the Pallas
+    flash kernel (ops/flash_attention.py): O(T·block) memory instead of a
+    (T, T) score tensor — the long-context fast path. Used when the mask is
+    absent or pure-causal; an explicit key mask falls back to the dense path
+    (the kernel doesn't take arbitrary masks).
+    """
 
     num_heads: int = 8
     causal: bool = False
     attn_dropout: float = 0.0
+    flash: bool = False
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
@@ -63,14 +77,23 @@ class MultiHeadAttention(Layer):
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
-        attn_mask = None
-        if self.causal:
-            causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
-            attn_mask = causal[None, None]
-        if mask is not None:
-            key_mask = mask[:, None, None, :].astype(jnp.bool_)  # (B,1,1,Tk)
-            attn_mask = key_mask if attn_mask is None else (attn_mask & key_mask)
-        y = dot_product_attention(q, k, v, mask=attn_mask)
+        drop = self.attn_dropout if (training and rng is not None) else 0.0
+        if self.flash and mask is None and drop == 0.0:
+            # flash kernel handles no-mask / pure-causal; attention dropout
+            # (weights are never materialized) falls back to dense
+            from ...ops.flash_attention import flash_attention
+
+            y = flash_attention(q, k, v, causal=self.causal)
+        else:
+            attn_mask = None
+            if self.causal:
+                causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+                attn_mask = causal[None, None]
+            if mask is not None:
+                key_mask = mask[:, None, None, :].astype(jnp.bool_)  # (B,1,1,Tk)
+                attn_mask = key_mask if attn_mask is None else (attn_mask & key_mask)
+            y = dot_product_attention(q, k, v, mask=attn_mask,
+                                      dropout_rate=drop, dropout_rng=rng)
         y = y.reshape(B, T, D) @ params["w_o"] + params["b_o"]
         return y, state, mask
 

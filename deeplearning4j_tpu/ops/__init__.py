@@ -1,7 +1,11 @@
 """TPU-native op foundation: activations, losses, initializers, updaters,
 schedules, regularization — the replacement for DL4J's external ND4J surface
-(SURVEY.md §2.11)."""
+(SURVEY.md §2.11). The pallas flash-attention kernel lives in
+``ops.flash_attention`` and is imported from there at use sites only, so
+importing the package never pulls in pallas.
+"""
 
 from . import activations, initializers, losses, regularization, schedules, updaters
 
-__all__ = ["activations", "initializers", "losses", "regularization", "schedules", "updaters"]
+__all__ = ["activations", "initializers", "losses", "regularization",
+           "schedules", "updaters"]

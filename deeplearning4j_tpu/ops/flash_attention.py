@@ -1,0 +1,209 @@
+"""Flash attention — Pallas TPU kernel for the attention hot path.
+
+The reference has no attention at all (DL4J 0.9 predates it; SURVEY.md §5);
+this kernel serves the framework's transformer/long-context families, where
+attention is the dominant non-matmul cost. Design per the Pallas TPU
+playbook (/opt/skills/guides/pallas_guide.md):
+
+- forward: ONE kernel, grid (B·H, T/bq, T/bk) with the key-block dimension
+  innermost (sequential on TPU), streaming-softmax accumulators (m, l, acc)
+  in VMEM scratch that persist across key blocks — O(T·block) memory, never
+  a (T, T) score tensor in HBM
+- scores accumulate in f32 regardless of input dtype (bf16-safe softmax,
+  same contract as ``dot_product_attention``)
+- backward: custom_vjp with the standard flash recomputation — the forward
+  saves only (o, logsumexp); gradients are rebuilt q-block-by-q-block in a
+  ``lax.scan`` (pure JAX: XLA already fuses the per-block matmul chain well,
+  and the scan bounds memory the same way the kernel does)
+- ``interpret=True`` automatically off-TPU, so the same code path is testable
+  on the CPU mesh (pl.pallas_call interpreter mode)
+
+Causal masking and right-padded sequences (T not a multiple of the block)
+are handled with compile-time index masks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, bq: int, bk: int, t_actual: int):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)         # (bq, D)
+        k = k_ref[0].astype(jnp.float32)         # (bk, D)
+        s = q @ k.T * scale                      # (bq, bk) f32 on the MXU
+
+        q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = k_pos < t_actual                 # right-padding mask
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[:]                        # (bq,)
+        m_cur = jnp.maximum(m_prev, s.max(axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])          # (bq, bk)
+        l_scr[:] = l_scr[:] * alpha + p.sum(axis=1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + p @ v_ref[0].astype(jnp.float32)
+        m_scr[:] = m_cur
+
+    if causal:
+        # skip key blocks entirely above the diagonal: their tile is all
+        # -inf and contributes nothing — half the FLOPs at large T
+        pl.when(ik * bk <= (iq + 1) * bq - 1)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, scale: float, causal: bool, bq: int, bk: int,
+               interpret: bool):
+    import math
+
+    BH, T, D = q.shape
+    pad = (-T) % math.lcm(bq, bk)  # both grids must tile the padded length
+    tp = T + pad
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+    nq, nk = tp // bq, tp // bk
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, t_actual=T)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, tp, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, tp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq,), jnp.float32),     # running max m
+            pltpu.VMEM((bq,), jnp.float32),     # running sum l
+            pltpu.VMEM((bq, D), jnp.float32),   # unnormalized output acc
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o[:, :T], lse[:, :T]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, bq, bk, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, bq, bk, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, bq, bk, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, do):
+    """Flash backward: recompute probabilities per q block from (q, k, lse);
+    scan over q blocks carrying (dk, dv) accumulators — peak memory
+    O(bq·T), never (T, T)."""
+    q, k, v, o, lse = res
+    BH, T, D = q.shape
+    qf, kf, vf = (a.astype(jnp.float32) for a in (q, k, v))
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (BH, T)
+
+    pad = (-T) % bq
+    tp = T + pad
+    nq = tp // bq
+    qp = jnp.pad(qf, ((0, 0), (0, pad), (0, 0))).reshape(BH, nq, bq, D)
+    dop = jnp.pad(dof, ((0, 0), (0, pad), (0, 0))).reshape(BH, nq, bq, D)
+    lsep = jnp.pad(lse, ((0, 0), (0, pad)), constant_values=1.0).reshape(BH, nq, bq)
+    deltap = jnp.pad(delta, ((0, 0), (0, pad))).reshape(BH, nq, bq)
+
+    k_pos = jnp.arange(T)[None, :]                       # (1, T)
+
+    def per_block(carry, xs):
+        dk_acc, dv_acc = carry
+        qb, dob, lseb, deltab, iq = xs                    # (BH, bq, D) ...
+        s = jnp.einsum("bqd,bkd->bqk", qb, kf) * scale    # (BH, bq, T)
+        q_pos = iq * bq + jnp.arange(bq)[:, None]         # (bq, 1)
+        valid = jnp.broadcast_to(k_pos <= q_pos if causal
+                                 else jnp.ones((bq, T), bool), (bq, T))
+        # padded q rows (q_pos >= T) contribute nothing: their do is 0-padded
+        p = jnp.where(valid[None], jnp.exp(s - lseb[..., None]), 0.0)
+        dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p, dob)
+        dp = jnp.einsum("bqd,bkd->bqk", dob, vf)
+        ds = p * (dp - deltab[..., None]) * scale
+        dq_b = jnp.einsum("bqk,bkd->bqd", ds, kf)
+        dk_acc = dk_acc + jnp.einsum("bqk,bqd->bkd", ds, qb)
+        return (dk_acc, dv_acc), dq_b
+
+    xs = (qp.transpose(1, 0, 2, 3), dop.transpose(1, 0, 2, 3),
+          lsep.transpose(1, 0, 2), deltap.transpose(1, 0, 2),
+          jnp.arange(nq))
+    (dk, dv), dq_blocks = lax.scan(
+        per_block, (jnp.zeros_like(kf), jnp.zeros_like(vf)), xs)
+    dq = dq_blocks.transpose(1, 0, 2, 3).reshape(BH, tp, D)[:, :T]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Memory-efficient exact attention. q, k, v: (B, T, H, D) (the layout of
+    ``dot_product_attention``); returns (B, T, H, D).
+
+    Differentiable (custom flash VJP). Off-TPU the kernel runs in Pallas
+    interpreter mode automatically, so CPU tests exercise the same code.
+    """
+    B, T, H, D = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes must match, got {q.shape} {k.shape} {v.shape}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    bq = min(block_q, max(16, T))
+    bk = min(block_k, max(16, T))
+
+    def to_bh(a):
+        return a.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, bq, bk, interpret)
+    return o.reshape(B, H, T, D).transpose(0, 2, 1, 3)

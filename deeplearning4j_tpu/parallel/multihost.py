@@ -223,6 +223,31 @@ class MultiHostTrainer:
         self.model.params = jax.tree.map(local, self.params)
         self.model.state = jax.tree.map(local, self.state)
 
+    def score_iterator(self, iterator) -> float:
+        """Average loss over an iterator of LOCAL shards, computed on the
+        global mesh (distributed evaluation — the reference scores RDDs
+        across executors; all processes must iterate in lockstep). Completes
+        the EarlyStoppingParallelTrainer contract."""
+        if not hasattr(self, "_score_fn") or self._score_fn is None:
+            model, seq = self.model, isinstance(self.model, Sequential)
+
+            @jax.jit
+            def score(p, s, x, y, mask=None):
+                l, _ = model.score(p, s, x, y, training=False,
+                                   **({"mask": mask} if seq else {"masks": mask}))
+                return l
+
+            self._score_fn = score
+
+        total, n_batches = 0.0, 0
+        for ds in iterator:
+            x, y, mask, _ = self._global_batch(ds)
+            total += float(self._score_fn(self.params, self.state, x, y, mask))
+            n_batches += 1
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return total / max(n_batches, 1)
+
     def save(self, path: str, normalizer=None):
         """Checkpoint from process 0 only (driver-side ModelSerializer parity)."""
         if not self.is_main:

@@ -307,11 +307,10 @@ class ParallelWrapper:
                         if ds.features_mask is not None else None)
                 b = x.shape[0]
                 if b % self.n_dev:  # pad to divisible (static shapes)
-                    pad = self.n_dev - b % self.n_dev
-                    x = np.concatenate([x, x[:pad]])
-                    y = np.concatenate([y, y[:pad]])
+                    x = self._pad_rows(x)
+                    y = self._pad_rows(y)
                     if mask is not None:
-                        mask = np.concatenate([mask, mask[:pad]])
+                        mask = self._pad_rows(mask)
                 for lst in listeners:
                     if isinstance(lst, PerformanceListener):
                         lst.step_begin(b)
@@ -412,3 +411,55 @@ class ParallelWrapper:
         if hasattr(iterator, "reset"):
             iterator.reset()
         return evaluation
+
+    def save(self, path: str, normalizer=None):
+        """Persist the (synced) model as the standard checkpoint zip."""
+        self._sync_model()
+        from ..train.serialization import save_model
+
+        save_model(path, self.model, params=self.model.params,
+                   state=self.model.state, normalizer=normalizer)
+
+    def _pad_rows(self, a: np.ndarray) -> np.ndarray:
+        """Pad dim 0 to a multiple of n_dev by cycling existing rows (safe
+        even when the batch is smaller than the pad)."""
+        pad = (-a.shape[0]) % self.n_dev
+        if not pad:
+            return a
+        idx = np.arange(pad) % a.shape[0]
+        return np.concatenate([a, a[idx]])
+
+    def score_iterator(self, iterator) -> float:
+        """Average loss over an iterator, batches sharded over the data axis
+        (the Trainer.score_iterator contract incl. feature masks, so
+        early-stopping score calculators work against the parallel trainer)."""
+        self._sync_model()
+        model = self.model
+        seq = isinstance(model, Sequential)
+        repl = NamedSharding(self.mesh, P())
+        batch_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        params = jax.device_put(model.params, repl)
+        state = jax.device_put(model.state, repl)
+
+        if not hasattr(self, "_score_fn") or self._score_fn is None:
+            @jax.jit
+            def score(p, s, x, y, mask=None):
+                l, _ = model.score(p, s, x, y, training=False,
+                                   **({"mask": mask} if seq else {"masks": mask}))
+                return l
+
+            self._score_fn = score  # cache: one compile per batch shape
+
+        total, n_batches = 0.0, 0
+        for ds in iterator:
+            x = self._pad_rows(np.asarray(ds.features))
+            y = self._pad_rows(np.asarray(ds.labels))
+            m = (jax.device_put(self._pad_rows(np.asarray(ds.features_mask)), batch_sh)
+                 if ds.features_mask is not None else None)
+            total += float(self._score_fn(params, state,
+                                          jax.device_put(x, batch_sh),
+                                          jax.device_put(y, batch_sh), m))
+            n_batches += 1
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return total / max(n_batches, 1)

@@ -2,6 +2,7 @@
 checkpointing (SURVEY.md §2.1 optimize/, earlystopping/)."""
 
 from .earlystopping import (BestScoreEpochTermination,
+                            EarlyStoppingParallelTrainer,
                             ClassificationScoreCalculator,
                             DataSetLossCalculator, EarlyStoppingConfiguration,
                             EarlyStoppingResult, EarlyStoppingTrainer,
@@ -26,7 +27,8 @@ __all__ = ["BestScoreEpochTermination", "CheckpointListener",
            "DivergenceListener", "FaultTolerantFit", "TrainingDivergedException",
            "ClassificationScoreCalculator", "CollectScoresListener",
            "DataSetLossCalculator", "EarlyStoppingConfiguration",
-           "EarlyStoppingResult", "EarlyStoppingTrainer", "EvaluativeListener",
+           "EarlyStoppingParallelTrainer", "EarlyStoppingResult",
+           "EarlyStoppingTrainer", "EvaluativeListener",
            "InMemoryModelSaver", "InvalidScoreIterationTermination",
            "LocalFileModelSaver", "MaxEpochsTermination",
            "MaxScoreIterationTermination", "MaxTimeIterationTermination",

@@ -252,3 +252,42 @@ class EarlyStoppingTrainer:
                     break
         return EarlyStoppingResult(reason, details, best_epoch, float(best_score),
                                    epoch + 1, scores)
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """parallelism/EarlyStoppingParallelTrainer.java equivalent: early
+    stopping driving a data-parallel trainer (ParallelWrapper or
+    MultiHostTrainer). Both expose the ``fit(iterator, epochs, listeners)`` +
+    ``score_iterator`` contract, so the epoch loop is shared. The configured
+    model saver is wrapped so best-model snapshots are taken from the SYNCED
+    single-replica model (not the wrapper's stacked device view)."""
+
+    class _SyncedSaver(ModelSaver):
+        def __init__(self, inner: ModelSaver, wrapper):
+            self.inner = inner
+            self.wrapper = wrapper
+
+        def save_best(self, trainer, score):
+            w = self.wrapper
+            if hasattr(w, "_sync_model"):
+                w._sync_model()
+
+            class _View:
+                params = w.model.params
+                state = w.model.state
+                model = w.model
+                save = staticmethod(getattr(w, "save", None))
+
+            self.inner.save_best(_View(), score)
+
+        def get_best(self):
+            return self.inner.get_best()
+
+    def __init__(self, config: EarlyStoppingConfiguration, wrapper):
+        for attr in ("fit", "score_iterator"):
+            if not hasattr(wrapper, attr):
+                raise TypeError(f"parallel trainer lacks .{attr}(); got "
+                                f"{type(wrapper).__name__}")
+        config = copy.copy(config)
+        config.model_saver = self._SyncedSaver(config.model_saver, wrapper)
+        super().__init__(config, wrapper)

@@ -145,3 +145,14 @@ class TestDegenerateInputs:
         from deeplearning4j_tpu.data.records import TransformProcess
         with pytest.raises(ValueError, match="Unknown transform op"):
             TransformProcess.from_json('{"ops": [{"op": "remove_colums", "indices": [0]}]}')
+
+    def test_backstop_returns_consistent_clusterset(self):
+        """Regression: backstop exit right after a strategy action used to
+        return assignments computed against the pre-strategy centers."""
+        pts = np.array([[0.0, 0.0]] * 5 + [[1.0, 1.0]] * 5, np.float32)
+        algo = KMeansClustering.setup(3, max_iterations=5, seed=0)
+        algo.MAX_TOTAL_ITERATIONS = 7
+        cs = algo.apply_to(pts)
+        assert cs.cluster_count == len(cs.info.clusters)
+        assert cs.assignments.max() < cs.cluster_count
+        assert sum(c.point_count for c in cs.info.clusters) == len(pts)

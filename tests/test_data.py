@@ -254,3 +254,58 @@ class TestRecordsETL:
         assert tp(rec) == tp2(rec) == [0.5, 1.0]
         with pytest.raises(ValueError, match="callables"):
             TransformProcess().filter_rows(lambda r: True).to_json()
+
+
+class TestMultiDataSetIterator:
+    def test_multi_reader_graph_batches(self):
+        from deeplearning4j_tpu.data.records import (
+            CollectionRecordReader, RecordReaderMultiDataSetIterator)
+        feats = CollectionRecordReader([[i * 1.0, i * 2.0, i % 3] for i in range(10)])
+        it = (RecordReaderMultiDataSetIterator(batch_size=4)
+              .add_reader("r", feats)
+              .add_input("r", 0, 1)
+              .add_output_one_hot("r", 2, 3))
+        batches = list(it)
+        assert len(batches) == 3
+        mds = batches[0]
+        assert mds.features[0].shape == (4, 2)
+        assert mds.labels[0].shape == (4, 3)
+        np.testing.assert_array_equal(mds.labels[0][1], [0, 1, 0])
+
+    def test_two_readers_lockstep(self):
+        from deeplearning4j_tpu.data.records import (
+            CollectionRecordReader, RecordReaderMultiDataSetIterator)
+        a = CollectionRecordReader([[1.0, 2.0]] * 6)
+        b = CollectionRecordReader([[0.5, 1]] * 6)
+        it = (RecordReaderMultiDataSetIterator(batch_size=3)
+              .add_reader("a", a).add_reader("b", b)
+              .add_input("a", 0, 1).add_input("b", 0, 0)
+              .add_output_one_hot("b", 1, 2))
+        mds = next(iter(it))
+        assert len(mds.features) == 2
+        assert mds.features[1].shape == (3, 1)
+
+    def test_unknown_reader_rejected(self):
+        from deeplearning4j_tpu.data.records import \
+            RecordReaderMultiDataSetIterator
+        it = (RecordReaderMultiDataSetIterator(batch_size=2)
+              .add_input("nope", 0, 1))
+        with pytest.raises(ValueError):
+            next(iter(it))
+
+
+class TestZooLabels:
+    def test_embedded_maps(self):
+        from deeplearning4j_tpu.models.labels import (COCO_LABELS, VOC_LABELS,
+                                                      decode_predictions)
+        assert len(COCO_LABELS) == 80 and len(VOC_LABELS) == 20
+        assert "person" in COCO_LABELS
+        probs = np.zeros(80)
+        probs[[3, 7]] = [0.7, 0.3]
+        top = decode_predictions(probs, COCO_LABELS, top=2)[0]
+        assert top[0] == ("motorcycle", 0.7)
+
+    def test_imagenet_requires_file(self):
+        from deeplearning4j_tpu.models.labels import imagenet_labels
+        with pytest.raises(FileNotFoundError, match="one-label-per-line"):
+            imagenet_labels()

@@ -230,3 +230,48 @@ class TestShardedSequenceVectors:
         from deeplearning4j_tpu.nlp.sequencevectors import SkipGram
 
         self._fit_pair(SkipGram(), negative=0)
+
+
+class TestCJKLexicons:
+    """Built-in core dictionaries give real multi-char segmentation without
+    external engines (weak-item fix: dictionaries were empty in round 1)."""
+
+    def test_chinese_core_maxmatch(self):
+        # force the lexicon path (jieba may or may not be importable)
+        from deeplearning4j_tpu.nlp.cjk import MaxMatchTokenizerFactory
+        from deeplearning4j_tpu.nlp.cjk_lexicon import CHINESE_CORE
+        mm = MaxMatchTokenizerFactory(CHINESE_CORE)
+        toks = mm.create("我们在学校学习人工智能和机器学习").get_tokens()
+        assert "我们" in toks and "学校" in toks
+        assert "人工智能" in toks  # longest match wins over 人工 / 智能
+        assert "机器学习" in toks or ("机器" in toks and "学习" in toks)
+        # multi-char ratio: real segmentation, not per-character fallback
+        assert sum(len(t) > 1 for t in toks) / len(toks) > 0.6
+
+    def test_japanese_core_maxmatch(self):
+        from deeplearning4j_tpu.nlp.cjk import MaxMatchTokenizerFactory
+        from deeplearning4j_tpu.nlp.cjk_lexicon import JAPANESE_CORE
+        mm = MaxMatchTokenizerFactory(JAPANESE_CORE)
+        toks = mm.create("私たちは大学で機械学習を勉強する").get_tokens()
+        assert "私たち" in toks and "大学" in toks
+        assert "機械学習" in toks and "勉強" in toks and "する" in toks
+        toks2 = mm.create("コンピュータとニューラルネットワークの研究").get_tokens()
+        assert "コンピュータ" in toks2 and "ニューラルネットワーク" in toks2
+
+    def test_factories_use_core_by_default(self):
+        from deeplearning4j_tpu.nlp.cjk import (ChineseTokenizerFactory,
+                                                JapaneseTokenizerFactory)
+        zh = ChineseTokenizerFactory()
+        toks = zh.create("我们学习深度学习").get_tokens()
+        assert "我们" in toks  # engine (jieba) or core lexicon — either way real words
+        ja = JapaneseTokenizerFactory()
+        toks = ja.create("機械学習の研究").get_tokens()
+        # an external engine (fugashi/MeCab) may segment 機械学習 as 機械+学習;
+        # both are real segmentations — only per-character splits are a failure
+        assert "機械学習" in toks or {"機械", "学習"} <= set(toks)
+
+    def test_user_lexicon_extends_core(self):
+        from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
+        ja = JapaneseTokenizerFactory(lexicon=["量子計算機"])
+        toks = ja.create("量子計算機を研究する").get_tokens()
+        assert "量子計算機" in toks

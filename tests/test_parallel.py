@@ -317,3 +317,40 @@ class TestAveragingMultiAxisMesh:
         for mode in ("averaging", "encoded_gradients"):
             with pytest.raises(ValueError, match="pure data-parallel"):
                 ParallelWrapper(iris_net(), mesh=mesh, mode=mode)
+
+
+class TestScoreIterator:
+    def test_tiny_final_batch_pads_correctly(self, iris):
+        """Regression: a 1-row final batch with n_dev=4 used to under-pad and
+        crash the sharded scoring."""
+        x, y = iris
+        mesh = cpu_test_mesh(4)
+        pw = ParallelWrapper(iris_net(), mesh=mesh, mode="shared_gradients")
+        it = ArrayIterator(x[:9], y[:9], 4)  # batches 4, 4, 1
+        s = pw.score_iterator(it)
+        assert np.isfinite(s)
+
+    def test_matches_single_device_scoring(self, iris):
+        x, y = iris
+        tr = Trainer(iris_net(seed=9))
+        pw = ParallelWrapper(iris_net(seed=9), mesh=cpu_test_mesh(4),
+                             mode="shared_gradients")
+        s1 = tr.score_iterator(ArrayIterator(x[:96], y[:96], 32))
+        s2 = pw.score_iterator(ArrayIterator(x[:96], y[:96], 32))
+        np.testing.assert_allclose(s1, s2, rtol=1e-5)
+
+    def test_multihost_score_iterator_single_process(self, iris):
+        from deeplearning4j_tpu.parallel import (MultiHostTrainer,
+                                                 ProcessShardIterator)
+        x, y = iris
+        mh = MultiHostTrainer(iris_net(seed=3), mesh=cpu_test_mesh(8), seed=3)
+        it = ProcessShardIterator(x[:96], y[:96], global_batch_size=32)
+        s = mh.score_iterator(it)
+        assert np.isfinite(s)
+        # and the early-stopping contract now accepts it
+        from deeplearning4j_tpu.train import (DataSetLossCalculator,
+                                              EarlyStoppingConfiguration,
+                                              EarlyStoppingParallelTrainer)
+        EarlyStoppingParallelTrainer(
+            EarlyStoppingConfiguration(score_calculator=DataSetLossCalculator(it)),
+            mh)  # must not raise

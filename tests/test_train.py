@@ -334,3 +334,50 @@ class TestFaults:
         ftf2 = FaultTolerantFit(tr2, str(tmp_path), segment_epochs=2)
         ftf2.fit(ArrayIterator(x, y, 64), epochs=4)  # no-op: already complete
         assert ftf2.completed_epochs() == 4
+
+
+class TestEarlyStoppingParallel:
+    """EarlyStoppingParallelTrainer.java parity: early stopping over the
+    data-parallel wrapper on the CPU test mesh."""
+
+    def test_early_stopping_over_parallel_wrapper(self):
+        from deeplearning4j_tpu.data.datasets import load_iris
+        from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        from deeplearning4j_tpu.parallel.mesh import make_mesh, DATA_AXIS
+        from deeplearning4j_tpu.train import (DataSetLossCalculator,
+                                              EarlyStoppingConfiguration,
+                                              EarlyStoppingParallelTrainer,
+                                              MaxEpochsTermination)
+
+        x, y = load_iris()
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam", "lr": 0.05}))
+               .input_shape(4)
+               .layer(L.Dense(n_out=16, activation="tanh"))
+               .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+               .build())
+        import jax as _jax
+
+        pw = ParallelWrapper(net, mesh=make_mesh({DATA_AXIS: 4},
+                                                 _jax.devices()[:4]),
+                             mode="shared_gradients")
+        held = ArrayIterator(x[120:], y[120:], 16)
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(held),
+            epoch_terminations=[MaxEpochsTermination(4)])
+        res = EarlyStoppingParallelTrainer(cfg, pw).fit(
+            ArrayIterator(x[:120], y[:120], 24), max_epochs=6)
+        assert res.best_epoch >= 0
+        assert np.isfinite(res.best_score)
+        best = cfg.model_saver.inner.get_best() if hasattr(cfg.model_saver, "inner") \
+            else cfg.model_saver.get_best()
+        assert best is not None
+
+    def test_rejects_non_parallel_contract(self):
+        from deeplearning4j_tpu.train import (EarlyStoppingConfiguration,
+                                              EarlyStoppingParallelTrainer,
+                                              DataSetLossCalculator)
+        cfg = EarlyStoppingConfiguration(score_calculator=DataSetLossCalculator(None))
+        with pytest.raises(TypeError):
+            EarlyStoppingParallelTrainer(cfg, object())
