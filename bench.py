@@ -37,18 +37,37 @@ PEAK_BF16 = {
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 8.18e9
 
 
-def main():
+def _probe_devices(timeout_s: float):
+    """jax.devices() with a watchdog: a wedged axon tunnel hangs device init
+    machine-wide (observed: a TPU program killed mid-flight wedges the relay);
+    fail fast with a diagnosable exit instead of hanging the driver."""
+    import threading
+
+    out = {}
+
+    def probe():
+        import jax
+
+        out["devices"] = jax.devices()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" not in out:
+        print(f"bench: device init did not complete in {timeout_s:.0f}s — "
+              f"TPU tunnel unreachable/wedged", file=sys.stderr)
+        os._exit(3)
+    return out["devices"]
+
+
+def _measure(batch: int, img: int, steps: int, on_tpu: bool):
+    """Build + train-step ResNet-50 at one batch size; returns
+    (images_per_sec, final_loss). Raises on OOM/compile failure."""
     import jax
 
     from deeplearning4j_tpu.data import BenchmarkIterator
     from deeplearning4j_tpu.models import ResNet50
     from deeplearning4j_tpu.train import Trainer
-
-    dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
-    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
-    img = int(os.environ.get("BENCH_IMG", 224 if on_tpu else 32))
-    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
 
     zm = ResNet50(num_classes=1000, seed=0, input_shape=(img, img, 3))
     model = zm.build()
@@ -59,11 +78,9 @@ def main():
 
     tr = Trainer(model)
     step = tr._make_step()
-    it = BenchmarkIterator((img, img, 3), 1000, batch, 1)
-    ds = next(iter(it))
+    ds = next(iter(BenchmarkIterator((img, img, 3), 1000, batch, 1)))
     x = jax.device_put(np.asarray(ds.features))
     y = jax.device_put(np.asarray(ds.labels))
-
     params, opt_state, state = tr.params, tr.opt_state, tr.state
     rng = jax.random.PRNGKey(0)
 
@@ -84,9 +101,38 @@ def main():
     t1, _, params, opt_state, state = run(k1, params, opt_state, state)
     t2, lf, params, opt_state, state = run(k2, params, opt_state, state)
     per_step = (t2 - t1) / (k2 - k1) if t2 > t1 else t2 / k2
-    loss = lf
+    return batch / per_step, lf
 
-    images_per_sec = batch / per_step
+
+def main():
+    _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    img = int(os.environ.get("BENCH_IMG", 224 if on_tpu else 32))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
+    if os.environ.get("BENCH_BATCH"):  # explicit single batch wins (back-compat)
+        batches = [int(os.environ["BENCH_BATCH"])]
+    else:
+        batches = [int(b) for b in os.environ.get(
+            "BENCH_BATCHES", "128,256" if on_tpu else "4").split(",")]
+
+    # sweep batch sizes, keep the best (larger batches lift MXU utilization
+    # until HBM runs out — catch OOM and fall back)
+    results = {}
+    for b in batches:
+        try:
+            ips, loss = _measure(b, img, steps, on_tpu)
+            results[b] = (ips, loss)
+        except Exception as e:  # OOM / compile failure at this batch size
+            print(f"bench: batch {b} failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr)
+    if not results:
+        print("bench: no batch size succeeded", file=sys.stderr)
+        raise SystemExit(2)
+    batch = max(results, key=lambda b: results[b][0])
+    images_per_sec, loss = results[batch]
     # scale flops if benchmarking at reduced resolution (flops ~ HW)
     flops_per_image = RESNET50_TRAIN_FLOPS_PER_IMAGE * (img / 224.0) ** 2
     peak = next((v for k, v in PEAK_BF16.items() if str(dev.device_kind).startswith(k)), 197e12)
@@ -102,6 +148,8 @@ def main():
             "batch": batch, "image_size": img, "steps": steps,
             "device": str(dev.device_kind), "mfu": round(mfu, 4),
             "loss_finite": bool(np.isfinite(loss)),
+            "swept": {str(b): round(r[0], 2) for b, r in results.items()},
+            "flops_per_image": flops_per_image,
         },
     }))
 

@@ -1,0 +1,113 @@
+"""Command-line training entry — ``parallelism/main/ParallelWrapperMain.java``
+parity (the reference ships a CLI that loads a serialized model and trains it
+data-parallel with optional UI).
+
+Usage:
+    python -m deeplearning4j_tpu.cli train --model net.zip --csv data.csv \
+        --label-index -1 --num-classes 3 --epochs 5 [--parallel shared_gradients]
+        [--batch 32] [--ui-port 9001] [--save out.zip]
+    python -m deeplearning4j_tpu.cli summary --model net.zip
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _load_model(path: str):
+    from .train.serialization import load_model
+
+    model, *_ = load_model(path)
+    return model
+
+
+def cmd_summary(args) -> int:
+    model = _load_model(args.model)
+    print(model.summary() if hasattr(model, "summary") else model.to_json())
+    return 0
+
+
+def cmd_train(args) -> int:
+    import numpy as np
+
+    from .data.records import (CSVRecordReader, RecordReaderDataSetIterator,
+                               TransformProcess)
+    from .train import Trainer
+    from .train.listeners import ScoreIterationListener
+
+    model = _load_model(args.model)
+    it = RecordReaderDataSetIterator(
+        CSVRecordReader(args.csv, skip_lines=args.skip_lines), args.batch,
+        label_index=args.label_index, num_classes=args.num_classes,
+        regression=args.regression)
+
+    listeners = [ScoreIterationListener(args.print_every)]
+    ui_server = None
+    if args.ui_port:
+        from .ui import InMemoryStatsStorage, StatsListener, UIServer
+
+        storage = InMemoryStatsStorage()
+        ui_server = UIServer(storage, port=args.ui_port).start()
+        listeners.append(StatsListener(storage, session_id="cli"))
+        print(f"training UI at http://127.0.0.1:{ui_server.port}/", file=sys.stderr)
+
+    if args.parallel:
+        from .parallel import ParallelWrapper
+
+        trainer = ParallelWrapper(model, mode=args.parallel)
+    else:
+        trainer = Trainer(model)
+    try:
+        trainer.fit(it, epochs=args.epochs, listeners=listeners)
+    finally:
+        if ui_server is not None:
+            ui_server.stop()
+    if args.save:
+        trainer.save(args.save)
+        print(f"saved -> {args.save}", file=sys.stderr)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="deeplearning4j_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("summary", help="print a serialized model's structure")
+    s.add_argument("--model", required=True)
+    s.set_defaults(fn=cmd_summary)
+
+    t = sub.add_parser("train", help="train a serialized model on a CSV")
+    t.add_argument("--model", required=True, help="model zip (serialization format)")
+    t.add_argument("--csv", required=True)
+    t.add_argument("--label-index", type=int, default=-1)
+    t.add_argument("--num-classes", type=int, default=0)
+    t.add_argument("--regression", action="store_true")
+    t.add_argument("--skip-lines", type=int, default=0)
+    t.add_argument("--batch", type=int, default=32)
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--parallel", choices=["shared_gradients", "averaging",
+                                          "encoded_gradients"], default=None)
+    t.add_argument("--print-every", type=int, default=10)
+    t.add_argument("--ui-port", type=int, default=0)
+    t.add_argument("--save", default=None)
+    t.set_defaults(fn=cmd_train)
+    return p
+
+
+def main(argv=None) -> int:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # mirror the env var into jax config: the hosting image's site hook
+        # can override the env-var-only path (and a wedged accelerator
+        # tunnel then hangs device init even for JAX_PLATFORMS=cpu runs)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

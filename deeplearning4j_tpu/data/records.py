@@ -448,17 +448,35 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
             return MultiDataSet(xs, ys)
 
         while True:
-            try:
-                recs = {n: [float(v) if not isinstance(v, str) else v
-                            for v in next(by_name[n])] for n in names}
-            except StopIteration:
+            # advance every reader; partial exhaustion is a hard error (the
+            # reference requires aligned readers — silent truncation trains
+            # on a shortened dataset)
+            rows = {}
+            done = []
+            for n in names:
+                try:
+                    rows[n] = next(by_name[n])
+                except StopIteration:
+                    done.append(n)
+            if done:
+                if len(done) != len(names):
+                    raise ValueError(
+                        f"readers exhausted out of lockstep: {done} ended "
+                        f"before {sorted(set(names) - set(done))}")
                 break
+            recs = {n: [float(v) if not isinstance(v, str) else v
+                        for v in row] for n, row in rows.items()}
             for i, (n, cf, ct) in enumerate(self._inputs):
                 xb[i].append(np.asarray(recs[n][cf:ct + 1], np.float32))
             for i, (kind, n, cf, ct, k) in enumerate(self._outputs):
                 if kind == "onehot":
+                    lab = int(recs[n][cf])
+                    if not 0 <= lab < k:
+                        raise ValueError(
+                            f"reader '{n}' column {cf}: label {lab} outside "
+                            f"[0, {k}) for one-hot output")
                     one = np.zeros(k, np.float32)
-                    one[int(recs[n][cf])] = 1.0
+                    one[lab] = 1.0
                     yb[i].append(one)
                 else:
                     yb[i].append(np.asarray(recs[n][cf:ct + 1], np.float32))

@@ -157,6 +157,40 @@ class VAE(Layer):
             return mu
         return jax.nn.sigmoid(out)
 
+    def reconstruction_log_probability(self, params, x, rng, num_samples: int = 16):
+        """Importance-sampling estimate of log p(x) per example — the
+        reference's anomaly-detection API
+        (VariationalAutoencoder.reconstructionLogProbability:1019):
+        log p(x) ≈ logsumexp_s [log p(x|z_s) + log p(z_s) - log q(z_s|x)] - log S.
+        """
+        mu, logvar = self.encode(params, x)
+        std = jnp.exp(0.5 * logvar)
+
+        def one_sample(key):
+            eps = jax.random.normal(key, mu.shape, mu.dtype)
+            z = mu + std * eps
+            out = self.decode(params, z)
+            if self.reconstruction == "gaussian":
+                rec_mu, rec_logvar = jnp.split(out, 2, axis=-1)
+                log_px_z = -0.5 * jnp.sum(
+                    rec_logvar + jnp.square(x - rec_mu) / jnp.exp(rec_logvar)
+                    + jnp.log(2 * jnp.pi), axis=-1)
+            else:
+                p = jnp.clip(jax.nn.sigmoid(out), 1e-7, 1 - 1e-7)
+                log_px_z = jnp.sum(x * jnp.log(p) + (1 - x) * jnp.log(1 - p), axis=-1)
+            log_pz = -0.5 * jnp.sum(jnp.square(z) + jnp.log(2 * jnp.pi), axis=-1)
+            log_qz_x = -0.5 * jnp.sum(
+                logvar + jnp.square(eps) + jnp.log(2 * jnp.pi), axis=-1)
+            return log_px_z + log_pz - log_qz_x
+
+        keys = jax.random.split(rng, num_samples)
+        log_w = jax.vmap(one_sample)(keys)                   # (S, B)
+        return jax.nn.logsumexp(log_w, axis=0) - jnp.log(num_samples)
+
+    def reconstruction_probability(self, params, x, rng, num_samples: int = 16):
+        """exp of reconstruction_log_probability (reconstructionProbability)."""
+        return jnp.exp(self.reconstruction_log_probability(params, x, rng, num_samples))
+
 
 @register_layer
 @dataclass(frozen=True)

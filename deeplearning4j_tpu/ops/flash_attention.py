@@ -199,8 +199,19 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
-    bq = min(block_q, max(16, T))
-    bk = min(block_k, max(16, T))
+    if interpret:
+        # interpreter mode has no tiling constraints: shrink blocks toward T
+        # so CPU tests stay fast
+        bq = min(block_q, max(16, T))
+        bk = min(block_k, max(16, T))
+    else:
+        # compiled TPU path: keep the user's (128-multiple) block sizes and
+        # let the lcm padding absorb odd T — Mosaic requires hardware-aligned
+        # (sublane x 128-lane) block shapes, so never clamp to raw T
+        if block_q % 128 or block_k % 128:
+            raise ValueError(f"block_q/block_k must be multiples of 128 on "
+                             f"TPU, got {block_q}/{block_k}")
+        bq, bk = block_q, block_k
 
     def to_bh(a):
         return a.transpose(0, 2, 1, 3).reshape(B * H, T, D)

@@ -204,7 +204,20 @@ def build(config: Union[str, dict, optax.GradientTransformation],
     else:
         cfg = dict(config)
         kind = cfg.pop("type")
-        chain.append(_REGISTRY[kind.lower()](**cfg))
+        if "lr" in cfg:  # common alias; was silently swallowed by **_ before
+            cfg["learning_rate"] = cfg.pop("lr")
+        factory = _REGISTRY[kind.lower()]
+        import inspect
+
+        known = set(inspect.signature(factory).parameters)
+        unknown = set(cfg) - known
+        if unknown:  # every factory takes **_, so unknown keys would be
+            import logging  # silently dropped — a config typo must be loud
+
+            logging.getLogger(__name__).warning(
+                "updater '%s': ignoring unknown config keys %s (known: %s)",
+                kind, sorted(unknown), sorted(known - {"_"}))
+        chain.append(factory(**cfg))
     return optax.chain(*chain) if len(chain) > 1 else chain[0]
 
 

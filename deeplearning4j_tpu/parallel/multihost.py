@@ -229,15 +229,9 @@ class MultiHostTrainer:
         across executors; all processes must iterate in lockstep). Completes
         the EarlyStoppingParallelTrainer contract."""
         if not hasattr(self, "_score_fn") or self._score_fn is None:
-            model, seq = self.model, isinstance(self.model, Sequential)
+            from ..train.trainer import make_score_fn
 
-            @jax.jit
-            def score(p, s, x, y, mask=None):
-                l, _ = model.score(p, s, x, y, training=False,
-                                   **({"mask": mask} if seq else {"masks": mask}))
-                return l
-
-            self._score_fn = score
+            self._score_fn = make_score_fn(self.model)
 
         total, n_batches = 0.0, 0
         for ds in iterator:

@@ -430,35 +430,42 @@ class ParallelWrapper:
         return np.concatenate([a, a[idx]])
 
     def score_iterator(self, iterator) -> float:
-        """Average loss over an iterator, batches sharded over the data axis
-        (the Trainer.score_iterator contract incl. feature masks, so
-        early-stopping score calculators work against the parallel trainer)."""
+        """Average loss over an iterator (mean of batch means — the exact
+        Trainer.score_iterator contract incl. feature masks). Divisible row
+        blocks are scored sharded over the data axis; a non-divisible tail is
+        scored unsharded so padded duplicate rows never bias the score."""
+        from ..train.trainer import make_score_fn
+
         self._sync_model()
         model = self.model
-        seq = isinstance(model, Sequential)
         repl = NamedSharding(self.mesh, P())
         batch_sh = NamedSharding(self.mesh, P(DATA_AXIS))
         params = jax.device_put(model.params, repl)
         state = jax.device_put(model.state, repl)
 
         if not hasattr(self, "_score_fn") or self._score_fn is None:
-            @jax.jit
-            def score(p, s, x, y, mask=None):
-                l, _ = model.score(p, s, x, y, training=False,
-                                   **({"mask": mask} if seq else {"masks": mask}))
-                return l
+            self._score_fn = make_score_fn(model)  # cache across epochs
 
-            self._score_fn = score  # cache: one compile per batch shape
-
+        score = self._score_fn
         total, n_batches = 0.0, 0
         for ds in iterator:
-            x = self._pad_rows(np.asarray(ds.features))
-            y = self._pad_rows(np.asarray(ds.labels))
-            m = (jax.device_put(self._pad_rows(np.asarray(ds.features_mask)), batch_sh)
-                 if ds.features_mask is not None else None)
-            total += float(self._score_fn(params, state,
-                                          jax.device_put(x, batch_sh),
-                                          jax.device_put(y, batch_sh), m))
+            x = np.asarray(ds.features)
+            y = np.asarray(ds.labels)
+            m = np.asarray(ds.features_mask) if ds.features_mask is not None else None
+            n = x.shape[0]
+            n_div = n - n % self.n_dev
+            batch_sum = 0.0
+            if n_div:
+                md = jax.device_put(m[:n_div], batch_sh) if m is not None else None
+                batch_sum += float(score(params, state,
+                                         jax.device_put(x[:n_div], batch_sh),
+                                         jax.device_put(y[:n_div], batch_sh),
+                                         md)) * n_div
+            if n - n_div:
+                mr = m[n_div:] if m is not None else None
+                batch_sum += float(score(params, state, x[n_div:], y[n_div:],
+                                         mr)) * (n - n_div)
+            total += batch_sum / n
             n_batches += 1
         if hasattr(iterator, "reset"):
             iterator.reset()

@@ -39,6 +39,21 @@ from ..ops import updaters as upd
 from .listeners import PerformanceListener, TrainingListener
 
 
+def make_score_fn(model):
+    """One jitted ``(params, state, x, y, mask) -> mean loss`` for a model —
+    shared by Trainer / ParallelWrapper / MultiHostTrainer scoring paths so
+    the Sequential-vs-Graph mask kwarg mapping lives in exactly one place."""
+    seq = isinstance(model, Sequential)
+
+    @jax.jit
+    def score(params, state, x, y, mask=None):
+        l, _ = model.score(params, state, x, y, training=False,
+                           **({"mask": mask} if seq else {"masks": mask}))
+        return l
+
+    return score
+
+
 def build_updater(model) -> optax.GradientTransformation:
     """Build the optax pipeline from NetConfig + per-layer overrides."""
     cfg: NetConfig = model.config
@@ -295,15 +310,7 @@ class Trainer:
 
     def score_iterator(self, iterator) -> float:
         """Average loss over an iterator (model.score(DataSetIterator) parity)."""
-        model = self.model
-
-        seq = isinstance(model, Sequential)
-
-        @jax.jit
-        def score(params, state, x, y, mask=None):
-            l, _ = model.score(params, state, x, y, training=False,
-                               **({"mask": mask} if seq else {"masks": mask}))
-            return l
+        score = make_score_fn(self.model)
 
         total, n = 0.0, 0
         for ds in iterator:

@@ -1,0 +1,97 @@
+"""Object-detection post-processing — ``nn/layers/objdetect/YoloUtils.java``
+and ``DetectedObject.java`` parity.
+
+Host-side by design: box filtering + greedy NMS is tiny, ragged, data-
+dependent work (exactly what does NOT belong in a jit); the device produces
+the activated (B, H, W, A*(5+C)) grid (Yolo2Output.apply) and this module
+turns it into detection lists.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence
+
+import numpy as np
+
+
+@dataclass
+class DetectedObject:
+    """One detection in GRID units (DetectedObject.java): center/size plus
+    confidence = objectness * class probability."""
+
+    center_x: float
+    center_y: float
+    width: float
+    height: float
+    confidence: float
+    predicted_class: int
+    class_probabilities: np.ndarray = field(repr=False)
+
+    @property
+    def top_left(self):
+        return (self.center_x - self.width / 2, self.center_y - self.height / 2)
+
+    @property
+    def bottom_right(self):
+        return (self.center_x + self.width / 2, self.center_y + self.height / 2)
+
+
+def iou(a: DetectedObject, b: DetectedObject) -> float:
+    """Intersection-over-union of two detections (YoloUtils.iou)."""
+    ax1, ay1 = a.top_left
+    ax2, ay2 = a.bottom_right
+    bx1, by1 = b.top_left
+    bx2, by2 = b.bottom_right
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = iw * ih
+    union = a.width * a.height + b.width * b.height - inter
+    return inter / union if union > 0 else 0.0
+
+
+def non_max_suppression(objs: List[DetectedObject], iou_threshold: float = 0.4,
+                        ) -> List[DetectedObject]:
+    """Greedy per-class NMS (YoloUtils.nms): keep highest-confidence boxes,
+    suppress same-class overlaps above the IoU threshold."""
+    keep: List[DetectedObject] = []
+    for obj in sorted(objs, key=lambda o: -o.confidence):
+        if all(not (k.predicted_class == obj.predicted_class
+                    and iou(k, obj) > iou_threshold) for k in keep):
+            keep.append(obj)
+    return keep
+
+
+def get_predicted_objects(activated: np.ndarray, num_anchors: int,
+                          conf_threshold: float = 0.5,
+                          nms_threshold: float = 0.4,
+                          apply_nms: bool = True) -> List[List[DetectedObject]]:
+    """Decode Yolo2Output.apply's activated grid into detections per image
+    (YoloUtils.getPredictedObjects). ``activated``: (B, H, W, A*(5+C)) with
+    per-anchor [x, y, w, h, obj, class-probs...]; x/y are offsets within the
+    cell, w/h grid-relative sizes (Yolo2Output encoding)."""
+    activated = np.asarray(activated)
+    B, H, W, D = activated.shape
+    A = num_anchors
+    C = D // A - 5
+    if C < 1:
+        raise ValueError(f"activated depth {D} with {A} anchors leaves no classes")
+    grid = activated.reshape(B, H, W, A, 5 + C)
+    out: List[List[DetectedObject]] = []
+    for b in range(B):
+        objs: List[DetectedObject] = []
+        obj_conf = grid[b, ..., 4]                       # (H, W, A)
+        cls_probs = grid[b, ..., 5:]                     # (H, W, A, C)
+        conf = obj_conf[..., None] * cls_probs           # per-class confidence
+        ys, xs, aa = np.nonzero(conf.max(-1) > conf_threshold)
+        for y, x, a in zip(ys, xs, aa):
+            cell = grid[b, y, x, a]
+            c = int(np.argmax(conf[y, x, a]))
+            objs.append(DetectedObject(
+                center_x=float(x + cell[0]), center_y=float(y + cell[1]),
+                width=float(cell[2]), height=float(cell[3]),
+                confidence=float(conf[y, x, a, c]),
+                predicted_class=c,
+                class_probabilities=cls_probs[y, x, a].copy()))
+        out.append(non_max_suppression(objs, nms_threshold) if apply_nms else objs)
+    return out

@@ -309,3 +309,24 @@ class TestZooLabels:
         from deeplearning4j_tpu.models.labels import imagenet_labels
         with pytest.raises(FileNotFoundError, match="one-label-per-line"):
             imagenet_labels()
+
+    def test_misaligned_readers_raise(self):
+        from deeplearning4j_tpu.data.records import (
+            CollectionRecordReader, RecordReaderMultiDataSetIterator)
+        a = CollectionRecordReader([[1.0, 0]] * 10)
+        b = CollectionRecordReader([[1.0, 0]] * 6)
+        it = (RecordReaderMultiDataSetIterator(batch_size=4)
+              .add_reader("a", a).add_reader("b", b)
+              .add_input("a", 0, 0).add_output_one_hot("b", 1, 2))
+        with pytest.raises(ValueError, match="lockstep"):
+            list(it)
+
+    def test_out_of_range_onehot_label_raises(self):
+        from deeplearning4j_tpu.data.records import (
+            CollectionRecordReader, RecordReaderMultiDataSetIterator)
+        r = CollectionRecordReader([[1.0, -1]])
+        it = (RecordReaderMultiDataSetIterator(batch_size=1)
+              .add_reader("r", r).add_input("r", 0, 0)
+              .add_output_one_hot("r", 1, 3))
+        with pytest.raises(ValueError, match="outside"):
+            list(it)

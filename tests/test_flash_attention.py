@@ -166,3 +166,8 @@ class TestReviewRegressions:
                                 "HOME": "/root"},
                            cwd="/root/repo", capture_output=True)
         assert r.returncode == 0, r.stderr.decode()[-500:]
+
+    def test_tpu_block_alignment_guard(self):
+        q, k, v = _qkv(T=20)
+        with pytest.raises(ValueError, match="multiples of 128"):
+            flash_attention(q, k, v, block_q=96, block_k=96, interpret=False)

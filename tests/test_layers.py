@@ -299,3 +299,95 @@ class TestGradients:
                                    max_checks_per_param=8, verbose=True)
         finally:
             jax.config.update("jax_enable_x64", False)
+
+
+class TestVAEAnomalyAPI:
+    """reconstructionLogProbability parity (VariationalAutoencoder.java:1019):
+    in-distribution data must score higher log p(x) than far outliers."""
+
+    def test_reconstruction_probability_separates_outliers(self):
+        import jax
+        from deeplearning4j_tpu.nn.layers import VAE
+        rng = np.random.default_rng(0)
+        vae = VAE(n_out=3, encoder_sizes=(16,), decoder_sizes=(16,),
+                  reconstruction="gaussian")
+        params, _ = vae.init(jax.random.PRNGKey(0), (6,))
+        x = jnp.asarray(rng.standard_normal((64, 6)) * 0.3, jnp.float32)
+        # quick ELBO fit so the model knows the data region
+        import optax
+        tx = optax.adam(1e-2)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(p, o, k):
+            l, g = jax.value_and_grad(lambda pp: vae.pretrain_loss(pp, x, k))(p)
+            u, o = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o, l
+
+        key = jax.random.PRNGKey(1)
+        for i in range(150):
+            key, k = jax.random.split(key)
+            params, opt, _ = step(params, opt, k)
+
+        inlier = jnp.asarray(rng.standard_normal((8, 6)) * 0.3, jnp.float32)
+        outlier = jnp.asarray(rng.standard_normal((8, 6)) * 0.3 + 25.0, jnp.float32)
+        lp_in = np.asarray(vae.reconstruction_log_probability(
+            params, inlier, jax.random.PRNGKey(2), num_samples=16))
+        lp_out = np.asarray(vae.reconstruction_log_probability(
+            params, outlier, jax.random.PRNGKey(3), num_samples=16))
+        assert lp_in.shape == (8,)
+        assert lp_in.mean() > lp_out.mean() + 10
+        p = np.asarray(vae.reconstruction_probability(
+            params, inlier, jax.random.PRNGKey(4), num_samples=4))
+        assert ((0 <= p) | np.isfinite(p)).all()
+
+
+class TestYoloDecode:
+    """YoloUtils.getPredictedObjects + nms parity."""
+
+    def _grid(self, H=4, W=4, A=2, C=3):
+        g = np.zeros((1, H, W, A, 5 + C), np.float32)
+        return g
+
+    def test_threshold_and_decode(self):
+        from deeplearning4j_tpu.utils.objdetect import get_predicted_objects
+        g = self._grid()
+        # one strong detection at cell (1,2), anchor 0, class 1
+        g[0, 1, 2, 0] = [0.5, 0.5, 1.2, 0.8, 0.9, 0.05, 0.9, 0.05]
+        # weak detection below threshold
+        g[0, 3, 3, 1] = [0.5, 0.5, 1.0, 1.0, 0.3, 0.1, 0.1, 0.8]
+        dets = get_predicted_objects(g.reshape(1, 4, 4, -1), num_anchors=2,
+                                     conf_threshold=0.5)
+        assert len(dets[0]) == 1
+        d = dets[0][0]
+        assert d.predicted_class == 1
+        np.testing.assert_allclose([d.center_x, d.center_y], [2.5, 1.5])
+        np.testing.assert_allclose(d.confidence, 0.9 * 0.9, rtol=1e-6)
+
+    def test_nms_suppresses_same_class_overlaps(self):
+        from deeplearning4j_tpu.utils.objdetect import (DetectedObject,
+                                                        get_predicted_objects,
+                                                        non_max_suppression)
+        g = self._grid()
+        # two overlapping boxes, same class, neighboring anchors of same cell
+        g[0, 1, 1, 0] = [0.5, 0.5, 2.0, 2.0, 0.9, 0.0, 1.0, 0.0]
+        g[0, 1, 1, 1] = [0.4, 0.4, 2.0, 2.0, 0.8, 0.0, 1.0, 0.0]
+        dets = get_predicted_objects(g.reshape(1, 4, 4, -1), num_anchors=2,
+                                     conf_threshold=0.3, nms_threshold=0.4)
+        assert len(dets[0]) == 1  # the weaker one suppressed
+        # different classes never suppress each other
+        a = DetectedObject(1, 1, 2, 2, 0.9, 0, np.zeros(2))
+        b = DetectedObject(1, 1, 2, 2, 0.8, 1, np.zeros(2))
+        assert len(non_max_suppression([a, b], 0.4)) == 2
+
+    def test_full_pipeline_from_layer(self):
+        import jax
+        from deeplearning4j_tpu.nn.layers import Yolo2Output
+        from deeplearning4j_tpu.utils.objdetect import get_predicted_objects
+        lay = Yolo2Output(anchors=((1.0, 1.0), (2.0, 2.0)))
+        raw = jnp.asarray(np.random.default_rng(0).standard_normal((2, 4, 4, 2 * 8)),
+                          jnp.float32)
+        act, _, _ = lay.apply({}, {}, raw)
+        dets = get_predicted_objects(np.asarray(act), num_anchors=2,
+                                     conf_threshold=0.1)
+        assert len(dets) == 2  # per-image lists; contents depend on random grid

@@ -313,3 +313,26 @@ class TestTimeSeriesUtils:
         np.testing.assert_array_equal(np.asarray(last_time_step_index(zeros)), [0, 0])
         e = expand_time_series_mask(m, 7)
         assert e.shape == (3, 5, 7)
+
+
+class TestUpdaterConfigAliases:
+    def test_lr_alias_is_honored(self):
+        """Regression: {"type": "adam", "lr": X} silently trained at the
+        default learning rate (the factory's **_ swallowed 'lr')."""
+        from deeplearning4j_tpu.ops import updaters as upd
+        import optax
+        tx_fast = upd.build({"type": "sgd", "lr": 1.0})
+        tx_slow = upd.build({"type": "sgd", "lr": 0.01})
+        p = {"w": jnp.ones(3)}
+        g = {"w": jnp.ones(3)}
+        uf, _ = tx_fast.update(g, tx_fast.init(p), p)
+        us, _ = tx_slow.update(g, tx_slow.init(p), p)
+        np.testing.assert_allclose(np.asarray(uf["w"]), -1.0, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(us["w"]), -0.01, rtol=1e-6)
+
+    def test_unknown_keys_warn(self, caplog):
+        from deeplearning4j_tpu.ops import updaters as upd
+        import logging
+        with caplog.at_level(logging.WARNING):
+            upd.build({"type": "adam", "learning_rte": 0.1})  # typo
+        assert any("unknown config keys" in r.message for r in caplog.records)

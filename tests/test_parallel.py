@@ -354,3 +354,15 @@ class TestScoreIterator:
         EarlyStoppingParallelTrainer(
             EarlyStoppingConfiguration(score_calculator=DataSetLossCalculator(it)),
             mh)  # must not raise
+
+    def test_nondivisible_tail_unbiased(self, iris):
+        """Regression: cyclic padding used to bias the tail batch's score;
+        must match Trainer.score_iterator exactly on non-divisible batches."""
+        x, y = iris
+        tr = Trainer(iris_net(seed=11))
+        pw = ParallelWrapper(iris_net(seed=11), mesh=cpu_test_mesh(4),
+                             mode="shared_gradients")
+        it1 = ArrayIterator(x[:29], y[:29], 10)  # batches 10, 10, 9
+        it2 = ArrayIterator(x[:29], y[:29], 10)
+        np.testing.assert_allclose(tr.score_iterator(it1),
+                                   pw.score_iterator(it2), rtol=1e-5)

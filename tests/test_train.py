@@ -381,3 +381,43 @@ class TestEarlyStoppingParallel:
         cfg = EarlyStoppingConfiguration(score_calculator=DataSetLossCalculator(None))
         with pytest.raises(TypeError):
             EarlyStoppingParallelTrainer(cfg, object())
+
+
+class TestCLI:
+    """ParallelWrapperMain.java parity: train a serialized model from the
+    command line."""
+
+    def test_train_and_summary(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import main as cli_main
+        from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.train.serialization import save_model
+
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam", "lr": 0.05}))
+               .input_shape(2)
+               .layer(L.Dense(n_out=8, activation="tanh"))
+               .layer(L.Output(n_out=2, activation="softmax", loss="mcxent"))
+               .build())
+        net.init()
+        mp = str(tmp_path / "net.zip")
+        save_model(mp, net)
+
+        rng = np.random.default_rng(0)
+        csv = tmp_path / "d.csv"
+        rows = []
+        for i in range(60):
+            c = i % 2
+            a, b = rng.standard_normal(2) + (2 * c - 1)
+            rows.append(f"{a:.4f},{b:.4f},{c}")
+        csv.write_text("\n".join(rows))
+
+        out = str(tmp_path / "trained.zip")
+        rc = cli_main(["train", "--model", mp, "--csv", str(csv),
+                       "--num-classes", "2", "--epochs", "8", "--batch", "16",
+                       "--save", out])
+        assert rc == 0
+        import os
+        assert os.path.exists(out)
+        rc = cli_main(["summary", "--model", out])
+        assert rc == 0
+        assert "Dense" in capsys.readouterr().out
