@@ -29,6 +29,10 @@ def cmd_summary(args) -> int:
 
 
 def cmd_train(args) -> int:
+    if not args.regression and args.num_classes < 1:
+        print("error: --num-classes is required for classification "
+              "(or pass --regression)", file=sys.stderr)
+        return 2
     import numpy as np
 
     from .data.records import (CSVRecordReader, RecordReaderDataSetIterator,

@@ -40,9 +40,10 @@ from ..nn.layers import (LRN, ActivationLayer, BatchNorm, Bidirectional,
                          Conv1D, Conv2D, Cropping2D, Deconv2D, Dense,
                          DepthwiseConv2D, DropoutLayer, EmbeddingSequence,
                          Flatten, GlobalPooling, GRU, LastTimeStep, LSTM,
-                         PReLU, Reshape, SeparableConv2D, SimpleRnn,
-                         Subsampling1D, Subsampling2D, Upsampling1D,
-                         Upsampling2D, ZeroPadding1D, ZeroPadding2D)
+                         LayerNorm, MultiHeadAttention, PReLU, Reshape,
+                         SeparableConv2D, SimpleRnn, Subsampling1D,
+                         Subsampling2D, Upsampling1D, Upsampling2D,
+                         ZeroPadding1D, ZeroPadding2D)
 from ..nn.model import Graph, GraphBuilder, NetConfig, Sequential
 from ..nn.vertices import ElementWise, GraphVertex, Merge
 
@@ -289,6 +290,37 @@ def _batchnorm(conf):
                      lock_gamma_beta=not (conf.get("scale", True) or conf.get("center", True)))
 
 
+def _layernorm(conf):
+    """Keras LayerNormalization -> LayerNorm (the transformer/BERT-import
+    path; no reference equivalent — DL4J 0.9 predates LN)."""
+    axis = conf.get("axis", -1)
+    if axis not in (-1, [-1], None):
+        raise UnsupportedKerasConfigurationException(
+            f"LayerNormalization over axis {axis} unsupported (last-axis only)")
+    if not conf.get("scale", True):
+        raise UnsupportedKerasConfigurationException(
+            "LayerNormalization(scale=False) unsupported")
+    return LayerNorm(eps=float(conf.get("epsilon", 1e-3)),
+                     use_bias=bool(conf.get("center", True)))
+
+
+def _mha(conf):
+    """Keras MultiHeadAttention -> fused-QKV MultiHeadAttention (self-
+    attention only; BERT-import path). Attention dropout carries over."""
+    if conf.get("output_shape") not in (None, []):
+        raise UnsupportedKerasConfigurationException(
+            "MultiHeadAttention with custom output_shape unsupported")
+    return MultiHeadAttention(num_heads=int(conf["num_heads"]),
+                              attn_dropout=float(conf.get("dropout", 0.0)))
+
+
+def _softmax_layer(conf):
+    if conf.get("axis", -1) not in (-1, None):
+        raise UnsupportedKerasConfigurationException(
+            f"Softmax over axis {conf.get('axis')} unsupported (last-axis only)")
+    return ActivationLayer(activation="softmax")
+
+
 def _lstm(conf):
     if conf.get("go_backwards"):
         raise UnsupportedKerasConfigurationException("LSTM go_backwards unsupported")
@@ -463,6 +495,8 @@ def _convert_layer(class_name: str, conf: dict, ctx: _Ctx):
         "LeakyReLU": _leaky_relu, "PReLU": _prelu,
         "ELU": lambda c: ActivationLayer(activation="elu"),
         "ThresholdedReLU": lambda c: ActivationLayer(activation="thresholdedrelu"),
+        "LayerNormalization": _layernorm, "MultiHeadAttention": _mha,
+        "Softmax": _softmax_layer,
     }
     if class_name == "Bidirectional":
         bidi = _bidirectional(conf, ctx)
@@ -556,6 +590,39 @@ def _convert_weights(layer: Layer, arrays: List[np.ndarray], *, keras_major: int
         beta = (vals[1] if scale else vals[0]) if center else np.zeros(n, np.float32)
         params = {} if layer.lock_gamma_beta else {"gamma": j(gamma), "beta": j(beta)}
         return params, {"mean": j(mean), "var": j(var)}
+    if isinstance(layer, LayerNorm):
+        p = {"gamma": j(a[0])}
+        if layer.use_bias:
+            if len(a) < 2:
+                raise InvalidKerasConfigurationException(
+                    "LayerNormalization(center=True) expects gamma+beta weights")
+            p["beta"] = j(a[1])
+        return p, {}
+    if isinstance(layer, MultiHeadAttention):
+        # keras MHA stores per-projection kernels: query/key/value (d, H, hd)
+        # + optional biases (H, hd), then attention_output (H, hd, d) + (d,).
+        # Our layer fuses them: w_qkv (d, 3d), w_o (d, d) — requires the
+        # standard BERT geometry H*hd == d.
+        use_bias = len(a) == 8
+        if len(a) not in (4, 8):
+            raise InvalidKerasConfigurationException(
+                f"MultiHeadAttention expects 4 or 8 weights, got {len(a)}")
+        if use_bias:
+            wq, bq_, wk, bk_, wv, bv_, wo, bo = a
+        else:
+            wq, wk, wv, wo = a
+        d, H, hd = wq.shape
+        if H * hd != d:
+            raise UnsupportedKerasConfigurationException(
+                f"MultiHeadAttention num_heads*key_dim={H * hd} != d_model={d}; "
+                f"the fused-QKV layer requires the standard geometry")
+        w_qkv = np.concatenate([w.reshape(d, d) for w in (wq, wk, wv)], axis=1)
+        if use_bias:
+            b_qkv = np.concatenate([b.reshape(d) for b in (bq_, bk_, bv_)])
+        else:
+            b_qkv, bo = np.zeros(3 * d, np.float32), np.zeros(d, np.float32)
+        return {"w_qkv": j(w_qkv), "b_qkv": j(b_qkv),
+                "w_o": j(wo.reshape(d, d)), "b_o": j(bo)}, {}
     if isinstance(layer, LSTM):
         # keras: kernel (in,4H) [i,f,c,o], recurrent_kernel (H,4H), bias (4H)
         # ours:  w_ih (in,4H) [i,f,g,o],  w_hh (H,4H),              b (4H)
@@ -851,6 +918,14 @@ def import_keras_model_and_weights(path: str):
             for i, refs in enumerate(apps or [[]]):
                 node_name = _app_node_name(name, i)
                 inbound = [_app_node_name(rn, ri) for rn, ri in refs]
+                if isinstance(converted, MultiHeadAttention):
+                    # keras calls MHA as (query, value[, key]); only SELF-
+                    # attention (all the same tensor) maps to our layer
+                    if len(set(inbound)) != 1:
+                        raise UnsupportedKerasConfigurationException(
+                            f"MultiHeadAttention '{name}': cross-attention "
+                            f"(distinct query/value inputs {inbound}) unsupported")
+                    inbound = inbound[:1]
                 if isinstance(converted, GraphVertex):
                     gb.add_vertex(node_name, converted, *inbound)
                 else:

@@ -37,6 +37,12 @@ from .layers.core import CenterLossOutput, LossLayer, Output, _LossMixin
 from .layers.recurrent import RecurrentLayer
 from .vertices import GraphVertex, vertex_from_dict
 
+
+def _is_loss_layer(spec) -> bool:
+    """A layer that can terminate training: _LossMixin outputs AND custom
+    loss layers that define their own score() (e.g. Yolo2Output)."""
+    return isinstance(spec, _LossMixin) or hasattr(spec, "score")
+
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16,
           "float64": jnp.float64}
 
@@ -173,7 +179,7 @@ class Sequential:
               mask: Optional[Array] = None, label_mask: Optional[Array] = None,
               ) -> Tuple[Array, State]:
         out_layer = self.layers[-1]
-        if not isinstance(out_layer, _LossMixin):
+        if not _is_loss_layer(out_layer):
             raise ValueError("Last layer must be an Output/Loss layer to compute score")
         feats, new_state = self.forward(params, state, x, training=training, rng=rng,
                                         mask=mask, up_to=len(self.layers) - 1)
@@ -424,6 +430,13 @@ class Graph:
     def score(self, params, state, inputs, labels, *, training=True, rng=None,
               masks=None, label_masks=None) -> Tuple[Array, State]:
         """Sum of losses over all output layers (ComputationGraph multi-output)."""
+        if not any(_is_loss_layer(self.nodes[o].spec) for o in self.outputs):
+            raise ValueError(
+                "Graph has no loss-bearing output layer — score/fit would "
+                "silently return 0. Imported inference graphs (e.g. Keras "
+                "import) need a training head: replace the terminal layer "
+                "with an Output layer via the transfer-learning builder "
+                "(nn/transfer.py) before training.")
         if not isinstance(inputs, dict):
             inputs = {self.inputs[0]: inputs}
         if masks is not None and not isinstance(masks, dict):
@@ -452,7 +465,7 @@ class Graph:
                 continue
             p = (_cast_floats(params.get(name, {}), cdt) if cdt is not None
                  else params.get(name, {}))
-            if name in out_idx and isinstance(node.spec, _LossMixin):
+            if name in out_idx and _is_loss_layer(node.spec):
                 li = out_idx[name]
                 lm = None
                 if label_masks is not None:

@@ -242,6 +242,48 @@ class MultiHostTrainer:
             iterator.reset()
         return total / max(n_batches, 1)
 
+    def evaluate(self, iterator, evaluation=None):
+        """Distributed evaluation (dl4j-spark evaluation parity: each
+        executor evaluates its partition, the driver merges accumulators).
+        Each process forwards its LOCAL shard rows on its own devices, then
+        the per-process confusion accumulators merge with one tiny
+        all-gather. Multiclass ``Evaluation`` only (the accumulators that
+        all-reduce)."""
+        from ..eval import Evaluation
+        from ..train.trainer import default_evaluation, make_infer_fn
+
+        self._sync_model()
+        if evaluation is None:
+            evaluation = default_evaluation(self.model)
+        elif not isinstance(evaluation, Evaluation):
+            raise TypeError("distributed evaluate requires a (mergeable) "
+                            "multiclass Evaluation")
+
+        if not hasattr(self, "_infer_fn") or self._infer_fn is None:
+            self._infer_fn = make_infer_fn(self.model)  # cache across calls
+
+        for ds in iterator:
+            preds = self._infer_fn(
+                self.model.params, self.model.state,
+                jnp.asarray(np.asarray(ds.features)),
+                (jnp.asarray(np.asarray(ds.features_mask))
+                 if ds.features_mask is not None else None))
+            evaluation.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(
+                {"confusion": evaluation.confusion.astype(np.int64),
+                 "top_n_correct": np.int64(evaluation.top_n_correct),
+                 "top_n_total": np.int64(evaluation.top_n_total)})
+            evaluation.confusion = np.asarray(gathered["confusion"]).sum(0)
+            evaluation.top_n_correct = int(np.asarray(gathered["top_n_correct"]).sum())
+            evaluation.top_n_total = int(np.asarray(gathered["top_n_total"]).sum())
+        return evaluation
+
     def save(self, path: str, normalizer=None):
         """Checkpoint from process 0 only (driver-side ModelSerializer parity)."""
         if not self.is_main:

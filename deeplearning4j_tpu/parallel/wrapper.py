@@ -376,37 +376,29 @@ class ParallelWrapper:
         replicated params run the forward on every device in parallel (the
         reference round-robins eval batches over its workers; here the batch
         sharding does the distribution and GSPMD the rest)."""
-        from ..eval import Evaluation
+        from ..train.trainer import default_evaluation, make_infer_fn
 
         self._sync_model()
         model = self.model
-        seq = isinstance(model, Sequential)
         if evaluation is None:
-            n_out = (model.output_shape[-1] if seq
-                     else model.output_shapes[0][-1])
-            evaluation = Evaluation(n_out)
+            evaluation = default_evaluation(model)
 
         repl = NamedSharding(self.mesh, P())
         batch_sh = NamedSharding(self.mesh, P(DATA_AXIS))
         params = jax.device_put(model.params, repl)
         state = jax.device_put(model.state, repl)
+        if not hasattr(self, "_infer_fn") or self._infer_fn is None:
+            self._infer_fn = make_infer_fn(model)
 
-        @jax.jit
-        def infer(p, s, x):
-            if seq:
-                y, _ = model.forward(p, s, x, training=False)
-            else:  # Graph: evaluate the primary (first) output
-                ys, _ = model.forward(p, s, x, training=False)
-                y = ys[0]
-            return y
-
-        n = self.n_dev
         for ds in iterator:
             x = np.asarray(ds.features)
-            pad = (-x.shape[0]) % n  # batch must divide the data axis
-            xp = np.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
-            preds = np.asarray(infer(params, state,
-                                     jax.device_put(xp, batch_sh)))[: x.shape[0]]
+            n_rows = x.shape[0]
+            m = (np.asarray(ds.features_mask)
+                 if ds.features_mask is not None else None)
+            preds = np.asarray(self._infer_fn(
+                params, state, jax.device_put(self._pad_rows(x), batch_sh),
+                (jax.device_put(self._pad_rows(m), batch_sh)
+                 if m is not None else None)))[:n_rows]
             evaluation.eval(ds.labels, preds, mask=ds.labels_mask)
         if hasattr(iterator, "reset"):
             iterator.reset()

@@ -54,6 +54,32 @@ def make_score_fn(model):
     return score
 
 
+def make_infer_fn(model):
+    """One jitted ``(params, state, x, mask) -> primary output`` forward for
+    a model (Sequential or Graph, masks threaded either way) — shared by the
+    evaluate paths of Trainer / ParallelWrapper / MultiHostTrainer."""
+    seq = isinstance(model, Sequential)
+
+    @jax.jit
+    def infer(params, state, x, mask=None):
+        if seq:
+            y, _ = model.forward(params, state, x, training=False, mask=mask)
+            return y
+        ys, _ = model.forward(params, state, x, training=False, masks=mask)
+        return ys[0]
+
+    return infer
+
+
+def default_evaluation(model):
+    """Multiclass Evaluation sized to the model's primary output."""
+    from ..eval import Evaluation
+
+    n_out = (model.output_shape[-1] if isinstance(model, Sequential)
+             else model.output_shapes[0][-1])
+    return Evaluation(n_out)
+
+
 def build_updater(model) -> optax.GradientTransformation:
     """Build the optax pipeline from NetConfig + per-layer overrides."""
     cfg: NetConfig = model.config

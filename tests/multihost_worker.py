@@ -37,11 +37,15 @@ def main():
     col = CollectScoresListener()
     it = ProcessShardIterator(x, y, global_batch_size=16)
     tr.fit(it, epochs=3, listeners=[col])
+    # distributed evaluation + scoring: every process participates (lockstep)
+    ev = tr.evaluate(ProcessShardIterator(x, y, global_batch_size=16))
+    score = tr.score_iterator(ProcessShardIterator(x, y, global_batch_size=16))
     if pid == 0:
         flat = {f"{k}/{k2}": np.asarray(v2)
                 for k, v in tr.model.params.items() for k2, v2 in v.items()}
         np.savez(os.path.join(outdir, "multihost_params.npz"),
-                 losses=np.asarray([s for _, s in col.scores]), **flat)
+                 losses=np.asarray([s for _, s in col.scores]),
+                 confusion=ev.confusion, dist_score=np.float64(score), **flat)
     print(f"worker {pid} done", flush=True)
 
 

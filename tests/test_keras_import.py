@@ -359,3 +359,52 @@ class TestModelGuesser:
         with open(p, "w") as f:
             f.write(m.to_json())
         assert guess_model_format(p) == "config-json"
+
+
+class TestTransformerImport:
+    """BERT-path layers (the driver's stretch config #5): LayerNormalization
+    + self-attention MultiHeadAttention import with golden activations."""
+
+    def test_transformer_block_golden(self, tmp_path):
+        d, H = 8, 2
+        inp = keras.Input((6, d))
+        x = layers.LayerNormalization(epsilon=1e-6)(inp)
+        att = layers.MultiHeadAttention(num_heads=H, key_dim=d // H)(x, x)
+        x = layers.Add()([inp, att])
+        y = layers.LayerNormalization(epsilon=1e-6)(x)
+        out = layers.Dense(4, activation="softmax")(
+            layers.GlobalAveragePooling1D()(y))
+        km = keras.Model(inp, out)
+        p = _save(tmp_path, km, "tblock.h5")
+
+        model = import_keras_model_and_weights(p)
+        xin = np.random.default_rng(0).standard_normal((3, 6, d)).astype(np.float32)
+        want = km.predict(xin, verbose=0)
+        got = model.output(xin)
+        if isinstance(got, list):
+            got = got[0]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+    def test_cross_attention_rejected(self, tmp_path):
+        d = 8
+        a = keras.Input((5, d))
+        b = keras.Input((7, d))
+        out = layers.MultiHeadAttention(num_heads=2, key_dim=4)(a, b)
+        km = keras.Model([a, b], out)
+        p = _save(tmp_path, km, "cross.h5")
+        from deeplearning4j_tpu.interop.keras_import import \
+            UnsupportedKerasConfigurationException
+        with pytest.raises(UnsupportedKerasConfigurationException,
+                           match="cross-attention"):
+            import_keras_model_and_weights(p)
+
+    def test_nonstandard_geometry_rejected(self, tmp_path):
+        d = 8
+        inp = keras.Input((5, d))
+        out = layers.MultiHeadAttention(num_heads=3, key_dim=5)(inp, inp)
+        km = keras.Model(inp, out)
+        p = _save(tmp_path, km, "geom.h5")
+        from deeplearning4j_tpu.interop.keras_import import \
+            UnsupportedKerasConfigurationException
+        with pytest.raises(UnsupportedKerasConfigurationException):
+            import_keras_model_and_weights(p)

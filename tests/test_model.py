@@ -294,3 +294,17 @@ class TestMixedPrecisionGraph:
         _, st = m16.score(m16.params, m16.state, x,
                           np.eye(3, dtype=np.float32)[[0, 1]], training=True)
         assert st["bn"]["mean"].dtype == jnp.float32
+
+
+class TestLosslessGraphGuard:
+    def test_graph_without_loss_head_raises_on_score(self):
+        """Regression: an inference-only graph (e.g. Keras import) used to
+        silently score 0.0 and 'train' to nowhere."""
+        g = (GraphBuilder(NetConfig(seed=0))
+             .add_input("in", (4,))
+             .add_layer("d1", L.Dense(n_out=3, activation="softmax"), "in")
+             .set_outputs("d1")
+             .build())
+        params, state = g.init()
+        with pytest.raises(ValueError, match="transfer-learning"):
+            g.score(params, state, jnp.zeros((2, 4)), jnp.zeros((2, 3)))

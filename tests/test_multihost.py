@@ -88,6 +88,14 @@ def test_two_process_training_matches_single_process(tmp_path):
                 got[f"{k}/{k2}"], np.asarray(v), rtol=1e-5, atol=1e-6,
                 err_msg=f"param {k}/{k2} diverged from single-process run")
 
+    # distributed evaluation merged across processes == single-process eval
+    ev = tr.evaluate(_ListIter())
+    np.testing.assert_array_equal(got["confusion"], ev.confusion)
+    assert got["confusion"].sum() == 64  # every row evaluated exactly once
+    # distributed scoring == single-process scoring
+    np.testing.assert_allclose(float(got["dist_score"]),
+                               tr.score_iterator(_ListIter()), rtol=1e-5)
+
 
 def test_single_process_multidevice_mode(tmp_path):
     """MultiHostTrainer degenerates to single-process multi-device sync DP

@@ -421,3 +421,9 @@ class TestCLI:
         rc = cli_main(["summary", "--model", out])
         assert rc == 0
         assert "Dense" in capsys.readouterr().out
+
+    def test_train_requires_num_classes(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import main as cli_main
+        rc = cli_main(["train", "--model", "x.zip", "--csv", "y.csv"])
+        assert rc == 2
+        assert "--num-classes" in capsys.readouterr().err

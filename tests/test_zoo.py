@@ -141,3 +141,31 @@ class TestInstantiation:
             js = model.to_json()
             model2 = (Sequential if isinstance(model, Sequential) else Graph).from_json(js)
             assert model2.to_json() == js
+
+
+class TestYoloTrainable:
+    def test_yolo_graph_loss_and_grads_flow(self):
+        """Regression: Graph.score dispatched only _LossMixin outputs, so
+        Yolo2Output.score was unreachable and YOLO 'training' silently
+        optimized a constant 0."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models import TinyYOLO
+
+        zm = TinyYOLO(num_classes=3, input_shape=(32, 32, 3), seed=0)
+        m = zm.build()
+        params, state = m.init()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((2, 32, 32, 3)), jnp.float32)
+        act = m.output(x)
+        act = act[0] if isinstance(act, list) else act
+        B, H, W, D = act.shape
+        A = D // 8
+        lab = np.zeros((B, H, W, A, 8), np.float32)
+        lab[0, 0, 0, 0] = [0.5, 0.5, 1, 1, 1, 1, 0, 0]
+        loss, _ = m.score(params, state, x, jnp.asarray(lab.reshape(B, H, W, -1)))
+        assert float(loss) > 0
+        g = jax.grad(lambda p: m.score(p, state, x,
+                                       jnp.asarray(lab.reshape(B, H, W, -1)))[0])(params)
+        assert any(float(jnp.abs(v).max()) > 0
+                   for v in jax.tree_util.tree_leaves(g))
