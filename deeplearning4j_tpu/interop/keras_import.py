@@ -290,13 +290,30 @@ def _batchnorm(conf):
                      lock_gamma_beta=not (conf.get("scale", True) or conf.get("center", True)))
 
 
-def _layernorm(conf):
-    """Keras LayerNormalization -> LayerNorm (the transformer/BERT-import
-    path; no reference equivalent — DL4J 0.9 predates LN)."""
+def _ln_axis(conf) -> int:
+    """Normalize the serialized LayerNormalization axis to one int (-1 or a
+    positive spelling to be validated against the input rank later)."""
     axis = conf.get("axis", -1)
-    if axis not in (-1, [-1], None):
+    if isinstance(axis, (list, tuple)):
+        if len(axis) != 1:
+            raise UnsupportedKerasConfigurationException(
+                f"LayerNormalization over multiple axes {axis} unsupported")
+        axis = axis[0]
+    if axis is None:
+        return -1
+    axis = int(axis)
+    if axis < -1:
         raise UnsupportedKerasConfigurationException(
             f"LayerNormalization over axis {axis} unsupported (last-axis only)")
+    return axis
+
+
+def _layernorm(conf):
+    """Keras LayerNormalization -> LayerNorm (the transformer/BERT-import
+    path; no reference equivalent — DL4J 0.9 predates LN). Positive axis
+    spellings are validated against the input rank post-build (tf.keras 2.x
+    stores the built axis, e.g. [2])."""
+    _ln_axis(conf)  # reject multi-axis / below -1 up front
     if not conf.get("scale", True):
         raise UnsupportedKerasConfigurationException(
             "LayerNormalization(scale=False) unsupported")
@@ -460,6 +477,10 @@ class _Ctx:
         # (concat layer name, positive axis) pairs to validate against actual
         # input ranks once the graph's shapes are known
         self.concat_axis_checks: List[Tuple[Optional[str], int]] = []
+        # LayerNormalization with a positive axis spelling (tf.keras 2.x
+        # serializes the built axis, e.g. [2]) — validate it IS the last
+        # axis once input ranks are known
+        self.ln_axis_checks: List[Tuple[Optional[str], int]] = []
 
 
 def _convert_layer(class_name: str, conf: dict, ctx: _Ctx):
@@ -498,6 +519,12 @@ def _convert_layer(class_name: str, conf: dict, ctx: _Ctx):
         "LayerNormalization": _layernorm, "MultiHeadAttention": _mha,
         "Softmax": _softmax_layer,
     }
+    if class_name == "LayerNormalization":
+        ln = _layernorm(conf)
+        ax = _ln_axis(conf)
+        if ax >= 0:  # positive spelling: defer rank validation
+            ctx.ln_axis_checks.append((conf.get("name"), ax))
+        return ln
     if class_name == "Bidirectional":
         bidi = _bidirectional(conf, ctx)
         if not conf["layer"]["config"].get("return_sequences", False):
@@ -616,6 +643,11 @@ def _convert_weights(layer: Layer, arrays: List[np.ndarray], *, keras_major: int
             raise UnsupportedKerasConfigurationException(
                 f"MultiHeadAttention num_heads*key_dim={H * hd} != d_model={d}; "
                 f"the fused-QKV layer requires the standard geometry")
+        if wk.shape != wq.shape or wv.shape != wq.shape:
+            raise UnsupportedKerasConfigurationException(
+                f"MultiHeadAttention with value_dim/key_dim mismatch "
+                f"(q{wq.shape} k{wk.shape} v{wv.shape}) unsupported — the "
+                f"fused-QKV layer requires identical projection shapes")
         w_qkv = np.concatenate([w.reshape(d, d) for w in (wq, wk, wv)], axis=1)
         if use_bias:
             b_qkv = np.concatenate([b.reshape(d) for b in (bq_, bk_, bv_)])
@@ -736,6 +768,18 @@ def import_keras_sequential_model_and_weights(path: str, *, input_shape=None) ->
             raise InvalidKerasConfigurationException(
                 "Could not infer input shape; pass input_shape=...")
         model = Sequential(NetConfig(), layers, in_shape)
+        # deferred LayerNormalization positive-axis validation (same contract
+        # as the functional path): the axis must be the LAST axis of the
+        # layer's actual input
+        if ctx.ln_axis_checks:
+            by_name = {layer.name: i for i, layer in enumerate(model.layers)}
+            for lname, ax in ctx.ln_axis_checks:
+                if lname in by_name:
+                    rank = len(model.layer_input_shape(by_name[lname])) + 1
+                    if ax != rank - 1:
+                        raise UnsupportedKerasConfigurationException(
+                            f"LayerNormalization '{lname}' axis={ax} is not "
+                            f"the last axis for rank-{rank} inputs")
         model.init()
         _load_weights_sequential(model, ar, keras_major, confs,
                                  th_ordering=th and keras_major < 2,
@@ -853,6 +897,43 @@ def _inbound_refs(inbound_nodes) -> List[List[Tuple[str, int]]]:
     return apps
 
 
+def _inbound_call_kwargs(inbound_nodes) -> List[dict]:
+    """Per-application CALL kwargs (keras 3 dict form / keras 1-2 4th entry).
+    Needed for layers whose call signature carries semantics (e.g.
+    MultiHeadAttention's value=/key= tensors and use_causal_mask)."""
+    out: List[dict] = []
+    for node in inbound_nodes or []:
+        if isinstance(node, dict):
+            out.append(node.get("kwargs") or {})
+        else:
+            kw = {}
+            for entry in node:
+                if len(entry) > 3 and isinstance(entry[3], dict):
+                    kw.update(entry[3])
+            out.append(kw)
+    return out
+
+
+def _kwargs_tensor_refs(kwargs: dict) -> List[Tuple[str, int]]:
+    """Tensor references hiding in call kwargs (value=/key= passed by name)."""
+    refs: List[Tuple[str, int]] = []
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            if obj.get("class_name") == "__keras_tensor__":
+                h = obj["config"]["keras_history"]
+                refs.append((h[0], int(h[1])))
+                return
+            for v in obj.values():
+                walk(v)
+        elif isinstance(obj, (list, tuple)):
+            for v in obj:
+                walk(v)
+
+    walk(kwargs)
+    return refs
+
+
 def _app_node_name(layer_name: str, app_idx: int) -> str:
     """Graph-node name for the app_idx'th application of a shared layer."""
     return layer_name if app_idx == 0 else f"{layer_name}__shared{app_idx}"
@@ -919,13 +1000,20 @@ def import_keras_model_and_weights(path: str):
                 node_name = _app_node_name(name, i)
                 inbound = [_app_node_name(rn, ri) for rn, ri in refs]
                 if isinstance(converted, MultiHeadAttention):
-                    # keras calls MHA as (query, value[, key]); only SELF-
-                    # attention (all the same tensor) maps to our layer
-                    if len(set(inbound)) != 1:
+                    # keras calls MHA as (query, value[, key]) positionally OR
+                    # by keyword; only SELF-attention maps to our layer
+                    call_kwargs = _inbound_call_kwargs(lc.get("inbound_nodes", []))
+                    kw = call_kwargs[i] if i < len(call_kwargs) else {}
+                    kw_refs = [_app_node_name(rn, ri)
+                               for rn, ri in _kwargs_tensor_refs(kw)]
+                    if len(set(inbound + kw_refs)) != 1:
                         raise UnsupportedKerasConfigurationException(
                             f"MultiHeadAttention '{name}': cross-attention "
-                            f"(distinct query/value inputs {inbound}) unsupported")
-                    inbound = inbound[:1]
+                            f"(distinct query/value inputs "
+                            f"{inbound + kw_refs}) unsupported")
+                    if kw.get("use_causal_mask"):
+                        converted = dataclass_replace(converted, causal=True)
+                    inbound = (inbound or kw_refs)[:1]
                 if isinstance(converted, GraphVertex):
                     gb.add_vertex(node_name, converted, *inbound)
                 else:
@@ -950,6 +1038,16 @@ def import_keras_model_and_weights(path: str):
                     raise UnsupportedKerasConfigurationException(
                         f"Concatenate '{cname}' axis={ax} is not the channel "
                         f"axis for rank-{rank} inputs")
+        for lname, ax in ctx.ln_axis_checks:
+            for node_name in app_nodes.get(lname, [lname]):
+                if node_name not in graph.nodes:
+                    continue
+                in0 = graph.nodes[node_name].inputs[0]
+                rank = len(graph._shapes[in0]) + 1
+                if ax != rank - 1:
+                    raise UnsupportedKerasConfigurationException(
+                        f"LayerNormalization '{lname}' axis={ax} is not the "
+                        f"last axis for rank-{rank} inputs")
         graph.init()
         th_ordering = th and keras_major < 2
         for node_name, layer in imported.items():

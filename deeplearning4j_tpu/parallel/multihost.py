@@ -262,10 +262,16 @@ class MultiHostTrainer:
         if not hasattr(self, "_infer_fn") or self._infer_fn is None:
             self._infer_fn = make_infer_fn(self.model)  # cache across calls
 
+        # snapshot so the cross-process merge sums only THIS call's counts
+        # (a pre-populated evaluation must not be re-summed x process_count)
+        conf0 = evaluation.confusion.copy()
+        topc0, topt0 = evaluation.top_n_correct, evaluation.top_n_total
+
+        params = jax.device_put(self.model.params)  # host->device once
+        state = jax.device_put(self.model.state)
         for ds in iterator:
             preds = self._infer_fn(
-                self.model.params, self.model.state,
-                jnp.asarray(np.asarray(ds.features)),
+                params, state, jnp.asarray(np.asarray(ds.features)),
                 (jnp.asarray(np.asarray(ds.features_mask))
                  if ds.features_mask is not None else None))
             evaluation.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
@@ -276,12 +282,12 @@ class MultiHostTrainer:
             from jax.experimental import multihost_utils
 
             gathered = multihost_utils.process_allgather(
-                {"confusion": evaluation.confusion.astype(np.int64),
-                 "top_n_correct": np.int64(evaluation.top_n_correct),
-                 "top_n_total": np.int64(evaluation.top_n_total)})
-            evaluation.confusion = np.asarray(gathered["confusion"]).sum(0)
-            evaluation.top_n_correct = int(np.asarray(gathered["top_n_correct"]).sum())
-            evaluation.top_n_total = int(np.asarray(gathered["top_n_total"]).sum())
+                {"confusion": (evaluation.confusion - conf0).astype(np.int64),
+                 "top_n_correct": np.int64(evaluation.top_n_correct - topc0),
+                 "top_n_total": np.int64(evaluation.top_n_total - topt0)})
+            evaluation.confusion = conf0 + np.asarray(gathered["confusion"]).sum(0)
+            evaluation.top_n_correct = topc0 + int(np.asarray(gathered["top_n_correct"]).sum())
+            evaluation.top_n_total = topt0 + int(np.asarray(gathered["top_n_total"]).sum())
         return evaluation
 
     def save(self, path: str, normalizer=None):

@@ -140,6 +140,7 @@ class Trainer:
         self._rng = jax.random.PRNGKey(seed)
         self._step_fn = None
         self._tbptt_step_fn = None
+        self._infer_fn = None
 
     # --- the jitted train step ---
     def _make_step(self):
@@ -312,23 +313,13 @@ class Trainer:
 
     # --- evaluation (streaming, Evaluation parity) ---
     def evaluate(self, iterator, evaluation=None):
-        from ..eval import Evaluation
-
-        model = self.model
         if evaluation is None:
-            n_out = model.output_shape[-1] if isinstance(model, Sequential) else model.output_shapes[0][-1]
-            evaluation = Evaluation(n_out)
-
-        @jax.jit
-        def infer(params, state, x, mask=None):
-            if isinstance(model, Sequential):
-                y, _ = model.forward(params, state, x, training=False, mask=mask)
-                return y
-            ys, _ = model.forward(params, state, x, training=False)
-            return ys[0]
-
+            evaluation = default_evaluation(self.model)
+        if self._infer_fn is None:
+            self._infer_fn = make_infer_fn(self.model)
         for ds in iterator:
-            preds = infer(self.params, self.state, ds.features, ds.features_mask)
+            preds = self._infer_fn(self.params, self.state, ds.features,
+                                   ds.features_mask)
             evaluation.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
         if hasattr(iterator, "reset"):
             iterator.reset()

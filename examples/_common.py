@@ -1,0 +1,12 @@
+"""Shared example bootstrap: pin the CPU backend when no accelerator is
+requested (the hosting image's site hook can override env-only config)."""
+
+import os
+
+
+def setup(platform=None):
+    plat = platform or os.environ.get("JAX_PLATFORMS") or "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", plat)
+    return jax

@@ -1,0 +1,52 @@
+"""Transformer fine-tune — BASELINE.json config #5 (BERT path).
+
+Builds the native BERT-style encoder (tiny config so it runs on CPU), then
+fine-tunes on a toy classification task. With a saved Keras BERT h5, the
+same flow starts from `import_keras_model_and_weights` instead (see
+tests/test_keras_import.py::TestTransformerImport).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from examples._common import setup
+
+setup()
+
+import numpy as np
+
+from deeplearning4j_tpu.data.iterators import ArrayIterator
+from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.train import Trainer
+
+
+def main(T=16, d=32, heads=4, blocks=2, n=256, epochs=6):
+    rng = np.random.default_rng(0)
+    cls = rng.integers(0, 2, n)
+    x = rng.standard_normal((n, T, d)).astype(np.float32) * 0.5
+    x[:, 0, :2] += np.eye(2, dtype=np.float32)[cls] * 3.0  # [CLS]-slot signal
+    y = np.eye(2, dtype=np.float32)[cls]
+
+    b = (SequentialBuilder(NetConfig(seed=0, updater={"type": "adamw",
+                                                      "learning_rate": 1e-3}))
+         .input_shape(T, d)
+         .layer(L.PositionalEmbedding(max_len=T)))
+    for _ in range(blocks):
+        b = b.layer(L.TransformerEncoderBlock(num_heads=heads))
+    net = (b.layer(L.GlobalPooling(mode="avg"))
+            .layer(L.Output(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net.init()
+
+    tr = Trainer(net)
+    tr.fit(ArrayIterator(x, y, 32, shuffle=True), epochs=epochs)
+    ev = tr.evaluate(ArrayIterator(x, y, 64))
+    print(f"fine-tune accuracy: {ev.accuracy():.3f}")
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    acc = main()
+    assert acc > 0.8

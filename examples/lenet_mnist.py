@@ -1,0 +1,37 @@
+"""LeNet on MNIST — the reference's canonical first example
+(BASELINE.json config #1; dl4j-examples LenetMnistExample)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from examples._common import setup
+
+setup()
+
+from deeplearning4j_tpu.data.datasets import load_mnist
+from deeplearning4j_tpu.data.iterators import ArrayIterator
+from deeplearning4j_tpu.models import LeNet
+from deeplearning4j_tpu.train import ScoreIterationListener, Trainer
+
+
+def main(epochs=1, train_examples=2048, batch=64):
+    xtr, ytr = load_mnist(train=True, num_examples=train_examples)
+    xte, yte = load_mnist(train=False, num_examples=512)
+
+    model = LeNet(num_classes=10, seed=0, input_shape=(28, 28, 1)).build()
+    model.config.updater = {"type": "adam", "learning_rate": 1e-3}
+    model.init()
+    print(model.summary())
+
+    tr = Trainer(model)
+    tr.fit(ArrayIterator(xtr, ytr, batch, shuffle=True), epochs=epochs,
+           listeners=[ScoreIterationListener(10)])
+    ev = tr.evaluate(ArrayIterator(xte, yte, 128))
+    print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    acc = main()
+    print(f"test accuracy: {acc:.3f}")

@@ -1,0 +1,47 @@
+"""Transfer learning — freeze a trained feature extractor, retrain the head
+(dl4j-examples TransferLearning; config #2's fine-tune workflow)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from examples._common import setup
+
+setup()
+
+import numpy as np
+
+from deeplearning4j_tpu.data.datasets import load_mnist
+from deeplearning4j_tpu.data.iterators import ArrayIterator
+from deeplearning4j_tpu.models import LeNet
+from deeplearning4j_tpu.nn.transfer import TransferLearningBuilder
+from deeplearning4j_tpu.train import Trainer
+
+
+def main():
+    # stage 1: train LeNet on digits 0-4
+    x, y10 = load_mnist(train=True, num_examples=2048)
+    lab = y10.argmax(1)
+    keep = lab < 5
+    xa, ya = x[keep], np.eye(5, dtype=np.float32)[lab[keep]]
+    base = LeNet(num_classes=5, seed=0, input_shape=(28, 28, 1)).build()
+    base.config.updater = {"type": "adam", "learning_rate": 1e-3}
+    base.init()
+    Trainer(base).fit(ArrayIterator(xa, ya, 64, shuffle=True), epochs=1)
+
+    # stage 2: freeze everything but the head, retrain for digits 5-9
+    xb, yb = x[~keep], np.eye(5, dtype=np.float32)[lab[~keep] - 5]
+    new_net, params, state = (TransferLearningBuilder(base)
+                              .set_feature_extractor(len(base.layers) - 2)
+                              .n_out_replace(len(base.layers) - 1, 5)
+                              .build())
+    new_net.params, new_net.state = params, state
+    tr = Trainer(new_net)
+    tr.fit(ArrayIterator(xb, yb, 64, shuffle=True), epochs=1)
+    ev = tr.evaluate(ArrayIterator(xb, yb, 128))
+    print(f"new-task accuracy after frozen-feature transfer: {ev.accuracy():.3f}")
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

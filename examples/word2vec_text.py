@@ -1,0 +1,36 @@
+"""Word2Vec embeddings + nearest words — dl4j-examples Word2VecRawTextExample."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from examples._common import setup
+
+setup()
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def main():
+    sentences = [
+        "the king rules the castle and the kingdom",
+        "the queen rules the castle with the king",
+        "the dog plays in the garden with the ball",
+        "a puppy chases the ball across the garden",
+        "the king and the queen host a royal feast",
+        "the dog and the puppy sleep in the garden",
+        "royal guards protect the king and the castle",
+        "children play with the dog near the garden",
+    ] * 24
+
+    w2v = Word2Vec(layer_size=32, min_word_frequency=2, window_size=3,
+                   epochs=18, seed=1)
+    w2v.fit(sentences)
+    for a, b in [("king", "queen"), ("dog", "puppy"), ("king", "garden")]:
+        print(f"similarity({a}, {b}) = {w2v.similarity(a, b):+.3f}")
+    print("nearest to 'king':", w2v.words_nearest("king", 3))
+    return w2v
+
+
+if __name__ == "__main__":
+    main()

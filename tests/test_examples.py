@@ -1,0 +1,31 @@
+"""Every example must RUN (subprocess, CPU) — dl4j-examples parity smoke.
+
+These are the user-facing entry points for the BASELINE.json reproduce
+configs; rot here is a real user-visible break."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+EXAMPLES = sorted(p.name for p in (REPO / "examples").glob("*.py")
+                  if not p.name.startswith("_"))
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)  # each example sets what it needs
+    r = subprocess.run([sys.executable, str(REPO / "examples" / name)],
+                       cwd=str(REPO), env=env, capture_output=True,
+                       text=True, timeout=900)
+    assert r.returncode == 0, f"{name} failed:\n{r.stdout[-1500:]}\n{r.stderr[-1500:]}"
+
+
+def test_examples_cover_baseline_configs():
+    # BASELINE.json lists 5 reproduce configs; keep the example set honest
+    assert {"lenet_mnist.py", "char_rnn.py", "parallel_training.py",
+            "bert_finetune.py"} <= set(EXAMPLES)

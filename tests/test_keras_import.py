@@ -408,3 +408,77 @@ class TestTransformerImport:
             UnsupportedKerasConfigurationException
         with pytest.raises(UnsupportedKerasConfigurationException):
             import_keras_model_and_weights(p)
+
+    def test_positive_lastaxis_layernorm_accepted(self, tmp_path):
+        """tf.keras 2.x stores the built axis as a positive list ([2] for
+        (B,T,D)); the importer must accept last-axis spellings."""
+        import json as _json
+        d = 8
+        inp = keras.Input((6, d))
+        x = layers.LayerNormalization(epsilon=1e-6)(inp)
+        out = layers.Dense(2)(x)
+        km = keras.Model(inp, out)
+        p = _save(tmp_path, km, "lnpos.h5")
+        # rewrite the stored config to the positive-axis spelling
+        import h5py
+        with h5py.File(p, "r+") as f:
+            cfg = _json.loads(f.attrs["model_config"])
+            for lc in cfg["config"]["layers"]:
+                if lc["class_name"] == "LayerNormalization":
+                    lc["config"]["axis"] = [2]
+            f.attrs["model_config"] = _json.dumps(cfg)
+        model = import_keras_model_and_weights(p)
+        xin = np.random.default_rng(1).standard_normal((2, 6, d)).astype(np.float32)
+        got = model.output(xin)
+        got = got[0] if isinstance(got, list) else got
+        np.testing.assert_allclose(np.asarray(got), km.predict(xin, verbose=0),
+                                   rtol=2e-4, atol=2e-5)
+        # a NON-last positive axis must still be rejected
+        with h5py.File(p, "r+") as f:
+            cfg = _json.loads(f.attrs["model_config"])
+            for lc in cfg["config"]["layers"]:
+                if lc["class_name"] == "LayerNormalization":
+                    lc["config"]["axis"] = [1]
+            f.attrs["model_config"] = _json.dumps(cfg)
+        from deeplearning4j_tpu.interop.keras_import import \
+            UnsupportedKerasConfigurationException
+        with pytest.raises(UnsupportedKerasConfigurationException):
+            import_keras_model_and_weights(p)
+
+    def test_kwarg_cross_attention_rejected(self, tmp_path):
+        d = 8
+        a = keras.Input((5, d))
+        b = keras.Input((5, d))
+        out = layers.MultiHeadAttention(num_heads=2, key_dim=4)(a, value=b)
+        km = keras.Model([a, b], out)
+        p = _save(tmp_path, km, "kwcross.h5")
+        from deeplearning4j_tpu.interop.keras_import import \
+            UnsupportedKerasConfigurationException
+        with pytest.raises(UnsupportedKerasConfigurationException,
+                           match="cross-attention"):
+            import_keras_model_and_weights(p)
+
+    def test_causal_mask_call_arg_imported(self, tmp_path):
+        d, T = 8, 6
+        inp = keras.Input((T, d))
+        out = layers.MultiHeadAttention(num_heads=2, key_dim=4)(
+            inp, inp, use_causal_mask=True)
+        km = keras.Model(inp, out)
+        p = _save(tmp_path, km, "causal.h5")
+        model = import_keras_model_and_weights(p)
+        xin = np.random.default_rng(2).standard_normal((2, T, d)).astype(np.float32)
+        got = model.output(xin)
+        got = got[0] if isinstance(got, list) else got
+        np.testing.assert_allclose(np.asarray(got), km.predict(xin, verbose=0),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_value_dim_mismatch_rejected(self, tmp_path):
+        d = 8
+        inp = keras.Input((5, d))
+        out = layers.MultiHeadAttention(num_heads=2, key_dim=4, value_dim=6)(inp, inp)
+        km = keras.Model(inp, out)
+        p = _save(tmp_path, km, "vdim.h5")
+        from deeplearning4j_tpu.interop.keras_import import \
+            UnsupportedKerasConfigurationException
+        with pytest.raises(UnsupportedKerasConfigurationException):
+            import_keras_model_and_weights(p)
