@@ -516,11 +516,11 @@ def _convert_layer(class_name: str, conf: dict, ctx: _Ctx):
         "LeakyReLU": _leaky_relu, "PReLU": _prelu,
         "ELU": lambda c: ActivationLayer(activation="elu"),
         "ThresholdedReLU": lambda c: ActivationLayer(activation="thresholdedrelu"),
-        "LayerNormalization": _layernorm, "MultiHeadAttention": _mha,
+        "MultiHeadAttention": _mha,
         "Softmax": _softmax_layer,
     }
     if class_name == "LayerNormalization":
-        ln = _layernorm(conf)
+        ln = _layernorm(conf)  # validates the axis spelling itself
         ax = _ln_axis(conf)
         if ax >= 0:  # positive spelling: defer rank validation
             ctx.ln_axis_checks.append((conf.get("name"), ax))
@@ -999,6 +999,8 @@ def import_keras_model_and_weights(path: str):
             for i, refs in enumerate(apps or [[]]):
                 node_name = _app_node_name(name, i)
                 inbound = [_app_node_name(rn, ri) for rn, ri in refs]
+                per_app = converted  # per-application variant (e.g. causal
+                # flag) must NOT leak into later applications of a shared layer
                 if isinstance(converted, MultiHeadAttention):
                     # keras calls MHA as (query, value[, key]) positionally OR
                     # by keyword; only SELF-attention maps to our layer
@@ -1012,12 +1014,12 @@ def import_keras_model_and_weights(path: str):
                             f"(distinct query/value inputs "
                             f"{inbound + kw_refs}) unsupported")
                     if kw.get("use_causal_mask"):
-                        converted = dataclass_replace(converted, causal=True)
+                        per_app = dataclass_replace(per_app, causal=True)
                     inbound = (inbound or kw_refs)[:1]
-                if isinstance(converted, GraphVertex):
-                    gb.add_vertex(node_name, converted, *inbound)
+                if isinstance(per_app, GraphVertex):
+                    gb.add_vertex(node_name, per_app, *inbound)
                 else:
-                    named = dataclass_replace(converted, name=node_name)
+                    named = dataclass_replace(per_app, name=node_name)
                     imported[node_name] = named
                     confs[node_name] = conf
                     gb.add_layer(node_name, named, *inbound)

@@ -90,17 +90,55 @@ class Conv2D(Layer):
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         x = apply_input_dropout(self, x, rng, training)
-        y = lax.conv_general_dilated(
-            x, params["w"],
-            window_strides=_pair(self.stride),
-            padding=_padding(self.padding, self.kernel),
-            rhs_dilation=_pair(self.dilation),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=self.groups,
-        )
+        y = self._stem_space_to_depth(params["w"], x)
+        if y is None:
+            y = lax.conv_general_dilated(
+                x, params["w"],
+                window_strides=_pair(self.stride),
+                padding=_padding(self.padding, self.kernel),
+                rhs_dilation=_pair(self.dilation),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=self.groups,
+            )
         if self.use_bias:
             y = y + params["b"]
         return activations.get(self.activation)(y), state, mask
+
+    def _stem_space_to_depth(self, w, x):
+        """Transparent space-to-depth rewrite of the 7x7/2 SAME stem conv.
+
+        A stride-2 conv with C_in=3 is the canonical MXU-hostile op (3 of 128
+        MXU rows used; strided HBM access; the stem weight-grad alone measured
+        ~1ms/step of the ResNet-50 bench). The MLPerf-standard fix: pack 2x2
+        input pixels into channels ((B,H,W,C) -> (B,H/2,W/2,4C)) and run the
+        mathematically identical 4x4 stride-1 conv with rearranged zero-padded
+        weights. Params keep the canonical (7,7,C,O) HWIO shape — the rewrite
+        is pure compute, invisible to serialization/import; the tiny weight
+        shuffle is constant-folded by XLA. Returns None when the pattern
+        doesn't match (generic path runs instead).
+        """
+        kh, kw = _pair(self.kernel)
+        if ((kh, kw) != (7, 7) or _pair(self.stride) != (2, 2)
+                or not (isinstance(self.padding, str) and self.padding.lower() == "same")
+                or _pair(self.dilation) != (1, 1) or self.groups != 1
+                or x.ndim != 4 or x.shape[-1] > 4
+                or x.shape[1] % 2 or x.shape[2] % 2):
+            return None
+        B, H, W, C = x.shape
+        xp = (x.reshape(B, H // 2, 2, W // 2, 2, C)
+               .transpose(0, 1, 3, 2, 4, 5)
+               .reshape(B, H // 2, W // 2, 4 * C))
+        # (7,7,C,O) -> zero-pad to (8,8,C,O) -> split each spatial dim into
+        # (packed position, parity) -> (4,4,4C,O); channel packing order
+        # (row parity, col parity, C) matches xp's.
+        wp = (jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+                 .reshape(4, 2, 4, 2, C, w.shape[-1])
+                 .transpose(0, 2, 1, 3, 4, 5)
+                 .reshape(4, 4, 4 * C, w.shape[-1]))
+        # SAME for (224,k7,s2) pads (2,3); in packed coords that is (1,2)
+        return lax.conv_general_dilated(
+            xp, wp, window_strides=(1, 1), padding=[(1, 2), (1, 2)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 @register_layer

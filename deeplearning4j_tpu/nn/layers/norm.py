@@ -46,23 +46,35 @@ class BatchNorm(Layer):
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))
         if training:
-            # accumulate statistics in f32 even under bf16 compute: batch
-            # moments are precision-sensitive; running stats stay f32
+            # Single-pass statistics: mean and E[x^2] are SIBLING reductions
+            # over the same operand, so XLA fuses them into ONE read of the
+            # activation (jnp.var's (x - mean)^2 form chains two dependent
+            # reductions = two full HBM passes — measured 39% of the ResNet-50
+            # step going to BatchNorm before this). Accumulate in f32 even
+            # under bf16 compute: batch moments are precision-sensitive.
             mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
-            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            msq = jnp.mean(lax.square(x.astype(jnp.float32)), axis=axes)
+            var = jnp.maximum(msq - lax.square(mean), 0.0)
             sdt = state["mean"].dtype
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean.astype(sdt),
                 "var": self.decay * state["var"] + (1 - self.decay) * var.astype(sdt),
             }
         else:
-            mean, var = state["mean"], state["var"]
+            mean = state["mean"].astype(jnp.float32)
+            var = state["var"].astype(jnp.float32)
             new_state = state
-        inv = lax.rsqrt(var.astype(jnp.float32) + self.eps)
-        # normalize in the compute dtype so bf16 stays bf16 through the layer
-        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+        # Fold (mean, var, gamma, beta) into ONE per-channel affine y = x*a + b
+        # (channel-vector math is free; the elementwise pass over x is one op
+        # that fuses with the following activation / residual add).
+        inv = lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:
-            y = y * params["gamma"].astype(x.dtype) + params["beta"].astype(x.dtype)
+            a = inv * params["gamma"].astype(jnp.float32)
+            b = params["beta"].astype(jnp.float32) - mean * a
+        else:
+            a = inv
+            b = -mean * inv
+        y = x * a.astype(x.dtype) + b.astype(x.dtype)
         return activations.get(self.activation)(y), new_state, mask
 
 

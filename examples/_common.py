@@ -1,12 +1,34 @@
-"""Shared example bootstrap: pin the CPU backend when no accelerator is
-requested (the hosting image's site hook can override env-only config)."""
+"""Shared example bootstrap.
+
+Uses whatever accelerator JAX picks by default (a real TPU slice runs the
+same example code unchanged); falls back to a virtual multi-device CPU
+backend when there is no accelerator or it exposes fewer devices than the
+example needs (`min_devices`). Explicit `JAX_PLATFORMS` / `platform=`
+always wins.
+"""
 
 import os
 
 
-def setup(platform=None):
-    plat = platform or os.environ.get("JAX_PLATFORMS") or "cpu"
+def setup(platform=None, min_devices=1):
+    plat = platform or os.environ.get("JAX_PLATFORMS")
+    # Make sure a CPU fallback would present enough virtual devices; the flag
+    # must be in the env before the cpu backend initializes, and accelerator
+    # backends ignore it.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={max(min_devices, 8)}"
+        ).strip()
     import jax
 
-    jax.config.update("jax_platforms", plat)
+    if plat is not None:
+        jax.config.update("jax_platforms", plat)
+        return jax
+    try:
+        if len(jax.devices()) >= min_devices:
+            return jax
+    except RuntimeError:
+        pass
+    jax.config.update("jax_platforms", "cpu")
     return jax

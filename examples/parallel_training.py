@@ -7,10 +7,9 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 from examples._common import setup
 
-setup()
+setup(min_devices=2)  # needs a mesh; falls back to 8 virtual CPU devices
 
 import numpy as np
 

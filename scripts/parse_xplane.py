@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Parse a captured .xplane.pb directly: aggregate device-plane XEvent
+durations by op name and print the top self-time entries.
+
+Usage: python scripts/parse_xplane.py <xplane.pb> [top_n]
+"""
+
+import collections
+import sys
+
+
+def main():
+    path = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "device" not in plane.name.lower():
+            continue
+        ev_meta = {m.id: m for m in plane.event_metadata.values()}
+        stat_meta = {m.id: m.name for m in plane.stat_metadata.values()}
+        totals = collections.Counter()
+        counts = collections.Counter()
+        total_all = 0
+        for line in plane.lines:
+            # XLA op lines: pick the line with the most events (op level)
+            for ev in line.events:
+                m = ev_meta.get(ev.metadata_id)
+                name = m.name if m else "?"
+                dur = ev.duration_ps / 1e9  # -> ms
+                totals[(line.name, name)] += dur
+                counts[(line.name, name)] += 1
+        by_line = collections.defaultdict(collections.Counter)
+        for (ln, name), d in totals.items():
+            by_line[ln][name] += d
+        print(f"=== plane: {plane.name} ===")
+        import re
+
+        for ln, ctr in by_line.items():
+            tot = sum(ctr.values())
+            print(f"--- line: {ln}  total {tot:.2f} ms over capture ---")
+            if ln == "XLA Ops":
+                # aggregate by op class (strip %, trailing .N, leading fused-op prefix)
+                cls = collections.Counter()
+                for name, d in ctr.items():
+                    m = re.match(r"%?([a-zA-Z_\-]+)", name)
+                    cls[m.group(1) if m else name] += d
+                for name, d in cls.most_common(20):
+                    print(f"  [class] {d:10.3f} ms  {name}")
+            for name, d in ctr.most_common(top_n):
+                print(f"{d:10.3f} ms  x{counts[(ln, name)]:<5d} {name[:140]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Capture a device trace of the ResNet-50 train step and print the top ops.
+
+Uses jax.profiler to write an xplane proto, then parses it with the
+tensorboard profile plugin's raw-to-tool converter to get per-op self time.
+"""
+
+import glob
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+
+BATCH = int(os.environ.get("PROF_BATCH", 128))
+IMG = 224
+
+
+def main():
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.train import Trainer
+
+    zm = ResNet50(num_classes=1000, seed=0, input_shape=(IMG, IMG, 3))
+    model = zm.build()
+    model.config.compute_dtype = "bfloat16"
+    model.init()
+    tr = Trainer(model)
+    step = tr._make_step()
+
+    x = jax.device_put(np.random.RandomState(0).rand(BATCH, IMG, IMG, 3).astype(np.float32))
+    y = jax.device_put(np.eye(1000, dtype=np.float32)[
+        np.random.RandomState(1).randint(0, 1000, BATCH)])
+    rng = jax.random.PRNGKey(0)
+
+    p, o, s = tr.params, tr.opt_state, tr.state
+    for _ in range(3):  # compile + warm
+        p, o, s, loss = step(p, o, s, x, y, rng)
+    float(loss)
+
+    logdir = tempfile.mkdtemp(prefix="rn50trace")
+    with jax.profiler.trace(logdir):
+        for _ in range(8):
+            p, o, s, loss = step(p, o, s, x, y, rng)
+        float(loss)
+
+    xplanes = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    print("xplane files:", xplanes, file=sys.stderr)
+    if not xplanes:
+        sys.exit("no xplane captured")
+
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data(xplanes, "framework_op_stats", {})
+    import gzip
+    import json
+
+    if isinstance(data, bytes):
+        try:
+            data = gzip.decompress(data)
+        except OSError:
+            pass
+        data = data.decode()
+    parsed = json.loads(data)
+    # framework_op_stats: list-of-tables; find the op table rows
+    print(json.dumps(parsed, indent=1)[:4000])
+
+
+if __name__ == "__main__":
+    main()

@@ -472,6 +472,28 @@ class TestTransformerImport:
         np.testing.assert_allclose(np.asarray(got), km.predict(xin, verbose=0),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_shared_mha_causal_flag_per_application(self, tmp_path):
+        """A shared MHA layer called first WITH use_causal_mask then without
+        must import with per-application causal flags (regression: the causal
+        dataclass_replace leaked into later applications of the shared
+        layer)."""
+        d, T = 8, 6
+        inp = keras.Input((T, d))
+        mha = layers.MultiHeadAttention(num_heads=2, key_dim=4, name="shared_mha")
+        a = mha(inp, inp, use_causal_mask=True)
+        out = mha(a, a)  # second application: NOT causal
+        km = keras.Model(inp, out)
+        p = _save(tmp_path, km, "shared_causal.h5")
+        model = import_keras_model_and_weights(p)
+        flags = {n: nd.spec.causal for n, nd in model.nodes.items()
+                 if type(nd.spec).__name__ == "MultiHeadAttention"}
+        assert sorted(flags.values()) == [False, True], flags
+        xin = np.random.default_rng(3).standard_normal((2, T, d)).astype(np.float32)
+        got = model.output(xin)
+        got = got[0] if isinstance(got, list) else got
+        np.testing.assert_allclose(np.asarray(got), km.predict(xin, verbose=0),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_value_dim_mismatch_rejected(self, tmp_path):
         d = 8
         inp = keras.Input((5, d))

@@ -100,6 +100,30 @@ class TestLayerSemantics:
         y, _, _ = layer.apply(params, {}, x)
         np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
 
+    def test_stem_space_to_depth_equivalence(self):
+        """The 7x7/2 SAME stem rewrite (MXU-friendly space-to-depth packing)
+        must be numerically identical to the generic strided conv, forward
+        and gradient (it is a pure reparametrization of the same math)."""
+        layer = L.Conv2D(n_out=8, kernel=(7, 7), stride=(2, 2), padding="same",
+                         use_bias=False, activation="identity")
+        x = jax.random.normal(KEY, (2, 16, 16, 3))
+        w = jax.random.normal(jax.random.PRNGKey(7), (7, 7, 3, 8))
+
+        from jax import lax
+        ref = lax.conv_general_dilated(x, w, (2, 2), "SAME",
+                                       dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        got = layer._stem_space_to_depth(w, x)
+        assert got is not None, "stem pattern should match the rewrite"
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-4)
+
+        g_ref = jax.grad(lambda w: jnp.sum(jnp.tanh(lax.conv_general_dilated(
+            x, w, (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")))))(w)
+        g_got = jax.grad(lambda w: jnp.sum(jnp.tanh(layer._stem_space_to_depth(w, x))))(w)
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), atol=1e-4)
+
+        # odd spatial size must fall back to the generic path
+        assert layer._stem_space_to_depth(w, x[:, :15, :15, :]) is None
+
     def test_maxpool_manual(self):
         layer = L.Subsampling2D(kernel=(2, 2), stride=(2, 2), mode="max")
         x = jnp.arange(16.0).reshape(1, 4, 4, 1)
