@@ -6,13 +6,15 @@ from .datasets import (char_rnn_corpus, load_cifar10, load_iris, load_mnist,
                        mnist_iterator)
 from .iterators import (ArrayIterator, AsyncIterator, BenchmarkIterator,
                         DataSet, DataSetIterator, EarlyTerminationIterator,
+                        FileDataSetIterator, export_batches,
                         MultiDataSet, MultipleEpochsIterator, split_iterator)
 from .normalizers import (ImageScaler, MinMaxScaler, Normalizer, Standardize,
                           VGG16Preprocessor)
 
 __all__ = ["ArrayIterator", "AsyncIterator", "BenchmarkIterator", "DataSet",
-           "DataSetIterator", "EarlyTerminationIterator", "ImageScaler",
-           "MinMaxScaler", "MultiDataSet", "MultipleEpochsIterator",
+           "DataSetIterator", "EarlyTerminationIterator", "FileDataSetIterator",
+           "ImageScaler",
+           "MinMaxScaler", "MultiDataSet", "MultipleEpochsIterator", "export_batches",
            "Normalizer", "Standardize", "VGG16Preprocessor", "char_rnn_corpus",
            "load_cifar10", "load_iris", "load_mnist", "mnist_iterator",
            "split_iterator"]

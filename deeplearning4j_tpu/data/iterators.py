@@ -218,6 +218,79 @@ class MultipleEpochsIterator(DataSetIterator):
             yield from self.base
 
 
+def export_batches(iterator: DataSetIterator, directory: str,
+                   prefix: str = "dataset") -> int:
+    """Export-based training path (BatchAndExportDataSetsFunction.java /
+    SparkUtils exportDataSet parity): materialize an iterator's batches as
+    numbered ``.npz`` files so later epochs (or other processes) stream from
+    disk instead of recomputing the ETL. Returns the number of files written.
+
+    With ``FileDataSetIterator(directory, shard=(rank, world))`` this is also
+    the per-process data-shard story for multi-host training (the reference's
+    exported-RDD + VirtualDataSetIterator pattern)."""
+    import os
+
+    os.makedirs(directory, exist_ok=True)
+    for stale in _batch_files(directory, prefix):  # a shorter re-export must
+        os.remove(stale)  # not leave higher-numbered files from the old run
+    n = 0
+    for ds in iterator:
+        arrs = {"features": np.asarray(ds.features), "labels": np.asarray(ds.labels)}
+        if ds.features_mask is not None:
+            arrs["features_mask"] = np.asarray(ds.features_mask)
+        if ds.labels_mask is not None:
+            arrs["labels_mask"] = np.asarray(ds.labels_mask)
+        np.savez(os.path.join(directory, f"{prefix}_{n:06d}.npz"), **arrs)
+        n += 1
+    if hasattr(iterator, "reset"):
+        iterator.reset()
+    return n
+
+
+def _batch_files(directory: str, prefix: str) -> List[str]:
+    """Exactly the files ``export_batches`` writes for this prefix
+    (``{prefix}_NNNNNN.npz``) — a strict match, so prefixes that extend each
+    other ("dataset" vs "dataset_val") never bleed into one another."""
+    import os
+    import re
+
+    pat = re.compile(re.escape(prefix) + r"_\d{6}\.npz$")
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    return sorted(os.path.join(directory, f) for f in names if pat.fullmatch(f))
+
+
+class FileDataSetIterator(DataSetIterator):
+    """ExistingMiniBatchDataSetIterator.java — stream pre-exported ``.npz``
+    batches from a directory; optional shuffle of file order per epoch and
+    ``shard=(rank, world_size)`` striping for per-process data sharding."""
+
+    def __init__(self, directory: str, prefix: str = "dataset",
+                 shuffle: bool = False, seed: int = 0,
+                 shard: Optional[Tuple[int, int]] = None):
+        self.files = _batch_files(directory, prefix)
+        if shard is not None:
+            rank, world = shard
+            self.files = self.files[rank::world]
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return len(self.files)
+
+    def __iter__(self):
+        order = np.arange(len(self.files))
+        if self.shuffle:
+            self._rng.shuffle(order)
+        for i in order:
+            with np.load(self.files[i]) as z:
+                yield DataSet(z["features"], z["labels"],
+                              z["features_mask"] if "features_mask" in z else None,
+                              z["labels_mask"] if "labels_mask" in z else None)
+
+
 def split_iterator(features, labels, fraction_train: float, batch_size: int = 32,
                    seed: int = 0, shuffle: bool = True) -> Tuple[ArrayIterator, ArrayIterator]:
     """DataSetIteratorSplitter / SplitTestAndTrain parity."""

@@ -9,7 +9,8 @@ from .conv import (Conv1D, Conv2D, Cropping2D, Deconv2D, DepthwiseConv2D,
                    ZeroPadding2D)
 from .core import (ActivationLayer, CenterLossOutput, CnnLossLayer, Dense,
                    DropoutLayer, ElementWiseMultiplication, Embedding,
-                   EmbeddingSequence, LossLayer, Output, PReLU, RnnOutput)
+                   EmbeddingSequence, LossLayer, Output, PReLU, RnnLossLayer,
+                   RnnOutput)
 from .custom import CustomLayer, Lambda, resolve_function
 from .norm import LRN, BatchNorm, LayerNorm, RMSNorm
 from .pooling import Flatten, GlobalPooling, Reshape
@@ -25,7 +26,7 @@ __all__ = [
     "Frozen", "GRU", "GlobalPooling", "GravesLSTM", "LRN", "LSTM", "Lambda",
     "LastTimeStep",
     "LayerNorm", "LossLayer", "MultiHeadAttention", "Output", "PReLU",
-    "PositionalEmbedding", "RMSNorm", "RecurrentLayer", "Reshape", "RnnOutput",
+    "PositionalEmbedding", "RMSNorm", "RecurrentLayer", "Reshape", "RnnLossLayer", "RnnOutput",
     "SeparableConv2D", "SimpleRnn", "SpaceToBatch", "SpaceToDepth",
     "Subsampling1D", "Subsampling2D", "TransformerEncoderBlock", "Upsampling1D",
     "Upsampling2D", "VAE", "Yolo2Output", "ZeroPadding1D", "ZeroPadding2D",

@@ -271,6 +271,14 @@ class CnnLossLayer(LossLayer):
 
 @register_layer
 @dataclass(frozen=True)
+class RnnLossLayer(LossLayer):
+    """RnnLossLayer.java: per-timestep loss over (B, T, F) with time masking
+    (the param-free counterpart of RnnOutput; input must already be n_out
+    wide — e.g. fed by a recurrent layer with matching hidden size)."""
+
+
+@register_layer
+@dataclass(frozen=True)
 class CenterLossOutput(Output):
     """CenterLossOutputLayer.java: softmax CE + center loss on the input features."""
 

@@ -143,6 +143,128 @@ class ROCScoreCalculator(ScoreCalculator):
         return 1.0 - roc.average_auc()
 
 
+@dataclass
+class RegressionScoreCalculator(ScoreCalculator):
+    """earlystopping/scorecalc/RegressionScoreCalculator.java — a
+    RegressionEvaluation column-averaged metric (MSE/MAE/RMSE/R2 etc.) on a
+    held-out iterator; R2/correlation-style metrics are negated so that
+    'lower is better' holds for every choice."""
+
+    iterator: Any
+    metric: str = "mse"  # mse | mae | rmse | r2 | pearson
+
+    _HIGHER_IS_BETTER = {"r2", "pearson"}
+
+    def score(self, trainer):
+        from ..eval import RegressionEvaluation
+        from ..nn.model import Sequential
+
+        n_out = (trainer.model.output_shape[-1]
+                 if isinstance(trainer.model, Sequential)
+                 else trainer.model.output_shapes[0][-1])
+        ev = trainer.evaluate(self.iterator, evaluation=RegressionEvaluation(n_out))
+        val = float(np.mean([getattr(ev, self.metric)(i) for i in range(ev.n)]))
+        return -val if self.metric in self._HIGHER_IS_BETTER else val
+
+
+def _vae_layer(trainer):
+    """Locate the (single) VAE layer of a Sequential model + its param key."""
+    from ..nn.layers.special import VAE
+    from ..nn.model import _layer_key
+
+    for i, l in enumerate(trainer.model.layers):
+        if isinstance(l, VAE):
+            return l, _layer_key(i, l), i
+    raise ValueError("model has no VAE layer")
+
+
+@dataclass
+class VAEReconErrorScoreCalculator(ScoreCalculator):
+    """scorecalc/VAEReconErrorScoreCalculator.java — deterministic
+    reconstruction error (decoder mean vs input, via the VAE pretrain loss
+    with a fixed rng) on a held-out iterator."""
+
+    iterator: Any
+
+    def score(self, trainer):
+        import jax
+
+        layer, key, idx = _vae_layer(trainer)
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            feats = _features_up_to(trainer, ds, idx)
+            total += float(layer.pretrain_loss(trainer.params[key], feats,
+                                               jax.random.PRNGKey(0)))
+            n += 1
+        _maybe_reset(self.iterator)
+        return total / max(n, 1)
+
+
+@dataclass
+class VAEReconProbScoreCalculator(ScoreCalculator):
+    """scorecalc/VAEReconProbScoreCalculator.java — negative mean
+    importance-sampled reconstruction log-probability (higher prob is better,
+    so negated for loss-style comparison)."""
+
+    iterator: Any
+    num_samples: int = 16
+
+    def score(self, trainer):
+        import jax
+
+        layer, key, idx = _vae_layer(trainer)
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            feats = _features_up_to(trainer, ds, idx)
+            lp = layer.reconstruction_log_probability(
+                trainer.params[key], feats, jax.random.PRNGKey(0),
+                num_samples=self.num_samples)
+            total += float(np.mean(np.asarray(lp)))
+            n += 1
+        _maybe_reset(self.iterator)
+        return -total / max(n, 1)
+
+
+@dataclass
+class AutoencoderScoreCalculator(ScoreCalculator):
+    """scorecalc/AutoencoderScoreCalculator.java — reconstruction loss of a
+    (non-variational) AutoEncoder layer on a held-out iterator."""
+
+    iterator: Any
+
+    def score(self, trainer):
+        from ..nn.layers.special import AutoEncoder
+        from ..nn.model import _layer_key
+
+        for i, l in enumerate(trainer.model.layers):
+            if isinstance(l, AutoEncoder):
+                layer, key, idx = l, _layer_key(i, l), i
+                break
+        else:
+            raise ValueError("model has no AutoEncoder layer")
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            feats = _features_up_to(trainer, ds, idx)
+            total += float(layer.pretrain_loss(trainer.params[key], feats))
+            n += 1
+        _maybe_reset(self.iterator)
+        return total / max(n, 1)
+
+
+def _features_up_to(trainer, ds, layer_index):
+    """Activations feeding layer `layer_index` (identity for layer 0)."""
+    if layer_index == 0:
+        return ds.features
+    feats, _ = trainer.model.forward(trainer.params, trainer.state, ds.features,
+                                     training=False, up_to=layer_index)
+    return feats
+
+
+def _maybe_reset(it):
+    if hasattr(it, "reset"):
+        it.reset()
+
+
 # --- model savers (earlystopping/saver/) ---
 
 class ModelSaver:

@@ -330,3 +330,64 @@ class TestZooLabels:
               .add_output_one_hot("r", 1, 3))
         with pytest.raises(ValueError, match="outside"):
             list(it)
+
+
+class TestExportBasedTraining:
+    """BatchAndExportDataSetsFunction / ExistingMiniBatchDataSetIterator parity."""
+
+    def test_export_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.data import (ArrayIterator, FileDataSetIterator,
+                                             export_batches)
+        rng = np.random.RandomState(0)
+        x = rng.randn(40, 3).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 40)]
+        n = export_batches(ArrayIterator(x, y, 8), str(tmp_path))
+        assert n == 5
+        back = list(FileDataSetIterator(str(tmp_path)))
+        assert len(back) == 5
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(b.features) for b in back]), x)
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(b.labels) for b in back]), y)
+
+    def test_sharded_read_partitions_batches(self, tmp_path):
+        from deeplearning4j_tpu.data import (ArrayIterator, FileDataSetIterator,
+                                             export_batches)
+        x = np.arange(64, dtype=np.float32).reshape(16, 4)
+        y = np.eye(2, dtype=np.float32)[np.arange(16) % 2]
+        export_batches(ArrayIterator(x, y, 4), str(tmp_path))
+        shards = [list(FileDataSetIterator(str(tmp_path), shard=(r, 2)))
+                  for r in range(2)]
+        assert [len(s) for s in shards] == [2, 2]
+        seen = np.concatenate([np.asarray(b.features) for s in shards for b in s])
+        np.testing.assert_array_equal(np.sort(seen.ravel()), np.arange(64.0))
+
+    def test_reexport_removes_stale_files(self, tmp_path):
+        from deeplearning4j_tpu.data import (ArrayIterator, FileDataSetIterator,
+                                             export_batches)
+        x = np.zeros((40, 2), np.float32)
+        y = np.zeros((40, 2), np.float32)
+        assert export_batches(ArrayIterator(x, y, 4), str(tmp_path)) == 10
+        assert export_batches(ArrayIterator(x[:20], y[:20], 4), str(tmp_path)) == 5
+        assert len(FileDataSetIterator(str(tmp_path))) == 5
+
+    def test_extended_prefix_does_not_bleed(self, tmp_path):
+        from deeplearning4j_tpu.data import (ArrayIterator, FileDataSetIterator,
+                                             export_batches)
+        x = np.zeros((8, 2), np.float32)
+        y = np.zeros((8, 2), np.float32)
+        export_batches(ArrayIterator(x, y, 4), str(tmp_path), prefix="dataset")
+        export_batches(ArrayIterator(x, y, 2), str(tmp_path), prefix="dataset_val")
+        assert len(FileDataSetIterator(str(tmp_path), prefix="dataset")) == 2
+        assert len(FileDataSetIterator(str(tmp_path), prefix="dataset_val")) == 4
+
+    def test_masks_preserved(self, tmp_path):
+        from deeplearning4j_tpu.data import (DataSet, FileDataSetIterator,
+                                             export_batches)
+        ds = DataSet(np.ones((2, 3, 4), np.float32), np.ones((2, 3, 2), np.float32),
+                     np.array([[1, 1, 0], [1, 0, 0]], np.float32),
+                     np.array([[1, 0, 0], [1, 1, 0]], np.float32))
+        export_batches([ds], str(tmp_path))
+        back = list(FileDataSetIterator(str(tmp_path)))[0]
+        np.testing.assert_array_equal(back.features_mask, ds.features_mask)
+        np.testing.assert_array_equal(back.labels_mask, ds.labels_mask)

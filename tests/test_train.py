@@ -235,6 +235,59 @@ class TestEarlyStopping:
         assert res.total_epochs == 3
         assert res.best_epoch >= 0
 
+    def test_regression_score_calculator(self):
+        from deeplearning4j_tpu.train.earlystopping import RegressionScoreCalculator
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 4).astype(np.float32)
+        w = rng.randn(4, 2).astype(np.float32)
+        y = x @ w
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                            "learning_rate": 5e-2}))
+               .input_shape(4)
+               .layer(L.Dense(n_out=2, activation="identity"))
+               .layer(L.LossLayer(loss="mse")).build())
+        tr = Trainer(net)
+        calc = RegressionScoreCalculator(ArrayIterator(x, y, 32), metric="mse")
+        before = calc.score(tr)
+        tr.fit(ArrayIterator(x, y, 32), epochs=30)
+        after = calc.score(tr)
+        assert after < before * 0.2
+        # r2 is negated (higher-is-better metric in loss-style orientation)
+        r2 = RegressionScoreCalculator(ArrayIterator(x, y, 32), metric="r2")
+        assert r2.score(tr) < -0.5
+
+    def test_autoencoder_score_calculator(self):
+        from deeplearning4j_tpu.train.earlystopping import AutoencoderScoreCalculator
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 8).astype(np.float32)
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                            "learning_rate": 1e-2}))
+               .input_shape(8)
+               .layer(L.AutoEncoder(n_out=4)).build())
+        tr = Trainer(net)
+        calc = AutoencoderScoreCalculator(ArrayIterator(x, x, 32))
+        s = calc.score(tr)
+        assert np.isfinite(s) and s > 0
+
+    def test_vae_score_calculators(self):
+        from deeplearning4j_tpu.train.earlystopping import (
+            VAEReconErrorScoreCalculator, VAEReconProbScoreCalculator)
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 6).astype(np.float32)
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                            "learning_rate": 1e-2}))
+               .input_shape(6)
+               .layer(L.VAE(n_out=3, encoder_sizes=(8,), decoder_sizes=(8,)))
+               .build())
+        tr = Trainer(net)
+        err = VAEReconErrorScoreCalculator(ArrayIterator(x, x, 16)).score(tr)
+        prob = VAEReconProbScoreCalculator(ArrayIterator(x, x, 16),
+                                           num_samples=4).score(tr)
+        assert np.isfinite(err) and np.isfinite(prob)
+
     def test_score_improvement_stops(self, iris):
         x, y = iris
         # lr=0 -> no improvement -> should stop after patience
