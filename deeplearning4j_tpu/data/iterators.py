@@ -270,7 +270,15 @@ class FileDataSetIterator(DataSetIterator):
     def __init__(self, directory: str, prefix: str = "dataset",
                  shuffle: bool = False, seed: int = 0,
                  shard: Optional[Tuple[int, int]] = None):
+        import os
+
+        if not os.path.isdir(directory):
+            raise FileNotFoundError(f"export directory does not exist: {directory}")
         self.files = _batch_files(directory, prefix)
+        if not self.files:  # before shard striping — an empty *shard* is legal
+            raise ValueError(
+                f"no exported batches matching '{prefix}_NNNNNN.npz' in "
+                f"{directory} — check the prefix or run export_batches first")
         if shard is not None:
             rank, world = shard
             self.files = self.files[rank::world]

@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -187,17 +188,22 @@ class VAEReconErrorScoreCalculator(ScoreCalculator):
     iterator: Any
 
     def score(self, trainer):
-        import jax
-
         layer, key, idx = _vae_layer(trainer)
+        loss_fn = self._jitted(layer, lambda p, feats: layer.pretrain_loss(
+            p, feats, jax.random.PRNGKey(0)))
         total, n = 0.0, 0
         for ds in self.iterator:
             feats = _features_up_to(trainer, ds, idx)
-            total += float(layer.pretrain_loss(trainer.params[key], feats,
-                                               jax.random.PRNGKey(0)))
+            total += float(loss_fn(trainer.params[key], feats))
             n += 1
         _maybe_reset(self.iterator)
         return total / max(n, 1)
+
+    def _jitted(self, layer, fn):
+        cached = getattr(self, "_loss_cache", None)
+        if cached is None or cached[0] is not layer:
+            self._loss_cache = (layer, jax.jit(fn))
+        return self._loss_cache[1]
 
 
 @dataclass
@@ -210,16 +216,16 @@ class VAEReconProbScoreCalculator(ScoreCalculator):
     num_samples: int = 16
 
     def score(self, trainer):
-        import jax
-
         layer, key, idx = _vae_layer(trainer)
+        lp_fn = VAEReconErrorScoreCalculator._jitted(
+            self, layer, lambda p, feats: jnp.mean(
+                layer.reconstruction_log_probability(
+                    p, feats, jax.random.PRNGKey(0),
+                    num_samples=self.num_samples)))
         total, n = 0.0, 0
         for ds in self.iterator:
             feats = _features_up_to(trainer, ds, idx)
-            lp = layer.reconstruction_log_probability(
-                trainer.params[key], feats, jax.random.PRNGKey(0),
-                num_samples=self.num_samples)
-            total += float(np.mean(np.asarray(lp)))
+            total += float(lp_fn(trainer.params[key], feats))
             n += 1
         _maybe_reset(self.iterator)
         return -total / max(n, 1)
@@ -242,22 +248,38 @@ class AutoencoderScoreCalculator(ScoreCalculator):
                 break
         else:
             raise ValueError("model has no AutoEncoder layer")
+        loss_fn = VAEReconErrorScoreCalculator._jitted(
+            self, layer, lambda p, feats: layer.pretrain_loss(p, feats))
         total, n = 0.0, 0
         for ds in self.iterator:
             feats = _features_up_to(trainer, ds, idx)
-            total += float(layer.pretrain_loss(trainer.params[key], feats))
+            total += float(loss_fn(trainer.params[key], feats))
             n += 1
         _maybe_reset(self.iterator)
         return total / max(n, 1)
 
 
 def _features_up_to(trainer, ds, layer_index):
-    """Activations feeding layer `layer_index` (identity for layer 0)."""
+    """Activations feeding layer `layer_index` (identity for layer 0).
+    Jitted and cached per (trainer, layer) so a held-out scoring pass is one
+    compiled dispatch per batch, not an eager op-by-op walk of the prefix."""
     if layer_index == 0:
         return ds.features
-    feats, _ = trainer.model.forward(trainer.params, trainer.state, ds.features,
-                                     training=False, up_to=layer_index)
-    return feats
+    cache = getattr(trainer, "_es_feature_fns", None)
+    if cache is None:
+        cache = trainer._es_feature_fns = {}
+    fn = cache.get(layer_index)
+    if fn is None:
+        model = trainer.model
+
+        @jax.jit
+        def fn(params, state, x):
+            feats, _ = model.forward(params, state, x, training=False,
+                                     up_to=layer_index)
+            return feats
+
+        cache[layer_index] = fn
+    return fn(trainer.params, trainer.state, ds.features)
 
 
 def _maybe_reset(it):

@@ -391,3 +391,13 @@ class TestExportBasedTraining:
         back = list(FileDataSetIterator(str(tmp_path)))[0]
         np.testing.assert_array_equal(back.features_mask, ds.features_mask)
         np.testing.assert_array_equal(back.labels_mask, ds.labels_mask)
+
+    def test_missing_directory_raises(self, tmp_path):
+        from deeplearning4j_tpu.data import FileDataSetIterator
+        with pytest.raises(FileNotFoundError):
+            FileDataSetIterator(str(tmp_path / "nope"))
+
+    def test_empty_directory_raises(self, tmp_path):
+        from deeplearning4j_tpu.data import FileDataSetIterator
+        with pytest.raises(ValueError, match="no exported batches"):
+            FileDataSetIterator(str(tmp_path))
