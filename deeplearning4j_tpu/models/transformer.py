@@ -74,13 +74,15 @@ class CausalLM(ZooModel):
     input_shape = (256,)
 
     def __init__(self, num_classes=None, seed=12345, input_shape=None, *,
-                 num_layers=None, d_model=None, num_heads=None, vocab=None, **kw):
+                 num_layers=None, d_model=None, num_heads=None, vocab=None,
+                 flash=False, **kw):
         super().__init__(num_classes, seed, input_shape, **kw)
         self.num_layers = num_layers or self.num_layers
         self.d_model = d_model or self.d_model
         self.num_heads = num_heads or self.num_heads
         self.vocab = vocab or self.vocab
         self.num_classes = self.vocab
+        self.flash = flash
 
     def build(self) -> Sequential:
         T = self.input_shape[0]
@@ -90,7 +92,8 @@ class CausalLM(ZooModel):
              .layer(L.EmbeddingSequence(n_in=self.vocab, n_out=self.d_model))
              .layer(L.PositionalEmbedding(max_len=max(T, 512))))
         for _ in range(self.num_layers):
-            b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=True))
+            b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=True,
+                                              flash=self.flash))
         b.layer(L.LayerNorm())
         b.layer(L.RnnOutput(n_out=self.vocab, activation="softmax", loss="mcxent"))
         return b.build()

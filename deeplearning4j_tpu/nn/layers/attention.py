@@ -108,6 +108,7 @@ class TransformerEncoderBlock(Layer):
     activation: str = "gelu"
     causal: bool = False
     dropout_rate: float = 0.0
+    flash: bool = False  # route self-attention through the Pallas kernel
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
@@ -132,7 +133,8 @@ class TransformerEncoderBlock(Layer):
         return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
-        mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal)
+        mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal,
+                                 flash=self.flash)
         h = self._ln(x, params["ln1_g"], params["ln1_b"])
         a, _, _ = mha.apply(params["attn"], {}, h, training=training, rng=rng, mask=mask)
         x = x + a

@@ -87,18 +87,36 @@ def mae(p, y, mask=None, weights=None):
     return _reduce(jnp.abs(p - y), mask, weights)
 
 
+def _sparse_nll(logp, y, mask, weights):
+    """Integer class-index labels: gather the target log-prob instead of a
+    one-hot product — for large vocabularies (LM heads) this avoids ever
+    materializing a (B, T, V) one-hot tensor."""
+    nll = -jnp.take_along_axis(logp, y[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if weights is not None:
+        nll = nll * jnp.take_along_axis(
+            jnp.broadcast_to(weights, logp.shape), y[..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+    return _reduce(nll[..., None], mask, None)
+
+
 @register("mcxent")
 @register("negativeloglikelihood")
 def mcxent(p, y, mask=None, weights=None):
-    """Multi-class cross-entropy on probabilities (post-softmax)."""
+    """Multi-class cross-entropy on probabilities (post-softmax).
+    Integer-dtype ``y`` is treated as sparse class indices."""
+    if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer):
+        return _sparse_nll(jnp.log(jnp.clip(p, _EPS, 1.0)), y, mask, weights)
     return _reduce(-y * jnp.log(jnp.clip(p, _EPS, 1.0)), mask, weights)
 
 
 @register("mcxent_logits")
 @register("softmax_cross_entropy_logits")
 def mcxent_logits(logits, y, mask=None, weights=None):
-    """Fused softmax+CE on raw logits — numerically stable, XLA-fused."""
+    """Fused softmax+CE on raw logits — numerically stable, XLA-fused.
+    Integer-dtype ``y`` is treated as sparse class indices."""
     logp = jax.nn.log_softmax(logits, axis=-1)
+    if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer):
+        return _sparse_nll(logp, y, mask, weights)
     return _reduce(-y * logp, mask, weights)
 
 

@@ -26,31 +26,60 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import SEQ_AXIS
 
 
-def _block_attend(q, k, v, *, scale, q_pos, k_pos, causal, m, l, o):
+def _block_attend(q, k, v, *, scale, q_pos, k_pos, causal, m, l, o,
+                  k_chunk: int = 1024):
     """One block of online-softmax attention accumulation.
 
     q: (B, Tq, H, D); k/v: (B, Tk, H, D); m/l running max/denominator
     (B, H, Tq); o running unnormalized output (B, Tq, H, D).
+
+    The key dimension is processed in ``k_chunk`` slices via an inner
+    ``lax.scan`` (differentiable), so peak score memory is
+    O(B·H·Tq·k_chunk) instead of O(B·H·Tq·Tk) — this is what lets a ring
+    device hold long local blocks without materializing a quadratic tile.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    if causal:
-        mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
-        s = jnp.where(mask, s, -jnp.inf)
-    m_block = jnp.max(s, axis=-1)
-    m_new = jnp.maximum(m, m_block)
-    # guard fully-masked rows (all -inf)
-    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    p = jnp.exp(s - m_safe[..., None])
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-    l_new = l * alpha + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
-    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
-    return m_new, l_new, o_new
+    B, Tk, H, D = k.shape
+
+    def chunk_step(m, l, o, k_c, v_c, kp_c):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_c,
+                       preferred_element_type=jnp.float32) * scale
+        keep = jnp.broadcast_to((kp_c >= 0)[None, None, None, :], s.shape)
+        if causal:
+            keep = keep & (kp_c[None, None, None, :] <= q_pos[None, None, :, None])
+        s = jnp.where(keep, s, -jnp.inf)
+        m_block = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_block)
+        # guard fully-masked rows (all -inf)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v_c)
+        o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+        return m_new, l_new, o_new
+
+    if Tk <= k_chunk:
+        return chunk_step(m, l, o, k, v, k_pos)
+    n_chunks = -(-Tk // k_chunk)
+    pad = n_chunks * k_chunk - Tk
+    if pad:  # padded keys get position -1: masked out by the keep guard
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        k_pos = jnp.pad(k_pos, (0, pad), constant_values=-1)
+    ks = k.reshape(B, n_chunks, k_chunk, H, D).transpose(1, 0, 2, 3, 4)
+    vs = v.reshape(B, n_chunks, k_chunk, H, D).transpose(1, 0, 2, 3, 4)
+    kps = k_pos.reshape(n_chunks, k_chunk)
+
+    def scan_body(carry, xs):
+        return (chunk_step(*carry, *xs), None)
+
+    (m, l, o), _ = lax.scan(scan_body, (m, l, o), (ks, vs, kps))
+    return m, l, o
 
 
 def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = False,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None, k_chunk: int = 1024):
     """Per-device body (call inside shard_map over ``axis_name``).
 
     q, k, v: (B, T_local, H, D) — this device's sequence block.
@@ -77,7 +106,8 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = F
         src = (idx - step) % n  # which block's K/V we hold this step
         k_pos = src * T + jnp.arange(T)
         m, l, o = _block_attend(q, k_cur, v_cur, scale=scale, q_pos=q_pos,
-                                k_pos=k_pos, causal=causal, m=m, l=l, o=o)
+                                k_pos=k_pos, causal=causal, m=m, l=l, o=o,
+                                k_chunk=k_chunk)
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return (m, l, o, k_next, v_next), None
@@ -88,14 +118,15 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = F
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
-                   seq_axis: str = SEQ_AXIS):
+                   seq_axis: str = SEQ_AXIS, k_chunk: int = 1024):
     """Convenience wrapper: (B, T, H, D) global arrays -> sharded ring attention.
 
     T must divide by mesh.shape[seq_axis]. Batch stays replicated here; compose
     with a data axis by sharding B outside.
     """
     fn = jax.shard_map(
-        partial(ring_attention_local, axis_name=seq_axis, causal=causal),
+        partial(ring_attention_local, axis_name=seq_axis, causal=causal,
+                k_chunk=k_chunk),
         mesh=mesh,
         in_specs=(P(None, seq_axis, None, None),) * 3,
         out_specs=P(None, seq_axis, None, None))

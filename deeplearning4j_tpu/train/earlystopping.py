@@ -100,6 +100,15 @@ class ScoreCalculator:
     def score(self, trainer) -> float:
         raise NotImplementedError
 
+    def _jitted(self, layer, fn):
+        """Per-calculator jit cache keyed on the layer identity (used by the
+        reconstruction-loss calculators so a scoring pass is one compiled
+        dispatch per batch)."""
+        cached = getattr(self, "_loss_cache", None)
+        if cached is None or cached[0] is not layer:
+            self._loss_cache = (layer, jax.jit(fn))
+        return self._loss_cache[1]
+
 
 @dataclass
 class DataSetLossCalculator(ScoreCalculator):
@@ -199,12 +208,6 @@ class VAEReconErrorScoreCalculator(ScoreCalculator):
         _maybe_reset(self.iterator)
         return total / max(n, 1)
 
-    def _jitted(self, layer, fn):
-        cached = getattr(self, "_loss_cache", None)
-        if cached is None or cached[0] is not layer:
-            self._loss_cache = (layer, jax.jit(fn))
-        return self._loss_cache[1]
-
 
 @dataclass
 class VAEReconProbScoreCalculator(ScoreCalculator):
@@ -217,8 +220,8 @@ class VAEReconProbScoreCalculator(ScoreCalculator):
 
     def score(self, trainer):
         layer, key, idx = _vae_layer(trainer)
-        lp_fn = VAEReconErrorScoreCalculator._jitted(
-            self, layer, lambda p, feats: jnp.mean(
+        lp_fn = self._jitted(
+            layer, lambda p, feats: jnp.mean(
                 layer.reconstruction_log_probability(
                     p, feats, jax.random.PRNGKey(0),
                     num_samples=self.num_samples)))
@@ -248,8 +251,8 @@ class AutoencoderScoreCalculator(ScoreCalculator):
                 break
         else:
             raise ValueError("model has no AutoEncoder layer")
-        loss_fn = VAEReconErrorScoreCalculator._jitted(
-            self, layer, lambda p, feats: layer.pretrain_loss(p, feats))
+        loss_fn = self._jitted(
+            layer, lambda p, feats: layer.pretrain_loss(p, feats))
         total, n = 0.0, 0
         for ds in self.iterator:
             feats = _features_up_to(trainer, ds, idx)

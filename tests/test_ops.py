@@ -90,6 +90,22 @@ class TestLosses:
         b = losses.get("mcxent_logits")(logits, y)
         np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
 
+    def test_sparse_integer_labels_match_onehot(self):
+        """Integer class-index labels (the large-vocab LM path — no one-hot
+        ever materialized) must give identical losses to one-hot labels,
+        with and without a time mask."""
+        logits = jax.random.normal(jax.random.PRNGKey(1), (4, 7, 11))
+        idx = jax.random.randint(jax.random.PRNGKey(2), (4, 7), 0, 11)
+        onehot = jax.nn.one_hot(idx, 11)
+        mask = jnp.array([[1, 1, 1, 0, 0, 0, 0]] * 4, jnp.float32)
+        for name, pred in (("mcxent_logits", logits),
+                           ("mcxent", jax.nn.softmax(logits))):
+            fn = losses.get(name)
+            np.testing.assert_allclose(float(fn(pred, idx)),
+                                       float(fn(pred, onehot)), rtol=1e-5)
+            np.testing.assert_allclose(float(fn(pred, idx, mask=mask)),
+                                       float(fn(pred, onehot, mask=mask)), rtol=1e-5)
+
     def test_xent_logits_stable(self):
         logits = jnp.array([[100.0, -100.0]])
         y = jnp.array([[1.0, 0.0]])

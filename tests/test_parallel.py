@@ -112,6 +112,24 @@ class TestRingAttention:
         ref_g = jax.grad(lambda q: jnp.sum(jnp.square(reference_attention(q, q, q, causal=True))))(q)
         np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g), rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_chunked_keys_match_dense(self, causal):
+        """k_chunk < T_local forces the inner key-chunk scan (the bounded-
+        memory path for long local blocks), including a ragged tail chunk —
+        must stay exact vs dense, values and gradients."""
+        mesh = cpu_test_mesh(2, {SEQ_AXIS: 2})
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q, k, v = (jax.random.normal(kk, (2, 24, 2, 8)) for kk in ks)
+        out = ring_attention(q, k, v, mesh, causal=causal, k_chunk=5)  # 12 -> 5,5,2
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+        g = jax.grad(lambda q: jnp.sum(jnp.square(
+            ring_attention(q, q, q, mesh, causal=causal, k_chunk=5))))(q)
+        ref_g = jax.grad(lambda q: jnp.sum(jnp.square(
+            reference_attention(q, q, q, causal=causal))))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g), rtol=1e-3, atol=1e-4)
+
 
 class TestTensorParallel:
     def test_sharded_transformer_matches_replicated(self):
