@@ -12,6 +12,7 @@ from .core import (ActivationLayer, CenterLossOutput, CnnLossLayer, Dense,
                    EmbeddingSequence, LossLayer, Output, PReLU, RnnLossLayer,
                    RnnOutput)
 from .custom import CustomLayer, Lambda, resolve_function
+from .moe import MoE, MoETransformerBlock
 from .norm import LRN, BatchNorm, LayerNorm, RMSNorm
 from .pooling import Flatten, GlobalPooling, Reshape
 from .recurrent import (GRU, LSTM, Bidirectional, GravesLSTM, LastTimeStep,
@@ -25,7 +26,8 @@ __all__ = [
     "ElementWiseMultiplication", "Embedding", "EmbeddingSequence", "Flatten",
     "Frozen", "GRU", "GlobalPooling", "GravesLSTM", "LRN", "LSTM", "Lambda",
     "LastTimeStep",
-    "LayerNorm", "LossLayer", "MultiHeadAttention", "Output", "PReLU",
+    "LayerNorm", "LossLayer", "MoE", "MoETransformerBlock",
+    "MultiHeadAttention", "Output", "PReLU",
     "PositionalEmbedding", "RMSNorm", "RecurrentLayer", "Reshape", "RnnLossLayer", "RnnOutput",
     "SeparableConv2D", "SimpleRnn", "SpaceToBatch", "SpaceToDepth",
     "Subsampling1D", "Subsampling2D", "TransformerEncoderBlock", "Upsampling1D",

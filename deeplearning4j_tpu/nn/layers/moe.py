@@ -1,0 +1,185 @@
+"""Mixture-of-Experts FFN — expert parallelism ("ep") for the transformer
+family.
+
+No reference equivalent (DL4J 0.9 predates MoE); included because expert
+parallelism is a first-class TPU scaling axis alongside dp/tp/sp/pp. The
+design is the GShard/Switch capacity-dispatch formulation, which maps onto
+the MXU as three batched einsums instead of per-token gathers:
+
+    dispatch (N, E, C)   one-hot token->slot assignment (top-k, capacity C)
+    x_e      (E, C, D) = einsum(dispatch, x)           # all-to-all under ep
+    h_e      (E, C, H) = act(x_e @ w_up[e])            # batched expert FFN
+    y_e      (E, C, D) = h_e @ w_down[e]
+    y        (N, D)    = einsum(combine, y_e)          # all-to-all back
+
+Expert weights carry a leading E axis; sharding that axis over the mesh's
+``expert`` (or ``model``) axis makes XLA insert the all-to-alls — that IS
+expert parallelism under GSPMD (see ``models/transformer.py`` rules and
+``__graft_entry__.dryrun_multichip``).
+
+The GShard load-balancing auxiliary loss is returned through the layer's
+``state`` under ``"aux_loss"``; ``Sequential.score``/``Graph.score`` add any
+such entries to the training loss (zero at inference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import activations, initializers
+from ..api import Layer, Shape, register_layer
+
+
+@register_layer
+@dataclass(frozen=True)
+class MoE(Layer):
+    """Top-k routed mixture-of-experts FFN block: (…, D) -> (…, D)."""
+
+    num_experts: int = 8
+    top_k: int = 2
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    activation: str = "gelu"
+    aux_loss_weight: float = 1e-2
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        return input_shape
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        d = input_shape[-1]
+        h = d * self.mlp_ratio
+        e = self.num_experts
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {
+            "w_router": initializers.init_param(k1, "xavier", (d, e), dtype=dtype),
+            "w_up": initializers.init_param(k2, "xavier", (e, d, h), dtype=dtype),
+            "b_up": jnp.zeros((e, h), dtype),
+            "w_down": initializers.init_param(k3, "xavier", (e, h, d), dtype=dtype),
+            "b_down": jnp.zeros((e, d), dtype),
+        }, {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        orig_shape = x.shape
+        d = x.shape[-1]
+        xf = x.reshape(-1, d)                          # (N, D) token view
+        n = xf.shape[0]
+        e, k = self.num_experts, min(self.top_k, self.num_experts)
+        cap = max(1, int(self.capacity_factor * n * k / e))
+
+        # padding tokens ((B, T) mask) neither route (no capacity consumed,
+        # their output is zero) nor count toward the load-balance statistics
+        valid_tok = None
+        if mask is not None and mask.ndim == len(orig_shape) - 1:
+            valid_tok = mask.reshape(-1).astype(jnp.float32)     # (N,)
+
+        logits = (xf @ params["w_router"]).astype(jnp.float32)   # (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)            # (N, K)
+        if k > 1:  # renormalize the selected gates
+            gate_vals = gate_vals / jnp.maximum(
+                jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+        if valid_tok is not None:
+            gate_vals = gate_vals * valid_tok[:, None]
+
+        # capacity-aware slot assignment: slot k=0 has priority; a token past
+        # an expert's capacity is dropped (its gate weight contributes 0 and
+        # the residual connection outside the layer carries it through)
+        combine = jnp.zeros((n, e, cap), jnp.float32)
+        dispatch = jnp.zeros((n, e, cap), jnp.float32)
+        counts = jnp.zeros((e,), jnp.int32)
+        for slot in range(k):
+            onehot_e = jax.nn.one_hot(gate_idx[:, slot], e, dtype=jnp.int32)
+            if valid_tok is not None:  # pads take no expert slot
+                onehot_e = onehot_e * valid_tok[:, None].astype(jnp.int32)
+            pos = jnp.cumsum(onehot_e, axis=0) - onehot_e + counts[None, :]
+            pos_tok = jnp.sum(pos * onehot_e, axis=1)            # (N,)
+            keep = pos_tok < cap
+            oh_cap = jax.nn.one_hot(pos_tok, cap, dtype=jnp.float32)
+            d_slot = (onehot_e.astype(jnp.float32)[:, :, None] * oh_cap[:, None, :]
+                      * keep[:, None, None].astype(jnp.float32))
+            dispatch = dispatch + d_slot
+            combine = combine + d_slot * gate_vals[:, slot][:, None, None]
+            counts = counts + jnp.sum(onehot_e, axis=0)
+
+        cdt = x.dtype
+        x_e = jnp.einsum("nec,nd->ecd", dispatch.astype(cdt), xf)
+        h = activations.get(self.activation)(
+            jnp.einsum("ecd,edh->ech", x_e, params["w_up"])
+            + params["b_up"][:, None, :])
+        y_e = jnp.einsum("ech,ehd->ecd", h, params["w_down"]) + params["b_down"][:, None, :]
+        y = jnp.einsum("nec,ecd->nd", combine.astype(cdt), y_e)
+
+        # GShard load-balancing loss: E * sum_e f_e * P_e over top-1 routing
+        # (statistics over REAL tokens only when a padding mask is present)
+        if training:
+            top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+            if valid_tok is None:
+                f_e = jnp.mean(top1, axis=0)
+                p_e = jnp.mean(probs, axis=0)
+            else:
+                denom = jnp.maximum(jnp.sum(valid_tok), 1.0)
+                f_e = jnp.sum(top1 * valid_tok[:, None], axis=0) / denom
+                p_e = jnp.sum(probs * valid_tok[:, None], axis=0) / denom
+            aux = self.aux_loss_weight * e * jnp.sum(f_e * p_e)
+        else:
+            aux = jnp.zeros((), jnp.float32)
+        return y.reshape(orig_shape), {"aux_loss": aux}, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class MoETransformerBlock(Layer):
+    """Pre-LN transformer block with an MoE FFN: LN -> MHA -> +res ->
+    LN -> MoE -> +res (the Switch-Transformer layer shape)."""
+
+    num_heads: int = 8
+    num_experts: int = 8
+    top_k: int = 2
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    activation: str = "gelu"
+    causal: bool = False
+    flash: bool = False
+    aux_loss_weight: float = 1e-2
+
+    def _parts(self):
+        from .attention import MultiHeadAttention
+
+        mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal,
+                                 flash=self.flash)
+        moe = MoE(num_experts=self.num_experts, top_k=self.top_k,
+                  mlp_ratio=self.mlp_ratio, capacity_factor=self.capacity_factor,
+                  activation=self.activation, aux_loss_weight=self.aux_loss_weight)
+        return mha, moe
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        d = input_shape[-1]
+        k1, k2 = jax.random.split(key)
+        mha, moe = self._parts()
+        attn_params, _ = mha.init(k1, input_shape, dtype)
+        moe_params, moe_state = moe.init(k2, input_shape, dtype)
+        return {
+            "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
+            "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
+            "attn": attn_params, "moe": moe_params,
+        }, moe_state
+
+    @staticmethod
+    def _ln(x, g, b, eps=1e-6):
+        from .attention import TransformerEncoderBlock
+
+        return TransformerEncoderBlock._ln(x, g, b, eps)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        mha, moe = self._parts()
+        h = self._ln(x, params["ln1_g"], params["ln1_b"])
+        a, _, _ = mha.apply(params["attn"], {}, h, training=training, rng=rng,
+                            mask=mask)
+        x = x + a
+        h = self._ln(x, params["ln2_g"], params["ln2_b"])
+        m, moe_state, _ = moe.apply(params["moe"], state, h, training=training,
+                                    rng=rng, mask=mask)
+        return x + m, moe_state, mask

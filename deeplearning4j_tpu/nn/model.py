@@ -83,6 +83,17 @@ class NetConfig:
         return cls(**d)
 
 
+def _collect_aux_losses(new_state):
+    """Sum per-layer auxiliary training losses surfaced through layer state
+    (e.g. the MoE load-balancing loss, ``state["aux_loss"]``). Zero when no
+    layer contributes."""
+    total = jnp.asarray(0.0, jnp.float32)
+    for s in new_state.values():
+        if isinstance(s, dict) and "aux_loss" in s:
+            total = total + jnp.asarray(s["aux_loss"], jnp.float32)
+    return total
+
+
 def _layer_key(i: int, layer: Layer) -> str:
     return layer.name or f"layer_{i}"
 
@@ -189,6 +200,7 @@ class Sequential:
         # L1/L2 regularization score term (BaseOptimizer scoring parity) is
         # applied through the updater (optax add_decayed_weights), not here —
         # DL4J adds it to the reported score; we report pure data loss.
+        loss = loss + _collect_aux_losses(new_state)
         return loss, new_state
 
     # --- inference (output :2006) ---
@@ -256,6 +268,7 @@ class Sequential:
         k = _layer_key(n - 1, out_layer)
         loss = out_layer.score(params.get(k, {}), state.get(k, {}), h, labels,
                                mask=label_mask if label_mask is not None else m)
+        loss = loss + _collect_aux_losses(new_state)
         return loss, new_state, new_carries
 
     # --- serde (MultiLayerConfiguration.toJson/fromJson) ---
@@ -489,6 +502,7 @@ class Graph:
             acts[name], act_masks[name] = y, m_out
             if s_out:
                 new_state[name] = s_out
+        total = total + _collect_aux_losses(new_state)
         return total, new_state
 
     def output(self, inputs, params=None, state=None, masks=None) -> List[Array]:

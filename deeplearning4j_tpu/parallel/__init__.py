@@ -13,6 +13,8 @@ from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
                    shard_batch)
 from .multihost import (MultiHostTrainer, ProcessShardIterator,
                         initialize_multihost)
+from .pipeline import (from_microbatches, pipeline_apply,
+                       stack_stage_params, to_microbatches)
 from .ring_attention import (reference_attention, ring_attention,
                              ring_attention_local)
 from .sharding import (CNN_RULES, TRANSFORMER_RULES, constrain_activations,
@@ -24,7 +26,9 @@ __all__ = ["CNN_RULES", "DATA_AXIS", "EXPERT_AXIS", "EncodedGradientsAccumulator
            "ParallelWrapper", "ProcessShardIterator", "initialize_multihost",
            "SEQ_AXIS", "SparseUpdate", "TRANSFORMER_RULES", "bitmap_decode",
            "bitmap_encode", "constrain_activations", "cpu_test_mesh",
-           "distributed_init", "make_mesh", "reference_attention", "replicate",
+           "distributed_init", "from_microbatches", "make_mesh", "pipeline_apply",
+           "reference_attention", "replicate", "stack_stage_params",
+           "to_microbatches",
            "ring_attention", "ring_attention_local", "shard_batch",
            "shard_params", "sharding_tree", "threshold_decode",
            "threshold_encode"]

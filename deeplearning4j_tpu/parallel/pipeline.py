@@ -1,0 +1,96 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over a ``pipe``
+mesh axis.
+
+Absent from the reference (DL4J 0.9 is data-parallel only — SURVEY.md §2.4
+item 5); first-class here because pp is one of the five TPU scaling axes
+(dp/tp/sp/ep/pp). Design:
+
+- The pipelined body must be a stack of UNIFORM stages: each stage maps an
+  activation of shape ``(mb, ...)`` to the same shape (transformer blocks are
+  the canonical case). Embedding/head layers run outside the pipeline,
+  replicated or sharded by other axes.
+- Each device holds ONE stage's parameters (the stacked parameter pytree is
+  sharded on its leading stage axis by ``shard_map``). Microbatches stream
+  through a ``lax.scan`` of ticks; activations hop stages via
+  ``lax.ppermute``. After ``M + S - 1`` ticks every microbatch has crossed
+  all ``S`` stages — the classic GPipe bubble of ``(S-1)/(M+S-1)``.
+- Everything is differentiable: the backward pass is autodiff through the
+  scan + ppermute (XLA schedules the reverse hops), so a pipelined train
+  step is just ``jax.grad`` over this function — no hand-written 1F1B
+  needed for correctness. Bubbles compute on zero-initialized buffers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import PIPE_AXIS
+
+
+def stack_stage_params(params_list: Sequence):
+    """Stack S structurally-identical per-stage param pytrees along a new
+    leading stage axis (the axis ``pipeline_apply`` shards over)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
+                   mesh: Mesh, *, axis_name: str = PIPE_AXIS):
+    """Run ``microbatches`` (M, mb, ...) through S pipelined stages.
+
+    ``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape``;
+    ``stacked_params`` has a leading S axis on every leaf. Returns the last
+    stage's outputs, shape (M, mb, ...), replicated across the pipe axis.
+    """
+    S = mesh.shape[axis_name]
+    M = microbatches.shape[0]
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stacked_params leading axis {leaf.shape[0]} != pipe axis "
+                f"size {S} — one stage per device (a larger multiple would "
+                f"silently drop stages)")
+
+    def local(params_blk, mbs):
+        me = jax.tree.map(lambda a: a[0], params_blk)  # this stage's params
+        s = lax.axis_index(axis_name)
+        first, last = s == 0, s == S - 1
+        vary = lambda a: lax.pcast(a, axis_name, to="varying")
+        buf0 = vary(jnp.zeros_like(mbs[0]))
+        out0 = vary(jnp.zeros_like(mbs))
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(carry, t):
+            buf, outs = carry
+            x_in = jnp.where(first, mbs[jnp.clip(t, 0, M - 1)], buf)
+            y = stage_fn(me, x_in)
+            buf_next = lax.ppermute(y, axis_name, perm) if S > 1 else y
+            oi = t - (S - 1)
+            upd = lax.dynamic_update_slice(
+                outs, y[None], (jnp.clip(oi, 0, M - 1),) + (0,) * y.ndim)
+            outs = jnp.where(last & (oi >= 0), upd, outs)
+            return (buf_next, outs), None
+
+        (_, outs), _ = lax.scan(tick, (buf0, out0), jnp.arange(M + S - 1))
+        # replicate the last stage's result across the pipe axis
+        return lax.psum(jnp.where(last, outs, jnp.zeros_like(outs)), axis_name)
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(P(axis_name), P()), out_specs=P())
+    return fn(stacked_params, microbatches)
+
+
+def to_microbatches(x, num_microbatches: int):
+    """(B, ...) -> (M, B/M, ...)."""
+    B = x.shape[0]
+    assert B % num_microbatches == 0, (B, num_microbatches)
+    return x.reshape((num_microbatches, B // num_microbatches) + x.shape[1:])
+
+
+def from_microbatches(x):
+    return x.reshape((-1,) + x.shape[2:])

@@ -1,0 +1,109 @@
+"""Pipeline parallelism (GPipe schedule) — pipelined == sequential
+equivalence on the virtual mesh (the distributed==single oracle,
+SURVEY.md §4), values and gradients, plus a full pipelined train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.parallel import (PIPE_AXIS, from_microbatches,
+                                         make_mesh, pipeline_apply,
+                                         stack_stage_params, to_microbatches)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _blocks(S, d=16, heads=2):
+    blk = L.TransformerEncoderBlock(num_heads=heads, causal=True)
+    keys = jax.random.split(KEY, S)
+    plist = [blk.init(k, (8, d))[0] for k in keys]
+
+    def stage_fn(p, h):
+        y, _, _ = blk.apply(p, {}, h, training=False)
+        return y
+
+    return blk, plist, stage_fn
+
+
+@pytest.mark.parametrize("S,M", [(2, 2), (4, 4), (4, 2)])
+def test_pipeline_matches_sequential(S, M):
+    mesh = make_mesh({PIPE_AXIS: S}, jax.devices()[:S])
+    _, plist, stage_fn = _blocks(S)
+    stacked = stack_stage_params(plist)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 16))
+    out = from_microbatches(pipeline_apply(stage_fn, stacked,
+                                           to_microbatches(x, M), mesh))
+    ref = x
+    for p in plist:
+        ref = stage_fn(p, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    S, M = 4, 4
+    mesh = make_mesh({PIPE_AXIS: S}, jax.devices()[:S])
+    _, plist, stage_fn = _blocks(S)
+    stacked = stack_stage_params(plist)
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 8, 16))
+    mbs = to_microbatches(x, M)
+
+    g_pipe = jax.grad(lambda sp: jnp.sum(jnp.square(
+        pipeline_apply(stage_fn, sp, mbs, mesh))))(stacked)
+
+    def seq_loss(plist):
+        h = x
+        for p in plist:
+            h = stage_fn(p, h)
+        return jnp.sum(jnp.square(h))
+
+    g_seq = stack_stage_params(jax.grad(seq_loss)(plist))
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_pipelined_train_step_learns():
+    """Embed -> S pipelined blocks -> head, trained end-to-end with the
+    pipeline in the loss: the full pp training composition."""
+    import optax
+
+    S, M, T, V, d = 2, 4, 8, 20, 16
+    mesh = make_mesh({PIPE_AXIS: S}, jax.devices()[:S])
+    blk = L.TransformerEncoderBlock(num_heads=2, causal=True)
+    emb = L.EmbeddingSequence(n_in=V, n_out=d)
+    head = L.RnnOutput(n_out=V, activation="softmax", loss="mcxent")
+    ks = jax.random.split(KEY, S + 2)
+    params = {
+        "emb": emb.init(ks[0], (T,))[0],
+        "blocks": stack_stage_params([blk.init(k, (T, d))[0] for k in ks[1:S + 1]]),
+        "head": head.init(ks[S + 1], (T, d))[0],
+    }
+
+    def stage_fn(p, h):
+        y, _, _ = blk.apply(p, {}, h, training=False)
+        return y
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, V, (16, T)).astype(np.int32)
+    y = ((x + 1) % V).astype(np.int32)  # learnable: successor token
+
+    def loss_fn(params):
+        h, _, _ = emb.apply(params["emb"], {}, x)
+        h = from_microbatches(pipeline_apply(
+            stage_fn, params["blocks"], to_microbatches(h, M), mesh))
+        return head.score(params["head"], {}, h, y)
+
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+    step = jax.jit(lambda p, o: (lambda l, g: (l,) + (lambda u, o2: (
+        optax.apply_updates(p, u), o2))(*tx.update(g, o, p)))(
+        *jax.value_and_grad(loss_fn)(p)))
+    l0 = None
+    for i in range(60):
+        l, params, opt = step(params, opt)
+        if l0 is None:
+            l0 = float(l)
+    assert float(l) < l0 * 0.5, f"pipelined training failed: {l0} -> {float(l)}"
