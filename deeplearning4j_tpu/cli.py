@@ -90,8 +90,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--skip-lines", type=int, default=0)
     t.add_argument("--batch", type=int, default=32)
     t.add_argument("--epochs", type=int, default=1)
-    t.add_argument("--parallel", choices=["shared_gradients", "averaging",
-                                          "encoded_gradients"], default=None)
+    t.add_argument("--parallel", choices=["shared_gradients", "zero_sharded",
+                                          "averaging", "encoded_gradients"],
+                   default=None)
     t.add_argument("--print-every", type=int, default=10)
     t.add_argument("--ui-port", type=int, default=0)
     t.add_argument("--save", default=None)

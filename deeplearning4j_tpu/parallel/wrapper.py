@@ -41,6 +41,10 @@ class ParallelWrapper:
     mode:
     - "shared_gradients" (default): ONE sharded jit per step; GSPMD inserts a
       dense gradient all-reduce over ICI. The fast path.
+    - "zero_sharded": shared_gradients + weight-update sharding (ZeRO-1,
+      arXiv:2004.13336): optimizer state sharded over the data axis, the
+      update computed 1/n-per-device and all-gathered — identical numerics,
+      ~1/n optimizer memory.
     - "averaging": independent replicas, params (+updater state) averaged
       every ``averaging_frequency`` iterations (TrainingMode.AVERAGING).
     - "encoded_gradients": per-worker threshold-compressed update exchange
@@ -74,6 +78,8 @@ class ParallelWrapper:
 
         if mode == "shared_gradients":
             self._init_sync()
+        elif mode == "zero_sharded":
+            self._init_sync(shard_opt_state=True)
         elif mode == "averaging":
             self._init_averaging()
         elif mode == "encoded_gradients":
@@ -86,19 +92,49 @@ class ParallelWrapper:
         return k
 
     # --- shared_gradients: one sharded jit, GSPMD all-reduce ---
-    def _init_sync(self):
+    def _init_sync(self, shard_opt_state: bool = False):
+        """``shard_opt_state=True`` is mode='zero_sharded' — weight-update
+        sharding (ZeRO-1; 'Automatic Cross-Replica Sharding of Weight Update
+        in Data-Parallel Training', arXiv:2004.13336 — PAPERS.md): the math
+        is IDENTICAL to shared_gradients, but each optimizer-state leaf is
+        placed sharded over the data axis along its largest divisible dim.
+        GSPMD then partitions the elementwise update computation across
+        replicas and all-gathers the applied updates — optimizer memory and
+        update FLOPs drop to ~1/n per device with bit-identical results
+        (elementwise updaters; global-norm gradient clipping stays exact too
+        since XLA computes the norm collectively)."""
         mesh, tx, model = self.mesh, self.tx, self.model
         repl = NamedSharding(mesh, P())
         batch_sh = NamedSharding(mesh, P(DATA_AXIS))
         self.params = jax.device_put(model.params, repl)
         self.state = jax.device_put(model.state, repl)
-        self.opt_state = jax.device_put(tx.init(self.params), repl)
+        opt0 = tx.init(self.params)
+        if shard_opt_state:
+            n = mesh.shape[DATA_AXIS]
+
+            def opt_spec(a):
+                if getattr(a, "ndim", 0) == 0:
+                    return P()
+                divisible = [(d, a.shape[d]) for d in range(a.ndim)
+                             if a.shape[d] % n == 0 and a.shape[d] >= n]
+                if not divisible:
+                    return P()
+                d = max(divisible, key=lambda t: t[1])[0]
+                spec = [None] * a.ndim
+                spec[d] = DATA_AXIS
+                return P(*spec)
+
+            opt_sh = jax.tree.map(
+                lambda a: NamedSharding(mesh, opt_spec(jnp.asarray(a))), opt0)
+        else:
+            opt_sh = repl
+        self.opt_state = jax.device_put(opt0, opt_sh)
         self._batch_sharding = batch_sh
 
         seq = isinstance(model, Sequential)
 
         @partial(jax.jit, donate_argnums=(0, 1, 2),
-                 out_shardings=(repl, repl, repl, repl))
+                 out_shardings=(repl, opt_sh, repl, repl))
         def step(params, opt_state, net_state, x, y, rng, mask=None):
             mask_kw = {"mask": mask} if seq else {"masks": mask}
 
@@ -326,7 +362,7 @@ class ParallelWrapper:
         return self
 
     def _fit_batch(self, x, y, mask=None):
-        if self.mode == "shared_gradients":
+        if self.mode in ("shared_gradients", "zero_sharded"):
             xd = jax.device_put(x, self._batch_sharding)
             yd = jax.device_put(y, self._batch_sharding)
             self.params, self.opt_state, self.state, loss = self._step(
