@@ -56,7 +56,24 @@ def cmd_train(args) -> int:
         listeners.append(StatsListener(storage, session_id="cli"))
         print(f"training UI at http://127.0.0.1:{ui_server.port}/", file=sys.stderr)
 
-    if args.parallel:
+    import os
+
+    if os.environ.get("DL4J_TPU_MULTIHOST"):
+        # pod-slice launch (utils/provision.py multihost_train_plan): every
+        # host runs this same command; bootstrap the global mesh and give
+        # this process its row-stripe of the CSV as its per-step shard
+        from .parallel import (MultiHostTrainer, ProcessShardIterator,
+                               initialize_multihost)
+
+        initialize_multihost()  # auto-discovers the coordinator on TPU pods
+        feats, labels = [], []
+        for ds in it:
+            feats.append(np.asarray(ds.features))
+            labels.append(np.asarray(ds.labels))
+        trainer = MultiHostTrainer(model)
+        it = ProcessShardIterator(np.concatenate(feats), np.concatenate(labels),
+                                  global_batch_size=args.batch)
+    elif args.parallel:
         from .parallel import ParallelWrapper
 
         trainer = ParallelWrapper(model, mode=args.parallel)

@@ -43,8 +43,10 @@ class BertBase(ZooModel):
     input_shape = (128,)  # (T,) int token ids
     num_classes = 2  # default classification head
 
-    def __init__(self, num_classes=None, seed=12345, input_shape=None, *, small=False, **kw):
+    def __init__(self, num_classes=None, seed=12345, input_shape=None, *, small=False,
+                 flash=False, **kw):
         super().__init__(num_classes, seed, input_shape, **kw)
+        self.flash = flash
         if small:  # test-sized variant
             self.num_layers, self.d_model, self.num_heads, self.vocab, self.max_len = 2, 64, 4, 1000, 128
 
@@ -56,7 +58,8 @@ class BertBase(ZooModel):
              .layer(L.EmbeddingSequence(n_in=self.vocab, n_out=self.d_model))
              .layer(L.PositionalEmbedding(max_len=self.max_len)))
         for _ in range(self.num_layers):
-            b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=False))
+            b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=False,
+                                              flash=self.flash))
         return (b.layer(L.LayerNorm())
                 .layer(L.GlobalPooling(mode="avg"))
                 .layer(L.Output(n_out=self.num_classes, activation="softmax", loss="mcxent"))

@@ -43,25 +43,33 @@ from ..train.trainer import build_updater
 from .mesh import DATA_AXIS, make_mesh
 
 
-def initialize_multihost(coordinator: str, num_processes: int, process_id: int,
+def initialize_multihost(coordinator: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None,
                          *, cpu_collectives: Optional[str] = None) -> bool:
     """Process-group bootstrap (SharedTrainingMaster.java:457 parity).
 
-    ``cpu_collectives``: "gloo"/"mpi" to enable cross-process collectives on
-    the CPU backend (used by tests and CPU clusters; TPU fabric needs none).
-    Returns True when this call performed the initialization.
+    With no arguments, relies on environment auto-discovery — on TPU pod
+    slices ``jax.distributed.initialize()`` finds the coordinator itself,
+    so every host runs the same command (utils/provision.py launch plans).
+    Explicit (coordinator, num_processes, process_id) serve CPU clusters
+    and tests. ``cpu_collectives``: "gloo"/"mpi" for cross-process
+    collectives on the CPU backend. Returns True when this call performed
+    the initialization (False: single process / already initialized /
+    nothing to discover — callers degenerate to single-process mode).
     """
-    if num_processes <= 1:
+    if num_processes is not None and num_processes <= 1:
         return False
     if cpu_collectives:
         jax.config.update("jax_cpu_collectives_implementation", cpu_collectives)
+    kwargs = {k: v for k, v in (("coordinator_address", coordinator),
+                                ("num_processes", num_processes),
+                                ("process_id", process_id)) if v is not None}
     try:
-        jax.distributed.initialize(coordinator_address=coordinator,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
+        jax.distributed.initialize(**kwargs)
         return True
-    except RuntimeError:
-        return False  # already initialized
+    except (RuntimeError, ValueError):
+        return False  # already initialized / no cluster env to discover
 
 
 class ProcessShardIterator:

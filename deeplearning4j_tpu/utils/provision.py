@@ -14,6 +14,46 @@ import shlex
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
+# (cores_per_chip, chips_per_host) by TPU generation. NOTE the public
+# naming convention: the pod-slice suffix counts TENSORCORES for v4/v5p
+# ("v4-32" = 32 cores = 16 chips on 4 hosts) but CHIPS for v5e/v6e
+# ("v5litepod-256" = 256 chips on 32 hosts).
+_GEN = {"v4": (2, 4), "v5p": (2, 4),
+        "v5litepod": (1, 8), "v5e": (1, 8), "v6e": (1, 8)}
+
+
+def topology(accelerator_type: str, strict: bool = True) -> Optional[Dict[str, int]]:
+    """Derive a slice's host/chip layout from its accelerator type.
+
+    Returns {"chips": N, "hosts": H, "chips_per_host": C}. Malformed
+    strings always raise; an UNKNOWN generation raises when ``strict``
+    (catching typos before a gcloud round trip) and returns None otherwise
+    (pure command generation still works for e.g. v2/v3 types this table
+    doesn't model).
+    """
+    try:
+        gen, count = accelerator_type.rsplit("-", 1)
+        suffix = int(count)
+    except ValueError:
+        raise ValueError(f"malformed accelerator type '{accelerator_type}' "
+                         f"(expected e.g. v4-32, v5litepod-256)")
+    if gen not in _GEN:
+        if strict:
+            raise ValueError(f"unknown TPU generation '{gen}' "
+                             f"(known: {sorted(_GEN)})")
+        return None
+    cores_per_chip, cph = _GEN[gen]
+    if suffix % cores_per_chip:
+        raise ValueError(f"{accelerator_type}: suffix {suffix} is not a "
+                         f"multiple of {cores_per_chip} cores/chip for {gen}")
+    chips = suffix // cores_per_chip
+    if chips <= cph:  # sub-host or single-host slice: one host
+        return {"chips": chips, "hosts": 1, "chips_per_host": chips}
+    if chips % cph:
+        raise ValueError(f"{accelerator_type}: {chips} chips is not a "
+                         f"multiple of {cph} chips/host for {gen}")
+    return {"chips": chips, "hosts": chips // cph, "chips_per_host": cph}
+
 
 @dataclass
 class TpuPodSpec:
@@ -27,6 +67,19 @@ class TpuPodSpec:
     preemptible: bool = False
     network: Optional[str] = None
     metadata: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        # non-strict: unknown generations (v2/v3, future gens) still allow
+        # pure command generation; host math is simply unavailable for them
+        self.topology = topology(self.accelerator_type, strict=False)
+
+    @property
+    def num_hosts(self) -> Optional[int]:
+        return self.topology["hosts"] if self.topology else None
+
+    @property
+    def num_chips(self) -> Optional[int]:
+        return self.topology["chips"] if self.topology else None
 
 
 class TpuClusterSetup:
@@ -90,8 +143,48 @@ class TpuClusterSetup:
         lines.append(entrypoint)
         return "\n".join(lines) + "\n"
 
+    def describe_command(self) -> List[str]:
+        s = self.spec
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "describe", s.name,
+               f"--zone={s.zone}"]
+        if s.project:
+            cmd.append(f"--project={s.project}")
+        return cmd
+
+    def copy_command(self, local_path: str, remote_path: str = "~/") -> List[str]:
+        """Ship code/data to every worker (ClusterSetup's rsync step)."""
+        s = self.spec
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "scp", "--recurse",
+               local_path, f"{s.name}:{remote_path}", f"--zone={s.zone}",
+               "--worker=all"]
+        if s.project:
+            cmd.append(f"--project={s.project}")
+        return cmd
+
     def plan(self, repo_url: str, entrypoint: str = "python train.py") -> List[List[str]]:
         boot = self.bootstrap_script(repo_url, entrypoint)
+        return [self.create_command(),
+                self.run_on_all_workers_command(f"bash -c {shlex.quote(boot)}")]
+
+    def multihost_train_plan(self, repo_url: str, train_args: str = "") -> List[List[str]]:
+        """Full distributed-training launch: provision the slice, then start
+        the framework's multi-host path on every worker. On TPU pods
+        ``jax.distributed.initialize()`` auto-discovers the coordinator, so
+        every host runs the SAME command; ``DL4J_TPU_MULTIHOST=1`` makes the
+        CLI bootstrap ``initialize_multihost`` + ``MultiHostTrainer`` with a
+        per-process data shard (cli.py). The reference needed Spark
+        master/worker asymmetry; a pod slice needs one command."""
+        if self.spec.topology is None:
+            raise ValueError(
+                f"multi-host launch needs known host math for "
+                f"'{self.spec.accelerator_type}' — known generations: "
+                f"{sorted(_GEN)}")
+        entry = ("python -m deeplearning4j_tpu.cli train "
+                 + train_args).strip()
+        boot = self.bootstrap_script(
+            repo_url, entry,
+            env={"DL4J_TPU_MULTIHOST": "1",
+                 "DL4J_TPU_NUM_HOSTS": str(self.spec.num_hosts)})
         return [self.create_command(),
                 self.run_on_all_workers_command(f"bash -c {shlex.quote(boot)}")]
 

@@ -1,0 +1,49 @@
+"""Long-context attention — ring attention over a sequence-parallel mesh.
+
+Each device holds a (B, T/n, H, D) slice of the sequence; K/V blocks rotate
+around the ring via collective permute while a streaming softmax accumulates
+EXACT attention (no (T, T) score tensor ever exists, and within each ring
+step keys stream in bounded chunks). Falls back to a virtual 8-device CPU
+mesh; on a TPU slice the same code rides the ICI ring.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+from examples._common import setup
+
+setup(min_devices=4)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel import (SEQ_AXIS, make_mesh,
+                                         reference_attention, ring_attention)
+
+
+def main(B=1, T=2048, H=4, D=32, ring=4):
+    mesh = make_mesh({SEQ_AXIS: ring}, jax.devices()[:ring])
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.float32) for kk in ks)
+
+    out = ring_attention(q, k, v, mesh, causal=True, k_chunk=256)
+    print(f"ring attention over {ring} devices: T={T} local_T={T // ring}, "
+          f"out {out.shape}")
+
+    # exactness vs the dense reference (which DOES build the (T, T) scores)
+    ref = reference_attention(q, k, v, causal=True)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    print(f"max |ring - dense| = {err:.2e}")
+    assert err < 5e-5
+
+    # differentiable end-to-end: gradients flow through the ring collectives
+    g = jax.grad(lambda q: jnp.sum(jnp.square(
+        ring_attention(q, q, q, mesh, causal=True, k_chunk=256))))(q)
+    print("grad finite:", bool(jnp.all(jnp.isfinite(g))))
+    return err
+
+
+if __name__ == "__main__":
+    main()

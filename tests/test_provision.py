@@ -1,0 +1,111 @@
+"""TPU pod provisioning (deeplearning4j-aws ClusterSetup equivalent) —
+plan-time topology validation and command generation, dry-run execution.
+
+Host math reflects the public naming convention: the v4/v5p suffix counts
+TENSORCORES (2/chip, 4 chips/host); v5e/v6e suffixes count CHIPS (8/host).
+"""
+
+import pytest
+
+from deeplearning4j_tpu.utils.provision import (TpuClusterSetup, TpuPodSpec,
+                                                topology)
+
+
+class TestTopology:
+    def test_known_shapes(self):
+        # v4-32 = 32 cores = 16 chips on 4 hosts
+        assert topology("v4-32") == {"chips": 16, "hosts": 4, "chips_per_host": 4}
+        # v5litepod-256 = 256 chips on 32 hosts
+        assert topology("v5litepod-256") == {"chips": 256, "hosts": 32,
+                                             "chips_per_host": 8}
+        # v5p-128 = 64 chips on 16 hosts
+        assert topology("v5p-128") == {"chips": 64, "hosts": 16,
+                                       "chips_per_host": 4}
+
+    def test_single_host_slices(self):
+        assert topology("v4-8")["hosts"] == 1          # 4 chips, one host
+        assert topology("v5litepod-8")["hosts"] == 1
+        assert topology("v5litepod-4")["hosts"] == 1
+
+    def test_rejects_bad_types(self):
+        with pytest.raises(ValueError, match="malformed"):
+            topology("v9-banana")
+        with pytest.raises(ValueError, match="unknown TPU generation"):
+            topology("v9-32")
+        with pytest.raises(ValueError, match="not a"):
+            topology("v4-60")  # 30 chips: not a multiple of 4/host
+
+    def test_unknown_generation_non_strict(self):
+        assert topology("v3-8", strict=False) is None
+        spec = TpuPodSpec(accelerator_type="v3-8")  # command gen still works
+        assert spec.num_hosts is None
+        cs = TpuClusterSetup(spec)
+        assert "v3-8" in " ".join(cs.create_command())
+        with pytest.raises(ValueError, match="known host math"):
+            cs.multihost_train_plan("https://example.com/r.git")
+
+
+class TestClusterSetup:
+    def test_plan_and_dry_run_execution(self):
+        spec = TpuPodSpec(name="pod1", accelerator_type="v5litepod-16",
+                          project="proj", preemptible=True)
+        assert (spec.num_hosts, spec.num_chips) == (2, 16)
+        ran = []
+        cs = TpuClusterSetup(spec, runner=lambda cmd: ran.append(cmd) or 0)
+        plan = cs.multihost_train_plan(
+            "https://example.com/repo.git",
+            "--model m.zip --csv d.csv --num-classes 10 --parallel zero_sharded")
+        assert cs.execute(plan) == 0
+        assert len(ran) == 2
+        create, launch = ran
+        assert create[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "create", "pod1"]
+        assert "--preemptible" in create and "--project=proj" in create
+        assert "--worker=all" in launch
+        joined = " ".join(launch)
+        assert "deeplearning4j_tpu.cli train" in joined
+        assert "DL4J_TPU_MULTIHOST=1" in joined
+        assert "DL4J_TPU_NUM_HOSTS=2" in joined
+
+    def test_cli_consumes_multihost_env(self, tmp_path, monkeypatch):
+        """DL4J_TPU_MULTIHOST=1 must route the CLI through MultiHostTrainer
+        with a per-process data shard (single-process degenerate mode here)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.cli import main as cli_main
+        from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.train import Trainer
+
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                            "learning_rate": 1e-2}))
+               .input_shape(3)
+               .layer(L.Dense(n_out=8, activation="relu"))
+               .layer(L.Output(n_out=2, activation="softmax", loss="mcxent"))
+               .build())
+        net.init()
+        mp = str(tmp_path / "m.zip")
+        Trainer(net).save(mp)
+        rng = np.random.RandomState(0)
+        csv = tmp_path / "d.csv"
+        rows = ["%f,%f,%f,%d" % (*rng.randn(3), rng.randint(0, 2))
+                for _ in range(32)]
+        csv.write_text("\n".join(rows) + "\n")
+        monkeypatch.setenv("DL4J_TPU_MULTIHOST", "1")
+        out = str(tmp_path / "out.zip")
+        rc = cli_main(["train", "--model", mp, "--csv", str(csv),
+                       "--num-classes", "2", "--batch", "8", "--epochs", "2",
+                       "--save", out])
+        assert rc == 0
+        t2 = Trainer.load(out)
+        assert all(np.isfinite(np.asarray(v)).all()
+                   for v in __import__("jax").tree.leaves(t2.params))
+
+    def test_dry_run_refuses_execute_without_runner(self):
+        cs = TpuClusterSetup(TpuPodSpec())
+        with pytest.raises(RuntimeError, match="dry-run"):
+            cs.execute([cs.create_command()])
+
+    def test_copy_and_describe(self):
+        cs = TpuClusterSetup(TpuPodSpec(name="x"))
+        assert "scp" in cs.copy_command("/data")
+        assert "describe" in cs.describe_command()
