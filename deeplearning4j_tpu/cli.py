@@ -62,10 +62,25 @@ def cmd_train(args) -> int:
         # pod-slice launch (utils/provision.py multihost_train_plan): every
         # host runs this same command; bootstrap the global mesh and give
         # this process its row-stripe of the CSV as its per-step shard
+        if args.parallel:
+            print("error: --parallel conflicts with DL4J_TPU_MULTIHOST "
+                  "(the multi-host path owns the parallel topology)",
+                  file=sys.stderr)
+            return 2
+        import jax
+
         from .parallel import (MultiHostTrainer, ProcessShardIterator,
                                initialize_multihost)
 
         initialize_multihost()  # auto-discovers the coordinator on TPU pods
+        expected = int(os.environ.get("DL4J_TPU_NUM_HOSTS", "0"))
+        if expected > 1 and jax.process_count() != expected:
+            print(f"error: expected {expected} hosts "
+                  f"(DL4J_TPU_NUM_HOSTS) but jax.process_count()="
+                  f"{jax.process_count()} — distributed init did not form "
+                  f"the full pod; refusing to train {expected} independent "
+                  f"copies", file=sys.stderr)
+            return 3
         feats, labels = [], []
         for ds in it:
             feats.append(np.asarray(ds.features))

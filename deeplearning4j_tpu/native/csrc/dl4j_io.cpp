@@ -120,6 +120,301 @@ struct Batcher {
 
 }  // namespace
 
+// ---------------------------------------------------------------------------
+// npz batch-directory streamer — native fast path for export-based training
+// (data/iterators.py export_batches / FileDataSetIterator): parses the
+// uncompressed-zip .npz files numpy's savez writes (ZIP_STORED members) and
+// prefetches upcoming batches on a background thread, off the Python GIL.
+// The reference's equivalent is ExistingMiniBatchDataSetIterator over
+// AsyncDataSetIterator (both Java-thread-backed).
+// ---------------------------------------------------------------------------
+
+#include <dirent.h>
+
+#include <algorithm>
+#include <fstream>
+#include <string>
+
+namespace {
+
+struct NpyMember {
+  int64_t data_offset = -1;  // absolute byte offset of raw f32 data
+  int64_t ndim = 0;
+  int64_t dims[8] = {0};
+  int64_t nelem = 0;
+  bool present() const { return data_offset >= 0; }
+};
+
+struct NpzFileInfo {
+  std::string path;
+  NpyMember feats, labels, fmask, lmask;
+};
+
+static uint16_t rd16(const unsigned char* p) { return p[0] | (p[1] << 8); }
+static uint32_t rd32(const unsigned char* p) {
+  return p[0] | (p[1] << 8) | (p[2] << 16) | (uint32_t(p[3]) << 24);
+}
+
+// Parse one member's npy header at `local_off` (zip local header offset).
+// Returns false on unsupported layout (compressed member, non-f32 dtype,
+// fortran order) — callers treat that file as unreadable.
+static bool parse_member(std::ifstream& f, int64_t local_off, NpyMember* out) {
+  unsigned char lh[30];
+  f.seekg(local_off);
+  f.read(reinterpret_cast<char*>(lh), 30);
+  if (!f || rd32(lh) != 0x04034b50) return false;
+  if (rd16(lh + 8) != 0) return false;  // compression: STORED only
+  const uint16_t nlen = rd16(lh + 26), xlen = rd16(lh + 28);
+  int64_t npy_off = local_off + 30 + nlen + xlen;
+  unsigned char mh[12];
+  f.seekg(npy_off);
+  f.read(reinterpret_cast<char*>(mh), 12);
+  if (!f || memcmp(mh, "\x93NUMPY", 6) != 0) return false;
+  const int major = mh[6];
+  int64_t hlen, hstart;
+  if (major == 1) { hlen = rd16(mh + 8); hstart = npy_off + 10; }
+  else { hlen = rd32(mh + 8); hstart = npy_off + 12; }
+  std::string hdr(hlen, '\0');
+  f.seekg(hstart);
+  f.read(&hdr[0], hlen);
+  if (!f) return false;
+  if (hdr.find("'<f4'") == std::string::npos) return false;
+  if (hdr.find("'fortran_order': True") != std::string::npos) return false;
+  const size_t sp = hdr.find("'shape':");
+  if (sp == std::string::npos) return false;
+  const size_t po = hdr.find('(', sp), pc = hdr.find(')', po);
+  if (po == std::string::npos || pc == std::string::npos) return false;
+  out->ndim = 0;
+  out->nelem = 1;
+  std::string tup = hdr.substr(po + 1, pc - po - 1);
+  size_t pos = 0;
+  while (pos < tup.size() && out->ndim < 8) {
+    while (pos < tup.size() && (tup[pos] == ' ' || tup[pos] == ',')) ++pos;
+    if (pos >= tup.size()) break;
+    int64_t v = 0;
+    bool any = false;
+    while (pos < tup.size() && tup[pos] >= '0' && tup[pos] <= '9') {
+      v = v * 10 + (tup[pos++] - '0');
+      any = true;
+    }
+    if (!any) break;
+    out->dims[out->ndim++] = v;
+    out->nelem *= v;
+  }
+  if (out->ndim == 0) return false;
+  out->data_offset = hstart + hlen;
+  return true;
+}
+
+// Scan a .npz's zip central directory for the four known member names.
+static bool parse_npz(const std::string& path, NpzFileInfo* info) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  f.seekg(0, std::ios::end);
+  const int64_t size = f.tellg();
+  const int64_t tail = std::min<int64_t>(size, 66000);
+  std::vector<unsigned char> buf(tail);
+  f.seekg(size - tail);
+  f.read(reinterpret_cast<char*>(buf.data()), tail);
+  int64_t eocd = -1;
+  for (int64_t i = tail - 22; i >= 0; --i) {
+    if (rd32(buf.data() + i) == 0x06054b50) { eocd = i; break; }
+  }
+  if (eocd < 0) return false;
+  const uint16_t nent = rd16(buf.data() + eocd + 10);
+  int64_t cd_off = rd32(buf.data() + eocd + 16);
+  info->path = path;
+  for (uint16_t e = 0; e < nent; ++e) {
+    unsigned char ch[46];
+    f.seekg(cd_off);
+    f.read(reinterpret_cast<char*>(ch), 46);
+    if (!f || rd32(ch) != 0x02014b50) return false;
+    const uint16_t nlen = rd16(ch + 28), xlen = rd16(ch + 30), clen = rd16(ch + 32);
+    std::string name(nlen, '\0');
+    f.read(&name[0], nlen);
+    const int64_t local_off = rd32(ch + 42);
+    NpyMember* dst = nullptr;
+    if (name == "features.npy") dst = &info->feats;
+    else if (name == "labels.npy") dst = &info->labels;
+    else if (name == "features_mask.npy") dst = &info->fmask;
+    else if (name == "labels_mask.npy") dst = &info->lmask;
+    if (dst && !parse_member(f, local_off, dst)) return false;
+    cd_off += 46 + nlen + xlen + clen;
+  }
+  return info->feats.present() && info->labels.present();
+}
+
+struct NpzLoaded {
+  int64_t idx = -1;
+  std::vector<float> feats, labels, fmask, lmask;
+};
+
+struct NpzDir {
+  std::vector<NpzFileInfo> files;
+  // prefetch machinery (restarted by set_order)
+  std::vector<int64_t> order;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::deque<NpzLoaded> queue;
+  size_t depth = 3;
+  size_t next_pos = 0;   // producer cursor into `order`
+  size_t in_flight = 0;  // claimed by the producer, not yet queued
+  bool stop = false;
+  bool failed = false;
+
+  ~NpzDir() { join(); }
+
+  void join() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv_put.notify_all();
+    cv_get.notify_all();
+    if (worker.joinable()) worker.join();
+  }
+
+  static bool load_member(std::ifstream& f, const NpyMember& m,
+                          std::vector<float>* out) {
+    if (!m.present()) { out->clear(); return true; }
+    out->resize(m.nelem);
+    f.seekg(m.data_offset);
+    f.read(reinterpret_cast<char*>(out->data()), m.nelem * 4);
+    return bool(f);
+  }
+
+  void run() {
+    for (;;) {
+      int64_t idx;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] { return stop || (queue.size() < depth &&
+                                              next_pos < order.size()); });
+        if (stop || next_pos >= order.size()) return;
+        idx = order[next_pos++];
+        ++in_flight;
+      }
+      NpzLoaded ld;
+      ld.idx = idx;
+      bool ok = idx >= 0 && idx < int64_t(files.size());
+      if (ok) {
+        const NpzFileInfo& fi = files[idx];
+        std::ifstream f(fi.path, std::ios::binary);
+        ok = f && load_member(f, fi.feats, &ld.feats) &&
+             load_member(f, fi.labels, &ld.labels) &&
+             load_member(f, fi.fmask, &ld.fmask) &&
+             load_member(f, fi.lmask, &ld.lmask);
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        --in_flight;
+        if (stop) return;
+        if (!ok) { failed = true; }
+        else queue.push_back(std::move(ld));
+      }
+      cv_get.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* npzdir_create(const char* dir, const char* prefix) {
+  DIR* d = opendir(dir);
+  if (!d) return nullptr;
+  const std::string pre = std::string(prefix) + "_";
+  std::vector<std::string> names;
+  while (dirent* ent = readdir(d)) {
+    std::string n = ent->d_name;
+    // strict match: {prefix}_NNNNNN.npz (mirror data/iterators._batch_files)
+    if (n.size() != pre.size() + 10 || n.compare(0, pre.size(), pre) != 0 ||
+        n.compare(n.size() - 4, 4, ".npz") != 0)
+      continue;
+    bool digits = true;
+    for (size_t i = pre.size(); i < pre.size() + 6; ++i)
+      digits &= (n[i] >= '0' && n[i] <= '9');
+    if (digits) names.push_back(n);
+  }
+  closedir(d);
+  std::sort(names.begin(), names.end());
+  auto* h = new NpzDir();
+  for (const auto& n : names) {
+    NpzFileInfo info;
+    if (!parse_npz(std::string(dir) + "/" + n, &info)) { delete h; return nullptr; }
+    h->files.push_back(std::move(info));
+  }
+  return h;
+}
+
+int64_t npzdir_count(void* hp) {
+  return hp ? int64_t(static_cast<NpzDir*>(hp)->files.size()) : -1;
+}
+
+// which: 0=features 1=labels 2=features_mask 3=labels_mask.
+// Returns ndim (0 = member absent, -1 = bad args); fills dims_out (cap 8).
+int64_t npzdir_shape(void* hp, int64_t file_idx, int which, int64_t* dims_out) {
+  auto* h = static_cast<NpzDir*>(hp);
+  if (!h || file_idx < 0 || file_idx >= int64_t(h->files.size())) return -1;
+  const NpzFileInfo& fi = h->files[file_idx];
+  const NpyMember* m = which == 0 ? &fi.feats : which == 1 ? &fi.labels
+                       : which == 2 ? &fi.fmask : &fi.lmask;
+  if (!m->present()) return 0;
+  for (int64_t i = 0; i < m->ndim; ++i) dims_out[i] = m->dims[i];
+  return m->ndim;
+}
+
+// (Re)start prefetching the given visit order (indices into the sorted file
+// list). Restart is a full worker teardown: simple and race-free.
+int npzdir_set_order(void* hp, const int64_t* order, int64_t n) {
+  auto* h = static_cast<NpzDir*>(hp);
+  if (!h || n < 0) return -1;
+  h->join();
+  {
+    std::lock_guard<std::mutex> lk(h->mu);
+    h->queue.clear();
+    h->order.assign(order, order + n);
+    h->next_pos = 0;
+    h->in_flight = 0;
+    h->stop = false;
+    h->failed = false;
+  }
+  h->worker = std::thread([h] { h->run(); });
+  return 0;
+}
+
+// Pop the next prefetched batch into caller buffers (sized via npzdir_shape).
+// Returns the file index, -1 at end-of-order, -2 on a read failure.
+int64_t npzdir_next(void* hp, float* feats, float* labels, float* fmask,
+                    float* lmask) {
+  auto* h = static_cast<NpzDir*>(hp);
+  if (!h) return -2;
+  NpzLoaded ld;
+  {
+    std::unique_lock<std::mutex> lk(h->mu);
+    h->cv_get.wait(lk, [&] {
+      return h->failed || !h->queue.empty() ||
+             (h->next_pos >= h->order.size() && h->in_flight == 0);
+    });
+    if (h->failed) return -2;
+    if (h->queue.empty()) return -1;  // order exhausted
+    ld = std::move(h->queue.front());
+    h->queue.pop_front();
+  }
+  h->cv_put.notify_all();
+  memcpy(feats, ld.feats.data(), ld.feats.size() * 4);
+  memcpy(labels, ld.labels.data(), ld.labels.size() * 4);
+  if (fmask && !ld.fmask.empty()) memcpy(fmask, ld.fmask.data(), ld.fmask.size() * 4);
+  if (lmask && !ld.lmask.empty()) memcpy(lmask, ld.lmask.data(), ld.lmask.size() * 4);
+  return ld.idx;
+}
+
+void npzdir_destroy(void* hp) { delete static_cast<NpzDir*>(hp); }
+
+}  // extern "C"
+
 extern "C" {
 
 void* batcher_create(const float* feats, const float* labels, int64_t n,

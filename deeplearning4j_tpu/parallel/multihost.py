@@ -68,8 +68,12 @@ def initialize_multihost(coordinator: Optional[str] = None,
     try:
         jax.distributed.initialize(**kwargs)
         return True
-    except (RuntimeError, ValueError):
-        return False  # already initialized / no cluster env to discover
+    except RuntimeError:
+        return False  # already initialized
+    except ValueError:
+        if kwargs:  # explicit args that don't work are a REAL config error —
+            raise   # never silently degrade to single-process training
+        return False  # pure auto-discovery with no cluster env: single process
 
 
 class ProcessShardIterator:

@@ -138,3 +138,86 @@ class TestNativeReaders:
         p.write_bytes(b"\x01\x02\x03\x04garbage")
         with pytest.raises(ValueError):
             read_idx(str(p))
+
+
+class TestNativeNpzStreamer:
+    """Native .npz batch streamer == pure-Python FileDataSetIterator
+    (accelerated-vs-reference equivalence, SURVEY.md §4)."""
+
+    def _export(self, tmp_path, n=40, with_masks=False):
+        from deeplearning4j_tpu.data import ArrayIterator, export_batches
+        from deeplearning4j_tpu.data.iterators import DataSet
+        rng = np.random.RandomState(0)
+        if with_masks:
+            batches = [DataSet(rng.randn(4, 5, 3).astype(np.float32),
+                               rng.randn(4, 5, 2).astype(np.float32),
+                               (rng.rand(4, 5) > 0.3).astype(np.float32),
+                               (rng.rand(4, 5) > 0.3).astype(np.float32))
+                       for _ in range(n // 4)]
+            export_batches(batches, str(tmp_path))
+        else:
+            x = rng.randn(n, 6).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+            export_batches(ArrayIterator(x, y, 8), str(tmp_path))
+
+    def test_matches_python_iterator(self, tmp_path):
+        from deeplearning4j_tpu.data import FileDataSetIterator
+        from deeplearning4j_tpu.native.io import NativeFileDataSetIterator
+        self._export(tmp_path)
+        py = list(FileDataSetIterator(str(tmp_path)))
+        nat = list(NativeFileDataSetIterator(str(tmp_path)))
+        assert len(py) == len(nat) == 5
+        for a, b in zip(py, nat):
+            np.testing.assert_array_equal(np.asarray(a.features), b.features)
+            np.testing.assert_array_equal(np.asarray(a.labels), b.labels)
+
+    def test_masks_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.data import FileDataSetIterator
+        from deeplearning4j_tpu.native.io import NativeFileDataSetIterator
+        self._export(tmp_path, with_masks=True)
+        py = list(FileDataSetIterator(str(tmp_path)))
+        nat = list(NativeFileDataSetIterator(str(tmp_path)))
+        for a, b in zip(py, nat):
+            np.testing.assert_array_equal(np.asarray(a.features_mask), b.features_mask)
+            np.testing.assert_array_equal(np.asarray(a.labels_mask), b.labels_mask)
+
+    def test_shuffle_and_shard(self, tmp_path):
+        from deeplearning4j_tpu.native.io import NativeFileDataSetIterator
+        self._export(tmp_path)
+        it = NativeFileDataSetIterator(str(tmp_path), shuffle=True, seed=3)
+        e1 = [b.features for b in it]
+        e2 = [b.features for b in it]  # second epoch: different order
+        assert len(e1) == len(e2) == 5
+        same = all(np.array_equal(a, b) for a, b in zip(e1, e2))
+        total = np.sort(np.concatenate([f.ravel() for f in e1]))
+        total2 = np.sort(np.concatenate([f.ravel() for f in e2]))
+        np.testing.assert_array_equal(total, total2)  # same content
+        assert not same  # different order (5! = 120 permutations, seed-dep)
+        shards = [list(NativeFileDataSetIterator(str(tmp_path), shard=(r, 2)))
+                  for r in range(2)]
+        assert [len(s) for s in shards] == [3, 2]
+
+    def test_missing_directory_raises(self, tmp_path):
+        from deeplearning4j_tpu.native.io import NativeFileDataSetIterator
+        with pytest.raises(FileNotFoundError):
+            NativeFileDataSetIterator(str(tmp_path / "nope"))
+
+    def test_empty_directory_raises(self, tmp_path):
+        from deeplearning4j_tpu.native.io import NativeFileDataSetIterator
+        with pytest.raises(ValueError, match="no readable"):
+            NativeFileDataSetIterator(str(tmp_path))
+
+    def test_interleaved_generators_independent(self, tmp_path):
+        """zip(it, it) / restart-mid-epoch must behave like the pure-Python
+        iterator: each __iter__ owns an independent native read stream."""
+        from deeplearning4j_tpu.native.io import NativeFileDataSetIterator
+        self._export(tmp_path)
+        it = NativeFileDataSetIterator(str(tmp_path))
+        g1 = iter(it)
+        first = next(g1).features
+        full = [b.features for b in it]        # full epoch while g1 is open
+        rest = [b.features for b in g1]        # g1 continues unaffected
+        assert len(full) == 5 and len(rest) == 4
+        np.testing.assert_array_equal(first, full[0])
+        for a, b in zip(full[1:], rest):
+            np.testing.assert_array_equal(a, b)

@@ -54,7 +54,7 @@ class TestClusterSetup:
         cs = TpuClusterSetup(spec, runner=lambda cmd: ran.append(cmd) or 0)
         plan = cs.multihost_train_plan(
             "https://example.com/repo.git",
-            "--model m.zip --csv d.csv --num-classes 10 --parallel zero_sharded")
+            "--model m.zip --csv d.csv --num-classes 10")
         assert cs.execute(plan) == 0
         assert len(ran) == 2
         create, launch = ran
