@@ -44,9 +44,10 @@ class BertBase(ZooModel):
     num_classes = 2  # default classification head
 
     def __init__(self, num_classes=None, seed=12345, input_shape=None, *, small=False,
-                 flash=False, **kw):
+                 flash=False, remat=False, **kw):
         super().__init__(num_classes, seed, input_shape, **kw)
         self.flash = flash
+        self.remat = remat
         if small:  # test-sized variant
             self.num_layers, self.d_model, self.num_heads, self.vocab, self.max_len = 2, 64, 4, 1000, 128
 
@@ -59,7 +60,7 @@ class BertBase(ZooModel):
              .layer(L.PositionalEmbedding(max_len=self.max_len)))
         for _ in range(self.num_layers):
             b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=False,
-                                              flash=self.flash))
+                                              flash=self.flash, remat=self.remat))
         return (b.layer(L.LayerNorm())
                 .layer(L.GlobalPooling(mode="avg"))
                 .layer(L.Output(n_out=self.num_classes, activation="softmax", loss="mcxent"))
@@ -78,7 +79,7 @@ class CausalLM(ZooModel):
 
     def __init__(self, num_classes=None, seed=12345, input_shape=None, *,
                  num_layers=None, d_model=None, num_heads=None, vocab=None,
-                 flash=False, **kw):
+                 flash=False, remat=False, **kw):
         super().__init__(num_classes, seed, input_shape, **kw)
         self.num_layers = num_layers or self.num_layers
         self.d_model = d_model or self.d_model
@@ -86,6 +87,7 @@ class CausalLM(ZooModel):
         self.vocab = vocab or self.vocab
         self.num_classes = self.vocab
         self.flash = flash
+        self.remat = remat
 
     def build(self) -> Sequential:
         T = self.input_shape[0]
@@ -96,7 +98,7 @@ class CausalLM(ZooModel):
              .layer(L.PositionalEmbedding(max_len=max(T, 512))))
         for _ in range(self.num_layers):
             b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=True,
-                                              flash=self.flash))
+                                              flash=self.flash, remat=self.remat))
         b.layer(L.LayerNorm())
         b.layer(L.RnnOutput(n_out=self.vocab, activation="softmax", loss="mcxent"))
         return b.build()

@@ -109,6 +109,10 @@ class TransformerEncoderBlock(Layer):
     causal: bool = False
     dropout_rate: float = 0.0
     flash: bool = False  # route self-attention through the Pallas kernel
+    remat: bool = False  # gradient checkpointing: recompute this block's
+    # internals in the backward pass instead of storing them — saved
+    # activation memory shrinks to ~one residual-stream tensor per block
+    # (jax.checkpoint per block; deep stacks / long context)
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
@@ -133,6 +137,16 @@ class TransformerEncoderBlock(Layer):
         return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        if self.remat:
+            import functools
+
+            body = functools.partial(self._body, training=training)
+            y = jax.checkpoint(body)(params, x, rng, mask)
+        else:
+            y = self._body(params, x, rng, mask, training=training)
+        return y, state, mask
+
+    def _body(self, params, x, rng, mask, *, training=False):
         mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal,
                                  flash=self.flash)
         h = self._ln(x, params["ln1_g"], params["ln1_b"])
@@ -145,7 +159,7 @@ class TransformerEncoderBlock(Layer):
             from ...ops.regularization import dropout as do
 
             m = do(rng, m, self.dropout_rate, True)
-        return x + m, state, mask
+        return x + m
 
 
 @register_layer

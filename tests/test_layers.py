@@ -100,6 +100,26 @@ class TestLayerSemantics:
         y, _, _ = layer.apply(params, {}, x)
         np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-6)
 
+    def test_transformer_block_remat_identical(self):
+        """Gradient checkpointing (remat=True) must be numerically identical
+        to the plain block, forward and gradients — it only changes WHEN
+        activations are (re)computed, trading FLOPs for memory."""
+        blk = L.TransformerEncoderBlock(num_heads=2, causal=True)
+        blk_r = L.TransformerEncoderBlock(num_heads=2, causal=True, remat=True)
+        p, _ = blk.init(KEY, (8, 16))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        y1, _, _ = blk.apply(p, {}, x)
+        y2, _, _ = blk_r.apply(p, {}, x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+        g1 = jax.grad(lambda p: jnp.sum(jnp.square(blk.apply(p, {}, x)[0])))(p)
+        g2 = jax.grad(lambda p: jnp.sum(jnp.square(blk_r.apply(p, {}, x)[0])))(p)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        # serde keeps the flag
+        from deeplearning4j_tpu.nn.api import layer_from_dict
+        assert layer_from_dict(blk_r.to_dict()) == blk_r
+
     def test_stem_space_to_depth_equivalence(self):
         """The 7x7/2 SAME stem rewrite (MXU-friendly space-to-depth packing)
         must be numerically identical to the generic strided conv, forward
