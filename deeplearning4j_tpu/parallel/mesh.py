@@ -25,6 +25,15 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+def pcast_varying(tree, axis_name: str):
+    """Mark fresh (device-invariant) arrays as varying over a shard_map axis
+    so scan carries that later mix with ppermute'd values type-check (the
+    manual-axes typing rule; used by ring attention and the pipeline)."""
+    import jax
+
+    return jax.tree.map(lambda a: jax.lax.pcast(a, axis_name, to="varying"), tree)
+
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"

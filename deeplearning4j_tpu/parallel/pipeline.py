@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import PIPE_AXIS
+from .mesh import PIPE_AXIS, pcast_varying
 
 
 def stack_stage_params(params_list: Sequence):
@@ -60,9 +60,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
         me = jax.tree.map(lambda a: a[0], params_blk)  # this stage's params
         s = lax.axis_index(axis_name)
         first, last = s == 0, s == S - 1
-        vary = lambda a: lax.pcast(a, axis_name, to="varying")
-        buf0 = vary(jnp.zeros_like(mbs[0]))
-        out0 = vary(jnp.zeros_like(mbs))
+        buf0 = pcast_varying(jnp.zeros_like(mbs[0]), axis_name)
+        out0 = pcast_varying(jnp.zeros_like(mbs), axis_name)
         perm = [(i, i + 1) for i in range(S - 1)]
 
         def tick(carry, t):

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import SEQ_AXIS
+from .mesh import SEQ_AXIS, pcast_varying
 
 
 def _block_attend(q, k, v, *, scale, q_pos, k_pos, causal, m, l, o,
@@ -91,14 +91,9 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = F
     scale = scale if scale is not None else 1.0 / jnp.sqrt(D)
     q_pos = idx * T + jnp.arange(T)
 
-    # mark the fresh accumulators as device-varying over the ring axis
-    # so the scan carry types match (shard_map manual-axes typing rule).
-    def _vary(a):
-        return lax.pcast(a, axis_name, to="varying")
-
-    m0 = _vary(jnp.full((B, H, T), -jnp.inf, jnp.float32))
-    l0 = _vary(jnp.zeros((B, H, T), jnp.float32))
-    o0 = _vary(jnp.zeros((B, T, H, D), jnp.float32))
+    m0 = pcast_varying(jnp.full((B, H, T), -jnp.inf, jnp.float32), axis_name)
+    l0 = pcast_varying(jnp.zeros((B, H, T), jnp.float32), axis_name)
+    o0 = pcast_varying(jnp.zeros((B, T, H, D), jnp.float32), axis_name)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(carry, step):

@@ -167,12 +167,10 @@ class RegressionScoreCalculator(ScoreCalculator):
 
     def score(self, trainer):
         from ..eval import RegressionEvaluation
-        from ..nn.model import Sequential
+        from .trainer import model_output_width
 
-        n_out = (trainer.model.output_shape[-1]
-                 if isinstance(trainer.model, Sequential)
-                 else trainer.model.output_shapes[0][-1])
-        ev = trainer.evaluate(self.iterator, evaluation=RegressionEvaluation(n_out))
+        ev = trainer.evaluate(self.iterator, evaluation=RegressionEvaluation(
+            model_output_width(trainer.model)))
         val = float(np.mean([getattr(ev, self.metric)(i) for i in range(ev.n)]))
         return -val if self.metric in self._HIGHER_IS_BETTER else val
 

@@ -71,13 +71,17 @@ def make_infer_fn(model):
     return infer
 
 
+def model_output_width(model) -> int:
+    """Width of the model's primary output (Sequential or Graph)."""
+    return (model.output_shape[-1] if isinstance(model, Sequential)
+            else model.output_shapes[0][-1])
+
+
 def default_evaluation(model):
     """Multiclass Evaluation sized to the model's primary output."""
     from ..eval import Evaluation
 
-    n_out = (model.output_shape[-1] if isinstance(model, Sequential)
-             else model.output_shapes[0][-1])
-    return Evaluation(n_out)
+    return Evaluation(model_output_width(model))
 
 
 def build_updater(model) -> optax.GradientTransformation:
