@@ -82,6 +82,41 @@ class TestMoE:
         assert float(train_loss) > float(eval_loss) + 5.0
         assert float(new_state["layer_0"]["aux_loss"]) >= 10.0
 
+    def test_aux_loss_in_graph_score(self):
+        """Graph.score must also collect layer aux losses."""
+        from deeplearning4j_tpu.nn.model import GraphBuilder
+
+        g = (GraphBuilder(NetConfig(seed=0)).add_input("in", (6,)))
+        g.add_layer("moe", L.MoE(num_experts=2, top_k=1, aux_loss_weight=10.0), "in")
+        g.add_layer("out", L.Output(n_out=3, activation="softmax", loss="mcxent"), "moe")
+        net = g.set_outputs("out").build()
+        params, state = net.init()
+        x = jax.random.normal(jax.random.PRNGKey(3), (16, 6))
+        y = jax.nn.one_hot(jnp.arange(16) % 3, 3)
+        train_loss, new_state = net.score(params, state, x, y, training=True)
+        eval_loss, _ = net.score(params, new_state, x, y, training=False)
+        assert float(train_loss) > float(eval_loss) + 5.0
+
+    def test_aux_loss_in_tbptt_score(self):
+        """score_with_carry (the tBPTT training path) must collect aux losses
+        too — otherwise MoE routers silently lose their balance gradient
+        under truncated BPTT."""
+        net = (SequentialBuilder(NetConfig(seed=0, tbptt_length=4))
+               .input_shape(8, 6)
+               .layer(L.SimpleRnn(n_out=6))
+               .layer(L.MoE(num_experts=2, top_k=1, aux_loss_weight=10.0))
+               .layer(L.RnnOutput(n_out=3, activation="softmax", loss="mcxent"))
+               .build())
+        params, state = net.init()
+        x = jax.random.normal(jax.random.PRNGKey(4), (4, 8, 6))
+        y = jax.nn.one_hot(jnp.arange(32).reshape(4, 8) % 3, 3)
+        carries = net.init_carries(4)
+        loss_t, _, _ = net.score_with_carry(params, state, x, y, carries,
+                                            training=True)
+        loss_e, _, _ = net.score_with_carry(params, state, x, y, carries,
+                                            training=False)
+        assert float(loss_t) > float(loss_e) + 5.0
+
     def test_moe_transformer_block_trains(self):
         from deeplearning4j_tpu.data import ArrayIterator
         from deeplearning4j_tpu.train import Trainer
