@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nn.model import Sequential
 from ..train.listeners import PerformanceListener, TrainingListener
-from ..train.trainer import build_updater
+from ..train.trainer import build_updater, check_not_donated
 from .mesh import DATA_AXIS, make_mesh
 
 
@@ -132,6 +132,7 @@ class MultiHostTrainer:
         self.tx = updater if updater is not None else build_updater(model)
         if model.params is None:
             model.init()
+        check_not_donated((model.params, model.state), "MultiHostTrainer")
         self._repl = NamedSharding(self.mesh, P())
         self._batch_sh = NamedSharding(self.mesh, P(DATA_AXIS))
         # every process initialized identically (same seed) -> the replicated

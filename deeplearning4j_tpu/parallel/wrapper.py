@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nn.model import Sequential
 from ..train.listeners import PerformanceListener, TrainingListener
-from ..train.trainer import build_updater
+from ..train.trainer import build_updater, check_not_donated
 from .mesh import DATA_AXIS, make_mesh
 
 
@@ -68,6 +68,7 @@ class ParallelWrapper:
         self.tx = build_updater(model)
         if model.params is None:
             model.init()
+        check_not_donated((model.params, model.state), "ParallelWrapper")
         self.n_dev = int(np.prod(self.mesh.devices.shape))
         self._rng = jax.random.PRNGKey(seed)
         self.iteration = 0

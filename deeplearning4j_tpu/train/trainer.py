@@ -84,6 +84,20 @@ def default_evaluation(model):
     return Evaluation(model_output_width(model))
 
 
+def check_not_donated(tree, who: str = "Trainer"):
+    """Raise a clear error when a params/state pytree holds buffers a previous
+    donating train step already consumed (``donate_argnums``) — otherwise the
+    failure surfaces as an opaque 'Array has been deleted' deep inside the
+    next jit call (SURVEY.md §5 donation/aliasing asserts)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if getattr(leaf, "is_deleted", lambda: False)():
+            raise ValueError(
+                f"{who}: the model holds donated (deleted) buffers — a "
+                f"previous jitted train step consumed them via buffer "
+                f"donation. Re-initialize (model.init()) or keep using the "
+                f"trainer that owns the live params/state.")
+
+
 def build_updater(model) -> optax.GradientTransformation:
     """Build the optax pipeline from NetConfig + per-layer overrides."""
     cfg: NetConfig = model.config
@@ -136,6 +150,7 @@ class Trainer:
         self.tx = updater if updater is not None else build_updater(model)
         if model.params is None:
             model.init()
+        check_not_donated((model.params, model.state), "Trainer")
         self.params = model.params
         self.state = model.state
         self.opt_state = self.tx.init(self.params)

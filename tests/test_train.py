@@ -480,3 +480,25 @@ class TestCLI:
         rc = cli_main(["train", "--model", "x.zip", "--csv", "y.csv"])
         assert rc == 2
         assert "--num-classes" in capsys.readouterr().err
+
+
+class TestDonationGuard:
+    def test_reusing_donated_params_raises_clearly(self, iris):
+        """A second Trainer built on a model whose param buffers were donated
+        by a previous jitted step must fail with an actionable message, not
+        an opaque 'Array has been deleted' inside jit (SURVEY.md §5)."""
+        import jax
+
+        x, y = iris
+        net = iris_net()
+        tr = Trainer(net)
+        step = tr._make_step()
+        import jax.numpy as jnp
+        p2, o2, s2, loss = step(tr.params, tr.opt_state, tr.state,
+                                jnp.asarray(x[:32]), jnp.asarray(y[:32]),
+                                jax.random.PRNGKey(0))
+        jax.block_until_ready(loss)
+        with pytest.raises(ValueError, match="donated"):
+            Trainer(net)
+        net.init()  # re-init clears the condition
+        Trainer(net)
