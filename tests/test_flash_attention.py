@@ -171,3 +171,19 @@ class TestReviewRegressions:
         q, k, v = _qkv(T=20)
         with pytest.raises(ValueError, match="multiples of 128"):
             flash_attention(q, k, v, block_q=96, block_k=96, interpret=False)
+
+
+def test_interpret_mode_odd_block_k():
+    """Regression: explicit block_k > 128 clamped to a non-multiple-of-128 T
+    in interpret mode must fall back to plain lane broadcast, not a
+    zero-width pltpu.repeat."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+    from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 200, 2, 16))
+    out = flash_attention(q, q, q, block_q=256, block_k=256)
+    ref = dot_product_attention(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
