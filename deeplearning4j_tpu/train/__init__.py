@@ -18,6 +18,8 @@ from .listeners import (CheckpointListener, CollectScoresListener,
 from .faults import (DivergenceListener, FaultTolerantFit,
                      TrainingDivergedException)
 from .profiler import PhaseTimer, ProfilerListener
+from .orbax_io import (load_model_json, restore_checkpoint,
+                       restore_trainer, save_checkpoint, save_trainer)
 from .serialization import load_model, save_model
 from .solvers import (Solver, SolverResult, backtrack_line_search,
                       cg_minimize, lbfgs_minimize, line_gradient_descent)

@@ -84,6 +84,15 @@ def save_model(path: str, model, *, params=None, state=None, opt_state=None,
             zf.writestr("normalizer.json", json.dumps(normalizer.to_dict()))
 
 
+def model_from_json(js: str):
+    """Dispatch architecture JSON to the right container class (single
+    source of the format-string convention)."""
+    from ..nn.model import Graph, Sequential
+
+    fmt = json.loads(js).get("format", "")
+    return Sequential.from_json(js) if "sequential" in fmt else Graph.from_json(js)
+
+
 def load_model(path: str, opt_state_template=None):
     """restoreMultiLayerNetwork / restoreComputationGraph equivalent.
 
@@ -91,12 +100,9 @@ def load_model(path: str, opt_state_template=None):
     are populated. opt_state needs a template (from Trainer.init) to rebuild
     its exact optax structure — pass None to skip.
     """
-    from ..nn.model import Graph, Sequential
-
     with zipfile.ZipFile(path) as zf:
         cfg = zf.read("configuration.json").decode()
-        fmt = json.loads(cfg).get("format", "")
-        model = Sequential.from_json(cfg) if "sequential" in fmt else Graph.from_json(cfg)
+        model = model_from_json(cfg)
         params = _load_npz(zf, "params.npz") or {}
         state = _load_npz(zf, "state.npz") or {}
         opt_state = None

@@ -183,7 +183,7 @@ def test_interpret_mode_odd_block_k():
     from deeplearning4j_tpu.ops.flash_attention import flash_attention
 
     q = jax.random.normal(jax.random.PRNGKey(0), (1, 200, 2, 16))
-    out = flash_attention(q, q, q, block_q=256, block_k=256)
+    out = flash_attention(q, q, q, block_q=256, block_k=256, interpret=True)
     ref = dot_product_attention(q, q, q)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)

@@ -1,0 +1,96 @@
+"""Orbax checkpoint bridge — sharded save/restore with preserved shardings
+and exact training continuation (SURVEY.md §5 checkpoint/resume)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec
+
+from deeplearning4j_tpu.data import ArrayIterator
+from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.mesh import cpu_test_mesh
+from deeplearning4j_tpu.train import Trainer
+from deeplearning4j_tpu.train.orbax_io import (load_model_json,
+                                               restore_trainer, save_trainer)
+
+
+def _net():
+    return (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                         "learning_rate": 1e-2}))
+            .input_shape(4)
+            .layer(L.Dense(n_out=16, activation="relu"))
+            .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 64)]
+    return x, y
+
+
+class TestOrbaxBridge:
+    def test_trainer_roundtrip_exact_continuation(self, tmp_path):
+        x, y = _data()
+        tr = Trainer(_net())
+        tr.fit(ArrayIterator(x, y, 32), epochs=5)
+        save_trainer(str(tmp_path / "ck"), tr)
+
+        tr2 = Trainer(load_model_json(str(tmp_path / "ck")))
+        restore_trainer(str(tmp_path / "ck"), tr2)
+        tr.fit(ArrayIterator(x, y, 32), epochs=3)
+        tr2.fit(ArrayIterator(x, y, 32), epochs=3)
+        for k in tr.params:
+            for pk in tr.params[k]:
+                np.testing.assert_allclose(np.asarray(tr.params[k][pk]),
+                                           np.asarray(tr2.params[k][pk]),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_sharded_optimizer_state_restores_sharded(self, tmp_path):
+        """zero_sharded wrapper: the checkpoint must restore optimizer leaves
+        back onto their data-axis shardings (no host-gathered fat restore)."""
+        x, y = _data()
+        pw = ParallelWrapper(_net(), mesh=cpu_test_mesh(8), mode="zero_sharded")
+        pw.fit(ArrayIterator(x, y, 32), epochs=3)
+        save_trainer(str(tmp_path / "ck"), pw)
+
+        pw2 = ParallelWrapper(load_model_json(str(tmp_path / "ck")),
+                              mesh=cpu_test_mesh(8), mode="zero_sharded")
+        restore_trainer(str(tmp_path / "ck"), pw2)
+        sharded = [a for a in jax.tree.leaves(pw2.opt_state)
+                   if hasattr(a, "sharding") and a.sharding.spec != PartitionSpec()]
+        assert sharded, "optimizer state came back fully replicated"
+        pw.fit(ArrayIterator(x, y, 32), epochs=2)
+        pw2.fit(ArrayIterator(x, y, 32), epochs=2)
+        pw._sync_model()
+        pw2._sync_model()
+        for k in pw.model.params:
+            for pk in pw.model.params[k]:
+                np.testing.assert_allclose(
+                    np.asarray(pw.model.params[k][pk]),
+                    np.asarray(pw2.model.params[k][pk]), rtol=1e-5, atol=1e-6)
+
+    def test_model_only_checkpoint_restores_into_trainer(self, tmp_path):
+        """save_checkpoint without opt state must still restore through
+        restore_trainer (fresh optimizer kept) and sync the model's params."""
+        from deeplearning4j_tpu.train.orbax_io import save_checkpoint
+
+        x, y = _data()
+        tr = Trainer(_net())
+        tr.fit(ArrayIterator(x, y, 32), epochs=3)
+        save_checkpoint(str(tmp_path / "ck"), tr.model, params=tr.params,
+                        state=tr.state)
+        tr2 = Trainer(load_model_json(str(tmp_path / "ck")))
+        restore_trainer(str(tmp_path / "ck"), tr2)
+        for k in tr.params:
+            for pk in tr.params[k]:
+                np.testing.assert_allclose(np.asarray(tr.params[k][pk]),
+                                           np.asarray(tr2.params[k][pk]))
+        # model-level inference reflects the restore immediately
+        np.testing.assert_allclose(
+            np.asarray(tr2.model.output(x[:4])),
+            np.asarray(tr.model.output(x[:4])), rtol=1e-6)
