@@ -175,3 +175,55 @@ class TestROCSaturatedScores:
         roc = ROC()
         roc.eval(labels, scores)
         assert roc.auc() == 0.0
+
+
+class TestSklearnOracle:
+    """Independent numerics oracle: exact-mode metrics must match sklearn on
+    realistic imbalanced predictions (SURVEY.md §7 hard part (e))."""
+
+    def test_classification_roc_regression_match_sklearn(self):
+        sk = pytest.importorskip("sklearn.metrics")
+        from deeplearning4j_tpu.eval import (Evaluation, ROC, ROCMultiClass,
+                                             RegressionEvaluation)
+        rng = np.random.RandomState(0)
+        N, C = 1000, 4
+        true = rng.choice(C, N, p=[0.55, 0.25, 0.15, 0.05])
+        logits = rng.randn(N, C) + 2.2 * np.eye(C)[true]
+        probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+        onehot = np.eye(C)[true]
+
+        ev = Evaluation(C)
+        ev.eval(onehot, probs)
+        pred = probs.argmax(1)
+        assert abs(ev.accuracy() - sk.accuracy_score(true, pred)) < 1e-9
+        for c in range(C):
+            assert abs(ev.precision(c) - sk.precision_score(
+                true, pred, labels=[c], average=None, zero_division=0)[0]) < 1e-9
+            assert abs(ev.recall(c) - sk.recall_score(
+                true, pred, labels=[c], average=None, zero_division=0)[0]) < 1e-9
+            assert abs(ev.f1(c) - sk.f1_score(
+                true, pred, labels=[c], average=None, zero_division=0)[0]) < 1e-9
+
+        scores = probs[:, 1]
+        is1 = (true == 1).astype(int)
+        roc = ROC(num_thresholds=0)
+        roc.eval(np.eye(2)[is1], np.stack([1 - scores, scores], 1))
+        assert abs(roc.auc() - sk.roc_auc_score(is1, scores)) < 1e-6
+
+        rm = ROCMultiClass(C, num_thresholds=0)
+        rm.eval(onehot, probs)
+        rm_hist = ROCMultiClass(C)  # DL4J-default 200-bin streaming mode
+        rm_hist.eval(onehot, probs)
+        for c in range(C):
+            ref = sk.roc_auc_score((true == c).astype(int), probs[:, c])
+            assert abs(rm.auc(c) - ref) < 1e-6
+            assert abs(rm_hist.auc(c) - ref) < 5e-4  # histogram approximation
+
+        yt = rng.randn(300, 3)
+        yp = yt + 0.3 * rng.randn(300, 3)
+        re = RegressionEvaluation(3)
+        re.eval(yt, yp)
+        for i in range(3):
+            assert abs(re.mse(i) - sk.mean_squared_error(yt[:, i], yp[:, i])) < 1e-9
+            assert abs(re.mae(i) - sk.mean_absolute_error(yt[:, i], yp[:, i])) < 1e-9
+            assert abs(re.r2(i) - sk.r2_score(yt[:, i], yp[:, i])) < 1e-9
