@@ -435,3 +435,48 @@ class TestYoloDecode:
         dets = get_predicted_objects(np.asarray(act), num_anchors=2,
                                      conf_threshold=0.1)
         assert len(dets) == 2  # per-image lists; contents depend on random grid
+
+
+class TestTorchOracle:
+    """torch (CPU) as an independent forward-math oracle — the
+    accelerated-vs-reference equivalence pattern (SURVEY.md §4) with an
+    external implementation."""
+
+    def test_conv2d_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(0)
+        B, H, W, Cin, Cout, K = 2, 11, 9, 3, 5, 3
+        x = rng.randn(B, H, W, Cin).astype(np.float32)
+        w = rng.randn(K, K, Cin, Cout).astype(np.float32)  # HWIO
+        b = rng.randn(Cout).astype(np.float32)
+        layer = L.Conv2D(n_out=Cout, kernel=(K, K), stride=(2, 2),
+                         padding="valid", activation="identity")
+        y, _, _ = layer.apply({"w": jnp.asarray(w), "b": jnp.asarray(b)}, {},
+                              jnp.asarray(x))
+        yt = torch.nn.functional.conv2d(
+            torch.tensor(x).permute(0, 3, 1, 2),
+            torch.tensor(w).permute(3, 2, 0, 1), torch.tensor(b), stride=2)
+        np.testing.assert_allclose(np.asarray(y),
+                                   yt.permute(0, 2, 3, 1).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_matches_torch(self):
+        """Same [i, f, g, o] fused-gate convention as torch — weights copy
+        over with a transpose and the sequence outputs must agree."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(0)
+        B, T, nin, Hd = 2, 7, 4, 6
+        xs = rng.randn(B, T, nin).astype(np.float32)
+        lstm = L.LSTM(n_out=Hd, forget_gate_bias_init=0.0)
+        params, _ = lstm.init(jax.random.PRNGKey(0), (T, nin))
+        ours, _ = lstm.apply_sequence(params, jnp.asarray(xs),
+                                      lstm.init_carry(B, (T, nin)))
+        tl = torch.nn.LSTM(nin, Hd, batch_first=True)
+        with torch.no_grad():
+            tl.weight_ih_l0.copy_(torch.tensor(np.asarray(params["w_ih"]).T))
+            tl.weight_hh_l0.copy_(torch.tensor(np.asarray(params["w_hh"]).T))
+            tl.bias_ih_l0.copy_(torch.tensor(np.asarray(params["b"])))
+            tl.bias_hh_l0.zero_()
+        yt, _ = tl(torch.tensor(xs))
+        np.testing.assert_allclose(np.asarray(ours), yt.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
