@@ -480,3 +480,24 @@ class TestTorchOracle:
         yt, _ = tl(torch.tensor(xs))
         np.testing.assert_allclose(np.asarray(ours), yt.detach().numpy(),
                                    rtol=1e-4, atol=1e-5)
+
+    def test_gru_reset_after_matches_torch(self):
+        """reset_after=True with [r, u, n] gate blocks is torch's GRU
+        convention exactly — weights copy with a transpose."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(0)
+        B, T, nin, H = 2, 7, 4, 6
+        xs = rng.randn(B, T, nin).astype(np.float32)
+        gru = L.GRU(n_out=H, reset_after=True)
+        params, _ = gru.init(jax.random.PRNGKey(0), (T, nin))
+        ours, _ = gru.apply_sequence(params, jnp.asarray(xs),
+                                     gru.init_carry(B, (T, nin)))
+        tg = torch.nn.GRU(nin, H, batch_first=True)
+        with torch.no_grad():
+            tg.weight_ih_l0.copy_(torch.tensor(np.asarray(params["w_ih"]).T))
+            tg.weight_hh_l0.copy_(torch.tensor(np.asarray(params["w_hh"]).T))
+            tg.bias_ih_l0.copy_(torch.tensor(np.asarray(params["b"])))
+            tg.bias_hh_l0.copy_(torch.tensor(np.asarray(params["b_hh"])))
+        yt, _ = tg(torch.tensor(xs))
+        np.testing.assert_allclose(np.asarray(ours), yt.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
