@@ -303,6 +303,11 @@ class TestGradients:
         (L.MultiHeadAttention(num_heads=2), (4, 6)),
         (L.PReLU(), (5,)),
         (L.ElementWiseMultiplication(), (5,)),
+        (L.RMSNorm(), (4,)),
+        (L.GRU(n_out=3, reset_after=True), (6, 2)),
+        (L.TransformerEncoderBlock(num_heads=2, mlp_ratio=2, activation="tanh"), (4, 6)),
+        (L.TransformerEncoderBlock(num_heads=2, mlp_ratio=2, activation="tanh",
+                                   remat=True), (4, 6)),
     ]
 
     @pytest.mark.parametrize("layer,in_shape", GRAD_CASES, ids=lambda c: type(c).__name__ if hasattr(c, "apply") else str(c))
