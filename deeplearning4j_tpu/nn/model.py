@@ -25,6 +25,7 @@ Serde: ``to_json``/``from_json`` round-trip the full architecture, parity with
 
 from __future__ import annotations
 
+import functools
 import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -72,6 +73,8 @@ class NetConfig:
     gradient_normalization_threshold: float = 1.0
     tbptt_length: int = 0  # 0 = full BPTT
     compute_dtype: Optional[str] = None  # e.g. "bfloat16" for MXU-native mixed precision
+    remat: bool = False  # gradient-checkpoint every layer apply: activations
+    # recomputed in the backward pass (one saved tensor per layer boundary)
 
     def to_dict(self):
         import dataclasses
@@ -96,6 +99,18 @@ def _collect_aux_losses(new_state):
 
 def _layer_key(i: int, layer: Layer) -> str:
     return layer.name or f"layer_{i}"
+
+
+def _apply_layer(cfg, layer, p, s, x, *, training, rng, mask):
+    """One layer application honoring ``NetConfig.remat`` (gradient
+    checkpointing), shared by Sequential and Graph. Layers that already
+    self-checkpoint (their own ``remat=True``, e.g. TransformerEncoderBlock)
+    are not double-wrapped — nesting would multiply backward recompute for
+    zero extra memory savings."""
+    if cfg.remat and not getattr(layer, "remat", False):
+        fn = jax.checkpoint(functools.partial(layer.apply, training=training))
+        return fn(p, s, x, rng=rng, mask=mask)
+    return layer.apply(p, s, x, training=training, rng=rng, mask=mask)
 
 
 class Sequential:
@@ -164,7 +179,9 @@ class Sequential:
             if cdt is not None:
                 p = _cast_floats(p, cdt)
             s = state.get(k, {})
-            x, s_out, mask = layer.apply(p, s, x, training=training, rng=rngs[i], mask=mask)
+            x, s_out, mask = _apply_layer(self.config, layer, p, s, x,
+                                          training=training, rng=rngs[i],
+                                          mask=mask)
             if s_out:
                 new_state[k] = s_out
         if cdt is not None:
@@ -424,8 +441,8 @@ class Graph:
                 p = params.get(name, {})
                 if cdt is not None:
                     p = _cast_floats(p, cdt)
-                y, s_out, m_out = node.spec.apply(
-                    p, state.get(name, {}), ins[0],
+                y, s_out, m_out = _apply_layer(
+                    self.config, node.spec, p, state.get(name, {}), ins[0],
                     training=training, rng=rngs.get(name), mask=m)
                 acts[name] = y
                 act_masks[name] = m_out
@@ -492,13 +509,15 @@ class Graph:
                 total = total + loss
                 if name not in consumed:  # leaf output: nothing downstream
                     continue              # needs its activation — skip apply
-                y, s_out, m_out = node.spec.apply(p, state.get(name, {}),
-                                                  ins[0], training=training, rng=rngs.get(name),
-                                                  mask=act_masks.get(node.inputs[0]))
+                y, s_out, m_out = _apply_layer(
+                    self.config, node.spec, p, state.get(name, {}), ins[0],
+                    training=training, rng=rngs.get(name),
+                    mask=act_masks.get(node.inputs[0]))
             else:
-                y, s_out, m_out = node.spec.apply(p, state.get(name, {}),
-                                                  ins[0], training=training, rng=rngs.get(name),
-                                                  mask=act_masks.get(node.inputs[0]))
+                y, s_out, m_out = _apply_layer(
+                    self.config, node.spec, p, state.get(name, {}), ins[0],
+                    training=training, rng=rngs.get(name),
+                    mask=act_masks.get(node.inputs[0]))
             acts[name], act_masks[name] = y, m_out
             if s_out:
                 new_state[name] = s_out

@@ -308,3 +308,70 @@ class TestLosslessGraphGuard:
         params, state = g.init()
         with pytest.raises(ValueError, match="transfer-learning"):
             g.score(params, state, jnp.zeros((2, 4)), jnp.zeros((2, 3)))
+
+
+class TestSequentialRemat:
+    def test_remat_identical_loss_and_grads(self):
+        """NetConfig.remat gradient-checkpoints every layer apply: losses and
+        gradients must be identical to the plain forward (memory/FLOPs trade
+        only), including state-carrying (BatchNorm) and rng-using layers."""
+        from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+        from deeplearning4j_tpu.nn import layers as L
+
+        def build(remat):
+            return (SequentialBuilder(NetConfig(seed=0, remat=remat))
+                    .input_shape(8, 8, 2)
+                    .layer(L.Conv2D(n_out=4, kernel=(3, 3), activation="relu"))
+                    .layer(L.BatchNorm(activation="relu"))
+                    .layer(L.Flatten())
+                    .layer(L.Dense(n_out=16, activation="relu",
+                                   dropout=0.3))
+                    .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+                    .build())
+
+        a, b = build(False), build(True)
+        pa, sa = a.init()
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8, 2))
+        y = jax.nn.one_hot(jnp.arange(4) % 3, 3)
+        rng = jax.random.PRNGKey(1)
+        la, st_a = a.score(pa, sa, x, y, training=True, rng=rng)
+        lb, st_b = b.score(pa, sa, x, y, training=True, rng=rng)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-7)
+        # BN running stats updated identically through the checkpointed apply
+        jax.tree.map(lambda u, v: np.testing.assert_allclose(
+            np.asarray(u), np.asarray(v), rtol=1e-6), st_a, st_b)
+        ga = jax.grad(lambda p: a.score(p, sa, x, y, training=True, rng=rng)[0])(pa)
+        gb = jax.grad(lambda p: b.score(p, sa, x, y, training=True, rng=rng)[0])(pa)
+        for u, v in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+            np.testing.assert_allclose(np.asarray(u), np.asarray(v),
+                                       rtol=1e-5, atol=1e-7)
+        # serde round-trips the flag
+        from deeplearning4j_tpu.nn.model import Sequential
+        assert Sequential.from_json(b.to_json()).config.remat is True
+
+    def test_graph_honors_remat(self):
+        """NetConfig.remat must apply to Graph containers too (not silently
+        drop — the lr-alias bug class)."""
+        from deeplearning4j_tpu.nn import NetConfig
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn.model import GraphBuilder
+
+        def build(remat):
+            g = GraphBuilder(NetConfig(seed=0, remat=remat)).add_input("in", (6,))
+            g.add_layer("d1", L.Dense(n_out=8, activation="tanh"), "in")
+            g.add_layer("out", L.Output(n_out=3, activation="softmax",
+                                        loss="mcxent"), "d1")
+            return g.set_outputs("out").build()
+
+        a, b = build(False), build(True)
+        pa, sa = a.init()
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 6))
+        y = jax.nn.one_hot(jnp.arange(4) % 3, 3)
+        la, _ = a.score(pa, sa, x, y, training=True)
+        lb, _ = b.score(pa, sa, x, y, training=True)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-7)
+        ga = jax.grad(lambda p: a.score(p, sa, x, y, training=True)[0])(pa)
+        gb = jax.grad(lambda p: b.score(p, sa, x, y, training=True)[0])(pa)
+        for u, v in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+            np.testing.assert_allclose(np.asarray(u), np.asarray(v),
+                                       rtol=1e-5, atol=1e-7)
