@@ -111,7 +111,7 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     img = int(os.environ.get("BENCH_IMG", 224 if on_tpu else 32))
-    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
+    steps = int(os.environ.get("BENCH_STEPS", 40 if on_tpu else 3))
     if os.environ.get("BENCH_BATCH"):  # explicit single batch wins (back-compat)
         batches = [int(os.environ["BENCH_BATCH"])]
     else:
