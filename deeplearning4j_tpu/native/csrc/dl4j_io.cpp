@@ -157,8 +157,11 @@ static uint32_t rd32(const unsigned char* p) {
 
 // Parse one member's npy header at `local_off` (zip local header offset).
 // Returns false on unsupported layout (compressed member, non-f32 dtype,
-// fortran order) — callers treat that file as unreadable.
-static bool parse_member(std::ifstream& f, int64_t local_off, NpyMember* out) {
+// fortran order) or on a corrupt/hostile shape whose element count overflows
+// or exceeds what the file can physically hold — callers treat that file as
+// unreadable. `file_size` bounds the data region.
+static bool parse_member(std::ifstream& f, int64_t local_off, int64_t file_size,
+                         NpyMember* out) {
   unsigned char lh[30];
   f.seekg(local_off);
   f.read(reinterpret_cast<char*>(lh), 30);
@@ -194,15 +197,21 @@ static bool parse_member(std::ifstream& f, int64_t local_off, NpyMember* out) {
     int64_t v = 0;
     bool any = false;
     while (pos < tup.size() && tup[pos] >= '0' && tup[pos] <= '9') {
+      if (v > (int64_t(1) << 50)) return false;  // hostile dim digits
       v = v * 10 + (tup[pos++] - '0');
       any = true;
     }
     if (!any) break;
     out->dims[out->ndim++] = v;
+    if (v != 0 && out->nelem > (int64_t(1) << 50) / v) return false;  // overflow
     out->nelem *= v;
   }
   if (out->ndim == 0) return false;
   out->data_offset = hstart + hlen;
+  // the claimed element count must fit in the file's remaining bytes —
+  // rejects corrupt headers before any resize()/read on the prefetch thread
+  if (out->nelem < 0 || out->nelem > (file_size - out->data_offset) / 4)
+    return false;
   return true;
 }
 
@@ -238,7 +247,7 @@ static bool parse_npz(const std::string& path, NpzFileInfo* info) {
     else if (name == "labels.npy") dst = &info->labels;
     else if (name == "features_mask.npy") dst = &info->fmask;
     else if (name == "labels_mask.npy") dst = &info->lmask;
-    if (dst && !parse_member(f, local_off, dst)) return false;
+    if (dst && !parse_member(f, local_off, size, dst)) return false;
     cd_off += 46 + nlen + xlen + clen;
   }
   return info->feats.present() && info->labels.present();
@@ -299,12 +308,18 @@ struct NpzDir {
       ld.idx = idx;
       bool ok = idx >= 0 && idx < int64_t(files.size());
       if (ok) {
-        const NpzFileInfo& fi = files[idx];
-        std::ifstream f(fi.path, std::ios::binary);
-        ok = f && load_member(f, fi.feats, &ld.feats) &&
-             load_member(f, fi.labels, &ld.labels) &&
-             load_member(f, fi.fmask, &ld.fmask) &&
-             load_member(f, fi.lmask, &ld.lmask);
+        // an uncaught bad_alloc/length_error on this thread would terminate
+        // the whole process; surface it as the ordinary -2 read failure
+        try {
+          const NpzFileInfo& fi = files[idx];
+          std::ifstream f(fi.path, std::ios::binary);
+          ok = f && load_member(f, fi.feats, &ld.feats) &&
+               load_member(f, fi.labels, &ld.labels) &&
+               load_member(f, fi.fmask, &ld.fmask) &&
+               load_member(f, fi.lmask, &ld.lmask);
+        } catch (...) {
+          ok = false;
+        }
       }
       {
         std::lock_guard<std::mutex> lk(mu);
@@ -386,9 +401,16 @@ int npzdir_set_order(void* hp, const int64_t* order, int64_t n) {
 }
 
 // Pop the next prefetched batch into caller buffers (sized via npzdir_shape).
-// Returns the file index, -1 at end-of-order, -2 on a read failure.
-int64_t npzdir_next(void* hp, float* feats, float* labels, float* fmask,
-                    float* lmask) {
+// Each *_cap is the caller buffer's size in ELEMENTS and must match the
+// loaded member exactly: larger would overflow the caller's heap, smaller
+// would leave uninitialized tail garbage in the caller's np.empty buffers
+// (files can legally be rewritten between shape caching and iteration, e.g.
+// a concurrent export_batches re-export).
+// Returns the file index, -1 at end-of-order, -2 on read failure, -3 on a
+// size mismatch.
+int64_t npzdir_next(void* hp, float* feats, int64_t feats_cap, float* labels,
+                    int64_t labels_cap, float* fmask, int64_t fmask_cap,
+                    float* lmask, int64_t lmask_cap) {
   auto* h = static_cast<NpzDir*>(hp);
   if (!h) return -2;
   NpzLoaded ld;
@@ -404,6 +426,11 @@ int64_t npzdir_next(void* hp, float* feats, float* labels, float* fmask,
     h->queue.pop_front();
   }
   h->cv_put.notify_all();
+  if (int64_t(ld.feats.size()) != feats_cap ||
+      int64_t(ld.labels.size()) != labels_cap ||
+      (fmask && int64_t(ld.fmask.size()) != fmask_cap) ||
+      (lmask && int64_t(ld.lmask.size()) != lmask_cap))
+    return -3;
   memcpy(feats, ld.feats.data(), ld.feats.size() * 4);
   memcpy(labels, ld.labels.data(), ld.labels.size() * 4);
   if (fmask && !ld.fmask.empty()) memcpy(fmask, ld.fmask.data(), ld.fmask.size() * 4);

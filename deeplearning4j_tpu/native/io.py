@@ -179,7 +179,7 @@ def _load_npz_api(lib):
                                      ctypes.c_int64]
     lib.npzdir_next.restype = ctypes.c_int64
     lib.npzdir_next.argtypes = [ctypes.c_void_p] + [
-        ctypes.POINTER(ctypes.c_float)] * 4
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64] * 4
     lib.npzdir_destroy.argtypes = [ctypes.c_void_p]
     lib._npz_ready = True
     return lib
@@ -259,9 +259,15 @@ class NativeFileDataSetIterator(DataSetIterator):
                 fm = np.empty(fms, np.float32) if fms else None
                 lm = np.empty(lms, np.float32) if lms else None
                 got = self._lib.npzdir_next(
-                    h, _fptr(f), _fptr(l),
+                    h, _fptr(f), f.size, _fptr(l), l.size,
                     _fptr(fm) if fm is not None else nullf,
-                    _fptr(lm) if lm is not None else nullf)
+                    fm.size if fm is not None else 0,
+                    _fptr(lm) if lm is not None else nullf,
+                    lm.size if lm is not None else 0)
+                if got == -3:
+                    raise RuntimeError(
+                        "native npz read: file changed size since shape "
+                        "caching (concurrent re-export?); rebuild the iterator")
                 if got < 0:
                     raise RuntimeError(f"native npz read failed (code {got})")
                 assert got == idx, (got, idx)

@@ -177,6 +177,11 @@ def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, do):
     O(bq·T), never (T, T)."""
     q, k, v, o, lse = res
     BH, T, D = q.shape
+    # Decoupled from the forward kernel's block width: the bwd is pure JAX
+    # (XLA-fused, far less sensitive to block size than Mosaic) and its
+    # per-step score tensor is O(BH·bq·T) — a 1024-wide fwd block would grow
+    # bwd peak memory 8x over 128 and can OOM a backward whose forward fits.
+    bq = min(bq, 256)
     qf, kf, vf = (a.astype(jnp.float32) for a in (q, k, v))
     dof = do.astype(jnp.float32)
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (BH, T)

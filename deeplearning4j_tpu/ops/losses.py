@@ -87,6 +87,22 @@ def mae(p, y, mask=None, weights=None):
     return _reduce(jnp.abs(p - y), mask, weights)
 
 
+def _is_sparse_labels(p, y):
+    """Sparse class-index labels = integer dtype AND one fewer trailing dim
+    than predictions. Integer labels at full rank (e.g. np.eye(...).astype(int)
+    one-hots) are ambiguous — reject loudly instead of silently gathering."""
+    y = jnp.asarray(y)
+    if not jnp.issubdtype(y.dtype, jnp.integer):
+        return False
+    if y.ndim == jnp.asarray(p).ndim - 1:
+        return True
+    raise ValueError(
+        f"integer labels with shape {y.shape} are ambiguous against "
+        f"predictions {jnp.asarray(p).shape}: cast one-hot labels to float "
+        f"for the dense loss, or drop the trailing class dim for sparse "
+        f"class-index labels")
+
+
 def _sparse_nll(logp, y, mask, weights):
     """Integer class-index labels: gather the target log-prob instead of a
     one-hot product — for large vocabularies (LM heads) this avoids ever
@@ -103,8 +119,8 @@ def _sparse_nll(logp, y, mask, weights):
 @register("negativeloglikelihood")
 def mcxent(p, y, mask=None, weights=None):
     """Multi-class cross-entropy on probabilities (post-softmax).
-    Integer-dtype ``y`` is treated as sparse class indices."""
-    if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer):
+    Integer ``y`` of rank ``p.ndim - 1`` is treated as sparse class indices."""
+    if _is_sparse_labels(p, y):
         return _sparse_nll(jnp.log(jnp.clip(p, _EPS, 1.0)), y, mask, weights)
     return _reduce(-y * jnp.log(jnp.clip(p, _EPS, 1.0)), mask, weights)
 
@@ -113,9 +129,9 @@ def mcxent(p, y, mask=None, weights=None):
 @register("softmax_cross_entropy_logits")
 def mcxent_logits(logits, y, mask=None, weights=None):
     """Fused softmax+CE on raw logits — numerically stable, XLA-fused.
-    Integer-dtype ``y`` is treated as sparse class indices."""
+    Integer ``y`` of rank ``logits.ndim - 1`` is treated as sparse indices."""
     logp = jax.nn.log_softmax(logits, axis=-1)
-    if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer):
+    if _is_sparse_labels(logits, y):
         return _sparse_nll(logp, y, mask, weights)
     return _reduce(-y * logp, mask, weights)
 

@@ -100,14 +100,17 @@ def restore_trainer(directory: str, trainer):
     residual = getattr(trainer, "residual", None)
     if residual is not None:
         template["residual"] = residual
-    try:
-        restored = restore_checkpoint(directory, template)
-    except Exception:
-        # checkpoint written without opt state / residual (e.g. plain
-        # save_checkpoint(dir, model)): retry with the reduced template
-        reduced = dict(template, opt_state={})
-        reduced.pop("residual", None)
-        restored = restore_checkpoint(directory, reduced)
+    # shape the template to what the checkpoint actually contains (a plain
+    # save_checkpoint(dir, model) writes opt_state={} and no residual) so a
+    # genuinely corrupt checkpoint or structure mismatch surfaces as ITS OWN
+    # error rather than a second, unrelated-looking retry failure
+    saved = _checkpointer().metadata(
+        os.path.join(os.path.abspath(directory), "arrays")).item_metadata.tree
+    if saved.get("opt_state") == {}:
+        template["opt_state"] = {}
+    if "residual" not in saved:
+        template.pop("residual", None)
+    restored = restore_checkpoint(directory, template)
     trainer.params = restored["params"]
     trainer.state = restored["net_state"]
     if restored.get("opt_state"):  # {} = checkpoint saved without opt state

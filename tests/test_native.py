@@ -221,3 +221,46 @@ class TestNativeNpzStreamer:
         np.testing.assert_array_equal(first, full[0])
         for a, b in zip(full[1:], rest):
             np.testing.assert_array_equal(a, b)
+
+    def test_file_grown_after_construction_fails_loudly(self, tmp_path):
+        """A file rewritten to a DIFFERENT size between shape caching
+        (__init__) and iteration must fail with a clear error: larger would
+        overflow the caller's numpy buffers, smaller would yield
+        uninitialized tail garbage as training data."""
+        from deeplearning4j_tpu.native.io import NativeFileDataSetIterator
+        self._export(tmp_path)
+        it = NativeFileDataSetIterator(str(tmp_path))
+        big_x = np.zeros((64, 6), np.float32)
+        big_y = np.zeros((64, 3), np.float32)
+        np.savez(tmp_path / "dataset_000002.npz", features=big_x, labels=big_y)
+        with pytest.raises(RuntimeError, match="changed size since shape caching"):
+            list(it)
+
+    def test_corrupt_header_huge_shape_fails_cleanly(self, tmp_path):
+        """A hostile/corrupt npy header claiming a huge shape must be
+        rejected at parse time (never a bad_alloc on the prefetch thread,
+        which would std::terminate the process)."""
+        from deeplearning4j_tpu.native.io import NativeFileDataSetIterator
+        self._export(tmp_path)
+        p = tmp_path / "dataset_000001.npz"
+        raw = bytearray(p.read_bytes())
+        # rewrite the ASCII shape digits of features.npy in place (STORED zip
+        # => plain bytes): same digit count keeps all zip offsets valid
+        i = raw.find(b"'shape': (")
+        j = raw.find(b")", i)
+        digits = raw[i + 10:j]
+        huge = b"99999999999999999999"[:len(digits)]
+        raw[i + 10:j] = huge
+        p.write_bytes(bytes(raw))
+        with pytest.raises(ValueError, match="no readable"):
+            NativeFileDataSetIterator(str(tmp_path))
+
+    def test_file_shrunk_after_construction_fails_loudly(self, tmp_path):
+        from deeplearning4j_tpu.native.io import NativeFileDataSetIterator
+        self._export(tmp_path)
+        it = NativeFileDataSetIterator(str(tmp_path))
+        np.savez(tmp_path / "dataset_000002.npz",
+                 features=np.zeros((2, 6), np.float32),
+                 labels=np.zeros((2, 3), np.float32))
+        with pytest.raises(RuntimeError, match="changed size"):
+            list(it)

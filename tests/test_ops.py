@@ -106,6 +106,17 @@ class TestLosses:
             np.testing.assert_allclose(float(fn(pred, idx, mask=mask)),
                                        float(fn(pred, onehot, mask=mask)), rtol=1e-5)
 
+    def test_integer_onehot_labels_rejected_loudly(self):
+        """Integer labels at FULL rank (np.eye(...).astype(int) one-hots or
+        argmax pipelines) are ambiguous — must raise a descriptive error, not
+        silently gather or fail deep inside take_along_axis."""
+        logits = jax.random.normal(jax.random.PRNGKey(3), (6, 4))
+        int_onehot = np.eye(4, dtype=np.int64)[np.arange(6) % 4]
+        for name, pred in (("mcxent_logits", logits),
+                           ("mcxent", jax.nn.softmax(logits))):
+            with pytest.raises(ValueError, match="ambiguous"):
+                losses.get(name)(pred, int_onehot)
+
     def test_xent_logits_stable(self):
         logits = jnp.array([[100.0, -100.0]])
         y = jnp.array([[1.0, 0.0]])
