@@ -23,6 +23,42 @@ def _to_np(a):
     return np.asarray(a)
 
 
+class _Mergeable:
+    """Distributed-evaluation protocol (IEvaluation.merge parity — the
+    reference evaluates ANY IEvaluation across the cluster and reduces:
+    dl4j-spark ``IEvaluateFlatMapFunction.java`` +
+    ``IEvaluationReduceFunction.java``). Subclasses list their additive
+    accumulator fields in ``_STATE_FIELDS``; everything needed for
+    per-process accumulate -> allgather -> merge follows:
+
+    - ``state()``: accumulators as a flat dict of numpy arrays (allgatherable)
+    - ``load_state(d)``: overwrite accumulators from such a dict
+    - ``merge(other)``: combine two accumulators (additive by default)
+    - ``new_like()``: empty instance with the same configuration
+    """
+
+    _STATE_FIELDS: Tuple[str, ...] = ()
+
+    def state(self) -> Dict[str, np.ndarray]:
+        return {f: np.asarray(getattr(self, f)) for f in self._STATE_FIELDS}
+
+    def load_state(self, d: Dict[str, np.ndarray]):
+        for f in self._STATE_FIELDS:
+            cur = getattr(self, f)
+            v = d[f]
+            setattr(self, f, type(cur)(v) if isinstance(cur, (int, float))
+                    else np.asarray(v))
+        return self
+
+    def merge(self, other):
+        for f in self._STATE_FIELDS:
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+        return self
+
+    def new_like(self):
+        raise NotImplementedError
+
+
 def _labels_to_idx(labels):
     labels = _to_np(labels)
     if labels.ndim >= 2 and labels.shape[-1] > 1:
@@ -30,11 +66,16 @@ def _labels_to_idx(labels):
     return labels.astype(np.int64).reshape(labels.shape[0], *labels.shape[1:-1]) if labels.ndim >= 2 else labels.astype(np.int64)
 
 
-class Evaluation:
+class Evaluation(_Mergeable):
     """eval/Evaluation.java — multiclass classification metrics.
 
     Accepts (B, K) batches or time-series (B, T, K) with optional (B, T) mask.
     """
+
+    _STATE_FIELDS = ("confusion", "top_n_correct", "top_n_total")
+
+    def new_like(self) -> "Evaluation":
+        return Evaluation(self.num_classes, self.top_n)
 
     def __init__(self, num_classes: int, top_n: int = 1):
         self.num_classes = num_classes
@@ -133,16 +174,13 @@ class Evaluation:
         lines.append(str(self.confusion))
         return "\n".join(lines)
 
-    def merge(self, other: "Evaluation") -> "Evaluation":
-        """Spark distributed-eval parity: combine accumulators."""
-        self.confusion += other.confusion
-        self.top_n_correct += other.top_n_correct
-        self.top_n_total += other.top_n_total
-        return self
-
-
-class EvaluationBinary:
+class EvaluationBinary(_Mergeable):
     """EvaluationBinary.java — per-output independent binary metrics."""
+
+    _STATE_FIELDS = ("tp", "fp", "tn", "fn")
+
+    def new_like(self) -> "EvaluationBinary":
+        return EvaluationBinary(self.n, self.threshold)
 
     def __init__(self, num_outputs: int, threshold: float = 0.5):
         self.n = num_outputs
@@ -181,8 +219,14 @@ class EvaluationBinary:
         return 2 * p * r / (p + r) if (p + r) else 0.0
 
 
-class RegressionEvaluation:
+class RegressionEvaluation(_Mergeable):
     """RegressionEvaluation.java — per-column MSE/MAE/RMSE/R²/correlation."""
+
+    _STATE_FIELDS = ("count", "sum_err2", "sum_abs_err", "sum_y", "sum_y2",
+                     "sum_p", "sum_p2", "sum_yp")
+
+    def new_like(self) -> "RegressionEvaluation":
+        return RegressionEvaluation(self.n)
 
     def __init__(self, num_columns: int):
         self.n = num_columns
@@ -238,12 +282,41 @@ class RegressionEvaluation:
         return "\n".join(cols)
 
 
-class ROC:
+class ROC(_Mergeable):
     """ROC.java — binary ROC/AUC + precision-recall curve via threshold sweep.
 
     ``num_thresholds=0`` keeps exact scores (DL4J "exact" mode); otherwise a
-    fixed-width histogram of scores is accumulated (streaming-friendly).
+    fixed-width histogram of scores is accumulated (streaming-friendly —
+    and the mode to use for DISTRIBUTED evaluation: exact-mode state is
+    variable-length and only merges when every process saw equal counts).
     """
+
+    _STATE_FIELDS = ("pos_hist", "neg_hist")  # histogram mode
+
+    def new_like(self) -> "ROC":
+        return ROC(self.num_thresholds)
+
+    def state(self):
+        if self.num_thresholds:
+            return super().state()
+        return {"scores": (np.concatenate(self._scores) if self._scores
+                           else np.zeros(0)),
+                "labels": (np.concatenate(self._labels) if self._labels
+                           else np.zeros(0))}
+
+    def load_state(self, d):
+        if self.num_thresholds:
+            return super().load_state(d)
+        self._scores = [np.asarray(d["scores"])]
+        self._labels = [np.asarray(d["labels"])]
+        return self
+
+    def merge(self, other: "ROC") -> "ROC":
+        if self.num_thresholds:
+            return super().merge(other)
+        self._scores.extend(other._scores)
+        self._labels.extend(other._labels)
+        return self
 
     def __init__(self, num_thresholds: int = 200):
         self.num_thresholds = num_thresholds
@@ -315,8 +388,26 @@ class ROC:
         return float(np.trapezoid(p, r))
 
 
-class ROCMultiClass:
+class ROCMultiClass(_Mergeable):
     """ROCMultiClass.java — one-vs-all ROC per class."""
+
+    def new_like(self) -> "ROCMultiClass":
+        return ROCMultiClass(len(self.rocs), self.rocs[0].num_thresholds
+                             if self.rocs else 200)
+
+    def state(self):
+        return {f"c{k}_{f}": v for k, r in enumerate(self.rocs)
+                for f, v in r.state().items()}
+
+    def load_state(self, d):
+        for k, r in enumerate(self.rocs):
+            r.load_state({f: d[f"c{k}_{f}"] for f in r.state()})
+        return self
+
+    def merge(self, other: "ROCMultiClass") -> "ROCMultiClass":
+        for r, o in zip(self.rocs, other.rocs):
+            r.merge(o)
+        return self
 
     def __init__(self, num_classes: int, num_thresholds: int = 200):
         self.rocs = [ROC(num_thresholds) for _ in range(num_classes)]
@@ -340,8 +431,13 @@ class ROCMultiClass:
         return float(np.mean([r.auc() for r in self.rocs]))
 
 
-class EvaluationCalibration:
+class EvaluationCalibration(_Mergeable):
     """EvaluationCalibration.java — reliability diagram + residual histogram."""
+
+    _STATE_FIELDS = ("bin_counts", "bin_pos", "bin_prob_sum")
+
+    def new_like(self) -> "EvaluationCalibration":
+        return EvaluationCalibration(self.num_bins)
 
     def __init__(self, num_bins: int = 10):
         self.num_bins = num_bins

@@ -14,16 +14,12 @@ from functools import partial
 from typing import Optional, Tuple
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..nn import layers as L
 from ..nn.model import NetConfig, Sequential, SequentialBuilder
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
-from ..parallel.ring_attention import ring_attention_local
-from ..parallel.sharding import TRANSFORMER_RULES, sharding_tree
+from ..parallel.sharding import TRANSFORMER_RULES
 from .zoo import ZooModel, register_model
 
 
@@ -108,10 +104,6 @@ class CausalLM(ZooModel):
 # Fully-sharded training step: dp x tp x sp over one mesh.
 # ---------------------------------------------------------------------------
 
-def _shard_specs_params(params, mesh):
-    return sharding_tree(params, mesh, TRANSFORMER_RULES)
-
-
 def sharded_lm_step(model: Sequential, mesh: Mesh, tx: optax.GradientTransformation):
     """Build a jit-compiled train step with:
 
@@ -120,36 +112,28 @@ def sharded_lm_step(model: Sequential, mesh: Mesh, tx: optax.GradientTransformat
     - activations sequence-sharded over ``seq`` (SP) via sharding constraints —
       GSPMD decomposes the attention einsums into collective-permuted blocks.
 
-    Returns (step_fn, placed_params, opt_state, placement helpers).
+    A thin functional wrapper over the one sharding API
+    (``parallel.sharding``: place_params / batch_sharding /
+    activation_sharding — the same machinery behind
+    ``Trainer(mesh=, rules=)``). Returns (step_fn, placed_params,
+    opt_state, placement helper).
     """
     assert model.params is not None, "init() the model first"
-    p_spec = _shard_specs_params(model.params, mesh)
-    repl = NamedSharding(mesh, P())
-    params = jax.tree.map(lambda a, s: jax.device_put(a, s), model.params, p_spec)
-    opt_state = jax.tree.map(lambda a: jax.device_put(a, repl), tx.init(params))
-    batch_sh = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    from ..parallel.sharding import (activation_sharding, batch_sharding,
+                                     place_params)
 
-    def constrain(x):
-        if x.ndim == 3:
-            return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None)))
-        return x
+    params = place_params(model.params, mesh, TRANSFORMER_RULES)
+    # eager init: moments inherit the params' shardings (a jitted init
+    # would give constants fresh single-device layouts)
+    opt_state = tx.init(params)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, targets, rng):
         def loss_fn(p):
-            # token/positional embed + blocks with activation constraints
-            x = tokens
-            state: dict = {}
-            for i, layer in enumerate(model.layers[:-1]):
-                key = f"layer_{i}"
-                x, _, _ = layer.apply(p.get(key, {}), state.get(key, {}), x,
-                                      training=True, rng=None)
-                if hasattr(x, "ndim") and x.ndim == 3:
-                    x = constrain(x)
-            out_layer = model.layers[-1]
-            key = f"layer_{len(model.layers) - 1}"
-            return out_layer.score(p.get(key, {}), {}, x, targets)
+            with activation_sharding(mesh):
+                loss, _ = model.score(p, {}, tokens, targets, training=True,
+                                      rng=rng)
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -157,7 +141,7 @@ def sharded_lm_step(model: Sequential, mesh: Mesh, tx: optax.GradientTransformat
         return params, opt_state, loss
 
     def place_batch(tokens, targets):
-        return (jax.device_put(tokens, batch_sh),
-                jax.device_put(targets, NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS, None))))
+        return (jax.device_put(tokens, batch_sharding(mesh, tokens)),
+                jax.device_put(targets, batch_sharding(mesh, targets)))
 
     return step, params, opt_state, place_batch

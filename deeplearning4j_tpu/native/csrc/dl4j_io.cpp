@@ -177,6 +177,10 @@ static bool parse_member(std::ifstream& f, int64_t local_off, int64_t file_size,
   int64_t hlen, hstart;
   if (major == 1) { hlen = rd16(mh + 8); hstart = npy_off + 10; }
   else { hlen = rd32(mh + 8); hstart = npy_off + 12; }
+  // bound the header length BEFORE allocating: a hostile 32-bit hlen would
+  // otherwise allocate ~4GB (or throw bad_alloc through the ctypes FFI
+  // frame on the main thread, which has no catch and would std::terminate)
+  if (hlen <= 0 || hstart + hlen > file_size) return false;
   std::string hdr(hlen, '\0');
   f.seekg(hstart);
   f.read(&hdr[0], hlen);

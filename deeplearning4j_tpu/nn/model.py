@@ -101,6 +101,18 @@ def _layer_key(i: int, layer: Layer) -> str:
     return layer.name or f"layer_{i}"
 
 
+# Optional activation-sharding hook (parallel/sharding.activation_sharding
+# installs it for the duration of a jit TRACE): called on every layer output
+# so with_sharding_constraint pins dp/sp layouts between layers for ANY
+# Sequential/Graph without the model knowing about meshes. A ContextVar so
+# concurrent traces (threads / nested models over different meshes) can't
+# cross-apply each other's mesh. None = no-op.
+import contextvars
+
+ACTIVATION_CONSTRAINT: "contextvars.ContextVar" = contextvars.ContextVar(
+    "dl4j_tpu_activation_constraint", default=None)
+
+
 def _apply_layer(cfg, layer, p, s, x, *, training, rng, mask):
     """One layer application honoring ``NetConfig.remat`` (gradient
     checkpointing), shared by Sequential and Graph. Layers that already
@@ -109,8 +121,13 @@ def _apply_layer(cfg, layer, p, s, x, *, training, rng, mask):
     zero extra memory savings."""
     if cfg.remat and not getattr(layer, "remat", False):
         fn = jax.checkpoint(functools.partial(layer.apply, training=training))
-        return fn(p, s, x, rng=rng, mask=mask)
-    return layer.apply(p, s, x, training=training, rng=rng, mask=mask)
+        y, s_out, m_out = fn(p, s, x, rng=rng, mask=mask)
+    else:
+        y, s_out, m_out = layer.apply(p, s, x, training=training, rng=rng, mask=mask)
+    constrain = ACTIVATION_CONSTRAINT.get()
+    if constrain is not None:
+        y = constrain(y)
+    return y, s_out, m_out
 
 
 class Sequential:

@@ -17,16 +17,21 @@ from .pipeline import (from_microbatches, pipeline_apply,
                        stack_stage_params, to_microbatches)
 from .ring_attention import (reference_attention, ring_attention,
                              ring_attention_local)
-from .sharding import (CNN_RULES, TRANSFORMER_RULES, constrain_activations,
+from .sharding import (CNN_RULES, DENSE_RULES, TRANSFORMER_RULES,
+                       activation_sharding, batch_sharding,
+                       constrain_activations, place_batch, place_params,
                        shard_params, sharding_tree)
 from .wrapper import ParallelWrapper
 
-__all__ = ["CNN_RULES", "DATA_AXIS", "EXPERT_AXIS", "EncodedGradientsAccumulator",
+__all__ = ["CNN_RULES", "DATA_AXIS", "DENSE_RULES", "EXPERT_AXIS",
+           "EncodedGradientsAccumulator",
            "MODEL_AXIS", "MultiHostTrainer", "PIPE_AXIS", "ParallelInference",
            "ParallelWrapper", "ProcessShardIterator", "initialize_multihost",
-           "SEQ_AXIS", "SparseUpdate", "TRANSFORMER_RULES", "bitmap_decode",
+           "SEQ_AXIS", "SparseUpdate", "TRANSFORMER_RULES",
+           "activation_sharding", "batch_sharding", "bitmap_decode",
            "bitmap_encode", "constrain_activations", "cpu_test_mesh",
-           "distributed_init", "from_microbatches", "make_mesh", "pipeline_apply",
+           "distributed_init", "from_microbatches", "make_mesh",
+           "pipeline_apply", "place_batch", "place_params",
            "reference_attention", "replicate", "stack_stage_params",
            "to_microbatches",
            "ring_attention", "ring_attention_local", "shard_batch",

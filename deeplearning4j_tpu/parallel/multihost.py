@@ -88,9 +88,12 @@ class ProcessShardIterator:
 
     def __init__(self, features, labels, global_batch_size: int,
                  process_id: Optional[int] = None,
-                 num_processes: Optional[int] = None):
+                 num_processes: Optional[int] = None,
+                 features_mask=None, labels_mask=None):
         self.x = np.asarray(features)
         self.y = np.asarray(labels)
+        self.fm = None if features_mask is None else np.asarray(features_mask)
+        self.lm = None if labels_mask is None else np.asarray(labels_mask)
         self.gb = int(global_batch_size)
         self.pid = jax.process_index() if process_id is None else process_id
         self.np_ = jax.process_count() if num_processes is None else num_processes
@@ -107,8 +110,10 @@ class ProcessShardIterator:
         for i in range(self.n_batches):
             g0 = i * self.gb
             lo = g0 + self.pid * self.local_b
-            yield DataSet(self.x[lo : lo + self.local_b],
-                          self.y[lo : lo + self.local_b])
+            sl = slice(lo, lo + self.local_b)
+            yield DataSet(self.x[sl], self.y[sl],
+                          self.fm[sl] if self.fm is not None else None,
+                          self.lm[sl] if self.lm is not None else None)
 
     def reset(self):
         pass
@@ -126,48 +131,257 @@ class MultiHostTrainer:
 
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  updater: Optional[optax.GradientTransformation] = None,
-                 seed: int = 0):
+                 seed: int = 0, rules=None, mode: str = "shared_gradients",
+                 threshold: float = 1e-3, capacity_frac: float = 0.05,
+                 quantize: bool = True):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.tx = updater if updater is not None else build_updater(model)
         if model.params is None:
             model.init()
         check_not_donated((model.params, model.state), "MultiHostTrainer")
+        self.rules = tuple(rules) if rules is not None else ()
+        self.mode = mode
         self._repl = NamedSharding(self.mesh, P())
         self._batch_sh = NamedSharding(self.mesh, P(DATA_AXIS))
-        # every process initialized identically (same seed) -> the replicated
-        # global arrays are consistent without a broadcast
-        self.params = jax.device_put(model.params, self._repl)
-        self.state = jax.device_put(model.state, self._repl)
-        self.opt_state = jax.device_put(self.tx.init(self.params), self._repl)
         self._rng = jax.random.PRNGKey(seed)
         self.iteration = 0
         self.epoch = 0
+        if mode == "encoded_gradients":
+            if rules:
+                raise ValueError("encoded_gradients replicates full model "
+                                 "copies per worker; rules= (tp/sp sharding) "
+                                 "only applies to mode='shared_gradients'")
+            self._init_encoded(threshold, capacity_frac, quantize)
+            return
+        if mode != "shared_gradients":
+            raise ValueError(f"Unknown mode '{mode}'")
+        # every process initialized identically (same seed) -> placement by
+        # callback is consistent without a broadcast; rules=() replicates
+        # (pure dp), rules shard params over the mesh's model/seq axes (the
+        # same one-sharding-API surface as Trainer(mesh=, rules=))
+        from .sharding import place_params, replicate_on_mesh
+
+        self.params = place_params(model.params, self.mesh, self.rules)
+        self.state = jax.tree.map(
+            lambda a: replicate_on_mesh(a, self.mesh), model.state)
+        # eager init: optimizer moments inherit each param's sharding
+        # (jit would give constants fresh single-device layouts); leaves
+        # with NO param dependence (adam's step count) come out
+        # single-device — re-place those replicated over the mesh
+        self.opt_state = jax.tree.map(
+            lambda a: a if getattr(getattr(a, "sharding", None), "mesh",
+                                   None) == self.mesh
+            else replicate_on_mesh(a, self.mesh), self.tx.init(self.params))
         self._step = self._make_step()
 
     @property
     def is_main(self) -> bool:
         return jax.process_index() == 0
 
+    def _dp_coverage(self) -> "tuple[list, int]":
+        """(sorted data-axis block indices this process's devices cover,
+        data-axis size)."""
+        names = list(self.mesh.axis_names)
+        if DATA_AXIS not in names:
+            return [0], 1
+        ax = names.index(DATA_AXIS)
+        local = set(jax.local_devices())
+        coords = {int(pos[ax]) for pos, d in np.ndenumerate(self.mesh.devices)
+                  if d in local}
+        return sorted(coords), int(self.mesh.devices.shape[ax])
+
+    def data_shard(self) -> "tuple[int, int]":
+        """(shard_index, num_shards) this process must feed — the data-plane
+        contract for meshes with model/seq axes: batch rows are sharded over
+        the ``data`` axis only, so processes whose devices sit in the same
+        data block (tp/sp peers) must supply the SAME rows. Pass the result
+        to ``ProcessShardIterator(process_id=, num_processes=)``. On a pure
+        dp mesh this degenerates to (process_index, process_count)."""
+        coords, dp = self._dp_coverage()
+        if jax.process_count() == 1:
+            return 0, 1
+        if len(coords) != 1:
+            raise ValueError(
+                f"this process's devices span data-axis blocks {coords} "
+                f"— feed per-device shards instead of one process shard")
+        return coords[0], dp
+
     def next_rng(self):
         self._rng, k = jax.random.split(self._rng)
         return k
+
+    # --- encoded_gradients: threshold-compressed update exchange over the
+    # process-spanning worker axis (the DCN-oriented option; the multi-host
+    # counterpart of ParallelWrapper(mode="encoded_gradients") and the
+    # semantic port of SharedTrainingMaster's Aeron gradient sharing,
+    # SharedTrainingMaster.java:493 + EncodingHandler.java:139). One worker
+    # per device across ALL processes; each encodes its local update to
+    # capacity indices(+signs/values), an all_gather crosses the wire
+    # (gloo/DCN), every worker applies the identical decoded mean. ---
+    def _init_encoded(self, threshold: float, capacity_frac: float,
+                      quantize: bool):
+        from functools import partial as _partial
+
+        from jax.flatten_util import ravel_pytree
+
+        from .compression import threshold_encode, topk_encode
+
+        mesh, tx, model = self.mesh, self.tx, self.model
+        n = int(np.prod(mesh.devices.shape))
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if axis_sizes.get(DATA_AXIS, 0) != n:
+            raise ValueError(f"encoded_gradients needs a pure data-parallel "
+                             f"mesh ({DATA_AXIS}={n}); got {axis_sizes}")
+        if quantize and threshold <= 0:
+            raise ValueError("encoded_gradients with quantize=True needs "
+                             "threshold>0 (use quantize=False for exact top-k)")
+        flat0, unravel = ravel_pytree(model.params)
+        size = flat0.shape[0]
+        capacity = max(1, min(size, int(size * capacity_frac)))
+        self._n_workers = n
+        dev_sh = self._batch_sh
+
+        def stack(tree):
+            """One replica per worker, stacked over the (global) data axis —
+            each process builds only its addressable shards from the shared
+            host copy (consistent across processes by same-seed init)."""
+            def one(a):
+                a = np.asarray(a)
+                gshape = (n,) + a.shape
+                rows = dev_sh.shard_shape(gshape)[0]
+                return jax.make_array_from_callback(
+                    gshape, dev_sh,
+                    lambda idx, _a=a, _r=rows: np.broadcast_to(
+                        _a[np.newaxis], (_r,) + _a.shape))
+
+            return jax.tree.map(one, tree)
+
+        self._stack = stack
+        self.params = stack(model.params)
+        self.state = stack(model.state)
+        self.opt_state = stack(tx.init(model.params))
+        rows = dev_sh.shard_shape((n, size))[0]
+        self.residual = jax.make_array_from_callback(
+            (n, size), dev_sh, lambda idx: np.zeros((rows, size), np.float32))
+        seq = isinstance(model, Sequential)
+
+        def make_step(with_fm: bool, with_lm: bool):
+            def local_step(params, opt_state, net_state, residual, x, y, rng, *masks):
+                params, opt_state, net_state = (jax.tree.map(lambda a: a[0], t)
+                                                for t in (params, opt_state, net_state))
+                residual, x, y = residual[0], x[0], y[0]
+                fm = masks[0][0] if with_fm else None
+                lm = masks[int(with_fm)][0] if with_lm else None
+                mask_kw = ({"mask": fm, "label_mask": lm} if seq
+                           else {"masks": fm, "label_masks": lm})
+
+                def loss_fn(p):
+                    loss, new_state = model.score(p, net_state, x, y,
+                                                  training=True, rng=rng[0],
+                                                  **mask_kw)
+                    return loss, new_state
+
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                # updater first, then the resulting update is encoded and
+                # shared (StochasticGradientDescent.java:66-74 order)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                flat = ravel_pytree(updates)[0].astype(jnp.float32)
+                if quantize:
+                    enc, new_residual = threshold_encode(flat, threshold,
+                                                         capacity, residual)
+                    values = enc.signs.astype(jnp.float32) * threshold
+                else:
+                    enc, new_residual = topk_encode(flat, threshold,
+                                                    capacity, residual)
+                    values = enc.values
+                g_idx = jax.lax.all_gather(enc.indices, DATA_AXIS)
+                g_val = jax.lax.all_gather(values, DATA_AXIS)
+                dense = jnp.zeros((size,), jnp.float32).at[g_idx.ravel()].add(
+                    g_val.ravel() / n)
+                params = optax.apply_updates(params, unravel(dense))
+                expand = lambda t: jax.tree.map(lambda a: a[None], t)  # noqa: E731
+                return (expand(params), expand(opt_state), expand(new_state),
+                        new_residual[None], loss[None])
+
+            n_in = 7 + int(with_fm) + int(with_lm)
+            sharded = jax.shard_map(
+                local_step, mesh=mesh,
+                in_specs=(P(DATA_AXIS),) * n_in,
+                out_specs=(P(DATA_AXIS),) * 5,
+                check_vma=False)
+            return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
+
+        self._enc_steps = {}
+        self._make_enc_step = make_step
+        self._loss_mean = jax.jit(jnp.mean, out_shardings=self._repl)
+
+    def _global_replica_batch(self, local):
+        """(local_b, ...) process-local rows -> global (n_workers, per, ...)
+        replica-major array sharded one worker per device."""
+        if local is None:
+            return None
+        local = np.asarray(local)
+        per_worker = (local.shape[0] * jax.process_count()) // self._n_workers
+        if per_worker == 0 or local.shape[0] % max(per_worker, 1):
+            raise ValueError(
+                f"local batch {local.shape[0]} rows not divisible over "
+                f"{self._n_workers} workers ({jax.process_count()} processes)")
+        lw = local.shape[0] // per_worker
+        lr = local.reshape(lw, per_worker, *local.shape[1:])
+        gshape = (self._n_workers, per_worker) + local.shape[1:]
+        return jax.make_array_from_process_local_data(self._batch_sh, lr, gshape)
+
+    def _fit_batch_encoded(self, ds):
+        x = self._global_replica_batch(ds.features)
+        y = self._global_replica_batch(ds.labels)
+        fm = self._global_replica_batch(ds.features_mask)
+        lm = self._global_replica_batch(ds.labels_mask)
+        # per-worker rng streams: every process computes the same global
+        # (n, 2) key array and contributes its slice (device order is
+        # process-major, matching the mesh layout)
+        rngs_h = np.asarray(jax.random.split(self.next_rng(), self._n_workers))
+        pid, pc = jax.process_index(), jax.process_count()
+        local_rngs = rngs_h.reshape(pc, self._n_workers // pc,
+                                    *rngs_h.shape[1:])[pid]
+        rngs = jax.make_array_from_process_local_data(
+            self._batch_sh, local_rngs, rngs_h.shape)
+        key = (fm is not None, lm is not None)
+        if key not in self._enc_steps:
+            self._enc_steps[key] = self._make_enc_step(*key)
+        extra = tuple(m for m in (fm, lm) if m is not None)
+        (self.params, self.opt_state, self.state, self.residual,
+         loss) = self._enc_steps[key](
+            self.params, self.opt_state, self.state, self.residual,
+            x, y, rngs, *extra)
+        return self._loss_mean(loss)
 
     def _make_step(self):
         tx, model = self.tx, self.model
         repl = self._repl
         seq = isinstance(model, Sequential)
+        from .sharding import activation_sharding
+
+        # outputs keep their inputs' shardings (params/opt_state may be
+        # rule-sharded over model/seq axes). net_state gets the single `repl`
+        # leaf — a valid tree-prefix for ANY output structure, robust to
+        # layers that add state keys on the first training step.
+        p_sh = jax.tree.map(lambda a: a.sharding, self.params)
+        o_sh = jax.tree.map(lambda a: a.sharding, self.opt_state)
+        mesh = self.mesh
 
         @partial(jax.jit, donate_argnums=(0, 1, 2),
-                 out_shardings=(repl, repl, repl, repl))
+                 out_shardings=(p_sh, o_sh, repl, repl))
         def step(params, opt_state, net_state, x, y, rng, mask=None,
                  label_mask=None):
             mask_kw = ({"mask": mask, "label_mask": label_mask} if seq
                        else {"masks": mask, "label_masks": label_mask})
 
             def loss_fn(p):
-                loss, new_state = model.score(p, net_state, x, y,
-                                              training=True, rng=rng, **mask_kw)
+                with activation_sharding(mesh):
+                    loss, new_state = model.score(p, net_state, x, y,
+                                                  training=True, rng=rng, **mask_kw)
                 return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -179,12 +393,18 @@ class MultiHostTrainer:
 
     def _global_batch(self, ds):
         """Assemble global sharded arrays from this process's local rows
-        (no host gather; remote shards stay remote). Masks included when set."""
+        (no host gather; remote shards stay remote). Masks included when set.
+        The global row count comes from data-axis COVERAGE, not process
+        count: tp/sp peer processes supply duplicate rows of the same data
+        block (see ``data_shard``)."""
+        coords, dp = self._dp_coverage()
+        mult = dp // len(coords)  # 1 in single-process mode (covers all)
+
         def put(local):
             if local is None:
                 return None
             local = np.asarray(local)
-            gshape = (local.shape[0] * jax.process_count(),) + local.shape[1:]
+            gshape = (local.shape[0] * mult,) + local.shape[1:]
             return jax.make_array_from_process_local_data(self._batch_sh, local, gshape)
 
         return (put(ds.features), put(ds.labels),
@@ -212,10 +432,13 @@ class MultiHostTrainer:
                     if isinstance(lst, PerformanceListener):
                         lst.step_begin(int(np.asarray(ds.features).shape[0])
                                        * jax.process_count())
-                x, y, mask, label_mask = self._global_batch(ds)
-                self.params, self.opt_state, self.state, loss = self._step(
-                    self.params, self.opt_state, self.state, x, y,
-                    self.next_rng(), mask, label_mask)
+                if self.mode == "encoded_gradients":
+                    loss = self._fit_batch_encoded(ds)
+                else:
+                    x, y, mask, label_mask = self._global_batch(ds)
+                    self.params, self.opt_state, self.state, loss = self._step(
+                        self.params, self.opt_state, self.state, x, y,
+                        self.next_rng(), mask, label_mask)
                 reporter.report(self.iteration, epoch, loss)
                 self.iteration += 1
             reporter.flush()
@@ -226,15 +449,27 @@ class MultiHostTrainer:
         self._sync_model()
         return self
 
-    def _sync_model(self):
-        """Pull the (replicated) params back to the host model. Uses the
-        process-local shard of the replicated arrays — identical on all
-        processes by construction."""
-        def local(a):
-            return np.asarray(a.addressable_shards[0].data)
+    def _to_host(self, a):
+        """One array -> full host value. Encoded mode reads any process's
+        first worker row (replicas are lockstep-identical); replicated
+        leaves read their local shard; rule-sharded multi-process leaves
+        go through ONE cached jitted identity resharded to replicated (an
+        all-gather every process must execute in lockstep)."""
+        if self.mode == "encoded_gradients":
+            return np.asarray(a.addressable_shards[0].data)[0]
+        if getattr(a, "is_fully_addressable", True):
+            return np.asarray(a)  # single-process: direct (sharded ok)
+        if not hasattr(self, "_gather_fn"):  # ONE jitted identity, reused —
+            self._gather_fn = jax.jit(       # a per-leaf lambda would defeat
+                lambda x: x, out_shardings=self._repl)  # the jit cache
+        g = self._gather_fn(a)
+        return np.asarray(g.addressable_shards[0].data)
 
-        self.model.params = jax.tree.map(local, self.params)
-        self.model.state = jax.tree.map(local, self.state)
+    def _sync_model(self):
+        """Pull the full params back to the host model (collective when
+        params are rule-sharded multi-process — call in lockstep)."""
+        self.model.params = jax.tree.map(self._to_host, self.params)
+        self.model.state = jax.tree.map(self._to_host, self.state)
 
     def score_iterator(self, iterator) -> float:
         """Average loss over an iterator of LOCAL shards, computed on the
@@ -246,40 +481,57 @@ class MultiHostTrainer:
 
             self._score_fn = make_score_fn(self.model)
 
+        if self.mode == "encoded_gradients":
+            # stacked replicas don't fit the score fn: use one synced copy,
+            # replicated over the mesh (identical on all processes)
+            from .sharding import replicate_on_mesh
+
+            self._sync_model()
+            sparams = jax.tree.map(lambda a: replicate_on_mesh(a, self.mesh),
+                                   self.model.params)
+            sstate = jax.tree.map(lambda a: replicate_on_mesh(a, self.mesh),
+                                  self.model.state)
+        else:
+            sparams, sstate = self.params, self.state
+
         total, n_batches = 0.0, 0
         for ds in iterator:
             x, y, mask, _ = self._global_batch(ds)
-            total += float(self._score_fn(self.params, self.state, x, y, mask))
+            total += float(self._score_fn(sparams, sstate, x, y, mask))
             n_batches += 1
         if hasattr(iterator, "reset"):
             iterator.reset()
         return total / max(n_batches, 1)
 
     def evaluate(self, iterator, evaluation=None):
-        """Distributed evaluation (dl4j-spark evaluation parity: each
-        executor evaluates its partition, the driver merges accumulators).
-        Each process forwards its LOCAL shard rows on its own devices, then
-        the per-process confusion accumulators merge with one tiny
-        all-gather. Multiclass ``Evaluation`` only (the accumulators that
-        all-reduce)."""
-        from ..eval import Evaluation
+        """Distributed evaluation for ANY mergeable evaluation type
+        (dl4j-spark parity: each executor evaluates its partition, the
+        driver reduces — ``IEvaluateFlatMapFunction.java`` +
+        ``IEvaluationReduceFunction.java``). Each process forwards its LOCAL
+        shard rows on its own devices and accumulates into a fresh instance;
+        the per-process accumulator dicts merge with one tiny all-gather.
+        Works for Evaluation / EvaluationBinary / RegressionEvaluation /
+        ROC (histogram mode) / ROCMultiClass / EvaluationCalibration — any
+        object implementing the ``_Mergeable`` protocol (new_like / state /
+        load_state / merge)."""
         from ..train.trainer import default_evaluation, make_infer_fn
 
         self._sync_model()
         if evaluation is None:
             evaluation = default_evaluation(self.model)
-        elif not isinstance(evaluation, Evaluation):
-            raise TypeError("distributed evaluate requires a (mergeable) "
-                            "multiclass Evaluation")
+        for attr in ("new_like", "state", "load_state", "merge", "eval"):
+            if not hasattr(evaluation, attr):
+                raise TypeError(
+                    f"distributed evaluate requires a mergeable evaluation "
+                    f"(new_like/state/load_state/merge); "
+                    f"{type(evaluation).__name__} lacks .{attr}")
 
         if not hasattr(self, "_infer_fn") or self._infer_fn is None:
             self._infer_fn = make_infer_fn(self.model)  # cache across calls
 
-        # snapshot so the cross-process merge sums only THIS call's counts
-        # (a pre-populated evaluation must not be re-summed x process_count)
-        conf0 = evaluation.confusion.copy()
-        topc0, topt0 = evaluation.top_n_correct, evaluation.top_n_total
-
+        # accumulate THIS call's counts into a fresh instance so a
+        # pre-populated evaluation is never re-summed x process_count
+        local = evaluation.new_like()
         params = jax.device_put(self.model.params)  # host->device once
         state = jax.device_put(self.model.state)
         for ds in iterator:
@@ -287,28 +539,81 @@ class MultiHostTrainer:
                 params, state, jnp.asarray(np.asarray(ds.features)),
                 (jnp.asarray(np.asarray(ds.features_mask))
                  if ds.features_mask is not None else None))
-            evaluation.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+            local.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
         if hasattr(iterator, "reset"):
             iterator.reset()
 
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            gathered = multihost_utils.process_allgather(
-                {"confusion": (evaluation.confusion - conf0).astype(np.int64),
-                 "top_n_correct": np.int64(evaluation.top_n_correct - topc0),
-                 "top_n_total": np.int64(evaluation.top_n_total - topt0)})
-            evaluation.confusion = conf0 + np.asarray(gathered["confusion"]).sum(0)
-            evaluation.top_n_correct = topc0 + int(np.asarray(gathered["top_n_correct"]).sum())
-            evaluation.top_n_total = topt0 + int(np.asarray(gathered["top_n_total"]).sum())
+            try:
+                gathered = multihost_utils.process_allgather(local.state())
+            except Exception as e:
+                raise ValueError(
+                    "distributed evaluate could not allgather accumulator "
+                    "state — exact-mode ROC (num_thresholds=0) has "
+                    "variable-length state; use histogram mode "
+                    "(num_thresholds>0) for multi-process evaluation"
+                ) from e
+            for i in range(jax.process_count()):
+                evaluation.merge(evaluation.new_like().load_state(
+                    jax.tree.map(lambda a: np.asarray(a)[i], gathered)))
+        else:
+            evaluation.merge(local)
         return evaluation
 
     def save(self, path: str, normalizer=None):
-        """Checkpoint from process 0 only (driver-side ModelSerializer parity)."""
-        if not self.is_main:
-            return
+        """Checkpoint INCLUDING updater state (ModelSerializer.java:141-145
+        always persists updaterState.bin; without it a resumed run silently
+        restarts Adam moments). Only process 0 writes, but this is a
+        COLLECTIVE: every process must call it in lockstep (the rule-sharded
+        gather and the write barrier both block) — do NOT guard with
+        ``if trainer.is_main: trainer.save(...)``, that deadlocks. Same
+        convention as orbax multi-host save."""
         from ..train.serialization import save_model
 
-        self._sync_model()
-        save_model(path, self.model, params=self.model.params,
-                   state=self.model.state, opt_state=None, normalizer=normalizer)
+        self._sync_model()  # lockstep: every process gathers
+        host_opt = jax.tree.map(self._to_host, self.opt_state)
+        if self.is_main:
+            save_model(path, self.model, params=self.model.params,
+                       state=self.model.state, opt_state=host_opt,
+                       normalizer=normalizer)
+        if jax.process_count() > 1:
+            # barrier: a peer that proceeds to restore() before process 0
+            # finishes writing would read a partial file and deadlock the
+            # next collective (orbax does this barrier internally; the zip
+            # path needs it explicitly)
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("dl4j_tpu_save")
+
+    def restore(self, path: str):
+        """Resume from a ``save`` checkpoint: params/state/opt_state are
+        re-placed on the mesh with their original shardings, so a restored
+        run continues EXACTLY (resume-equivalence, SURVEY §5)."""
+        from ..train.serialization import load_model
+        from .sharding import replicate_on_mesh
+
+        template = (self.tx.init(self.model.params)
+                    if self.mode == "encoded_gradients" else self.opt_state)
+        _, params, state, opt_state, _ = load_model(
+            path, opt_state_template=template)
+        self.model.params, self.model.state = params, state
+        if self.mode == "encoded_gradients":
+            self.params = self._stack(params)
+            self.state = self._stack(state)
+            if opt_state is not None:
+                self.opt_state = self._stack(opt_state)
+            return self
+        from .sharding import place_params
+
+        self.params = place_params(params, self.mesh, self.rules)
+        self.state = jax.tree.map(
+            lambda a: replicate_on_mesh(a, self.mesh), state)
+        if opt_state is not None:
+            sh = jax.tree.map(lambda a: a.sharding, self.opt_state)
+            self.opt_state = jax.tree.map(
+                lambda a, s: jax.make_array_from_callback(
+                    np.asarray(a).shape, s,
+                    lambda idx, _a=np.asarray(a): _a[idx]), opt_state, sh)
+        return self

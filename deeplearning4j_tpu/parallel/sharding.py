@@ -43,6 +43,13 @@ CNN_RULES: Rules = (
     (r".*/b$", P(MODEL_AXIS)),
 )
 
+# Plain MLP stacks: column-parallel every dense kernel (output dim). GSPMD
+# inserts the gather/reduce between consecutive column-split matmuls.
+DENSE_RULES: Rules = (
+    (r".*/w$", P(None, MODEL_AXIS)),
+    (r".*/b$", P(MODEL_AXIS)),
+)
+
 
 def _tree_paths(tree, prefix=""):
     out = []
@@ -57,15 +64,17 @@ def _tree_paths(tree, prefix=""):
 def spec_for(path: str, leaf, rules: Rules, mesh: Mesh) -> P:
     for pattern, spec in rules:
         if re.fullmatch(pattern, path):
-            # drop axes that don't divide the dim (fallback to replication)
+            # drop axes missing from this mesh or not dividing the dim
+            # (fallback to replication) — rules are written once and work on
+            # any mesh shape (a pure-dp mesh replicates everything)
             dims = np.asarray(leaf).shape
             fixed = []
             for i, ax in enumerate(spec):
                 if ax is None or i >= len(dims):
                     fixed.append(None)
                     continue
-                size = mesh.shape[ax] if isinstance(ax, str) else 1
-                fixed.append(ax if dims[i] % max(size, 1) == 0 else None)
+                size = mesh.shape.get(ax, 0) if isinstance(ax, str) else 1
+                fixed.append(ax if size > 0 and dims[i] % size == 0 else None)
             return P(*fixed)
     return P()
 
@@ -109,3 +118,107 @@ def constrain_activations(x, mesh: Mesh, *, batch_axis: str = DATA_AXIS,
     else:
         spec = P(batch_axis)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+# ---------------------------------------------------------------------------
+# The "one sharding API" (SURVEY §7): Trainer/MultiHostTrainer take mesh= +
+# rules= and any Sequential/Graph trains dp x tp x sp. The pieces:
+#   - activation_sharding: installs the per-layer-output constraint hook in
+#     nn.model for the duration of a jit TRACE,
+#   - batch_sharding / place_batch: rank/dtype-aware dp(+sp) batch layout,
+#   - place_params: rules -> NamedSharding placement that also works on a
+#     process-spanning mesh (multi-host) where plain device_put can't.
+# ---------------------------------------------------------------------------
+
+
+class activation_sharding:
+    """Context manager: while active (use INSIDE the traced step so it wraps
+    exactly the trace), every layer output in Sequential/Graph forward/score
+    gets a dp(+sp) with_sharding_constraint. Keeps batch-dim layouts pinned
+    between layers so GSPMD never falls back to a gathered intermediate."""
+
+    def __init__(self, mesh: Mesh, *, batch_axis: str = DATA_AXIS,
+                 seq_axis: Optional[str] = SEQ_AXIS):
+        self.mesh = mesh
+        self.batch_axis = batch_axis if batch_axis in mesh.shape else None
+        self.seq_axis = (seq_axis if seq_axis and seq_axis in mesh.shape
+                         and mesh.shape[seq_axis] > 1 else None)
+
+    def _constrain(self, x):
+        if not hasattr(x, "ndim") or x.ndim < 2:
+            return x
+        sp = self.seq_axis
+        if x.ndim == 3:  # (B, T, D): sequence-shard when T divides
+            sp = sp if sp and x.shape[1] % self.mesh.shape[sp] == 0 else None
+            spec = P(self.batch_axis, sp, None)
+        else:  # (B, D) / (B, H, W, C) / ...: batch only
+            spec = P(self.batch_axis, *([None] * (x.ndim - 1)))
+        if x.shape[0] % max(self.mesh.shape.get(self.batch_axis, 1), 1):
+            return x  # ragged batch: leave the layout to GSPMD
+        return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
+
+    def __enter__(self):
+        from ..nn import model as _m
+
+        self._token = _m.ACTIVATION_CONSTRAINT.set(self._constrain)
+        return self
+
+    def __exit__(self, *exc):
+        from ..nn import model as _m
+
+        _m.ACTIVATION_CONSTRAINT.reset(self._token)
+        return False
+
+
+def batch_sharding(mesh: Mesh, x, *, batch_axis: str = DATA_AXIS,
+                   seq_axis: str = SEQ_AXIS) -> NamedSharding:
+    """dp(+sp) sharding for one batch array, by rank/dtype:
+
+    - dim 0 over ``data`` when divisible;
+    - dim 1 over ``seq`` for rank>=3 arrays and for rank-2 INTEGER arrays
+      (token ids / sparse targets (B, T)) when divisible — rank-2 floats are
+      (B, features) MLP batches whose dim 1 is not a sequence.
+    """
+    x = np.asarray(x) if not hasattr(x, "shape") else x
+    dims: List[Optional[str]] = [None] * x.ndim
+    if batch_axis in mesh.shape and x.ndim >= 1 and \
+            x.shape[0] % mesh.shape[batch_axis] == 0:
+        dims[0] = batch_axis
+    seqish = x.ndim >= 3 or (x.ndim == 2 and np.issubdtype(x.dtype, np.integer))
+    if seq_axis in mesh.shape and mesh.shape[seq_axis] > 1 and seqish and \
+            x.ndim >= 2 and x.shape[1] % mesh.shape[seq_axis] == 0:
+        dims[1] = seq_axis
+    return NamedSharding(mesh, P(*dims))
+
+
+def place_batch(mesh: Mesh, *arrays, batch_axis: str = DATA_AXIS,
+                seq_axis: str = SEQ_AXIS):
+    """device_put each (non-None) array with its ``batch_sharding``."""
+    return tuple(
+        None if a is None else jax.device_put(
+            a, batch_sharding(mesh, np.asarray(a), batch_axis=batch_axis,
+                              seq_axis=seq_axis))
+        for a in arrays)
+
+
+def replicate_on_mesh(a, mesh: Mesh):
+    """Place one host array replicated over the mesh — works on a
+    process-spanning mesh (every process must hold the same host value;
+    callback placement needs no cross-process broadcast)."""
+    h = np.asarray(a)
+    sh = NamedSharding(mesh, P())
+    return jax.make_array_from_callback(h.shape, sh, lambda idx, _h=h: _h[idx])
+
+
+def place_params(params, mesh: Mesh, rules: Rules):
+    """Place a params pytree per rules — works on a single-process mesh AND
+    a process-spanning (multi-host) mesh. Every process must hold the same
+    host values (true after same-seed init), which
+    ``make_array_from_callback`` slices per-device."""
+    specs = sharding_tree(params, mesh, rules)
+
+    def place(leaf, sh):
+        a = np.asarray(leaf)
+        return jax.make_array_from_callback(a.shape, sh, lambda idx: a[idx])
+
+    return jax.tree.map(place, params, specs)

@@ -142,18 +142,51 @@ def build_updater(model) -> optax.GradientTransformation:
 
 
 class Trainer:
-    """Owns (params, state, opt_state) and the jitted step — Solver parity."""
+    """Owns (params, state, opt_state) and the jitted step — Solver parity.
+
+    The one sharding API (SURVEY §7): pass ``mesh=`` (a jax.sharding.Mesh
+    with any of the data/model/seq axes) and optionally ``rules=`` (path
+    regex -> PartitionSpec, e.g. ``parallel.sharding.TRANSFORMER_RULES`` /
+    ``DENSE_RULES`` / ``CNN_RULES``) and ANY Sequential/Graph trains
+    dp x tp x sp: params are placed per rules, batches are dp(+sp)-sharded,
+    activations carry with_sharding_constraints between layers, and GSPMD
+    inserts the collectives. No rules = pure data parallelism. Replaces the
+    reference's single-device-params restriction (SURVEY §2.4.5) rather than
+    porting it."""
 
     def __init__(self, model, updater: Optional[optax.GradientTransformation] = None,
-                 seed: int = 0):
+                 seed: int = 0, mesh=None, rules=None):
         self.model = model
         self.tx = updater if updater is not None else build_updater(model)
         if model.params is None:
             model.init()
         check_not_donated((model.params, model.state), "Trainer")
-        self.params = model.params
-        self.state = model.state
+        self.mesh = mesh
+        self.rules = tuple(rules) if rules is not None else ()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.sharding import place_params
+
+            self.params = place_params(model.params, mesh, self.rules)
+            self.state = jax.device_put(model.state, NamedSharding(mesh, P()))
+        else:
+            self.params = model.params
+            self.state = model.state
+        # eager init on placed params: zeros_like/ones_like follow their
+        # input's sharding, so adam moments land sharded like their params
+        # (a jitted init would NOT propagate — constants get fresh layouts);
+        # leaves with no param dependence (adam's step count) come out
+        # single-device — re-place those replicated over the mesh
         self.opt_state = self.tx.init(self.params)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            self.opt_state = jax.tree.map(
+                lambda a: a if getattr(getattr(a, "sharding", None), "mesh",
+                                       None) == mesh
+                else jax.device_put(a, repl), self.opt_state)
         self.iteration = 0
         self.epoch = 0
         self._rng = jax.random.PRNGKey(seed)
@@ -161,13 +194,55 @@ class Trainer:
         self._tbptt_step_fn = None
         self._infer_fn = None
 
+    def _place_batch(self, *arrays):
+        """dp(+sp)-shard batch arrays when training over a mesh. Each element
+        may be an array or a (Graph multi-input) dict/list of arrays."""
+        if self.mesh is None:
+            return arrays
+        from ..parallel.sharding import batch_sharding
+
+        def put(leaf):
+            # keep device arrays on device (AsyncIterator may have
+            # device_put them already — device_put reshards D2D, so no
+            # blocking host roundtrip); only host data goes through numpy
+            a = (leaf if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+                 else np.asarray(leaf))
+            return jax.device_put(a, batch_sharding(self.mesh, a))
+
+        return tuple(None if a is None else jax.tree.map(put, a)
+                     for a in arrays)
+
+    def _mesh_jit_setup(self, n_unpinned_outputs: int):
+        """(act_ctx, jit kwargs) for a mesh-aware jitted step: the activation
+        constraint context plus out_shardings pinning params/opt_state to
+        their placed shardings — without the pin GSPMD may hand params back
+        re-laid-out, drifting from the rules and forcing a retrace on the
+        next step. ``n_unpinned_outputs`` outputs between opt_state and the
+        loss stay unspecified (net_state — layers may add keys on the first
+        training step — and tBPTT carries)."""
+        if self.mesh is None:
+            import contextlib
+
+            return contextlib.nullcontext, {}
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharding import activation_sharding
+
+        mesh = self.mesh
+        jit_kw = {"out_shardings": (
+            jax.tree.map(lambda a: a.sharding, self.params),
+            jax.tree.map(lambda a: a.sharding, self.opt_state),
+            *([None] * n_unpinned_outputs), NamedSharding(mesh, P()))}
+        return (lambda: activation_sharding(mesh)), jit_kw
+
     # --- the jitted train step ---
     def _make_step(self):
         tx, model = self.tx, self.model
 
         seq = isinstance(model, Sequential)
+        act_ctx, jit_kw = self._mesh_jit_setup(n_unpinned_outputs=1)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        @partial(jax.jit, donate_argnums=(0, 1, 2), **jit_kw)
         def step(params, opt_state, net_state, x, y, rng, mask=None, label_mask=None):
             if seq:
                 mask_kw = {"mask": mask, "label_mask": label_mask}
@@ -175,8 +250,11 @@ class Trainer:
                 mask_kw = {"masks": mask, "label_masks": label_mask}
 
             def loss_fn(p):
-                loss, new_state = model.score(p, net_state, x, y, training=True,
-                                              rng=rng, **mask_kw)
+                # the context wraps the TRACE: every layer output gets a
+                # dp(+sp) sharding constraint when training over a mesh
+                with act_ctx():
+                    loss, new_state = model.score(p, net_state, x, y, training=True,
+                                                  rng=rng, **mask_kw)
                 return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -189,8 +267,9 @@ class Trainer:
     def _make_tbptt_step(self):
         tx, model = self.tx, self.model
         assert isinstance(model, Sequential), "tBPTT fit targets Sequential RNNs"
+        act_ctx, jit_kw = self._mesh_jit_setup(n_unpinned_outputs=2)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        @partial(jax.jit, donate_argnums=(0, 1, 2), **jit_kw)
         def step(params, opt_state, net_state, x, y, rng, carries, mask=None,
                  label_mask=None):
             """One tBPTT chunk: grads flow within the chunk; carries are
@@ -198,9 +277,10 @@ class Trainer:
             carries = jax.lax.stop_gradient(carries)
 
             def loss_fn(p):
-                loss, new_state, new_carries = model.score_with_carry(
-                    p, net_state, x, y, carries, training=True, rng=rng,
-                    mask=mask, label_mask=label_mask)
+                with act_ctx():
+                    loss, new_state, new_carries = model.score_with_carry(
+                        p, net_state, x, y, carries, training=True, rng=rng,
+                        mask=mask, label_mask=label_mask)
                 return loss, (new_state, new_carries)
 
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -247,10 +327,11 @@ class Trainer:
                 if tbptt and np.asarray(ds.features).ndim >= 3:
                     loss = self._fit_tbptt_batch(ds, tbptt)
                 else:
+                    x, y, fm, lm = self._place_batch(
+                        ds.features, ds.labels, ds.features_mask, ds.labels_mask)
                     self.params, self.opt_state, self.state, loss = self._step_fn(
                         self.params, self.opt_state, self.state,
-                        ds.features, ds.labels, self.next_rng(),
-                        ds.features_mask, ds.labels_mask)
+                        x, y, self.next_rng(), fm, lm)
                 reporter.report(self.iteration, epoch, loss)
                 self.iteration += 1
             reporter.flush()
@@ -286,6 +367,7 @@ class Trainer:
                             [(0, 0), (0, pad)])
                 if lmc is not None:
                     lmc = np.pad(lmc, [(0, 0), (0, pad)])
+            xc, yc, mc, lmc = self._place_batch(xc, yc, mc, lmc)
             self.params, self.opt_state, self.state, carries, l = self._tbptt_step_fn(
                 self.params, self.opt_state, self.state, xc, yc, self.next_rng(),
                 carries, mc, lmc)

@@ -227,3 +227,57 @@ class TestSklearnOracle:
             assert abs(re.mse(i) - sk.mean_squared_error(yt[:, i], yp[:, i])) < 1e-9
             assert abs(re.mae(i) - sk.mean_absolute_error(yt[:, i], yp[:, i])) < 1e-9
             assert abs(re.r2(i) - sk.r2_score(yt[:, i], yp[:, i])) < 1e-9
+
+
+class TestMergeProtocol:
+    """IEvaluation.merge parity: evaluating a split stream on two instances
+    and merging must equal one instance over the whole stream — for EVERY
+    evaluation type (the reduce step of distributed evaluation,
+    dl4j-spark IEvaluationReduceFunction.java)."""
+
+    def _pairs(self):
+        rng = np.random.RandomState(3)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 64)]
+        p = rng.dirichlet(np.ones(4), 64).astype(np.float32)
+        return y, p
+
+    def _check(self, make, stats_of, rtol=1e-12):
+        y, p = self._pairs()
+        whole = make().eval(y, p)
+        a, b = make().eval(y[:32], p[:32]), make().eval(y[32:], p[32:])
+        merged = a.merge(b)
+        for f, v in whole.state().items():
+            np.testing.assert_allclose(merged.state()[f], v, rtol=rtol,
+                                       err_msg=f)
+        np.testing.assert_allclose(stats_of(merged), stats_of(whole), rtol=1e-9)
+        # state round-trip: load_state(state()) reproduces the metrics
+        rt = make().load_state(whole.state())
+        np.testing.assert_allclose(stats_of(rt), stats_of(whole), rtol=1e-12)
+
+    def test_evaluation(self):
+        self._check(lambda: Evaluation(4), lambda e: e.accuracy())
+
+    def test_binary(self):
+        self._check(lambda: EvaluationBinary(4), lambda e: e.f1(1))
+
+    def test_regression(self):
+        self._check(lambda: RegressionEvaluation(4), lambda e: e.rmse(0))
+
+    def test_roc_hist(self):
+        self._check(lambda: ROC(num_thresholds=50), lambda e: e.auc())
+
+    def test_roc_exact_merge(self):
+        y, p = self._pairs()
+        yb, pb = y[:, 1], p[:, 1]
+        whole = ROC(num_thresholds=0).eval(yb, pb)
+        merged = (ROC(num_thresholds=0).eval(yb[:32], pb[:32])
+                  .merge(ROC(num_thresholds=0).eval(yb[32:], pb[32:])))
+        np.testing.assert_allclose(merged.auc(), whole.auc(), rtol=1e-12)
+
+    def test_roc_multiclass(self):
+        self._check(lambda: ROCMultiClass(4, num_thresholds=50),
+                    lambda e: e.average_auc())
+
+    def test_calibration(self):
+        self._check(lambda: EvaluationCalibration(10),
+                    lambda e: e.expected_calibration_error())

@@ -25,7 +25,8 @@ def _free_port() -> int:
     return p
 
 
-def _spawn_workers(nprocs: int, outdir: str, timeout: int = 240):
+def _spawn_workers(nprocs: int, outdir: str, timeout: int = 240,
+                   mode: str = "mlp"):
     port = _free_port()
     env = dict(os.environ)
     # strip the TPU-tunnel site hook: every interpreter would otherwise open
@@ -36,7 +37,8 @@ def _spawn_workers(nprocs: int, outdir: str, timeout: int = 240):
     env.pop("XLA_FLAGS", None)
     worker = os.path.join(REPO, "tests", "multihost_worker.py")
     procs = [subprocess.Popen(
-        [sys.executable, worker, str(pid), str(nprocs), str(port), outdir],
+        [sys.executable, worker, str(pid), str(nprocs), str(port), outdir,
+         mode],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for pid in range(nprocs)]
     outs = []
@@ -96,6 +98,30 @@ def test_two_process_training_matches_single_process(tmp_path):
     np.testing.assert_allclose(float(got["dist_score"]),
                                tr.score_iterator(_ListIter()), rtol=1e-5)
 
+    # EVERY mergeable evaluation type: distributed accumulate+merge must
+    # equal the single-process accumulators (IEvaluationReduceFunction.java)
+    from deeplearning4j_tpu.eval import (EvaluationBinary,
+                                         EvaluationCalibration,
+                                         RegressionEvaluation, ROC,
+                                         ROCMultiClass)
+
+    singles = {
+        "bin": tr.evaluate(_ListIter(), EvaluationBinary(3)),
+        "reg": tr.evaluate(_ListIter(), RegressionEvaluation(3)),
+        "roc": tr.evaluate(_ListIter(), ROC(num_thresholds=100)),
+        "rocmc": tr.evaluate(_ListIter(), ROCMultiClass(3, num_thresholds=100)),
+        "cal": tr.evaluate(_ListIter(), EvaluationCalibration(10)),
+    }
+    for prefix, single in singles.items():
+        for f, v in single.state().items():
+            np.testing.assert_allclose(
+                got[f"{prefix}_{f}"], v, rtol=1e-6, atol=1e-9,
+                err_msg=f"distributed {prefix}.{f} != single-process")
+    # and the derived metrics agree
+    dist_roc = ROC(num_thresholds=100).load_state(
+        {f: got[f"roc_{f}"] for f in ("pos_hist", "neg_hist")})
+    np.testing.assert_allclose(dist_roc.auc(), singles["roc"].auc(), rtol=1e-9)
+
 
 def test_single_process_multidevice_mode(tmp_path):
     """MultiHostTrainer degenerates to single-process multi-device sync DP
@@ -111,3 +137,114 @@ def test_single_process_multidevice_mode(tmp_path):
     leaves = [np.asarray(v) for v in
               __import__("jax").tree_util.tree_leaves(tr.model.params)]
     assert all(np.isfinite(a).all() for a in leaves)
+
+
+def test_save_restore_resume_equivalence(tmp_path):
+    """ModelSerializer.java:141-145 parity: save() persists updater state,
+    so save-mid-training -> restore -> continue == uninterrupted run (Adam
+    moments continue, not restart)."""
+    from deeplearning4j_tpu.data.iterators import DataSet
+    from deeplearning4j_tpu.parallel import (DATA_AXIS, DENSE_RULES,
+                                             MODEL_AXIS, MultiHostTrainer,
+                                             ProcessShardIterator, make_mesh)
+    from multihost_worker import build_net, make_data
+    import jax
+
+    x, y = make_data()
+    mesh = make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2}, jax.devices()[:8])
+
+    # uninterrupted: 2 epochs straight
+    tr_a = MultiHostTrainer(build_net(), mesh=mesh, seed=0, rules=DENSE_RULES)
+    it = ProcessShardIterator(x, y, global_batch_size=16)
+    tr_a.fit(it, epochs=2)
+    tr_a._sync_model()
+
+    # interrupted: 1 epoch, save, fresh trainer, restore, 1 more epoch
+    tr_b = MultiHostTrainer(build_net(), mesh=mesh, seed=0, rules=DENSE_RULES)
+    tr_b.fit(ProcessShardIterator(x, y, global_batch_size=16), epochs=1)
+    ckpt = str(tmp_path / "mh.zip")
+    tr_b.save(ckpt)
+    tr_c = MultiHostTrainer(build_net(), mesh=mesh, seed=0, rules=DENSE_RULES)
+    tr_c.restore(ckpt)
+    tr_c._rng = tr_b._rng  # same rng stream as the uninterrupted run
+    tr_c.fit(ProcessShardIterator(x, y, global_batch_size=16), epochs=1)
+    tr_c._sync_model()
+
+    for k in tr_a.model.params:
+        for k2, v in tr_a.model.params[k].items():
+            np.testing.assert_allclose(
+                np.asarray(tr_c.model.params[k][k2]), np.asarray(v),
+                rtol=1e-5, atol=1e-7,
+                err_msg=f"resumed run diverged at {k}/{k2}")
+
+
+def test_four_process_scale(tmp_path):
+    """r3 VERDICT #4: the multi-node proof at scale — 4 OS processes,
+    a process-SPANNING dp x tp mesh (tp collectives cross process
+    boundaries), a Graph model with masks, and compressed
+    (encoded_gradients) exchange — each equivalent to single-process runs."""
+    _spawn_workers(4, str(tmp_path), timeout=420, mode="scale4")
+    got = np.load(tmp_path / "scale4.npz")
+
+    from deeplearning4j_tpu.data.iterators import DataSet
+    from deeplearning4j_tpu.train import Trainer
+    from multihost_worker import (build_graph, build_net, make_data,
+                                  make_seq_data)
+
+    class _ListIter:
+        def __init__(self, batches):
+            self.batches = batches
+
+        def __iter__(self):
+            return iter(self.batches)
+
+        def reset(self):
+            pass
+
+    # (a) dp x tp across processes == plain single-process Trainer
+    x, y = make_data()
+    batches = _ListIter([DataSet(x[i:i + 16], y[i:i + 16])
+                         for i in range(0, 64, 16)])
+    tr = Trainer(build_net(), seed=0)
+    tr.fit(batches, epochs=2, prefetch=False)
+    for k, layer in tr.params.items():
+        for k2, v in layer.items():
+            np.testing.assert_allclose(
+                got[f"tp/{k}/{k2}"], np.asarray(v), rtol=2e-5, atol=1e-6,
+                err_msg=f"4-proc dp x tp diverged at {k}/{k2}")
+
+    # (b) Graph + masks through the multi-host path == single-process
+    xg, yg, fm, lm = make_seq_data()
+    gbatches = _ListIter([DataSet(xg[i:i + 16], yg[i:i + 16],
+                                  fm[i:i + 16], lm[i:i + 16])
+                          for i in range(0, 64, 16)])
+    trg = Trainer(build_graph(), seed=0)
+    trg.fit(gbatches, epochs=2, prefetch=False)
+    for k, layer in trg.params.items():
+        for k2, v in layer.items():
+            np.testing.assert_allclose(
+                got[f"graph/{k}/{k2}"], np.asarray(v), rtol=2e-5, atol=1e-6,
+                err_msg=f"4-proc Graph+masks diverged at {k}/{k2}")
+
+    # (c) cross-process encoded_gradients == single-process ParallelWrapper
+    # encoded mode with the same 4 workers (deterministic algorithm)
+    import jax
+
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+    from deeplearning4j_tpu.train.listeners import CollectScoresListener
+
+    pw = ParallelWrapper(build_net(), mesh=make_mesh({"data": 4},
+                                                     jax.devices()[:4]),
+                         mode="encoded_gradients", seed=0,
+                         threshold=1e-3, capacity_frac=0.25)
+    colw = CollectScoresListener()
+    pw.fit(batches, epochs=2, listeners=[colw])
+    pw._sync_model()
+    for k, layer in pw.model.params.items():
+        for k2, v in layer.items():
+            np.testing.assert_allclose(
+                got[f"enc/{k}/{k2}"], np.asarray(v), rtol=2e-5, atol=1e-6,
+                err_msg=f"4-proc encoded_gradients diverged at {k}/{k2}")
+    np.testing.assert_allclose(got["enc_losses"],
+                               np.asarray([s for _, s in colw.scores]),
+                               rtol=1e-5, atol=1e-6)

@@ -1,0 +1,174 @@
+"""The one sharding API (SURVEY §7): ``Trainer(mesh=, rules=)`` /
+``MultiHostTrainer(rules=)`` must train ANY Sequential/Graph over a
+dp x tp x sp mesh with results numerically equivalent to unsharded
+single-device training — GSPMD inserts the collectives, the math is the
+same. This is the productization of what ``sharded_lm_step`` proved for
+one bespoke model (r2 VERDICT weak #5)."""
+
+import chex
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.nn import GraphBuilder, NetConfig, SequentialBuilder
+from deeplearning4j_tpu.nn import layers as L
+from deeplearning4j_tpu.parallel import (DATA_AXIS, DENSE_RULES, MODEL_AXIS,
+                                         SEQ_AXIS, TRANSFORMER_RULES,
+                                         make_mesh)
+from deeplearning4j_tpu.train import Trainer
+
+
+def _mlp():
+    return (SequentialBuilder(NetConfig(seed=7, updater={"type": "adam",
+                                                         "learning_rate": 1e-2}))
+            .input_shape(12)
+            .layer(L.Dense(n_out=16, activation="relu"))
+            .layer(L.Dense(n_out=8, activation="tanh"))
+            .layer(L.Output(n_out=4, activation="softmax", loss="mcxent"))
+            .build())
+
+
+def _data(n=32, d=12, c=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[rng.integers(0, c, n)]
+    return x, y
+
+
+def _fit_steps(tr, x, y, steps, bs):
+    from deeplearning4j_tpu.data import ArrayIterator
+
+    it = ArrayIterator(x[: steps * bs], y[: steps * bs], bs, shuffle=False)
+    tr.fit(it, epochs=1, prefetch=False)
+    return jax.tree.map(np.asarray, tr.params)
+
+
+class TestTrainerMesh:
+    def test_dp_tp_equivalence_mlp(self):
+        """Non-LM model + DENSE_RULES on a dp x tp mesh == unsharded."""
+        x, y = _data()
+        ref = _fit_steps(Trainer(_mlp(), seed=3), x, y, steps=4, bs=8)
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2}, jax.devices()[:4])
+        got = _fit_steps(Trainer(_mlp(), seed=3, mesh=mesh, rules=DENSE_RULES),
+                         x, y, steps=4, bs=8)
+        chex.assert_trees_all_close(got, ref, rtol=2e-5, atol=1e-6)
+
+    def test_dp_tp_sp_equivalence_lm(self):
+        """CausalLM + TRANSFORMER_RULES over all three axes == unsharded."""
+        from deeplearning4j_tpu.models import CausalLM
+
+        def build():
+            zm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=16,
+                          num_heads=2, vocab=32)
+            m = zm.build()
+            m.init()
+            return m
+
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 32, (16, 17))
+        x = ids[:, :-1]
+        y = np.eye(32, dtype=np.float32)[ids[:, 1:]]
+
+        # SGD: linear in gradients, so the comparison tests the sharded
+        # collectives' math rather than adam's amplification of float32
+        # reduction-order noise on near-zero moments
+        import optax
+
+        ref = _fit_steps(Trainer(build(), seed=5, updater=optax.sgd(0.1)),
+                         x, y, steps=2, bs=8)
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2, SEQ_AXIS: 2}, jax.devices()[:8])
+        got = _fit_steps(Trainer(build(), seed=5, updater=optax.sgd(0.1),
+                                 mesh=mesh, rules=TRANSFORMER_RULES),
+                         x, y, steps=2, bs=8)
+        chex.assert_trees_all_close(got, ref, rtol=5e-5, atol=1e-5)
+
+    def test_graph_model_with_masks(self):
+        """Graph container through the same API (masks included)."""
+        def build():
+            g = (GraphBuilder(NetConfig(seed=11, updater={"type": "adam",
+                                                          "learning_rate": 1e-2}))
+                 .add_input("in", (10, 6))
+                 .add_layer("rnn", L.LSTM(n_out=8), "in")
+                 .add_layer("out", L.RnnOutput(n_out=3, activation="softmax",
+                                               loss="mcxent"), "rnn")
+                 .set_outputs("out")
+                 .build())
+            g.init()
+            return g
+
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((16, 10, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (16, 10))]
+        mask = (rng.random((16, 10)) > 0.2).astype(np.float32)
+
+        from deeplearning4j_tpu.data.iterators import DataSet
+
+        def fit(tr):
+            for i in range(2):
+                ds = DataSet(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8],
+                             mask[i * 8:(i + 1) * 8], mask[i * 8:(i + 1) * 8])
+
+                class _It:
+                    def __iter__(self):
+                        return iter([ds])
+
+                    def reset(self):
+                        pass
+
+                tr.fit(_It(), epochs=1, prefetch=False)
+            return jax.tree.map(np.asarray, tr.params)
+
+        ref = fit(Trainer(build(), seed=9))
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2}, jax.devices()[:4])
+        got = fit(Trainer(build(), seed=9, mesh=mesh, rules=DENSE_RULES))
+        chex.assert_trees_all_close(got, ref, rtol=2e-5, atol=1e-6)
+
+    def test_params_actually_sharded(self):
+        """The rules must actually distribute: a tp-ruled kernel's shards
+        live on distinct devices with distinct index ranges."""
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4}, jax.devices()[:8])
+        tr = Trainer(_mlp(), mesh=mesh, rules=DENSE_RULES)
+        w = tr.params["layer_0"]["w"]  # (12, 16) column-split over 4
+        assert w.sharding.spec == P(None, MODEL_AXIS)
+        idx = {tuple(map(lambda s: (s.start, s.stop),
+                         shard.index)) for shard in w.addressable_shards}
+        assert len(idx) == 4  # 4 distinct column blocks
+        # optimizer moments inherit the param sharding (ZeRO-free TP)
+        mu = tr.opt_state[0].mu["layer_0"]["w"]
+        assert mu.sharding.spec == P(None, MODEL_AXIS)
+
+    def test_evaluate_and_score_under_mesh(self):
+        from deeplearning4j_tpu.data import ArrayIterator
+
+        x, y = _data(24)
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2}, jax.devices()[:4])
+        tr = Trainer(_mlp(), mesh=mesh, rules=DENSE_RULES)
+        it = ArrayIterator(x, y, 8, shuffle=False)
+        tr.fit(it, epochs=1, prefetch=False)
+        ev = tr.evaluate(ArrayIterator(x, y, 8, shuffle=False))
+        assert ev.confusion.sum() == 24
+        s = tr.score_iterator(ArrayIterator(x, y, 8, shuffle=False))
+        assert np.isfinite(s)
+
+
+class TestMultiHostTrainerRules:
+    def test_single_process_dp_tp(self):
+        """MultiHostTrainer(rules=) in single-process multi-device mode:
+        dp x tp mesh, params sharded, result == plain Trainer."""
+        from deeplearning4j_tpu.parallel import (MultiHostTrainer,
+                                                 ProcessShardIterator)
+
+        x, y = _data(32)
+        ref = _fit_steps(Trainer(_mlp(), seed=3), x, y, steps=4, bs=8)
+
+        mesh = make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2}, jax.devices()[:8])
+        mh = MultiHostTrainer(_mlp(), mesh=mesh, seed=3, rules=DENSE_RULES)
+        mh.fit(ProcessShardIterator(x, y, global_batch_size=8), epochs=1)
+        w = mh.params["layer_0"]["w"]
+        assert w.sharding.spec == P(None, MODEL_AXIS)
+        mh._sync_model()
+        chex.assert_trees_all_close(
+            jax.tree.map(np.asarray, mh.model.params), ref,
+            rtol=2e-5, atol=1e-6)
