@@ -26,6 +26,16 @@ import jax
 import jax.numpy as jnp
 
 
+def auto_capacity_frac(n_workers: int) -> float:
+    """Default message capacity as a fraction of the param count — derived
+    from the measured wire model (scripts/bench_encoded.py, PERF.md):
+    quantized message = 5 bytes/slot all-gathered to n workers vs dense ring
+    all-reduce ~= 2(n-1)/n * 4 bytes/param, so the per-worker wire break-even
+    is capacity_frac = 8/(5n). Default to HALF that (2x wire headroom),
+    capped at the ND4J-ish 0.05 for small meshes."""
+    return min(0.05, 1.6 / max(n_workers, 1))
+
+
 class SparseUpdate(NamedTuple):
     """Fixed-capacity sparse encoding: indices (k,), signs (k,), count, threshold."""
 
@@ -124,9 +134,12 @@ class EncodedGradientsAccumulator:
     updates into a parameter-sized dense buffer. Used by the DCN gradient-
     sharing path; within a slice the sync all-reduce path bypasses this."""
 
-    def __init__(self, size: int, threshold: float = 1e-3, capacity_frac: float = 0.05):
+    def __init__(self, size: int, threshold: float = 1e-3,
+                 capacity_frac: "float | None" = None, n_workers: int = 8):
         self.size = size
         self.threshold = threshold
+        if capacity_frac is None:
+            capacity_frac = auto_capacity_frac(n_workers)
         self.capacity = max(1, int(size * capacity_frac))
         self.residuals = {}
         self.pending = []

@@ -132,7 +132,7 @@ class MultiHostTrainer:
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  updater: Optional[optax.GradientTransformation] = None,
                  seed: int = 0, rules=None, mode: str = "shared_gradients",
-                 threshold: float = 1e-3, capacity_frac: float = 0.05,
+                 threshold: float = 1e-3, capacity_frac: Optional[float] = None,
                  quantize: bool = True):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -219,7 +219,7 @@ class MultiHostTrainer:
     # per device across ALL processes; each encodes its local update to
     # capacity indices(+signs/values), an all_gather crosses the wire
     # (gloo/DCN), every worker applies the identical decoded mean. ---
-    def _init_encoded(self, threshold: float, capacity_frac: float,
+    def _init_encoded(self, threshold: float, capacity_frac: Optional[float],
                       quantize: bool):
         from functools import partial as _partial
 
@@ -238,6 +238,10 @@ class MultiHostTrainer:
                              "threshold>0 (use quantize=False for exact top-k)")
         flat0, unravel = ravel_pytree(model.params)
         size = flat0.shape[0]
+        if capacity_frac is None:
+            from .compression import auto_capacity_frac
+
+            capacity_frac = auto_capacity_frac(n)
         capacity = max(1, min(size, int(size * capacity_frac)))
         self._n_workers = n
         dev_sh = self._batch_sh

@@ -59,7 +59,7 @@ class ParallelWrapper:
     def __init__(self, model, mesh: Optional[Mesh] = None, mode: str = "shared_gradients",
                  averaging_frequency: int = 5, average_updater_state: bool = True,
                  seed: int = 0, threshold: float = 1e-3,
-                 capacity_frac: float = 0.05, quantize: bool = True):
+                 capacity_frac: Optional[float] = None, quantize: bool = True):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
@@ -74,7 +74,10 @@ class ParallelWrapper:
         self.iteration = 0
         self.epoch = 0
         self.threshold = threshold
-        self.capacity_frac = capacity_frac
+        from .compression import auto_capacity_frac
+
+        self.capacity_frac = (capacity_frac if capacity_frac is not None
+                              else auto_capacity_frac(self.n_dev))
         self.quantize = quantize
 
         if mode == "shared_gradients":
