@@ -31,9 +31,9 @@ def auto_capacity_frac(n_workers: int) -> float:
     from the measured wire model (scripts/bench_encoded.py, PERF.md):
     quantized message = 5 bytes/slot all-gathered to n workers vs dense ring
     all-reduce ~= 2(n-1)/n * 4 bytes/param, so the per-worker wire break-even
-    is capacity_frac = 8/(5n). Default to HALF that (2x wire headroom),
-    capped at the ND4J-ish 0.05 for small meshes."""
-    return min(0.05, 1.6 / max(n_workers, 1))
+    is capacity_frac = 8/(5n) = 1.6/n. Default to HALF that (2x wire
+    headroom), capped at the ND4J-ish 0.05 for small meshes."""
+    return min(0.05, 0.8 / max(n_workers, 1))
 
 
 class SparseUpdate(NamedTuple):

@@ -225,7 +225,8 @@ class MultiHostTrainer:
 
         from jax.flatten_util import ravel_pytree
 
-        from .compression import threshold_encode, topk_encode
+        from .compression import (auto_capacity_frac, threshold_encode,
+                                  topk_encode)
 
         mesh, tx, model = self.mesh, self.tx, self.model
         n = int(np.prod(mesh.devices.shape))
@@ -239,8 +240,6 @@ class MultiHostTrainer:
         flat0, unravel = ravel_pytree(model.params)
         size = flat0.shape[0]
         if capacity_frac is None:
-            from .compression import auto_capacity_frac
-
             capacity_frac = auto_capacity_frac(n)
         capacity = max(1, min(size, int(size * capacity_frac)))
         self._n_workers = n
