@@ -36,10 +36,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..nn.api import Layer
-from ..nn.layers import (LRN, ActivationLayer, BatchNorm, Bidirectional,
-                         Conv1D, Conv2D, Cropping2D, Deconv2D, Dense,
+from ..nn.layers import (LRN, ActivationLayer, AlphaDropout, BatchNorm,
+                         Bidirectional,
+                         Conv1D, Conv2D, Cropping1D, Cropping2D, Deconv2D,
+                         Dense,
                          DepthwiseConv2D, DropoutLayer, EmbeddingSequence,
-                         Flatten, GlobalPooling, GRU, LastTimeStep, LSTM,
+                         Flatten, GaussianDropout, GaussianNoise,
+                         GlobalPooling, GRU, LastTimeStep, LSTM,
                          LayerNorm, MultiHeadAttention, PReLU, Reshape,
                          SeparableConv2D, SimpleRnn, Subsampling1D,
                          Subsampling2D, Upsampling1D, Upsampling2D,
@@ -134,6 +137,7 @@ _K1_CLASS_RENAMES = {
     "Convolution1D": "Conv1D",
     "Deconvolution2D": "Conv2DTranspose",
     "AtrousConvolution2D": "Conv2D",
+    "AtrousConvolution1D": "Conv1D",
     "SeparableConvolution2D": "SeparableConv2D",
 }
 
@@ -160,6 +164,9 @@ def _normalize_config(class_name: str, conf: dict, keras_major: int) -> Tuple[st
         c["strides"] = c.pop("subsample")
     if "subsample_length" in c:
         c["strides"] = [c.pop("subsample_length")]
+    if "atrous_rate" in c:  # AtrousConvolution1D/2D: the dilation IS the layer
+        r = c.pop("atrous_rate")
+        c["dilation_rate"] = list(r) if isinstance(r, (list, tuple)) else [r]
     if "border_mode" in c:
         c["padding"] = c.pop("border_mode")
     if "dim_ordering" in c:
@@ -518,6 +525,12 @@ def _convert_layer(class_name: str, conf: dict, ctx: _Ctx):
         "ThresholdedReLU": lambda c: ActivationLayer(activation="thresholdedrelu"),
         "MultiHeadAttention": _mha,
         "Softmax": _softmax_layer,
+        # noise/ converters (KerasGaussianNoise/GaussianDropout/AlphaDropout)
+        "GaussianNoise": lambda c: GaussianNoise(stddev=float(c.get("stddev", 0.1))),
+        "GaussianDropout": lambda c: GaussianDropout(rate=float(c.get("rate", 0.5))),
+        "AlphaDropout": lambda c: AlphaDropout(rate=float(c.get("rate", 0.5))),
+        "Cropping1D": lambda c: Cropping1D(
+            cropping=tuple(int(v) for v in _tuple2(c.get("cropping", (1, 1))))),
     }
     if class_name == "LayerNormalization":
         ln = _layernorm(conf)  # validates the axis spelling itself

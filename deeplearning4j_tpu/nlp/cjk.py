@@ -129,6 +129,14 @@ def segmentation_scores(factory: TokenizerFactory,
     tp = fp = fn = 0
     for tokens in gold:
         text = sep.join(tokens)
+        # tokenizers DROP punctuation/space characters; align gold offsets to
+        # the retained character stream (and drop all-punct gold tokens) so a
+        # punctuated gold corpus scores correctly
+        kept = [
+            "".join(ch for ch in t
+                    if _char_block(ch) not in ("space", "punct"))
+            for t in tokens]
+        kept = [t for t in kept if t]
 
         def spans(toks):
             out, pos = set(), 0
@@ -138,7 +146,7 @@ def segmentation_scores(factory: TokenizerFactory,
             return out
 
         pred = list(factory.create(text).get_tokens())
-        g, p = spans(tokens), spans(pred)
+        g, p = spans(kept), spans(pred)
         tp += len(g & p)
         fp += len(p - g)
         fn += len(g - p)

@@ -3,13 +3,16 @@ configs) plus TPU-first attention/transformer layers."""
 
 from .attention import (MultiHeadAttention, PositionalEmbedding,
                         TransformerEncoderBlock, dot_product_attention)
-from .conv import (Conv1D, Conv2D, Cropping2D, Deconv2D, DepthwiseConv2D,
+from .conv import (Conv1D, Conv2D, Cropping1D, Cropping2D, Deconv2D,
+                   DepthwiseConv2D,
                    SeparableConv2D, SpaceToBatch, SpaceToDepth, Subsampling1D,
                    Subsampling2D, Upsampling1D, Upsampling2D, ZeroPadding1D,
                    ZeroPadding2D)
-from .core import (ActivationLayer, CenterLossOutput, CnnLossLayer, Dense,
+from .core import (ActivationLayer, AlphaDropout, CenterLossOutput,
+                   CnnLossLayer, Dense,
                    DropoutLayer, ElementWiseMultiplication, Embedding,
-                   EmbeddingSequence, LossLayer, Output, PReLU, RnnLossLayer,
+                   EmbeddingSequence, GaussianDropout, GaussianNoise,
+                   LossLayer, Output, PReLU, RnnLossLayer,
                    RnnOutput)
 from .custom import CustomLayer, Lambda, resolve_function
 from .moe import MoE, MoETransformerBlock
@@ -20,10 +23,13 @@ from .recurrent import (GRU, LSTM, Bidirectional, GravesLSTM, LastTimeStep,
 from .special import VAE, AutoEncoder, Frozen, Yolo2Output
 
 __all__ = [
-    "ActivationLayer", "AutoEncoder", "BatchNorm", "Bidirectional",
-    "CenterLossOutput", "CnnLossLayer", "Conv1D", "Conv2D", "Cropping2D",
+    "ActivationLayer", "AlphaDropout", "AutoEncoder", "BatchNorm",
+    "Bidirectional",
+    "CenterLossOutput", "CnnLossLayer", "Conv1D", "Conv2D", "Cropping1D",
+    "Cropping2D",
     "CustomLayer", "Deconv2D", "Dense", "DepthwiseConv2D", "DropoutLayer",
-    "ElementWiseMultiplication", "Embedding", "EmbeddingSequence", "Flatten",
+    "ElementWiseMultiplication", "Embedding", "EmbeddingSequence",
+    "GaussianDropout", "GaussianNoise", "Flatten",
     "Frozen", "GRU", "GlobalPooling", "GravesLSTM", "LRN", "LSTM", "Lambda",
     "LastTimeStep",
     "LayerNorm", "LossLayer", "MoE", "MoETransformerBlock",

@@ -518,6 +518,29 @@ class Cropping2D(Layer):
 
 @register_layer
 @dataclass(frozen=True)
+class Cropping1D(Layer):
+    """Cropping1D.java — crop (left, right) timesteps of (B, T, C)."""
+
+    cropping: Sequence[int] = (0, 0)
+
+    def has_params(self):
+        return False
+
+    def output_shape(self, input_shape: Shape) -> Shape:
+        t, c = input_shape
+        l, r = self.cropping
+        return (t - l - r, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        l, r = self.cropping
+        t = x.shape[1]
+        if mask is not None:
+            mask = mask[:, l : t - r]
+        return x[:, l : t - r, :], state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
 class SpaceToDepth(Layer):
     """SpaceToDepthLayer.java — rearrange (H*b, W*b, C) -> (H, W, C*b*b)."""
 

@@ -86,6 +86,81 @@ class DropoutLayer(Layer):
 
 @register_layer
 @dataclass(frozen=True)
+class GaussianNoise(Layer):
+    """Additive zero-mean Gaussian noise during training
+    (conf/dropout/GaussianNoise.java; Keras GaussianNoise parity)."""
+
+    stddev: float = 0.1
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        if training:
+            if rng is None:
+                raise ValueError("GaussianNoise needs rng in training mode")
+            x = x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+        return x, state, mask
+
+
+def _check_rate(layer_name: str, rate: float):
+    if not 0.0 <= rate < 1.0:
+        raise ValueError(f"{layer_name} rate must be in [0, 1), got {rate}")
+
+
+@register_layer
+@dataclass(frozen=True)
+class GaussianDropout(Layer):
+    """Multiplicative 1-mean Gaussian noise with stddev sqrt(rate/(1-rate))
+    (conf/dropout/GaussianDropout.java; Keras GaussianDropout parity)."""
+
+    rate: float = 0.5
+
+    def __post_init__(self):
+        _check_rate("GaussianDropout", self.rate)
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        if training and self.rate > 0.0:
+            if rng is None:
+                raise ValueError("GaussianDropout needs rng in training mode")
+            std = (self.rate / (1.0 - self.rate)) ** 0.5
+            x = x * (1.0 + std * jax.random.normal(rng, x.shape, x.dtype))
+        return x, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
+class AlphaDropout(Layer):
+    """SELU-preserving dropout (conf/dropout/AlphaDropout.java; Keras
+    AlphaDropout parity): dropped units are set to alpha' and the output is
+    affinely rescaled so self-normalizing activations keep mean/variance."""
+
+    rate: float = 0.5
+
+    def __post_init__(self):
+        _check_rate("AlphaDropout", self.rate)
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        if training and self.rate > 0.0:
+            if rng is None:
+                raise ValueError("AlphaDropout needs rng in training mode")
+            alpha_p = -1.7580993408473766  # -alpha*lambda of SELU
+            q = 1.0 - self.rate
+            a = float((q + alpha_p ** 2 * q * self.rate) ** -0.5)
+            b = float(-a * alpha_p * self.rate)
+            keep = jax.random.bernoulli(rng, q, x.shape)
+            x = a * jnp.where(keep, x, jnp.asarray(alpha_p, x.dtype)) + b
+        return x, state, mask
+
+
+@register_layer
+@dataclass(frozen=True)
 class Embedding(Layer):
     """EmbeddingLayer.java: integer ids -> embedding vectors.
 

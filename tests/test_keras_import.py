@@ -504,3 +504,85 @@ class TestTransformerImport:
             UnsupportedKerasConfigurationException
         with pytest.raises(UnsupportedKerasConfigurationException):
             import_keras_model_and_weights(p)
+
+
+class TestConverterCoverage:
+    """r3 VERDICT #9: the converter tail + named failures. One golden test
+    for the noise/ converters (KerasGaussianNoise/GaussianDropout/
+    AlphaDropout parity — identity at inference, so outputs must match),
+    plus an enumeration test pinning which Keras classes convert and which
+    raise a NAMED UnsupportedKerasConfiguration error."""
+
+    def test_noise_and_cropping_golden(self, tmp_path):
+        km = keras.Sequential([
+            layers.Input((10, 4)),
+            layers.GaussianNoise(0.2),
+            layers.Cropping1D((1, 2)),
+            layers.GaussianDropout(0.3),
+            layers.Dense(8, activation="relu"),
+            layers.AlphaDropout(0.1),
+            layers.GlobalAveragePooling1D(),
+            layers.Dense(3, activation="softmax"),
+        ])
+        path = _save(tmp_path, km, "noise.h5")
+        model = import_keras_sequential_model_and_weights(path)
+        x = np.random.RandomState(2).randn(4, 10, 4).astype(np.float32)
+        want = np.asarray(km(x, training=False))
+        got = np.asarray(model.output(x))
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+        # training mode actually injects noise (not a silent no-op import)
+        import jax
+
+        noisy, _ = model.forward(model.params, model.state, x, training=True,
+                                 rng=jax.random.PRNGKey(0))
+        assert not np.allclose(np.asarray(noisy), got)
+
+    SUPPORTED = {
+        "Dense": {"units": 4, "activation": "linear"},
+        "Conv2D": {"filters": 2, "kernel_size": [3, 3], "activation": "linear"},
+        "Conv1D": {"filters": 2, "kernel_size": [3], "activation": "linear"},
+        "DepthwiseConv2D": {"kernel_size": [3, 3], "activation": "linear"},
+        "SeparableConv2D": {"filters": 2, "kernel_size": [3, 3],
+                            "activation": "linear"},
+        "Conv2DTranspose": {"filters": 2, "kernel_size": [3, 3],
+                            "activation": "linear"},
+        "MaxPooling2D": {}, "AveragePooling2D": {}, "MaxPooling1D": {},
+        "AveragePooling1D": {}, "GlobalMaxPooling2D": {},
+        "GlobalAveragePooling2D": {}, "GlobalMaxPooling1D": {},
+        "GlobalAveragePooling1D": {}, "BatchNormalization": {},
+        "Embedding": {"input_dim": 10, "output_dim": 4},
+        "Activation": {"activation": "relu"}, "Dropout": {"rate": 0.5},
+        "SpatialDropout1D": {"rate": 0.5}, "SpatialDropout2D": {"rate": 0.5},
+        "Flatten": {}, "Reshape": {"target_shape": [4]},
+        "ZeroPadding2D": {"padding": [1, 1]}, "ZeroPadding1D": {"padding": 1},
+        "Cropping2D": {"cropping": [[1, 1], [1, 1]]},
+        "Cropping1D": {"cropping": [1, 1]},
+        "UpSampling2D": {"size": [2, 2]}, "UpSampling1D": {"size": 2},
+        "LeakyReLU": {"alpha": 0.01}, "PReLU": {},
+        "ELU": {}, "ThresholdedReLU": {}, "Softmax": {},
+        "GaussianNoise": {"stddev": 0.1}, "GaussianDropout": {"rate": 0.3},
+        "AlphaDropout": {"rate": 0.3},
+        "Add": {}, "Subtract": {}, "Multiply": {}, "Average": {},
+        "Maximum": {}, "Concatenate": {},
+        "LayerNormalization": {"axis": -1},
+    }
+    REJECTED = ["ConvLSTM2D", "Lambda", "Masking", "RepeatVector",
+                "LocallyConnected2D", "Permute", "Dot", "Attention",
+                "Conv3D", "MaxPooling3D", "AveragePooling3D"]
+
+    def test_supported_classes_convert(self):
+        from deeplearning4j_tpu.interop.keras_import import (_Ctx,
+                                                             _convert_layer)
+
+        for cls, conf in self.SUPPORTED.items():
+            out = _convert_layer(cls, dict(conf, name="x"), _Ctx(2))
+            assert out is not None, cls
+
+    def test_rejected_classes_fail_with_named_error(self):
+        from deeplearning4j_tpu.interop.keras_import import (
+            _Ctx, _convert_layer, UnsupportedKerasConfigurationException)
+
+        for cls in self.REJECTED:
+            with pytest.raises(UnsupportedKerasConfigurationException,
+                               match=cls):
+                _convert_layer(cls, {"name": "x"}, _Ctx(2))

@@ -506,3 +506,58 @@ class TestTorchOracle:
         yt, _ = tg(torch.tensor(xs))
         np.testing.assert_allclose(np.asarray(ours), yt.detach().numpy(),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestNoiseLayers:
+    """GaussianNoise/GaussianDropout/AlphaDropout (conf/dropout/*.java
+    parity): identity at inference, stochastic-but-finite in training,
+    JSON round-trip."""
+
+    def test_inference_identity_and_training_noise(self):
+        import jax
+
+        from deeplearning4j_tpu.nn import layers as L
+
+        x = jnp.asarray(np.random.RandomState(0).randn(8, 6), jnp.float32)
+        for layer in (L.GaussianNoise(stddev=0.5), L.GaussianDropout(rate=0.4),
+                      L.AlphaDropout(rate=0.4)):
+            y, _, _ = layer.apply({}, {}, x, training=False)
+            np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+            yt, _, _ = layer.apply({}, {}, x, training=True,
+                                   rng=jax.random.PRNGKey(1))
+            assert not np.allclose(np.asarray(yt), np.asarray(x))
+            assert np.isfinite(np.asarray(yt)).all()
+
+    def test_alpha_dropout_preserves_selu_stats(self):
+        """The whole point of AlphaDropout: mean/variance of SELU-activated
+        inputs are approximately preserved under training."""
+        import jax
+
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.ops import activations
+
+        x = activations.get("selu")(
+            jnp.asarray(np.random.RandomState(1).randn(4096, 64), jnp.float32))
+        y, _, _ = L.AlphaDropout(rate=0.2).apply(
+            {}, {}, x, training=True, rng=jax.random.PRNGKey(2))
+        assert abs(float(jnp.mean(y)) - float(jnp.mean(x))) < 0.05
+        assert abs(float(jnp.std(y)) - float(jnp.std(x))) < 0.08
+
+    def test_serde_roundtrip(self):
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.nn.api import layer_from_dict
+
+        for layer in (L.GaussianNoise(stddev=0.3), L.GaussianDropout(rate=0.2),
+                      L.AlphaDropout(rate=0.1), L.Cropping1D(cropping=(1, 2))):
+            back = layer_from_dict(layer.to_dict())
+            assert back.to_dict() == layer.to_dict()
+
+    def test_cropping1d_shapes_and_mask(self):
+        from deeplearning4j_tpu.nn import layers as L
+
+        layer = L.Cropping1D(cropping=(1, 2))
+        assert layer.output_shape((10, 4)) == (7, 4)
+        x = jnp.ones((2, 10, 4))
+        m = jnp.ones((2, 10))
+        y, _, m2 = layer.apply({}, {}, x, mask=m)
+        assert y.shape == (2, 7, 4) and m2.shape == (2, 7)
