@@ -59,10 +59,17 @@ class ParallelWrapper:
     def __init__(self, model, mesh: Optional[Mesh] = None, mode: str = "shared_gradients",
                  averaging_frequency: int = 5, average_updater_state: bool = True,
                  seed: int = 0, threshold: float = 1e-3,
-                 capacity_frac: Optional[float] = None, quantize: bool = True):
+                 capacity_frac: Optional[float] = None, quantize: bool = True,
+                 rules=None):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
+        self.rules = tuple(rules) if rules is not None else ()
+        if rules and mode not in ("shared_gradients", "zero_sharded"):
+            raise ValueError("rules= (tensor/seq parallelism) applies to "
+                             "mode='shared_gradients'/'zero_sharded' only — "
+                             "averaging/encoded modes replicate full model "
+                             "copies per worker")
         self.averaging_frequency = averaging_frequency
         self.average_updater_state = average_updater_state
         self.tx = build_updater(model)
@@ -110,10 +117,23 @@ class ParallelWrapper:
         mesh, tx, model = self.mesh, self.tx, self.model
         repl = NamedSharding(mesh, P())
         batch_sh = NamedSharding(mesh, P(DATA_AXIS))
-        self.params = jax.device_put(model.params, repl)
+        if self.rules:  # one sharding API (parallel/sharding.py): params
+            from .sharding import place_params  # tp/sp-sharded per rules
+
+            self.params = place_params(model.params, mesh, self.rules)
+        else:
+            self.params = jax.device_put(model.params, repl)
         self.state = jax.device_put(model.state, repl)
         opt0 = tx.init(self.params)
-        if shard_opt_state:
+        if self.rules:
+            # moments inherited the params' tp/sp shardings from eager init;
+            # keep them (zero_sharded's data-axis re-shard would discard the
+            # rule layout). Off-mesh leaves (adam's count) go replicated.
+            opt_sh = jax.tree.map(
+                lambda a: a.sharding
+                if getattr(getattr(a, "sharding", None), "mesh", None) == mesh
+                else repl, opt0)
+        elif shard_opt_state:
             n = mesh.shape[DATA_AXIS]
 
             def opt_spec(a):
@@ -134,17 +154,28 @@ class ParallelWrapper:
             opt_sh = repl
         self.opt_state = jax.device_put(opt0, opt_sh)
         self._batch_sharding = batch_sh
+        p_sh = (jax.tree.map(lambda a: a.sharding, self.params)
+                if self.rules else repl)
+        if self.rules:
+            from .sharding import activation_sharding
+
+            act_ctx = lambda: activation_sharding(mesh)  # noqa: E731
+        else:
+            import contextlib
+
+            act_ctx = contextlib.nullcontext
 
         seq = isinstance(model, Sequential)
 
         @partial(jax.jit, donate_argnums=(0, 1, 2),
-                 out_shardings=(repl, opt_sh, repl, repl))
+                 out_shardings=(p_sh, opt_sh, repl, repl))
         def step(params, opt_state, net_state, x, y, rng, mask=None):
             mask_kw = {"mask": mask} if seq else {"masks": mask}
 
             def loss_fn(p):
-                loss, new_state = model.score(p, net_state, x, y, training=True,
-                                              rng=rng, **mask_kw)
+                with act_ctx():
+                    loss, new_state = model.score(p, net_state, x, y, training=True,
+                                                  rng=rng, **mask_kw)
                 return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)

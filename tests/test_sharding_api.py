@@ -172,3 +172,29 @@ class TestMultiHostTrainerRules:
         chex.assert_trees_all_close(
             jax.tree.map(np.asarray, mh.model.params), ref,
             rtol=2e-5, atol=1e-6)
+
+
+class TestParallelWrapperRules:
+    def test_shared_gradients_dp_tp(self):
+        """ParallelWrapper(rules=) — the third surface of the one sharding
+        API: shared_gradients over a dp x tp mesh == plain Trainer."""
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        x, y = _data(32)
+        ref = _fit_steps(Trainer(_mlp(), seed=3), x, y, steps=4, bs=8)
+
+        from deeplearning4j_tpu.data import ArrayIterator
+
+        mesh = make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2}, jax.devices()[:8])
+        pw = ParallelWrapper(_mlp(), mesh=mesh, seed=3, rules=DENSE_RULES)
+        assert pw.params["layer_0"]["w"].sharding.spec == P(None, MODEL_AXIS)
+        pw.fit(ArrayIterator(x, y, 8, shuffle=False), epochs=1)
+        chex.assert_trees_all_close(
+            jax.tree.map(np.asarray, pw.model.params), ref,
+            rtol=2e-5, atol=1e-6)
+
+    def test_rules_rejected_for_replica_modes(self):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        with pytest.raises(ValueError, match="rules"):
+            ParallelWrapper(_mlp(), mode="averaging", rules=DENSE_RULES)
