@@ -75,7 +75,7 @@ class CausalLM(ZooModel):
 
     def __init__(self, num_classes=None, seed=12345, input_shape=None, *,
                  num_layers=None, d_model=None, num_heads=None, vocab=None,
-                 flash=False, remat=False, **kw):
+                 flash=False, remat=False, ring=False, **kw):
         super().__init__(num_classes, seed, input_shape, **kw)
         self.num_layers = num_layers or self.num_layers
         self.d_model = d_model or self.d_model
@@ -84,6 +84,7 @@ class CausalLM(ZooModel):
         self.num_classes = self.vocab
         self.flash = flash
         self.remat = remat
+        self.ring = ring
 
     def build(self) -> Sequential:
         T = self.input_shape[0]
@@ -94,7 +95,8 @@ class CausalLM(ZooModel):
              .layer(L.PositionalEmbedding(max_len=max(T, 512))))
         for _ in range(self.num_layers):
             b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=True,
-                                              flash=self.flash, remat=self.remat))
+                                              flash=self.flash, remat=self.remat,
+                                              ring=self.ring))
         b.layer(L.LayerNorm())
         b.layer(L.RnnOutput(n_out=self.vocab, activation="softmax", loss="mcxent"))
         return b.build()

@@ -29,6 +29,15 @@ import jax
 import jax.numpy as jnp
 
 Array = jax.Array
+
+# Ambient mesh for mesh-aware layers (ring attention): set for the duration
+# of a sharded step TRACE by parallel.sharding.activation_sharding, read by
+# layers that can exploit a sequence-parallel axis. A ContextVar so
+# concurrent traces over different meshes can't cross-apply.
+import contextvars
+
+ACTIVE_MESH: "contextvars.ContextVar" = contextvars.ContextVar(
+    "dl4j_tpu_active_mesh", default=None)
 Params = Dict[str, Any]
 State = Dict[str, Any]
 Shape = Tuple[int, ...]

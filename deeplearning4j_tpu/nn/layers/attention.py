@@ -54,12 +54,21 @@ class MultiHeadAttention(Layer):
     (T, T) score tensor — the long-context fast path. Used when the mask is
     absent or pure-causal; an explicit key mask falls back to the dense path
     (the kernel doesn't take arbitrary masks).
+
+    ``ring=True`` routes through sequence-parallel ring attention
+    (parallel/ring_attention.py) whenever the step is being traced under a
+    mesh with a ``seq`` axis (Trainer/ParallelWrapper/MultiHostTrainer with
+    ``mesh=``/``rules=`` install the ambient mesh): Q/K/V shard over the
+    sequence axis, K/V blocks rotate via ppermute, O(T/n) memory per device.
+    Outside a seq-parallel trace it falls back to flash/dense, so the same
+    model config runs anywhere.
     """
 
     num_heads: int = 8
     causal: bool = False
     attn_dropout: float = 0.0
     flash: bool = False
+    ring: bool = False
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
@@ -78,7 +87,25 @@ class MultiHeadAttention(Layer):
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
         drop = self.attn_dropout if (training and rng is not None) else 0.0
-        if self.flash and mask is None and drop == 0.0:
+        ring_mesh = dp = tp = None
+        if self.ring and mask is None and drop == 0.0:
+            from ..api import ACTIVE_MESH
+            from ...parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+            m = ACTIVE_MESH.get()
+            shape = dict(m.shape) if m is not None else {}
+            if shape.get(SEQ_AXIS, 1) > 1 and T % shape[SEQ_AXIS] == 0:
+                ring_mesh = m
+                dp, tp = shape.get(DATA_AXIS, 1), shape.get(MODEL_AXIS, 1)
+        if ring_mesh is not None:
+            from ...parallel.mesh import DATA_AXIS, MODEL_AXIS
+            from ...parallel.ring_attention import ring_attention
+
+            y = ring_attention(
+                q, k, v, ring_mesh, causal=self.causal,
+                batch_axis=DATA_AXIS if dp > 1 and B % dp == 0 else None,
+                head_axis=MODEL_AXIS if tp > 1 and H % tp == 0 else None)
+        elif self.flash and mask is None and drop == 0.0:
             # flash kernel handles no-mask / pure-causal; attention dropout
             # (weights are never materialized) falls back to dense
             from ...ops.flash_attention import flash_attention
@@ -109,6 +136,9 @@ class TransformerEncoderBlock(Layer):
     causal: bool = False
     dropout_rate: float = 0.0
     flash: bool = False  # route self-attention through the Pallas kernel
+    ring: bool = False   # route self-attention through seq-parallel ring
+    # attention when traced under a mesh with a seq axis (falls back
+    # flash/dense otherwise — same config runs anywhere)
     remat: bool = False  # gradient checkpointing: recompute this block's
     # internals in the backward pass instead of storing them — saved
     # activation memory shrinks to ~one residual-stream tensor per block
@@ -148,7 +178,7 @@ class TransformerEncoderBlock(Layer):
 
     def _body(self, params, x, rng, mask, *, training=False):
         mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal,
-                                 flash=self.flash)
+                                 flash=self.flash, ring=self.ring)
         h = self._ln(x, params["ln1_g"], params["ln1_b"])
         a, _, _ = mha.apply(params["attn"], {}, h, training=training, rng=rng, mask=mask)
         x = x + a

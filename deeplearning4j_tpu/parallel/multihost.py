@@ -482,7 +482,7 @@ class MultiHostTrainer:
         if not hasattr(self, "_score_fn") or self._score_fn is None:
             from ..train.trainer import make_score_fn
 
-            self._score_fn = make_score_fn(self.model)
+            self._score_fn = make_score_fn(self.model, self.mesh)
 
         if self.mode == "encoded_gradients":
             # stacked replicas don't fit the score fn: use one synced copy,

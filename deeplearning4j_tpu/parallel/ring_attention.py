@@ -113,18 +113,26 @@ def ring_attention_local(q, k, v, *, axis_name: str = SEQ_AXIS, causal: bool = F
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
-                   seq_axis: str = SEQ_AXIS, k_chunk: int = 1024):
+                   seq_axis: str = SEQ_AXIS, k_chunk: int = 1024,
+                   batch_axis: Optional[str] = None,
+                   head_axis: Optional[str] = None):
     """Convenience wrapper: (B, T, H, D) global arrays -> sharded ring attention.
 
-    T must divide by mesh.shape[seq_axis]. Batch stays replicated here; compose
-    with a data axis by sharding B outside.
+    T must divide by mesh.shape[seq_axis]. ``batch_axis`` additionally shards
+    B over the data axis (the dp x sp composition); ``head_axis`` shards the
+    head dim over a model axis (the tp x sp composition — the ring math is
+    head-independent, so each tp shard runs the ring over its own heads
+    instead of all-gathering and computing every head tp times).
     """
+    spec = P(batch_axis, seq_axis, head_axis, None)
     fn = jax.shard_map(
         partial(ring_attention_local, axis_name=seq_axis, causal=causal,
                 k_chunk=k_chunk),
         mesh=mesh,
-        in_specs=(P(None, seq_axis, None, None),) * 3,
-        out_specs=P(None, seq_axis, None, None))
+        in_specs=(spec,) * 3,
+        out_specs=spec,
+        check_vma=False)  # unmentioned axes replicate; no replication
+        #                   proofs needed for the ring semantics
     return fn(q, k, v)
 
 

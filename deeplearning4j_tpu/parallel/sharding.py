@@ -158,15 +158,17 @@ class activation_sharding:
         return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, spec))
 
     def __enter__(self):
-        from ..nn import model as _m
+        from ..nn import api as _api, model as _m
 
         self._token = _m.ACTIVATION_CONSTRAINT.set(self._constrain)
+        self._mesh_token = _api.ACTIVE_MESH.set(self.mesh)
         return self
 
     def __exit__(self, *exc):
-        from ..nn import model as _m
+        from ..nn import api as _api, model as _m
 
         _m.ACTIVATION_CONSTRAINT.reset(self._token)
+        _api.ACTIVE_MESH.reset(self._mesh_token)
         return False
 
 

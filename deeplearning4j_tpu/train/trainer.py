@@ -39,34 +39,54 @@ from ..ops import updaters as upd
 from .listeners import PerformanceListener, TrainingListener
 
 
-def make_score_fn(model):
+def _mesh_ctx(mesh):
+    """Trace context for a mesh (activation constraints + ambient mesh for
+    ring attention) or a no-op when mesh is None."""
+    if mesh is None:
+        import contextlib
+
+        return contextlib.nullcontext
+    from ..parallel.sharding import activation_sharding
+
+    return lambda: activation_sharding(mesh)
+
+
+def make_score_fn(model, mesh=None):
     """One jitted ``(params, state, x, y, mask) -> mean loss`` for a model —
     shared by Trainer / ParallelWrapper / MultiHostTrainer scoring paths so
-    the Sequential-vs-Graph mask kwarg mapping lives in exactly one place."""
+    the Sequential-vs-Graph mask kwarg mapping lives in exactly one place.
+    ``mesh``: trace under the mesh so mesh-aware layers (ring attention)
+    keep their sharded path at scoring time too."""
     seq = isinstance(model, Sequential)
+    ctx = _mesh_ctx(mesh)
 
     @jax.jit
     def score(params, state, x, y, mask=None):
-        l, _ = model.score(params, state, x, y, training=False,
-                           **({"mask": mask} if seq else {"masks": mask}))
+        with ctx():
+            l, _ = model.score(params, state, x, y, training=False,
+                               **({"mask": mask} if seq else {"masks": mask}))
         return l
 
     return score
 
 
-def make_infer_fn(model):
+def make_infer_fn(model, mesh=None):
     """One jitted ``(params, state, x, mask) -> primary output`` forward for
     a model (Sequential or Graph, masks threaded either way) — shared by the
-    evaluate paths of Trainer / ParallelWrapper / MultiHostTrainer."""
+    evaluate paths of Trainer / ParallelWrapper / MultiHostTrainer. ``mesh``:
+    see make_score_fn — without it a ring=True model would silently fall
+    back to dense O(T^2) attention during evaluation."""
     seq = isinstance(model, Sequential)
+    ctx = _mesh_ctx(mesh)
 
     @jax.jit
     def infer(params, state, x, mask=None):
-        if seq:
-            y, _ = model.forward(params, state, x, training=False, mask=mask)
-            return y
-        ys, _ = model.forward(params, state, x, training=False, masks=mask)
-        return ys[0]
+        with ctx():
+            if seq:
+                y, _ = model.forward(params, state, x, training=False, mask=mask)
+                return y
+            ys, _ = model.forward(params, state, x, training=False, masks=mask)
+            return ys[0]
 
     return infer
 
@@ -417,7 +437,7 @@ class Trainer:
         if evaluation is None:
             evaluation = default_evaluation(self.model)
         if self._infer_fn is None:
-            self._infer_fn = make_infer_fn(self.model)
+            self._infer_fn = make_infer_fn(self.model, self.mesh)
         for ds in iterator:
             preds = self._infer_fn(self.params, self.state, ds.features,
                                    ds.features_mask)
@@ -428,7 +448,7 @@ class Trainer:
 
     def score_iterator(self, iterator) -> float:
         """Average loss over an iterator (model.score(DataSetIterator) parity)."""
-        score = make_score_fn(self.model)
+        score = make_score_fn(self.model, self.mesh)
 
         total, n = 0.0, 0
         for ds in iterator:

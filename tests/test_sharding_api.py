@@ -198,3 +198,49 @@ class TestParallelWrapperRules:
 
         with pytest.raises(ValueError, match="rules"):
             ParallelWrapper(_mlp(), mode="averaging", rules=DENSE_RULES)
+
+
+class TestRingThroughLayerStack:
+    """ring=True on MultiHeadAttention/TransformerEncoderBlock routes
+    through sequence-parallel ring attention whenever the step traces under
+    a mesh with a seq axis (the ambient-mesh ContextVar the sharding API
+    installs) — and falls back to dense anywhere else, so ONE model config
+    runs on any topology."""
+
+    def test_ring_equals_dense_under_dp_sp(self):
+        from deeplearning4j_tpu.models import CausalLM
+        import optax
+
+        def build(ring):
+            zm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=16,
+                          num_heads=2, vocab=32, ring=ring)
+            m = zm.build()
+            m.init()
+            return m
+
+        rng = np.random.default_rng(4)
+        ids = rng.integers(0, 32, (8, 17))
+        x, y = ids[:, :-1], np.eye(32, dtype=np.float32)[ids[:, 1:]]
+
+        ref = _fit_steps(Trainer(build(False), seed=5, updater=optax.sgd(0.1)),
+                         x, y, steps=2, bs=4)
+        mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4}, jax.devices()[:8])
+        got = _fit_steps(Trainer(build(True), seed=5, updater=optax.sgd(0.1),
+                                 mesh=mesh, rules=TRANSFORMER_RULES),
+                         x, y, steps=2, bs=4)
+        chex.assert_trees_all_close(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_ring_falls_back_without_mesh(self):
+        """Same config, no mesh: must run (dense path) and match ring=False."""
+        from deeplearning4j_tpu.nn import layers as L
+        import jax as _jax
+
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 8, 16)),
+                        jnp.float32)
+        blk_r = L.TransformerEncoderBlock(num_heads=2, causal=True, ring=True)
+        blk_d = L.TransformerEncoderBlock(num_heads=2, causal=True)
+        p, _ = blk_r.init(_jax.random.PRNGKey(0), (8, 16))
+        yr, _, _ = blk_r.apply(p, {}, x, training=False)
+        yd, _, _ = blk_d.apply(p, {}, x, training=False)
+        np.testing.assert_allclose(np.asarray(yr), np.asarray(yd),
+                                   rtol=1e-6, atol=1e-7)
