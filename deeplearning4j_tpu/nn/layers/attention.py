@@ -98,7 +98,6 @@ class MultiHeadAttention(Layer):
                 ring_mesh = m
                 dp, tp = shape.get(DATA_AXIS, 1), shape.get(MODEL_AXIS, 1)
         if ring_mesh is not None:
-            from ...parallel.mesh import DATA_AXIS, MODEL_AXIS
             from ...parallel.ring_attention import ring_attention
 
             y = ring_attention(

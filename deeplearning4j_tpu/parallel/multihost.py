@@ -530,7 +530,7 @@ class MultiHostTrainer:
                     f"{type(evaluation).__name__} lacks .{attr}")
 
         if not hasattr(self, "_infer_fn") or self._infer_fn is None:
-            self._infer_fn = make_infer_fn(self.model)  # cache across calls
+            self._infer_fn = make_infer_fn(self.model, self.mesh)  # cache across calls
 
         # accumulate THIS call's counts into a fresh instance so a
         # pre-populated evaluation is never re-summed x process_count

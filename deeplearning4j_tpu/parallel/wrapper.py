@@ -459,7 +459,7 @@ class ParallelWrapper:
         params = jax.device_put(model.params, repl)
         state = jax.device_put(model.state, repl)
         if not hasattr(self, "_infer_fn") or self._infer_fn is None:
-            self._infer_fn = make_infer_fn(model)
+            self._infer_fn = make_infer_fn(model, self.mesh)
 
         for ds in iterator:
             x = np.asarray(ds.features)
@@ -507,7 +507,7 @@ class ParallelWrapper:
         state = jax.device_put(model.state, repl)
 
         if not hasattr(self, "_score_fn") or self._score_fn is None:
-            self._score_fn = make_score_fn(model)  # cache across epochs
+            self._score_fn = make_score_fn(model, self.mesh)  # cache across epochs
 
         score = self._score_fn
         total, n_batches = 0.0, 0

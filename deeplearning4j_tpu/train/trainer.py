@@ -241,19 +241,14 @@ class Trainer:
         loss stay unspecified (net_state — layers may add keys on the first
         training step — and tBPTT carries)."""
         if self.mesh is None:
-            import contextlib
-
-            return contextlib.nullcontext, {}
+            return _mesh_ctx(None), {}
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ..parallel.sharding import activation_sharding
-
-        mesh = self.mesh
         jit_kw = {"out_shardings": (
             jax.tree.map(lambda a: a.sharding, self.params),
             jax.tree.map(lambda a: a.sharding, self.opt_state),
-            *([None] * n_unpinned_outputs), NamedSharding(mesh, P()))}
-        return (lambda: activation_sharding(mesh)), jit_kw
+            *([None] * n_unpinned_outputs), NamedSharding(self.mesh, P()))}
+        return _mesh_ctx(self.mesh), jit_kw
 
     # --- the jitted train step ---
     def _make_step(self):
