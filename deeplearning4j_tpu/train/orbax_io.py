@@ -78,11 +78,19 @@ def load_model_json(directory: str):
 def save_trainer(directory: str, trainer) -> str:
     """One-call save of a Trainer / ParallelWrapper / MultiHostTrainer.
     Includes the encoded_gradients error-feedback residual when the wrapper
-    carries one, so that mode also continues exactly."""
+    carries one, AND the trainer's rng stream + iteration counter — without
+    them a crash-resume would replay already-consumed dropout keys and
+    diverge from the uninterrupted run."""
+    import numpy as np
+
     extras = {}
     residual = getattr(trainer, "residual", None)
     if residual is not None:
         extras["residual"] = residual
+    if getattr(trainer, "_rng", None) is not None:
+        extras["trainer_rng"] = np.asarray(trainer._rng)
+        extras["iteration"] = np.asarray(getattr(trainer, "iteration", 0),
+                                         np.int64)
     return save_checkpoint(directory, trainer.model, params=trainer.params,
                            state=trainer.state, opt_state=trainer.opt_state,
                            extras=extras)
@@ -95,11 +103,17 @@ def restore_trainer(directory: str, trainer):
     the checkpoint contents placed on the same shardings. The underlying
     model's params/state are synced too, so inference/serialization work
     immediately after restore. Returns the trainer."""
+    import numpy as np
+
     template = {"params": trainer.params, "net_state": trainer.state,
                 "opt_state": trainer.opt_state}
     residual = getattr(trainer, "residual", None)
     if residual is not None:
         template["residual"] = residual
+    if getattr(trainer, "_rng", None) is not None:
+        template["trainer_rng"] = np.asarray(trainer._rng)
+        template["iteration"] = np.asarray(getattr(trainer, "iteration", 0),
+                                           np.int64)
     # shape the template to what the checkpoint actually contains (a plain
     # save_checkpoint(dir, model) writes opt_state={} and no residual) so a
     # genuinely corrupt checkpoint or structure mismatch surfaces as ITS OWN
@@ -108,8 +122,9 @@ def restore_trainer(directory: str, trainer):
         os.path.join(os.path.abspath(directory), "arrays")).item_metadata.tree
     if saved.get("opt_state") == {}:
         template["opt_state"] = {}
-    if "residual" not in saved:
-        template.pop("residual", None)
+    for opt_key in ("residual", "trainer_rng", "iteration"):
+        if opt_key not in saved:
+            template.pop(opt_key, None)
     restored = restore_checkpoint(directory, template)
     trainer.params = restored["params"]
     trainer.state = restored["net_state"]
@@ -117,6 +132,11 @@ def restore_trainer(directory: str, trainer):
         trainer.opt_state = restored["opt_state"]
     if residual is not None and restored.get("residual") is not None:
         trainer.residual = restored["residual"]
+    if restored.get("trainer_rng") is not None:
+        import jax.numpy as jnp
+
+        trainer._rng = jnp.asarray(np.asarray(restored["trainer_rng"]))
+        trainer.iteration = int(np.asarray(restored["iteration"]))
     trainer.model.params = trainer.params
     trainer.model.state = trainer.state
     return trainer

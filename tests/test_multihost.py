@@ -248,3 +248,17 @@ def test_four_process_scale(tmp_path):
     np.testing.assert_allclose(got["enc_losses"],
                                np.asarray([s for _, s in colw.scores]),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_orbax_checkpoint_across_processes(tmp_path):
+    """Orbax sharded checkpointing with params tensor-sharded ACROSS two OS
+    processes: per-process shard write, restore onto the same cross-process
+    shardings, resumed run == uninterrupted run."""
+    _spawn_workers(2, str(tmp_path), timeout=300, mode="orbax2")
+    got = np.load(tmp_path / "orbax2.npz")
+    keys = sorted(k[len("cont/"):] for k in got.files if k.startswith("cont/"))
+    assert keys, "worker produced no params"
+    for k in keys:
+        np.testing.assert_allclose(
+            got[f"resumed/{k}"], got[f"cont/{k}"], rtol=1e-5, atol=1e-7,
+            err_msg=f"orbax resume diverged at {k}")
