@@ -530,7 +530,14 @@ class MultiHostTrainer:
                     f"{type(evaluation).__name__} lacks .{attr}")
 
         if not hasattr(self, "_infer_fn") or self._infer_fn is None:
-            self._infer_fn = make_infer_fn(self.model, self.mesh)  # cache across calls
+            # NO mesh here: evaluate forwards each process's LOCAL shard on
+            # its own devices (then merges accumulators) — constraining those
+            # local arrays onto the process-spanning mesh would turn them
+            # into non-addressable global arrays. Consequence: mesh-aware
+            # layers (ring=True) take their single-device fallback during
+            # multi-host evaluate; use score_iterator (global-mesh path) when
+            # the model is too big for one device.
+            self._infer_fn = make_infer_fn(self.model)  # cache across calls
 
         # accumulate THIS call's counts into a fresh instance so a
         # pre-populated evaluation is never re-summed x process_count
