@@ -70,7 +70,9 @@ def spec_for(path: str, leaf, rules: Rules, mesh: Mesh) -> P:
             dims = np.asarray(leaf).shape
             fixed = []
             for i, ax in enumerate(spec):
-                if ax is None or i >= len(dims):
+                if i >= len(dims):  # rule written for a higher-rank tensor
+                    break           # (e.g. conv rule hitting a dense kernel)
+                if ax is None:
                     fixed.append(None)
                     continue
                 size = mesh.shape.get(ax, 0) if isinstance(ax, str) else 1

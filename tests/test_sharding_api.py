@@ -244,3 +244,32 @@ class TestRingThroughLayerStack:
         yd, _, _ = blk_d.apply(p, {}, x, training=False)
         np.testing.assert_allclose(np.asarray(yr), np.asarray(yd),
                                    rtol=1e-6, atol=1e-7)
+
+
+class TestCnnRules:
+    def test_dp_tp_equivalence_cnn(self):
+        """CNN_RULES (output-channel-split HWIO kernels) on a dp x tp mesh ==
+        unsharded — the conv-stack leg of the one sharding API."""
+        from deeplearning4j_tpu.parallel import CNN_RULES
+
+        def build():
+            return (SequentialBuilder(NetConfig(seed=2, updater={"type": "adam",
+                                                                 "learning_rate": 1e-2}))
+                    .input_shape(8, 8, 3)
+                    .layer(L.Conv2D(n_out=8, kernel=(3, 3), activation="relu"))
+                    .layer(L.Subsampling2D(kernel=(2, 2), stride=(2, 2)))
+                    .layer(L.Conv2D(n_out=4, kernel=(3, 3), activation="relu"))
+                    .layer(L.Flatten())
+                    .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+                    .build())
+
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((32, 8, 8, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        ref = _fit_steps(Trainer(build(), seed=1), x, y, steps=4, bs=8)
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2}, jax.devices()[:4])
+        tr = Trainer(build(), seed=1, mesh=mesh, rules=CNN_RULES)
+        assert tr.params["layer_0"]["w"].sharding.spec == P(None, None, None,
+                                                            MODEL_AXIS)
+        got = _fit_steps(tr, x, y, steps=4, bs=8)
+        chex.assert_trees_all_close(got, ref, rtol=5e-5, atol=1e-6)
