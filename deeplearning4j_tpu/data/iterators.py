@@ -34,7 +34,8 @@ class DataSet:
 
     @property
     def num_examples(self) -> int:
-        return int(np.asarray(self.features).shape[0])
+        f = self.features  # .shape avoids a D2H copy for device arrays
+        return int(f.shape[0] if hasattr(f, "shape") else np.asarray(f).shape[0])
 
     def to_device(self, device=None):
         put = (lambda a: jax.device_put(a, device)) if device else jax.device_put
@@ -53,6 +54,17 @@ class MultiDataSet:
     labels: List[Any]
     features_masks: Optional[List[Any]] = None
     labels_masks: Optional[List[Any]] = None
+
+    @property
+    def num_examples(self) -> int:
+        f = self.features[0]  # .shape avoids a D2H copy for device arrays
+        return int(f.shape[0] if hasattr(f, "shape") else np.asarray(f).shape[0])
+
+    def to_device(self, device=None):
+        put = (lambda a: jax.device_put(a, device)) if device else jax.device_put
+        puts = lambda seq: None if seq is None else [put(a) for a in seq]
+        return MultiDataSet(puts(self.features), puts(self.labels),
+                            puts(self.features_masks), puts(self.labels_masks))
 
 
 class DataSetIterator:

@@ -309,6 +309,40 @@ class Trainer:
         self._rng, k = jax.random.split(self._rng)
         return k
 
+    def _unpack_batch(self, ds):
+        """(x, y, feature_mask, label_mask) from a DataSet OR a MultiDataSet
+        (ComputationGraph.fit(MultiDataSetIterator) parity, SURVEY §3.2):
+        MultiDataSet features map onto the Graph's named inputs by position,
+        labels/label-masks stay positional lists matching ``outputs``."""
+        from ..data.iterators import MultiDataSet
+
+        if isinstance(ds, MultiDataSet):
+            if not isinstance(self.model, Graph):
+                raise TypeError("MultiDataSet batches require a Graph model")
+            names = self.model.inputs
+            if len(ds.features) != len(names):
+                raise ValueError(f"MultiDataSet has {len(ds.features)} feature "
+                                 f"arrays; Graph expects inputs {names}")
+            outs = self.model.outputs
+            if len(ds.labels) != len(outs):
+                raise ValueError(f"MultiDataSet has {len(ds.labels)} label "
+                                 f"arrays; Graph expects outputs {outs}")
+            if ds.labels_masks is not None and len(ds.labels_masks) != len(outs):
+                raise ValueError(f"MultiDataSet has {len(ds.labels_masks)} "
+                                 f"label masks; Graph expects outputs {outs}")
+            if getattr(self.model.config, "tbptt_length", 0):
+                raise ValueError(
+                    "tbptt_length is set but tBPTT is not supported for "
+                    "MultiDataSet/Graph fit — train full-BPTT "
+                    "(tbptt_length=0) or use a Sequential model")
+            x = dict(zip(names, ds.features))
+            y = list(ds.labels)
+            fm = (dict(zip(names, ds.features_masks))
+                  if ds.features_masks is not None else None)
+            lm = list(ds.labels_masks) if ds.labels_masks is not None else None
+            return x, y, fm, lm
+        return ds.features, ds.labels, ds.features_mask, ds.labels_mask
+
     # --- fit (MultiLayerNetwork.fit :1262 / ComputationGraph.fit :1010) ---
     def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = (),
             prefetch: bool = True) -> "Trainer":
@@ -333,17 +367,18 @@ class Trainer:
                 lst.on_epoch_start(self, epoch)
             it = AsyncIterator(iterator) if prefetch else iterator
             for ds in it:
-                bs = int(np.asarray(ds.features).shape[0])
+                bs = ds.num_examples
                 for lst in listeners:
                     if isinstance(lst, PerformanceListener):
                         lst.step_begin(bs)
                 if self._step_fn is None:  # invalidated mid-fit (e.g. a
                     self._step_fn = self._make_step()  # rollback listener)
-                if tbptt and np.asarray(ds.features).ndim >= 3:
+                xb, yb, fmb, lmb = self._unpack_batch(ds)
+                if tbptt and not isinstance(xb, dict) and \
+                        np.asarray(xb).ndim >= 3:
                     loss = self._fit_tbptt_batch(ds, tbptt)
                 else:
-                    x, y, fm, lm = self._place_batch(
-                        ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+                    x, y, fm, lm = self._place_batch(xb, yb, fmb, lmb)
                     self.params, self.opt_state, self.state, loss = self._step_fn(
                         self.params, self.opt_state, self.state,
                         x, y, self.next_rng(), fm, lm)
@@ -434,9 +469,14 @@ class Trainer:
         if self._infer_fn is None:
             self._infer_fn = make_infer_fn(self.model, self.mesh)
         for ds in iterator:
-            preds = self._infer_fn(self.params, self.state, ds.features,
-                                   ds.features_mask)
-            evaluation.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+            x, y, fm, lm = self._unpack_batch(ds)
+            preds = self._infer_fn(self.params, self.state, x, fm)
+            # multi-output graphs: evaluate the PRIMARY output (reference
+            # SparkComputationGraph evaluation convention)
+            if isinstance(y, list):
+                y = y[0]
+                lm = lm[0] if lm else None
+            evaluation.eval(y, np.asarray(preds), mask=lm)
         if hasattr(iterator, "reset"):
             iterator.reset()
         return evaluation
@@ -447,7 +487,8 @@ class Trainer:
 
         total, n = 0.0, 0
         for ds in iterator:
-            total += float(score(self.params, self.state, ds.features, ds.labels, ds.features_mask))
+            x, y, fm, _ = self._unpack_batch(ds)
+            total += float(score(self.params, self.state, x, y, fm))
             n += 1
         if hasattr(iterator, "reset"):
             iterator.reset()
