@@ -12,12 +12,16 @@ import zipfile
 
 
 def guess_model_format(path: str) -> str:
-    """Return one of: 'native-zip', 'keras-h5', 'config-json', 'unknown'."""
+    """Return one of: 'native-zip', 'keras-h5', 'keras-v3', 'config-json',
+    'unknown'."""
     try:
         if zipfile.is_zipfile(path):
             with zipfile.ZipFile(path) as zf:
-                if "configuration.json" in zf.namelist():
+                names = zf.namelist()
+                if "configuration.json" in names:
                     return "native-zip"
+                if "config.json" in names and "model.weights.h5" in names:
+                    return "keras-v3"  # Keras 3 native .keras archive
             return "unknown"
         with open(path, "rb") as f:
             magic = f.read(8)
@@ -37,7 +41,7 @@ def load_model_guess(path: str):
         from ..train.serialization import load_model
 
         return load_model(path)[0]
-    if fmt == "keras-h5":
+    if fmt in ("keras-h5", "keras-v3"):
         from .keras_import import import_keras_model_and_weights
 
         return import_keras_model_and_weights(path)

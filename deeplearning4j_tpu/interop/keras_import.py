@@ -128,6 +128,128 @@ class KerasHdf5Archive:
         return out
 
 
+class KerasV3Archive:
+    """Keras 3 native ``.keras`` archive (a zip of config.json +
+    model.weights.h5) — the format ``model.save("m.keras")`` writes today.
+    Presents the same surface as :class:`KerasHdf5Archive`, so every
+    converter/golden-test path is shared; only the weight layout differs
+    (``layers/<name>/.../vars/<i>`` instead of ``weight_names``-ordered
+    datasets). Beyond the reference (which predates Keras 3)."""
+
+    # composite layers store sub-weights in NAMED subgroups that h5py walks
+    # alphabetically; the converters expect the legacy weight_names order
+    _SUB_ORDER = {"query_dense": 0, "key_dense": 1, "value_dense": 2,
+                  "output_dense": 3, "forward_layer": 0, "backward_layer": 1}
+
+    def __init__(self, path: str):
+        import zipfile
+
+        self._zf = zipfile.ZipFile(path)
+        try:
+            self._cfg = json.loads(self._zf.read("config.json"))
+            try:
+                self._meta = json.loads(self._zf.read("metadata.json"))
+            except KeyError:
+                self._meta = {}
+            if "model.weights.h5" not in self._zf.namelist():
+                raise InvalidKerasConfigurationException(
+                    f"{path}: zip has config.json but no model.weights.h5 "
+                    f"(not a Keras v3 archive)")
+        except Exception:
+            self._zf.close()
+            raise
+        self._f = None  # weights h5 opened lazily: config-only probes and
+        #                 the first import pass never pay the decompress
+        # the weight store IGNORES layer.name: groups are class-name slugs
+        # deduped per file in model order (an explicitly-named "my_first"
+        # Dense still stores as "dense"). Map config names -> store names.
+        import re as _re
+
+        def snake(cls: str) -> str:  # keras.src.utils.naming.to_snake_case
+            cls = _re.sub(r"\W+", "", cls)
+            cls = _re.sub("(.)([A-Z][a-z]+)", r"\1_\2", cls)
+            return _re.sub("([a-z])([A-Z])", r"\1_\2", cls).lower()
+
+        mc = self._cfg.get("config", {})
+        layer_list = mc.get("layers", []) if isinstance(mc, dict) else []
+        self._store_map: Dict[str, str] = {}
+        counts: Dict[str, int] = {}
+        for lc in layer_list:
+            cls = lc.get("class_name", "")
+            nm = lc.get("config", {}).get("name")
+            if cls == "InputLayer" or nm is None:
+                continue
+            slug = snake(cls)
+            k = counts.get(slug, 0)
+            counts[slug] = k + 1
+            self._store_map[nm] = slug if k == 0 else f"{slug}_{k}"
+
+    @property
+    def f(self):
+        if self._f is None:
+            import io
+
+            import h5py
+
+            self._f = h5py.File(
+                io.BytesIO(self._zf.read("model.weights.h5")), "r")
+        return self._f
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+        self._zf.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def model_config(self) -> dict:
+        return self._cfg
+
+    def keras_version(self) -> str:
+        return str(self._meta.get("keras_version", "3.0.0"))
+
+    def layer_weights(self, layer_name: str) -> List[np.ndarray]:
+        import h5py
+
+        root = self.f.get("layers")
+        if root is None:
+            return []
+        layer_name = self._store_map.get(layer_name, layer_name)
+        if layer_name not in root:
+            return []
+        out: List[np.ndarray] = []
+
+        def collect(g):
+            if "vars" in g:
+                v = g["vars"]
+                out.extend(np.asarray(v[k]) for k in sorted(v, key=int))
+            subs = [k for k in g
+                    if k != "vars" and not isinstance(g[k], h5py.Dataset)]
+            for k in sorted(subs, key=lambda n: (self._SUB_ORDER.get(n, 50), n)):
+                collect(g[k])
+
+        collect(root[layer_name])
+        return out
+
+
+def open_keras_archive(path: str):
+    """HDF5 (Keras 1/2 + Keras-3 legacy H5) or native Keras-3 ``.keras``
+    zip — dispatched by content, not extension."""
+    import zipfile
+
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as z:
+            if "config.json" in z.namelist():
+                return KerasV3Archive(path)
+        raise InvalidKerasConfigurationException(
+            f"{path} is a zip but not a Keras v3 archive (no config.json)")
+    return KerasHdf5Archive(path)
+
+
 # ---------------------------------------------------------------------------
 # Config normalization (Keras 1 → Keras 2 vocabulary)
 # ---------------------------------------------------------------------------
@@ -740,7 +862,7 @@ def _nhwc_shape(shape: Tuple[int, ...], data_format: str) -> Tuple[int, ...]:
 def import_keras_sequential_model_and_weights(path: str, *, input_shape=None) -> Sequential:
     """KerasModelImport.importKerasSequentialModelAndWeights (:74) equivalent:
     Keras Sequential HDF5 → our ``Sequential`` with weights loaded."""
-    with KerasHdf5Archive(path) as ar:
+    with open_keras_archive(path) as ar:
         cfg = ar.model_config()
         if cfg.get("class_name") not in ("Sequential",):
             raise InvalidKerasConfigurationException(
@@ -955,13 +1077,13 @@ def _app_node_name(layer_name: str, app_idx: int) -> str:
 def import_keras_model_and_weights(path: str):
     """KerasModelImport.importKerasModelAndWeights (:50) equivalent. Auto-detects
     Sequential vs Functional; returns ``Sequential`` or ``Graph`` accordingly."""
-    with KerasHdf5Archive(path) as ar:
+    with open_keras_archive(path) as ar:
         cfg = ar.model_config()
     if cfg.get("class_name") == "Sequential":
         return import_keras_sequential_model_and_weights(path)
     if cfg.get("class_name") not in ("Model", "Functional"):
         raise InvalidKerasConfigurationException(f"Unknown model class {cfg.get('class_name')}")
-    with KerasHdf5Archive(path) as ar:
+    with open_keras_archive(path) as ar:
         keras_major = int(ar.keras_version().split(".")[0])
         ctx = _Ctx(keras_major)
         mc = cfg["config"]

@@ -323,6 +323,10 @@ class Trainer:
             if len(ds.features) != len(names):
                 raise ValueError(f"MultiDataSet has {len(ds.features)} feature "
                                  f"arrays; Graph expects inputs {names}")
+            if ds.features_masks is not None and \
+                    len(ds.features_masks) != len(names):
+                raise ValueError(f"MultiDataSet has {len(ds.features_masks)} "
+                                 f"feature masks; Graph expects inputs {names}")
             outs = self.model.outputs
             if len(ds.labels) != len(outs):
                 raise ValueError(f"MultiDataSet has {len(ds.labels)} label "
@@ -374,8 +378,11 @@ class Trainer:
                 if self._step_fn is None:  # invalidated mid-fit (e.g. a
                     self._step_fn = self._make_step()  # rollback listener)
                 xb, yb, fmb, lmb = self._unpack_batch(ds)
-                if tbptt and not isinstance(xb, dict) and \
-                        np.asarray(xb).ndim >= 3:
+                xb_ndim = (getattr(xb, "ndim", None)  # no D2H just for rank
+                           if not isinstance(xb, dict) else 0)
+                if xb_ndim is None:
+                    xb_ndim = np.asarray(xb).ndim
+                if tbptt and xb_ndim >= 3:
                     loss = self._fit_tbptt_batch(ds, tbptt)
                 else:
                     x, y, fm, lm = self._place_batch(xb, yb, fmb, lmb)

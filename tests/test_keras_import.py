@@ -586,3 +586,74 @@ class TestConverterCoverage:
             with pytest.raises(UnsupportedKerasConfigurationException,
                                match=cls):
                 _convert_layer(cls, {"name": "x"}, _Ctx(2))
+
+
+class TestKerasV3Archive:
+    """Native Keras-3 ``.keras`` zip import (beyond the reference, which
+    predates Keras 3): same converters, different weight layout
+    (layers/<name>/**/vars/<i> with named composite subgroups)."""
+
+    def test_mlp_keras_v3(self, tmp_path):
+        km = keras.Sequential([
+            layers.Input((8,)),
+            layers.Dense(16, activation="relu"),
+            layers.Dense(4, activation="softmax"),
+        ])
+        path = _save(tmp_path, km, "m.keras")
+        model = import_keras_sequential_model_and_weights(path)
+        x = np.random.RandomState(0).randn(5, 8).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.output(x)),
+                                   np.asarray(km(x)), rtol=RTOL, atol=ATOL)
+
+    def test_cnn_bn_keras_v3(self, tmp_path):
+        km = keras.Sequential([
+            layers.Input((12, 12, 3)),
+            layers.Conv2D(8, 3, padding="same", activation="relu"),
+            layers.BatchNormalization(),
+            layers.MaxPooling2D(2),
+            layers.GlobalAveragePooling2D(),
+            layers.Dense(6, activation="softmax"),
+        ])
+        path = _save(tmp_path, km, "cnn.keras")
+        model = import_keras_sequential_model_and_weights(path)
+        x = np.random.RandomState(1).randn(3, 12, 12, 3).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.output(x)),
+                                   np.asarray(km(x, training=False)),
+                                   rtol=RTOL, atol=ATOL)
+
+    def test_lstm_bidirectional_keras_v3(self, tmp_path):
+        km = keras.Sequential([
+            layers.Input((7, 5)),
+            layers.Bidirectional(layers.LSTM(6, return_sequences=True)),
+            layers.LSTM(4, return_sequences=True),
+            layers.GlobalAveragePooling1D(),
+            layers.Dense(3, activation="softmax"),
+        ])
+        path = _save(tmp_path, km, "rnn.keras")
+        model = import_keras_sequential_model_and_weights(path)
+        x = np.random.RandomState(2).randn(4, 7, 5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.output(x)),
+                                   np.asarray(km(x)), rtol=RTOL, atol=ATOL)
+
+    def test_functional_mha_keras_v3(self, tmp_path):
+        """MultiHeadAttention's named subgroups come back in query/key/value/
+        output order (alphabetical h5 iteration would scramble them)."""
+        inp = keras.Input((6, 16))
+        x = layers.MultiHeadAttention(num_heads=2, key_dim=8)(inp, inp)
+        x = layers.GlobalAveragePooling1D()(x)
+        out = layers.Dense(2, activation="softmax")(x)
+        km = keras.Model(inp, out)
+        path = _save(tmp_path, km, "mha.keras")
+        model = import_keras_model_and_weights(path)
+        x = np.random.RandomState(3).randn(2, 6, 16).astype(np.float32)
+        want = np.asarray(km(x))
+        got = np.asarray(model.output(x)[0])
+        np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+    def test_guesser_handles_keras_v3(self, tmp_path):
+        km = keras.Sequential([layers.Input((4,)), layers.Dense(2)])
+        path = _save(tmp_path, km, "g.keras")
+        model = load_model_guess(path)
+        x = np.random.RandomState(4).randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.output(x)),
+                                   np.asarray(km(x)), rtol=RTOL, atol=ATOL)
