@@ -185,6 +185,7 @@ def main():
     peak = next((v for k, v in PEAK_BF16.items() if str(dev.device_kind).startswith(k)), 197e12)
     mfu = images_per_sec * flops_per_image / peak
     vs_baseline = mfu / 0.70  # north-star: >70% MFU (BASELINE.json)
+    run_breadth = on_tpu and os.environ.get("BENCH_BREADTH", "1") != "0"
 
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
@@ -202,14 +203,14 @@ def main():
             # conv MXU floor ~16ms of a ~44ms step); the matmul-dominated
             # family's numbers land in BENCH_BREADTH.json (written AFTER the
             # headline so a slow extra model can never cost this line)
-            "breadth_file": "BENCH_BREADTH.json",
+            **({"breadth_file": "BENCH_BREADTH.json"} if run_breadth else {}),
         },
     }), flush=True)
 
     # breadth + envelope evidence (LeNet / char-RNN / VGG16 / 440M-flash
     # transformer): runs AFTER the headline is safely on stdout; results go
     # to a repo-root file + stderr so stdout stays one JSON line
-    if on_tpu and os.environ.get("BENCH_BREADTH", "1") != "0":
+    if run_breadth:
         deadline = t_start + float(os.environ.get("BENCH_DEADLINE", 480))
         breadth = _breadth(deadline, on_tpu)
         out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

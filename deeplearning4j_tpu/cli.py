@@ -28,6 +28,32 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def _parse_mesh(spec: str):
+    """'data=2,model=2,seq=2' (or 'data=-1' to absorb remaining devices) ->
+    jax.sharding.Mesh via parallel.make_mesh. NOTE: initializes the JAX
+    backend — on the multihost path call only AFTER jax.distributed init.
+    Raises ValueError with a user-actionable message on malformed specs."""
+    from .parallel import make_mesh
+
+    axes = {}
+    for part in spec.split(","):
+        name, eq, size = part.partition("=")
+        name = name.strip()
+        if not eq or not name:
+            raise ValueError(f"bad --mesh entry '{part}' (want name=size)")
+        if name in axes:
+            raise ValueError(f"duplicate --mesh axis '{name}'")
+        try:
+            axes[name] = int(size)
+        except ValueError:
+            raise ValueError(f"bad --mesh size '{size}' for axis '{name}'")
+    return make_mesh(axes)
+
+
+_RULE_SETS = {"transformer": "TRANSFORMER_RULES", "dense": "DENSE_RULES",
+              "cnn": "CNN_RULES"}
+
+
 def cmd_train(args) -> int:
     if not args.regression and args.num_classes < 1:
         print("error: --num-classes is required for classification "
@@ -58,6 +84,28 @@ def cmd_train(args) -> int:
 
     import os
 
+    rules = None
+    if args.rules:
+        from . import parallel as _par
+
+        rules = getattr(_par, _RULE_SETS[args.rules])
+    if rules is not None and args.mesh is None and \
+            not os.environ.get("DL4J_TPU_MULTIHOST"):
+        print("error: --rules needs --mesh (or DL4J_TPU_MULTIHOST)",
+              file=sys.stderr)
+        return 2
+
+    def parse_mesh_or_none():
+        # deferred: building a Mesh touches jax.devices(), which must happen
+        # AFTER jax.distributed init on the multihost path
+        if not args.mesh:
+            return None, 0
+        try:
+            return _parse_mesh(args.mesh), 0
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return None, 2
+
     if os.environ.get("DL4J_TPU_MULTIHOST"):
         # pod-slice launch (utils/provision.py multihost_train_plan): every
         # host runs this same command; bootstrap the global mesh and give
@@ -81,19 +129,32 @@ def cmd_train(args) -> int:
                   f"the full pod; refusing to train {expected} independent "
                   f"copies", file=sys.stderr)
             return 3
+        mesh, rc = parse_mesh_or_none()  # AFTER distributed init
+        if rc:
+            return rc
         feats, labels = [], []
         for ds in it:
             feats.append(np.asarray(ds.features))
             labels.append(np.asarray(ds.labels))
-        trainer = MultiHostTrainer(model)
+        trainer = MultiHostTrainer(model, mesh=mesh, rules=rules)
+        sh, ns = trainer.data_shard()
         it = ProcessShardIterator(np.concatenate(feats), np.concatenate(labels),
-                                  global_batch_size=args.batch)
+                                  global_batch_size=args.batch,
+                                  process_id=sh, num_processes=ns)
     elif args.parallel:
         from .parallel import ParallelWrapper
 
-        trainer = ParallelWrapper(model, mode=args.parallel)
+        mesh, rc = parse_mesh_or_none()
+        if rc:
+            return rc
+        trainer = ParallelWrapper(model, mesh=mesh, mode=args.parallel,
+                                  rules=rules)
     else:
-        trainer = Trainer(model)
+        # --mesh/--rules: the one sharding API (dp x tp x sp for any model)
+        mesh, rc = parse_mesh_or_none()
+        if rc:
+            return rc
+        trainer = Trainer(model, mesh=mesh, rules=rules)
     try:
         trainer.fit(it, epochs=args.epochs, listeners=listeners)
     finally:
@@ -125,6 +186,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--parallel", choices=["shared_gradients", "zero_sharded",
                                           "averaging", "encoded_gradients"],
                    default=None)
+    t.add_argument("--mesh", default=None,
+                   help="device mesh axes, e.g. 'data=2,model=2,seq=2' "
+                        "(-1 once to absorb remaining devices)")
+    t.add_argument("--rules", choices=sorted(_RULE_SETS), default=None,
+                   help="sharding rule set for --mesh (the one sharding API)")
     t.add_argument("--print-every", type=int, default=10)
     t.add_argument("--ui-port", type=int, default=0)
     t.add_argument("--save", default=None)

@@ -481,6 +481,41 @@ class TestCLI:
         assert rc == 2
         assert "--num-classes" in capsys.readouterr().err
 
+    def test_train_with_mesh_rules(self, tmp_path, capsys):
+        """--mesh/--rules: the one sharding API from the command line."""
+        from deeplearning4j_tpu.cli import main as cli_main
+        from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+        from deeplearning4j_tpu.nn import layers as L
+        from deeplearning4j_tpu.train.serialization import save_model
+
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                            "lr": 0.05}))
+               .input_shape(2)
+               .layer(L.Dense(n_out=8, activation="tanh"))
+               .layer(L.Output(n_out=2, activation="softmax", loss="mcxent"))
+               .build())
+        net.init()
+        mp = str(tmp_path / "net.zip")
+        save_model(mp, net)
+        rng = np.random.default_rng(0)
+        csv = tmp_path / "d.csv"
+        csv.write_text("\n".join(
+            f"{a:.4f},{b:.4f},{i % 2}" for i, (a, b) in
+            enumerate(rng.standard_normal((64, 2)) )))
+        out = str(tmp_path / "trained.zip")
+        rc = cli_main(["train", "--model", mp, "--csv", str(csv),
+                       "--num-classes", "2", "--epochs", "2", "--batch", "16",
+                       "--mesh", "data=4,model=2", "--rules", "dense",
+                       "--save", out])
+        assert rc == 0
+        import os
+        assert os.path.exists(out)
+        # --rules without --mesh is a config error
+        rc = cli_main(["train", "--model", mp, "--csv", str(csv),
+                       "--num-classes", "2", "--rules", "dense"])
+        assert rc == 2
+        assert "--mesh" in capsys.readouterr().err
+
 
 class TestDonationGuard:
     def test_reusing_donated_params_raises_clearly(self, iris):
