@@ -657,3 +657,40 @@ class TestKerasV3Archive:
         x = np.random.RandomState(4).randn(3, 4).astype(np.float32)
         np.testing.assert_allclose(np.asarray(model.output(x)),
                                    np.asarray(km(x)), rtol=RTOL, atol=ATOL)
+
+
+class TestKeras1Normalization:
+    def test_atrous_rate_maps_to_dilation(self):
+        """Keras-1 AtrousConvolution: the dilation IS the layer — dropping
+        atrous_rate would import a numerically wrong conv."""
+        from deeplearning4j_tpu.interop.keras_import import _normalize_config
+
+        cls, conf = _normalize_config(
+            "AtrousConvolution1D",
+            {"nb_filter": 4, "filter_length": 3, "atrous_rate": 2,
+             "subsample_length": 1, "border_mode": "same",
+             "activation": "relu", "name": "a"}, keras_major=1)
+        assert cls == "Conv1D"
+        assert conf["dilation_rate"] == [2]
+        assert conf["kernel_size"] == [3] and conf["filters"] == 4
+
+        cls2, conf2 = _normalize_config(
+            "AtrousConvolution2D",
+            {"nb_filter": 4, "nb_row": 3, "nb_col": 3, "atrous_rate": [2, 2],
+             "border_mode": "same", "activation": "relu", "name": "b"},
+            keras_major=1)
+        assert cls2 == "Conv2D"
+        assert conf2["dilation_rate"] == [2, 2]
+
+    def test_dilated_conv_converts_with_dilation(self):
+        from deeplearning4j_tpu.interop.keras_import import (_Ctx,
+                                                             _convert_layer,
+                                                             _normalize_config)
+
+        cls, conf = _normalize_config(
+            "AtrousConvolution1D",
+            {"nb_filter": 4, "filter_length": 3, "atrous_rate": 2,
+             "border_mode": "same", "activation": "relu", "name": "a"},
+            keras_major=1)
+        layer = _convert_layer(cls, conf, _Ctx(1))
+        assert layer.dilation == 2
