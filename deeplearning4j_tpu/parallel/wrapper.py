@@ -169,8 +169,10 @@ class ParallelWrapper:
 
         @partial(jax.jit, donate_argnums=(0, 1, 2),
                  out_shardings=(p_sh, opt_sh, repl, repl))
-        def step(params, opt_state, net_state, x, y, rng, mask=None):
-            mask_kw = {"mask": mask} if seq else {"masks": mask}
+        def step(params, opt_state, net_state, x, y, rng, mask=None,
+                 label_mask=None):
+            mask_kw = ({"mask": mask, "label_mask": label_mask} if seq
+                       else {"masks": mask, "label_masks": label_mask})
 
             def loss_fn(p):
                 with act_ctx():
@@ -226,15 +228,17 @@ class ParallelWrapper:
         self.opt_state = stack(tx.init(model.params))
         self._batch_sharding = dev_sh
 
-        def make_step(with_mask: bool):
-            def local_step(params, opt_state, net_state, x, y, rng, *mask):
+        def make_step(with_fm: bool, with_lm: bool):
+            def local_step(params, opt_state, net_state, x, y, rng, *masks):
                 # runs per device; leading replica axis stripped by shard_map
                 params, opt_state, net_state = (jax.tree.map(lambda a: a[0], t)
                                                 for t in (params, opt_state, net_state))
                 x, y = x[0], y[0]
-                m = mask[0][0] if with_mask else None
-                mask_kw = ({"mask": m} if isinstance(model, Sequential)
-                           else {"masks": m})
+                fm = masks[0][0] if with_fm else None
+                lm = masks[int(with_fm)][0] if with_lm else None
+                mask_kw = ({"mask": fm, "label_mask": lm}
+                           if isinstance(model, Sequential)
+                           else {"masks": fm, "label_masks": lm})
 
                 def loss_fn(p):
                     loss, new_state = model.score(p, net_state, x, y, training=True,
@@ -247,7 +251,7 @@ class ParallelWrapper:
                 expand = lambda t: jax.tree.map(lambda a: a[None], t)
                 return expand(params), expand(opt_state), expand(new_state), loss[None]
 
-            n_in = 7 if with_mask else 6
+            n_in = 6 + int(with_fm) + int(with_lm)
             sharded_step = jax.shard_map(
                 local_step, mesh=mesh,
                 in_specs=(P(DATA_AXIS),) * n_in,
@@ -256,8 +260,8 @@ class ParallelWrapper:
                                   # initialized inside would trip the check
             return jax.jit(sharded_step, donate_argnums=(0, 1, 2))
 
-        self._steps = {False: make_step(False)}
-        self._make_masked_step = lambda: make_step(True)
+        self._steps = {}
+        self._make_step_masked = make_step
 
         def avg(tree):
             def mean_one(stacked):
@@ -311,14 +315,16 @@ class ParallelWrapper:
         self.residual = jax.device_put(jnp.zeros((n, size), jnp.float32), dev_sh)
         self._batch_sharding = dev_sh
 
-        def make_step(with_mask: bool):
-            def local_step(params, opt_state, net_state, residual, x, y, rng, *mask):
+        def make_step(with_fm: bool, with_lm: bool):
+            def local_step(params, opt_state, net_state, residual, x, y, rng, *masks):
                 params, opt_state, net_state = (jax.tree.map(lambda a: a[0], t)
                                                 for t in (params, opt_state, net_state))
                 residual, x, y = residual[0], x[0], y[0]
-                m = mask[0][0] if with_mask else None
-                mask_kw = ({"mask": m} if isinstance(model, Sequential)
-                           else {"masks": m})
+                fm = masks[0][0] if with_fm else None
+                lm = masks[int(with_fm)][0] if with_lm else None
+                mask_kw = ({"mask": fm, "label_mask": lm}
+                           if isinstance(model, Sequential)
+                           else {"masks": fm, "label_masks": lm})
 
                 def loss_fn(p):
                     loss, new_state = model.score(p, net_state, x, y, training=True,
@@ -349,7 +355,7 @@ class ParallelWrapper:
                 return (expand(params), expand(opt_state), expand(new_state),
                         new_residual[None], loss[None])
 
-            n_in = 8 if with_mask else 7
+            n_in = 7 + int(with_fm) + int(with_lm)
             sharded = jax.shard_map(
                 local_step, mesh=mesh,
                 in_specs=(P(DATA_AXIS),) * n_in,
@@ -357,8 +363,8 @@ class ParallelWrapper:
                 check_vma=False)
             return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
 
-        self._steps = {False: make_step(False)}
-        self._make_masked_step = lambda: make_step(True)
+        self._steps = {}
+        self._make_step_masked = make_step
 
     # --- fit loop (ParallelWrapper.fit :467) ---
     def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = ()):
@@ -376,16 +382,20 @@ class ParallelWrapper:
                 y = np.asarray(ds.labels)
                 mask = (np.asarray(ds.features_mask)
                         if ds.features_mask is not None else None)
+                lmask = (np.asarray(ds.labels_mask)
+                         if ds.labels_mask is not None else None)
                 b = x.shape[0]
                 if b % self.n_dev:  # pad to divisible (static shapes)
                     x = self._pad_rows(x)
                     y = self._pad_rows(y)
                     if mask is not None:
                         mask = self._pad_rows(mask)
+                    if lmask is not None:
+                        lmask = self._pad_rows(lmask)
                 for lst in listeners:
                     if isinstance(lst, PerformanceListener):
                         lst.step_begin(b)
-                loss = self._fit_batch(x, y, mask)
+                loss = self._fit_batch(x, y, mask, lmask)
                 reporter.report(self.iteration, epoch, loss)
                 self.iteration += 1
             reporter.flush()
@@ -396,26 +406,28 @@ class ParallelWrapper:
         self._sync_model()
         return self
 
-    def _fit_batch(self, x, y, mask=None):
+    def _fit_batch(self, x, y, mask=None, label_mask=None):
         if self.mode in ("shared_gradients", "zero_sharded"):
             xd = jax.device_put(x, self._batch_sharding)
             yd = jax.device_put(y, self._batch_sharding)
             self.params, self.opt_state, self.state, loss = self._step(
-                self.params, self.opt_state, self.state, xd, yd, self.next_rng(), mask)
+                self.params, self.opt_state, self.state, xd, yd,
+                self.next_rng(), mask, label_mask)
             return loss
         # averaging/encoded modes: reshape to (n_dev, per_dev, ...) replica batches
         n = self.n_dev
         xr = x.reshape(n, x.shape[0] // n, *x.shape[1:])
         yr = y.reshape(n, y.shape[0] // n, *y.shape[1:])
         rngs = jax.random.split(self.next_rng(), n)
-        with_mask = mask is not None
-        if with_mask and True not in self._steps:
-            self._steps[True] = self._make_masked_step()
-        step = self._steps[with_mask]
-        extra = ()
-        if with_mask:
-            mr = np.asarray(mask).reshape(n, mask.shape[0] // n, *mask.shape[1:])
-            extra = (jax.device_put(mr, self._batch_sharding),)
+        key = (mask is not None, label_mask is not None)
+        if key not in self._steps:
+            self._steps[key] = self._make_step_masked(*key)
+        step = self._steps[key]
+        extra = tuple(
+            jax.device_put(np.asarray(m).reshape(n, m.shape[0] // n,
+                                                 *m.shape[1:]),
+                           self._batch_sharding)
+            for m in (mask, label_mask) if m is not None)
         if self.mode == "encoded_gradients":
             (self.params, self.opt_state, self.state, self.residual,
              loss) = step(

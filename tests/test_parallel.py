@@ -417,3 +417,84 @@ class TestScoreIterator:
         it2 = ArrayIterator(x[:29], y[:29], 10)
         np.testing.assert_allclose(tr.score_iterator(it1),
                                    pw.score_iterator(it2), rtol=1e-5)
+
+
+class TestLabelMasks:
+    """labels_mask threads through EVERY wrapper mode (previously silently
+    dropped): training with a label mask must differ from training without
+    it, and shared_gradients must equal single-device Trainer exactly."""
+
+    def _seq_net(self):
+        from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+        from deeplearning4j_tpu.nn import layers as L
+
+        return (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                             "learning_rate": 1e-2}))
+                .input_shape(6, 4)
+                .layer(L.LSTM(n_out=8))
+                .layer(L.RnnOutput(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+
+    def _data(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 6, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, (16, 6))]
+        lm = np.zeros((16, 6), np.float32)
+        lm[:, :2] = 1.0  # score only the first two timesteps
+        return x, y, lm
+
+    def test_shared_gradients_label_mask_equals_trainer(self):
+        from deeplearning4j_tpu.data.iterators import DataSet
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        from deeplearning4j_tpu.train import Trainer
+
+        x, y, lm = self._data()
+
+        class It:
+            def __iter__(self):
+                return iter([DataSet(x, y, None, lm)])
+
+            def reset(self):
+                pass
+
+        tr = Trainer(self._seq_net(), seed=0)
+        tr.fit(It(), epochs=2, prefetch=False)
+        pw = ParallelWrapper(self._seq_net(), mode="shared_gradients", seed=0)
+        pw.fit(It(), epochs=2)
+        pw._sync_model()
+        for k in tr.params:
+            for k2, v in tr.params[k].items():
+                np.testing.assert_allclose(
+                    np.asarray(pw.model.params[k][k2]), np.asarray(v),
+                    rtol=2e-5, atol=1e-6, err_msg=f"{k}/{k2}")
+
+    @pytest.mark.parametrize("mode", ["averaging", "encoded_gradients"])
+    def test_replica_modes_use_label_mask(self, mode):
+        from deeplearning4j_tpu.data.iterators import DataSet
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        x, y, lm = self._data()
+
+        def run(with_lm):
+            class It:
+                def __iter__(self):
+                    return iter([DataSet(x, y, None, lm if with_lm else None)])
+
+                def reset(self):
+                    pass
+
+            kw = dict(threshold=1e-5, capacity_frac=0.5, quantize=False) \
+                if mode == "encoded_gradients" else {}
+            pw = ParallelWrapper(self._seq_net(), mode=mode, seed=0, **kw)
+            pw.fit(It(), epochs=2)
+            pw._sync_model()
+            import jax
+
+            return np.concatenate([np.asarray(v).ravel() for v in
+                                   jax.tree_util.tree_leaves(pw.model.params)])
+
+        masked, unmasked = run(True), run(False)
+        assert not np.allclose(masked, unmasked), \
+            f"{mode}: labels_mask had no effect (silently dropped)"
+        assert np.isfinite(masked).all()
