@@ -499,8 +499,9 @@ class MultiHostTrainer:
 
         total, n_batches = 0.0, 0
         for ds in iterator:
-            x, y, mask, _ = self._global_batch(ds)
-            total += float(self._score_fn(sparams, sstate, x, y, mask))
+            x, y, mask, label_mask = self._global_batch(ds)
+            total += float(self._score_fn(sparams, sstate, x, y, mask,
+                                          label_mask))
             n_batches += 1
         if hasattr(iterator, "reset"):
             iterator.reset()

@@ -527,20 +527,21 @@ class ParallelWrapper:
             x = np.asarray(ds.features)
             y = np.asarray(ds.labels)
             m = np.asarray(ds.features_mask) if ds.features_mask is not None else None
+            lm = np.asarray(ds.labels_mask) if ds.labels_mask is not None else None
             n = x.shape[0]
-            n_div = n - n % self.n_dev
-            batch_sum = 0.0
-            if n_div:
-                md = jax.device_put(m[:n_div], batch_sh) if m is not None else None
-                batch_sum += float(score(params, state,
-                                         jax.device_put(x[:n_div], batch_sh),
-                                         jax.device_put(y[:n_div], batch_sh),
-                                         md)) * n_div
-            if n - n_div:
-                mr = m[n_div:] if m is not None else None
-                batch_sum += float(score(params, state, x[n_div:], y[n_div:],
-                                         mr)) * (n - n_div)
-            total += batch_sum / n
+            if n % self.n_dev == 0:  # shard the whole batch over the mesh
+                total += float(score(
+                    params, state,
+                    jax.device_put(x, batch_sh), jax.device_put(y, batch_sh),
+                    jax.device_put(m, batch_sh) if m is not None else None,
+                    jax.device_put(lm, batch_sh) if lm is not None else None))
+            else:
+                # a ragged batch is scored whole and UNSHARDED: masked losses
+                # reduce sum(loss*mask)/sum(mask), so recombining split
+                # sub-batch means by row counts would be wrong whenever mask
+                # coverage varies per row (exact Trainer.score_iterator
+                # contract beats the partial sharding win)
+                total += float(score(params, state, x, y, m, lm))
             n_batches += 1
         if hasattr(iterator, "reset"):
             iterator.reset()

@@ -61,10 +61,11 @@ def make_score_fn(model, mesh=None):
     ctx = _mesh_ctx(mesh)
 
     @jax.jit
-    def score(params, state, x, y, mask=None):
+    def score(params, state, x, y, mask=None, label_mask=None):
+        kw = ({"mask": mask, "label_mask": label_mask} if seq
+              else {"masks": mask, "label_masks": label_mask})
         with ctx():
-            l, _ = model.score(params, state, x, y, training=False,
-                               **({"mask": mask} if seq else {"masks": mask}))
+            l, _ = model.score(params, state, x, y, training=False, **kw)
         return l
 
     return score
@@ -490,12 +491,14 @@ class Trainer:
 
     def score_iterator(self, iterator) -> float:
         """Average loss over an iterator (model.score(DataSetIterator) parity)."""
-        score = make_score_fn(self.model, self.mesh)
+        if getattr(self, "_score_fn", None) is None:  # cache: rebuilding the
+            self._score_fn = make_score_fn(self.model, self.mesh)  # jit each
+        score = self._score_fn  # call would recompile every epoch
 
         total, n = 0.0, 0
         for ds in iterator:
-            x, y, fm, _ = self._unpack_batch(ds)
-            total += float(score(self.params, self.state, x, y, fm))
+            x, y, fm, lm = self._unpack_batch(ds)
+            total += float(score(self.params, self.state, x, y, fm, lm))
             n += 1
         if hasattr(iterator, "reset"):
             iterator.reset()

@@ -498,3 +498,64 @@ class TestLabelMasks:
         assert not np.allclose(masked, unmasked), \
             f"{mode}: labels_mask had no effect (silently dropped)"
         assert np.isfinite(masked).all()
+
+    def test_score_iterator_honors_label_mask(self):
+        """score_iterator with a DISTINCT labels_mask must differ from the
+        unmasked score and agree across Trainer / ParallelWrapper /
+        MultiHostTrainer."""
+        from deeplearning4j_tpu.data.iterators import DataSet
+        from deeplearning4j_tpu.parallel import (MultiHostTrainer,
+                                                 ParallelWrapper)
+        from deeplearning4j_tpu.train import Trainer
+
+        x, y, lm = self._data()
+
+        def it(with_lm):
+            class It:
+                def __iter__(self):
+                    return iter([DataSet(x, y, None, lm if with_lm else None)])
+
+                def reset(self):
+                    pass
+
+            return It()
+
+        tr = Trainer(self._seq_net(), seed=0)
+        s_masked = tr.score_iterator(it(True))
+        s_plain = tr.score_iterator(it(False))
+        assert abs(s_masked - s_plain) > 1e-6, "labels_mask ignored in scoring"
+        pw = ParallelWrapper(self._seq_net(), mode="shared_gradients", seed=0)
+        np.testing.assert_allclose(pw.score_iterator(it(True)), s_masked,
+                                   rtol=1e-5)
+        mh = MultiHostTrainer(self._seq_net(), seed=0)
+        np.testing.assert_allclose(mh.score_iterator(it(True)), s_masked,
+                                   rtol=1e-5)
+
+    def test_score_iterator_ragged_batch_with_varying_mask(self):
+        """A batch NOT divisible by n_dev with per-row-varying label-mask
+        coverage: wrapper score must equal Trainer exactly (sum/sum masked
+        reduction — row-count recombination of split sub-batches would be
+        wrong here)."""
+        from deeplearning4j_tpu.data.iterators import DataSet
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        from deeplearning4j_tpu.train import Trainer
+
+        rng = np.random.RandomState(1)
+        n = 10  # not divisible by the 8-device mesh
+        x = rng.randn(n, 6, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, (n, 6))]
+        lm = np.zeros((n, 6), np.float32)
+        for i in range(n):  # wildly varying coverage per row
+            lm[i, : 1 + (i % 6)] = 1.0
+
+        class It:
+            def __iter__(self):
+                return iter([DataSet(x, y, None, lm)])
+
+            def reset(self):
+                pass
+
+        tr = Trainer(self._seq_net(), seed=0)
+        pw = ParallelWrapper(self._seq_net(), mode="shared_gradients", seed=0)
+        np.testing.assert_allclose(pw.score_iterator(It()),
+                                   tr.score_iterator(It()), rtol=1e-5)
