@@ -89,10 +89,11 @@ def cmd_train(args) -> int:
         from . import parallel as _par
 
         rules = getattr(_par, _RULE_SETS[args.rules])
-    if rules is not None and args.mesh is None and \
-            not os.environ.get("DL4J_TPU_MULTIHOST"):
-        print("error: --rules needs --mesh (or DL4J_TPU_MULTIHOST)",
-              file=sys.stderr)
+    if rules is not None and args.mesh is None:
+        # without a model/seq axis every rule silently replicates — reject
+        # on the multihost path too (its default mesh is pure-dp)
+        print("error: --rules needs --mesh with a model/seq axis "
+              "(e.g. --mesh data=-1,model=2)", file=sys.stderr)
         return 2
 
     def parse_mesh_or_none():

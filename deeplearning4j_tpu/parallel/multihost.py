@@ -197,15 +197,20 @@ class MultiHostTrainer:
         the ``data`` axis only, so processes whose devices sit in the same
         data block (tp/sp peers) must supply the SAME rows. Pass the result
         to ``ProcessShardIterator(process_id=, num_processes=)``. On a pure
-        dp mesh this degenerates to (process_index, process_count)."""
+        dp mesh this degenerates to (process_index, process_count) — incl.
+        multi-device hosts (a 4-chip host covering data blocks [4i, 4i+4)
+        feeds shard i of nprocs)."""
         coords, dp = self._dp_coverage()
         if jax.process_count() == 1:
             return 0, 1
-        if len(coords) != 1:
+        k = len(coords)
+        contiguous = coords == list(range(coords[0], coords[0] + k))
+        if not contiguous or coords[0] % k or dp % k:
             raise ValueError(
-                f"this process's devices span data-axis blocks {coords} "
-                f"— feed per-device shards instead of one process shard")
-        return coords[0], dp
+                f"this process's devices cover non-contiguous/unaligned "
+                f"data-axis blocks {coords} (of {dp}) — feed per-device "
+                f"shards instead of one process shard")
+        return coords[0] // k, dp // k
 
     def next_rng(self):
         self._rng, k = jax.random.split(self._rng)
@@ -221,8 +226,6 @@ class MultiHostTrainer:
     # (gloo/DCN), every worker applies the identical decoded mean. ---
     def _init_encoded(self, threshold: float, capacity_frac: Optional[float],
                       quantize: bool):
-        from functools import partial as _partial
-
         from jax.flatten_util import ravel_pytree
 
         from .compression import (auto_capacity_frac, threshold_encode,
@@ -433,8 +436,11 @@ class MultiHostTrainer:
             for ds in iterator:
                 for lst in listeners:
                     if isinstance(lst, PerformanceListener):
-                        lst.step_begin(int(np.asarray(ds.features).shape[0])
-                                       * jax.process_count())
+                        # global examples = local rows x distinct data blocks
+                        # NOT covered by this process (tp/sp peers feed
+                        # duplicate rows — process_count would overcount)
+                        coords, dp = self._dp_coverage()
+                        lst.step_begin(ds.num_examples * (dp // len(coords)))
                 if self.mode == "encoded_gradients":
                     loss = self._fit_batch_encoded(ds)
                 else:
@@ -600,8 +606,12 @@ class MultiHostTrainer:
 
     def restore(self, path: str):
         """Resume from a ``save`` checkpoint: params/state/opt_state are
-        re-placed on the mesh with their original shardings, so a restored
-        run continues EXACTLY (resume-equivalence, SURVEY §5)."""
+        re-placed on the mesh with their original shardings. The zip format
+        (ModelSerializer parity) does NOT carry the rng stream/iteration —
+        training continuation is exact for models without stochastic layers;
+        for dropout-bearing models use the orbax path
+        (``train.orbax_io.save_trainer``/``restore_trainer``), which
+        persists both."""
         from ..train.serialization import load_model
         from .sharding import replicate_on_mesh
 
@@ -613,6 +623,10 @@ class MultiHostTrainer:
         if self.mode == "encoded_gradients":
             self.params = self._stack(params)
             self.state = self._stack(state)
+            # a trainer that already trained carries a stale error-feedback
+            # residual; the zip doesn't persist it — reset rather than apply
+            # the previous run's feedback to the restored weights
+            self.residual = jax.tree.map(jnp.zeros_like, self.residual)
             if opt_state is not None:
                 self.opt_state = self._stack(opt_state)
             return self

@@ -125,29 +125,37 @@ class ParallelWrapper:
             self.params = jax.device_put(model.params, repl)
         self.state = jax.device_put(model.state, repl)
         opt0 = tx.init(self.params)
+        n = mesh.shape[DATA_AXIS]
+
+        def opt_spec(a):
+            if getattr(a, "ndim", 0) == 0:
+                return P()
+            divisible = [(d, a.shape[d]) for d in range(a.ndim)
+                         if a.shape[d] % n == 0 and a.shape[d] >= n]
+            if not divisible:
+                return P()
+            d = max(divisible, key=lambda t: t[1])[0]
+            spec = [None] * a.ndim
+            spec[d] = DATA_AXIS
+            return P(*spec)
+
         if self.rules:
-            # moments inherited the params' tp/sp shardings from eager init;
-            # keep them (zero_sharded's data-axis re-shard would discard the
-            # rule layout). Off-mesh leaves (adam's count) go replicated.
-            opt_sh = jax.tree.map(
-                lambda a: a.sharding
-                if getattr(getattr(a, "sharding", None), "mesh", None) == mesh
-                else repl, opt0)
+            # moments inherited the params' tp/sp shardings from eager init —
+            # keep those; with zero_sharded, leaves that came out REPLICATED
+            # (un-ruled params' moments) additionally shard over the data
+            # axis, so rules + ZeRO-1 compose instead of rules silently
+            # disabling the optimizer-memory saving
+            def rule_or_zero(a):
+                sh = getattr(a, "sharding", None)
+                if getattr(sh, "mesh", None) == mesh and \
+                        any(ax is not None for ax in getattr(sh, "spec", ())):
+                    return sh
+                if shard_opt_state:
+                    return NamedSharding(mesh, opt_spec(jnp.asarray(a)))
+                return repl
+
+            opt_sh = jax.tree.map(rule_or_zero, opt0)
         elif shard_opt_state:
-            n = mesh.shape[DATA_AXIS]
-
-            def opt_spec(a):
-                if getattr(a, "ndim", 0) == 0:
-                    return P()
-                divisible = [(d, a.shape[d]) for d in range(a.ndim)
-                             if a.shape[d] % n == 0 and a.shape[d] >= n]
-                if not divisible:
-                    return P()
-                d = max(divisible, key=lambda t: t[1])[0]
-                spec = [None] * a.ndim
-                spec[d] = DATA_AXIS
-                return P(*spec)
-
             opt_sh = jax.tree.map(
                 lambda a: NamedSharding(mesh, opt_spec(jnp.asarray(a))), opt0)
         else:
@@ -529,17 +537,29 @@ class ParallelWrapper:
             m = np.asarray(ds.features_mask) if ds.features_mask is not None else None
             lm = np.asarray(ds.labels_mask) if ds.labels_mask is not None else None
             n = x.shape[0]
+            n_div = n - n % self.n_dev
             if n % self.n_dev == 0:  # shard the whole batch over the mesh
                 total += float(score(
                     params, state,
                     jax.device_put(x, batch_sh), jax.device_put(y, batch_sh),
                     jax.device_put(m, batch_sh) if m is not None else None,
                     jax.device_put(lm, batch_sh) if lm is not None else None))
+            elif m is None and lm is None and n_div:
+                # unmasked ragged batch: the split-and-recombine-by-row-count
+                # path is EXACT (plain per-example mean), so keep the
+                # divisible block sharded and only the tail unsharded
+                s_div = float(score(params, state,
+                                    jax.device_put(x[:n_div], batch_sh),
+                                    jax.device_put(y[:n_div], batch_sh),
+                                    None, None))
+                s_tail = float(score(params, state, x[n_div:], y[n_div:],
+                                     None, None))
+                total += (s_div * n_div + s_tail * (n - n_div)) / n
             else:
-                # a ragged batch is scored whole and UNSHARDED: masked losses
-                # reduce sum(loss*mask)/sum(mask), so recombining split
-                # sub-batch means by row counts would be wrong whenever mask
-                # coverage varies per row (exact Trainer.score_iterator
+                # a MASKED ragged batch is scored whole and unsharded: masked
+                # losses reduce sum(loss*mask)/sum(mask), so recombining
+                # split sub-batch means by row counts would be wrong whenever
+                # mask coverage varies per row (exact Trainer.score_iterator
                 # contract beats the partial sharding win)
                 total += float(score(params, state, x, y, m, lm))
             n_batches += 1

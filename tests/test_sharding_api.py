@@ -273,3 +273,31 @@ class TestCnnRules:
                                                             MODEL_AXIS)
         got = _fit_steps(tr, x, y, steps=4, bs=8)
         chex.assert_trees_all_close(got, ref, rtol=5e-5, atol=1e-6)
+
+
+class TestZeroShardedWithRules:
+    def test_rules_compose_with_zero1(self):
+        """mode='zero_sharded' + rules: ruled moments keep the tp layout,
+        un-ruled (replicated) moments still get the ZeRO-1 data-axis shard —
+        and training equals plain Trainer."""
+        from deeplearning4j_tpu.data import ArrayIterator
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        x, y = _data(32)
+        ref = _fit_steps(Trainer(_mlp(), seed=3), x, y, steps=4, bs=8)
+
+        mesh = make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2}, jax.devices()[:8])
+        # rules that shard ONLY the first layer, leaving layer_1/layer_2
+        # moments replicated -> they must pick up the data-axis shard
+        rules = ((r"layer_0/w", P(None, MODEL_AXIS)),)
+        pw = ParallelWrapper(_mlp(), mesh=mesh, seed=3, mode="zero_sharded",
+                             rules=rules)
+        mu = pw.opt_state[0].mu
+        assert mu["layer_0"]["w"].sharding.spec == P(None, MODEL_AXIS)
+        zero_spec = mu["layer_1"]["w"].sharding.spec
+        assert DATA_AXIS in [ax for ax in zero_spec if ax], \
+            f"un-ruled moment not ZeRO-sharded: {zero_spec}"
+        pw.fit(ArrayIterator(x, y, 8, shuffle=False), epochs=1)
+        chex.assert_trees_all_close(
+            jax.tree.map(np.asarray, pw.model.params), ref,
+            rtol=2e-5, atol=1e-6)
