@@ -111,7 +111,9 @@ def _breadth(deadline: float, on_tpu: bool) -> dict:
     case (440M CausalLM + flash kernel — PERF.md's 0.45-MFU argument for
     where the hardware ceiling actually is) while time remains. Every job is
     individually fenced; running out of deadline records the skip instead of
-    risking the headline."""
+    risking the headline. A skipped/failed job keeps the previously captured
+    number from BENCH_BREADTH.json (same device kind) so a slow run never
+    erases a real measurement."""
     import sys as _sys
 
     _sys.path.insert(0, os.path.join(os.path.dirname(
@@ -139,14 +141,28 @@ def _breadth(deadline: float, on_tpu: bool) -> dict:
                           input_shape=(224, 224, 3)).build(),
             64, (224, 224, 3), 1000, on_tpu=on_tpu)),
     ]
+    prior = {}
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_BREADTH.json")) as f:
+            saved = json.load(f)
+        import jax
+        if saved.get("device") == str(jax.devices()[0].device_kind):
+            prior = {k: v for k, v in saved.get("breadth", {}).items()
+                     if isinstance(v, dict) and "mfu" in v}
+    except Exception:
+        pass
     for name, fn in jobs:
         if time.time() > deadline:
-            out[name] = {"skipped": "deadline"}
+            out[name] = (dict(prior[name], kept="prior run (deadline)")
+                         if name in prior else {"skipped": "deadline"})
             continue
         try:
-            out[name] = fn()
+            out[name] = dict(fn(), captured=time.strftime("%Y-%m-%d"))
         except Exception as e:
-            out[name] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+            err = f"{type(e).__name__}: {str(e)[:160]}"
+            out[name] = (dict(prior[name], kept=f"prior run ({err})")
+                         if name in prior else {"error": err})
     return out
 
 
