@@ -23,7 +23,7 @@ PEAK_BF16 = {"TPU v4": 275e12, "TPU v5 lite": 197e12, "TPU v5": 459e12,
 
 
 def bench_model(name, build_fn, batch, in_shape, n_classes, *, seq=False,
-                steps=20, bf16=True, on_tpu=True):
+                steps=20, bf16=True, on_tpu=True, token_vocab=None):
     import jax
 
     from deeplearning4j_tpu.train import Trainer
@@ -36,7 +36,10 @@ def bench_model(name, build_fn, batch, in_shape, n_classes, *, seq=False,
     step = tr._make_step()
     rng = np.random.RandomState(0)
     x = rng.randn(batch, *in_shape).astype(np.float32)
-    if seq:  # (B, T, V) one-hot inputs + (B, T, V) targets (char-RNN)
+    if token_vocab:  # (B, T) int token ids (BERT fine-tune shape)
+        x = rng.randint(0, token_vocab, (batch, *in_shape)).astype(np.int32)
+        y = np.eye(n_classes, dtype=np.float32)[rng.randint(0, n_classes, batch)]
+    elif seq:  # (B, T, V) one-hot inputs + (B, T, V) targets (char-RNN)
         T, V = in_shape
         ids = rng.randint(0, V, (batch, T))
         x = np.eye(V, dtype=np.float32)[ids]
@@ -134,7 +137,7 @@ def main():
 
     smoke = bool(os.environ.get("MB_SMOKE"))
     on_tpu = jax.devices()[0].platform == "tpu"
-    from deeplearning4j_tpu.models import (LeNet, ResNet50, VGG16,
+    from deeplearning4j_tpu.models import (BertBase, LeNet, ResNet50, VGG16,
                                            GravesLSTMCharRNN)
 
     img = 224 if (on_tpu and not smoke) else 32
@@ -156,6 +159,15 @@ def main():
                           input_shape=(img, img, 3)).build(),
          dict(batch=2 if smoke else 128, in_shape=(img, img, 3),
               n_classes=1000)),
+        # BASELINE config 5 (stretch): BERT-base fine-tune shape — the
+        # architecture the Keras/HF import path targets (models/transformer.py
+        # BertBase; keras_import golden tests cover the weight path).
+        ("bert_base_t128",
+         lambda: BertBase(small=smoke, num_classes=2, seed=0,
+                          input_shape=(16 if smoke else 128,),
+                          flash=False).build(),
+         dict(batch=2 if smoke else 64, in_shape=(16 if smoke else 128,),
+              n_classes=2, token_vocab=1000 if smoke else 30522)),
     ]
     steps = 3 if smoke else 20
     for name, build, kw in jobs:
