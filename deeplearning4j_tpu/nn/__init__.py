@@ -3,6 +3,7 @@ containers — TPU-native replacement for deeplearning4j-nn."""
 
 from . import layers, vertices
 from .api import Layer, layer_from_dict, register_layer
+from .generation import generate, sample_logits
 from .model import (Graph, GraphBuilder, GraphNode, NetConfig, Sequential,
                     SequentialBuilder)
 from .transfer import (FineTuneConfiguration, TransferGraphBuilder,
@@ -11,5 +12,5 @@ from .transfer import (FineTuneConfiguration, TransferGraphBuilder,
 __all__ = ["FineTuneConfiguration", "Graph", "GraphBuilder", "GraphNode",
            "Layer", "NetConfig", "Sequential", "SequentialBuilder",
            "TransferGraphBuilder", "TransferLearningBuilder",
-           "TransferLearningHelper", "layer_from_dict", "layers",
-           "register_layer", "vertices"]
+           "TransferLearningHelper", "generate", "layer_from_dict", "layers",
+           "register_layer", "sample_logits", "vertices"]

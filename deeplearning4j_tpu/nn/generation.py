@@ -1,0 +1,227 @@
+"""Autoregressive generation: KV-cache decode + sampling for Sequential models.
+
+Reference parity: DL4J generates text by stepping a stateful net one token at
+a time — ``MultiLayerNetwork.rnnTimeStep`` (``MultiLayerNetwork.java:2800``)
+drives the char-by-char sampling loop behind ``TextGenerationLSTM``
+(``zoo/model/TextGenerationLSTM.java``), re-dispatching every op per token.
+
+TPU design: the whole generate loop is ONE jit-compiled program — prefill
+processes the prompt as a single chunk, then ``lax.scan`` emits tokens with
+static shapes throughout. Attention layers decode against fixed-capacity KV
+caches written in place with ``lax.dynamic_update_slice``; validity is a mask
+computed from the traced absolute position (no dynamic shapes, no per-token
+Python dispatch, no recompilation between steps). Recurrent layers thread
+their ``rnnTimeStep`` carries through the same scan. Works for any Sequential
+whose layers are token-local (embedding/norm/dense/output), recurrent, or
+causal attention — i.e. the CausalLM / TextGenerationLSTM / GravesLSTMCharRNN
+families — without the model opting in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops import activations as _act
+from .layers import (ActivationLayer, AlphaDropout, Dense, DropoutLayer,
+                     ElementWiseMultiplication, Embedding, EmbeddingSequence,
+                     GaussianDropout, GaussianNoise, LayerNorm,
+                     MultiHeadAttention, Output, PositionalEmbedding, PReLU,
+                     RMSNorm, TransformerEncoderBlock)
+from .layers.recurrent import RecurrentLayer
+from .model import DTYPES, Sequential, _cast_floats, _layer_key
+
+# Layers that act on each position independently — safe to run on a decode
+# chunk with their ordinary eval-time apply(). Anything outside this set,
+# the attention/positional/recurrent special cases, and the final Output is
+# rejected by generate() up front: silently decoding a sequence-global layer
+# (GlobalPooling, Bidirectional, convolution over time, ...) one token at a
+# time would return numbers that disagree with the full forward pass.
+_TOKEN_LOCAL = (ActivationLayer, AlphaDropout, Dense, DropoutLayer,
+                ElementWiseMultiplication, Embedding, EmbeddingSequence,
+                GaussianDropout, GaussianNoise, LayerNorm, PReLU, RMSNorm)
+
+
+def _mha_decode(num_heads: int, params, x, cache, pos):
+    """Decode a query chunk ``x`` (B, Tq, D) at absolute offset ``pos``
+    against a KV cache {"k","v"}: (B, C, H, hd). Returns (y, new_cache).
+    Attention is causal by construction — the ``valid`` mask lets token t
+    see cache slots 0..pos+t; generate() rejects non-causal attention
+    layers up front (they cannot be decoded incrementally)."""
+    B, Tq, D = x.shape
+    H = num_heads
+    hd = D // H
+    qkv = x @ params["w_qkv"] + params["b_qkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, Tq, H, hd)
+    k = k.reshape(B, Tq, H, hd)
+    v = v.reshape(B, Tq, H, hd)
+    ck = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
+                                  (0, pos, 0, 0))
+    cv = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
+                                  (0, pos, 0, 0))
+    C = ck.shape[1]
+    scale = 1.0 / np.sqrt(hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                        preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(C)[None, :] <= (pos + jnp.arange(Tq)[:, None])  # (Tq, C)
+    scores = jnp.where(valid[None, None], scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    y = jnp.einsum("bhqk,bkhd->bqhd", w, cv)
+    y = y.reshape(B, Tq, D) @ params["w_o"] + params["b_o"]
+    return y, {"k": ck, "v": cv}
+
+
+def _init_caches(model: Sequential, batch: int, capacity: int, dtype):
+    caches: Dict[str, Any] = {}
+    for i, layer in enumerate(model.layers):
+        k = _layer_key(i, layer)
+        if isinstance(layer, (TransformerEncoderBlock, MultiHeadAttention)):
+            d = model._shapes[i][-1]
+            hd = d // layer.num_heads
+            z = jnp.zeros((batch, capacity, layer.num_heads, hd), dtype)
+            caches[k] = {"k": z, "v": z}
+        elif isinstance(layer, RecurrentLayer):
+            caches[k] = layer.init_carry(batch, model._shapes[i], dtype)
+    return caches
+
+
+def _decode_forward(model: Sequential, params, state, x, caches, pos):
+    """Run one decode chunk through the stack. ``x``: (B, Tq) int ids or
+    (B, Tq, F) features at absolute offset ``pos``; returns
+    (logits (B, Tq, V), new_caches). The final Output layer contributes its
+    PRE-activation (logits) — sampling applies temperature in logit space."""
+    cdt = DTYPES[model.config.compute_dtype] if model.config.compute_dtype else None
+    if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(cdt)
+    new = dict(caches)
+    mask = None
+    for i, layer in enumerate(model.layers):
+        k = _layer_key(i, layer)
+        p = params.get(k, {})
+        if cdt is not None:
+            p = _cast_floats(p, cdt)
+        if isinstance(layer, TransformerEncoderBlock):
+            h = layer._ln(x, p["ln1_g"], p["ln1_b"])
+            a, new[k] = _mha_decode(layer.num_heads, p["attn"], h, new[k], pos)
+            x = x + a
+            h = layer._ln(x, p["ln2_g"], p["ln2_b"])
+            m = (_act.get(layer.activation)(h @ p["w_up"] + p["b_up"])
+                 @ p["w_down"] + p["b_down"])
+            x = x + m
+        elif isinstance(layer, MultiHeadAttention):
+            x, new[k] = _mha_decode(layer.num_heads, p, x, new[k], pos)
+        elif isinstance(layer, PositionalEmbedding):
+            Tq = x.shape[1]
+            x = x + lax.dynamic_slice(p["pos"], (pos, 0),
+                                      (Tq, p["pos"].shape[1]))
+        elif isinstance(layer, RecurrentLayer):
+            x, new[k] = layer.apply_sequence(p, x, new[k])
+        elif isinstance(layer, Output):  # incl. RnnOutput/CenterLossOutput
+            x = layer.preactivation(p, x)
+        else:  # token-local layers: embedding, norms, dense, dropout(eval)...
+            x, _, mask = layer.apply(p, state.get(k, {}), x,
+                                     training=False, mask=mask)
+    if cdt is not None:
+        x = x.astype(jnp.float32)
+    return x, new
+
+
+def sample_logits(logits, rng, temperature: float = 1.0,
+                  top_k: Optional[int] = None):
+    """Sample token ids (B,) from (B, V) logits. ``temperature=0`` = greedy;
+    ``top_k`` restricts sampling to the k most likely tokens."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, -1e30)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(model: Sequential, prompt, max_new_tokens: int, *,
+             params=None, state=None, temperature: float = 1.0,
+             top_k: Optional[int] = None, rng=None,
+             capacity: Optional[int] = None) -> np.ndarray:
+    """Autoregressively continue ``prompt`` for ``max_new_tokens`` tokens.
+
+    ``prompt``: (B, Tp) int token ids (embedding-front models, e.g. CausalLM)
+    or (B, Tp, V) one-hot rows (char models, e.g. TextGenerationLSTM /
+    GravesLSTMCharRNN — the sampled id is re-fed as a one-hot row exactly
+    like the reference's sampling loop). Returns the generated ids (B, N).
+
+    One compiled program: prompt prefill + a ``lax.scan`` over decode steps.
+    ``capacity`` (default Tp + max_new_tokens) sizes the KV caches.
+    """
+    params = params if params is not None else model.params
+    state = state if state is not None else model.state
+    assert params is not None, "call init() first"
+    prompt = jnp.asarray(prompt)
+    onehot = prompt.ndim == 3
+    B, Tp = prompt.shape[:2]
+    total = Tp + max_new_tokens
+    capacity = capacity or total
+    if capacity < total:
+        raise ValueError(f"capacity {capacity} < prompt+new tokens {total}")
+    for i, layer in enumerate(model.layers):
+        if isinstance(layer, PositionalEmbedding):
+            if layer.max_len < total:
+                raise ValueError(
+                    f"PositionalEmbedding(max_len={layer.max_len}) is shorter "
+                    f"than prompt+new tokens {total}")
+        elif isinstance(layer, (TransformerEncoderBlock, MultiHeadAttention)):
+            if not layer.causal:
+                raise ValueError(
+                    f"layer {i} {type(layer).__name__}(causal=False) cannot "
+                    f"be decoded autoregressively — generation needs causal "
+                    f"attention")
+        elif isinstance(layer, (RecurrentLayer, _TOKEN_LOCAL)):
+            pass
+        elif isinstance(layer, Output) and i == len(model.layers) - 1:
+            pass
+        else:
+            raise ValueError(
+                f"generate() does not support layer {i} "
+                f"{type(layer).__name__}: it is not token-local along the "
+                f"sequence (decoding it one token at a time would disagree "
+                f"with the full forward pass)")
+    out_layer = model.layers[-1]
+    V = getattr(out_layer, "n_out", 0) or model._shapes[-1][-1]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    caches = _init_caches(model, B, capacity, model.dtype)
+
+    def embed(tok):  # (B,) int -> next input chunk
+        if onehot:
+            return jax.nn.one_hot(tok, V, dtype=prompt.dtype)[:, None, :]
+        return tok[:, None].astype(prompt.dtype)
+
+    def run(params, state, prompt, rng):
+        logits, c = _decode_forward(model, params, state, prompt, caches, 0)
+        last = logits[:, -1]
+
+        def body(carry, i):
+            c, last, rng = carry
+            rng, k1 = jax.random.split(rng)
+            tok = sample_logits(last, k1, temperature, top_k)
+            lg, c = _decode_forward(model, params, state, embed(tok), c,
+                                    Tp + i)
+            return (c, lg[:, -1], rng), tok
+
+        (_, _, _), toks = lax.scan(body, (c, last, rng),
+                                   jnp.arange(max_new_tokens))
+        return toks.T  # (B, N)
+
+    # one compiled program per (shape/sampling) signature, cached ON the
+    # model so repeated generate() calls (the interactive use) don't
+    # recompile; the cache dies with the model object
+    key = (B, Tp, max_new_tokens, capacity, onehot, float(temperature),
+           top_k, str(prompt.dtype), str(model.config.compute_dtype))
+    jit_cache = model.__dict__.setdefault("_generate_jit_cache", {})
+    if key not in jit_cache:
+        jit_cache[key] = jax.jit(run)
+    return np.asarray(jit_cache[key](params, state, prompt, rng))

@@ -1,0 +1,166 @@
+"""Autoregressive generation: KV-cache decode + sampling (nn/generation.py).
+
+The load-bearing oracle is EQUIVALENCE (SURVEY §4): incremental decode with
+KV caches must reproduce the full-sequence forward pass position for
+position, for both the attention family (CausalLM) and the recurrent family
+(TextGenerationLSTM one-hot char models) — the rnnTimeStep contract
+(MultiLayerNetwork.java:2800) generalized to attention caches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import CausalLM, TextGenerationLSTM
+from deeplearning4j_tpu.nn.generation import (_decode_forward, _init_caches,
+                                              generate, sample_logits)
+
+
+def _stepwise_logits(model, prompt, capacity):
+    """Feed tokens one at a time through the decode path; collect logits."""
+    caches = _init_caches(model, prompt.shape[0], capacity, model.dtype)
+    outs = []
+    for t in range(prompt.shape[1]):
+        chunk = prompt[:, t:t + 1]
+        lg, caches = _decode_forward(model, model.params, model.state,
+                                     jnp.asarray(chunk), caches, t)
+        outs.append(np.asarray(lg[:, 0]))
+    return np.stack(outs, axis=1)  # (B, T, V)
+
+
+class TestCausalLMDecode:
+    def setup_method(self):
+        self.zm = CausalLM(seed=0, input_shape=(16,), num_layers=2,
+                           d_model=32, num_heads=4, vocab=50)
+        self.model = self.zm.build()
+        self.model.init()
+        rng = np.random.RandomState(0)
+        self.prompt = rng.randint(0, 50, (2, 10)).astype(np.int32)
+
+    def _full_logprobs(self, ids):
+        probs = self.model.output(jnp.asarray(ids))
+        return np.log(np.asarray(probs) + 1e-20)
+
+    def test_prefill_matches_full_forward(self):
+        caches = _init_caches(self.model, 2, 16, self.model.dtype)
+        lg, _ = _decode_forward(self.model, self.model.params,
+                                self.model.state, jnp.asarray(self.prompt),
+                                caches, 0)
+        got = np.asarray(jax.nn.log_softmax(lg, axis=-1))
+        want = self._full_logprobs(self.prompt)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_stepwise_decode_matches_full_forward(self):
+        lg = _stepwise_logits(self.model, self.prompt, capacity=16)
+        got = np.asarray(jax.nn.log_softmax(jnp.asarray(lg), axis=-1))
+        want = self._full_logprobs(self.prompt)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_greedy_generate_matches_argmax_rollout(self):
+        n_new = 5
+        toks = generate(self.model, self.prompt, n_new, temperature=0.0)
+        assert toks.shape == (2, n_new)
+        # oracle: repeated FULL forward + argmax (no caches involved)
+        ids = self.prompt.copy()
+        for _ in range(n_new):
+            probs = np.asarray(self.model.output(jnp.asarray(ids)))
+            nxt = probs[:, -1].argmax(-1).astype(np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(toks, ids[:, -n_new:])
+
+    def test_sampled_generate_reproducible_and_in_range(self):
+        r = jax.random.PRNGKey(7)
+        a = generate(self.model, self.prompt, 4, temperature=0.8, rng=r)
+        b = generate(self.model, self.prompt, 4, temperature=0.8, rng=r)
+        np.testing.assert_array_equal(a, b)
+        assert a.min() >= 0 and a.max() < 50
+
+    def test_capacity_and_position_guards(self):
+        with pytest.raises(ValueError, match="capacity"):
+            generate(self.model, self.prompt, 5, capacity=10)
+        zm = CausalLM(seed=0, input_shape=(16,), num_layers=1, d_model=32,
+                      num_heads=4, vocab=50)
+        m = zm.build()
+        m.init()
+        # PositionalEmbedding(max_len=512) default is fine; shrink the check
+        from deeplearning4j_tpu.nn.layers import PositionalEmbedding
+        for i, l in enumerate(m.layers):
+            if isinstance(l, PositionalEmbedding):
+                m.layers[i] = PositionalEmbedding(max_len=12)
+        with pytest.raises(ValueError, match="max_len"):
+            generate(m, self.prompt, 5)
+
+    def test_rejects_non_causal_and_sequence_global_models(self):
+        from deeplearning4j_tpu.models import BertBase
+        bert = BertBase(small=True, num_classes=3, input_shape=(16,)).build()
+        bert.init()
+        ids = np.zeros((1, 4), np.int32)
+        # BERT: non-causal attention first; even with causal blocks, its
+        # GlobalPooling head is sequence-global — both must be rejected
+        with pytest.raises(ValueError, match="causal"):
+            generate(bert, ids, 3)
+        from deeplearning4j_tpu.nn.layers import TransformerEncoderBlock
+        for i, l in enumerate(bert.layers):
+            if isinstance(l, TransformerEncoderBlock):
+                bert.layers[i] = TransformerEncoderBlock(
+                    num_heads=l.num_heads, causal=True)
+        with pytest.raises(ValueError, match="GlobalPooling"):
+            generate(bert, ids, 3)
+
+    def test_repeated_calls_reuse_compiled_program(self):
+        a = generate(self.model, self.prompt, 3, temperature=0.0)
+        assert len(self.model.__dict__["_generate_jit_cache"]) == 1
+        b = generate(self.model, self.prompt, 3, temperature=0.0)
+        assert len(self.model.__dict__["_generate_jit_cache"]) == 1
+        np.testing.assert_array_equal(a, b)
+
+
+class TestRnnDecode:
+    def setup_method(self):
+        self.zm = TextGenerationLSTM(seed=0, input_shape=(12, 30))
+        self.zm.num_classes = 30
+        self.model = self.zm.build()
+        self.model.init()
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 30, (2, 8))
+        self.prompt = np.eye(30, dtype=np.float32)[ids]  # (B, T, V) one-hot
+
+    def test_stepwise_decode_matches_full_forward(self):
+        lg = _stepwise_logits(self.model, self.prompt, capacity=16)
+        got = np.asarray(jax.nn.log_softmax(jnp.asarray(lg), axis=-1))
+        probs = self.model.output(jnp.asarray(self.prompt))
+        want = np.log(np.asarray(probs) + 1e-20)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_greedy_generate_matches_argmax_rollout(self):
+        n_new = 4
+        toks = generate(self.model, self.prompt, n_new, temperature=0.0)
+        assert toks.shape == (2, n_new)
+        x = self.prompt.copy()
+        for _ in range(n_new):
+            probs = np.asarray(self.model.output(jnp.asarray(x)))
+            nxt = probs[:, -1].argmax(-1)
+            x = np.concatenate([x, np.eye(30, dtype=np.float32)[nxt][:, None]],
+                               axis=1)
+        want = x[:, -n_new:].argmax(-1)
+        np.testing.assert_array_equal(toks, want)
+
+
+class TestSampling:
+    def test_temperature_zero_is_argmax(self):
+        logits = jnp.asarray([[0.1, 3.0, -1.0], [2.0, 0.0, 5.0]])
+        got = sample_logits(logits, jax.random.PRNGKey(0), temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(got), [1, 2])
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 4.0]] * 64)
+        toks = np.asarray(sample_logits(
+            logits, jax.random.PRNGKey(3), temperature=1.0, top_k=2))
+        assert set(toks.tolist()) <= {3, 4}
+
+    def test_low_temperature_concentrates(self):
+        logits = jnp.asarray([[0.0, 0.5, 1.0]] * 128)
+        toks = np.asarray(sample_logits(
+            logits, jax.random.PRNGKey(5), temperature=0.05))
+        assert (toks == 2).mean() > 0.95
