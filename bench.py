@@ -133,14 +133,18 @@ def _breadth(deadline: float, on_tpu: bool) -> dict:
         # MFU in the LM family on v5e (batch 4 beats 8 — HBM pressure)
         ("causal_lm_738m_flash", lambda: mb.bench_transformer(
             d_model=2048, batch=4, flash=on_tpu)),
+        # LeNet/char-RNN single steps are 1-3 ms — tunnel dispatch dominates;
+        # spe= measures the steps_per_execution megastep (K steps as one
+        # compiled scan, Trainer._make_multi_step), the honest device number
         ("lenet_mnist", lambda: mb.bench_model(
             "lenet_mnist",
             lambda: LeNet(num_classes=10, seed=0, input_shape=(28, 28, 1)).build(),
-            1024, (28, 28, 1), 10, on_tpu=on_tpu)),
+            1024, (28, 28, 1), 10, on_tpu=on_tpu, spe=16 if on_tpu else 1)),
         ("graves_lstm_char_rnn", lambda: mb.bench_model(
             "graves_lstm_char_rnn",
             lambda: GravesLSTMCharRNN(seed=0, tbptt=0).build(),
-            128, (64, 98), 98, seq=True, on_tpu=on_tpu)),
+            128, (64, 98), 98, seq=True, on_tpu=on_tpu,
+            spe=8 if on_tpu else 1)),
         ("vgg16", lambda: mb.bench_model(
             "vgg16",
             lambda: VGG16(num_classes=1000, seed=0,

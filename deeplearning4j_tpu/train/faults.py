@@ -93,6 +93,7 @@ class DivergenceListener(TrainingListener):
             trainer._base_tx = trainer.tx
         trainer.tx = optax.chain(trainer._base_tx, optax.scale(self.lr_scale))
         trainer._step_fn = None
+        trainer._multi_step_fn = None
         trainer._tbptt_step_fn = None
 
 

@@ -632,3 +632,51 @@ class TestMultiDataSetFit:
 
         with pytest.raises(ValueError, match="expects inputs"):
             tr.fit(It(), epochs=1, prefetch=False)
+
+
+class TestStepsPerExecution:
+    """steps_per_execution=K: K steps as one lax.scan program must match K
+    single-step calls exactly (same rng stream, same updater math)."""
+
+    def test_megastep_equals_single_steps(self, iris):
+        x, y = iris
+        it = lambda: ArrayIterator(x, y, 30, shuffle=False)  # 5 batches/epoch
+        tr_a = Trainer(iris_net(seed=3))
+        tr_a.fit(it(), epochs=2)
+        tr_b = Trainer(iris_net(seed=3))
+        tr_b.fit(it(), epochs=2, steps_per_execution=4)
+        assert tr_b.iteration == tr_a.iteration
+        for ka, kb in zip(jax.tree_util.tree_leaves(tr_a.params),
+                          jax.tree_util.tree_leaves(tr_b.params)):
+            np.testing.assert_allclose(np.asarray(ka), np.asarray(kb),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_megastep_reports_every_iteration(self, iris):
+        x, y = iris
+        col = CollectScoresListener()
+        tr = Trainer(iris_net(seed=1))
+        tr.fit(ArrayIterator(x, y, 30, shuffle=False), epochs=2,
+               steps_per_execution=3, listeners=[col])
+        # 150/30 = 5 batches x 2 epochs, all reported, in order
+        assert [i for i, _ in col.scores] == list(range(10))
+        assert all(np.isfinite(s) for _, s in col.scores)
+
+    def test_megastep_ragged_tail_and_masks(self):
+        # 4 batches of 16 + ragged 8; batch-norm state + dropout rng engaged
+        rng = np.random.RandomState(0)
+        x = rng.randn(72, 6).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 72)]
+        net = (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                            "learning_rate": 1e-2}))
+               .input_shape(6)
+               .layer(L.Dense(n_out=12, activation="relu"))
+               .layer(L.BatchNorm())
+               .layer(L.DropoutLayer(rate=0.25))
+               .layer(L.Output(n_out=2, activation="softmax", loss="mcxent"))
+               .build())
+        tr = Trainer(net)
+        tr.fit(ArrayIterator(x, y, 16, shuffle=False), epochs=2,
+               steps_per_execution=2)
+        assert tr.iteration == 10  # 5 batches x 2 epochs, none dropped
+        assert all(np.all(np.isfinite(np.asarray(p)))
+                   for p in jax.tree_util.tree_leaves(tr.params))
