@@ -130,7 +130,65 @@ def _apply_layer(cfg, layer, p, s, x, *, training, rng, mask):
     return y, s_out, m_out
 
 
-class Sequential:
+class TrainableModel:
+    """``net.fit(iterator)`` front door (MultiLayerNetwork.fit :1262 /
+    ComputationGraph.fit :1010 parity): lazily builds and caches ONE Trainer
+    so repeated fits resume — params, optimizer state, rng stream and
+    iteration count carry across calls, exactly like refitting the same
+    reference network object. For meshes, custom updaters, listeners-heavy
+    loops, construct ``train.Trainer`` explicitly; ``net.trainer()`` exposes
+    the cached one."""
+
+    _trainer = None
+    _trainer_kw = None
+    _infer_fn_cache = None
+    _score_fn_cache = None
+
+    def trainer(self, **kw):
+        """The cached Trainer (built on first use, seeded from
+        ``config.seed``); passing DIFFERENT kwargs (e.g. ``mesh=``,
+        ``rules=``, ``updater=``) rebuilds — which resets optimizer state
+        and iteration count; repeating the same kwargs reuses the cache."""
+        kw.setdefault("seed", self.config.seed)
+        if self._trainer is None or kw != self._trainer_kw:
+            from ..train.trainer import Trainer
+
+            self._trainer = Trainer(self, **kw)
+            self._trainer_kw = dict(kw)
+        return self._trainer
+
+    def fit(self, iterator, epochs: int = 1, **kw):
+        return self.trainer().fit(iterator, epochs=epochs, **kw)
+
+    def evaluate(self, iterator, evaluation=None):
+        """Evaluation WITHOUT allocating optimizer state: uses the cached
+        Trainer when one exists (so its jitted infer fn is reused), else a
+        Trainer-free streaming pass over (params, state)."""
+        if self._trainer is not None:
+            return self._trainer.evaluate(iterator, evaluation)
+        from ..train.trainer import evaluate_model, make_infer_fn
+
+        if self.params is None:
+            self.init()
+        if self._infer_fn_cache is None:
+            self._infer_fn_cache = make_infer_fn(self)
+        return evaluate_model(self, self.params, self.state, iterator,
+                              evaluation, infer_fn=self._infer_fn_cache)
+
+    def score_iterator(self, iterator) -> float:
+        if self._trainer is not None:
+            return self._trainer.score_iterator(iterator)
+        from ..train.trainer import make_score_fn, score_model
+
+        if self.params is None:
+            self.init()
+        if self._score_fn_cache is None:
+            self._score_fn_cache = make_score_fn(self)
+        return score_model(self, self.params, self.state, iterator,
+                           score_fn=self._score_fn_cache)
+
+
+class Sequential(TrainableModel):
     """MultiLayerNetwork equivalent: an ordered stack of layers ending (usually)
     in an Output/Loss layer. Construct via ``Sequential(config, layers, input_shape)``
     or the ``SequentialBuilder`` fluent API (DL4J ListBuilder parity)."""
@@ -345,7 +403,7 @@ class GraphNode:
         return isinstance(self.spec, Layer)
 
 
-class Graph:
+class Graph(TrainableModel):
     """ComputationGraph equivalent: DAG of layers and vertices.
 
     ``nodes``: dict name -> GraphNode; ``inputs``: external input names;

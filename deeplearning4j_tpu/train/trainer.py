@@ -98,6 +98,82 @@ def model_output_width(model) -> int:
             else model.output_shapes[0][-1])
 
 
+def unpack_batch(model, ds):
+    """(x, y, feature_mask, label_mask) from a DataSet OR a MultiDataSet
+    (ComputationGraph.fit(MultiDataSetIterator) parity, SURVEY §3.2):
+    MultiDataSet features map onto the Graph's named inputs by position,
+    labels/label-masks stay positional lists matching ``outputs``."""
+    from ..data.iterators import MultiDataSet
+
+    if isinstance(ds, MultiDataSet):
+        if not isinstance(model, Graph):
+            raise TypeError("MultiDataSet batches require a Graph model")
+        names = model.inputs
+        if len(ds.features) != len(names):
+            raise ValueError(f"MultiDataSet has {len(ds.features)} feature "
+                             f"arrays; Graph expects inputs {names}")
+        if ds.features_masks is not None and \
+                len(ds.features_masks) != len(names):
+            raise ValueError(f"MultiDataSet has {len(ds.features_masks)} "
+                             f"feature masks; Graph expects inputs {names}")
+        outs = model.outputs
+        if len(ds.labels) != len(outs):
+            raise ValueError(f"MultiDataSet has {len(ds.labels)} label "
+                             f"arrays; Graph expects outputs {outs}")
+        if ds.labels_masks is not None and len(ds.labels_masks) != len(outs):
+            raise ValueError(f"MultiDataSet has {len(ds.labels_masks)} "
+                             f"label masks; Graph expects outputs {outs}")
+        if getattr(model.config, "tbptt_length", 0):
+            raise ValueError(
+                "tbptt_length is set but tBPTT is not supported for "
+                "MultiDataSet/Graph fit — train full-BPTT "
+                "(tbptt_length=0) or use a Sequential model")
+        x = dict(zip(names, ds.features))
+        y = list(ds.labels)
+        fm = (dict(zip(names, ds.features_masks))
+              if ds.features_masks is not None else None)
+        lm = list(ds.labels_masks) if ds.labels_masks is not None else None
+        return x, y, fm, lm
+    return ds.features, ds.labels, ds.features_mask, ds.labels_mask
+
+
+def evaluate_model(model, params, state, iterator, evaluation=None, *,
+                   infer_fn=None, mesh=None):
+    """Streaming evaluation over an iterator — the shared engine behind
+    ``Trainer.evaluate`` and the Trainer-free ``net.evaluate`` sugar
+    (no optimizer state is touched or allocated)."""
+    if evaluation is None:
+        evaluation = default_evaluation(model)
+    infer = infer_fn if infer_fn is not None else make_infer_fn(model, mesh)
+    for ds in iterator:
+        x, y, fm, lm = unpack_batch(model, ds)
+        preds = infer(params, state, x, fm)
+        # multi-output graphs: evaluate the PRIMARY output (reference
+        # SparkComputationGraph evaluation convention)
+        if isinstance(y, list):
+            y = y[0]
+            lm = lm[0] if lm else None
+        evaluation.eval(y, np.asarray(preds), mask=lm)
+    if hasattr(iterator, "reset"):
+        iterator.reset()
+    return evaluation
+
+
+def score_model(model, params, state, iterator, *, score_fn=None, mesh=None) -> float:
+    """Average loss over an iterator (model.score(DataSetIterator) parity) —
+    shared engine behind ``Trainer.score_iterator`` and the Trainer-free
+    ``net.score_iterator`` sugar."""
+    score = score_fn if score_fn is not None else make_score_fn(model, mesh)
+    total, n = 0.0, 0
+    for ds in iterator:
+        x, y, fm, lm = unpack_batch(model, ds)
+        total += float(score(params, state, x, y, fm, lm))
+        n += 1
+    if hasattr(iterator, "reset"):
+        iterator.reset()
+    return total / max(n, 1)
+
+
 def default_evaluation(model):
     """Multiclass Evaluation sized to the model's primary output."""
     from ..eval import Evaluation
@@ -352,42 +428,7 @@ class Trainer:
         return k
 
     def _unpack_batch(self, ds):
-        """(x, y, feature_mask, label_mask) from a DataSet OR a MultiDataSet
-        (ComputationGraph.fit(MultiDataSetIterator) parity, SURVEY §3.2):
-        MultiDataSet features map onto the Graph's named inputs by position,
-        labels/label-masks stay positional lists matching ``outputs``."""
-        from ..data.iterators import MultiDataSet
-
-        if isinstance(ds, MultiDataSet):
-            if not isinstance(self.model, Graph):
-                raise TypeError("MultiDataSet batches require a Graph model")
-            names = self.model.inputs
-            if len(ds.features) != len(names):
-                raise ValueError(f"MultiDataSet has {len(ds.features)} feature "
-                                 f"arrays; Graph expects inputs {names}")
-            if ds.features_masks is not None and \
-                    len(ds.features_masks) != len(names):
-                raise ValueError(f"MultiDataSet has {len(ds.features_masks)} "
-                                 f"feature masks; Graph expects inputs {names}")
-            outs = self.model.outputs
-            if len(ds.labels) != len(outs):
-                raise ValueError(f"MultiDataSet has {len(ds.labels)} label "
-                                 f"arrays; Graph expects outputs {outs}")
-            if ds.labels_masks is not None and len(ds.labels_masks) != len(outs):
-                raise ValueError(f"MultiDataSet has {len(ds.labels_masks)} "
-                                 f"label masks; Graph expects outputs {outs}")
-            if getattr(self.model.config, "tbptt_length", 0):
-                raise ValueError(
-                    "tbptt_length is set but tBPTT is not supported for "
-                    "MultiDataSet/Graph fit — train full-BPTT "
-                    "(tbptt_length=0) or use a Sequential model")
-            x = dict(zip(names, ds.features))
-            y = list(ds.labels)
-            fm = (dict(zip(names, ds.features_masks))
-                  if ds.features_masks is not None else None)
-            lm = list(ds.labels_masks) if ds.labels_masks is not None else None
-            return x, y, fm, lm
-        return ds.features, ds.labels, ds.features_mask, ds.labels_mask
+        return unpack_batch(self.model, ds)
 
     # --- fit (MultiLayerNetwork.fit :1262 / ComputationGraph.fit :1010) ---
     def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = (),
@@ -605,37 +646,17 @@ class Trainer:
 
     # --- evaluation (streaming, Evaluation parity) ---
     def evaluate(self, iterator, evaluation=None):
-        if evaluation is None:
-            evaluation = default_evaluation(self.model)
         if self._infer_fn is None:
             self._infer_fn = make_infer_fn(self.model, self.mesh)
-        for ds in iterator:
-            x, y, fm, lm = self._unpack_batch(ds)
-            preds = self._infer_fn(self.params, self.state, x, fm)
-            # multi-output graphs: evaluate the PRIMARY output (reference
-            # SparkComputationGraph evaluation convention)
-            if isinstance(y, list):
-                y = y[0]
-                lm = lm[0] if lm else None
-            evaluation.eval(y, np.asarray(preds), mask=lm)
-        if hasattr(iterator, "reset"):
-            iterator.reset()
-        return evaluation
+        return evaluate_model(self.model, self.params, self.state, iterator,
+                              evaluation, infer_fn=self._infer_fn)
 
     def score_iterator(self, iterator) -> float:
         """Average loss over an iterator (model.score(DataSetIterator) parity)."""
         if getattr(self, "_score_fn", None) is None:  # cache: rebuilding the
             self._score_fn = make_score_fn(self.model, self.mesh)  # jit each
-        score = self._score_fn  # call would recompile every epoch
-
-        total, n = 0.0, 0
-        for ds in iterator:
-            x, y, fm, lm = self._unpack_batch(ds)
-            total += float(score(self.params, self.state, x, y, fm, lm))
-            n += 1
-        if hasattr(iterator, "reset"):
-            iterator.reset()
-        return total / max(n, 1)
+        return score_model(self.model, self.params, self.state, iterator,
+                           score_fn=self._score_fn)  # call would recompile
 
     # --- checkpointing ---
     def save(self, path: str, normalizer=None):

@@ -680,3 +680,63 @@ class TestStepsPerExecution:
         assert tr.iteration == 10  # 5 batches x 2 epochs, none dropped
         assert all(np.all(np.isfinite(np.asarray(p)))
                    for p in jax.tree_util.tree_leaves(tr.params))
+
+
+class TestModelFitSugar:
+    """net.fit(iterator) front door (MultiLayerNetwork.fit parity): cached
+    Trainer, resumable across calls, shared with evaluate/score_iterator."""
+
+    def test_fit_evaluate_on_model(self, iris):
+        x, y = iris
+        net = iris_net(seed=2)
+        net.fit(ArrayIterator(x, y, 32, shuffle=True, seed=3), epochs=60)
+        assert net.evaluate(ArrayIterator(x, y, 64)).accuracy() > 0.9
+        assert np.isfinite(net.score_iterator(ArrayIterator(x, y, 64)))
+
+    def test_refit_resumes_same_trainer(self, iris):
+        x, y = iris
+        net = iris_net(seed=4)
+        net.fit(ArrayIterator(x, y, 50), epochs=1)
+        t1 = net.trainer()
+        it1 = t1.iteration
+        net.fit(ArrayIterator(x, y, 50), epochs=1)
+        assert net.trainer() is t1 and t1.iteration == 2 * it1
+
+    def test_graph_fit_sugar(self, iris):
+        from deeplearning4j_tpu.nn import GraphBuilder
+        x, y = iris
+        g = (GraphBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                     "learning_rate": 5e-2}))
+             .add_input("in", (4,))
+             .add_layer("h", L.Dense(n_out=16, activation="relu"), "in")
+             .add_layer("out", L.Output(n_out=3, activation="softmax",
+                                        loss="mcxent"), "h")
+             .set_outputs("out")
+             .build())
+        g.fit(ArrayIterator(x, y, 32, shuffle=True, seed=5), epochs=40)
+        assert g.evaluate(ArrayIterator(x, y, 64)).accuracy() > 0.9
+
+    def test_evaluate_without_fit_allocates_no_trainer(self, iris):
+        x, y = iris
+        net = iris_net(seed=8)
+        ev = net.evaluate(ArrayIterator(x, y, 64))
+        assert net._trainer is None  # no optimizer state allocated
+        assert 0.0 <= ev.accuracy() <= 1.0
+        assert np.isfinite(net.score_iterator(ArrayIterator(x, y, 64)))
+        assert net._trainer is None
+
+    def test_trainer_kw_cache(self, iris):
+        net = iris_net(seed=9)
+        t1 = net.trainer()
+        assert net.trainer() is t1  # same kwargs -> cached
+        t2 = net.trainer(seed=123)  # different kwargs -> rebuild
+        assert t2 is not t1 and net.trainer(seed=123) is t2
+
+    def test_trainer_seeded_from_config(self, iris):
+        net = iris_net(seed=11)
+        assert net.trainer()._rng is not None
+        # config.seed flows into the Trainer rng stream
+        from deeplearning4j_tpu.train import Trainer
+        expected = Trainer(iris_net(seed=11), seed=11)._rng
+        assert np.array_equal(np.asarray(net.trainer()._rng),
+                              np.asarray(expected))
