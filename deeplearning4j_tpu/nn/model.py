@@ -692,5 +692,29 @@ class SequentialBuilder:
         return self
 
     def build(self) -> Sequential:
+        """Builds the Sequential, auto-inserting a ``Flatten`` wherever a
+        feed-forward layer (Dense/Output/AutoEncoder/VAE) directly follows
+        conv-shaped ``(H, W, C)`` activations — the reference's implicit
+        ``CnnToFeedForwardPreProcessor`` (FeedForwardLayer.java:62
+        getPreProcessorForInputType; setInputType wiring in
+        MultiLayerConfiguration). RNN->FF needs no preprocessor here: Dense
+        broadcasts over leading dims, matching RnnToFeedForwardPreProcessor's
+        per-timestep semantics. The inserted Flatten is a normal layer, so
+        JSON round-trips see the explicit architecture."""
         assert self._input_shape is not None, "set input_shape first"
-        return Sequential(self.config, self._layers, self._input_shape)
+        from .layers.core import Dense, Output, RnnOutput
+        from .layers.pooling import Flatten
+        from .layers.special import AutoEncoder, VAE
+
+        layers: List[Layer] = []
+        shape: Shape = self._input_shape
+        for layer in self._layers:
+            if (len(shape) == 3
+                    and isinstance(layer, (Dense, Output, AutoEncoder, VAE))
+                    and not isinstance(layer, RnnOutput)):
+                flatten = Flatten()
+                layers.append(flatten)
+                shape = tuple(flatten.output_shape(shape))
+            layers.append(layer)
+            shape = tuple(layer.output_shape(shape))
+        return Sequential(self.config, layers, self._input_shape)

@@ -375,3 +375,62 @@ class TestSequentialRemat:
         for u, v in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
             np.testing.assert_allclose(np.asarray(u), np.asarray(v),
                                        rtol=1e-5, atol=1e-7)
+
+
+class TestAutoFlatten:
+    """SequentialBuilder auto-inserts Flatten between conv activations and
+    feed-forward layers (CnnToFeedForwardPreProcessor parity,
+    FeedForwardLayer.java:62)."""
+
+    def test_dense_after_conv_auto_flattens(self):
+        from deeplearning4j_tpu.nn.layers.pooling import Flatten
+        net = (SequentialBuilder(NetConfig(seed=0))
+               .input_shape(8, 8, 1)
+               .layer(L.Conv2D(n_out=4, kernel=(3, 3), activation="relu"))
+               .layer(L.Dense(n_out=16, activation="relu"))
+               .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+               .build())
+        assert any(isinstance(l, Flatten) for l in net.layers)
+        net.init()
+        x = np.random.RandomState(0).rand(2, 8, 8, 1).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (2, 3)
+        # JSON round-trip keeps the inserted Flatten explicit
+        from deeplearning4j_tpu.train.serialization import model_from_json
+        net2 = model_from_json(net.to_json())
+        assert [type(l).__name__ for l in net2.layers] == \
+               [type(l).__name__ for l in net.layers]
+
+    def test_explicit_flatten_not_duplicated(self):
+        from deeplearning4j_tpu.nn.layers.pooling import Flatten
+        net = (SequentialBuilder(NetConfig(seed=0))
+               .input_shape(8, 8, 1)
+               .layer(L.Conv2D(n_out=4, kernel=(3, 3), activation="relu"))
+               .layer(L.Flatten())
+               .layer(L.Dense(n_out=16, activation="relu"))
+               .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+               .build())
+        assert sum(isinstance(l, Flatten) for l in net.layers) == 1
+
+    def test_rnn_to_dense_broadcasts_without_flatten(self):
+        from deeplearning4j_tpu.nn.layers.pooling import Flatten
+        net = (SequentialBuilder(NetConfig(seed=0))
+               .input_shape(6, 10)  # (T, F) rnn activations
+               .layer(L.LSTM(n_out=8))
+               .layer(L.Dense(n_out=5, activation="relu"))  # per timestep
+               .layer(L.RnnOutput(n_out=4, activation="softmax", loss="mcxent"))
+               .build())
+        assert not any(isinstance(l, Flatten) for l in net.layers)
+        net.init()
+        x = np.random.RandomState(0).rand(2, 6, 10).astype(np.float32)
+        assert net.output(x).shape == (2, 6, 4)
+
+    def test_cnn_output_layer_untouched(self):
+        from deeplearning4j_tpu.nn.layers.pooling import Flatten
+        net = (SequentialBuilder(NetConfig(seed=0))
+               .input_shape(8, 8, 1)
+               .layer(L.Conv2D(n_out=4, kernel=(3, 3), padding="same",
+                               activation="relu"))
+               .layer(L.CnnLossLayer(loss="mcxent"))
+               .build())
+        assert not any(isinstance(l, Flatten) for l in net.layers)
