@@ -672,7 +672,38 @@ class GraphBuilder:
         return self
 
     def build(self) -> Graph:
-        return Graph(self.config, self._inputs, self._input_shapes, self._nodes, self._outputs)
+        """Builds the Graph, auto-inserting ``Flatten`` nodes wherever a
+        feed-forward layer (Dense/Output/AutoEncoder/VAE) is wired directly
+        to conv-shaped ``(H, W, C)`` activations — the reference's implicit
+        preprocessor insertion (ComputationGraphConfiguration
+        addPreProcessors / FeedForwardLayer.getPreProcessorForInputType).
+        Inserted nodes are named ``<layer>_flatten`` and serialize like any
+        other node; ``Graph.from_json`` bypasses the builder, so round-trips
+        never double-insert."""
+        from .layers.core import Dense, Output, RnnOutput
+        from .layers.pooling import Flatten
+        from .layers.special import AutoEncoder, VAE
+
+        probe = Graph(self.config, self._inputs, self._input_shapes,
+                      self._nodes, self._outputs)
+        nodes: Dict[str, GraphNode] = {}
+        inserted = False
+        for name, node in self._nodes.items():
+            if (node.is_layer()
+                    and isinstance(node.spec, (Dense, Output, AutoEncoder, VAE))
+                    and not isinstance(node.spec, RnnOutput)
+                    and len(probe._shapes[node.inputs[0]]) == 3):
+                fname = f"{name}_flatten"
+                while fname in self._nodes or fname in nodes:
+                    fname += "_"
+                nodes[fname] = GraphNode(Flatten(), node.inputs)
+                node = GraphNode(node.spec, (fname,))
+                inserted = True
+            nodes[name] = node
+        if not inserted:
+            return probe
+        return Graph(self.config, self._inputs, self._input_shapes, nodes,
+                     self._outputs)
 
 
 class SequentialBuilder:

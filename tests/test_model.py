@@ -434,3 +434,24 @@ class TestAutoFlatten:
                .layer(L.CnnLossLayer(loss="mcxent"))
                .build())
         assert not any(isinstance(l, Flatten) for l in net.layers)
+
+    def test_graph_dense_after_conv_auto_flattens(self):
+        from deeplearning4j_tpu.nn.layers.pooling import Flatten
+        g = (GraphBuilder(NetConfig(seed=0))
+             .add_input("in", (8, 8, 1))
+             .add_layer("conv", L.Conv2D(n_out=4, kernel=(3, 3),
+                                         activation="relu"), "in")
+             .add_layer("fc", L.Dense(n_out=16, activation="relu"), "conv")
+             .add_layer("out", L.Output(n_out=3, activation="softmax",
+                                        loss="mcxent"), "fc")
+             .set_outputs("out")
+             .build())
+        assert "fc_flatten" in g.nodes and \
+            isinstance(g.nodes["fc_flatten"].spec, Flatten)
+        assert g.nodes["fc"].inputs == ("fc_flatten",)
+        g.init()
+        x = np.random.RandomState(0).rand(2, 8, 8, 1).astype(np.float32)
+        assert g.output(x)[0].shape == (2, 3)
+        # serde round-trip keeps the inserted node, no double insertion
+        g2 = Graph.from_json(g.to_json())
+        assert set(g2.nodes) == set(g.nodes)
