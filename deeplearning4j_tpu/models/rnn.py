@@ -41,7 +41,9 @@ class GravesLSTMCharRNN(ZooModel):
         return (SequentialBuilder(NetConfig(seed=self.seed, tbptt_length=self.kwargs.get("tbptt", 50),
                                             updater={"type": "rmsprop", "learning_rate": 1e-1}))
                 .input_shape(T, V)
-                .layer(L.GravesLSTM(n_out=self.hidden))
-                .layer(L.GravesLSTM(n_out=self.hidden))
+                .layer(L.GravesLSTM(n_out=self.hidden,
+                                    scan_unroll=self.kwargs.get("scan_unroll", 1)))
+                .layer(L.GravesLSTM(n_out=self.hidden,
+                                    scan_unroll=self.kwargs.get("scan_unroll", 1)))
                 .layer(L.RnnOutput(n_out=self.num_classes, activation="softmax", loss="mcxent"))
                 .build())
