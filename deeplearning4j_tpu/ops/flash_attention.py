@@ -171,7 +171,209 @@ def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret):
     return o, (q, k, v, o, lse)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale: float, causal: bool, bq: int, bk: int,
+                   t_actual: int):
+    """dQ pass: grid (BH, T/bq, T/bk), key blocks innermost sequential.
+    Standard FlashAttention-2 recomputation: p = exp(s - lse);
+    ds = p * (dp - delta) * scale; dq += ds @ k — accumulated in VMEM."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def _accumulate(masked: bool):
+        q = q_ref[0].astype(jnp.float32)          # (bq, D)
+        k = k_ref[0].astype(jnp.float32)          # (bk, D)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        lse = lse_ref[0]                          # (bq, 1) f32
+        p = jnp.exp(s - jnp.broadcast_to(lse, s.shape))
+        if masked:
+            q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            valid = k_pos < t_actual
+            if causal:
+                valid = valid & (k_pos <= q_pos)
+            p = jnp.where(valid, p, 0.0)
+        do = do_ref[0].astype(jnp.float32)        # (bq, D)
+        dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (bq, bk)
+        ds = p * (dp - jnp.broadcast_to(delta_ref[0], dp.shape)) * scale
+        dq_scr[...] += lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    k_end = (ik + 1) * bk
+    interior = k_end <= t_actual
+    if causal:
+        on_diag = k_end - 1 > iq * bq
+        interior = interior & jnp.logical_not(on_diag)
+        reachable = ik * bk <= (iq + 1) * bq - 1
+        pl.when(reachable & interior)(lambda: _accumulate(False))
+        pl.when(reachable & jnp.logical_not(interior))(lambda: _accumulate(True))
+    else:
+        pl.when(interior)(lambda: _accumulate(False))
+        pl.when(jnp.logical_not(interior))(lambda: _accumulate(True))
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, bq: int, bk: int, t_actual: int):
+    """dK/dV pass: grid (BH, T/bk, T/bq), query blocks innermost sequential.
+    dv += p^T @ do; dk += ds^T @ q — both accumulated in VMEM."""
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _accumulate(masked: bool):
+        q = q_ref[0].astype(jnp.float32)          # (bq, D)
+        k = k_ref[0].astype(jnp.float32)          # (bk, D)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        lse = lse_ref[0]                          # (bq, 1)
+        p = jnp.exp(s - jnp.broadcast_to(lse, s.shape))
+        if masked:
+            q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            valid = k_pos < t_actual
+            if causal:
+                valid = valid & (k_pos <= q_pos)
+            p = jnp.where(valid, p, 0.0)
+        do = do_ref[0].astype(jnp.float32)        # (bq, D)
+        # dv += p^T @ do ((bk, bq) @ (bq, D)); p in [0,1] — bf16 operand ok
+        dv_scr[...] += lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (bq, bk)
+        ds = p * (dp - jnp.broadcast_to(delta_ref[0], dp.shape)) * scale
+        dk_scr[...] += lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    q_end = (iq + 1) * bq
+    interior = q_end <= t_actual
+    if causal:
+        # diagonal touches this (ik, iq) pair unless the k block is fully
+        # below every q row in the block
+        on_diag = (ik + 1) * bk - 1 > iq * bq
+        interior = interior & jnp.logical_not(on_diag)
+        reachable = q_end - 1 >= ik * bk  # some q row can see this k block
+        pl.when(reachable & interior)(lambda: _accumulate(False))
+        pl.when(reachable & jnp.logical_not(interior))(lambda: _accumulate(True))
+    else:
+        pl.when(interior)(lambda: _accumulate(False))
+        pl.when(jnp.logical_not(interior))(lambda: _accumulate(True))
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, bq, bk, interpret):
+    """Kernel-based flash backward (FlashAttention-2 decomposition): one
+    pallas_call for dq (k innermost), one for dk/dv (q innermost)."""
+    import math
+
+    BH, T, D = q.shape
+    # more live tiles than the forward (q, k, v, do + p/ds): cap blocks at
+    # 512 to stay comfortably inside VMEM
+    bq, bk = min(bq, 512), min(bk, 512)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)       # (BH, T, 1)
+    lse3 = lse[..., None]                          # (BH, T, 1)
+
+    pad = (-T) % math.lcm(bq, bk)
+    tp = T + pad
+    if pad:
+        zpad = ((0, 0), (0, pad), (0, 0))
+        q, k, v, do = (jnp.pad(a, zpad) for a in (q, k, v, do))
+        delta = jnp.pad(delta, zpad)
+        lse3 = jnp.pad(lse3, zpad)
+    nq, nk = tp // bq, tp // bk
+
+    common = dict(scale=scale, causal=causal, bq=bq, bk=bk, t_actual=T)
+    vmem = pltpu.CompilerParams(vmem_limit_bytes=96 * 1024 * 1024)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),   # q
+            pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),   # k
+            pl.BlockSpec((1, bk, D), lambda bh, iq, ik: (bh, ik, 0)),   # v
+            pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),   # do
+            pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),   # lse
+            pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),   # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, tp, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=vmem,
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, ik, iq: (bh, iq, 0)),   # q
+            pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),   # k
+            pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),   # v
+            pl.BlockSpec((1, bq, D), lambda bh, ik, iq: (bh, iq, 0)),   # do
+            pl.BlockSpec((1, bq, 1), lambda bh, ik, iq: (bh, iq, 0)),   # lse
+            pl.BlockSpec((1, bq, 1), lambda bh, ik, iq: (bh, iq, 0)),   # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ik, iq: (bh, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, tp, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, tp, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=vmem,
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta)
+    return dq[:, :T], dk[:, :T], dv[:, :T]
+
+
+# Backward implementation switch: "pallas" = the Mosaic kernels above,
+# "xla" = the pure-JAX scan recomputation. Default stays "xla" until the
+# Mosaic lowering of the backward kernels is validated on a real chip
+# (interpret-mode tests prove numerics, not lowering) — flip after the
+# on-chip A/B in PERF.md.
+BACKWARD = "xla"
+
+
 def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, do):
+    if BACKWARD == "pallas":
+        q, k, v, o, lse = res
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
+                                       bq, bk, interpret)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_vjp_bwd_xla(scale, causal, bq, bk, interpret, res, do)
+
+
+def _flash_vjp_bwd_xla(scale, causal, bq, bk, interpret, res, do):
     """Flash backward: recompute probabilities per q block from (q, k, lse);
     scan over q blocks carrying (dk, dv) accumulators — peak memory
     O(bq·T), never (T, T)."""
