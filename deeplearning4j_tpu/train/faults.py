@@ -94,6 +94,7 @@ class DivergenceListener(TrainingListener):
         trainer.tx = optax.chain(trainer._base_tx, optax.scale(self.lr_scale))
         trainer._step_fn = None
         trainer._multi_step_fn = None
+        trainer._accum_step_fn = None
         trainer._tbptt_step_fn = None
 
 

@@ -252,8 +252,16 @@ class Trainer:
     porting it."""
 
     def __init__(self, model, updater: Optional[optax.GradientTransformation] = None,
-                 seed: int = 0, mesh=None, rules=None):
+                 seed: int = 0, mesh=None, rules=None, grad_accum: int = 1):
         self.model = model
+        # grad_accum=N: each fit batch is split into N sequential microbatches
+        # inside ONE jitted step (lax.scan); grads are averaged and the
+        # updater runs once. Activation memory scales with the microbatch,
+        # optimizer HBM traffic (read m,v,params + write back — the dominant
+        # per-step cost for 100M+ param models) is paid once per N
+        # microbatches. Loss/grad semantics are the standard
+        # mean-of-microbatch-means (exact for equal, unmasked microbatches).
+        self.grad_accum = max(1, int(grad_accum))
         self.tx = updater if updater is not None else build_updater(model)
         if model.params is None:
             model.init()
@@ -289,6 +297,7 @@ class Trainer:
         self._rng = jax.random.PRNGKey(seed)
         self._step_fn = None
         self._multi_step_fn = None
+        self._accum_step_fn = None
         self._tbptt_step_fn = None
         self._infer_fn = None
 
@@ -364,6 +373,48 @@ class Trainer:
         @partial(jax.jit, donate_argnums=(0, 1, 2), **jit_kw)
         def step(params, opt_state, net_state, x, y, rng, mask=None, label_mask=None):
             return one_step(params, opt_state, net_state, x, y, rng, mask, label_mask)
+
+        return step
+
+    def _make_accum_step(self):
+        """One optimizer update from ``grad_accum`` sequential microbatches,
+        compiled as a single program: ``lax.scan`` accumulates grads (and
+        net_state carries through, so BN stats/dropout streams see every
+        microbatch), then the updater applies the mean gradient ONCE.
+        Inputs carry a leading (n_micro,) axis."""
+        tx = self.tx
+        n_micro = self.grad_accum
+        act_ctx, jit_kw = self._mesh_jit_setup(n_unpinned_outputs=1)
+        model = self.model
+        seq = isinstance(model, Sequential)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2), **jit_kw)
+        def step(params, opt_state, net_state, xs, ys, rngs, fms, lms):
+            def one(carry, mb):
+                g_acc, loss_acc, net_state = carry
+                x, y, rng, fm, lm = mb
+                mask_kw = ({"mask": fm, "label_mask": lm} if seq
+                           else {"masks": fm, "label_masks": lm})
+
+                def loss_fn(p):
+                    with act_ctx():
+                        loss, ns = model.score(p, net_state, x, y,
+                                               training=True, rng=rng,
+                                               **mask_kw)
+                    return loss, ns
+
+                (loss, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                return (jax.tree.map(jnp.add, g_acc, g),
+                        loss_acc + loss, ns), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (g, loss_sum, net_state), _ = jax.lax.scan(
+                one, (zeros, jnp.asarray(0.0, jnp.float32), net_state),
+                (xs, ys, rngs, fms, lms))
+            g = jax.tree.map(lambda a: a / n_micro, g)
+            updates, opt_state = tx.update(g, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, net_state, loss_sum / n_micro
 
         return step
 
@@ -463,6 +514,7 @@ class Trainer:
         # every iteration validated before the next mutates trainer state —
         # a K-step program would run K steps past the first bad one
         use_mega = (spe > 1 and not tbptt and self.mesh is None
+                    and self.grad_accum == 1
                     and not any(getattr(l, "requires_sync", False)
                                 for l in listeners))
         buf: List[tuple] = []
@@ -496,10 +548,7 @@ class Trainer:
                 if tbptt and xb_ndim >= 3:
                     loss = self._fit_tbptt_batch(ds, tbptt)
                 else:
-                    x, y, fm, lm = self._place_batch(xb, yb, fmb, lmb)
-                    self.params, self.opt_state, self.state, loss = self._step_fn(
-                        self.params, self.opt_state, self.state,
-                        x, y, self.next_rng(), fm, lm)
+                    loss = self._dispatch_train_step(xb, yb, fmb, lmb)
                 reporter.report(self.iteration, epoch, loss)
                 self.iteration += 1
             if buf:  # ragged tail: fewer than K buffered at epoch end
@@ -513,6 +562,37 @@ class Trainer:
         self.model.params, self.model.state = self.params, self.state
         return self
 
+    def _dispatch_train_step(self, xb, yb, fmb, lmb):
+        """Place one batch and run it through the plain step or, when
+        ``grad_accum=N`` and the batch divides evenly, the microbatch-scan
+        accumulation step (one optimizer update per batch either way).
+        Returns the device loss scalar."""
+        x, y, fm, lm = self._place_batch(xb, yb, fmb, lmb)
+        if self.grad_accum > 1:
+            n = self.grad_accum
+            first = next(iter(x.values())) if isinstance(x, dict) else x
+            bs = int(first.shape[0])
+            if bs % n == 0:
+                def resh(t):
+                    return None if t is None else jax.tree.map(
+                        lambda a: a.reshape((n, bs // n) + a.shape[1:]), t)
+
+                if self._accum_step_fn is None:
+                    self._accum_step_fn = self._make_accum_step()
+                rngs = jnp.stack([self.next_rng() for _ in range(n)])
+                (self.params, self.opt_state, self.state,
+                 loss) = self._accum_step_fn(
+                    self.params, self.opt_state, self.state,
+                    resh(x), resh(y), rngs, resh(fm), resh(lm))
+                return loss
+            # indivisible (ragged tail) batch: one plain step
+        if self._step_fn is None:
+            self._step_fn = self._make_step()
+        self.params, self.opt_state, self.state, loss = self._step_fn(
+            self.params, self.opt_state, self.state,
+            x, y, self.next_rng(), fm, lm)
+        return loss
+
     @staticmethod
     def _batch_sig(parts):
         """Structure+shape+dtype signature of an unpacked batch — megastep
@@ -523,17 +603,12 @@ class Trainer:
                       for l in leaves))
 
     def _exec_singles(self, buf, reporter, epoch, listeners):
-        """Run buffered batches through the single jitted step, in order."""
-        if self._step_fn is None:
-            self._step_fn = self._make_step()
+        """Run buffered batches through the single-batch step path, in order."""
         for xb, yb, fmb, lmb, bs in buf:
             for lst in listeners:
                 if isinstance(lst, PerformanceListener):
                     lst.step_begin(bs)
-            x, y, fm, lm = self._place_batch(xb, yb, fmb, lmb)
-            self.params, self.opt_state, self.state, loss = self._step_fn(
-                self.params, self.opt_state, self.state,
-                x, y, self.next_rng(), fm, lm)
+            loss = self._dispatch_train_step(xb, yb, fmb, lmb)
             reporter.report(self.iteration, epoch, loss)
             self.iteration += 1
 

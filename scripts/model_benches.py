@@ -111,10 +111,15 @@ def jnp_stack_k(a, k):
 
 
 def bench_transformer(*, num_layers=12, d_model=1536, batch=8, seq=1024,
-                      vocab=32000, flash=True, steps=15, smoke=False):
+                      vocab=32000, flash=True, steps=15, smoke=False,
+                      micro=1):
     """The matmul-dominated envelope case (PERF.md: 440M CausalLM + flash
     kernel measured at MFU 0.45 where exact-BN ResNet-50 caps ~0.36-0.40).
-    Sparse integer labels — no (B, T, V) one-hot."""
+    Sparse integer labels — no (B, T, V) one-hot. ``micro=N`` measures the
+    grad_accum path: N microbatches of size ``batch`` per optimizer update
+    (one compiled program) — amortizes the AdamW HBM pass, the dominant
+    non-matmul cost at 500M+ params. step_ms/tokens are per MICROBATCH so
+    rows stay comparable."""
     import jax
 
     from deeplearning4j_tpu.models import CausalLM
@@ -129,25 +134,34 @@ def bench_transformer(*, num_layers=12, d_model=1536, batch=8, seq=1024,
     if not smoke:
         model.config.compute_dtype = "bfloat16"
     model.init()
-    tr = Trainer(model)
-    step = tr._make_step()
+    tr = Trainer(model, grad_accum=micro)
     rng = np.random.RandomState(0)
-    x = jax.device_put(rng.randint(0, vocab, (batch, seq)).astype(np.int32))
-    y = jax.device_put(rng.randint(0, vocab, (batch, seq)).astype(np.int32))
+    x = jax.device_put(rng.randint(0, vocab, (micro * batch, seq)).astype(np.int32))
+    y = jax.device_put(rng.randint(0, vocab, (micro * batch, seq)).astype(np.int32))
     r = jax.random.PRNGKey(0)
-    compiled = step.lower(tr.params, tr.opt_state, tr.state, x, y, r,
-                          None, None).compile()
-    flops = float((compiled.cost_analysis() or {}).get("flops", 0.0))
+    if micro > 1:
+        import jax.numpy as jnp
+
+        step = tr._make_accum_step()
+        xs = x.reshape(micro, batch, seq)
+        ys = y.reshape(micro, batch, seq)
+        rs = jax.random.split(r, micro)
+        args = (xs, ys, rs, None, None)
+    else:
+        step = tr._make_step()
+        args = (x, y, r, None, None)
+    compiled = step.lower(tr.params, tr.opt_state, tr.state, *args).compile()
+    flops = float((compiled.cost_analysis() or {}).get("flops", 0.0)) / micro
     p, o, s = tr.params, tr.opt_state, tr.state
-    p, o, s, loss = step(p, o, s, x, y, r, None, None)
+    p, o, s, loss = step(p, o, s, *args)
     float(loss)
 
     def run(k, p, o, s):
         t0 = time.perf_counter()
         for _ in range(k):
-            p, o, s, loss = step(p, o, s, x, y, r, None, None)
+            p, o, s, loss = step(p, o, s, *args)
         float(loss)
-        return time.perf_counter() - t0, p, o, s
+        return (time.perf_counter() - t0) / micro, p, o, s
 
     k1, k2 = max(steps // 4, 1), steps
     t1, p, o, s = run(k1, p, o, s)
@@ -157,11 +171,14 @@ def bench_transformer(*, num_layers=12, d_model=1536, batch=8, seq=1024,
     peak = next((v for k, v in PEAK_BF16.items()
                  if str(dev.device_kind).startswith(k)), 197e12)
     n_params = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(tr.params))
-    return {"model": f"causal_lm_{n_params/1e6:.0f}M_{'flash' if flash else 'dense'}",
-            "batch": batch, "seq": seq, "step_ms": round(dt * 1e3, 2),
-            "tokens_per_sec": round(batch * seq / dt, 1),
-            "flops_per_step": flops,
-            "mfu": round(flops / dt / peak, 4) if flops else None}
+    row = {"model": f"causal_lm_{n_params/1e6:.0f}M_{'flash' if flash else 'dense'}",
+           "batch": batch, "seq": seq, "step_ms": round(dt * 1e3, 2),
+           "tokens_per_sec": round(batch * seq / dt, 1),
+           "flops_per_step": flops,
+           "mfu": round(flops / dt / peak, 4) if flops else None}
+    if micro > 1:
+        row["grad_accum"] = micro
+    return row
 
 
 def main():
