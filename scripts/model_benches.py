@@ -16,6 +16,11 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# persistent XLA compile cache (same setting as bench.py) — effective only
+# if jax hasn't initialized yet
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dl4j_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+
 import numpy as np
 
 PEAK_BF16 = {"TPU v4": 275e12, "TPU v5 lite": 197e12, "TPU v5": 459e12,
