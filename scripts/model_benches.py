@@ -28,21 +28,26 @@ PEAK_BF16 = {"TPU v4": 275e12, "TPU v5 lite": 197e12, "TPU v5": 459e12,
 
 
 def bench_model(name, build_fn, batch, in_shape, n_classes, *, seq=False,
-                steps=20, bf16=True, on_tpu=True, token_vocab=None, spe=1):
+                steps=20, bf16=True, on_tpu=True, token_vocab=None, spe=1,
+                micro=1):
     """``spe`` > 1 measures the ``steps_per_execution`` megastep path
     (Trainer._make_multi_step): spe train steps scanned inside one compiled
     program, amortizing per-step dispatch — the honest number for small
     models whose single step is ~1-3 ms (dispatch-bound through the tunnel).
-    flops/step_ms are reported per TRAIN STEP either way."""
+    ``micro`` > 1 measures the grad_accum path: micro microbatches of size
+    ``batch`` per optimizer update (amortizes updater HBM traffic for
+    100M+ param models). step_ms/flops are per (micro)batch step either
+    way. spe and micro are mutually exclusive."""
     import jax
 
     from deeplearning4j_tpu.train import Trainer
 
+    assert not (spe > 1 and micro > 1)
     model = build_fn()
     if on_tpu and bf16:
         model.config.compute_dtype = "bfloat16"
     model.init()
-    tr = Trainer(model)
+    tr = Trainer(model, grad_accum=micro)
     step = tr._make_step()
     rng = np.random.RandomState(0)
     x = rng.randn(batch, *in_shape).astype(np.float32)
@@ -82,6 +87,20 @@ def bench_model(name, build_fn, batch, in_shape, n_classes, *, seq=False,
                 p, o, s, losses = mstep(p, o, s, xs, ys, rs, None, None)
             float(losses[-1])
             return time.perf_counter() - t0, p, o, s
+    elif micro > 1:
+        astep = tr._make_accum_step()
+        xs = jnp_stack_k(xd, micro)
+        ys = jnp_stack_k(yd, micro)
+        rs = jax.random.split(jax.random.PRNGKey(1), micro)
+        p, o, s, loss = astep(p, o, s, xs, ys, rs, None, None)  # compile+warm
+        float(loss)
+
+        def run(k, p, o, s):
+            t0 = time.perf_counter()
+            for _ in range(k):
+                p, o, s, loss = astep(p, o, s, xs, ys, rs, None, None)
+            float(loss)
+            return time.perf_counter() - t0, p, o, s
     else:
         def run(k, p, o, s):
             t0 = time.perf_counter()
@@ -94,7 +113,7 @@ def bench_model(name, build_fn, batch, in_shape, n_classes, *, seq=False,
     t1, p, o, s = run(k1, p, o, s)
     t2, p, o, s = run(k2, p, o, s)
     dt = (t2 - t1) / (k2 - k1) if t2 > t1 else t2 / k2
-    dt /= spe  # per train step either way
+    dt /= spe * micro  # per (micro)batch train step either way
     dev = jax.devices()[0]
     peak = next((v for k, v in PEAK_BF16.items()
                  if str(dev.device_kind).startswith(k)), 197e12)
@@ -104,6 +123,8 @@ def bench_model(name, build_fn, batch, in_shape, n_classes, *, seq=False,
            "mfu": round(flops / dt / peak, 4) if flops else None}
     if spe > 1:
         row["steps_per_execution"] = spe
+    if micro > 1:
+        row["grad_accum"] = micro
     return row
 
 
