@@ -130,6 +130,18 @@ def _apply_layer(cfg, layer, p, s, x, *, training, rng, mask):
     return y, s_out, m_out
 
 
+def _wants_flat_input(spec) -> bool:
+    """True for feed-forward layers that, per the reference's implicit
+    CnnToFeedForwardPreProcessor (FeedForwardLayer.java:62), should receive
+    flattened features when wired to conv-shaped (H, W, C) activations.
+    Shared by SequentialBuilder.build and GraphBuilder.build."""
+    from .layers.core import Dense, Output, RnnOutput
+    from .layers.special import AutoEncoder, VAE
+
+    return (isinstance(spec, (Dense, Output, AutoEncoder, VAE))
+            and not isinstance(spec, RnnOutput))
+
+
 class TrainableModel:
     """``net.fit(iterator)`` front door (MultiLayerNetwork.fit :1262 /
     ComputationGraph.fit :1010 parity): lazily builds and caches ONE Trainer
@@ -680,18 +692,14 @@ class GraphBuilder:
         Inserted nodes are named ``<layer>_flatten`` and serialize like any
         other node; ``Graph.from_json`` bypasses the builder, so round-trips
         never double-insert."""
-        from .layers.core import Dense, Output, RnnOutput
         from .layers.pooling import Flatten
-        from .layers.special import AutoEncoder, VAE
 
         probe = Graph(self.config, self._inputs, self._input_shapes,
                       self._nodes, self._outputs)
         nodes: Dict[str, GraphNode] = {}
         inserted = False
         for name, node in self._nodes.items():
-            if (node.is_layer()
-                    and isinstance(node.spec, (Dense, Output, AutoEncoder, VAE))
-                    and not isinstance(node.spec, RnnOutput)
+            if (node.is_layer() and _wants_flat_input(node.spec)
                     and len(probe._shapes[node.inputs[0]]) == 3):
                 fname = f"{name}_flatten"
                 while fname in self._nodes or fname in nodes:
@@ -733,16 +741,12 @@ class SequentialBuilder:
         per-timestep semantics. The inserted Flatten is a normal layer, so
         JSON round-trips see the explicit architecture."""
         assert self._input_shape is not None, "set input_shape first"
-        from .layers.core import Dense, Output, RnnOutput
         from .layers.pooling import Flatten
-        from .layers.special import AutoEncoder, VAE
 
         layers: List[Layer] = []
         shape: Shape = self._input_shape
         for layer in self._layers:
-            if (len(shape) == 3
-                    and isinstance(layer, (Dense, Output, AutoEncoder, VAE))
-                    and not isinstance(layer, RnnOutput)):
+            if len(shape) == 3 and _wants_flat_input(layer):
                 flatten = Flatten()
                 layers.append(flatten)
                 shape = tuple(flatten.output_shape(shape))

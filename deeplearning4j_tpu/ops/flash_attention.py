@@ -160,15 +160,41 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, bq: int, bk: int,
     return o[:, :T], lse[:, :T, 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, bq, bk, interpret, backward):
     o, _ = _flash_fwd(q, k, v, scale, causal, bq, bk, interpret)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret):
+def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret, backward):
     o, lse = _flash_fwd(q, k, v, scale, causal, bq, bk, interpret)
     return o, (q, k, v, o, lse)
+
+
+def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
+              scale, causal, masked, iq, ik, bq, bk, t_actual):
+    """Shared FlashAttention-2 backward recomputation for both passes:
+    returns (p, ds) with p = exp(s - lse) (masked) and
+    ds = p * (do @ v^T - delta) * scale."""
+    q = q_ref[0].astype(jnp.float32)          # (bq, D)
+    k = k_ref[0].astype(jnp.float32)          # (bk, D)
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    lse = lse_ref[0]                          # (bq, 1) f32
+    p = jnp.exp(s - jnp.broadcast_to(lse, s.shape))
+    if masked:
+        q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = k_pos < t_actual
+        if causal:
+            valid = valid & (k_pos <= q_pos)
+        p = jnp.where(valid, p, 0.0)
+    do = do_ref[0].astype(jnp.float32)        # (bq, D)
+    dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                         (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)  # (bq, bk)
+    ds = p * (dp - jnp.broadcast_to(delta_ref[0], dp.shape)) * scale
+    return p, ds
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -186,24 +212,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     def _accumulate(masked: bool):
-        q = q_ref[0].astype(jnp.float32)          # (bq, D)
-        k = k_ref[0].astype(jnp.float32)          # (bk, D)
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-        lse = lse_ref[0]                          # (bq, 1) f32
-        p = jnp.exp(s - jnp.broadcast_to(lse, s.shape))
-        if masked:
-            q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            valid = k_pos < t_actual
-            if causal:
-                valid = valid & (k_pos <= q_pos)
-            p = jnp.where(valid, p, 0.0)
-        do = do_ref[0].astype(jnp.float32)        # (bq, D)
-        dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # (bq, bk)
-        ds = p * (dp - jnp.broadcast_to(delta_ref[0], dp.shape)) * scale
+        _, ds = _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          scale=scale, causal=causal, masked=masked,
+                          iq=iq, ik=ik, bq=bq, bk=bk, t_actual=t_actual)
         dq_scr[...] += lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -240,28 +251,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     def _accumulate(masked: bool):
-        q = q_ref[0].astype(jnp.float32)          # (bq, D)
-        k = k_ref[0].astype(jnp.float32)          # (bk, D)
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-        lse = lse_ref[0]                          # (bq, 1)
-        p = jnp.exp(s - jnp.broadcast_to(lse, s.shape))
-        if masked:
-            q_pos = iq * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = ik * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            valid = k_pos < t_actual
-            if causal:
-                valid = valid & (k_pos <= q_pos)
-            p = jnp.where(valid, p, 0.0)
-        do = do_ref[0].astype(jnp.float32)        # (bq, D)
+        p, ds = _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          scale=scale, causal=causal, masked=masked,
+                          iq=iq, ik=ik, bq=bq, bk=bk, t_actual=t_actual)
         # dv += p^T @ do ((bk, bq) @ (bq, D)); p in [0,1] — bf16 operand ok
         dv_scr[...] += lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # (bq, bk)
-        ds = p * (dp - jnp.broadcast_to(delta_ref[0], dp.shape)) * scale
         dk_scr[...] += lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -356,16 +352,18 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, bq, bk, interpret):
     return dq[:, :T], dk[:, :T], dv[:, :T]
 
 
-# Backward implementation switch: "pallas" = the Mosaic kernels above,
-# "xla" = the pure-JAX scan recomputation. Default stays "xla" until the
-# Mosaic lowering of the backward kernels is validated on a real chip
-# (interpret-mode tests prove numerics, not lowering) — flip after the
-# on-chip A/B in PERF.md.
+# Default backward implementation: "pallas" = the Mosaic kernels above,
+# "xla" = the pure-JAX scan recomputation. The per-call ``backward=`` arg of
+# ``flash_attention`` overrides this (and, being a nondiff static arg, keys
+# the jit cache correctly — mutating the global alone cannot retrace an
+# already-compiled function). Default stays "xla" until the Mosaic lowering
+# of the backward kernels is validated on a real chip (interpret-mode tests
+# prove numerics, not lowering) — flip after the on-chip A/B in PERF.md.
 BACKWARD = "xla"
 
 
-def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, do):
-    if BACKWARD == "pallas":
+def _flash_vjp_bwd(scale, causal, bq, bk, interpret, backward, res, do):
+    if backward == "pallas":
         q, k, v, o, lse = res
         dq, dk, dv = _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal,
                                        bq, bk, interpret)
@@ -429,7 +427,8 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None, block_k: Optional[int] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    backward: Optional[str] = None):
     """Memory-efficient exact attention. q, k, v: (B, T, H, D) (the layout of
     ``dot_product_attention``); returns (B, T, H, D).
 
@@ -466,5 +465,6 @@ def flash_attention(q, k, v, *, causal: bool = False,
     def to_bh(a):
         return a.transpose(0, 2, 1, 3).reshape(B * H, T, D)
 
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, bq, bk, interpret)
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, bq, bk, interpret,
+               backward if backward is not None else BACKWARD)
     return o.reshape(B, H, T, D).transpose(0, 2, 1, 3)

@@ -200,20 +200,17 @@ class TestPallasBackward:
         rng = np.random.RandomState(0)
         q, k, v = (rng.randn(2, T, 2, 16).astype(np.float32) for _ in range(3))
 
-        def loss(q, k, v):
-            o = fa.flash_attention(jnp.asarray(q), jnp.asarray(k),
-                                   jnp.asarray(v), causal=causal,
-                                   block_q=32, block_k=32)
-            return jnp.sum(o * jnp.cos(o))  # non-trivial cotangent
+        def loss_with(backward):
+            def loss(q, k, v):
+                o = fa.flash_attention(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v), causal=causal,
+                                       block_q=32, block_k=32,
+                                       backward=backward)
+                return jnp.sum(o * jnp.cos(o))  # non-trivial cotangent
+            return loss
 
-        old = fa.BACKWARD
-        try:
-            fa.BACKWARD = "pallas"
-            gp = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-            fa.BACKWARD = "xla"
-            gx = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-        finally:
-            fa.BACKWARD = old
+        gp = jax.grad(loss_with("pallas"), argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss_with("xla"), argnums=(0, 1, 2))(q, k, v)
         for a, b, name in zip(gp, gx, "qkv"):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-5,
