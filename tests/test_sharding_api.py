@@ -301,3 +301,16 @@ class TestZeroShardedWithRules:
         chex.assert_trees_all_close(
             jax.tree.map(np.asarray, pw.model.params), ref,
             rtol=2e-5, atol=1e-6)
+
+
+class TestGradAccumMesh:
+    def test_grad_accum_dp_tp_equivalence(self):
+        """grad_accum composes with the sharding API: microbatch scan +
+        one update over a dp x tp mesh == the same on one device."""
+        x, y = _data(n=64)
+        ref = _fit_steps(Trainer(_mlp(), seed=5, grad_accum=2),
+                         x, y, steps=4, bs=16)
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2}, jax.devices()[:4])
+        got = _fit_steps(Trainer(_mlp(), seed=5, mesh=mesh, rules=DENSE_RULES,
+                                 grad_accum=2), x, y, steps=4, bs=16)
+        chex.assert_trees_all_close(got, ref, rtol=5e-5, atol=1e-6)
