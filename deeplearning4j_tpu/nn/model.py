@@ -130,6 +130,19 @@ def _apply_layer(cfg, layer, p, s, x, *, training, rng, mask):
     return y, s_out, m_out
 
 
+class _SingleBatch:
+    """One-DataSet iterator for the fit(x, y) / fit(DataSet) overloads."""
+
+    def __init__(self, ds):
+        self.ds = ds
+
+    def __iter__(self):
+        return iter([self.ds])
+
+    def reset(self):
+        pass
+
+
 def _wants_flat_input(spec) -> bool:
     """True for feed-forward layers that, per the reference's implicit
     CnnToFeedForwardPreProcessor (FeedForwardLayer.java:62), should receive
@@ -169,8 +182,33 @@ class TrainableModel:
             self._trainer_kw = dict(kw)
         return self._trainer
 
-    def fit(self, iterator, epochs: int = 1, **kw):
-        return self.trainer().fit(iterator, epochs=epochs, **kw)
+    def fit(self, data, labels=None, epochs: int = 1, **kw):
+        """fit(iterator), fit(DataSet), or fit(x, y) — the reference's three
+        overloads (MultiLayerNetwork.fit :1262 / :1860). Raw arrays / a
+        single DataSet train as one full batch per epoch."""
+        from ..data.iterators import DataSet
+
+        it = data
+        if labels is not None:
+            it = _SingleBatch(DataSet(data, labels))
+        elif isinstance(data, DataSet):
+            it = _SingleBatch(data)
+        return self.trainer().fit(it, epochs=epochs, **kw)
+
+    def _get_infer_fn(self):
+        """The cached jitted inference fn shared by evaluate/output_iterator
+        (the Trainer's when one exists — its mesh placement included)."""
+        from ..train.trainer import make_infer_fn
+
+        if self.params is None:
+            self.init()
+        if self._trainer is not None:
+            if self._trainer._infer_fn is None:
+                self._trainer._infer_fn = make_infer_fn(self, self._trainer.mesh)
+            return self._trainer._infer_fn
+        if self._infer_fn_cache is None:
+            self._infer_fn_cache = make_infer_fn(self)
+        return self._infer_fn_cache
 
     def evaluate(self, iterator, evaluation=None):
         """Evaluation WITHOUT allocating optimizer state: uses the cached
@@ -178,14 +216,32 @@ class TrainableModel:
         Trainer-free streaming pass over (params, state)."""
         if self._trainer is not None:
             return self._trainer.evaluate(iterator, evaluation)
-        from ..train.trainer import evaluate_model, make_infer_fn
+        from ..train.trainer import evaluate_model
 
-        if self.params is None:
-            self.init()
-        if self._infer_fn_cache is None:
-            self._infer_fn_cache = make_infer_fn(self)
+        infer = self._get_infer_fn()  # inits params/state on first use
         return evaluate_model(self, self.params, self.state, iterator,
-                              evaluation, infer_fn=self._infer_fn_cache)
+                              evaluation, infer_fn=infer)
+
+    def output_iterator(self, iterator):
+        """Stacked inference outputs over a DataSetIterator —
+        ``output(DataSetIterator)`` parity (MultiLayerNetwork.java:2128).
+        Returns one array (Sequential / single-output Graph) or a list of
+        arrays, batches concatenated along axis 0."""
+        from ..train.trainer import unpack_batch
+
+        infer = self._get_infer_fn()
+        chunks = []
+        for ds in iterator:
+            x, _, fm, _ = unpack_batch(self, ds)
+            chunks.append(infer(self.params, self.state, x, fm))
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        if not chunks:
+            return []
+        if isinstance(chunks[0], (list, tuple)):  # multi-output Graph
+            return [jnp.concatenate([c[i] for c in chunks], axis=0)
+                    for i in range(len(chunks[0]))]
+        return jnp.concatenate(chunks, axis=0)
 
     def score_iterator(self, iterator) -> float:
         if self._trainer is not None:

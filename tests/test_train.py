@@ -783,3 +783,37 @@ class TestGradAccum:
         tr = Trainer(iris_net(seed=22), grad_accum=4)
         tr.fit(ArrayIterator(x, y, 40, shuffle=False), epochs=1)
         assert tr.iteration == 4  # every batch trained, none dropped
+
+
+class TestFitOverloadsAndOutputIterator:
+    """MultiLayerNetwork fit(x, y)/fit(DataSet) overloads (:1860) and
+    output(DataSetIterator) (:2128) parity on the model front door."""
+
+    def test_fit_raw_arrays(self, iris):
+        x, y = iris
+        net = iris_net(seed=30)
+        net.fit(x, y, epochs=80)  # one full batch per epoch
+        assert net.trainer().iteration == 80
+        assert net.evaluate(ArrayIterator(x, y, 64)).accuracy() > 0.9
+
+    def test_fit_single_dataset(self, iris):
+        from deeplearning4j_tpu.data import DataSet
+        x, y = iris
+        net = iris_net(seed=31)
+        net.fit(DataSet(x, y), epochs=3)
+        assert net.trainer().iteration == 3
+
+    def test_output_iterator_matches_direct(self, iris):
+        x, y = iris
+        net = iris_net(seed=32)
+        net.fit(ArrayIterator(x, y, 50), epochs=2)
+        got = np.asarray(net.output_iterator(ArrayIterator(x, y, 40)))
+        assert got.shape == (150, 3)
+        direct = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, direct, rtol=1e-5, atol=1e-6)
+
+    def test_output_iterator_without_fit(self, iris):
+        x, y = iris
+        net = iris_net(seed=33)
+        out = np.asarray(net.output_iterator(ArrayIterator(x, y, 75)))
+        assert out.shape == (150, 3) and net._trainer is None
