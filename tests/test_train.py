@@ -817,3 +817,42 @@ class TestFitOverloadsAndOutputIterator:
         net = iris_net(seed=33)
         out = np.asarray(net.output_iterator(ArrayIterator(x, y, 75)))
         assert out.shape == (150, 3) and net._trainer is None
+
+
+
+class TestProfiler:
+    """train/profiler.py — ProfilerListener trace capture + PhaseTimer
+    export surfaces (SURVEY §5 tracing; the reference's only analogue is
+    PerformanceListener + Spark phase stats)."""
+
+    def test_profiler_listener_writes_trace(self, iris, tmp_path):
+        from deeplearning4j_tpu.train.profiler import ProfilerListener
+        x, y = iris
+        d = str(tmp_path / "trace")
+        tr = Trainer(iris_net())
+        tr.fit(ArrayIterator(x, y, 50), epochs=2,
+               listeners=[ProfilerListener(d, start_iteration=1,
+                                           num_iterations=2)])
+        files = list((tmp_path / "trace").rglob("*"))
+        assert any(f.suffix == ".pb" or "trace" in f.name.lower()
+                   for f in files if f.is_file()), files
+
+    def test_phase_timer_summary_and_exports(self, tmp_path):
+        import time as _time
+
+        from deeplearning4j_tpu.train.profiler import PhaseTimer
+        pt = PhaseTimer()
+        for _ in range(3):
+            with pt.phase("fit"):
+                _time.sleep(0.002)
+        with pt.phase("aggregate"):
+            _time.sleep(0.001)
+        s = pt.summary()
+        assert s["fit"]["count"] == 3 and s["aggregate"]["count"] == 1
+        assert s["fit"]["total_s"] >= 0.006
+        j = pt.export_json(str(tmp_path / "phases.json"))
+        assert "aggregate" in j and (tmp_path / "phases.json").exists()
+        pt.export_chrome_trace(str(tmp_path / "trace.json"))
+        import json as _json
+        ev = _json.load(open(tmp_path / "trace.json"))["traceEvents"]
+        assert len(ev) == 4 and all(e["ph"] == "X" for e in ev)
