@@ -133,7 +133,7 @@ class MultiHostTrainer:
                  updater: Optional[optax.GradientTransformation] = None,
                  seed: int = 0, rules=None, mode: str = "shared_gradients",
                  threshold: float = 1e-3, capacity_frac: Optional[float] = None,
-                 quantize: bool = True):
+                 quantize: bool = True, grad_accum: int = 1):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.tx = updater if updater is not None else build_updater(model)
@@ -142,6 +142,14 @@ class MultiHostTrainer:
         check_not_donated((model.params, model.state), "MultiHostTrainer")
         self.rules = tuple(rules) if rules is not None else ()
         self.mode = mode
+        # grad_accum=N: each global batch trains as N sequential microbatches
+        # inside the one jitted step (see _make_step) — the updater's HBM
+        # pass amortizes over N, the win that matters most at multi-host
+        # model scale. shared_gradients only.
+        self.grad_accum = max(1, int(grad_accum))
+        if self.grad_accum > 1 and mode == "encoded_gradients":
+            raise ValueError("grad_accum requires mode='shared_gradients'")
+        self._plain_step = None  # lazy fallback for indivisible batches
         self._repl = NamedSharding(self.mesh, P())
         self._batch_sh = NamedSharding(self.mesh, P(DATA_AXIS))
         self._rng = jax.random.PRNGKey(seed)
@@ -173,7 +181,7 @@ class MultiHostTrainer:
             lambda a: a if getattr(getattr(a, "sharding", None), "mesh",
                                    None) == self.mesh
             else replicate_on_mesh(a, self.mesh), self.tx.init(self.params))
-        self._step = self._make_step()
+        self._step = self._make_step(self.grad_accum)
 
     @property
     def is_main(self) -> bool:
@@ -363,7 +371,7 @@ class MultiHostTrainer:
             x, y, rngs, *extra)
         return self._loss_mean(loss)
 
-    def _make_step(self):
+    def _make_step(self, accum: int = 1):
         tx, model = self.tx, self.model
         repl = self._repl
         seq = isinstance(model, Sequential)
@@ -377,25 +385,77 @@ class MultiHostTrainer:
         o_sh = jax.tree.map(lambda a: a.sharding, self.opt_state)
         mesh = self.mesh
 
+        if accum == 1:
+            @partial(jax.jit, donate_argnums=(0, 1, 2),
+                     out_shardings=(p_sh, o_sh, repl, repl))
+            def step(params, opt_state, net_state, x, y, rng, mask=None,
+                     label_mask=None):
+                mask_kw = ({"mask": mask, "label_mask": label_mask} if seq
+                           else {"masks": mask, "label_masks": label_mask})
+
+                def loss_fn(p):
+                    with activation_sharding(mesh):
+                        loss, new_state = model.score(p, net_state, x, y,
+                                                      training=True, rng=rng, **mask_kw)
+                    return loss, new_state
+
+                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, new_state, loss
+
+            return step
+
+        # grad_accum: regroup the flat global batch into `accum` STRIDED
+        # microbatches INSIDE the jit (eager reshape of a multi-process
+        # global array is not possible, and striding — row i -> microbatch
+        # i % accum — keeps every microbatch evenly dp-sharded, so the scan
+        # induces no cross-device row movement). rng carries (accum, 2) keys.
         @partial(jax.jit, donate_argnums=(0, 1, 2),
                  out_shardings=(p_sh, o_sh, repl, repl))
-        def step(params, opt_state, net_state, x, y, rng, mask=None,
-                 label_mask=None):
-            mask_kw = ({"mask": mask, "label_mask": label_mask} if seq
-                       else {"masks": mask, "label_masks": label_mask})
+        def accum_step(params, opt_state, net_state, x, y, rng, mask=None,
+                       label_mask=None):
+            def regroup(t):
+                if t is None:
+                    return None
 
-            def loss_fn(p):
-                with activation_sharding(mesh):
-                    loss, new_state = model.score(p, net_state, x, y,
-                                                  training=True, rng=rng, **mask_kw)
-                return loss, new_state
+                def r(a):
+                    mb = a.shape[0] // accum
+                    a = a.reshape((mb, accum) + a.shape[1:])
+                    a = jnp.moveaxis(a, 1, 0)  # (accum, mb, ...)
+                    return jax.lax.with_sharding_constraint(
+                        a, NamedSharding(mesh, P(None, DATA_AXIS)))
 
-            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            updates, opt_state = tx.update(grads, opt_state, params)
+                return jax.tree.map(r, t)
+
+            xs, ys, fms, lms = (regroup(t) for t in (x, y, mask, label_mask))
+
+            def one(carry, microbatch):
+                g_acc, loss_acc, net_state = carry
+                xi, yi, ri, fmi, lmi = microbatch
+                mask_kw = ({"mask": fmi, "label_mask": lmi} if seq
+                           else {"masks": fmi, "label_masks": lmi})
+
+                def loss_fn(p):
+                    with activation_sharding(mesh):
+                        loss, ns = model.score(p, net_state, xi, yi,
+                                               training=True, rng=ri, **mask_kw)
+                    return loss, ns
+
+                (loss, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                return (jax.tree.map(jnp.add, g_acc, g),
+                        loss_acc + loss, ns), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (g, loss_sum, net_state), _ = jax.lax.scan(
+                one, (zeros, jnp.asarray(0.0, jnp.float32), net_state),
+                (xs, ys, rng, fms, lms))
+            g = jax.tree.map(lambda a: a / accum, g)
+            updates, opt_state = tx.update(g, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, new_state, loss
+            return params, opt_state, net_state, loss_sum / accum
 
-        return step
+        return accum_step
 
     def _global_batch(self, ds):
         """Assemble global sharded arrays from this process's local rows
@@ -445,9 +505,22 @@ class MultiHostTrainer:
                     loss = self._fit_batch_encoded(ds)
                 else:
                     x, y, mask, label_mask = self._global_batch(ds)
-                    self.params, self.opt_state, self.state, loss = self._step(
+                    n = self.grad_accum
+                    # strided regrouping needs every dp shard's rows to
+                    # split evenly into n microbatches
+                    dp = self.mesh.shape.get(DATA_AXIS, 1)
+                    rows_per_dev = x.shape[0] // max(dp, 1)
+                    if n > 1 and rows_per_dev % n == 0:
+                        rng = jnp.stack([self.next_rng() for _ in range(n)])
+                        step = self._step
+                    else:
+                        if n > 1 and self._plain_step is None:
+                            self._plain_step = self._make_step(1)
+                        step = self._plain_step if n > 1 else self._step
+                        rng = self.next_rng()
+                    self.params, self.opt_state, self.state, loss = step(
                         self.params, self.opt_state, self.state, x, y,
-                        self.next_rng(), mask, label_mask)
+                        rng, mask, label_mask)
                 reporter.report(self.iteration, epoch, loss)
                 self.iteration += 1
             reporter.flush()

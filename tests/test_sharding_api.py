@@ -314,3 +314,31 @@ class TestGradAccumMesh:
         got = _fit_steps(Trainer(_mlp(), seed=5, mesh=mesh, rules=DENSE_RULES,
                                  grad_accum=2), x, y, steps=4, bs=16)
         chex.assert_trees_all_close(got, ref, rtol=5e-5, atol=1e-6)
+
+    def test_grad_accum_multihost_trainer(self):
+        """MultiHostTrainer(grad_accum=N) (in-jit strided microbatching —
+        eager reshape is impossible on multi-process global arrays) matches
+        Trainer(grad_accum=N): gradient mean is grouping-invariant."""
+        from deeplearning4j_tpu.parallel import MultiHostTrainer
+        from deeplearning4j_tpu.parallel.multihost import ProcessShardIterator
+        x, y = _data(n=128)
+        a = Trainer(_mlp(), seed=0, grad_accum=2)
+        a.fit(__import__("deeplearning4j_tpu.data", fromlist=["ArrayIterator"]
+                         ).ArrayIterator(x, y, 32, shuffle=False), epochs=2)
+        mesh = make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2}, jax.devices()[:8])
+        b = MultiHostTrainer(_mlp(), mesh=mesh, rules=DENSE_RULES,
+                             grad_accum=2, seed=0)
+        b.fit(ProcessShardIterator(x, y, global_batch_size=32), epochs=2)
+        pa = jax.tree.map(np.asarray, a.params)
+        pb = jax.tree.map(lambda t: np.asarray(b._to_host(t)), b.params)
+        chex.assert_trees_all_close(pb, pa, rtol=5e-5, atol=1e-6)
+
+    def test_grad_accum_multihost_indivisible_falls_back(self):
+        from deeplearning4j_tpu.parallel import MultiHostTrainer
+        from deeplearning4j_tpu.parallel.multihost import ProcessShardIterator
+        x, y = _data(n=120)
+        mesh = make_mesh({DATA_AXIS: 4}, jax.devices()[:4])
+        tr = MultiHostTrainer(_mlp(), mesh=mesh, grad_accum=4, seed=0)
+        # 24 rows / 4 dp shards = 6 rows per device, 6 % 4 != 0 -> plain step
+        tr.fit(ProcessShardIterator(x, y, global_batch_size=24), epochs=1)
+        assert tr.iteration == 5
