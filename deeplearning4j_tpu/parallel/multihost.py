@@ -406,56 +406,14 @@ class MultiHostTrainer:
 
             return step
 
-        # grad_accum: regroup the flat global batch into `accum` STRIDED
-        # microbatches INSIDE the jit (eager reshape of a multi-process
-        # global array is not possible, and striding — row i -> microbatch
-        # i % accum — keeps every microbatch evenly dp-sharded, so the scan
-        # induces no cross-device row movement). rng carries (accum, 2) keys.
-        @partial(jax.jit, donate_argnums=(0, 1, 2),
-                 out_shardings=(p_sh, o_sh, repl, repl))
-        def accum_step(params, opt_state, net_state, x, y, rng, mask=None,
-                       label_mask=None):
-            def regroup(t):
-                if t is None:
-                    return None
+        # grad_accum: shared strided-microbatch accumulation program
+        # (parallel/sharding.make_mesh_accum_step — also used by
+        # ParallelWrapper's sync modes)
+        from .sharding import make_mesh_accum_step
 
-                def r(a):
-                    mb = a.shape[0] // accum
-                    a = a.reshape((mb, accum) + a.shape[1:])
-                    a = jnp.moveaxis(a, 1, 0)  # (accum, mb, ...)
-                    return jax.lax.with_sharding_constraint(
-                        a, NamedSharding(mesh, P(None, DATA_AXIS)))
-
-                return jax.tree.map(r, t)
-
-            xs, ys, fms, lms = (regroup(t) for t in (x, y, mask, label_mask))
-
-            def one(carry, microbatch):
-                g_acc, loss_acc, net_state = carry
-                xi, yi, ri, fmi, lmi = microbatch
-                mask_kw = ({"mask": fmi, "label_mask": lmi} if seq
-                           else {"masks": fmi, "label_masks": lmi})
-
-                def loss_fn(p):
-                    with activation_sharding(mesh):
-                        loss, ns = model.score(p, net_state, xi, yi,
-                                               training=True, rng=ri, **mask_kw)
-                    return loss, ns
-
-                (loss, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
-                return (jax.tree.map(jnp.add, g_acc, g),
-                        loss_acc + loss, ns), None
-
-            zeros = jax.tree.map(jnp.zeros_like, params)
-            (g, loss_sum, net_state), _ = jax.lax.scan(
-                one, (zeros, jnp.asarray(0.0, jnp.float32), net_state),
-                (xs, ys, rng, fms, lms))
-            g = jax.tree.map(lambda a: a / accum, g)
-            updates, opt_state = tx.update(g, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, net_state, loss_sum / accum
-
-        return accum_step
+        return make_mesh_accum_step(
+            model, tx, mesh, accum, lambda: activation_sharding(mesh),
+            p_sh, o_sh, repl)
 
     def _global_batch(self, ds):
         """Assemble global sharded arrays from this process's local rows

@@ -226,3 +226,68 @@ def place_params(params, mesh: Mesh, rules: Rules):
         return jax.make_array_from_callback(a.shape, sh, lambda idx: a[idx])
 
     return jax.tree.map(place, params, specs)
+
+
+def make_mesh_accum_step(model, tx, mesh, accum, act_ctx, p_sh, o_sh, repl):
+    """The shared grad_accum train step for mesh trainers (MultiHostTrainer
+    and ParallelWrapper shared_gradients/zero_sharded): one jitted program
+    that regroups the flat dp-sharded global batch into ``accum`` STRIDED
+    microbatches (row i -> microbatch i mod accum, so every microbatch stays
+    evenly dp-sharded and the scan moves no rows between devices — eager
+    reshape of a multi-process global array is impossible anyway), scans
+    them accumulating the gradient sum, then applies the updater ONCE on
+    the mean. ``rng`` carries (accum, 2) keys; loss returned is the
+    microbatch mean."""
+    import functools
+
+    import jax.numpy as jnp
+    import optax
+
+    from ..nn.model import Sequential
+
+    seq = isinstance(model, Sequential)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                       out_shardings=(p_sh, o_sh, repl, repl))
+    def accum_step(params, opt_state, net_state, x, y, rng, mask=None,
+                   label_mask=None):
+        def regroup(t):
+            if t is None:
+                return None
+
+            def r(a):
+                mb = a.shape[0] // accum
+                a = a.reshape((mb, accum) + a.shape[1:])
+                a = jnp.moveaxis(a, 1, 0)  # (accum, mb, ...)
+                return jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, P(None, DATA_AXIS)))
+
+            return jax.tree.map(r, t)
+
+        xs, ys, fms, lms = (regroup(t) for t in (x, y, mask, label_mask))
+
+        def one(carry, microbatch):
+            g_acc, loss_acc, net_state = carry
+            xi, yi, ri, fmi, lmi = microbatch
+            mask_kw = ({"mask": fmi, "label_mask": lmi} if seq
+                       else {"masks": fmi, "label_masks": lmi})
+
+            def loss_fn(p):
+                with act_ctx():
+                    loss, ns = model.score(p, net_state, xi, yi,
+                                           training=True, rng=ri, **mask_kw)
+                return loss, ns
+
+            (loss, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            return (jax.tree.map(jnp.add, g_acc, g), loss_acc + loss, ns), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (g, loss_sum, net_state), _ = jax.lax.scan(
+            one, (zeros, jnp.asarray(0.0, jnp.float32), net_state),
+            (xs, ys, rng, fms, lms))
+        g = jax.tree.map(lambda a: a / accum, g)
+        updates, opt_state = tx.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, net_state, loss_sum / accum
+
+    return accum_step

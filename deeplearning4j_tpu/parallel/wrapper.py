@@ -60,7 +60,7 @@ class ParallelWrapper:
                  averaging_frequency: int = 5, average_updater_state: bool = True,
                  seed: int = 0, threshold: float = 1e-3,
                  capacity_frac: Optional[float] = None, quantize: bool = True,
-                 rules=None):
+                 rules=None, grad_accum: int = 1):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
@@ -70,6 +70,14 @@ class ParallelWrapper:
                              "mode='shared_gradients'/'zero_sharded' only — "
                              "averaging/encoded modes replicate full model "
                              "copies per worker")
+        # grad_accum=N: N sequential microbatches per optimizer update inside
+        # the one jitted step (sync modes only — replica modes re-dispatch
+        # per device already)
+        self.grad_accum = max(1, int(grad_accum))
+        if self.grad_accum > 1 and mode not in ("shared_gradients",
+                                                "zero_sharded"):
+            raise ValueError("grad_accum applies to mode="
+                             "'shared_gradients'/'zero_sharded' only")
         self.averaging_frequency = averaging_frequency
         self.average_updater_state = average_updater_state
         self.tx = build_updater(model)
@@ -194,6 +202,12 @@ class ParallelWrapper:
             return params, opt_state, new_state, loss
 
         self._step = step
+        self._accum_step = None
+        if self.grad_accum > 1:
+            from .sharding import make_mesh_accum_step
+
+            self._accum_step = make_mesh_accum_step(
+                model, tx, mesh, self.grad_accum, act_ctx, p_sh, opt_sh, repl)
 
     def _require_pure_data_mesh(self):
         """averaging/encoded modes stack one replica per device along the
@@ -418,9 +432,16 @@ class ParallelWrapper:
         if self.mode in ("shared_gradients", "zero_sharded"):
             xd = jax.device_put(x, self._batch_sharding)
             yd = jax.device_put(y, self._batch_sharding)
-            self.params, self.opt_state, self.state, loss = self._step(
+            na = self.grad_accum
+            dp = self.mesh.shape.get(DATA_AXIS, 1)
+            if na > 1 and (x.shape[0] // max(dp, 1)) % na == 0:
+                step, rng = self._accum_step, jnp.stack(
+                    [self.next_rng() for _ in range(na)])
+            else:  # indivisible per-device rows: plain step
+                step, rng = self._step, self.next_rng()
+            self.params, self.opt_state, self.state, loss = step(
                 self.params, self.opt_state, self.state, xd, yd,
-                self.next_rng(), mask, label_mask)
+                rng, mask, label_mask)
             return loss
         # averaging/encoded modes: reshape to (n_dev, per_dev, ...) replica batches
         n = self.n_dev

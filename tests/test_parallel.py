@@ -559,3 +559,41 @@ class TestLabelMasks:
         pw = ParallelWrapper(self._seq_net(), mode="shared_gradients", seed=0)
         np.testing.assert_allclose(pw.score_iterator(It()),
                                    tr.score_iterator(It()), rtol=1e-5)
+
+
+class TestWrapperGradAccum:
+    def test_shared_gradients_grad_accum_equivalence(self):
+        """ParallelWrapper(grad_accum=N) sync modes == Trainer(grad_accum=N)
+        (shared make_mesh_accum_step; gradient mean is grouping-invariant)."""
+        from deeplearning4j_tpu.data import ArrayIterator
+        from deeplearning4j_tpu.train import Trainer
+        rng = np.random.RandomState(3)
+        x = rng.randn(128, 10).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 128)]
+
+        def net():
+            return (SequentialBuilder(NetConfig(seed=4, updater={"type": "adam",
+                                                                 "learning_rate": 1e-2}))
+                    .input_shape(10)
+                    .layer(L.Dense(n_out=16, activation="relu"))
+                    .layer(L.Output(n_out=3, activation="softmax", loss="mcxent"))
+                    .build())
+
+        a = Trainer(net(), grad_accum=2)
+        a.fit(ArrayIterator(x, y, 32, shuffle=False), epochs=2)
+        for mode in ("shared_gradients", "zero_sharded"):
+            w = ParallelWrapper(net(), mode=mode, grad_accum=2)
+            w.fit(ArrayIterator(x, y, 32, shuffle=False), epochs=2)
+            for ka, kb in zip(jax.tree_util.tree_leaves(a.params),
+                              jax.tree_util.tree_leaves(w.model.params)):
+                np.testing.assert_allclose(np.asarray(ka), np.asarray(kb),
+                                           rtol=5e-5, atol=1e-6,
+                                           err_msg=mode)
+
+    def test_grad_accum_rejected_for_replica_modes(self):
+        with pytest.raises(ValueError, match="grad_accum"):
+            ParallelWrapper(
+                (SequentialBuilder(NetConfig(seed=0)).input_shape(4)
+                 .layer(L.Output(n_out=2, activation="softmax", loss="mcxent"))
+                 .build()),
+                mode="averaging", grad_accum=2)
