@@ -167,13 +167,18 @@ class TrainableModel:
     _trainer = None
     _trainer_kw = None
     _infer_fn_cache = None
+    _full_infer_fn_cache = None
     _score_fn_cache = None
 
     def trainer(self, **kw):
         """The cached Trainer (built on first use, seeded from
-        ``config.seed``); passing DIFFERENT kwargs (e.g. ``mesh=``,
-        ``rules=``, ``updater=``) rebuilds — which resets optimizer state
-        and iteration count; repeating the same kwargs reuses the cache."""
+        ``config.seed``). A no-kwarg call ALWAYS returns the cached one
+        (fit/evaluate go through here — they must never discard a trainer
+        the user configured via ``net.trainer(mesh=..., ...)``); passing
+        DIFFERENT kwargs rebuilds, which resets optimizer state and
+        iteration count; repeating the same kwargs reuses the cache."""
+        if not kw and self._trainer is not None:
+            return self._trainer
         kw.setdefault("seed", self.config.seed)
         if self._trainer is None or kw != self._trainer_kw:
             from ..train.trainer import Trainer
@@ -183,16 +188,25 @@ class TrainableModel:
         return self._trainer
 
     def fit(self, data, labels=None, epochs: int = 1, **kw):
-        """fit(iterator), fit(DataSet), or fit(x, y) — the reference's three
-        overloads (MultiLayerNetwork.fit :1262 / :1860). Raw arrays / a
-        single DataSet train as one full batch per epoch."""
+        """fit(iterator), fit(iterator, num_epochs), fit(DataSet), or
+        fit(x, y) — the reference's overloads (MultiLayerNetwork.fit :1262 /
+        :1860). Raw arrays / a single DataSet train as one full batch per
+        epoch (placed on device once — no per-epoch re-upload)."""
         from ..data.iterators import DataSet
 
+        if isinstance(labels, int):  # fit(iterator, numEpochs) overload
+            labels, epochs = None, labels
         it = data
         if labels is not None:
-            it = _SingleBatch(DataSet(data, labels))
+            if not hasattr(data, "shape") or not hasattr(labels, "shape"):
+                raise TypeError(
+                    "fit(x, y) expects two arrays; to set the epoch count "
+                    "use fit(iterator, epochs=N)")
+            it = _SingleBatch(DataSet(jnp.asarray(data), jnp.asarray(labels)))
+            kw.setdefault("prefetch", False)  # nothing to prefetch
         elif isinstance(data, DataSet):
             it = _SingleBatch(data)
+            kw.setdefault("prefetch", False)
         return self.trainer().fit(it, epochs=epochs, **kw)
 
     def _get_infer_fn(self):
@@ -224,12 +238,24 @@ class TrainableModel:
 
     def output_iterator(self, iterator):
         """Stacked inference outputs over a DataSetIterator —
-        ``output(DataSetIterator)`` parity (MultiLayerNetwork.java:2128).
-        Returns one array (Sequential / single-output Graph) or a list of
-        arrays, batches concatenated along axis 0."""
+        ``output(DataSetIterator)`` parity (MultiLayerNetwork.java:2128 /
+        ComputationGraph equivalent). Returns one array (Sequential) or a
+        list of arrays — ALL outputs — for a Graph, batches concatenated
+        along axis 0."""
         from ..train.trainer import unpack_batch
 
-        infer = self._get_infer_fn()
+        if isinstance(self, Graph):
+            # full-output jitted forward (make_infer_fn returns the primary
+            # output only — the evaluate convention, not output()'s)
+            if self._full_infer_fn_cache is None:
+                if self.params is None:
+                    self.init()
+                self._full_infer_fn_cache = jax.jit(
+                    lambda p, s, x, m: self.forward(p, s, x, training=False,
+                                                    masks=m)[0])
+            infer = self._full_infer_fn_cache
+        else:
+            infer = self._get_infer_fn()
         chunks = []
         for ds in iterator:
             x, _, fm, _ = unpack_batch(self, ds)
@@ -238,7 +264,7 @@ class TrainableModel:
             iterator.reset()
         if not chunks:
             return []
-        if isinstance(chunks[0], (list, tuple)):  # multi-output Graph
+        if isinstance(chunks[0], (list, tuple)):  # Graph: all outputs
             return [jnp.concatenate([c[i] for c in chunks], axis=0)
                     for i in range(len(chunks[0]))]
         return jnp.concatenate(chunks, axis=0)
@@ -751,19 +777,30 @@ class GraphBuilder:
         from .layers.pooling import Flatten
 
         probe = Graph(self.config, self._inputs, self._input_shapes,
-                      self._nodes, self._outputs)
+                      self._nodes, self._outputs)  # validates + topo-sorts
+        # shapes must be recomputed AS flattens are inserted — deciding from
+        # the pre-insertion probe shapes would see stale 3-D activations
+        # downstream of the first insertion and flatten every later FF layer
+        shapes: Dict[str, Shape] = dict(probe.input_shapes)
         nodes: Dict[str, GraphNode] = {}
         inserted = False
-        for name, node in self._nodes.items():
+        for name in probe.topo_order:
+            node = self._nodes[name]
+            in_shape = shapes[node.inputs[0]] if node.inputs else None
             if (node.is_layer() and _wants_flat_input(node.spec)
-                    and len(probe._shapes[node.inputs[0]]) == 3):
+                    and len(in_shape) == 3):
                 fname = f"{name}_flatten"
                 while fname in self._nodes or fname in nodes:
                     fname += "_"
-                nodes[fname] = GraphNode(Flatten(), node.inputs)
+                flatten = Flatten()
+                nodes[fname] = GraphNode(flatten, node.inputs)
                 node = GraphNode(node.spec, (fname,))
+                in_shape = tuple(flatten.output_shape(in_shape))
                 inserted = True
             nodes[name] = node
+            shapes[name] = tuple(
+                node.spec.output_shape(in_shape) if node.is_layer()
+                else node.spec.output_shape([shapes[i] for i in node.inputs]))
         if not inserted:
             return probe
         return Graph(self.config, self._inputs, self._input_shapes, nodes,

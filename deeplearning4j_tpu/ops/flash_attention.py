@@ -443,6 +443,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
     B, T, H, D = q.shape
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(f"q/k/v shapes must match, got {q.shape} {k.shape} {v.shape}")
+    bw = backward if backward is not None else BACKWARD
+    if bw not in ("pallas", "xla"):
+        raise ValueError(f"backward must be 'pallas' or 'xla', got {bw!r}")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
@@ -466,5 +469,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
         return a.transpose(0, 2, 1, 3).reshape(B * H, T, D)
 
     o = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, bq, bk, interpret,
-               backward if backward is not None else BACKWARD)
+               bw)
     return o.reshape(B, H, T, D).transpose(0, 2, 1, 3)

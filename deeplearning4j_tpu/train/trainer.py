@@ -381,10 +381,24 @@ class Trainer:
         compiled as a single program: ``lax.scan`` accumulates grads (and
         net_state carries through, so BN stats/dropout streams see every
         microbatch), then the updater applies the mean gradient ONCE.
-        Inputs carry a leading (n_micro,) axis."""
+        Inputs carry a leading (n_micro,) axis. Over a mesh, the shared
+        strided program (parallel/sharding.make_mesh_accum_step) is used
+        instead — it regroups the flat dp-sharded batch in-jit so no rows
+        move between devices (an eager contiguous reshape would gather
+        microbatch 0's rows from only dp/N of the devices every step)."""
         tx = self.tx
         n_micro = self.grad_accum
         act_ctx, jit_kw = self._mesh_jit_setup(n_unpinned_outputs=1)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.sharding import make_mesh_accum_step
+
+            return make_mesh_accum_step(
+                self.model, tx, self.mesh, n_micro, act_ctx,
+                jax.tree.map(lambda a: a.sharding, self.params),
+                jax.tree.map(lambda a: a.sharding, self.opt_state),
+                NamedSharding(self.mesh, P()))
         model = self.model
         seq = isinstance(model, Sequential)
 
@@ -572,7 +586,21 @@ class Trainer:
             n = self.grad_accum
             first = next(iter(x.values())) if isinstance(x, dict) else x
             bs = int(first.shape[0])
-            if bs % n == 0:
+            if self.mesh is not None:
+                from ..parallel.mesh import DATA_AXIS
+
+                dp = self.mesh.shape.get(DATA_AXIS, 1)
+                if (bs // max(dp, 1)) % n == 0:
+                    # shared strided program: flat batch, (n, 2) rng keys
+                    if self._accum_step_fn is None:
+                        self._accum_step_fn = self._make_accum_step()
+                    rngs = jnp.stack([self.next_rng() for _ in range(n)])
+                    (self.params, self.opt_state, self.state,
+                     loss) = self._accum_step_fn(
+                        self.params, self.opt_state, self.state,
+                        x, y, rngs, fm, lm)
+                    return loss
+            elif bs % n == 0:
                 def resh(t):
                     return None if t is None else jax.tree.map(
                         lambda a: a.reshape((n, bs // n) + a.shape[1:]), t)
@@ -622,10 +650,12 @@ class Trainer:
             return
         if self._multi_step_fn is None:
             self._multi_step_fn = self._make_multi_step()
-        for *_unused, bs in buf:
-            for lst in listeners:
-                if isinstance(lst, PerformanceListener):
-                    lst.step_begin(bs)
+        # ONE step_begin with the window's total samples: K back-to-back
+        # calls would zero the ETL metric for K-1 of every K iterations and
+        # never bracket a real step (samples/sec over the window stays exact)
+        for lst in listeners:
+            if isinstance(lst, PerformanceListener):
+                lst.step_begin(sum(b[-1] for b in buf))
 
         def stack(parts):
             if all(p is None for p in parts):

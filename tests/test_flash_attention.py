@@ -215,3 +215,8 @@ class TestPallasBackward:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-5,
                                        err_msg=f"d{name} mismatch")
+
+    def test_invalid_backward_rejected(self):
+        q, k, v = _qkv(T=16)
+        with pytest.raises(ValueError, match="backward"):
+            flash_attention(q, k, v, backward="mosaic")

@@ -455,3 +455,21 @@ class TestAutoFlatten:
         # serde round-trip keeps the inserted node, no double insertion
         g2 = Graph.from_json(g.to_json())
         assert set(g2.nodes) == set(g.nodes)
+
+    def test_graph_no_cascade_flatten(self):
+        """Regression: only the conv->FF boundary gets a Flatten — FF layers
+        downstream of the first insertion must NOT each grow their own."""
+        from deeplearning4j_tpu.nn.layers.pooling import Flatten
+        g = (GraphBuilder(NetConfig(seed=0))
+             .add_input("in", (8, 8, 1))
+             .add_layer("conv", L.Conv2D(n_out=4, kernel=(3, 3),
+                                         activation="relu"), "in")
+             .add_layer("fc", L.Dense(n_out=16, activation="relu"), "conv")
+             .add_layer("fc2", L.Dense(n_out=8, activation="relu"), "fc")
+             .add_layer("out", L.Output(n_out=3, activation="softmax",
+                                        loss="mcxent"), "fc2")
+             .set_outputs("out")
+             .build())
+        flats = [n for n, node in g.nodes.items()
+                 if isinstance(node.spec, Flatten)]
+        assert flats == ["fc_flatten"], flats

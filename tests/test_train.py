@@ -856,3 +856,51 @@ class TestProfiler:
         import json as _json
         ev = _json.load(open(tmp_path / "trace.json"))["traceEvents"]
         assert len(ev) == 4 and all(e["ph"] == "X" for e in ev)
+
+    def test_fit_iterator_epochs_positional(self, iris):
+        # MultiLayerNetwork.fit(DataSetIterator, int numEpochs) overload
+        x, y = iris
+        net = iris_net(seed=34)
+        net.fit(ArrayIterator(x, y, 50), 3)
+        assert net.trainer().iteration == 9  # 3 batches x 3 epochs
+
+    def test_fit_bad_arrays_raise(self, iris):
+        x, y = iris
+        net = iris_net(seed=35)
+        with pytest.raises(TypeError, match="two arrays"):
+            net.fit(ArrayIterator(x, y, 50), "labels")
+
+    def test_configured_trainer_survives_fit(self, iris):
+        # Regression: net.fit must NOT discard a kwarg-configured trainer
+        x, y = iris
+        net = iris_net(seed=36)
+        t = net.trainer(seed=99)
+        net.fit(ArrayIterator(x, y, 75), epochs=1)
+        assert net.trainer() is t and t.iteration == 2
+
+    def test_output_iterator_multi_output_graph(self, iris):
+        from deeplearning4j_tpu.nn import GraphBuilder
+        x, y = iris
+        g = (GraphBuilder(NetConfig(seed=0))
+             .add_input("in", (4,))
+             .add_layer("h", L.Dense(n_out=8, activation="relu"), "in")
+             .add_layer("o1", L.Output(n_out=3, activation="softmax",
+                                       loss="mcxent"), "h")
+             .add_layer("o2", L.Output(n_out=2, activation="softmax",
+                                       loss="mcxent"), "h")
+             .set_outputs("o1", "o2")
+             .build())
+        g.init()
+        from deeplearning4j_tpu.data.iterators import MultiDataSet
+
+        class It:
+            def __iter__(self):
+                for i in range(0, 150, 75):
+                    yield MultiDataSet([x[i:i + 75]],
+                                       [y[i:i + 75], y[i:i + 75, :2]])
+            def reset(self):
+                pass
+
+        outs = g.output_iterator(It())
+        assert isinstance(outs, list) and len(outs) == 2
+        assert outs[0].shape == (150, 3) and outs[1].shape == (150, 2)
