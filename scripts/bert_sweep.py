@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Sweep BERT-base fine-tune batch sizes (and seq lens) on the real chip to
+find the best MFU point; goal: >=0.70 MFU (north-star) on this config."""
+import json
+import os
+import sys
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/scripts")
+
+import model_benches as mb
+from deeplearning4j_tpu.models import BertBase
+
+results = {}
+for batch, T, flash in [(128, 128, False), (256, 128, False),
+                        (32, 512, False), (64, 512, False)]:
+    name = f"bert_b{batch}_t{T}" + ("_flash" if flash else "")
+    try:
+        r = mb.bench_model(
+            name,
+            lambda T=T, flash=flash: BertBase(num_classes=2, seed=0,
+                                              input_shape=(T,), flash=flash).build(),
+            batch, (T,), 2, token_vocab=30522, on_tpu=True)
+        results[name] = r
+        print(json.dumps(r), flush=True)
+    except Exception as e:
+        print(f"{name}: {type(e).__name__}: {str(e)[:200]}", flush=True)
+
+print(json.dumps(results, indent=1))

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""On-chip sweeps: 738M grad_accum A/B, char-RNN scan_unroll, LeNet spe,
+BERT T=512 flash. Probe-guarded; each job fenced; sized to finish."""
+import json
+import sys
+import threading
+
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/scripts")
+
+out = {}
+def probe():
+    import jax
+    out["d"] = jax.devices()
+t = threading.Thread(target=probe, daemon=True)
+t.start(); t.join(90)
+if "d" not in out:
+    print("WEDGED"); raise SystemExit(3)
+print("devices:", out["d"])
+
+import model_benches as mb
+from deeplearning4j_tpu.models import BertBase, GravesLSTMCharRNN, LeNet
+
+JOBS = [
+    # 738M: optimizer-amortization A/B (batch 4 microbatch, 1/2/4 accum)
+    ("738m_micro1", lambda: mb.bench_transformer(d_model=2048, batch=4,
+                                                 flash=True, micro=1, steps=10)),
+    ("738m_micro2", lambda: mb.bench_transformer(d_model=2048, batch=4,
+                                                 flash=True, micro=2, steps=8)),
+    ("738m_micro4", lambda: mb.bench_transformer(d_model=2048, batch=4,
+                                                 flash=True, micro=4, steps=6)),
+    # char-RNN: scan_unroll sweep at spe=8
+    ("charrnn_u1", lambda: mb.bench_model(
+        "charrnn_u1", lambda: GravesLSTMCharRNN(seed=0, tbptt=0).build(),
+        128, (64, 98), 98, seq=True, spe=8)),
+    ("charrnn_u4", lambda: mb.bench_model(
+        "charrnn_u4", lambda: GravesLSTMCharRNN(seed=0, tbptt=0,
+                                                scan_unroll=4).build(),
+        128, (64, 98), 98, seq=True, spe=8)),
+    ("charrnn_u8", lambda: mb.bench_model(
+        "charrnn_u8", lambda: GravesLSTMCharRNN(seed=0, tbptt=0,
+                                                scan_unroll=8).build(),
+        128, (64, 98), 98, seq=True, spe=8)),
+    # LeNet megastep capture
+    ("lenet_spe16", lambda: mb.bench_model(
+        "lenet_spe16",
+        lambda: LeNet(num_classes=10, seed=0, input_shape=(28, 28, 1)).build(),
+        1024, (28, 28, 1), 10, spe=16)),
+    # VGG16 (138M params): optimizer-amortization A/B via grad_accum
+    ("vgg16_micro2", lambda: mb.bench_model(
+        "vgg16_micro2",
+        lambda: __import__("deeplearning4j_tpu.models", fromlist=["VGG16"]
+                           ).VGG16(num_classes=1000, seed=0,
+                                   input_shape=(224, 224, 3)).build(),
+        32, (224, 224, 3), 1000, micro=2, steps=10)),
+    # BERT T=512: flash vs dense attention
+    ("bert_t512_dense", lambda: mb.bench_model(
+        "bert_t512_dense",
+        lambda: BertBase(num_classes=2, seed=0, input_shape=(512,)).build(),
+        32, (512,), 2, token_vocab=30522)),
+    ("bert_t512_flash", lambda: mb.bench_model(
+        "bert_t512_flash",
+        lambda: BertBase(num_classes=2, seed=0, input_shape=(512,),
+                         flash=True).build(),
+        32, (512,), 2, token_vocab=30522)),
+]
+
+def bench_bert_inference(batch=64, T=128, iters=30):
+    """Forward-only (serving) throughput, bf16 — the ParallelInference
+    surface's device ceiling."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models import BertBase
+    from deeplearning4j_tpu.train.trainer import make_infer_fn
+
+    m = BertBase(num_classes=2, seed=0, input_shape=(T,)).build()
+    m.config.compute_dtype = "bfloat16"
+    m.init()
+    infer = make_infer_fn(m)
+    x = jax.device_put(np.random.RandomState(0).randint(
+        0, 30522, (batch, T)).astype(np.int32))
+    r = infer(m.params, m.state, x, None)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = infer(m.params, m.state, x, None)
+    jax.block_until_ready(r)
+    dt = (time.perf_counter() - t0) / iters
+    return {"model": f"bert_infer_b{batch}_t{T}", "batch": batch,
+            "step_ms": round(dt * 1e3, 2),
+            "samples_per_sec": round(batch / dt, 1)}
+
+
+JOBS.append(("bert_infer", bench_bert_inference))
+
+results = {}
+for name, fn in JOBS:
+    try:
+        results[name] = fn()
+        print(name, json.dumps(results[name]), flush=True)
+    except Exception as e:
+        results[name] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+        print(name, "ERROR", results[name]["error"], flush=True)
+
+with open("/tmp/chip_sweeps_results.json", "w") as f:
+    json.dump(results, f, indent=1)
+print("DONE -> /tmp/chip_sweeps_results.json")
