@@ -74,6 +74,11 @@ def _probe_devices(timeout_s: float):
         try:
             with open(LAST_HEADLINE) as f:
                 last = json.load(f)
+            # Top-level marker so automated consumers cannot mistake the
+            # fallback for a fresh capture (r3 advisor): the metric name is
+            # suffixed AND "stale": true rides next to "value".
+            last["stale"] = True
+            last["metric"] = str(last.get("metric", "")) + "_stale"
             last.setdefault("detail", {})
             last["detail"]["stale_from"] = last["detail"].get("captured", "?")
             last["detail"]["stale_reason"] = (

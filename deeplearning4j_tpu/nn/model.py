@@ -170,19 +170,37 @@ class TrainableModel:
     _full_infer_fn_cache = None
     _score_fn_cache = None
 
-    def trainer(self, **kw):
+    def trainer(self, reset: bool = False, **kw):
         """The cached Trainer (built on first use, seeded from
         ``config.seed``). A no-kwarg call ALWAYS returns the cached one
         (fit/evaluate go through here — they must never discard a trainer
         the user configured via ``net.trainer(mesh=..., ...)``); passing
-        DIFFERENT kwargs rebuilds, which resets optimizer state and
-        iteration count; repeating the same kwargs reuses the cache."""
-        if not kw and self._trainer is not None:
+        DIFFERENT kwargs rebuilds, which resets optimizer state, rng stream
+        and iteration count; repeating the same kwargs reuses the cache.
+        Rebuilding away a trainer that has already trained (iteration > 0)
+        is usually an accident mid-training — it warns unless ``reset=True``
+        acknowledges the discard. ``reset=True`` also FORCES a rebuild (a
+        fresh optimizer/rng/iteration state) even when the kwargs match the
+        cached ones; with no kwargs it rebuilds with the cached kwargs."""
+        if not kw and self._trainer is not None and not reset:
             return self._trainer
+        if reset and not kw and self._trainer_kw is not None:
+            kw = dict(self._trainer_kw)
         kw.setdefault("seed", self.config.seed)
-        if self._trainer is None or kw != self._trainer_kw:
+        if self._trainer is None or reset or kw != self._trainer_kw:
             from ..train.trainer import Trainer
 
+            old = self._trainer
+            if (old is not None and getattr(old, "iteration", 0) > 0
+                    and not reset):
+                import warnings
+
+                warnings.warn(
+                    f"net.trainer(**{kw!r}) discards the existing trainer at "
+                    f"iteration {old.iteration} — optimizer state, rng "
+                    f"stream and iteration count reset. Pass reset=True to "
+                    f"acknowledge, or call net.trainer() with no kwargs to "
+                    f"keep training with the current one.", stacklevel=2)
             self._trainer = Trainer(self, **kw)
             self._trainer_kw = dict(kw)
         return self._trainer
@@ -334,7 +352,11 @@ class Sequential(TrainableModel):
     # --- pure forward (feedForward, MultiLayerNetwork.java:2388) ---
     def forward(self, params: Params, state: State, x: Array, *, training: bool = False,
                 rng: Optional[Array] = None, mask: Optional[Array] = None,
-                up_to: Optional[int] = None) -> Tuple[Array, State]:
+                up_to: Optional[int] = None, return_mask: bool = False):
+        """``return_mask=True`` additionally returns the layer-PROPAGATED
+        mask after the last applied layer — the mask the loss must reduce
+        with (a pooling layer that collapses the time axis propagates None;
+        RNN stacks pass the (B, T) mask through unchanged)."""
         n = len(self.layers) if up_to is None else up_to
         rngs = jax.random.split(rng, n) if rng is not None else [None] * n
         new_state = dict(state)
@@ -355,6 +377,8 @@ class Sequential(TrainableModel):
                 new_state[k] = s_out
         if cdt is not None:
             x = x.astype(self.dtype)
+        if return_mask:
+            return x, new_state, mask
         return x, new_state
 
     def activations(self, params, state, x, **kw) -> List[Array]:
@@ -374,19 +398,32 @@ class Sequential(TrainableModel):
     def score(self, params: Params, state: State, x: Array, labels: Array, *,
               training: bool = True, rng: Optional[Array] = None,
               mask: Optional[Array] = None, label_mask: Optional[Array] = None,
-              ) -> Tuple[Array, State]:
+              with_mass: bool = False):
+        """Training loss. The loss reduces with ``label_mask`` when given,
+        else with the layer-PROPAGATED feature mask (same rule as
+        :meth:`score_with_carry` — a pooling layer that collapses the time
+        axis propagates None, so a masked sequence CLASSIFIER gets the
+        correct unmasked per-example mean). ``with_mass=True`` additionally
+        returns the loss-reduction mass (ops.losses.reduction_mass) —
+        grad_accum's exact microbatch recombination weight."""
         out_layer = self.layers[-1]
         if not _is_loss_layer(out_layer):
             raise ValueError("Last layer must be an Output/Loss layer to compute score")
-        feats, new_state = self.forward(params, state, x, training=training, rng=rng,
-                                        mask=mask, up_to=len(self.layers) - 1)
+        feats, new_state, prop_mask = self.forward(
+            params, state, x, training=training, rng=rng, mask=mask,
+            up_to=len(self.layers) - 1, return_mask=True)
         k = _layer_key(len(self.layers) - 1, out_layer)
+        eff_mask = label_mask if label_mask is not None else prop_mask
         loss = out_layer.score(params.get(k, {}), state.get(k, {}), feats, labels,
-                               mask=label_mask if label_mask is not None else mask)
+                               mask=eff_mask)
         # L1/L2 regularization score term (BaseOptimizer scoring parity) is
         # applied through the updater (optax add_decayed_weights), not here —
         # DL4J adds it to the reported score; we report pure data loss.
         loss = loss + _collect_aux_losses(new_state)
+        if with_mass:
+            from ..ops.losses import reduction_mass
+
+            return loss, new_state, reduction_mass(labels, eff_mask)
         return loss, new_state
 
     # --- inference (output :2006) ---

@@ -87,6 +87,30 @@ def mae(p, y, mask=None, weights=None):
     return _reduce(jnp.abs(p - y), mask, weights)
 
 
+def reduction_mass(labels, mask=None):
+    """Total denominator weight of one (micro)batch under :func:`_reduce`'s
+    masked mean — used by ``grad_accum`` for EXACT recombination of
+    microbatch masked means (weight each microbatch's loss/grads by its
+    mass, divide by the total): ``sum(mask)`` broadcast to the per-example
+    shape, or the per-example element count when unmasked. Integer labels
+    take the sparse-index path (per-example shape == labels shape); dense
+    labels lose the trailing feature axis."""
+    labels = jnp.asarray(labels)
+    sparse = jnp.issubdtype(labels.dtype, jnp.integer)
+    pe_shape = tuple(labels.shape) if sparse else tuple(labels.shape[:-1])
+    if not pe_shape:
+        pe_shape = (1,)
+    if mask is None:
+        n = 1
+        for d in pe_shape:
+            n *= int(d)
+        return jnp.asarray(float(n), jnp.float32)
+    m = jnp.asarray(mask).astype(jnp.float32)
+    m = jnp.broadcast_to(
+        m.reshape(m.shape + (1,) * (len(pe_shape) - m.ndim)), pe_shape)
+    return jnp.sum(m)
+
+
 def _is_sparse_labels(p, y):
     """Sparse class-index labels = integer dtype AND one fewer trailing dim
     than predictions. Integer labels at full rank (e.g. np.eye(...).astype(int)

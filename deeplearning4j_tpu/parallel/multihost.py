@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nn.model import Sequential
 from ..train.listeners import PerformanceListener, TrainingListener
-from ..train.trainer import build_updater, check_not_donated
+from ..train.trainer import accum_supported, build_updater, check_not_donated
 from .mesh import DATA_AXIS, make_mesh
 
 
@@ -468,7 +468,8 @@ class MultiHostTrainer:
                     # split evenly into n microbatches
                     dp = self.mesh.shape.get(DATA_AXIS, 1)
                     rows_per_dev = x.shape[0] // max(dp, 1)
-                    if n > 1 and rows_per_dev % n == 0:
+                    if (n > 1 and rows_per_dev % n == 0
+                            and accum_supported(self.model, mask, label_mask)):
                         rng = jnp.stack([self.next_rng() for _ in range(n)])
                         step = self._step
                     else:
@@ -552,7 +553,8 @@ class MultiHostTrainer:
         shard rows on its own devices and accumulates into a fresh instance;
         the per-process accumulator dicts merge with one tiny all-gather.
         Works for Evaluation / EvaluationBinary / RegressionEvaluation /
-        ROC (histogram mode) / ROCMultiClass / EvaluationCalibration — any
+        ROC (histogram mode) / ROCBinary / ROCMultiClass /
+        EvaluationCalibration — any
         object implementing the ``_Mergeable`` protocol (new_like / state /
         load_state / merge)."""
         from ..train.trainer import default_evaluation, make_infer_fn

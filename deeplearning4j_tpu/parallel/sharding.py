@@ -267,27 +267,42 @@ def make_mesh_accum_step(model, tx, mesh, accum, act_ctx, p_sh, o_sh, repl):
         xs, ys, fms, lms = (regroup(t) for t in (x, y, mask, label_mask))
 
         def one(carry, microbatch):
-            g_acc, loss_acc, net_state = carry
+            g_acc, loss_acc, w_acc, net_state = carry
             xi, yi, ri, fmi, lmi = microbatch
-            mask_kw = ({"mask": fmi, "label_mask": lmi} if seq
-                       else {"masks": fmi, "label_masks": lmi})
 
             def loss_fn(p):
+                # mass-weighted recombination (see Trainer._make_accum_step):
+                # exact vs the single-step masked mean even when mask
+                # coverage varies across microbatches; reduces to the plain
+                # mean when unmasked. Graph-with-masks callers fall back to
+                # the plain step (per-output mask masses).
                 with act_ctx():
-                    loss, ns = model.score(p, net_state, xi, yi,
-                                           training=True, rng=ri, **mask_kw)
-                return loss, ns
+                    if seq:
+                        loss, ns, w = model.score(
+                            p, net_state, xi, yi, training=True, rng=ri,
+                            mask=fmi, label_mask=lmi, with_mass=True)
+                    else:
+                        loss, ns = model.score(
+                            p, net_state, xi, yi, training=True, rng=ri,
+                            masks=fmi, label_masks=lmi)
+                        w = jnp.asarray(1.0, jnp.float32)
+                return loss * w, (ns, w)
 
-            (loss, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            return (jax.tree.map(jnp.add, g_acc, g), loss_acc + loss, ns), None
+            ((wloss, (ns, w)), g) = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return (jax.tree.map(jnp.add, g_acc, g),
+                    loss_acc + wloss, w_acc + w, ns), None
 
         zeros = jax.tree.map(jnp.zeros_like, params)
-        (g, loss_sum, net_state), _ = jax.lax.scan(
-            one, (zeros, jnp.asarray(0.0, jnp.float32), net_state),
+        (g, loss_sum, w_sum, net_state), _ = jax.lax.scan(
+            one, (zeros, jnp.asarray(0.0, jnp.float32),
+                  jnp.asarray(0.0, jnp.float32), net_state),
             (xs, ys, rng, fms, lms))
-        g = jax.tree.map(lambda a: a / accum, g)
+        # clamp like losses._reduce: an all-masked batch yields 0, not NaN
+        w_sum = jnp.maximum(w_sum, 1.0)
+        g = jax.tree.map(lambda a: a / w_sum, g)
         updates, opt_state = tx.update(g, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, net_state, loss_sum / accum
+        return params, opt_state, net_state, loss_sum / w_sum
 
     return accum_step

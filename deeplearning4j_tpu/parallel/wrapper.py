@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nn.model import Sequential
 from ..train.listeners import PerformanceListener, TrainingListener
-from ..train.trainer import build_updater, check_not_donated
+from ..train.trainer import accum_supported, build_updater, check_not_donated
 from .mesh import DATA_AXIS, make_mesh
 
 
@@ -434,7 +434,8 @@ class ParallelWrapper:
             yd = jax.device_put(y, self._batch_sharding)
             na = self.grad_accum
             dp = self.mesh.shape.get(DATA_AXIS, 1)
-            if na > 1 and (x.shape[0] // max(dp, 1)) % na == 0:
+            if (na > 1 and (x.shape[0] // max(dp, 1)) % na == 0
+                    and accum_supported(self.model, mask, label_mask)):
                 step, rng = self._accum_step, jnp.stack(
                     [self.next_rng() for _ in range(na)])
             else:  # indivisible per-device rows: plain step

@@ -25,9 +25,23 @@ class TrainingListener:
     normally defer the loss readback of iteration k until after iteration
     k+1 has been dispatched (keeps the device busy); a sync listener forces
     in-order reporting so its control flow acts before the next dispatch.
+
+    ``snapshots_state``: set True on listeners that read trainer
+    params/state in ``iteration_done`` (per-iteration evaluation or
+    checkpointing). Its presence (a) disables the ``steps_per_execution``
+    megastep — all K iterations would complete on device before any is
+    reported, so iteration i would observe params up to K steps ahead —
+    and (b) forces synchronous in-order reporting (like ``requires_sync``),
+    so the snapshot at iteration i is exactly iteration i's params, not the
+    lagged path's i+1. Set it per-instance when the state read is
+    conditional (EvaluativeListener sets it only for
+    ``invocation="iteration"``; CheckpointListener only when
+    ``every_n_iterations`` is configured — epoch-end-only instances keep
+    the fast paths).
     """
 
     requires_sync: bool = False
+    snapshots_state: bool = False
 
     def on_epoch_start(self, trainer, epoch: int):
         pass
@@ -51,7 +65,12 @@ class DeferredScoreReporter:
         self.trainer = trainer
         self.listeners = list(listeners)
         self.reduce = reduce  # device scalar -> float
+        # snapshots_state listeners read trainer params in iteration_done:
+        # the lagged path would hand them iteration i+1's params for
+        # iteration i (the next step has already been dispatched on donated
+        # buffers) — they need in-order reporting just like requires_sync
         self.lagged = not any(getattr(l, "requires_sync", False)
+                              or getattr(l, "snapshots_state", False)
                               for l in self.listeners)
         self._pending = None
 
@@ -154,6 +173,9 @@ class EvaluativeListener(TrainingListener):
         self.test_iterator = test_iterator
         self.frequency = frequency
         self.invocation = invocation
+        # only per-iteration invocation reads params in iteration_done;
+        # epoch_end instances keep the megastep/lagged fast paths
+        self.snapshots_state = invocation == "iteration"
         self.evaluation_factory = evaluation_factory
         self.log = log_fn or (lambda s: logger.info(s))
         self.last_evaluation = None
@@ -211,6 +233,9 @@ class CheckpointListener(TrainingListener):
 
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
+        # per-iteration checkpoints save trainer params in iteration_done;
+        # epoch-only instances keep the megastep/lagged fast paths
+        self.snapshots_state = every_n_iterations is not None
         self.every_n_iterations = every_n_iterations
         self.every_n_epochs = every_n_epochs
         self.keep_last = keep_last

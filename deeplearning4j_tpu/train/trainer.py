@@ -39,6 +39,26 @@ from ..ops import updaters as upd
 from .listeners import PerformanceListener, TrainingListener
 
 
+def accum_supported(model, mask, label_mask) -> bool:
+    """Whether ``grad_accum``'s microbatch accumulation is EXACT for this
+    batch. Callers (Trainer, ParallelWrapper, MultiHostTrainer) run the
+    plain step when False — one rule, three dispatch sites.
+
+    - unmasked batches: always (equal masses reduce to the plain mean)
+    - masked Sequential: yes via mass-weighted recombination
+      (``score(with_mass=True)`` — one effective loss mask) — UNLESS the
+      model carries aux losses (MoE load balancing): those are per-token
+      over ALL positions and must not inherit the label-mask mass weighting
+    - masked Graph: no (per-output label_masks would need per-output masses)
+    """
+    if mask is None and label_mask is None:
+        return True
+    if not isinstance(model, Sequential):
+        return False
+    return not any(getattr(l, "aux_loss_weight", None) is not None
+                   for l in model.layers)
+
+
 def _mesh_ctx(mesh):
     """Trace context for a mesh (activation constraints + ambient mesh for
     ring attention) or a no-op when mesh is None."""
@@ -259,8 +279,11 @@ class Trainer:
         # updater runs once. Activation memory scales with the microbatch,
         # optimizer HBM traffic (read m,v,params + write back — the dominant
         # per-step cost for 100M+ param models) is paid once per N
-        # microbatches. Loss/grad semantics are the standard
-        # mean-of-microbatch-means (exact for equal, unmasked microbatches).
+        # microbatches. Loss/grad semantics: microbatches recombine weighted
+        # by their loss-reduction mass (ops.losses.reduction_mass), so the
+        # result is EXACT vs the single big-batch masked mean even when mask
+        # coverage varies across microbatches; Graph models with masks fall
+        # back to the plain step (per-output masses not implemented).
         self.grad_accum = max(1, int(grad_accum))
         self.tx = updater if updater is not None else build_updater(model)
         if model.params is None:
@@ -405,30 +428,47 @@ class Trainer:
         @partial(jax.jit, donate_argnums=(0, 1, 2), **jit_kw)
         def step(params, opt_state, net_state, xs, ys, rngs, fms, lms):
             def one(carry, mb):
-                g_acc, loss_acc, net_state = carry
+                g_acc, loss_acc, w_acc, net_state = carry
                 x, y, rng, fm, lm = mb
-                mask_kw = ({"mask": fm, "label_mask": lm} if seq
-                           else {"masks": fm, "label_masks": lm})
 
                 def loss_fn(p):
+                    # mass-weighted recombination: each microbatch's
+                    # masked-mean loss/grads weigh in by the reduction mass
+                    # of the mask the loss ACTUALLY consumed (score's
+                    # with_mass aux), so the combined result equals the
+                    # single-step masked mean even when mask coverage varies
+                    # across microbatches (padded RNN batches). Unmasked
+                    # microbatches get equal masses — same as the plain
+                    # mean. Graph models with masks never reach here
+                    # (dispatch falls back — per-output mask masses).
                     with act_ctx():
-                        loss, ns = model.score(p, net_state, x, y,
-                                               training=True, rng=rng,
-                                               **mask_kw)
-                    return loss, ns
+                        if seq:
+                            loss, ns, w = model.score(
+                                p, net_state, x, y, training=True, rng=rng,
+                                mask=fm, label_mask=lm, with_mass=True)
+                        else:
+                            loss, ns = model.score(
+                                p, net_state, x, y, training=True, rng=rng,
+                                masks=fm, label_masks=lm)
+                            w = jnp.asarray(1.0, jnp.float32)
+                    return loss * w, (ns, w)
 
-                (loss, ns), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                ((wloss, (ns, w)), g) = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
                 return (jax.tree.map(jnp.add, g_acc, g),
-                        loss_acc + loss, ns), None
+                        loss_acc + wloss, w_acc + w, ns), None
 
             zeros = jax.tree.map(jnp.zeros_like, params)
-            (g, loss_sum, net_state), _ = jax.lax.scan(
-                one, (zeros, jnp.asarray(0.0, jnp.float32), net_state),
+            (g, loss_sum, w_sum, net_state), _ = jax.lax.scan(
+                one, (zeros, jnp.asarray(0.0, jnp.float32),
+                      jnp.asarray(0.0, jnp.float32), net_state),
                 (xs, ys, rngs, fms, lms))
-            g = jax.tree.map(lambda a: a / n_micro, g)
+            # clamp like losses._reduce: an all-masked batch yields 0, not NaN
+            w_sum = jnp.maximum(w_sum, 1.0)
+            g = jax.tree.map(lambda a: a / w_sum, g)
             updates, opt_state = tx.update(g, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, net_state, loss_sum / n_micro
+            return params, opt_state, net_state, loss_sum / w_sum
 
         return step
 
@@ -513,9 +553,12 @@ class Trainer:
         small/fast models where per-step dispatch dominates (LeNet-class
         models run ~1-3 ms/step; one K-step program pays the dispatch cost
         once). Ignored for tBPTT fits, mesh-sharded trainers (their batches
-        are placed per-minibatch), and when any listener ``requires_sync``
+        are placed per-minibatch), when any listener ``requires_sync``
         (e.g. divergence rollback — it must validate each iteration before
-        the next runs); ragged tail batches fall back to the single step."""
+        the next runs), and when any listener ``snapshots_state``
+        (checkpoint/evaluative — under a megastep iteration i would observe
+        params up to K steps ahead); ragged tail batches fall back to the
+        single step."""
         from ..data.iterators import AsyncIterator
         from .listeners import DeferredScoreReporter
 
@@ -526,10 +569,14 @@ class Trainer:
         spe = max(1, int(steps_per_execution))
         # requires_sync listeners (e.g. DivergenceListener rollback) need
         # every iteration validated before the next mutates trainer state —
-        # a K-step program would run K steps past the first bad one
+        # a K-step program would run K steps past the first bad one.
+        # snapshots_state listeners (checkpoint/evaluative) read trainer
+        # params in iteration_done; under a megastep iteration i would see
+        # params up to K steps ahead, so they too force the single step.
         use_mega = (spe > 1 and not tbptt and self.mesh is None
                     and self.grad_accum == 1
                     and not any(getattr(l, "requires_sync", False)
+                                or getattr(l, "snapshots_state", False)
                                 for l in listeners))
         buf: List[tuple] = []
 
@@ -582,7 +629,7 @@ class Trainer:
         accumulation step (one optimizer update per batch either way).
         Returns the device loss scalar."""
         x, y, fm, lm = self._place_batch(xb, yb, fmb, lmb)
-        if self.grad_accum > 1:
+        if self.grad_accum > 1 and accum_supported(self.model, fm, lm):
             n = self.grad_accum
             first = next(iter(x.values())) if isinstance(x, dict) else x
             bs = int(first.shape[0])

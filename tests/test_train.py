@@ -681,6 +681,64 @@ class TestStepsPerExecution:
         assert all(np.all(np.isfinite(np.asarray(p)))
                    for p in jax.tree_util.tree_leaves(tr.params))
 
+    def test_megastep_disabled_for_state_snapshot_listeners(self, iris, tmp_path):
+        """Listeners that read trainer params in iteration_done (checkpoint,
+        evaluative) would observe params up to K steps ahead inside a
+        megastep window — their presence must force the single-step path
+        (r3 advisor)."""
+        x, y = iris
+        ck = CheckpointListener(str(tmp_path), every_n_iterations=2)
+        tr = Trainer(iris_net(seed=30))
+        tr.fit(ArrayIterator(x[:120], y[:120], 30, shuffle=False), epochs=2,
+               listeners=[ck], steps_per_execution=4)
+        assert tr._multi_step_fn is None  # megastep never compiled
+        assert tr.iteration == 8 and len(ck.saved) > 0
+        tr2 = Trainer(iris_net(seed=30))
+        tr2.fit(ArrayIterator(x[:120], y[:120], 30, shuffle=False), epochs=2,
+                steps_per_execution=4)
+        assert tr2._multi_step_fn is not None  # sanity: gate is the listener
+        # epoch-end-only instances never read params in iteration_done and
+        # must NOT disable the megastep
+        ck_ep = CheckpointListener(str(tmp_path / "ep"), every_n_epochs=1)
+        tr3 = Trainer(iris_net(seed=30))
+        tr3.fit(ArrayIterator(x[:120], y[:120], 30, shuffle=False), epochs=2,
+                listeners=[ck_ep], steps_per_execution=4)
+        assert tr3._multi_step_fn is not None and len(ck_ep.saved) == 2
+
+    def test_snapshot_listener_sees_in_sync_params(self, iris):
+        """snapshots_state forces synchronous reporting: the params a
+        checkpoint/evaluative listener reads at iteration i are exactly
+        iteration i's params — the lagged fast path would hand it i+1's
+        (the next step is already dispatched on donated buffers)."""
+        x, y = iris
+
+        class Snap(CollectScoresListener):
+            snapshots_state = True
+
+            def __init__(self):
+                super().__init__()
+                self.params_seen = []
+
+            def iteration_done(self, trainer, iteration, epoch, loss):
+                super().iteration_done(trainer, iteration, epoch, loss)
+                self.params_seen.append(jax.tree.map(np.asarray,
+                                                     trainer.params))
+
+        snap = Snap()
+        tr = Trainer(iris_net(seed=33))
+        tr.fit(ArrayIterator(x[:90], y[:90], 30, shuffle=False), epochs=1,
+               listeners=[snap])
+        # oracle: an identical trainer run one batch at a time
+        tr2 = Trainer(iris_net(seed=33))
+        for i in range(3):
+            tr2.fit(iter([DataSet(x[30 * i:30 * (i + 1)],
+                                  y[30 * i:30 * (i + 1)])]),
+                    epochs=1, prefetch=False)
+            for a, b in zip(jax.tree_util.tree_leaves(snap.params_seen[i]),
+                            jax.tree_util.tree_leaves(
+                                jax.tree.map(np.asarray, tr2.params))):
+                np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
 
 class TestModelFitSugar:
     """net.fit(iterator) front door (MultiLayerNetwork.fit parity): cached
@@ -731,6 +789,31 @@ class TestModelFitSugar:
         assert net.trainer() is t1  # same kwargs -> cached
         t2 = net.trainer(seed=123)  # different kwargs -> rebuild
         assert t2 is not t1 and net.trainer(seed=123) is t2
+
+    def test_trainer_rebuild_after_training_warns(self, iris):
+        """Rebuilding away a trainer that already trained discards optimizer
+        state mid-training — warn unless reset=True acknowledges it
+        (r3 advisor)."""
+        import warnings
+
+        x, y = iris
+        net = iris_net(seed=31)
+        net.fit(ArrayIterator(x, y, 64, shuffle=False), epochs=1)
+        assert net.trainer().iteration > 0
+        with pytest.warns(UserWarning, match="discards the existing trainer"):
+            net.trainer(grad_accum=2)
+        net2 = iris_net(seed=31)
+        net2.fit(ArrayIterator(x, y, 64, shuffle=False), epochs=1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # reset=True must be silent
+            t = net2.trainer(grad_accum=2, reset=True)
+        assert t.iteration == 0
+        # reset=True forces a fresh rebuild even with identical kwargs,
+        # and with no kwargs rebuilds with the cached ones
+        t2 = net2.trainer(grad_accum=2, reset=True)
+        assert t2 is not t
+        t3 = net2.trainer(reset=True)
+        assert t3 is not t2 and net2._trainer_kw.get("grad_accum") == 2
 
     def test_trainer_seeded_from_config(self, iris):
         net = iris_net(seed=11)
@@ -783,6 +866,175 @@ class TestGradAccum:
         tr = Trainer(iris_net(seed=22), grad_accum=4)
         tr.fit(ArrayIterator(x, y, 40, shuffle=False), epochs=1)
         assert tr.iteration == 4  # every batch trained, none dropped
+
+    def test_accum_masked_equals_single_step(self):
+        """Mask coverage varying ACROSS microbatches: the mass-weighted
+        recombination must reproduce the single-step masked mean exactly
+        (r3 advisor: plain mean-of-microbatch-means deviated here)."""
+        T, B = 8, 8
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((B, T, 3)).astype(np.float32)
+        y = np.zeros((B, T, 2), np.float32)
+        y[np.arange(B)[:, None], np.arange(T)[None, :],
+          rng.integers(0, 2, (B, T))] = 1.0
+        lm = np.ones((B, T), np.float32)
+        lm[B // 2:, 2:] = 0.0  # 2nd microbatch carries 1/4 the mask mass
+
+        def run(accum):
+            net = (SequentialBuilder(NetConfig(seed=0, updater={
+                       "type": "sgd", "learning_rate": 1e-1}))
+                   .input_shape(T, 3)
+                   .layer(L.LSTM(n_out=5))
+                   .layer(L.RnnOutput(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                   .build())
+            tr = Trainer(net, seed=0, grad_accum=accum)
+            tr.fit(iter([DataSet(x, y, labels_mask=lm)]), epochs=1,
+                   prefetch=False)
+            return jax.tree.map(np.asarray, tr.params)
+
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                    atol=1e-6),
+            run(1), run(2))
+
+    def test_accum_graph_with_masks_falls_back(self):
+        """Graph models with masks run the plain step (exact per-output
+        recombination not implemented) — training must still proceed."""
+        from deeplearning4j_tpu.nn import GraphBuilder
+        T, B = 6, 8
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((B, T, 3)).astype(np.float32)
+        y = np.zeros((B, T, 2), np.float32)
+        y[..., 0] = 1.0
+        lm = np.ones((B, T), np.float32)
+        lm[B // 2:, 3:] = 0.0
+        g = (GraphBuilder(NetConfig(seed=0, updater={"type": "sgd",
+                                                     "learning_rate": 1e-1}))
+             .add_input("in", (T, 3))
+             .add_layer("rnn", L.LSTM(n_out=5), "in")
+             .add_layer("out", L.RnnOutput(n_out=2, activation="softmax",
+                                           loss="mcxent"), "rnn")
+             .set_outputs("out")
+             .build())
+        tr = Trainer(g, seed=0, grad_accum=2)
+        tr.fit(iter([DataSet(x, y, labels_mask=lm)]), epochs=1,
+               prefetch=False)
+        assert tr._accum_step_fn is None  # accum program never built
+        assert tr.iteration == 1
+        assert all(np.all(np.isfinite(np.asarray(p)))
+                   for p in jax.tree_util.tree_leaves(tr.params))
+
+    def test_masked_pooling_classifier_trains(self):
+        """score() reduces the loss with the layer-PROPAGATED mask (same rule
+        as score_with_carry): GlobalPooling consumes the (B, T) feature mask,
+        so a masked sequence CLASSIFIER's loss is the plain per-example mean
+        — passing the raw (B, T) mask used to crash the reduction. Both the
+        plain and accum paths must train, and masked-tail garbage in the
+        features must not change the result."""
+        B, T, F = 8, 6, 4
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((B, T, F)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, B)]
+        fm = (np.arange(T)[None, :]
+              < rng.integers(2, T + 1, B)[:, None]).astype(np.float32)
+        x_garbage = x.copy()
+        x_garbage[fm == 0] = 777.0  # masked steps: content must not matter
+
+        def run(xa, accum):
+            net = (SequentialBuilder(NetConfig(seed=0, updater={
+                       "type": "sgd", "learning_rate": 1e-1}))
+                   .input_shape(T, F)
+                   .layer(L.LSTM(n_out=5))
+                   .layer(L.GlobalPooling(mode="avg"))
+                   .layer(L.Output(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                   .build())
+            tr = Trainer(net, seed=0, grad_accum=accum)
+            tr.fit(iter([DataSet(xa, y, features_mask=fm)]), epochs=1,
+                   prefetch=False)
+            return jax.tree.map(np.asarray, tr.params)
+
+        p1, p2 = run(x, 1), run(x, 2)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                             atol=1e-6),
+                     p1, p2)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                             atol=1e-6),
+                     p1, run(x_garbage, 1))
+
+    def test_accum_all_masked_batch_yields_zero_not_nan(self):
+        """A fully label-masked batch under grad_accum: the w_sum clamp
+        (mirroring losses._reduce) must produce zero loss/grads, not 0/0."""
+        T, B = 6, 8
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((B, T, 3)).astype(np.float32)
+        y = np.zeros((B, T, 2), np.float32)
+        y[..., 0] = 1.0
+        lm = np.zeros((B, T), np.float32)
+        net = (SequentialBuilder(NetConfig(seed=0, updater={
+                   "type": "sgd", "learning_rate": 1e-1}))
+               .input_shape(T, 3)
+               .layer(L.LSTM(n_out=4))
+               .layer(L.RnnOutput(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+               .build())
+        before = jax.tree.map(np.asarray, net.params or net.init()[0])
+        tr = Trainer(net, seed=0, grad_accum=2)
+        col = CollectScoresListener()
+        tr.fit(iter([DataSet(x, y, labels_mask=lm)]), epochs=1,
+               prefetch=False, listeners=[col])
+        assert col.scores[-1][1] == 0.0  # zero loss, not NaN
+        jax.tree.map(np.testing.assert_array_equal, before,
+                     jax.tree.map(np.asarray, tr.params))
+
+    def test_accum_moe_with_masks_falls_back(self):
+        """Aux losses (MoE load balancing) are per-token over ALL positions;
+        they must not inherit the label-mask mass weighting — masked batches
+        on aux-loss models run the plain step."""
+        T, B, D = 4, 8, 8
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((B, T, D)).astype(np.float32)
+        y = np.zeros((B, T, 2), np.float32)
+        y[..., 0] = 1.0
+        lm = np.ones((B, T), np.float32)
+        lm[B // 2:, 2:] = 0.0
+        net = (SequentialBuilder(NetConfig(seed=0, updater={
+                   "type": "sgd", "learning_rate": 1e-2}))
+               .input_shape(T, D)
+               .layer(L.MoE(num_experts=2, top_k=1))
+               .layer(L.RnnOutput(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+               .build())
+        tr = Trainer(net, seed=0, grad_accum=2)
+        tr.fit(iter([DataSet(x, y, labels_mask=lm)]), epochs=1,
+               prefetch=False)
+        assert tr._accum_step_fn is None  # plain step took the batch
+        # unmasked batches on the same architecture DO accumulate
+        net2 = (SequentialBuilder(NetConfig(seed=0, updater={
+                    "type": "sgd", "learning_rate": 1e-2}))
+                .input_shape(T, D)
+                .layer(L.MoE(num_experts=2, top_k=1))
+                .layer(L.RnnOutput(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        tr2 = Trainer(net2, seed=0, grad_accum=2)
+        tr2.fit(iter([DataSet(x, y)]), epochs=1, prefetch=False)
+        assert tr2._accum_step_fn is not None
+
+    def test_reduction_mass(self):
+        from deeplearning4j_tpu.ops.losses import reduction_mass
+        dense = np.zeros((4, 6, 2), np.float32)  # per-example (4, 6)
+        assert float(reduction_mass(dense)) == 24.0
+        m = np.ones((4, 6), np.float32)
+        m[2:, 3:] = 0.0
+        assert float(reduction_mass(dense, m)) == 18.0
+        sparse = np.zeros((4, 6), np.int32)  # sparse ids: per-example (4, 6)
+        assert float(reduction_mass(sparse)) == 24.0
+        assert float(reduction_mass(sparse, m)) == 18.0
+        # (B,) mask against (B, T) per-example broadcasts over T
+        mb = np.array([1, 1, 0, 0], np.float32)
+        assert float(reduction_mass(dense, mb)) == 12.0
 
 
 class TestFitOverloadsAndOutputIterator:
