@@ -1,10 +1,11 @@
 """Evaluation suite — parity with deeplearning4j eval/ (SURVEY.md §2.1)."""
 
 from .evaluation import (ROC, Evaluation, EvaluationBinary,
-                         EvaluationCalibration, ROCMultiClass,
-                         RegressionEvaluation)
+                         EvaluationCalibration, Prediction, ROCBinary,
+                         ROCMultiClass, RegressionEvaluation)
 from .tools import (export_evaluation_to_html, export_roc_charts_to_html)
 
-__all__ = ["Evaluation", "EvaluationBinary", "EvaluationCalibration", "ROC",
-           "ROCMultiClass", "RegressionEvaluation",
-           "export_evaluation_to_html", "export_roc_charts_to_html"]
+__all__ = ["Evaluation", "EvaluationBinary", "EvaluationCalibration",
+           "Prediction", "ROC", "ROCBinary", "ROCMultiClass",
+           "RegressionEvaluation", "export_evaluation_to_html",
+           "export_roc_charts_to_html"]

@@ -66,42 +66,112 @@ def _labels_to_idx(labels):
     return labels.astype(np.int64).reshape(labels.shape[0], *labels.shape[1:-1]) if labels.ndim >= 2 else labels.astype(np.int64)
 
 
+@dataclass
+class Prediction:
+    """eval/meta/Prediction.java — which example landed in which confusion
+    cell, for error inspection (``record_metadata`` on :class:`Evaluation`)."""
+
+    actual: int
+    predicted: int
+    metadata: object = None
+
+
+class _AutoId(int):
+    """Marker for auto-generated running-index metadata ids: merge offsets
+    ONLY these (position in the concatenated stream); user-supplied ids —
+    even ints — are never rewritten."""
+
+
 class Evaluation(_Mergeable):
     """eval/Evaluation.java — multiclass classification metrics.
 
     Accepts (B, K) batches or time-series (B, T, K) with optional (B, T) mask.
-    """
+
+    ``record_metadata=True`` captures a :class:`Prediction` per example
+    (Evaluation.java's RecordMetaData path): pass per-example ids via
+    ``eval(..., metadata=[...])`` (defaults to the running example index),
+    then inspect with :meth:`prediction_errors` /
+    :meth:`predictions_by_actual_class` / :meth:`predictions_by_predicted_class`.
+    Predictions merge by concatenation; they ride along ``merge()`` but are
+    NOT part of the numpy ``state()`` dict (the distributed allgather path
+    exchanges fixed-shape accumulators only — DL4J likewise excludes
+    metadata from its Spark reduce)."""
 
     _STATE_FIELDS = ("confusion", "top_n_correct", "top_n_total")
 
     def new_like(self) -> "Evaluation":
-        return Evaluation(self.num_classes, self.top_n)
+        return Evaluation(self.num_classes, self.top_n,
+                          record_metadata=self.record_metadata)
 
-    def __init__(self, num_classes: int, top_n: int = 1):
+    def merge(self, other):
+        super().merge(other)
+        # auto ids are running indices local to OTHER's stream: offset them
+        # past this instance's predictions so merged ids stay unique and
+        # equal to the position in the concatenated stream (exactly what one
+        # instance over the whole stream assigns); explicit user ids are
+        # never rewritten
+        base = len(self.predictions)
+        self.predictions.extend(
+            Prediction(pr.actual, pr.predicted, _AutoId(pr.metadata + base))
+            if isinstance(pr.metadata, _AutoId) else pr
+            for pr in getattr(other, "predictions", ()))
+        return self
+
+    def __init__(self, num_classes: int, top_n: int = 1,
+                 record_metadata: bool = False):
         self.num_classes = num_classes
         self.top_n = top_n
+        self.record_metadata = record_metadata
+        self.predictions: List[Prediction] = []
         self.confusion = np.zeros((num_classes, num_classes), np.int64)
         self.top_n_correct = 0
         self.top_n_total = 0
 
-    def eval(self, labels, predictions, mask=None):
+    def eval(self, labels, predictions, mask=None, metadata=None):
         y = _to_np(labels)
         p = _to_np(predictions)
+        meta = list(metadata) if metadata is not None else None
+        if meta is not None and len(meta) != y.shape[0]:
+            raise ValueError(
+                f"metadata has {len(meta)} entries for a batch of "
+                f"{y.shape[0]} examples — one id per example required")
         if y.ndim == 3:  # time series: flatten with mask
             if mask is not None:
                 m = _to_np(mask).astype(bool).reshape(-1)
             else:
                 m = np.ones(y.shape[0] * y.shape[1], bool)
+            if meta is not None:  # one id per (example, timestep)
+                T = y.shape[1]
+                meta = [(mid, t) for mid in meta for t in range(T)]
+                meta = [x for x, keep in zip(meta, m) if keep]
             y = y.reshape(-1, y.shape[-1])[m]
             p = p.reshape(-1, p.shape[-1])[m]
         yi = y.argmax(-1)
         pi = p.argmax(-1)
         np.add.at(self.confusion, (yi, pi), 1)
+        if self.record_metadata:
+            base = len(self.predictions)
+            if meta is None:
+                meta = [_AutoId(i) for i in range(base, base + len(yi))]
+            self.predictions.extend(
+                Prediction(int(a), int(b), mid)
+                for a, b, mid in zip(yi, pi, meta))
         if self.top_n > 1:
             topn = np.argsort(-p, axis=-1)[:, : self.top_n]
             self.top_n_correct += int((topn == yi[:, None]).any(-1).sum())
             self.top_n_total += len(yi)
         return self
+
+    # --- prediction metadata (eval/meta/Prediction.java) ---
+    def prediction_errors(self) -> List[Prediction]:
+        """Misclassified examples (getPredictionErrors)."""
+        return [pr for pr in self.predictions if pr.actual != pr.predicted]
+
+    def predictions_by_actual_class(self, cls: int) -> List[Prediction]:
+        return [pr for pr in self.predictions if pr.actual == cls]
+
+    def predictions_by_predicted_class(self, cls: int) -> List[Prediction]:
+        return [pr for pr in self.predictions if pr.predicted == cls]
 
     # --- metrics (Evaluation.java getters) ---
     @property
@@ -386,6 +456,85 @@ class ROC(_Mergeable):
     def auc_pr(self) -> float:
         r, p = self.pr_curve()
         return float(np.trapezoid(p, r))
+
+
+class ROCBinary(_Mergeable):
+    """ROCBinary.java:28 — independent binary ROC/AUC per output column.
+
+    For networks with ``n`` independent sigmoid outputs (multi-label):
+    per-output ROC/AUC/PR, unlike :class:`EvaluationBinary`'s fixed-threshold
+    counts. Accepts (B, n) or time-series (B, T, n); ``mask`` may be
+    per-example (B,)/(B, T) or PER-OUTPUT with the same shape as the labels
+    (DL4J supports per-output masking for multi-label time series)."""
+
+    def new_like(self) -> "ROCBinary":
+        return ROCBinary(self.n, self.num_thresholds)
+
+    def state(self):
+        return {f"o{k}_{f}": v for k, r in enumerate(self.rocs)
+                for f, v in r.state().items()}
+
+    def load_state(self, d):
+        for k, r in enumerate(self.rocs):
+            r.load_state({f: d[f"o{k}_{f}"] for f in r.state()})
+        return self
+
+    def merge(self, other: "ROCBinary") -> "ROCBinary":
+        for r, o in zip(self.rocs, other.rocs):
+            r.merge(o)
+        return self
+
+    def __init__(self, num_outputs: int, num_thresholds: int = 200):
+        self.n = num_outputs
+        self.num_thresholds = num_thresholds
+        self.rocs = [ROC(num_thresholds) for _ in range(num_outputs)]
+
+    def eval(self, labels, predictions, mask=None):
+        y = _to_np(labels)
+        p = _to_np(predictions)
+        y2 = y.reshape(-1, self.n)
+        p2 = p.reshape(-1, self.n)
+        m2 = None
+        if mask is not None:
+            m = _to_np(mask)
+            if m.shape == y.shape:  # per-output mask
+                m2 = m.reshape(-1, self.n).astype(bool)
+            else:  # per-example/timestep: keep or drop whole rows —
+                # a (B,) mask against (B, T, n) labels broadcasts over T
+                m = m.astype(bool)
+                m = np.broadcast_to(
+                    m.reshape(m.shape + (1,) * (y.ndim - 1 - m.ndim)),
+                    y.shape[:-1])
+                rows = m.reshape(-1)
+                y2, p2 = y2[rows], p2[rows]
+        for k, roc in enumerate(self.rocs):
+            if m2 is not None:
+                keep = m2[:, k]
+                roc.eval(y2[keep, k], p2[keep, k])
+            else:
+                roc.eval(y2[:, k], p2[:, k])
+        return self
+
+    def auc(self, output: int) -> float:
+        return self.rocs[output].auc()
+
+    def auc_pr(self, output: int) -> float:
+        return self.rocs[output].auc_pr()
+
+    def average_auc(self) -> float:
+        return float(np.mean([r.auc() for r in self.rocs]))
+
+    def roc_curve(self, output: int):
+        return self.rocs[output].roc_curve()
+
+    def pr_curve(self, output: int):
+        return self.rocs[output].pr_curve()
+
+    def stats(self) -> str:
+        lines = [f"output {k}: AUC={self.auc(k):.4f} AUPRC={self.auc_pr(k):.4f}"
+                 for k in range(self.n)]
+        lines.append(f"average AUC: {self.average_auc():.4f}")
+        return "\n".join(lines)
 
 
 class ROCMultiClass(_Mergeable):

@@ -52,7 +52,7 @@ def main():
     from deeplearning4j_tpu.eval import (EvaluationBinary,
                                          EvaluationCalibration,
                                          RegressionEvaluation, ROC,
-                                         ROCMultiClass)
+                                         ROCBinary, ROCMultiClass)
 
     def shard_it():
         return ProcessShardIterator(x, y, global_batch_size=16)
@@ -62,6 +62,7 @@ def main():
     ev_roc = tr.evaluate(shard_it(), ROC(num_thresholds=100))
     ev_rocmc = tr.evaluate(shard_it(), ROCMultiClass(3, num_thresholds=100))
     ev_cal = tr.evaluate(shard_it(), EvaluationCalibration(10))
+    ev_rocb = tr.evaluate(shard_it(), ROCBinary(3, num_thresholds=100))
 
     if pid == 0:
         flat = {f"{k}/{k2}": np.asarray(v2)
@@ -71,6 +72,7 @@ def main():
         evals.update({f"roc_{f}": v for f, v in ev_roc.state().items()})
         evals.update({f"rocmc_{f}": v for f, v in ev_rocmc.state().items()})
         evals.update({f"cal_{f}": v for f, v in ev_cal.state().items()})
+        evals.update({f"rocb_{f}": v for f, v in ev_rocb.state().items()})
         np.savez(os.path.join(outdir, "multihost_params.npz"),
                  losses=np.asarray([s for _, s in col.scores]),
                  confusion=ev.confusion, dist_score=np.float64(score),

@@ -281,3 +281,164 @@ class TestMergeProtocol:
     def test_calibration(self):
         self._check(lambda: EvaluationCalibration(10),
                     lambda e: e.expected_calibration_error())
+
+    def test_roc_binary(self):
+        from deeplearning4j_tpu.eval import ROCBinary
+        rng = np.random.RandomState(4)
+        y = (rng.rand(64, 4) > 0.6).astype(np.float32)
+        p = np.clip(0.65 * y + 0.35 * rng.rand(64, 4), 0, 1)
+        whole = ROCBinary(4, num_thresholds=50).eval(y, p)
+        merged = (ROCBinary(4, num_thresholds=50).eval(y[:32], p[:32])
+                  .merge(ROCBinary(4, num_thresholds=50).eval(y[32:], p[32:])))
+        for f, v in whole.state().items():
+            np.testing.assert_allclose(merged.state()[f], v, err_msg=f)
+        rt = ROCBinary(4, num_thresholds=50).load_state(whole.state())
+        np.testing.assert_allclose(rt.average_auc(), whole.average_auc(),
+                                   rtol=1e-12)
+
+
+class TestROCBinary:
+    """ROCBinary.java:28 — per-output ROC/AUC for independent sigmoid
+    outputs, sklearn-oracle checked."""
+
+    def test_matches_sklearn_per_output(self):
+        sk = pytest.importorskip("sklearn.metrics")
+        from deeplearning4j_tpu.eval import ROCBinary
+        rng = np.random.RandomState(0)
+        N, n = 800, 3
+        y = (rng.rand(N, n) > np.array([0.5, 0.8, 0.3])).astype(np.float32)
+        p = np.clip(y * rng.beta(4, 2, (N, n)) +
+                    (1 - y) * rng.beta(2, 4, (N, n)), 0, 1)
+        rb = ROCBinary(n, num_thresholds=0)
+        rb.eval(y[:400], p[:400])
+        rb.eval(y[400:], p[400:])  # streaming accumulation
+        rb_hist = ROCBinary(n)  # DL4J-default 200-bin streaming mode
+        rb_hist.eval(y, p)
+        for k in range(n):
+            ref = sk.roc_auc_score(y[:, k], p[:, k])
+            assert abs(rb.auc(k) - ref) < 1e-6
+            assert abs(rb_hist.auc(k) - ref) < 5e-3
+            ref_pr = sk.average_precision_score(y[:, k], p[:, k])
+            assert abs(rb.auc_pr(k) - ref_pr) < 2e-2  # trapezoid vs step AP
+        assert "AUC" in rb.stats()
+
+    def test_per_output_mask(self):
+        from deeplearning4j_tpu.eval import ROCBinary
+        rng = np.random.RandomState(1)
+        y = (rng.rand(100, 2) > 0.5).astype(np.float32)
+        p = rng.rand(100, 2).astype(np.float32)
+        m = np.ones_like(y)
+        m[:, 1] = 0.0  # output 1 fully masked
+        m[50:, 0] = 0.0  # output 0: only first 50 rows count
+        rb = ROCBinary(2, num_thresholds=0).eval(y, p, mask=m)
+        oracle = ROCBinary(2, num_thresholds=0).eval(y[:50], p[:50])
+        np.testing.assert_allclose(rb.auc(0), oracle.auc(0), rtol=1e-12)
+        assert sum(s.size for s in rb.rocs[1]._scores) == 0  # fully masked
+        # per-example mask drops whole rows
+        rb2 = ROCBinary(2, num_thresholds=0).eval(
+            y, p, mask=(np.arange(100) < 50).astype(np.float32))
+        np.testing.assert_allclose(rb2.auc(0), oracle.auc(0), rtol=1e-12)
+
+    def test_timeseries_shape(self):
+        from deeplearning4j_tpu.eval import ROCBinary
+        rng = np.random.RandomState(2)
+        y = (rng.rand(8, 5, 3) > 0.5).astype(np.float32)
+        p = rng.rand(8, 5, 3).astype(np.float32)
+        rb = ROCBinary(3, num_thresholds=0).eval(y, p)
+        flat = ROCBinary(3, num_thresholds=0).eval(
+            y.reshape(-1, 3), p.reshape(-1, 3))
+        for k in range(3):
+            np.testing.assert_allclose(rb.auc(k), flat.auc(k), rtol=1e-12)
+
+    def test_timeseries_per_example_mask_broadcasts(self):
+        """A (B,) mask against (B, T, n) labels keeps/drops whole examples
+        (broadcast over T), per the docstring contract."""
+        from deeplearning4j_tpu.eval import ROCBinary
+        rng = np.random.RandomState(3)
+        y = (rng.rand(6, 4, 2) > 0.5).astype(np.float32)
+        p = rng.rand(6, 4, 2).astype(np.float32)
+        m = np.array([1, 1, 1, 0, 0, 0], np.float32)
+        rb = ROCBinary(2, num_thresholds=0).eval(y, p, mask=m)
+        oracle = ROCBinary(2, num_thresholds=0).eval(y[:3], p[:3])
+        for k in range(2):
+            np.testing.assert_allclose(rb.auc(k), oracle.auc(k), rtol=1e-12)
+
+
+class TestPredictionMetadata:
+    """eval/meta/Prediction.java — example-level confusion-cell capture."""
+
+    def test_errors_and_lookup(self):
+        from deeplearning4j_tpu.eval import Evaluation, Prediction
+        y = np.eye(3)[[0, 1, 2, 0, 1]]
+        p = np.eye(3)[[0, 2, 2, 1, 1]]  # errors at idx 1 (1->2) and 3 (0->1)
+        ev = Evaluation(3, record_metadata=True)
+        ev.eval(y, p, metadata=["a", "b", "c", "d", "e"])
+        errs = ev.prediction_errors()
+        assert [(e.actual, e.predicted, e.metadata) for e in errs] == [
+            (1, 2, "b"), (0, 1, "d")]
+        assert [pr.metadata for pr in ev.predictions_by_actual_class(0)] == ["a", "d"]
+        assert [pr.metadata for pr in ev.predictions_by_predicted_class(2)] == ["b", "c"]
+        assert isinstance(errs[0], Prediction)
+
+    def test_default_ids_and_merge_roundtrip(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        rng = np.random.RandomState(5)
+        y = np.eye(3)[rng.randint(0, 3, 20)]
+        p = rng.dirichlet(np.ones(3), 20)
+        whole = Evaluation(3, record_metadata=True).eval(y, p)
+        assert [pr.metadata for pr in whole.predictions] == list(range(20))
+        a = Evaluation(3, record_metadata=True).eval(y[:10], p[:10],
+                                                     metadata=range(10))
+        b = Evaluation(3, record_metadata=True).eval(y[10:], p[10:],
+                                                     metadata=range(10, 20))
+        merged = a.merge(b)
+        assert [(pr.actual, pr.predicted, pr.metadata)
+                for pr in merged.predictions] == \
+               [(pr.actual, pr.predicted, pr.metadata)
+                for pr in whole.predictions]
+        assert merged.accuracy() == whole.accuracy()
+        # merging two AUTO-id shards offsets the second shard's running
+        # indices so merged ids == position in the concatenated stream
+        c = Evaluation(3, record_metadata=True).eval(y[:10], p[:10])
+        d = Evaluation(3, record_metadata=True).eval(y[10:], p[10:])
+        cd = c.merge(d)
+        assert [pr.metadata for pr in cd.predictions] == list(range(20))
+        # explicit user ids (even ints) are never rewritten by merge
+        e1 = Evaluation(3, record_metadata=True).eval(
+            y[:10], p[:10], metadata=[100 + i for i in range(10)])
+        e2 = Evaluation(3, record_metadata=True).eval(y[10:], p[10:])
+        mixed = e1.merge(e2)  # explicit + auto
+        assert [pr.metadata for pr in mixed.predictions] == \
+               [100 + i for i in range(10)] + list(range(10, 20))
+        # a shard mixing explicit strings and auto ids merges without error
+        f1 = Evaluation(3, record_metadata=True)
+        f1.eval(y[:5], p[:5], metadata=list("abcde"))
+        f1.eval(y[5:10], p[5:10])  # auto ids 5..9
+        g = Evaluation(3, record_metadata=True).eval(y[10:], p[10:])
+        gm = g.merge(f1)
+        metas = [pr.metadata for pr in gm.predictions]
+        assert metas[:10] == list(range(10)) and metas[10:15] == list("abcde")
+        assert metas[15:] == list(range(15, 20))  # auto ids re-offset
+
+    def test_metadata_length_mismatch_raises(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        y = np.eye(3)[[0, 1, 2, 0]]
+        ev = Evaluation(3, record_metadata=True)
+        with pytest.raises(ValueError, match="one id per example"):
+            ev.eval(y, y, metadata=["a", "b"])  # 2 ids, 4 examples
+        assert ev.predictions == [] and ev.num_examples == 0  # nothing half-recorded
+        # metadata stays out of the numpy state dict (distributed allgather)
+        ev.eval(y, y, metadata=["a", "b", "c", "d"])
+        assert set(ev.state()) == {"confusion", "top_n_correct",
+                                   "top_n_total"}
+
+    def test_timeseries_metadata_expands_with_mask(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        y = np.zeros((2, 3, 2))
+        y[:, :, 0] = 1
+        p = y.copy()
+        m = np.array([[1, 1, 0], [1, 0, 0]], np.float32)
+        ev = Evaluation(2, record_metadata=True)
+        ev.eval(y, p, mask=m, metadata=["s0", "s1"])
+        assert [pr.metadata for pr in ev.predictions] == [
+            ("s0", 0), ("s0", 1), ("s1", 0)]

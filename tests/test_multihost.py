@@ -103,7 +103,7 @@ def test_two_process_training_matches_single_process(tmp_path):
     from deeplearning4j_tpu.eval import (EvaluationBinary,
                                          EvaluationCalibration,
                                          RegressionEvaluation, ROC,
-                                         ROCMultiClass)
+                                         ROCBinary, ROCMultiClass)
 
     singles = {
         "bin": tr.evaluate(_ListIter(), EvaluationBinary(3)),
@@ -111,6 +111,7 @@ def test_two_process_training_matches_single_process(tmp_path):
         "roc": tr.evaluate(_ListIter(), ROC(num_thresholds=100)),
         "rocmc": tr.evaluate(_ListIter(), ROCMultiClass(3, num_thresholds=100)),
         "cal": tr.evaluate(_ListIter(), EvaluationCalibration(10)),
+        "rocb": tr.evaluate(_ListIter(), ROCBinary(3, num_thresholds=100)),
     }
     for prefix, single in singles.items():
         for f, v in single.state().items():
