@@ -131,10 +131,14 @@ class Evaluation(_Mergeable):
         y = _to_np(labels)
         p = _to_np(predictions)
         meta = list(metadata) if metadata is not None else None
-        if meta is not None and len(meta) != y.shape[0]:
-            raise ValueError(
-                f"metadata has {len(meta)} entries for a batch of "
-                f"{y.shape[0]} examples — one id per example required")
+        if meta is not None:
+            if len(meta) != y.shape[0]:
+                raise ValueError(
+                    f"metadata has {len(meta)} entries for a batch of "
+                    f"{y.shape[0]} examples — one id per example required")
+            # explicit ids mean the caller wants capture (the reference's
+            # eval(labels, out, recordMetaData) overload behaves the same)
+            self.record_metadata = True
         if y.ndim == 3:  # time series: flatten with mask
             if mask is not None:
                 m = _to_np(mask).astype(bool).reshape(-1)
@@ -458,31 +462,46 @@ class ROC(_Mergeable):
         return float(np.trapezoid(p, r))
 
 
-class ROCBinary(_Mergeable):
+class _ROCList(_Mergeable):
+    """Shared plumbing for per-output / per-class ROC collections
+    (:class:`ROCBinary`, :class:`ROCMultiClass`): a list of :class:`ROC`
+    accumulators with prefixed flat state dicts, pairwise merge and AUC
+    aggregation. Subclasses own the eval semantics."""
+
+    _key = "o"  # state-dict prefix
+
+    def state(self):
+        return {f"{self._key}{k}_{f}": v for k, r in enumerate(self.rocs)
+                for f, v in r.state().items()}
+
+    def load_state(self, d):
+        for k, r in enumerate(self.rocs):
+            r.load_state({f: d[f"{self._key}{k}_{f}"] for f in r.state()})
+        return self
+
+    def merge(self, other):
+        for r, o in zip(self.rocs, other.rocs):
+            r.merge(o)
+        return self
+
+    def auc(self, i: int) -> float:
+        return self.rocs[i].auc()
+
+    def average_auc(self) -> float:
+        return float(np.mean([r.auc() for r in self.rocs]))
+
+
+class ROCBinary(_ROCList):
     """ROCBinary.java:28 — independent binary ROC/AUC per output column.
 
     For networks with ``n`` independent sigmoid outputs (multi-label):
     per-output ROC/AUC/PR, unlike :class:`EvaluationBinary`'s fixed-threshold
     counts. Accepts (B, n) or time-series (B, T, n); ``mask`` may be
-    per-example (B,)/(B, T) or PER-OUTPUT with the same shape as the labels
-    (DL4J supports per-output masking for multi-label time series)."""
+    per-example (B,)/(B, 1)/(B, T) or PER-OUTPUT with the same shape as the
+    labels (DL4J supports per-output masking for multi-label time series)."""
 
     def new_like(self) -> "ROCBinary":
         return ROCBinary(self.n, self.num_thresholds)
-
-    def state(self):
-        return {f"o{k}_{f}": v for k, r in enumerate(self.rocs)
-                for f, v in r.state().items()}
-
-    def load_state(self, d):
-        for k, r in enumerate(self.rocs):
-            r.load_state({f: d[f"o{k}_{f}"] for f in r.state()})
-        return self
-
-    def merge(self, other: "ROCBinary") -> "ROCBinary":
-        for r, o in zip(self.rocs, other.rocs):
-            r.merge(o)
-        return self
 
     def __init__(self, num_outputs: int, num_thresholds: int = 200):
         self.n = num_outputs
@@ -500,8 +519,11 @@ class ROCBinary(_Mergeable):
             if m.shape == y.shape:  # per-output mask
                 m2 = m.reshape(-1, self.n).astype(bool)
             else:  # per-example/timestep: keep or drop whole rows —
-                # a (B,) mask against (B, T, n) labels broadcasts over T
+                # a (B,) mask against (B, T, n) labels broadcasts over T;
+                # DL4J's column-vector (B, 1) / (B, T, 1) shapes squeeze
                 m = m.astype(bool)
+                while m.ndim > y.ndim - 1 and m.shape[-1] == 1:
+                    m = m[..., 0]
                 m = np.broadcast_to(
                     m.reshape(m.shape + (1,) * (y.ndim - 1 - m.ndim)),
                     y.shape[:-1])
@@ -515,14 +537,8 @@ class ROCBinary(_Mergeable):
                 roc.eval(y2[:, k], p2[:, k])
         return self
 
-    def auc(self, output: int) -> float:
-        return self.rocs[output].auc()
-
     def auc_pr(self, output: int) -> float:
         return self.rocs[output].auc_pr()
-
-    def average_auc(self) -> float:
-        return float(np.mean([r.auc() for r in self.rocs]))
 
     def roc_curve(self, output: int):
         return self.rocs[output].roc_curve()
@@ -537,26 +553,14 @@ class ROCBinary(_Mergeable):
         return "\n".join(lines)
 
 
-class ROCMultiClass(_Mergeable):
+class ROCMultiClass(_ROCList):
     """ROCMultiClass.java — one-vs-all ROC per class."""
+
+    _key = "c"
 
     def new_like(self) -> "ROCMultiClass":
         return ROCMultiClass(len(self.rocs), self.rocs[0].num_thresholds
                              if self.rocs else 200)
-
-    def state(self):
-        return {f"c{k}_{f}": v for k, r in enumerate(self.rocs)
-                for f, v in r.state().items()}
-
-    def load_state(self, d):
-        for k, r in enumerate(self.rocs):
-            r.load_state({f: d[f"c{k}_{f}"] for f in r.state()})
-        return self
-
-    def merge(self, other: "ROCMultiClass") -> "ROCMultiClass":
-        for r, o in zip(self.rocs, other.rocs):
-            r.merge(o)
-        return self
 
     def __init__(self, num_classes: int, num_thresholds: int = 200):
         self.rocs = [ROC(num_thresholds) for _ in range(num_classes)]
@@ -572,12 +576,6 @@ class ROCMultiClass(_Mergeable):
         for k, roc in enumerate(self.rocs):
             roc.eval(y2[:, k], p2[:, k])
         return self
-
-    def auc(self, cls: int) -> float:
-        return self.rocs[cls].auc()
-
-    def average_auc(self) -> float:
-        return float(np.mean([r.auc() for r in self.rocs]))
 
 
 class EvaluationCalibration(_Mergeable):

@@ -415,12 +415,14 @@ class MultiHostTrainer:
             model, tx, mesh, accum, lambda: activation_sharding(mesh),
             p_sh, o_sh, repl)
 
-    def _global_batch(self, ds):
+    def _global_batch(self, ds, features_only: bool = False):
         """Assemble global sharded arrays from this process's local rows
         (no host gather; remote shards stay remote). Masks included when set.
         The global row count comes from data-axis COVERAGE, not process
         count: tp/sp peer processes supply duplicate rows of the same data
-        block (see ``data_shard``)."""
+        block (see ``data_shard``). ``features_only`` skips the label
+        arrays (evaluate consumes labels host-side — for LM eval the
+        one-hot labels are the largest tensor in the batch)."""
         coords, dp = self._dp_coverage()
         mult = dp // len(coords)  # 1 in single-process mode (covers all)
 
@@ -431,8 +433,10 @@ class MultiHostTrainer:
             gshape = (local.shape[0] * mult,) + local.shape[1:]
             return jax.make_array_from_process_local_data(self._batch_sh, local, gshape)
 
-        return (put(ds.features), put(ds.labels),
-                put(ds.features_mask), put(ds.labels_mask))
+        return (put(ds.features),
+                None if features_only else put(ds.labels),
+                put(ds.features_mask),
+                None if features_only else put(ds.labels_mask))
 
     # --- fit (executeTraining :493 / ParameterAveragingTrainingMaster fit) ---
     def fit(self, iterator: Iterable, epochs: int = 1,
@@ -545,21 +549,66 @@ class MultiHostTrainer:
             iterator.reset()
         return total / max(n_batches, 1)
 
-    def evaluate(self, iterator, evaluation=None):
+    def _is_primary(self) -> bool:
+        """True for the one process per data block that accumulates metrics:
+        tp/sp peer processes feed DUPLICATE rows of the same data block
+        (``data_shard``), so only the process owning its block's device at
+        the non-data-axes origin counts them — anything else double-counts
+        every example ``mult`` times in the merged metrics."""
+        coords, _ = self._dp_coverage()
+        names = list(self.mesh.axis_names)
+        idx = [0] * len(names)
+        if DATA_AXIS in names:
+            idx[names.index(DATA_AXIS)] = coords[0]
+        return self.mesh.devices[tuple(idx)] in set(jax.local_devices())
+
+    def _needs_global_mesh_eval(self) -> bool:
+        """rules-sharded params can't be gathered onto one device, and
+        mesh-aware layers (ring attention) need the ambient mesh to keep
+        their sequence-parallel path at eval time. encoded_gradients has no
+        placed params (replicated worker copies on a pure-dp mesh, where
+        ring falls back to dense anyway) — always mesh-free there."""
+        if self.mode == "encoded_gradients":
+            return False
+        if self.rules:
+            return True
+        specs = (self.model.layers if isinstance(self.model, Sequential)
+                 else [self.model.nodes[n].spec
+                       for n in self.model.topo_order
+                       if self.model.nodes[n].is_layer()])
+        return any(getattr(l, "ring", False) for l in specs)
+
+    def evaluate(self, iterator, evaluation=None,
+                 global_mesh: Optional[bool] = None):
         """Distributed evaluation for ANY mergeable evaluation type
         (dl4j-spark parity: each executor evaluates its partition, the
         driver reduces — ``IEvaluateFlatMapFunction.java`` +
         ``IEvaluationReduceFunction.java``). Each process forwards its LOCAL
-        shard rows on its own devices and accumulates into a fresh instance;
-        the per-process accumulator dicts merge with one tiny all-gather.
+        shard rows, accumulates into a fresh instance, and the per-process
+        accumulator dicts merge with one tiny all-gather.
         Works for Evaluation / EvaluationBinary / RegressionEvaluation /
         ROC (histogram mode) / ROCBinary / ROCMultiClass /
         EvaluationCalibration — any
         object implementing the ``_Mergeable`` protocol (new_like / state /
-        load_state / merge)."""
+        load_state / merge).
+
+        ``global_mesh``: route forwards through the SAME mesh/rules program
+        as training — required for rules-sharded params (they never fit one
+        device) and for ring=True models (the mesh-free forward would
+        silently fall back to full O(T²) single-device attention and OOM at
+        exactly the sizes ring exists for). Default: auto — on when
+        ``rules`` are set or a layer is mesh-aware; the mesh-free path
+        stays the default for small replicated models (no collectives in
+        the forward).
+
+        Feeding contracts differ: the GLOBAL-MESH path assembles global
+        batches, so feed per ``data_shard()`` (tp/sp peers supply duplicate
+        rows; only the primary process per data block accumulates — no
+        double counting). The MESH-FREE path forwards local arrays with no
+        global assembly: every process feeds DISTINCT rows and every
+        process accumulates."""
         from ..train.trainer import default_evaluation, make_infer_fn
 
-        self._sync_model()
         if evaluation is None:
             evaluation = default_evaluation(self.model)
         for attr in ("new_like", "state", "load_state", "merge", "eval"):
@@ -568,28 +617,52 @@ class MultiHostTrainer:
                     f"distributed evaluate requires a mergeable evaluation "
                     f"(new_like/state/load_state/merge); "
                     f"{type(evaluation).__name__} lacks .{attr}")
-
-        if not hasattr(self, "_infer_fn") or self._infer_fn is None:
-            # NO mesh here: evaluate forwards each process's LOCAL shard on
-            # its own devices (then merges accumulators) — constraining those
-            # local arrays onto the process-spanning mesh would turn them
-            # into non-addressable global arrays. Consequence: mesh-aware
-            # layers (ring=True) take their single-device fallback during
-            # multi-host evaluate; use score_iterator (global-mesh path) when
-            # the model is too big for one device.
-            self._infer_fn = make_infer_fn(self.model)  # cache across calls
+        if global_mesh is None:
+            global_mesh = self._needs_global_mesh_eval()
 
         # accumulate THIS call's counts into a fresh instance so a
         # pre-populated evaluation is never re-summed x process_count
         local = evaluation.new_like()
-        params = jax.device_put(self.model.params)  # host->device once
-        state = jax.device_put(self.model.state)
-        for ds in iterator:
-            preds = self._infer_fn(
-                params, state, jnp.asarray(np.asarray(ds.features)),
-                (jnp.asarray(np.asarray(ds.features_mask))
-                 if ds.features_mask is not None else None))
-            local.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
+        if global_mesh:
+            if self.mode == "encoded_gradients":
+                raise ValueError("global_mesh evaluate needs the "
+                                 "shared_gradients placed params")
+            if getattr(self, "_mesh_infer_fn", None) is None:
+                self._mesh_infer_fn = make_infer_fn(
+                    self.model, self.mesh, out_sharding=self._batch_sh)
+            # tp/sp peer processes feed duplicate rows of the same data
+            # block (data_shard contract) — only the primary per block
+            # accumulates, or every example counts mult times
+            primary = self._is_primary()
+            for ds in iterator:
+                x, _, mask, _ = self._global_batch(ds, features_only=True)
+                preds = self._mesh_infer_fn(self.params, self.state, x, mask)
+                if primary:
+                    # this process's rows: its addressable dp shards, in
+                    # global row order (deduped — model/seq-axis replication
+                    # gives every local device a copy of the same rows)
+                    by_start = {
+                        (s.index[0].start or 0): np.asarray(s.data)
+                        for s in preds.addressable_shards}
+                    p_local = np.concatenate(
+                        [by_start[k] for k in sorted(by_start)], axis=0)
+                    local.eval(ds.labels, p_local, mask=ds.labels_mask)
+        else:
+            # NO mesh: each process forwards its LOCAL shard on its own
+            # devices — constraining those local arrays onto the
+            # process-spanning mesh would make them non-addressable. Every
+            # process feeds DISTINCT rows and every process accumulates.
+            self._sync_model()
+            if not hasattr(self, "_infer_fn") or self._infer_fn is None:
+                self._infer_fn = make_infer_fn(self.model)  # cache
+            params = jax.device_put(self.model.params)  # host->device once
+            state = jax.device_put(self.model.state)
+            for ds in iterator:
+                preds = self._infer_fn(
+                    params, state, jnp.asarray(np.asarray(ds.features)),
+                    (jnp.asarray(np.asarray(ds.features_mask))
+                     if ds.features_mask is not None else None))
+                local.eval(ds.labels, np.asarray(preds), mask=ds.labels_mask)
         if hasattr(iterator, "reset"):
             iterator.reset()
 

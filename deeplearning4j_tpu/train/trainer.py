@@ -91,16 +91,19 @@ def make_score_fn(model, mesh=None):
     return score
 
 
-def make_infer_fn(model, mesh=None):
+def make_infer_fn(model, mesh=None, out_sharding=None):
     """One jitted ``(params, state, x, mask) -> primary output`` forward for
     a model (Sequential or Graph, masks threaded either way) — shared by the
     evaluate paths of Trainer / ParallelWrapper / MultiHostTrainer. ``mesh``:
     see make_score_fn — without it a ring=True model would silently fall
-    back to dense O(T^2) attention during evaluation."""
+    back to dense O(T^2) attention during evaluation. ``out_sharding`` pins
+    the output placement (the global-mesh evaluate path pins predictions
+    dp-sharded so every process can read back exactly its own rows)."""
     seq = isinstance(model, Sequential)
     ctx = _mesh_ctx(mesh)
 
-    @jax.jit
+    @partial(jax.jit, **({"out_shardings": out_sharding}
+                         if out_sharding is not None else {}))
     def infer(params, state, x, mask=None):
         with ctx():
             if seq:

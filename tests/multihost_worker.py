@@ -13,6 +13,10 @@ import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("XLA_FLAGS", None)  # exactly 1 local CPU device per process
+if len(sys.argv) > 5 and sys.argv[5] == "ringeval":
+    # ringeval: 2 devices per process x 4 processes = the 8-device
+    # dp2 x tp2 x sp2 process-spanning mesh
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
 
 def main():
@@ -32,6 +36,8 @@ def main():
         return scale4(pid, nprocs, outdir)
     if mode == "orbax2":
         return orbax2(pid, nprocs, outdir)
+    if mode == "ringeval":
+        return ringeval(pid, nprocs, outdir)
     import numpy as np
 
     from deeplearning4j_tpu.train.listeners import CollectScoresListener
@@ -193,6 +199,50 @@ def orbax2(pid, nprocs, outdir):
                     flat[f"{tag}/{k}/{k2}"] = np.asarray(v2)
         np.savez(os.path.join(outdir, "orbax2.npz"), **flat)
     print(f"worker {pid} orbax2 done", flush=True)
+
+
+def ringeval(pid, nprocs, outdir):
+    """r4 VERDICT #7: ring=True CausalLM evaluated through the GLOBAL-MESH
+    evaluate path on a process-spanning dp2 x tp2 x sp2 mesh (2 devices per
+    process x 4 processes). Merged metrics must equal a single-process
+    evaluation of the same seed-identical model. tp/sp peer processes feed
+    DUPLICATE rows of their data block (data_shard contract) — primary-only
+    accumulation must dedupe them, or every example counts twice."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.models import CausalLM
+    from deeplearning4j_tpu.parallel import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS,
+                                             MultiHostTrainer,
+                                             ProcessShardIterator,
+                                             TRANSFORMER_RULES, make_mesh)
+
+    x, y1h, V = make_lm_data()
+    net = CausalLM(seed=11, input_shape=(16,), num_layers=2, d_model=32,
+                   num_heads=2, vocab=V, ring=True).build()
+    mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2, SEQ_AXIS: 2},
+                     jax.devices())
+    tr = MultiHostTrainer(net, mesh=mesh, seed=0, rules=TRANSFORMER_RULES)
+    assert tr._needs_global_mesh_eval()  # rules + ring force the mesh path
+    sh, ns = tr.data_shard()  # tp/sp peers feed the SAME data-block rows
+    ev = tr.evaluate(
+        ProcessShardIterator(x, y1h, global_batch_size=8,
+                             process_id=sh, num_processes=ns),
+        Evaluation(V))
+    if pid == 0:
+        np.savez(os.path.join(outdir, "ringeval.npz"), confusion=ev.confusion)
+    print(f"worker {pid} ringeval done", flush=True)
+
+
+def make_lm_data():
+    import numpy as np
+
+    rng = np.random.RandomState(9)
+    V = 32
+    x = rng.randint(0, V, (16, 16)).astype(np.int32)
+    y = np.eye(V, dtype=np.float32)[np.roll(x, -1, axis=1)]
+    return x, y, V
 
 
 def make_seq_data():

@@ -338,6 +338,10 @@ class TestROCBinary:
         rb2 = ROCBinary(2, num_thresholds=0).eval(
             y, p, mask=(np.arange(100) < 50).astype(np.float32))
         np.testing.assert_allclose(rb2.auc(0), oracle.auc(0), rtol=1e-12)
+        # DL4J's column-vector (B, 1) per-example mask squeezes
+        rb3 = ROCBinary(2, num_thresholds=0).eval(
+            y, p, mask=(np.arange(100) < 50).astype(np.float32)[:, None])
+        np.testing.assert_allclose(rb3.auc(0), oracle.auc(0), rtol=1e-12)
 
     def test_timeseries_shape(self):
         from deeplearning4j_tpu.eval import ROCBinary
@@ -419,6 +423,16 @@ class TestPredictionMetadata:
         metas = [pr.metadata for pr in gm.predictions]
         assert metas[:10] == list(range(10)) and metas[10:15] == list("abcde")
         assert metas[15:] == list(range(15, 20))  # auto ids re-offset
+
+    def test_explicit_metadata_auto_enables_capture(self):
+        """eval(..., metadata=ids) on a default-constructed Evaluation
+        captures predictions (the reference's recordMetaData overload) —
+        silently dropping explicitly passed ids would hide the mistake."""
+        from deeplearning4j_tpu.eval import Evaluation
+        y = np.eye(3)[[0, 1, 2]]
+        ev = Evaluation(3)  # record_metadata NOT set
+        ev.eval(y, y, metadata=["a", "b", "c"])
+        assert [pr.metadata for pr in ev.predictions] == ["a", "b", "c"]
 
     def test_metadata_length_mismatch_raises(self):
         from deeplearning4j_tpu.eval import Evaluation
