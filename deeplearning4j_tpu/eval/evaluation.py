@@ -87,10 +87,12 @@ class Evaluation(_Mergeable):
 
     Accepts (B, K) batches or time-series (B, T, K) with optional (B, T) mask.
 
-    ``record_metadata=True`` captures a :class:`Prediction` per example
-    (Evaluation.java's RecordMetaData path): pass per-example ids via
-    ``eval(..., metadata=[...])`` (defaults to the running example index),
-    then inspect with :meth:`prediction_errors` /
+    ``record_metadata=True`` captures a :class:`Prediction` per example on
+    EVERY eval call (auto-numbering batches without ids); passing
+    ``eval(..., metadata=[...])`` captures that batch regardless — the
+    reference's ``eval(labels, out, recordMetaData)`` overload records
+    exactly the batches that supply ids. Inspect with
+    :meth:`prediction_errors` /
     :meth:`predictions_by_actual_class` / :meth:`predictions_by_predicted_class`.
     Predictions merge by concatenation; they ride along ``merge()`` but are
     NOT part of the numpy ``state()`` dict (the distributed allgather path
@@ -131,14 +133,15 @@ class Evaluation(_Mergeable):
         y = _to_np(labels)
         p = _to_np(predictions)
         meta = list(metadata) if metadata is not None else None
-        if meta is not None:
-            if len(meta) != y.shape[0]:
-                raise ValueError(
-                    f"metadata has {len(meta)} entries for a batch of "
-                    f"{y.shape[0]} examples — one id per example required")
-            # explicit ids mean the caller wants capture (the reference's
-            # eval(labels, out, recordMetaData) overload behaves the same)
-            self.record_metadata = True
+        if meta is not None and len(meta) != y.shape[0]:
+            raise ValueError(
+                f"metadata has {len(meta)} entries for a batch of "
+                f"{y.shape[0]} examples — one id per example required")
+        # explicit ids capture THIS batch (the reference's
+        # eval(labels, out, recordMetaData) overload records exactly the
+        # batches that supply ids); record_metadata=True captures every
+        # batch, auto-numbering the ones without ids
+        capture = self.record_metadata or meta is not None
         if y.ndim == 3:  # time series: flatten with mask
             if mask is not None:
                 m = _to_np(mask).astype(bool).reshape(-1)
@@ -153,7 +156,7 @@ class Evaluation(_Mergeable):
         yi = y.argmax(-1)
         pi = p.argmax(-1)
         np.add.at(self.confusion, (yi, pi), 1)
-        if self.record_metadata:
+        if capture:
             base = len(self.predictions)
             if meta is None:
                 meta = [_AutoId(i) for i in range(base, base + len(yi))]

@@ -6,10 +6,12 @@ from .keras_import import (InvalidKerasConfigurationException,
                            import_keras_model_and_weights,
                            import_keras_sequential_model_and_weights)
 from .guesser import guess_model_format, load_model_guess
+from .pretrained import convert_keras_application
 
 __all__ = [
     "InvalidKerasConfigurationException", "KerasHdf5Archive",
-    "UnsupportedKerasConfigurationException", "import_keras_model_and_weights",
+    "UnsupportedKerasConfigurationException", "convert_keras_application",
+    "import_keras_model_and_weights",
     "import_keras_sequential_model_and_weights", "guess_model_format",
     "load_model_guess",
 ]

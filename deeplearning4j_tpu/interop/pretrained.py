@@ -1,0 +1,99 @@
+"""Pretrained zoo weights via the Keras bridge (ZooModel.java:51-81 parity).
+
+The reference ships trained ImageNet weights from its CDN with checksum
+validation (``ZooModel.initPretrained`` downloads, md5-checks, deletes on
+corruption — ZooModel.java:54-66; per-model URLs e.g. ResNet50.java:54-66).
+The TPU-native pipeline replaces the CDN with the (golden-tested) Keras
+importer: ``keras.applications`` weights convert through
+``import_keras_model_and_weights`` into the standard checkpoint zip,
+publish into the zoo cache with a recorded sha256, and
+``ZooModel.init_pretrained()`` serves + verifies the checksum on load.
+
+On an egress-less machine the conversion needs a warm ``~/.keras`` weight
+cache; everything downstream of the download (conversion, checksum,
+serve, logits parity vs Keras) is exercised by ``tests/test_pretrained.py``
+with Keras-initialized weights — the identical path trained weights ride.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from pathlib import Path
+
+# zoo name -> keras.applications factory attribute
+KERAS_APPLICATIONS = {
+    "vgg16": "VGG16",
+    "vgg19": "VGG19",
+    "resnet50": "ResNet50",
+}
+
+
+def sha256_of(path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_checksum(path) -> Path:
+    """Record ``<zip>.sha256`` next to a published checkpoint (the cache's
+    integrity sidecar — the reference embeds expected md5s in each zoo
+    class, ZooModel.java:62)."""
+    side = Path(str(path) + ".sha256")
+    side.write_text(sha256_of(path) + "\n")
+    return side
+
+
+def verify_checksum(path) -> bool:
+    """True if no sidecar exists (nothing to verify) or the digest matches;
+    raises ``OSError`` on mismatch (mirroring the reference's
+    delete-and-fail on a corrupt download)."""
+    side = Path(str(path) + ".sha256")
+    if not side.exists():
+        return True
+    expected = side.read_text().strip()
+    actual = sha256_of(path)
+    if actual != expected:
+        raise OSError(
+            f"pretrained checkpoint {path} is corrupt: sha256 {actual} != "
+            f"recorded {expected} — delete it and re-run the conversion "
+            f"(interop.pretrained.convert_keras_application)")
+    return True
+
+
+def convert_keras_application(name: str, *, weights: str = "imagenet",
+                              pretrained_type: str = "imagenet",
+                              classes: int = 1000, keras_model=None):
+    """Convert a ``keras.applications`` network into this zoo entry's
+    pretrained checkpoint zip: build the Keras model (downloading its
+    weights when ``weights='imagenet'`` and egress/cache allow), run it
+    through the Keras importer, publish via ``save_pretrained`` and record
+    the sha256. Returns the checkpoint path.
+
+    ``keras_model`` supplies a prebuilt Keras network (skipping the
+    factory); ``weights=None`` converts the Keras-initialized network —
+    the golden tests use both to prove the pipeline end-to-end without
+    egress."""
+    import tempfile
+
+    from ..models.zoo import model_by_name
+    from .keras_import import import_keras_model_and_weights
+
+    key = name.lower()
+    if key not in KERAS_APPLICATIONS:
+        raise ValueError(
+            f"No keras.applications mapping for zoo model '{name}'; "
+            f"available: {sorted(KERAS_APPLICATIONS)}")
+    km = keras_model
+    if km is None:
+        import keras
+
+        factory = getattr(keras.applications, KERAS_APPLICATIONS[key])
+        km = factory(weights=weights, classes=classes)
+    with tempfile.TemporaryDirectory() as d:
+        h5 = str(Path(d) / f"{key}.h5")
+        km.save(h5)
+        net = import_keras_model_and_weights(h5)
+    zoo = model_by_name(key)
+    return zoo.save_pretrained(net, pretrained_type)

@@ -57,29 +57,62 @@ class ZooModel:
         (ZooModel.pretrainedUrl)."""
         return CACHE_DIR / f"{type(self).__name__.lower()}_{pretrained_type}.zip"
 
-    def init_pretrained(self, pretrained_type: str = "imagenet"):
-        """initPretrained(PretrainedType) — local cache only (zero egress)."""
+    def init_pretrained(self, pretrained_type: str = "imagenet",
+                        auto_convert: bool = True):
+        """initPretrained(PretrainedType) parity (ZooModel.java:51-81): load
+        this entry's checkpoint from the cache, verifying the recorded
+        sha256 (the reference md5-checks its CDN download and deletes on
+        corruption). On a cache miss, ``auto_convert`` runs the
+        keras.applications bridge (interop.pretrained) when this model has
+        a mapping — that downloads the Keras weights where egress (or a
+        warm ~/.keras cache) allows, converts through the golden-tested
+        Keras importer, and publishes into the cache."""
         path = self.pretrained_path(pretrained_type)
+        # auto-convert only for weight sets Keras can actually supply —
+        # other PretrainedTypes (mnist/cifar10/vggface) have no
+        # keras.applications source and must come from save_pretrained
+        if not path.exists() and auto_convert and pretrained_type == "imagenet":
+            from ..interop.pretrained import (KERAS_APPLICATIONS,
+                                              convert_keras_application)
+
+            name = type(self).__name__.lower()
+            if name in KERAS_APPLICATIONS:
+                try:
+                    convert_keras_application(name, weights=pretrained_type,
+                                              pretrained_type=pretrained_type)
+                except Exception as e:
+                    raise FileNotFoundError(
+                        f"No cached pretrained weights at {path}, and the "
+                        f"keras.applications conversion failed "
+                        f"({type(e).__name__}: {str(e)[:200]}). On an "
+                        f"egress-less machine, warm ~/.keras/models first or "
+                        f"copy a converted zip into the cache.") from e
         if not path.exists():
             raise FileNotFoundError(
                 f"No cached pretrained weights at {path}. The reference downloads "
                 f"from a CDN (ZooModel.java:54-66); this environment has no egress — "
-                f"produce the zip with save_pretrained() (e.g. from a Keras import) "
-                f"to use pretrained weights.")
+                f"produce the zip with save_pretrained() or "
+                f"interop.pretrained.convert_keras_application() to use "
+                f"pretrained weights.")
+        from ..interop.pretrained import verify_checksum
         from ..train.serialization import load_model
 
+        verify_checksum(path)
         model, *_ = load_model(str(path))  # populates model.params/state
         return model
 
     def save_pretrained(self, model, pretrained_type: str = "imagenet") -> Path:
         """Publish `model`'s weights as this zoo entry's pretrained
-        checkpoint — the producer side the reference lacks locally (its zips
-        come only from the CDN). Round-trips with init_pretrained."""
+        checkpoint (+ sha256 sidecar) — the producer side the reference
+        lacks locally (its zips come only from the CDN). Round-trips with
+        init_pretrained."""
         path = self.pretrained_path(pretrained_type)
         path.parent.mkdir(parents=True, exist_ok=True)
+        from ..interop.pretrained import write_checksum
         from ..train.serialization import save_model
 
         save_model(str(path), model, params=model.params, state=model.state)
+        write_checksum(path)
         return path
 
 

@@ -460,38 +460,63 @@ class Upsampling1D(Layer):
 @register_layer
 @dataclass(frozen=True)
 class ZeroPadding2D(Layer):
-    """ZeroPaddingLayer.java — (top, bottom, left, right)."""
+    """ZeroPaddingLayer.java — (top, bottom, left, right); a scalar or an
+    (h, w) pair means symmetric padding per axis (Keras-import shapes)."""
 
     padding: Sequence[int] = (1, 1, 1, 1)
+
+    def _pad4(self):
+        p = ((int(self.padding),) if isinstance(self.padding, int)
+             else tuple(int(v) for v in self.padding))
+        if len(p) == 1:
+            p = p * 2
+        if len(p) == 2:
+            p = (p[0], p[0], p[1], p[1])
+        if len(p) != 4:
+            raise ValueError(f"ZeroPadding2D padding {self.padding!r}: "
+                             f"expected scalar, (h, w) or (t, b, l, r)")
+        return p
 
     def has_params(self):
         return False
 
     def output_shape(self, input_shape: Shape) -> Shape:
         h, w, c = input_shape
-        t, b, l, r = self.padding
+        t, b, l, r = self._pad4()
         return (h + t + b, w + l + r, c)
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
-        t, b, l, r = self.padding
+        t, b, l, r = self._pad4()
         return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state, mask
 
 
 @register_layer
 @dataclass(frozen=True)
 class ZeroPadding1D(Layer):
+    """Scalar padding means symmetric (left == right)."""
+
     padding: Sequence[int] = (1, 1)
+
+    def _pad2(self):
+        p = ((int(self.padding),) if isinstance(self.padding, int)
+             else tuple(int(v) for v in self.padding))
+        if len(p) == 1:
+            p = p * 2
+        if len(p) != 2:
+            raise ValueError(f"ZeroPadding1D padding {self.padding!r}: "
+                             f"expected scalar or (left, right)")
+        return p
 
     def has_params(self):
         return False
 
     def output_shape(self, input_shape: Shape) -> Shape:
         t, c = input_shape
-        l, r = self.padding
+        l, r = self._pad2()
         return (t + l + r, c)
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
-        l, r = self.padding
+        l, r = self._pad2()
         return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state, mask
 
 

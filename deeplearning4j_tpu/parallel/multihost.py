@@ -619,6 +619,22 @@ class MultiHostTrainer:
                     f"{type(evaluation).__name__} lacks .{attr}")
         if global_mesh is None:
             global_mesh = self._needs_global_mesh_eval()
+            if global_mesh and jax.process_count() > 1:
+                coords, dp = self._dp_coverage()
+                if dp // max(len(coords), 1) > 1:
+                    import warnings
+
+                    # tp/sp peer processes exist: the global-mesh path needs
+                    # the data_shard() feeding contract (peers supply the
+                    # SAME rows, like fit); a caller still feeding distinct
+                    # rows per process_index gets silently wrong metrics
+                    warnings.warn(
+                        "evaluate() auto-routed through the global-mesh "
+                        "program (rules/ring model): feed each process per "
+                        "data_shard() — tp/sp peers must supply the SAME "
+                        "data-block rows, exactly as for fit(). Pass "
+                        "global_mesh=False to force the mesh-free "
+                        "local-shard path.", stacklevel=2)
 
         # accumulate THIS call's counts into a fresh instance so a
         # pre-populated evaluation is never re-summed x process_count
