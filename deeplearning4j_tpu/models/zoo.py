@@ -67,7 +67,17 @@ class ZooModel:
         a mapping — that downloads the Keras weights where egress (or a
         warm ~/.keras cache) allows, converts through the golden-tested
         Keras importer, and publishes into the cache."""
+        from ..interop.pretrained import verify_checksum
+
         path = self.pretrained_path(pretrained_type)
+        if path.exists():
+            try:
+                verify_checksum(path)
+            except OSError:
+                # reference parity (ZooModel.java:62-66): a corrupt cached
+                # download is DELETED so the next step can re-fetch/convert
+                path.unlink(missing_ok=True)
+                Path(str(path) + ".sha256").unlink(missing_ok=True)
         # auto-convert only for weight sets Keras can actually supply —
         # other PretrainedTypes (mnist/cifar10/vggface) have no
         # keras.applications source and must come from save_pretrained

@@ -278,15 +278,32 @@ class TestCJKLexicons:
 
 
 class TestCJKSegmentationQuality:
-    """Measured segmentation quality with an asserted floor (r3 VERDICT #8) —
-    the reference's vendored analyzers (ansj, Kuromoji) were corpus-validated
-    upstream; this harness gives the lexicon-driven max-match path the same
-    treatment: word-boundary P/R/F1 (SIGHAN scoring) against small gold
-    corpora in tests/data/. The corpora are development sets — failures
-    observed here drove the core-lexicon growth (cjk_lexicon.py), and words
-    deliberately left OOV (转动, 越来越, 深刻, ...) keep the floor honest.
+    """Measured segmentation quality with HONEST floors (r4 VERDICT #6 —
+    the r3 harness was self-referential: ~20 builder-authored sentences
+    whose vocabulary overlapped the lexicons scored zh 0.965/ja 0.988/
+    ko 1.0; re-measured on the r3 sets' independence-fixed replacements,
+    the r3 430-word zh lexicon actually scores F1 0.35).
 
-    Measured at r3 (max-match): zh F1 0.965, ja F1 0.988, ko F1 1.0."""
+    The r4 harness (word-boundary P/R/F1, SIGHAN scoring convention):
+
+    - zh: 188 naturalistic sentences authored raw, segmented into gold by
+      JIEBA (an independent analyzer with its own 350k-entry dictionary;
+      tests/data/cjk_raw_zh.txt documents the provenance) — so the score
+      is agreement-with-jieba, the standard proxy when no bakeoff corpus
+      is available offline. Lexicon grown from jieba's frequency list
+      (430 -> 100k words, scripts/grow_cjk_lexicon.py).
+      Measured r4: max-match 0.868, unigram-Viterbi 0.886.
+    - ja: 102 hand-segmented sentences (no JP analyzer/dictionary exists
+      offline), authored before the lexicon growth and never tuned on;
+      convention documented in the file header. Lexicon 300 -> ~1.3k.
+      Measured r4: 0.717 (the honest number for a 1.3k-word max-match
+      segmenter; the r3 0.988 was circular).
+    - ko: 60 sentences with MORPHEME-level gold (josa particles split,
+      OpenKoreanText-style — the r3 eojeol gold was trivially 1.0 by
+      construction). Measured r4: particle-splitting mode 0.95; plain
+      eojeol mode 0.48 against the same gold.
+
+    Floors assert measured-minus-margin so regressions fail, not targets."""
 
     @staticmethod
     def _gold(name):
@@ -294,7 +311,8 @@ class TestCJKSegmentationQuality:
 
         path = os.path.join(os.path.dirname(__file__), "data", name)
         with open(path, encoding="utf-8") as f:
-            return [line.split() for line in f if line.strip()]
+            return [line.split() for line in f
+                    if line.strip() and not line.startswith("#")]
 
     def test_chinese_max_match_floor(self):
         from deeplearning4j_tpu.nlp.cjk import (MaxMatchTokenizerFactory,
@@ -303,8 +321,21 @@ class TestCJKSegmentationQuality:
 
         s = segmentation_scores(MaxMatchTokenizerFactory(CHINESE_CORE),
                                 self._gold("cjk_gold_zh.txt"))
-        assert s["f1"] >= 0.93, s
-        assert s["gold_words"] >= 150  # corpus didn't silently shrink
+        assert s["f1"] >= 0.85, s
+        assert s["gold_words"] >= 1900  # corpus didn't silently shrink
+
+    def test_chinese_unigram_viterbi_beats_maxmatch(self):
+        from deeplearning4j_tpu.nlp.cjk import (MaxMatchTokenizerFactory,
+                                                UnigramTokenizerFactory,
+                                                segmentation_scores)
+        from deeplearning4j_tpu.nlp.cjk_lexicon import (CHINESE_CORE,
+                                                        CHINESE_FREQS)
+
+        gold = self._gold("cjk_gold_zh.txt")
+        uni = segmentation_scores(UnigramTokenizerFactory(CHINESE_FREQS), gold)
+        mm = segmentation_scores(MaxMatchTokenizerFactory(CHINESE_CORE), gold)
+        assert uni["f1"] >= 0.87, uni
+        assert uni["f1"] >= mm["f1"], (uni, mm)  # freqs must not hurt
 
     def test_japanese_max_match_floor(self):
         from deeplearning4j_tpu.nlp.cjk import (MaxMatchTokenizerFactory,
@@ -313,25 +344,29 @@ class TestCJKSegmentationQuality:
 
         s = segmentation_scores(MaxMatchTokenizerFactory(JAPANESE_CORE),
                                 self._gold("cjk_gold_ja.txt"))
-        assert s["f1"] >= 0.95, s
+        assert s["f1"] >= 0.70, s  # honest 1.3k-lexicon number (r4: 0.717)
+        assert s["gold_words"] >= 1000
 
-    def test_korean_eojeol_floor(self):
+    def test_korean_morpheme_floor(self):
         from deeplearning4j_tpu.nlp.cjk import (KoreanTokenizerFactory,
                                                 segmentation_scores)
 
         factory = KoreanTokenizerFactory()
         if factory._engine is not None:
-            pytest.skip("konlpy active: engine segments morphemes, not the "
-                        "eojeol units this gold corpus scores")
-        s = segmentation_scores(factory, self._gold("cjk_gold_ko.txt"),
-                                sep=" ")
-        assert s["f1"] >= 0.99, s
+            pytest.skip("konlpy active: engine conventions differ from the "
+                        "suffix-splitting gold")
+        gold = self._gold("cjk_gold_ko.txt")
+        s = segmentation_scores(factory, gold, sep=" ")
+        assert s["f1"] >= 0.93, s  # r4 measured: 0.95
+        # eojeol mode scores FAR lower against morpheme gold — recorded so
+        # the gap (what a real analyzer adds) stays visible
+        e = segmentation_scores(KoreanTokenizerFactory(split_particles=False),
+                                gold, sep=" ")
+        assert e["f1"] < 0.6, e
 
     def test_factory_path_floor(self):
-        """The user-facing factories (engine when importable, else
-        max-match) must clear a floor too — an engine with different
-        conventions (e.g. jieba) may score lower than our lexicon-tuned
-        max-match, but must stay in the same quality band."""
+        """The user-facing factories (engine when importable, else the
+        dictionary fallback) must clear the same honest floors."""
         from deeplearning4j_tpu.nlp.cjk import (ChineseTokenizerFactory,
                                                 JapaneseTokenizerFactory,
                                                 segmentation_scores)
@@ -340,5 +375,7 @@ class TestCJKSegmentationQuality:
                                 self._gold("cjk_gold_zh.txt"))
         j = segmentation_scores(JapaneseTokenizerFactory(),
                                 self._gold("cjk_gold_ja.txt"))
-        assert z["f1"] >= 0.85, z
-        assert j["f1"] >= 0.85, j
+        # with jieba importable the zh factory IS the gold's author (~1.0);
+        # without it the unigram-Viterbi fallback measured 0.886
+        assert z["f1"] >= 0.87, z
+        assert j["f1"] >= 0.70, j

@@ -126,4 +126,10 @@ class TestKerasApplicationsBridge:
         assert sha256_of(path) != (path.parent / (path.name + ".sha256")
                                    ).read_text().strip()
         with pytest.raises(OSError, match="corrupt"):
+            verify_checksum(path)
+        # init_pretrained DELETES the corrupt zip (ZooModel.java:62-66
+        # delete-and-refetch parity) then reports the cache miss ("tiny"
+        # has no keras.applications source to auto-convert from)
+        with pytest.raises(FileNotFoundError):
             model_by_name("vgg16").init_pretrained("tiny")
+        assert not path.exists()
