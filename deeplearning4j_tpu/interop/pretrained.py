@@ -45,9 +45,15 @@ def write_checksum(path) -> Path:
     return side
 
 
+class ChecksumMismatch(OSError):
+    """Cached checkpoint digest != recorded sidecar digest. A dedicated
+    type so delete-on-corrupt logic can't be triggered by transient I/O
+    errors (permissions, NFS hiccups) that also surface as OSError."""
+
+
 def verify_checksum(path) -> bool:
     """True if no sidecar exists (nothing to verify) or the digest matches;
-    raises ``OSError`` on mismatch (mirroring the reference's
+    raises ``ChecksumMismatch`` on mismatch (mirroring the reference's
     delete-and-fail on a corrupt download)."""
     side = Path(str(path) + ".sha256")
     if not side.exists():
@@ -55,7 +61,7 @@ def verify_checksum(path) -> bool:
     expected = side.read_text().strip()
     actual = sha256_of(path)
     if actual != expected:
-        raise OSError(
+        raise ChecksumMismatch(
             f"pretrained checkpoint {path} is corrupt: sha256 {actual} != "
             f"recorded {expected} — delete it and re-run the conversion "
             f"(interop.pretrained.convert_keras_application)")

@@ -67,15 +67,18 @@ class ZooModel:
         a mapping — that downloads the Keras weights where egress (or a
         warm ~/.keras cache) allows, converts through the golden-tested
         Keras importer, and publishes into the cache."""
-        from ..interop.pretrained import verify_checksum
+        from ..interop.pretrained import ChecksumMismatch, verify_checksum
 
         path = self.pretrained_path(pretrained_type)
+        verified = False
         if path.exists():
             try:
-                verify_checksum(path)
-            except OSError:
+                verified = verify_checksum(path)
+            except ChecksumMismatch:
                 # reference parity (ZooModel.java:62-66): a corrupt cached
-                # download is DELETED so the next step can re-fetch/convert
+                # download is DELETED so the next step can re-fetch/convert.
+                # Only on a genuine digest mismatch — a transient read error
+                # (also an OSError) must not unlink a valid cache entry.
                 path.unlink(missing_ok=True)
                 Path(str(path) + ".sha256").unlink(missing_ok=True)
         # auto-convert only for weight sets Keras can actually supply —
@@ -104,10 +107,10 @@ class ZooModel:
                 f"produce the zip with save_pretrained() or "
                 f"interop.pretrained.convert_keras_application() to use "
                 f"pretrained weights.")
-        from ..interop.pretrained import verify_checksum
         from ..train.serialization import load_model
 
-        verify_checksum(path)
+        if not verified:  # fresh conversion above; head check already
+            verify_checksum(path)  # hashed the warm-cache path once
         model, *_ = load_model(str(path))  # populates model.params/state
         return model
 

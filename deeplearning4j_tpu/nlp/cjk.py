@@ -21,6 +21,7 @@ are language-agnostic exactly like the reference's TokenizerFactory SPI.
 from __future__ import annotations
 
 import unicodedata
+from functools import lru_cache
 from typing import Iterable, List, Optional, Sequence, Set
 
 from .tokenization import Tokenizer, TokenizerFactory
@@ -139,6 +140,33 @@ class UnigramTokenizerFactory(TokenizerFactory):
                                 max((len(w) for w in freqs), default=1))
         self._logtot = math.log(max(sum(freqs.values()), 1))
         self._log = {w: math.log(f) for w, f in freqs.items() if f > 0}
+
+    def clone(self) -> "UnigramTokenizerFactory":
+        """Cheap copy sharing nothing mutable: a dict copy of the 111k log
+        table (C-speed) instead of re-running ``math.log`` per entry —
+        used so per-instance user dictionaries don't mutate the shared
+        default factory."""
+        c = object.__new__(type(self))
+        TokenizerFactory.__init__(c)
+        c._pre = self._pre
+        c.max_word_len = self.max_word_len
+        c._logtot = self._logtot
+        c._log = dict(self._log)
+        return c
+
+    def add_word(self, word: str) -> None:
+        """Register a user-dictionary word so it actually wins segmentation
+        (jieba ``suggest_freq`` style): give it a log-frequency just above
+        the best competing split's path score. Merging user words at
+        frequency 1 silently loses to splits into frequent components —
+        exactly the domain-compound case user dictionaries exist for."""
+        if len(word) < 2:
+            return
+        score = sum(self._log.get(w, 0.0) - self._logtot
+                    for w in self._viterbi(word))
+        needed = score + self._logtot + 1e-9  # strictly beat the split
+        self._log[word] = max(self._log.get(word, -1e18), needed)
+        self.max_word_len = max(self.max_word_len, len(word))
 
     def _viterbi(self, text: str) -> List[str]:
         n = len(text)
@@ -277,30 +305,42 @@ class _ScriptFallbackFactory(TokenizerFactory):
         return Tokenizer(script_segment(text), self._pre)
 
 
+@lru_cache(maxsize=None)
+def _shared_unigram() -> Optional["UnigramTokenizerFactory"]:
+    """Default zh unigram factory, built once per process: the 111k-entry
+    log table costs ~100ms+ to derive, so lexicon-less factories share it
+    (instances with a user ``lexicon=`` take a cheap ``clone()``)."""
+    from .cjk_lexicon import chinese_freqs
+
+    freqs = chinese_freqs()
+    return UnigramTokenizerFactory(freqs) if freqs else None
+
+
 class ChineseTokenizerFactory(_ScriptFallbackFactory):
     """deeplearning4j-nlp-chinese ``ChineseTokenizerFactory`` equivalent.
 
     Fallback chain: jieba when importable → unigram-Viterbi over the
-    shipped 100k frequency dictionary (merged with any user ``lexicon=``
-    at frequency 1) → max-match → Unicode blocks. Only the selected stage
-    is constructed (no dead 100k-word max-match build)."""
+    shipped 100k frequency dictionary (user ``lexicon=`` words injected at
+    a frequency that beats their best competing split, jieba
+    ``suggest_freq`` style) → max-match → Unicode blocks. Only the
+    selected stage is constructed (no dead 100k-word max-match build)."""
 
     def __init__(self, lexicon: Optional[Iterable[str]] = None):
         TokenizerFactory.__init__(self)
-        self._engine = self._load_engine()
+        lexicon = tuple(lexicon or ())
+        self._engine = self._load_engine(lexicon)
         self._mm = None
         if self._engine is not None:
             return
-        from .cjk_lexicon import CHINESE_FREQS
-
-        if CHINESE_FREQS:
-            freqs = dict(CHINESE_FREQS)
-            for w in (lexicon or ()):
-                freqs.setdefault(w, 1)
-            self._mm = UnigramTokenizerFactory(freqs)
+        if _shared_unigram() is not None:
+            self._mm = _shared_unigram()
+            if lexicon:  # private copy: user words must not leak across
+                self._mm = self._mm.clone()
+                for w in lexicon:
+                    self._mm.add_word(w)
         else:
             base = set(self.default_lexicon())
-            base.update(lexicon or ())
+            base.update(lexicon)
             self._mm = MaxMatchTokenizerFactory(base) if base else None
 
     def default_lexicon(self):
@@ -308,11 +348,20 @@ class ChineseTokenizerFactory(_ScriptFallbackFactory):
 
         return CHINESE_CORE
 
-    def _load_engine(self):
+    def _load_engine(self, lexicon=()):
         try:
             import jieba  # optional; not baked into the hosting image
 
-            return lambda text: [t for t in jieba.cut(text) if t.strip()]
+            if lexicon:
+                # user dictionary must win on the engine path too: a
+                # private jieba.Tokenizer so user words don't leak into
+                # other factories' segmentation
+                tok = jieba.Tokenizer()
+                for w in lexicon:
+                    tok.suggest_freq(w, tune=True)
+            else:
+                tok = jieba
+            return lambda text: [t for t in tok.cut(text) if t.strip()]
         except ImportError:
             return None
 

@@ -171,6 +171,12 @@ def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret, backward):
     return o, (q, k, v, o, lse)
 
 
+# Block cap for the Mosaic backward kernels (the backward keeps more live
+# tiles than the forward, so its VMEM-optimal block is smaller; 512 measured
+# best on v5e at T<=4096 — scripts/chip_flashbwd.py sweeps this).
+BWD_BLOCK_CAP = 512
+
+
 def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
               scale, causal, masked, iq, ik, bq, bk, t_actual):
     """Shared FlashAttention-2 backward recomputation for both passes:
@@ -288,9 +294,9 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal, bq, bk, interpret):
     import math
 
     BH, T, D = q.shape
-    # more live tiles than the forward (q, k, v, do + p/ds): cap blocks at
-    # 512 to stay comfortably inside VMEM
-    bq, bk = min(bq, 512), min(bk, 512)
+    # more live tiles than the forward (q, k, v, do + p/ds): cap blocks to
+    # stay inside VMEM (sweepable — see scripts/chip_flashbwd.py)
+    bq, bk = min(bq, BWD_BLOCK_CAP), min(bk, BWD_BLOCK_CAP)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)       # (BH, T, 1)
     lse3 = lse[..., None]                          # (BH, T, 1)

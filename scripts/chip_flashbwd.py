@@ -23,24 +23,39 @@ import numpy as np
 
 import deeplearning4j_tpu.ops.flash_attention as fa
 
-rng = np.random.RandomState(0)
-
 def timed_grads(backend, B, T, H, D, causal=True, iters=8, dtype=np.float32):
+    # Fresh seeded RNG per call: both backends must see IDENTICAL inputs or
+    # the correctness comparison below is meaningless (a shared module-level
+    # RandomState advanced between calls once made pallas-vs-xla compare
+    # gradients at two different random points — rel err ~1.1, a harness
+    # bug, not a kernel bug).
+    rng = np.random.RandomState(0)
     q, k, v = (jnp.asarray(rng.randn(B, T, H, D), dtype) for _ in range(3))
 
     @jax.jit
-    def g(q, k, v):
+    def g(q, k, v, carry):
+        # carry chains iteration i to i-1 (value-neutral: *0) so ONE host
+        # fetch after the loop waits for the whole chain — no per-iteration
+        # RTT, no reliance on block_until_ready (unreliable through the
+        # tunnel: measured flat 0.04ms for workloads differing 100x in
+        # FLOPs).
         def loss(q, k, v):
             return jnp.sum(fa.flash_attention(q, k, v, causal=causal,
                                               backward=backend) ** 2)
-        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(
+            q + (carry * 0).astype(q.dtype), k, v)
+        sync = (jnp.sum(dq.astype(jnp.float32))
+                + jnp.sum(dk.astype(jnp.float32))
+                + jnp.sum(dv.astype(jnp.float32)))
+        return (dq, dk, dv), sync
 
-    r = g(q, k, v)  # compile
-    jax.block_until_ready(r)
+    carry = jnp.float32(0)
+    r, carry = g(q, k, v, carry)  # compile + warm
+    float(carry)
     t0 = time.perf_counter()
     for _ in range(iters):
-        r = g(q, k, v)
-    jax.block_until_ready(r)
+        r, carry = g(q, k, v, carry)
+    float(carry)  # single sync point for the chain
     return r, (time.perf_counter() - t0) / iters * 1e3
 
 # 1. correctness: pallas vs xla on-chip (f32, T=1024)

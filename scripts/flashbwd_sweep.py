@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Sweep the Mosaic flash-backward block cap vs the XLA scan backward on
+chip, in the regimes that matter: BERT fine-tune (T=512) and long-context
+(T=2048..8192). Decides the BACKWARD default.
+
+Timing discipline: `jax.block_until_ready` proved unreliable through the
+axon tunnel (flat 0.04ms for workloads that differ 100x in FLOPs), so every
+measurement forces a scalar device->host readback that depends on all three
+gradients — that fetch cannot complete before the computation has."""
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dl4j_tpu_jax_cache")
+
+out = {}
+def probe():
+    import jax
+    out["d"] = jax.devices()
+t = threading.Thread(target=probe, daemon=True)
+t.start(); t.join(90)
+if "d" not in out:
+    print("WEDGED"); raise SystemExit(3)
+print("devices:", out["d"])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deeplearning4j_tpu.ops.flash_attention as fa
+
+
+def timed(backend, B, T, H, D, iters=10, dtype=jnp.bfloat16):
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D), dtype) for _ in range(3))
+
+    @jax.jit
+    def g(q, k, v, carry):
+        # carry chains iteration i to i-1 (value-neutral: *0), so the ONE
+        # host fetch after the loop transitively waits for every
+        # iteration — no per-iteration RTT stall, and no reliance on
+        # block_until_ready (unreliable through the tunnel) or on
+        # enqueue-order guarantees.
+        def loss(q, k, v):
+            return jnp.sum(fa.flash_attention(q, k, v, causal=True,
+                                              backward=backend) ** 2)
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(
+            q + (carry * 0).astype(q.dtype), k, v)
+        return (jnp.sum(dq.astype(jnp.float32)) + jnp.sum(dk.astype(jnp.float32))
+                + jnp.sum(dv.astype(jnp.float32)))
+
+    carry = jnp.float32(0)
+    carry = g(q, k, v, carry)  # compile + warm
+    float(carry)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry = g(q, k, v, carry)
+    float(carry)  # the single sync point for the whole chain
+    dt = (time.perf_counter() - t0) / iters
+    return dt * 1e3
+
+
+for B, T, H, D in [(32, 512, 12, 64), (2, 2048, 8, 64), (2, 4096, 8, 64),
+                   (1, 8192, 8, 64)]:
+    tx = timed("xla", B, T, H, D)
+    print(f"B{B} T{T}: xla {tx:.2f}ms", flush=True)
+    for cap in (256, 512, 1024):
+        fa.BWD_BLOCK_CAP = cap
+        jax.clear_caches()  # cap is a trace-time constant; force retrace
+        tp = timed("pallas", B, T, H, D)
+        print(f"  pallas@{cap} {tp:.2f}ms ({tx/tp:.2f}x)", flush=True)
+print("DONE")

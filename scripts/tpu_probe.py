@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Fast wedge-safe chip probe: daemon-thread jax.devices() + a tiny matmul
+with scalar readback, joined with a timeout. Exits 0/OK only if the chip
+actually computed something. Never wrap chip work in `timeout` — a SIGTERM
+mid-flight re-wedges the tunnel; this probe's main thread just exits and
+leaves the daemon thread behind instead."""
+import os
+import sys
+import threading
+
+out = {}
+
+
+def probe():
+    import jax
+    import jax.numpy as jnp
+    out["d"] = jax.devices()
+    x = jnp.ones((256, 256))
+    out["v"] = float((x @ x).sum())  # D2H readback = real execution proof
+
+
+t = threading.Thread(target=probe, daemon=True)
+t.start()
+t.join(75)
+if "v" in out:
+    print(f"OK {out['d']} sum={out['v']}")
+    sys.exit(0)
+print("WEDGED" + (" (devices visible, exec hung)" if "d" in out else ""))
+os._exit(3)  # plain sys.exit can hang joining PJRT threads

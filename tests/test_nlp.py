@@ -276,6 +276,33 @@ class TestCJKLexicons:
         toks = ja.create("量子計算機を研究する").get_tokens()
         assert "量子計算機" in toks
 
+    def test_zh_user_lexicon_beats_frequent_splits(self):
+        """A user word made of frequent components must win segmentation
+        (jieba suggest_freq semantics) on BOTH the engine path and the
+        unigram-Viterbi fallback — merging at frequency 1 silently lost to
+        the split for exactly the domain-compound case user dictionaries
+        exist for."""
+        import builtins
+
+        from deeplearning4j_tpu.nlp.cjk import ChineseTokenizerFactory
+
+        for block_jieba in (False, True):
+            real = builtins.__import__
+            if block_jieba:
+                def imp(name, *a, **k):
+                    if name == "jieba":
+                        raise ImportError("blocked for test")
+                    return real(name, *a, **k)
+                builtins.__import__ = imp
+            try:
+                zh = ChineseTokenizerFactory(lexicon=["的时候了"])
+                assert zh.create("的时候了").get_tokens() == ["的时候了"]
+                # default factory unaffected by another instance's lexicon
+                default = ChineseTokenizerFactory()
+                assert "的时候了" not in default.create("的时候了").get_tokens()
+            finally:
+                builtins.__import__ = real
+
 
 class TestCJKSegmentationQuality:
     """Measured segmentation quality with HONEST floors (r4 VERDICT #6 —

@@ -133,3 +133,28 @@ class TestKerasApplicationsBridge:
         with pytest.raises(FileNotFoundError):
             model_by_name("vgg16").init_pretrained("tiny")
         assert not path.exists()
+
+    def test_transient_io_error_does_not_delete_cache(self, cache):
+        """Only a genuine digest mismatch (ChecksumMismatch) may unlink the
+        cached zip — a transient read failure (plain OSError) must leave a
+        valid multi-hundred-MB conversion in place."""
+        keras = pytest.importorskip("keras")
+        from deeplearning4j_tpu.interop import pretrained as pt
+
+        km = keras.applications.VGG16(weights=None, classes=5,
+                                      input_shape=(32, 32, 3))
+        path = pt.convert_keras_application("vgg16", weights=None,
+                                            pretrained_type="tiny2",
+                                            keras_model=km)
+
+        def flaky(p):
+            raise OSError("disk hiccup while reading sidecar")
+        real_verify = pt.verify_checksum
+        pt.verify_checksum = flaky
+        try:
+            with pytest.raises(OSError, match="hiccup"):
+                model_by_name("vgg16").init_pretrained("tiny2")
+        finally:
+            pt.verify_checksum = real_verify
+        assert path.exists()  # cache entry survived the transient error
+        assert model_by_name("vgg16").init_pretrained("tiny2") is not None
