@@ -75,7 +75,7 @@ class CausalLM(ZooModel):
 
     def __init__(self, num_classes=None, seed=12345, input_shape=None, *,
                  num_layers=None, d_model=None, num_heads=None, vocab=None,
-                 flash=False, remat=False, ring=False, **kw):
+                 flash=False, remat=False, ring=False, pos="learned", **kw):
         super().__init__(num_classes, seed, input_shape, **kw)
         self.num_layers = num_layers or self.num_layers
         self.d_model = d_model or self.d_model
@@ -85,18 +85,26 @@ class CausalLM(ZooModel):
         self.flash = flash
         self.remat = remat
         self.ring = ring
+        if pos not in ("learned", "rope"):
+            raise ValueError(f"pos must be 'learned' or 'rope', got {pos!r}")
+        self.pos = pos
 
     def build(self) -> Sequential:
         T = self.input_shape[0]
         b = (SequentialBuilder(NetConfig(seed=self.seed,
                                          updater={"type": "adamw", "learning_rate": 3e-4}))
              .input_shape(T)
-             .layer(L.EmbeddingSequence(n_in=self.vocab, n_out=self.d_model))
-             .layer(L.PositionalEmbedding(max_len=max(T, 512))))
+             .layer(L.EmbeddingSequence(n_in=self.vocab, n_out=self.d_model)))
+        rope = self.pos == "rope"
+        if not rope:
+            # learned absolute table; at long context prefer pos="rope"
+            # (a T=64k table is 100M params at d=1536 and cannot
+            # extrapolate past max_len)
+            b.layer(L.PositionalEmbedding(max_len=max(T, 512)))
         for _ in range(self.num_layers):
             b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=True,
                                               flash=self.flash, remat=self.remat,
-                                              ring=self.ring))
+                                              ring=self.ring, rope=rope))
         b.layer(L.LayerNorm())
         b.layer(L.RnnOutput(n_out=self.vocab, activation="softmax", loss="mcxent"))
         return b.build()

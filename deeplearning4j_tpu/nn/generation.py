@@ -46,12 +46,18 @@ _TOKEN_LOCAL = (ActivationLayer, AlphaDropout, Dense, DropoutLayer,
                 GaussianDropout, GaussianNoise, LayerNorm, PReLU, RMSNorm)
 
 
-def _mha_decode(num_heads: int, params, x, cache, pos):
+def _mha_decode(num_heads: int, params, x, cache, pos, *, rope=False,
+                rope_base=10000.0):
     """Decode a query chunk ``x`` (B, Tq, D) at absolute offset ``pos``
     against a KV cache {"k","v"}: (B, C, H, hd). Returns (y, new_cache).
     Attention is causal by construction — the ``valid`` mask lets token t
     see cache slots 0..pos+t; generate() rejects non-causal attention
-    layers up front (they cannot be decoded incrementally)."""
+    layers up front (they cannot be decoded incrementally). With ``rope``,
+    the chunk's q/k rotate at their ABSOLUTE positions (pos..pos+Tq-1)
+    before k enters the cache — cached keys were rotated at their own
+    positions when written, so cached entries are never re-rotated."""
+    from .layers.attention import rope_rotate
+
     B, Tq, D = x.shape
     H = num_heads
     hd = D // H
@@ -60,6 +66,10 @@ def _mha_decode(num_heads: int, params, x, cache, pos):
     q = q.reshape(B, Tq, H, hd)
     k = k.reshape(B, Tq, H, hd)
     v = v.reshape(B, Tq, H, hd)
+    if rope:
+        abs_pos = pos + jnp.arange(Tq)
+        q = rope_rotate(q, abs_pos, rope_base)
+        k = rope_rotate(k, abs_pos, rope_base)
     ck = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
                                   (0, pos, 0, 0))
     cv = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
@@ -107,14 +117,18 @@ def _decode_forward(model: Sequential, params, state, x, caches, pos):
             p = _cast_floats(p, cdt)
         if isinstance(layer, TransformerEncoderBlock):
             h = layer._ln(x, p["ln1_g"], p["ln1_b"])
-            a, new[k] = _mha_decode(layer.num_heads, p["attn"], h, new[k], pos)
+            a, new[k] = _mha_decode(layer.num_heads, p["attn"], h, new[k],
+                                    pos, rope=layer.rope,
+                                    rope_base=layer.rope_base)
             x = x + a
             h = layer._ln(x, p["ln2_g"], p["ln2_b"])
             m = (_act.get(layer.activation)(h @ p["w_up"] + p["b_up"])
                  @ p["w_down"] + p["b_down"])
             x = x + m
         elif isinstance(layer, MultiHeadAttention):
-            x, new[k] = _mha_decode(layer.num_heads, p, x, new[k], pos)
+            x, new[k] = _mha_decode(layer.num_heads, p, x, new[k], pos,
+                                    rope=layer.rope,
+                                    rope_base=layer.rope_base)
         elif isinstance(layer, PositionalEmbedding):
             Tq = x.shape[1]
             x = x + lax.dynamic_slice(p["pos"], (pos, 0),

@@ -44,6 +44,27 @@ def dot_product_attention(q, k, v, *, mask=None, scale=None,
     return jnp.einsum("bhqk,bkhd->bqhd", w, v)
 
 
+def rope_rotate(x, positions, base: float = 10000.0):
+    """Rotary position embedding (RoFormer) on (B, T, H, Dh) at absolute
+    ``positions`` (T,). The long-context position scheme: no learned table
+    (a T=64k learned table is 100M params at d=1536), relative-distance
+    attention by construction, and extrapolates past the training length.
+    Rotation computed in f32 (bf16 angles at position ~64k lose the
+    low-order bits that carry relative phase), cast back to x.dtype."""
+    Dh = x.shape[-1]
+    if Dh % 2:
+        raise ValueError(f"rope needs an even head dim, got {Dh}")
+    half = Dh // 2
+    inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (T, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
 @register_layer
 @dataclass(frozen=True)
 class MultiHeadAttention(Layer):
@@ -52,8 +73,9 @@ class MultiHeadAttention(Layer):
     ``flash=True`` routes the score/softmax/weighted-sum through the Pallas
     flash kernel (ops/flash_attention.py): O(T·block) memory instead of a
     (T, T) score tensor — the long-context fast path. Used when the mask is
-    absent or pure-causal; an explicit key mask falls back to the dense path
-    (the kernel doesn't take arbitrary masks).
+    absent, pure-causal, or a (B, T) key mask (the kernel's exact
+    ``key_mask`` path — any mask pattern, no right-padding assumption);
+    attention dropout falls back to the dense path.
 
     ``ring=True`` routes through sequence-parallel ring attention
     (parallel/ring_attention.py) whenever the step is being traced under a
@@ -69,6 +91,8 @@ class MultiHeadAttention(Layer):
     attn_dropout: float = 0.0
     flash: bool = False
     ring: bool = False
+    rope: bool = False       # rotary positions on q/k (no learned table)
+    rope_base: float = 10000.0
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
@@ -86,6 +110,13 @@ class MultiHeadAttention(Layer):
         q = q.reshape(B, T, H, D // H)
         k = k.reshape(B, T, H, D // H)
         v = v.reshape(B, T, H, D // H)
+        if self.rope:
+            # T here is the global length even under sequence parallelism
+            # (shard_map splitting happens inside ring_attention), so
+            # absolute positions are just arange(T)
+            pos = jnp.arange(T)
+            q = rope_rotate(q, pos, self.rope_base)
+            k = rope_rotate(k, pos, self.rope_base)
         drop = self.attn_dropout if (training and rng is not None) else 0.0
         ring_mesh = dp = tp = None
         if self.ring and mask is None and drop == 0.0:
@@ -104,12 +135,16 @@ class MultiHeadAttention(Layer):
                 q, k, v, ring_mesh, causal=self.causal,
                 batch_axis=DATA_AXIS if dp > 1 and B % dp == 0 else None,
                 head_axis=MODEL_AXIS if tp > 1 and H % tp == 0 else None)
-        elif self.flash and mask is None and drop == 0.0:
-            # flash kernel handles no-mask / pure-causal; attention dropout
-            # (weights are never materialized) falls back to dense
+        elif self.flash and drop == 0.0 and (
+                mask is None or (hasattr(mask, "ndim") and mask.ndim == 2)):
+            # flash kernel handles no-mask / pure-causal directly; a (B, T)
+            # key mask rides the kernel's EXACT key_mask path (no
+            # right-padding assumption — left-padded or gappy masks are
+            # honored bit-for-bit like the dense path). Attention dropout
+            # (weights never materialized) falls back to dense.
             from ...ops.flash_attention import flash_attention
 
-            y = flash_attention(q, k, v, causal=self.causal)
+            y = flash_attention(q, k, v, causal=self.causal, key_mask=mask)
         else:
             attn_mask = None
             if self.causal:
@@ -142,6 +177,8 @@ class TransformerEncoderBlock(Layer):
     # internals in the backward pass instead of storing them — saved
     # activation memory shrinks to ~one residual-stream tensor per block
     # (jax.checkpoint per block; deep stacks / long context)
+    rope: bool = False   # rotary positions on q/k inside the attention
+    rope_base: float = 10000.0
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
@@ -177,7 +214,8 @@ class TransformerEncoderBlock(Layer):
 
     def _body(self, params, x, rng, mask, *, training=False):
         mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal,
-                                 flash=self.flash, ring=self.ring)
+                                 flash=self.flash, ring=self.ring,
+                                 rope=self.rope, rope_base=self.rope_base)
         h = self._ln(x, params["ln1_g"], params["ln1_b"])
         a, _, _ = mha.apply(params["attn"], {}, h, training=training, rng=rng, mask=mask)
         x = x + a

@@ -28,12 +28,15 @@ print("devices:", out["d"])
 import model_benches as mb
 
 JOBS = [
-    # 12-layer d=1536 (the 440M family): T=16k, batch 2
+    # 12-layer d=1536 (the 440M family): T=16k, batch 2. pos="rope": no
+    # learned table (100M params at T=64k) — the long-context design.
     ("longctx_t16k", dict(num_layers=12, d_model=1536, batch=2, seq=16384,
-                          vocab=8192, flash=True, remat=True, steps=6)),
+                          vocab=8192, flash=True, remat=True, pos="rope",
+                          steps=6)),
     # T=64k, batch 1 — the headline long-context row
     ("longctx_t64k", dict(num_layers=12, d_model=1536, batch=1, seq=65536,
-                          vocab=8192, flash=True, remat=True, steps=3)),
+                          vocab=8192, flash=True, remat=True, pos="rope",
+                          steps=3)),
 ]
 
 results = {}

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Conv layout experiment (r3 floor-analysis follow-up, r4 VERDICT task 2):
+does feeding XLA NCHW instead of NHWC change the conv+BN step floor on v5e?
+
+Times isolated ResNet-50 stage blocks (conv3x3 + BN-train + relu, fwd+bwd)
+under both dimension_numbers on the real chip. XLA chooses internal tilings
+either way (activation layouts are compiler-picked batch-minor); this
+settles with a measurement whether the NHWC choice in nn/layers/conv.py
+leaves layout headroom, as named (and not run) in PERF.md's r3 floor
+analysis. Timing: value-neutral carry chain + one readback (see
+flashbwd_sweep.py).
+"""
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dl4j_tpu_jax_cache")
+
+out = {}
+def probe():
+    import jax
+    out["d"] = jax.devices()
+t = threading.Thread(target=probe, daemon=True)
+t.start(); t.join(90)
+if "d" not in out:
+    print("WEDGED"); raise SystemExit(3)
+print("devices:", out["d"])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# ResNet-50 stage shapes (B, H, W, C_in, C_out) — stride-1 3x3 blocks, the
+# bulk of the conv time (strided transition convs are a small fraction)
+STAGES = [
+    ("stage1", 128, 56, 56, 256, 256),
+    ("stage2", 128, 28, 28, 512, 512),
+    ("stage3", 128, 14, 14, 1024, 1024),
+]
+
+
+def block_nhwc(x, w, gamma, beta):
+    y = lax.conv_general_dilated(x, w, (1, 1), "SAME",
+                                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    mean = jnp.mean(y, axis=(0, 1, 2), dtype=jnp.float32)
+    msq = jnp.mean(lax.square(y.astype(jnp.float32)), axis=(0, 1, 2))
+    var = jnp.maximum(msq - lax.square(mean), 0.0)
+    a = lax.rsqrt(var + 1e-5) * gamma
+    b = beta - mean * a
+    return jax.nn.relu(y * a.astype(y.dtype) + b.astype(y.dtype))
+
+
+def block_nchw(x, w, gamma, beta):
+    y = lax.conv_general_dilated(x, w, (1, 1), "SAME",
+                                 dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    mean = jnp.mean(y, axis=(0, 2, 3), dtype=jnp.float32)
+    msq = jnp.mean(lax.square(y.astype(jnp.float32)), axis=(0, 2, 3))
+    var = jnp.maximum(msq - lax.square(mean), 0.0)
+    a = (lax.rsqrt(var + 1e-5) * gamma)[None, :, None, None]
+    b = (beta - mean * lax.rsqrt(var + 1e-5) * gamma)[None, :, None, None]
+    return jax.nn.relu(y * a.astype(y.dtype) + b.astype(y.dtype))
+
+
+def timed(layout, B, H, W, Cin, Cout, iters=8):
+    rng = np.random.RandomState(0)
+    if layout == "nhwc":
+        x = jnp.asarray(rng.randn(B, H, W, Cin), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(3, 3, Cin, Cout) * 0.05, jnp.bfloat16)
+        fn = block_nhwc
+    else:
+        x = jnp.asarray(rng.randn(B, Cin, H, W), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(Cout, Cin, 3, 3) * 0.05, jnp.bfloat16)
+        fn = block_nchw
+    gamma = jnp.ones((Cout,), jnp.float32)
+    beta = jnp.zeros((Cout,), jnp.float32)
+
+    @jax.jit
+    def g(x, w, carry):
+        def loss(x, w):
+            return jnp.sum(fn(x + (carry * 0).astype(x.dtype), w,
+                              gamma, beta).astype(jnp.float32) ** 2)
+        dx, dw = jax.grad(loss, argnums=(0, 1))(x, w)
+        return (jnp.sum(dx.astype(jnp.float32))
+                + jnp.sum(dw.astype(jnp.float32)))
+
+    carry = jnp.float32(0)
+    carry = g(x, w, carry)
+    float(carry)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry = g(x, w, carry)
+    float(carry)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+for name, B, H, W, Cin, Cout in STAGES:
+    t_nhwc = timed("nhwc", B, H, W, Cin, Cout)
+    t_nchw = timed("nchw", B, H, W, Cin, Cout)
+    flops = 2 * B * H * W * 9 * Cin * Cout * 3  # fwd + dx + dw
+    print(f"{name} (B{B} {H}x{W} C{Cin}->{Cout}): NHWC {t_nhwc:.2f}ms "
+          f"({flops/t_nhwc/1e9:.1f} TF/s)  NCHW {t_nchw:.2f}ms "
+          f"({flops/t_nchw/1e9:.1f} TF/s)", flush=True)
+print("DONE")

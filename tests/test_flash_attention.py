@@ -107,15 +107,157 @@ class TestLayerIntegration:
         np.testing.assert_allclose(np.asarray(yf), np.asarray(yd),
                                    rtol=1e-5, atol=1e-5)
 
-    def test_key_mask_falls_back_to_dense(self):
+    def test_key_mask_routes_as_ragged_lengths(self):
+        """A (B, T) right-padded key mask on flash=True now rides the
+        kernel's ragged-lengths path and must EQUAL the dense masked
+        layer, not merely run."""
         from deeplearning4j_tpu.nn.layers import MultiHeadAttention
         x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 16, 8)),
                         jnp.float32)
         mask = jnp.asarray(np.array([[1] * 10 + [0] * 6, [1] * 16], np.float32))
-        lay = MultiHeadAttention(num_heads=2, flash=True)
-        p, s = lay.init(jax.random.PRNGKey(0), (16, 8))
-        y, _, _ = lay.apply(p, s, x, mask=mask)  # must not crash; dense path
-        assert np.isfinite(np.asarray(y)).all()
+        p, s = MultiHeadAttention(num_heads=2, flash=True).init(
+            jax.random.PRNGKey(0), (16, 8))
+        yf, _, _ = MultiHeadAttention(num_heads=2, flash=True).apply(
+            p, s, x, mask=mask)
+        yd, _, _ = MultiHeadAttention(num_heads=2).apply(p, s, x, mask=mask)
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yd),
+                                   rtol=1e-5, atol=1e-5)
+
+class TestRaggedLengths:
+    """flash_attention(lengths=) vs the dense key-masked oracle: the
+    kernel's ragged path (BERT-style right-padded batches) forward and
+    through BOTH backward implementations."""
+
+    def _masked_dense(self, q, k, v, lengths, causal):
+        T = q.shape[1]
+        key_mask = (jnp.arange(T)[None, :] < lengths[:, None])[:, None, None]
+        mask = key_mask
+        if causal:
+            mask = mask & jnp.tril(jnp.ones((T, T), bool))[None, None]
+        return dot_product_attention(q, k, v, mask=mask)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_masked_dense(self, causal):
+        q, k, v = _qkv(B=3, T=48, seed=11)
+        lengths = jnp.asarray([48, 17, 33])
+        o = flash_attention(q, k, v, causal=causal, lengths=lengths,
+                            block_q=16, block_k=16)
+        want = self._masked_dense(q, k, v, lengths, causal)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("backward", ["xla", "pallas"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_masked_dense(self, causal, backward):
+        q, k, v = _qkv(B=3, T=48, seed=12)
+        lengths = jnp.asarray([48, 17, 33])
+        # dy nonzero ONLY on valid rows (the trained configuration: loss
+        # masks padded positions)
+        row_ok = (jnp.arange(48)[None, :] < lengths[:, None]
+                  ).astype(jnp.float32)[:, :, None, None]
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, lengths=lengths,
+                                backward=backward, block_q=16, block_k=16)
+            return jnp.sum((o * row_ok) ** 2)
+
+        def loss_dense(q, k, v):
+            o = self._masked_dense(q, k, v, lengths, causal)
+            return jnp.sum((o * row_ok) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4, err_msg=name)
+
+    def test_padded_keys_get_zero_kv_grads(self):
+        q, k, v = _qkv(B=2, T=32, seed=13)
+        lengths = jnp.asarray([32, 9])
+
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, causal=True, lengths=lengths,
+                                backward="pallas", block_q=16, block_k=16)
+            return jnp.sum(o ** 2)
+
+        _, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_array_equal(np.asarray(dk[1, 9:]), 0.0)
+        np.testing.assert_array_equal(np.asarray(dv[1, 9:]), 0.0)
+
+    def test_bad_lengths_shape_rejected(self):
+        q, k, v = _qkv(B=2, T=16, seed=14)
+        with pytest.raises(ValueError, match="lengths"):
+            flash_attention(q, k, v, lengths=jnp.asarray([5]))
+
+    def test_lengths_and_key_mask_mutually_exclusive(self):
+        q, k, v = _qkv(B=2, T=16, seed=14)
+        with pytest.raises(ValueError, match="not both"):
+            flash_attention(q, k, v, lengths=jnp.asarray([5, 6]),
+                            key_mask=jnp.ones((2, 16), bool))
+
+
+class TestExactKeyMask:
+    """flash_attention(key_mask=) honors ARBITRARY (B, T) masks exactly —
+    left padding, mid-sequence holes — with no contiguity assumption (the
+    review's repro: sum(mask)-as-lengths inverted a left-padded mask)."""
+
+    def _masks(self, B, T, seed):
+        rng = np.random.default_rng(seed)
+        m = rng.random((B, T)) > 0.35        # gappy
+        m[0] = np.r_[np.zeros(T // 2), np.ones(T - T // 2)]  # left-padded
+        m[:, 0] = True  # every row keeps >= 1 valid key (non-degenerate)
+        return jnp.asarray(m)
+
+    def _dense(self, q, k, v, km, causal):
+        T = q.shape[1]
+        mask = km[:, None, None, :]
+        if causal:
+            mask = mask & jnp.tril(jnp.ones((T, T), bool))[None, None]
+        return dot_product_attention(q, k, v, mask=mask)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_masked_dense(self, causal):
+        q, k, v = _qkv(B=3, T=48, seed=21)
+        km = self._masks(3, 48, 22)
+        o = flash_attention(q, k, v, causal=causal, key_mask=km,
+                            block_q=16, block_k=16)
+        want = self._dense(q, k, v, km, causal)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("backward", ["xla", "pallas"])
+    def test_grads_match_masked_dense(self, backward):
+        q, k, v = _qkv(B=3, T=48, seed=23)
+        km = self._masks(3, 48, 24)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=True, key_mask=km,
+                                backward=backward, block_q=16, block_k=16)
+            return jnp.sum(o ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(self._dense(q, k, v, km, True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4, err_msg=name)
+
+    def test_left_padded_layer_mask_is_honored(self):
+        """The review's exact scenario: MultiHeadAttention(flash=True) with
+        a LEFT-padded (B, T) mask must equal the dense layer."""
+        from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+        x = jnp.asarray(np.random.default_rng(25).standard_normal((1, 8, 8)),
+                        jnp.float32)
+        mask = jnp.asarray([[0, 0, 0, 1, 1, 1, 1, 1]], jnp.float32)
+        p, s = MultiHeadAttention(num_heads=2, flash=True).init(
+            jax.random.PRNGKey(0), (8, 8))
+        yf, _, _ = MultiHeadAttention(num_heads=2, flash=True).apply(
+            p, s, x, mask=mask)
+        yd, _, _ = MultiHeadAttention(num_heads=2).apply(p, s, x, mask=mask)
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yd),
+                                   rtol=1e-5, atol=1e-5)
 
 
 class TestReviewRegressions:

@@ -164,3 +164,64 @@ class TestSampling:
         toks = np.asarray(sample_logits(
             logits, jax.random.PRNGKey(5), temperature=0.05))
         assert (toks == 2).mean() > 0.95
+
+
+class TestRopeDecode:
+    """RoPE (pos="rope") through the same equivalence oracle: incremental
+    decode with absolute-position rotation must reproduce the full forward
+    (cached keys rotate once, at their own positions)."""
+
+    def setup_method(self):
+        self.zm = CausalLM(seed=0, input_shape=(16,), num_layers=2,
+                           d_model=32, num_heads=4, vocab=50, pos="rope")
+        self.model = self.zm.build()
+        self.model.init()
+        rng = np.random.RandomState(1)
+        self.prompt = rng.randint(0, 50, (2, 10)).astype(np.int32)
+
+    def _full_logprobs(self, ids):
+        probs = self.model.output(jnp.asarray(ids))
+        return np.log(np.asarray(probs) + 1e-20)
+
+    def test_rope_has_no_learned_table(self):
+        from deeplearning4j_tpu.nn.layers.attention import PositionalEmbedding
+        assert not any(isinstance(l, PositionalEmbedding)
+                       for l in self.model.layers)
+
+    def test_stepwise_decode_matches_full_forward(self):
+        lg = _stepwise_logits(self.model, self.prompt, capacity=16)
+        got = np.asarray(jax.nn.log_softmax(jnp.asarray(lg), axis=-1))
+        want = self._full_logprobs(self.prompt)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_greedy_generate_matches_argmax_rollout(self):
+        n_new = 4
+        toks = generate(self.model, self.prompt, n_new, temperature=0.0)
+        x = self.prompt.copy()
+        for _ in range(n_new):
+            probs = np.asarray(self.model.output(jnp.asarray(x)))
+            nxt = probs[:, -1].argmax(-1).astype(np.int32)
+            x = np.concatenate([x, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(toks), x[:, -n_new:])
+
+    def test_shift_invariance(self):
+        """Attention scores under RoPE depend only on relative distance."""
+        from deeplearning4j_tpu.nn.layers.attention import rope_rotate
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(1, 3, 2, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 3, 2, 8), jnp.float32)
+        def scores(shift):
+            pos = jnp.arange(3) + shift
+            qr = rope_rotate(q, pos)
+            kr = rope_rotate(k, pos)
+            return np.asarray(jnp.einsum("bqhd,bkhd->bhqk", qr, kr))
+        np.testing.assert_allclose(scores(0), scores(37), rtol=2e-4, atol=2e-4)
+
+    def test_config_roundtrip(self):
+        from deeplearning4j_tpu.nn.model import Sequential
+        js = self.model.to_json()
+        m2 = Sequential.from_json(js)
+        m2.init()
+        blocks = [l for l in m2.layers
+                  if type(l).__name__ == "TransformerEncoderBlock"]
+        assert blocks and all(l.rope for l in blocks)

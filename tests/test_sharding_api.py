@@ -230,6 +230,33 @@ class TestRingThroughLayerStack:
                          x, y, steps=2, bs=4)
         chex.assert_trees_all_close(got, ref, rtol=1e-4, atol=1e-5)
 
+    def test_rope_ring_equals_rope_dense_under_dp_sp(self):
+        """RoPE rotates q/k on the GLOBAL sequence before ring attention
+        shards it, so pos='rope' must train identically under a dp x sp
+        mesh and unsharded — the long-context flagship configuration
+        (rope + ring + flash fallback) end to end."""
+        from deeplearning4j_tpu.models import CausalLM
+        import optax
+
+        def build(ring):
+            zm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=16,
+                          num_heads=2, vocab=32, ring=ring, pos="rope")
+            m = zm.build()
+            m.init()
+            return m
+
+        rng = np.random.default_rng(7)
+        ids = rng.integers(0, 32, (8, 17))
+        x, y = ids[:, :-1], np.eye(32, dtype=np.float32)[ids[:, 1:]]
+
+        ref = _fit_steps(Trainer(build(False), seed=5, updater=optax.sgd(0.1)),
+                         x, y, steps=2, bs=4)
+        mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4}, jax.devices()[:8])
+        got = _fit_steps(Trainer(build(True), seed=5, updater=optax.sgd(0.1),
+                                 mesh=mesh, rules=TRANSFORMER_RULES),
+                         x, y, steps=2, bs=4)
+        chex.assert_trees_all_close(got, ref, rtol=1e-4, atol=1e-5)
+
     def test_ring_falls_back_without_mesh(self):
         """Same config, no mesh: must run (dense path) and match ring=False."""
         from deeplearning4j_tpu.nn import layers as L
