@@ -75,7 +75,8 @@ class CausalLM(ZooModel):
 
     def __init__(self, num_classes=None, seed=12345, input_shape=None, *,
                  num_layers=None, d_model=None, num_heads=None, vocab=None,
-                 flash=False, remat=False, ring=False, pos="learned", **kw):
+                 flash=False, remat=False, ring=False, pos="learned",
+                 num_kv_heads=None, **kw):
         super().__init__(num_classes, seed, input_shape, **kw)
         self.num_layers = num_layers or self.num_layers
         self.d_model = d_model or self.d_model
@@ -88,6 +89,7 @@ class CausalLM(ZooModel):
         if pos not in ("learned", "rope"):
             raise ValueError(f"pos must be 'learned' or 'rope', got {pos!r}")
         self.pos = pos
+        self.num_kv_heads = num_kv_heads  # GQA: shrink KV proj + decode cache
 
     def build(self) -> Sequential:
         T = self.input_shape[0]
@@ -104,7 +106,8 @@ class CausalLM(ZooModel):
         for _ in range(self.num_layers):
             b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=True,
                                               flash=self.flash, remat=self.remat,
-                                              ring=self.ring, rope=rope))
+                                              ring=self.ring, rope=rope,
+                                              num_kv_heads=self.num_kv_heads))
         b.layer(L.LayerNorm())
         b.layer(L.RnnOutput(n_out=self.vocab, activation="softmax", loss="mcxent"))
         return b.build()

@@ -47,25 +47,28 @@ _TOKEN_LOCAL = (ActivationLayer, AlphaDropout, Dense, DropoutLayer,
 
 
 def _mha_decode(num_heads: int, params, x, cache, pos, *, rope=False,
-                rope_base=10000.0):
+                rope_base=10000.0, num_kv_heads=None):
     """Decode a query chunk ``x`` (B, Tq, D) at absolute offset ``pos``
-    against a KV cache {"k","v"}: (B, C, H, hd). Returns (y, new_cache).
+    against a KV cache {"k","v"}: (B, C, Hkv, hd). Returns (y, new_cache).
     Attention is causal by construction — the ``valid`` mask lets token t
     see cache slots 0..pos+t; generate() rejects non-causal attention
     layers up front (they cannot be decoded incrementally). With ``rope``,
     the chunk's q/k rotate at their ABSOLUTE positions (pos..pos+Tq-1)
     before k enters the cache — cached keys were rotated at their own
-    positions when written, so cached entries are never re-rotated."""
+    positions when written, so cached entries are never re-rotated. With
+    GQA (num_kv_heads < num_heads) the cache holds only Hkv heads — the
+    serving memory win — and broadcasts to H at score time."""
     from .layers.attention import rope_rotate
 
     B, Tq, D = x.shape
     H = num_heads
+    Hkv = num_kv_heads or H
     hd = D // H
     qkv = x @ params["w_qkv"] + params["b_qkv"]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = jnp.split(qkv, [D, D + Hkv * hd], axis=-1)
     q = q.reshape(B, Tq, H, hd)
-    k = k.reshape(B, Tq, H, hd)
-    v = v.reshape(B, Tq, H, hd)
+    k = k.reshape(B, Tq, Hkv, hd)
+    v = v.reshape(B, Tq, Hkv, hd)
     if rope:
         abs_pos = pos + jnp.arange(Tq)
         q = rope_rotate(q, abs_pos, rope_base)
@@ -76,13 +79,26 @@ def _mha_decode(num_heads: int, params, x, cache, pos, *, rope=False,
                                   (0, pos, 0, 0))
     C = ck.shape[1]
     scale = 1.0 / np.sqrt(hd)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
-                        preferred_element_type=jnp.float32) * scale
     valid = jnp.arange(C)[None, :] <= (pos + jnp.arange(Tq)[:, None])  # (Tq, C)
-    scores = jnp.where(valid[None, None], scores, -1e30)
-    w = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-    y = jnp.einsum("bhqk,bkhd->bqhd", w, cv)
-    y = y.reshape(B, Tq, D) @ params["w_o"] + params["b_o"]
+    if Hkv != H:
+        # grouped einsum: query heads fold into (Hkv, G) so the cache is
+        # consumed at Hkv heads directly — repeating it to H would
+        # materialize a full-size (B, C, H, hd) transient every decode
+        # step and forfeit the GQA serving-memory win at peak
+        G = H // Hkv
+        qg = q.reshape(B, Tq, Hkv, G, hd)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(valid[None, None, None], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        y = jnp.einsum("bhgqk,bkhd->bqhgd", w, cv).reshape(B, Tq, D)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(valid[None, None], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        y = jnp.einsum("bhqk,bkhd->bqhd", w, cv).reshape(B, Tq, D)
+    y = y @ params["w_o"] + params["b_o"]
     return y, {"k": ck, "v": cv}
 
 
@@ -93,7 +109,8 @@ def _init_caches(model: Sequential, batch: int, capacity: int, dtype):
         if isinstance(layer, (TransformerEncoderBlock, MultiHeadAttention)):
             d = model._shapes[i][-1]
             hd = d // layer.num_heads
-            z = jnp.zeros((batch, capacity, layer.num_heads, hd), dtype)
+            hkv = layer.num_kv_heads or layer.num_heads  # GQA: smaller cache
+            z = jnp.zeros((batch, capacity, hkv, hd), dtype)
             caches[k] = {"k": z, "v": z}
         elif isinstance(layer, RecurrentLayer):
             caches[k] = layer.init_carry(batch, model._shapes[i], dtype)
@@ -119,7 +136,8 @@ def _decode_forward(model: Sequential, params, state, x, caches, pos):
             h = layer._ln(x, p["ln1_g"], p["ln1_b"])
             a, new[k] = _mha_decode(layer.num_heads, p["attn"], h, new[k],
                                     pos, rope=layer.rope,
-                                    rope_base=layer.rope_base)
+                                    rope_base=layer.rope_base,
+                                    num_kv_heads=layer.num_kv_heads)
             x = x + a
             h = layer._ln(x, p["ln2_g"], p["ln2_b"])
             m = (_act.get(layer.activation)(h @ p["w_up"] + p["b_up"])
@@ -128,7 +146,8 @@ def _decode_forward(model: Sequential, params, state, x, caches, pos):
         elif isinstance(layer, MultiHeadAttention):
             x, new[k] = _mha_decode(layer.num_heads, p, x, new[k], pos,
                                     rope=layer.rope,
-                                    rope_base=layer.rope_base)
+                                    rope_base=layer.rope_base,
+                                    num_kv_heads=layer.num_kv_heads)
         elif isinstance(layer, PositionalEmbedding):
             Tq = x.shape[1]
             x = x + lax.dynamic_slice(p["pos"], (pos, 0),

@@ -93,23 +93,43 @@ class MultiHeadAttention(Layer):
     ring: bool = False
     rope: bool = False       # rotary positions on q/k (no learned table)
     rope_base: float = 10000.0
+    num_kv_heads: Optional[int] = None  # GQA: < num_heads shrinks the KV
+    # projection and decode cache by num_heads/num_kv_heads (MQA at 1);
+    # None = standard MHA (one KV head per query head)
+
+    @property
+    def kv_heads(self) -> int:
+        h = self.num_kv_heads or self.num_heads
+        if self.num_heads % h:
+            raise ValueError(f"num_heads={self.num_heads} must be divisible "
+                             f"by num_kv_heads={h}")
+        return h
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
+        d_kv = d // self.num_heads * self.kv_heads
         k1, k2 = jax.random.split(key)
-        wqkv = initializers.init_param(k1, self.weight_init or "xavier", (d, 3 * d), dtype=dtype)
+        wqkv = initializers.init_param(k1, self.weight_init or "xavier",
+                                       (d, d + 2 * d_kv), dtype=dtype)
         wo = initializers.init_param(k2, self.weight_init or "xavier", (d, d), dtype=dtype)
-        return {"w_qkv": wqkv, "b_qkv": jnp.zeros((3 * d,), dtype),
+        return {"w_qkv": wqkv, "b_qkv": jnp.zeros((d + 2 * d_kv,), dtype),
                 "w_o": wo, "b_o": jnp.zeros((d,), dtype)}, {}
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         B, T, D = x.shape
         H = self.num_heads
+        Hkv = self.kv_heads
+        hd = D // H
         qkv = x @ params["w_qkv"] + params["b_qkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, H, D // H)
-        k = k.reshape(B, T, H, D // H)
-        v = v.reshape(B, T, H, D // H)
+        q, k, v = jnp.split(qkv, [D, D + Hkv * hd], axis=-1)
+        q = q.reshape(B, T, H, hd)
+        k = k.reshape(B, T, Hkv, hd)
+        v = v.reshape(B, T, Hkv, hd)
+        if Hkv != H:
+            # broadcast KV groups up to the query heads; the parameter and
+            # decode-cache savings are upstream of this repeat
+            k = jnp.repeat(k, H // Hkv, axis=2)
+            v = jnp.repeat(v, H // Hkv, axis=2)
         if self.rope:
             # T here is the global length even under sequence parallelism
             # (shard_map splitting happens inside ring_attention), so
@@ -179,11 +199,13 @@ class TransformerEncoderBlock(Layer):
     # (jax.checkpoint per block; deep stacks / long context)
     rope: bool = False   # rotary positions on q/k inside the attention
     rope_base: float = 10000.0
+    num_kv_heads: Optional[int] = None  # GQA (see MultiHeadAttention)
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
         k1, k2, k3 = jax.random.split(key, 3)
-        mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal)
+        mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal,
+                                 num_kv_heads=self.num_kv_heads)
         attn_params, _ = mha.init(k1, input_shape, dtype)
         h = d * self.mlp_ratio
         return {
@@ -215,7 +237,8 @@ class TransformerEncoderBlock(Layer):
     def _body(self, params, x, rng, mask, *, training=False):
         mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal,
                                  flash=self.flash, ring=self.ring,
-                                 rope=self.rope, rope_base=self.rope_base)
+                                 rope=self.rope, rope_base=self.rope_base,
+                                 num_kv_heads=self.num_kv_heads)
         h = self._ln(x, params["ln1_g"], params["ln1_b"])
         a, _, _ = mha.apply(params["attn"], {}, h, training=training, rng=rng, mask=mask)
         x = x + a

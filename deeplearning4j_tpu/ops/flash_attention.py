@@ -143,6 +143,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, lens_ref, kmask_ref, o_ref, lse_ref,
         lse_ref[0] = m_scr[...][:, :1] + jnp.log(l)
 
 
+def _mask_operands(lens, kmask, BH, tp, pad):
+    """(lens3, km3) pallas operands shared by the forward and backward
+    calls — dummies when absent, so both directions keep ONE pallas_call
+    signature and can never desynchronize their masking inputs."""
+    if lens is None:
+        lens = jnp.zeros((BH,), jnp.int32)
+    lens3 = lens.reshape(BH, 1, 1)
+    if kmask is None:
+        km3 = jnp.zeros((BH, 1, tp), jnp.int8)
+    else:
+        km3 = jnp.pad(kmask.astype(jnp.int8), ((0, 0), (0, pad))
+                      ).reshape(BH, 1, tp)
+    return lens3, km3
+
+
 def _flash_fwd(q, k, v, lens, kmask, scale: float, causal: bool, bq: int,
                bk: int, interpret: bool, has_lens: bool, has_kmask: bool):
     import math
@@ -155,14 +170,7 @@ def _flash_fwd(q, k, v, lens, kmask, scale: float, causal: bool, bq: int,
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
     nq, nk = tp // bq, tp // bk
-    if lens is None:  # dummy inputs keep one pallas_call signature
-        lens = jnp.zeros((BH,), jnp.int32)
-    lens3 = lens.reshape(BH, 1, 1)
-    if kmask is None:
-        km3 = jnp.zeros((BH, 1, tp), jnp.int8)
-    else:
-        km3 = jnp.pad(kmask.astype(jnp.int8), ((0, 0), (0, pad))
-                      ).reshape(BH, 1, tp)
+    lens3, km3 = _mask_operands(lens, kmask, BH, tp, pad)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, t_actual=T, has_lens=has_lens,
@@ -383,14 +391,7 @@ def _flash_bwd_pallas(q, k, v, lens, kmask, o, lse, do, scale, causal, bq, bk,
     nq, nk = tp // bq, tp // bk
     has_lens = lens is not None
     has_kmask = kmask is not None
-    if lens is None:
-        lens = jnp.zeros((BH,), jnp.int32)
-    lens3 = lens.reshape(BH, 1, 1)
-    if kmask is None:
-        km3 = jnp.zeros((BH, 1, tp), jnp.int8)
-    else:
-        km3 = jnp.pad(kmask.astype(jnp.int8), ((0, 0), (0, pad))
-                      ).reshape(BH, 1, tp)
+    lens3, km3 = _mask_operands(lens, kmask, BH, tp, pad)
 
     common = dict(scale=scale, causal=causal, bq=bq, bk=bk, t_actual=T,
                   has_lens=has_lens, has_kmask=has_kmask)
@@ -547,7 +548,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
     materializing a mask or falling back to dense attention. Equivalent to
     the dense path's 2-D key mask ``arange(T) < lengths[:, None]``. The
     fast ragged variant: blocks fully inside the length keep the unmasked
-    specialization, blocks beyond it are skipped.
+    specialization, blocks beyond it are skipped. ``lengths[b] == 0``
+    (fully padded example) returns 0 for that row with zero gradients —
+    the dense oracle's mean(v) for an all-masked softmax is equally
+    meaningless there; mask the loss either way.
 
     ``key_mask`` ((B, T) bool/int, optional): EXACT arbitrary key mask —
     no contiguity assumption (left padding, mid-sequence holes). Every
@@ -569,7 +573,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if lengths is not None:
         if lengths.shape != (B,):
             raise ValueError(f"lengths must be ({B},), got {lengths.shape}")
-        lengths = jnp.clip(lengths.astype(jnp.int32), 1, T)
+        # length 0 = fully padded example: every block is skipped, the row
+        # outputs 0 and contributes zero gradients (same contract as an
+        # all-masked key_mask row) — do NOT clamp to 1, which would
+        # silently attend key 0 and diverge from the dense oracle
+        lengths = jnp.clip(lengths.astype(jnp.int32), 0, T)
     if key_mask is not None:
         if key_mask.shape != (B, T):
             raise ValueError(f"key_mask must be ({B}, {T}), got {key_mask.shape}")

@@ -184,6 +184,26 @@ class TestRaggedLengths:
         np.testing.assert_array_equal(np.asarray(dk[1, 9:]), 0.0)
         np.testing.assert_array_equal(np.asarray(dv[1, 9:]), 0.0)
 
+    def test_zero_length_example_is_fully_masked(self):
+        """lengths=0 (fully padded example) must output 0 with zero k/v
+        gradients — not silently attend key 0 (the old min-clamp)."""
+        q, k, v = _qkv(B=2, T=32, seed=15)
+        lengths = jnp.asarray([32, 0])
+
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, causal=True, lengths=lengths,
+                                block_q=16, block_k=16)
+            return o, jnp.sum(o ** 2)
+
+        o, _ = loss(q, k, v)
+        np.testing.assert_array_equal(np.asarray(o[1]), 0.0)
+        for backward in ("xla", "pallas"):
+            g = jax.grad(lambda *a: flash_attention(
+                *a, causal=True, lengths=lengths, backward=backward,
+                block_q=16, block_k=16).sum() ** 2, argnums=(0, 1, 2))(q, k, v)
+            np.testing.assert_array_equal(np.asarray(g[1][1]), 0.0)  # dk ex.1
+            np.testing.assert_array_equal(np.asarray(g[2][1]), 0.0)  # dv ex.1
+
     def test_bad_lengths_shape_rejected(self):
         q, k, v = _qkv(B=2, T=16, seed=14)
         with pytest.raises(ValueError, match="lengths"):
@@ -362,3 +382,21 @@ class TestPallasBackward:
         q, k, v = _qkv(T=16)
         with pytest.raises(ValueError, match="backward"):
             flash_attention(q, k, v, backward="mosaic")
+
+
+class TestGQAFlash:
+    def test_gqa_flash_equals_gqa_dense(self):
+        """KV groups broadcast upstream of the kernel: flash and dense must
+        agree for num_kv_heads < num_heads."""
+        from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+        x = jnp.asarray(np.random.default_rng(30).standard_normal((2, 24, 16)),
+                        jnp.float32)
+        dense = MultiHeadAttention(num_heads=4, num_kv_heads=2, causal=True)
+        flash = MultiHeadAttention(num_heads=4, num_kv_heads=2, causal=True,
+                                   flash=True)
+        p, s = dense.init(jax.random.PRNGKey(1), (24, 16))
+        assert p["w_qkv"].shape == (16, 16 + 2 * 8)  # d + 2 * d_kv
+        yd, _, _ = dense.apply(p, s, x)
+        yf, _, _ = flash.apply(p, s, x)
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yd),
+                                   rtol=1e-5, atol=1e-5)
