@@ -76,7 +76,7 @@ class CausalLM(ZooModel):
     def __init__(self, num_classes=None, seed=12345, input_shape=None, *,
                  num_layers=None, d_model=None, num_heads=None, vocab=None,
                  flash=False, remat=False, ring=False, pos="learned",
-                 num_kv_heads=None, **kw):
+                 num_kv_heads=None, window=None, **kw):
         super().__init__(num_classes, seed, input_shape, **kw)
         self.num_layers = num_layers or self.num_layers
         self.d_model = d_model or self.d_model
@@ -90,6 +90,7 @@ class CausalLM(ZooModel):
             raise ValueError(f"pos must be 'learned' or 'rope', got {pos!r}")
         self.pos = pos
         self.num_kv_heads = num_kv_heads  # GQA: shrink KV proj + decode cache
+        self.window = window  # sliding-window attention (Mistral-style)
 
     def build(self) -> Sequential:
         T = self.input_shape[0]
@@ -107,7 +108,8 @@ class CausalLM(ZooModel):
             b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=True,
                                               flash=self.flash, remat=self.remat,
                                               ring=self.ring, rope=rope,
-                                              num_kv_heads=self.num_kv_heads))
+                                              num_kv_heads=self.num_kv_heads,
+                                              window=self.window))
         b.layer(L.LayerNorm())
         b.layer(L.RnnOutput(n_out=self.vocab, activation="softmax", loss="mcxent"))
         return b.build()

@@ -47,7 +47,7 @@ _TOKEN_LOCAL = (ActivationLayer, AlphaDropout, Dense, DropoutLayer,
 
 
 def _mha_decode(num_heads: int, params, x, cache, pos, *, rope=False,
-                rope_base=10000.0, num_kv_heads=None):
+                rope_base=10000.0, num_kv_heads=None, window=None):
     """Decode a query chunk ``x`` (B, Tq, D) at absolute offset ``pos``
     against a KV cache {"k","v"}: (B, C, Hkv, hd). Returns (y, new_cache).
     Attention is causal by construction — the ``valid`` mask lets token t
@@ -79,7 +79,13 @@ def _mha_decode(num_heads: int, params, x, cache, pos, *, rope=False,
                                   (0, pos, 0, 0))
     C = ck.shape[1]
     scale = 1.0 / np.sqrt(hd)
-    valid = jnp.arange(C)[None, :] <= (pos + jnp.arange(Tq)[:, None])  # (Tq, C)
+    qpos = pos + jnp.arange(Tq)[:, None]
+    valid = jnp.arange(C)[None, :] <= qpos  # (Tq, C)
+    if window is not None:
+        # sliding window: only the last `window` cache slots are visible
+        # (cache stays full-capacity; the band mask honors the training
+        # semantics — a ring-buffer cache is a future memory optimization)
+        valid = valid & (qpos - jnp.arange(C)[None, :] < window)
     if Hkv != H:
         # grouped einsum: query heads fold into (Hkv, G) so the cache is
         # consumed at Hkv heads directly — repeating it to H would
@@ -137,7 +143,8 @@ def _decode_forward(model: Sequential, params, state, x, caches, pos):
             a, new[k] = _mha_decode(layer.num_heads, p["attn"], h, new[k],
                                     pos, rope=layer.rope,
                                     rope_base=layer.rope_base,
-                                    num_kv_heads=layer.num_kv_heads)
+                                    num_kv_heads=layer.num_kv_heads,
+                                    window=layer.window)
             x = x + a
             h = layer._ln(x, p["ln2_g"], p["ln2_b"])
             m = (_act.get(layer.activation)(h @ p["w_up"] + p["b_up"])
@@ -147,7 +154,8 @@ def _decode_forward(model: Sequential, params, state, x, caches, pos):
             x, new[k] = _mha_decode(layer.num_heads, p, x, new[k], pos,
                                     rope=layer.rope,
                                     rope_base=layer.rope_base,
-                                    num_kv_heads=layer.num_kv_heads)
+                                    num_kv_heads=layer.num_kv_heads,
+                                    window=layer.window)
         elif isinstance(layer, PositionalEmbedding):
             Tq = x.shape[1]
             x = x + lax.dynamic_slice(p["pos"], (pos, 0),

@@ -96,6 +96,8 @@ class MultiHeadAttention(Layer):
     num_kv_heads: Optional[int] = None  # GQA: < num_heads shrinks the KV
     # projection and decode cache by num_heads/num_kv_heads (MQA at 1);
     # None = standard MHA (one KV head per query head)
+    window: Optional[int] = None  # sliding-window attention (causal only):
+    # query t attends keys [t-window+1, t]; O(T*window) attention cost
 
     @property
     def kv_heads(self) -> int:
@@ -116,6 +118,16 @@ class MultiHeadAttention(Layer):
                 "w_o": wo, "b_o": jnp.zeros((d,), dtype)}, {}
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        if self.window is not None:
+            # validate ONCE at the layer so both paths agree: the dense
+            # fallback would otherwise silently ignore a non-causal window
+            # while the flash path raises at trace time
+            if not self.causal:
+                raise ValueError("window= requires causal=True "
+                                 "(sliding-window attention is a causal-LM "
+                                 "construct)")
+            if self.window < 1:
+                raise ValueError(f"window must be >= 1, got {self.window}")
         B, T, D = x.shape
         H = self.num_heads
         Hkv = self.kv_heads
@@ -125,21 +137,26 @@ class MultiHeadAttention(Layer):
         q = q.reshape(B, T, H, hd)
         k = k.reshape(B, T, Hkv, hd)
         v = v.reshape(B, T, Hkv, hd)
+        if self.rope:
+            # T here is the global length even under sequence parallelism
+            # (shard_map splitting happens inside ring_attention), so
+            # absolute positions are just arange(T). k rotates at Hkv heads
+            # BEFORE any GQA repeat — rope depends only on position and
+            # head_dim, so rotate-then-repeat == repeat-then-rotate at
+            # H/Hkv times less work.
+            pos = jnp.arange(T)
+            q = rope_rotate(q, pos, self.rope_base)
+            k = rope_rotate(k, pos, self.rope_base)
         if Hkv != H:
             # broadcast KV groups up to the query heads; the parameter and
             # decode-cache savings are upstream of this repeat
             k = jnp.repeat(k, H // Hkv, axis=2)
             v = jnp.repeat(v, H // Hkv, axis=2)
-        if self.rope:
-            # T here is the global length even under sequence parallelism
-            # (shard_map splitting happens inside ring_attention), so
-            # absolute positions are just arange(T)
-            pos = jnp.arange(T)
-            q = rope_rotate(q, pos, self.rope_base)
-            k = rope_rotate(k, pos, self.rope_base)
         drop = self.attn_dropout if (training and rng is not None) else 0.0
         ring_mesh = dp = tp = None
-        if self.ring and mask is None and drop == 0.0:
+        if self.ring and mask is None and drop == 0.0 and self.window is None:
+            # (ring attention computes full causal attention; a window
+            # routes through flash/dense so the band is actually honored)
             from ..api import ACTIVE_MESH
             from ...parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
@@ -164,11 +181,16 @@ class MultiHeadAttention(Layer):
             # (weights never materialized) falls back to dense.
             from ...ops.flash_attention import flash_attention
 
-            y = flash_attention(q, k, v, causal=self.causal, key_mask=mask)
+            y = flash_attention(q, k, v, causal=self.causal, key_mask=mask,
+                                window=self.window)
         else:
             attn_mask = None
             if self.causal:
                 causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+                if self.window is not None:
+                    band = (jnp.arange(T)[:, None] - jnp.arange(T)[None, :]
+                            < self.window)
+                    causal = causal & band
                 attn_mask = causal[None, None]
             if mask is not None:
                 key_mask = mask[:, None, None, :].astype(jnp.bool_)  # (B,1,1,Tk)
@@ -200,6 +222,7 @@ class TransformerEncoderBlock(Layer):
     rope: bool = False   # rotary positions on q/k inside the attention
     rope_base: float = 10000.0
     num_kv_heads: Optional[int] = None  # GQA (see MultiHeadAttention)
+    window: Optional[int] = None  # sliding-window attention (causal only)
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
@@ -238,7 +261,8 @@ class TransformerEncoderBlock(Layer):
         mha = MultiHeadAttention(num_heads=self.num_heads, causal=self.causal,
                                  flash=self.flash, ring=self.ring,
                                  rope=self.rope, rope_base=self.rope_base,
-                                 num_kv_heads=self.num_kv_heads)
+                                 num_kv_heads=self.num_kv_heads,
+                                 window=self.window)
         h = self._ln(x, params["ln1_g"], params["ln1_b"])
         a, _, _ = mha.apply(params["attn"], {}, h, training=training, rng=rng, mask=mask)
         x = x + a

@@ -40,7 +40,7 @@ NEG_INF = -1e30
 def _fwd_kernel(q_ref, k_ref, v_ref, lens_ref, kmask_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
                 bq: int, bk: int, t_actual: int, has_lens: bool,
-                has_kmask: bool):
+                has_kmask: bool, window: int = 0):
     """Mosaic-friendly layout notes: the (m, l) running stats live in
     (bq, 128) lane-replicated VMEM scratch (TPU vectors are (8, 128) tiles —
     1-D per-row scalars don't lower); lse is written as a (bq, 1) column so
@@ -83,6 +83,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, lens_ref, kmask_ref, o_ref, lse_ref,
                 valid = valid & (kmask_ref[0, 0] != 0)[None, :]
             if causal:
                 valid = valid & (k_pos <= q_pos)
+            if window:  # sliding window: q attends [q-window+1, q]
+                valid = valid & (q_pos - k_pos < window)
             s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[...]                      # (bq, 128) replicated
@@ -98,9 +100,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, lens_ref, kmask_ref, o_ref, lse_ref,
         else:  # interpret mode (tiny or odd blocks): plain broadcast works
             m_bk = jnp.broadcast_to(m_cur[:, :1], (m_cur.shape[0], bk))
         p = jnp.exp(s - m_bk)                                # (bq, bk)
-        if masked and has_kmask:
+        if masked:
             # a row whose every key so far is masked has m == NEG_INF, where
             # exp(s - m) = exp(0) = 1 for masked entries — zero p explicitly
+            # (reachable with kmask, and with window x lengths on padding
+            # rows whose window lies wholly beyond the example length)
             p = jnp.where(valid, p, 0.0)
         l_scr[...] = l_prev * alpha + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), l_prev.shape)
@@ -129,6 +133,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, lens_ref, kmask_ref, o_ref, lse_ref,
         on_diag = k_end - 1 > iq * bq  # any k_pos could exceed some q_pos
         interior = interior & jnp.logical_not(on_diag)
         reachable = (ik * bk <= (iq + 1) * bq - 1) & run  # skip above-diagonal
+        if window:
+            # skip key blocks entirely behind every q row's window; a block
+            # is interior only if its OLDEST (q, k) pair is still in-window
+            reachable = reachable & (k_end - 1 >= iq * bq - (window - 1))
+            interior = interior & ((iq + 1) * bq - 1 - ik * bk <= window - 1)
         pl.when(reachable & interior)(lambda: _accumulate(False))
         pl.when(reachable & jnp.logical_not(interior))(lambda: _accumulate(True))
     else:
@@ -159,7 +168,8 @@ def _mask_operands(lens, kmask, BH, tp, pad):
 
 
 def _flash_fwd(q, k, v, lens, kmask, scale: float, causal: bool, bq: int,
-               bk: int, interpret: bool, has_lens: bool, has_kmask: bool):
+               bk: int, interpret: bool, has_lens: bool, has_kmask: bool,
+               window: int = 0):
     import math
 
     BH, T, D = q.shape
@@ -174,7 +184,7 @@ def _flash_fwd(q, k, v, lens, kmask, scale: float, causal: bool, bq: int,
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, t_actual=T, has_lens=has_lens,
-                               has_kmask=has_kmask)
+                               has_kmask=has_kmask, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
@@ -207,17 +217,19 @@ def _flash_fwd(q, k, v, lens, kmask, scale: float, causal: bool, bq: int,
     return o[:, :T], lse[:, :T, 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, lens, kmask, scale, causal, bq, bk, interpret, backward):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, lens, kmask, scale, causal, bq, bk, interpret, backward,
+           window):
     o, _ = _flash_fwd(q, k, v, lens, kmask, scale, causal, bq, bk, interpret,
-                      lens is not None, kmask is not None)
+                      lens is not None, kmask is not None, window)
     return o
 
 
 def _flash_vjp_fwd(q, k, v, lens, kmask, scale, causal, bq, bk, interpret,
-                   backward):
+                   backward, window):
     o, lse = _flash_fwd(q, k, v, lens, kmask, scale, causal, bq, bk,
-                        interpret, lens is not None, kmask is not None)
+                        interpret, lens is not None, kmask is not None,
+                        window)
     return o, (q, k, v, lens, kmask, o, lse)
 
 
@@ -229,7 +241,7 @@ BWD_BLOCK_CAP = 512
 
 def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
               scale, causal, masked, iq, ik, bq, bk, t_actual, L=None,
-              kmask_row=None):
+              kmask_row=None, window=0):
     """Shared FlashAttention-2 backward recomputation for both passes:
     returns (p, ds) with p = exp(s - lse) (masked) and
     ds = p * (do @ v^T - delta) * scale. ``L`` (traced scalar): ragged
@@ -251,6 +263,8 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
             valid = valid & (kmask_row != 0)[None, :]
         if causal:
             valid = valid & (k_pos <= q_pos)
+        if window:
+            valid = valid & (q_pos - k_pos < window)
         p = jnp.where(valid, p, 0.0)
     do = do_ref[0].astype(jnp.float32)        # (bq, D)
     dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
@@ -263,7 +277,7 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
                    kmask_ref, dq_ref, dq_scr, *, scale: float, causal: bool,
                    bq: int, bk: int, t_actual: int, has_lens: bool,
-                   has_kmask: bool):
+                   has_kmask: bool, window: int = 0):
     """dQ pass: grid (BH, T/bq, T/bk), key blocks innermost sequential.
     Standard FlashAttention-2 recomputation: p = exp(s - lse);
     ds = p * (dp - delta) * scale; dq += ds @ k — accumulated in VMEM."""
@@ -282,7 +296,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
                           iq=iq, ik=ik, bq=bq, bk=bk, t_actual=t_actual,
                           L=L if masked else None,
                           kmask_row=(kmask_ref[0, 0]
-                                     if masked and has_kmask else None))
+                                     if masked and has_kmask else None),
+                          window=window if masked else 0)
         dq_scr[...] += lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -297,6 +312,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
         on_diag = k_end - 1 > iq * bq
         interior = interior & jnp.logical_not(on_diag)
         reachable = (ik * bk <= (iq + 1) * bq - 1) & run
+        if window:
+            reachable = reachable & (k_end - 1 >= iq * bq - (window - 1))
+            interior = interior & ((iq + 1) * bq - 1 - ik * bk <= window - 1)
         pl.when(reachable & interior)(lambda: _accumulate(False))
         pl.when(reachable & jnp.logical_not(interior))(lambda: _accumulate(True))
     else:
@@ -311,7 +329,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
                     kmask_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                     scale: float, causal: bool, bq: int, bk: int,
-                    t_actual: int, has_lens: bool, has_kmask: bool):
+                    t_actual: int, has_lens: bool, has_kmask: bool,
+                    window: int = 0):
     """dK/dV pass: grid (BH, T/bk, T/bq), query blocks innermost sequential.
     dv += p^T @ do; dk += ds^T @ q — both accumulated in VMEM. With ragged
     lengths, a key block fully beyond the length skips every accumulate, so
@@ -334,7 +353,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
                           iq=iq, ik=ik, bq=bq, bk=bk, t_actual=t_actual,
                           L=L if masked else None,
                           kmask_row=(kmask_ref[0, 0]
-                                     if masked and has_kmask else None))
+                                     if masked and has_kmask else None),
+                          window=window if masked else 0)
         # dv += p^T @ do ((bk, bq) @ (bq, D)); p in [0,1] — bf16 operand ok
         dv_scr[...] += lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
@@ -355,6 +375,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
         on_diag = (ik + 1) * bk - 1 > iq * bq
         interior = interior & jnp.logical_not(on_diag)
         reachable = (q_end - 1 >= ik * bk) & run  # some q row sees this k
+        if window:
+            # some (q, k) pair still in-window for this block pair; interior
+            # additionally needs the OLDEST pair in-window
+            reachable = reachable & (iq * bq <= (ik + 1) * bk - 1 + window - 1)
+            interior = interior & (q_end - 1 - ik * bk <= window - 1)
         pl.when(reachable & interior)(lambda: _accumulate(False))
         pl.when(reachable & jnp.logical_not(interior))(lambda: _accumulate(True))
     else:
@@ -368,7 +393,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
 
 
 def _flash_bwd_pallas(q, k, v, lens, kmask, o, lse, do, scale, causal, bq, bk,
-                      interpret):
+                      interpret, window=0):
     """Kernel-based flash backward (FlashAttention-2 decomposition): one
     pallas_call for dq (k innermost), one for dk/dv (q innermost)."""
     import math
@@ -394,7 +419,7 @@ def _flash_bwd_pallas(q, k, v, lens, kmask, o, lse, do, scale, causal, bq, bk,
     lens3, km3 = _mask_operands(lens, kmask, BH, tp, pad)
 
     common = dict(scale=scale, causal=causal, bq=bq, bk=bk, t_actual=T,
-                  has_lens=has_lens, has_kmask=has_kmask)
+                  has_lens=has_lens, has_kmask=has_kmask, window=window)
     vmem = pltpu.CompilerParams(vmem_limit_bytes=96 * 1024 * 1024)
 
     dq = pl.pallas_call(
@@ -456,17 +481,20 @@ def _flash_bwd_pallas(q, k, v, lens, kmask, o, lse, do, scale, causal, bq, bk,
 BACKWARD = "xla"
 
 
-def _flash_vjp_bwd(scale, causal, bq, bk, interpret, backward, res, do):
+def _flash_vjp_bwd(scale, causal, bq, bk, interpret, backward, window, res,
+                   do):
     if backward == "pallas":
         q, k, v, lens, kmask, o, lse = res
         dq, dk, dv = _flash_bwd_pallas(q, k, v, lens, kmask, o, lse, do,
-                                       scale, causal, bq, bk, interpret)
+                                       scale, causal, bq, bk, interpret,
+                                       window)
         return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
                 _lens_ct(lens), _lens_ct(kmask))
-    return _flash_vjp_bwd_xla(scale, causal, bq, bk, interpret, res, do)
+    return _flash_vjp_bwd_xla(scale, causal, bq, bk, interpret, window, res,
+                              do)
 
 
-def _flash_vjp_bwd_xla(scale, causal, bq, bk, interpret, res, do):
+def _flash_vjp_bwd_xla(scale, causal, bq, bk, interpret, window, res, do):
     """Flash backward: recompute probabilities per q block from (q, k, lse);
     scan over q blocks carrying (dk, dv) accumulators — peak memory
     O(bq·T), never (T, T)."""
@@ -502,6 +530,8 @@ def _flash_vjp_bwd_xla(scale, causal, bq, bk, interpret, res, do):
             valid = valid & (k_pos[None] < lens[:, None, None])
         if kmask is not None:  # exact (BH, T) key mask
             valid = valid & (kmask != 0)[:, None, :]
+        if window:  # sliding window: q attends [q-window+1, q]
+            valid = valid & (q_pos - k_pos < window)[None]
         # padded q rows (q_pos >= T) contribute nothing: their do is 0-padded
         p = jnp.where(valid, jnp.exp(s - lseb[..., None]), 0.0)
         dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p, dob)
@@ -535,7 +565,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: Optional[int] = None, block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     backward: Optional[str] = None,
-                    lengths=None, key_mask=None):
+                    lengths=None, key_mask=None,
+                    window: Optional[int] = None):
     """Memory-efficient exact attention. q, k, v: (B, T, H, D) (the layout of
     ``dot_product_attention``); returns (B, T, H, D).
 
@@ -560,6 +591,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ALL masked return 0 (the dense path returns mean(v) there — both are
     degenerate; mask the loss). Padded ROWS still emit (ignored) outputs.
 
+    ``window`` (int, optional, causal only): sliding-window attention —
+    query t attends keys [t-window+1, t]. Key blocks wholly behind the
+    window are SKIPPED, so attention cost scales O(T·window) instead of
+    O(T²/2): at T=64k with window=4k that is ~16x less attention work.
+    window >= T degrades to plain causal. Composes with lengths/key_mask.
+
     Default block sizes adapt to T, capped at 1024 — the measured optimum on
     v5e (T=4096 causal: ~21 TF/s at 1024x1024 or 2048x2048, 5x faster than
     dense attention and 4.5x faster than this kernel at its previous 128x128
@@ -570,6 +607,15 @@ def flash_attention(q, k, v, *, causal: bool = False,
         raise ValueError(f"q/k/v shapes must match, got {q.shape} {k.shape} {v.shape}")
     if lengths is not None and key_mask is not None:
         raise ValueError("pass lengths OR key_mask, not both")
+    if window is not None:
+        if not causal:
+            raise ValueError("window= requires causal=True (sliding-window "
+                             "attention is a causal-LM construct)")
+        window = int(window)
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if window >= T:
+            window = None  # full causal attention; keep the fast path
     if lengths is not None:
         if lengths.shape != (B,):
             raise ValueError(f"lengths must be ({B},), got {lengths.shape}")
@@ -610,5 +656,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
     lens_bh = None if lengths is None else jnp.repeat(lengths, H)
     km_bh = None if key_mask is None else jnp.repeat(key_mask, H, axis=0)
     o = _flash(to_bh(q), to_bh(k), to_bh(v), lens_bh, km_bh, scale, causal,
-               bq, bk, interpret, bw)
+               bq, bk, interpret, bw, window or 0)
     return o.reshape(B, H, T, D).transpose(0, 2, 1, 3)

@@ -39,6 +39,13 @@ JOBS = [
                           steps=3)),
 ]
 
+# sliding-window variant: window=4096 cuts attention work ~16x at T=64k —
+# the local-attention throughput row (tokens/s comparison vs full causal)
+JOBS.append(("longctx_t64k_w4k", dict(num_layers=12, d_model=1536, batch=1,
+                                      seq=65536, vocab=8192, flash=True,
+                                      remat=True, pos="rope", window=4096,
+                                      steps=3)))
+
 results = {}
 for name, kw in JOBS:
     try:

@@ -138,7 +138,7 @@ def jnp_stack_k(a, k):
 
 def bench_transformer(*, num_layers=12, d_model=1536, batch=8, seq=1024,
                       vocab=32000, flash=True, steps=15, smoke=False,
-                      micro=1, remat=False, pos="learned"):
+                      micro=1, remat=False, pos="learned", window=None):
     """The matmul-dominated envelope case (PERF.md: 440M CausalLM + flash
     kernel measured at MFU 0.45 where exact-BN ResNet-50 caps ~0.36-0.40).
     Sparse integer labels — no (B, T, V) one-hot. ``micro=N`` measures the
@@ -155,7 +155,8 @@ def bench_transformer(*, num_layers=12, d_model=1536, batch=8, seq=1024,
         num_layers, d_model, batch, seq, vocab, steps = 2, 64, 2, 64, 128, 2
     zm = CausalLM(seed=0, input_shape=(seq,), num_layers=num_layers,
                   d_model=d_model, num_heads=max(d_model // 64, 1),
-                  vocab=vocab, flash=flash, remat=remat, pos=pos)
+                  vocab=vocab, flash=flash, remat=remat, pos=pos,
+                  window=window)
     model = zm.build()
     if not smoke:
         model.config.compute_dtype = "bfloat16"

@@ -400,3 +400,110 @@ class TestGQAFlash:
         yf, _, _ = flash.apply(p, s, x)
         np.testing.assert_allclose(np.asarray(yf), np.asarray(yd),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestSlidingWindow:
+    """window= vs the dense band-masked oracle (causal & (q - k < W)) —
+    forward and BOTH backwards, window straddling block boundaries."""
+
+    def _dense(self, q, k, v, W):
+        T = q.shape[1]
+        d = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]
+        mask = ((d >= 0) & (d < W))[None, None]
+        return dot_product_attention(q, k, v, mask=mask)
+
+    @pytest.mark.parametrize("W", [7, 16, 33])
+    def test_forward_matches_banded_dense(self, W):
+        q, k, v = _qkv(B=2, T=48, seed=31)
+        o = flash_attention(q, k, v, causal=True, window=W,
+                            block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.asarray(self._dense(q, k, v, W)),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("backward", ["xla", "pallas"])
+    def test_grads_match_banded_dense(self, backward):
+        q, k, v = _qkv(B=2, T=48, seed=32)
+        W = 13  # straddles the 16-wide blocks
+
+        def lf(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True, window=W,
+                                           backward=backward,
+                                           block_q=16, block_k=16) ** 2)
+
+        def ld(q, k, v):
+            return jnp.sum(self._dense(q, k, v, W) ** 2)
+
+        gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(ld, argnums=(0, 1, 2))(q, k, v)
+        for n, a, b in zip("qkv", gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4, err_msg=n)
+
+    def test_window_composes_with_lengths(self):
+        q, k, v = _qkv(B=2, T=48, seed=33)
+        lengths = jnp.asarray([48, 20])
+        W = 9
+        o = flash_attention(q, k, v, causal=True, window=W, lengths=lengths,
+                            block_q=16, block_k=16)
+        T = 48
+        d = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]
+        band = ((d >= 0) & (d < W))[None, None]
+        keym = (jnp.arange(T)[None, :] < lengths[:, None])[:, None, None]
+        want = dot_product_attention(q, k, v, mask=band & keym)
+        # valid rows (t < length) must match the dense oracle exactly
+        np.testing.assert_allclose(np.asarray(o[0]), np.asarray(want[0]),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(o[1, :20]),
+                                   np.asarray(want[1, :20]),
+                                   rtol=1e-5, atol=1e-5)
+        # padding rows whose window is wholly beyond the length have no
+        # valid keys: flash returns 0 (the dense softmax over all -1e30
+        # returns mean(v) — both degenerate; ours is the documented one).
+        # Rows 20..27 still reach keys < 20 through the 9-wide window.
+        np.testing.assert_array_equal(np.asarray(o[1, 20 + W:]), 0.0)
+
+    def test_window_requires_causal(self):
+        q, k, v = _qkv(B=2, T=16, seed=34)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=4)
+
+    def test_window_ge_T_is_plain_causal(self):
+        q, k, v = _qkv(B=2, T=32, seed=35)
+        o1 = flash_attention(q, k, v, causal=True, window=999,
+                             block_q=16, block_k=16)
+        o2 = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_layer_window_matches_dense_layer(self):
+        from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+        x = jnp.asarray(np.random.default_rng(36).standard_normal((2, 32, 16)),
+                        jnp.float32)
+        fl = MultiHeadAttention(num_heads=2, causal=True, flash=True, window=5)
+        de = MultiHeadAttention(num_heads=2, causal=True, window=5)
+        p, s = de.init(jax.random.PRNGKey(0), (32, 16))
+        yf, _, _ = fl.apply(p, s, x)
+        yd, _, _ = de.apply(p, s, x)
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yd),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestWindowLayerValidation:
+    def test_non_causal_window_rejected_on_both_paths(self):
+        from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+        x = jnp.asarray(np.zeros((1, 8, 8), np.float32))
+        for flash in (False, True):
+            lay = MultiHeadAttention(num_heads=2, causal=False, window=4,
+                                     flash=flash)
+            p, s = lay.init(jax.random.PRNGKey(0), (8, 8))
+            with pytest.raises(ValueError, match="causal"):
+                lay.apply(p, s, x)
+
+    def test_zero_window_rejected(self):
+        from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+        x = jnp.asarray(np.zeros((1, 8, 8), np.float32))
+        lay = MultiHeadAttention(num_heads=2, causal=True, window=0)
+        p, s = lay.init(jax.random.PRNGKey(0), (8, 8))
+        with pytest.raises(ValueError, match=">= 1"):
+            lay.apply(p, s, x)
