@@ -154,6 +154,16 @@ class MultiHeadAttention(Layer):
             v = jnp.repeat(v, H // Hkv, axis=2)
         drop = self.attn_dropout if (training and rng is not None) else 0.0
         ring_mesh = dp = tp = None
+        if self.ring and self.window is not None:
+            import warnings
+
+            warnings.warn(
+                "ring=True is disabled because window= is set: ring "
+                "attention computes full causal attention, so the window "
+                "routes through flash/dense instead — per-device memory is "
+                "O(T), not ring's O(T/n). Drop window= to keep sequence "
+                "parallelism, or drop ring= to silence this.",
+                stacklevel=2)
         if self.ring and mask is None and drop == 0.0 and self.window is None:
             # (ring attention computes full causal attention; a window
             # routes through flash/dense so the band is actually honored)

@@ -595,7 +595,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     query t attends keys [t-window+1, t]. Key blocks wholly behind the
     window are SKIPPED, so attention cost scales O(T·window) instead of
     O(T²/2): at T=64k with window=4k that is ~16x less attention work.
-    window >= T degrades to plain causal. Composes with lengths/key_mask.
+    Windowed calls default to ``backward="pallas"`` — the Mosaic backward
+    skips out-of-window blocks too, while the XLA scan backward computes
+    full-width scores and only masks (pass ``backward="xla"`` to override;
+    correct, but no backward FLOPs saving). window >= T degrades to plain
+    causal. Composes with lengths/key_mask.
 
     Default block sizes adapt to T, capped at 1024 — the measured optimum on
     v5e (T=4096 causal: ~21 TF/s at 1024x1024 or 2048x2048, 5x faster than
@@ -628,7 +632,17 @@ def flash_attention(q, k, v, *, causal: bool = False,
         if key_mask.shape != (B, T):
             raise ValueError(f"key_mask must be ({B}, {T}), got {key_mask.shape}")
         key_mask = key_mask.astype(jnp.int8)
-    bw = backward if backward is not None else BACKWARD
+    if backward is not None:
+        bw = backward
+    elif window:
+        # the O(T·window) claim needs block SKIPPING in the backward too;
+        # the XLA scan backward computes full (bq, T) scores per q block
+        # and only masks, so windowed calls default to the Mosaic backward
+        # (chip-validated numerics; scripts/chip_flashbwd.py covers the
+        # windowed case)
+        bw = "pallas"
+    else:
+        bw = BACKWARD
     if bw not in ("pallas", "xla"):
         raise ValueError(f"backward must be 'pallas' or 'xla', got {bw!r}")
     if interpret is None:

@@ -507,3 +507,33 @@ class TestWindowLayerValidation:
         p, s = lay.init(jax.random.PRNGKey(0), (8, 8))
         with pytest.raises(ValueError, match=">= 1"):
             lay.apply(p, s, x)
+
+
+class TestWindowBackwardDefault:
+    def test_windowed_default_backward_matches_explicit_xla(self):
+        """window= defaults to the block-skipping pallas backward; numbers
+        must match the (masking-only) xla backward."""
+        q, k, v = _qkv(B=2, T=48, seed=40)
+
+        def loss(backward):
+            def f(q, k, v):
+                return jnp.sum(flash_attention(
+                    q, k, v, causal=True, window=11, backward=backward,
+                    block_q=16, block_k=16) ** 2)
+            return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        g_default = loss(None)   # -> pallas for windowed calls
+        g_xla = loss("xla")
+        for n, a, b in zip("qkv", g_default, g_xla):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4, err_msg=n)
+
+    def test_ring_plus_window_warns(self):
+        import warnings as w
+
+        from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+        x = jnp.asarray(np.zeros((1, 8, 8), np.float32))
+        lay = MultiHeadAttention(num_heads=2, causal=True, ring=True, window=4)
+        p, s = lay.init(jax.random.PRNGKey(0), (8, 8))
+        with pytest.warns(UserWarning, match="ring=True is disabled"):
+            lay.apply(p, s, x)

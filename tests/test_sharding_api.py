@@ -257,6 +257,33 @@ class TestRingThroughLayerStack:
                          x, y, steps=2, bs=4)
         chex.assert_trees_all_close(got, ref, rtol=1e-4, atol=1e-5)
 
+    def test_gqa_window_model_trains_sharded(self):
+        """GQA narrows the fused w_qkv; a window adds band masking — both
+        must train identically under dp x tp and unsharded (GSPMD shards
+        the uneven q|k|v column blocks as plain data placement)."""
+        from deeplearning4j_tpu.models import CausalLM
+        import optax
+
+        def build():
+            zm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=16,
+                          num_heads=4, num_kv_heads=2, vocab=32, pos="rope",
+                          window=5)
+            m = zm.build()
+            m.init()
+            return m
+
+        rng = np.random.default_rng(9)
+        ids = rng.integers(0, 32, (8, 17))
+        x, y = ids[:, :-1], np.eye(32, dtype=np.float32)[ids[:, 1:]]
+
+        ref = _fit_steps(Trainer(build(), seed=5, updater=optax.sgd(0.1)),
+                         x, y, steps=2, bs=4)
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2}, jax.devices()[:4])
+        got = _fit_steps(Trainer(build(), seed=5, updater=optax.sgd(0.1),
+                                 mesh=mesh, rules=TRANSFORMER_RULES),
+                         x, y, steps=2, bs=4)
+        chex.assert_trees_all_close(got, ref, rtol=1e-4, atol=1e-5)
+
     def test_ring_falls_back_without_mesh(self):
         """Same config, no mesh: must run (dense path) and match ring=False."""
         from deeplearning4j_tpu.nn import layers as L
