@@ -152,6 +152,8 @@ class UnigramTokenizerFactory(TokenizerFactory):
         c.max_word_len = self.max_word_len
         c._logtot = self._logtot
         c._log = dict(self._log)
+        if getattr(self, "_base_log", None) is not None:
+            c._base_log = dict(self._base_log)
         return c
 
     def add_word(self, word: str) -> None:
@@ -159,20 +161,50 @@ class UnigramTokenizerFactory(TokenizerFactory):
         (jieba ``suggest_freq`` style): give it a log-frequency just above
         the best competing split's path score. Merging user words at
         frequency 1 silently loses to splits into frequent components —
-        exactly the domain-compound case user dictionaries exist for."""
+        exactly the domain-compound case user dictionaries exist for.
+
+        Restrictions (by construction of ``create``): only han runs route
+        through Viterbi — kana/hangul/latin runs and punctuation are cut
+        off BEFORE the word DAG is built. A word containing any non-han
+        character (mixed-script compounds like 卡拉OK, pure-kana loanwords)
+        can therefore never match; such words are skipped with a
+        ``UserWarning`` rather than injected as dead weight. They are NOT
+        an error: the same lexicon is legitimate on an engine path (jieba
+        handles 卡拉OK via suggest_freq), so construction must not crash
+        based on which optional engine is importable.
+        The competing-split score is computed against the BASE table (user
+        words excluded), so the result is independent of the order words
+        are added in; the injected mass is deliberately NOT added to
+        ``_logtot`` (each user word would otherwise deflate every
+        previously added word's margin)."""
         if len(word) < 2:
             return
-        score = sum(self._log.get(w, 0.0) - self._logtot
-                    for w in self._viterbi(word))
+        if any(_char_block(c) != "han" for c in word):
+            import warnings
+
+            warnings.warn(
+                f"user word {word!r} contains non-han characters; the "
+                "unigram fallback only runs Viterbi over han runs, so the "
+                "word can never match and was skipped (engines like jieba "
+                "do handle such words when importable)", stacklevel=2)
+            return
+        base = getattr(self, "_base_log", None)
+        if base is None:
+            base = self._base_log = dict(self._log)
+        score = sum(base.get(w, 0.0) - self._logtot
+                    for w in self._viterbi_over(base, word))
         needed = score + self._logtot + 1e-9  # strictly beat the split
         self._log[word] = max(self._log.get(word, -1e18), needed)
         self.max_word_len = max(self.max_word_len, len(word))
 
     def _viterbi(self, text: str) -> List[str]:
+        return self._viterbi_over(self._log, text)
+
+    def _viterbi_over(self, logs, text: str) -> List[str]:
         n = len(text)
         best = [0.0] + [-1e18] * n
         back = [0] * (n + 1)
-        logs, logtot = self._log, self._logtot
+        logtot = self._logtot
         for j in range(1, n + 1):
             for L in range(1, min(self.max_word_len, j) + 1):
                 w = text[j - L:j]

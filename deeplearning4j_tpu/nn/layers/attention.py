@@ -74,8 +74,18 @@ class MultiHeadAttention(Layer):
     flash kernel (ops/flash_attention.py): O(T·block) memory instead of a
     (T, T) score tensor — the long-context fast path. Used when the mask is
     absent, pure-causal, or a (B, T) key mask (the kernel's exact
-    ``key_mask`` path — any mask pattern, no right-padding assumption);
-    attention dropout falls back to the dense path.
+    ``key_mask`` path — any mask pattern, no right-padding assumption, but
+    every key block pays the masked-path cost); attention dropout falls
+    back to the dense path.
+
+    ``ragged=True`` declares that any (B, T) mask handed to this layer is
+    RIGHT-PADDED (BERT-style: ones then zeros). The flash path then
+    converts it to per-example ``lengths`` and rides the kernel's ragged
+    path, which specializes interior blocks and skips key blocks beyond
+    the length entirely — strictly faster than the exact key_mask path.
+    The conversion is ``lengths = mask.sum(-1)``, so a mask that is NOT
+    right-padded silently attends differently from the dense oracle;
+    leave ragged=False (the default) for gappy/left-padded masks.
 
     ``ring=True`` routes through sequence-parallel ring attention
     (parallel/ring_attention.py) whenever the step is being traced under a
@@ -95,7 +105,13 @@ class MultiHeadAttention(Layer):
     rope_base: float = 10000.0
     num_kv_heads: Optional[int] = None  # GQA: < num_heads shrinks the KV
     # projection and decode cache by num_heads/num_kv_heads (MQA at 1);
-    # None = standard MHA (one KV head per query head)
+    # None = standard MHA (one KV head per query head). NOTE: on the
+    # flash/dense TRAINING paths KV is repeated to full H before attention
+    # (full-width (B,T,H,hd) transients) — the savings are in params,
+    # projection FLOPs, and the decode cache, not in attention compute; a
+    # num_kv_heads-aware kernel variant is future work.
+    ragged: bool = False  # (B, T) masks are right-padded: flash path uses
+    # the faster per-example lengths kernel path (see class docstring)
     window: Optional[int] = None  # sliding-window attention (causal only):
     # query t attends keys [t-window+1, t]; O(T*window) attention cost
 
@@ -187,12 +203,20 @@ class MultiHeadAttention(Layer):
             # flash kernel handles no-mask / pure-causal directly; a (B, T)
             # key mask rides the kernel's EXACT key_mask path (no
             # right-padding assumption — left-padded or gappy masks are
-            # honored bit-for-bit like the dense path). Attention dropout
-            # (weights never materialized) falls back to dense.
+            # honored bit-for-bit like the dense path), unless ragged=True
+            # declared right-padding, in which case the faster per-example
+            # lengths path (interior-block specialization + tail-block
+            # skipping) is used. Attention dropout (weights never
+            # materialized) falls back to dense.
             from ...ops.flash_attention import flash_attention
 
-            y = flash_attention(q, k, v, causal=self.causal, key_mask=mask,
-                                window=self.window)
+            if mask is not None and self.ragged:
+                lengths = mask.astype(jnp.int32).sum(axis=-1)
+                y = flash_attention(q, k, v, causal=self.causal,
+                                    lengths=lengths, window=self.window)
+            else:
+                y = flash_attention(q, k, v, causal=self.causal,
+                                    key_mask=mask, window=self.window)
         else:
             attn_mask = None
             if self.causal:
@@ -233,6 +257,8 @@ class TransformerEncoderBlock(Layer):
     rope_base: float = 10000.0
     num_kv_heads: Optional[int] = None  # GQA (see MultiHeadAttention)
     window: Optional[int] = None  # sliding-window attention (causal only)
+    ragged: bool = False  # (B, T) masks are right-padded -> flash lengths
+    # path (see MultiHeadAttention.ragged)
 
     def init(self, key, input_shape, dtype=jnp.float32):
         d = input_shape[-1]
@@ -272,7 +298,7 @@ class TransformerEncoderBlock(Layer):
                                  flash=self.flash, ring=self.ring,
                                  rope=self.rope, rope_base=self.rope_base,
                                  num_kv_heads=self.num_kv_heads,
-                                 window=self.window)
+                                 window=self.window, ragged=self.ragged)
         h = self._ln(x, params["ln1_g"], params["ln1_b"])
         a, _, _ = mha.apply(params["attn"], {}, h, training=training, rng=rng, mask=mask)
         x = x + a

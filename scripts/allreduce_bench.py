@@ -98,24 +98,30 @@ def virtual_mesh():
     code = r"""
 import time
 import jax, jax.numpy as jnp
+# the env var alone is NOT enough here: the hosting image's sitecustomize
+# registers the axon PJRT plugin and overrides jax_platforms, so devices()
+# would dial the (possibly wedged) tunnel — the explicit config.update is
+# what actually pins CPU (same as tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 devs = np.array(jax.devices()[:8])
 mesh = Mesh(devs, ("dp",))
 N = 25_557_032
-# one flat f32 buffer, replicated per worker (worst-case wire)
-g = jnp.ones((8, N // 8 * 8 // 8), jnp.float32)  # (dp, N/8) sharded rows
+# the FULL gradient buffer replicated on every worker (in_specs P(None)):
+# each device contributes all 25.6M f32 values, exactly the
+# ParallelWrapper shared_gradients wire pattern
+g = jnp.ones((N,), jnp.float32)
 
 @jax.jit
 def reduce_only(g):
     def f(g):
         return jax.lax.psum(g, "dp")
-    r = shard_map(f, mesh=mesh, in_specs=P("dp", None),
-                  out_specs=P("dp", None))(g)
+    r = shard_map(f, mesh=mesh, in_specs=P(None), out_specs=P(None))(g)
     # scalar readback below is the sync point (block_until_ready measured
     # unreliable for timing; see flashbwd_sweep.py)
-    return r, jnp.sum(r[:, ::4097])
+    return r, jnp.sum(r[::4097])
 
 r, s = reduce_only(g); float(s)
 t0 = time.perf_counter()
@@ -123,7 +129,7 @@ for _ in range(5):
     r, s = reduce_only(g)
     float(s)
 dt = (time.perf_counter() - t0) / 5
-mb = 2 * 7 / 8 * (N // 8) * 8 * 4 / 1e6
+mb = 2 * 7 / 8 * N * 4 / 1e6  # ring all-reduce: 2(n-1)/n of the buffer
 print(f"RESULT {dt*1e3:.2f} {mb:.0f}")
 """
     env = dict(os.environ, JAX_PLATFORMS="cpu",
@@ -134,7 +140,9 @@ print(f"RESULT {dt*1e3:.2f} {mb:.0f}")
         if line.startswith("RESULT"):
             ms, mb = line.split()[1:]
             return {"psum_ms_8dev_cpu": float(ms),
-                    "note": "CPU shared-memory ring; collective overhead "
+                    "ring_MB_per_worker": float(mb),
+                    "note": "full 25.6M-param buffer replicated per worker; "
+                            "CPU shared-memory ring; collective overhead "
                             "floor, not ICI wire"}
     return {"error": out.stderr[-300:]}
 

@@ -107,9 +107,9 @@ class TestLayerIntegration:
         np.testing.assert_allclose(np.asarray(yf), np.asarray(yd),
                                    rtol=1e-5, atol=1e-5)
 
-    def test_key_mask_routes_as_ragged_lengths(self):
-        """A (B, T) right-padded key mask on flash=True now rides the
-        kernel's ragged-lengths path and must EQUAL the dense masked
+    def test_key_mask_routes_exact_mask_path(self):
+        """A (B, T) key mask on flash=True (default ragged=False) rides the
+        kernel's exact key_mask path and must EQUAL the dense masked
         layer, not merely run."""
         from deeplearning4j_tpu.nn.layers import MultiHeadAttention
         x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 16, 8)),
@@ -121,6 +121,25 @@ class TestLayerIntegration:
             p, s, x, mask=mask)
         yd, _, _ = MultiHeadAttention(num_heads=2).apply(p, s, x, mask=mask)
         np.testing.assert_allclose(np.asarray(yf), np.asarray(yd),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_ragged_flag_routes_lengths_path(self):
+        """ragged=True converts a right-padded (B, T) mask to per-example
+        lengths (the kernel's faster ragged path) and must still EQUAL the
+        dense masked layer — including a zero-length example."""
+        from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+        x = jnp.asarray(np.random.default_rng(7).standard_normal((3, 16, 8)),
+                        jnp.float32)
+        mask = jnp.asarray(np.array([[1] * 10 + [0] * 6, [1] * 16, [0] * 16],
+                                    np.float32))
+        p, s = MultiHeadAttention(num_heads=2, flash=True, ragged=True).init(
+            jax.random.PRNGKey(0), (16, 8))
+        yf, _, _ = MultiHeadAttention(num_heads=2, flash=True,
+                                      ragged=True).apply(p, s, x, mask=mask)
+        yd, _, _ = MultiHeadAttention(num_heads=2).apply(p, s, x, mask=mask)
+        # all-masked rows are degenerate (dense softmax over -inf): compare
+        # only rows with at least one visible key
+        np.testing.assert_allclose(np.asarray(yf)[:2], np.asarray(yd)[:2],
                                    rtol=1e-5, atol=1e-5)
 
 class TestRaggedLengths:
