@@ -259,6 +259,200 @@ class UnigramTokenizerFactory(TokenizerFactory):
         return Tokenizer(tokens, self._pre)
 
 
+class JapaneseUnigramTokenizerFactory(TokenizerFactory):
+    """Unigram-LM Viterbi segmentation for Japanese — the kuromoji-class
+    replacement (reference: deeplearning4j-nlp-japanese, com.atilika.kuromoji
+    ViterbiBuilder/ViterbiSearcher over the ipadic lattice).
+
+    Japanese differs from Chinese in two ways that shape this class:
+
+    - words span script boundaries (kanji stem + okurigana: 強かった,
+      起きて), so the Viterbi runs over the full mixed kana/kanji run —
+      NOT per-script like the zh factory;
+    - inflection: the shipped lexicon (``data/ja_lexicon.txt``, built by
+      scripts/grow_ja_lexicon.py) stores every conjugated surface as its
+      own entry (the ipadic design), generated by conjugation-paradigm
+      expansion (ja_conjugation.py) from corpus + authored base forms.
+
+    Unknown words use a MeCab-style character-category model: an unseen
+    maximal katakana run is one candidate token (cost ``unk_katakana``),
+    unseen kanji n-grams cost ``unk_kanji_first + unk_kanji_char*(L-1)``
+    (longer unknown compounds are cheaper per char, so unseen proper
+    nouns group instead of shattering into singles), unseen single
+    hiragana cost ``unk_hiragana`` (high: function words are in-lexicon).
+    Defaults were grid-searched on a held-out slice of the Botchan corpus
+    (scripts/grow_ja_lexicon.py --tune), never on tests/data gold."""
+
+    def __init__(self, freqs: "Optional[dict]" = None,
+                 unk_katakana: float = 16.0,
+                 unk_kanji_first: float = 16.0,
+                 unk_kanji_char: float = 8.0,
+                 unk_hiragana: float = 15.0,
+                 max_word_len: int = 12):
+        super().__init__()
+        import math
+
+        if freqs is None:
+            from .cjk_lexicon import japanese_freqs
+
+            freqs = japanese_freqs()
+        self.max_word_len = max(max_word_len,
+                                max((len(w) for w in freqs), default=1))
+        self._logtot = math.log(max(sum(freqs.values()), 1))
+        self._log = {w: math.log(f) for w, f in freqs.items() if f > 0}
+        self.unk_katakana = unk_katakana
+        self.unk_kanji_first = unk_kanji_first
+        self.unk_kanji_char = unk_kanji_char
+        self.unk_hiragana = unk_hiragana
+
+    def clone(self) -> "JapaneseUnigramTokenizerFactory":
+        c = object.__new__(type(self))
+        TokenizerFactory.__init__(c)
+        c._pre = self._pre
+        c.max_word_len = self.max_word_len
+        c._logtot = self._logtot
+        c._log = dict(self._log)
+        c.unk_katakana = self.unk_katakana
+        c.unk_kanji_first = self.unk_kanji_first
+        c.unk_kanji_char = self.unk_kanji_char
+        c.unk_hiragana = self.unk_hiragana
+        if getattr(self, "_base_log", None) is not None:
+            c._base_log = dict(self._base_log)
+        return c
+
+    def add_word(self, word: str) -> None:
+        """User-dictionary injection at a frequency that beats the best
+        competing split (same mechanism as the zh factory). Kana/kanji
+        words only — others can never match and warn+skip."""
+        if len(word) < 2:
+            return
+        if any(_char_block(c) not in ("han", "hiragana", "katakana")
+               and c not in "ー々" for c in word):
+            # ー/々 extend kanji/kana runs in the Viterbi (人々, 時々,
+            # ラーメン), so words containing them are matchable
+            import warnings
+
+            warnings.warn(
+                f"user word {word!r} contains non-Japanese-script "
+                "characters; the segmenter only matches kana/kanji runs, "
+                "so it was skipped", stacklevel=2)
+            return
+        base = getattr(self, "_base_log", None)
+        if base is None:
+            base = self._base_log = dict(self._log)
+        score = sum(self._word_score(base, w)
+                    for w in self._viterbi_over(base, word))
+        self._log[word] = max(self._log.get(word, -1e18),
+                              score + self._logtot + 1e-9)
+        self.max_word_len = max(self.max_word_len, len(word))
+
+    def _word_score(self, logs, w):
+        lg = logs.get(w)
+        if lg is not None:
+            return lg - self._logtot
+        b = _char_block(w[0])
+        if b == "katakana":
+            return -self.unk_katakana
+        if b == "han":
+            return -(self.unk_kanji_first
+                     + self.unk_kanji_char * (len(w) - 1))
+        return -self.unk_hiragana
+
+    def _viterbi(self, text: str) -> List[str]:
+        return self._viterbi_over(self._log, text)
+
+    def _viterbi_over(self, logs, text: str) -> List[str]:
+        n = len(text)
+        blocks = [_char_block(c) if c not in "ー々" else "same"
+                  for c in text]
+        # ー/々 extend whichever run they appear in
+        for i, b in enumerate(blocks):
+            if b == "same":
+                blocks[i] = blocks[i - 1] if i else "katakana"
+        # kata_start[j]: start of the maximal katakana run ending at j-1
+        best = [0.0] + [-1e18] * n
+        back = [0] * (n + 1)
+        logtot = self._logtot
+        for j in range(1, n + 1):
+            # 1) lexicon words
+            for L in range(1, min(self.max_word_len, j) + 1):
+                w = text[j - L:j]
+                lg = logs.get(w)
+                if lg is not None:
+                    sc = best[j - L] + lg - logtot
+                    if sc > best[j]:
+                        best[j], back[j] = sc, j - L
+            bj = blocks[j - 1]
+            # 2) unknown single char
+            if bj == "hiragana":
+                sc = best[j - 1] - self.unk_hiragana
+                if sc > best[j]:
+                    best[j], back[j] = sc, j - 1
+            elif bj == "han":
+                # unknown kanji n-gram (all-han window)
+                i = j - 1
+                while i > 0 and blocks[i - 1] == "han" and j - i < 6:
+                    i -= 1
+                for s in range(i, j):
+                    sc = best[s] - (self.unk_kanji_first
+                                    + self.unk_kanji_char * (j - s - 1))
+                    if sc > best[j]:
+                        best[j], back[j] = sc, s
+            elif bj == "katakana":
+                # maximal katakana run ending at j (only when the run
+                # really ends here: groups loanwords as one token)
+                if j == n or blocks[j] != "katakana":
+                    i = j - 1
+                    while i > 0 and blocks[i - 1] == "katakana":
+                        i -= 1
+                    sc = best[i] - self.unk_katakana
+                    if sc > best[j]:
+                        best[j], back[j] = sc, i
+                # single-char fallback so the DP never dead-ends mid-run
+                sc = best[j - 1] - (self.unk_katakana + 4.0)
+                if sc > best[j]:
+                    best[j], back[j] = sc, j - 1
+        out: List[str] = []
+        j = n
+        while j > 0:
+            out.append(text[back[j]:j])
+            j = back[j]
+        return out[::-1]
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        i, n = 0, len(text)
+        run_start = None
+
+        def flush(end):
+            if run_start is not None:
+                tokens.extend(self._viterbi(text[run_start:end]))
+
+        while i < n:
+            ch = text[i]
+            b = _char_block(ch) if ch not in "ー々" else (
+                "han" if run_start is not None else "punct")
+            if b in ("han", "hiragana", "katakana"):
+                if run_start is None:
+                    run_start = i
+                i += 1
+                continue
+            flush(i)
+            run_start = None
+            if b in ("space", "punct"):
+                i += 1
+            else:  # latin/digit/hangul/etc runs emitted whole (same-block
+                #    run loop — a char outside the loop's block must still
+                #    advance, or non-Japanese scripts would spin forever)
+                j = i
+                while j < n and _char_block(text[j]) == b:
+                    j += 1
+                tokens.append(text[i:j])
+                i = j
+        flush(n)
+        return Tokenizer(tokens, self._pre)
+
+
 def segmentation_scores(factory: TokenizerFactory,
                         gold: Sequence[Sequence[str]],
                         sep: str = "") -> dict:
@@ -328,6 +522,28 @@ class _ScriptFallbackFactory(TokenizerFactory):
     def _load_engine(self):
         return None
 
+    def _init_unigram_chain(self, lexicon, shared_unigram):
+        """Shared stage selection for the dictionary-backed factories
+        (zh/ja): external engine → shared unigram-Viterbi factory (user
+        ``lexicon=`` words injected into a private clone at split-beating
+        frequencies) → max-match over the hand core. Only the selected
+        stage is constructed."""
+        TokenizerFactory.__init__(self)
+        lexicon = tuple(lexicon or ())
+        self._mm = None
+        if self._engine is not None:
+            return
+        if shared_unigram is not None:
+            self._mm = shared_unigram
+            if lexicon:  # private copy: user words must not leak across
+                self._mm = self._mm.clone()
+                for w in lexicon:
+                    self._mm.add_word(w)
+        else:
+            base = set(self.default_lexicon())
+            base.update(lexicon)
+            self._mm = MaxMatchTokenizerFactory(base) if base else None
+
     def create(self, text: str) -> Tokenizer:
         if self._engine is not None:
             return Tokenizer(self._engine(text), self._pre)
@@ -358,22 +574,8 @@ class ChineseTokenizerFactory(_ScriptFallbackFactory):
     selected stage is constructed (no dead 100k-word max-match build)."""
 
     def __init__(self, lexicon: Optional[Iterable[str]] = None):
-        TokenizerFactory.__init__(self)
-        lexicon = tuple(lexicon or ())
-        self._engine = self._load_engine(lexicon)
-        self._mm = None
-        if self._engine is not None:
-            return
-        if _shared_unigram() is not None:
-            self._mm = _shared_unigram()
-            if lexicon:  # private copy: user words must not leak across
-                self._mm = self._mm.clone()
-                for w in lexicon:
-                    self._mm.add_word(w)
-        else:
-            base = set(self.default_lexicon())
-            base.update(lexicon)
-            self._mm = MaxMatchTokenizerFactory(base) if base else None
+        self._engine = self._load_engine(tuple(lexicon or ()))
+        self._init_unigram_chain(lexicon, _shared_unigram())
 
     def default_lexicon(self):
         from .cjk_lexicon import CHINESE_CORE
@@ -398,8 +600,28 @@ class ChineseTokenizerFactory(_ScriptFallbackFactory):
             return None
 
 
+@lru_cache(maxsize=None)
+def _shared_ja_unigram() -> Optional["JapaneseUnigramTokenizerFactory"]:
+    """Default ja unigram factory, built once per process (same sharing
+    pattern as the zh ``_shared_unigram``)."""
+    from .cjk_lexicon import japanese_freqs
+
+    freqs = japanese_freqs()
+    return JapaneseUnigramTokenizerFactory(freqs) if freqs else None
+
+
 class JapaneseTokenizerFactory(_ScriptFallbackFactory):
-    """deeplearning4j-nlp-japanese (Kuromoji) equivalent."""
+    """deeplearning4j-nlp-japanese (Kuromoji) equivalent.
+
+    Fallback chain: fugashi/MeCab when importable → unigram-Viterbi over
+    the shipped frequency lexicon (conjugated surfaces are first-class
+    entries, so inflected text segments correctly; user ``lexicon=``
+    words injected at a split-beating frequency) → max-match over the
+    hand core → Unicode blocks."""
+
+    def __init__(self, lexicon: Optional[Iterable[str]] = None):
+        self._engine = self._load_engine()
+        self._init_unigram_chain(lexicon, _shared_ja_unigram())
 
     def default_lexicon(self):
         from .cjk_lexicon import JAPANESE_CORE

@@ -1,0 +1,112 @@
+"""Japanese conjugation paradigms (ipadic 活用型) — surface-form expansion.
+
+The reference vendors Kuromoji with the full ipadic binary dictionary
+(deeplearning4j-nlp-japanese/, com.atilika.kuromoji; the dictionary itself
+stores every conjugated surface as its own entry — that is how MeCab-family
+analyzers handle inflection). This module reproduces that design choice in
+data-light form: given a dictionary form and its ipadic conjugation class
+(活用型, e.g. ``五段・カ行イ音便``), generate the conjugated SURFACE forms so
+the unigram-Viterbi segmenter (cjk.py) can match inflected text without a
+morphological lattice.
+
+Paradigms are standard school-grammar tables (public knowledge; the same
+tables ipadic's own ``*.csv`` entries are generated from):
+
+- 五段 (godan) verbs: one row per consonant column, plus the euphonic-change
+  (音便) stem used before た/て — イ音便 (書く→書い), 促音便 (勝つ→勝っ),
+  撥音便 (読む→読ん).
+- 一段 (ichidan) verbs: drop る, invariant stem.
+- カ変 (来る) / サ変 (する): suppletive forms.
+- 形容詞 (i-adjectives): く/かっ/けれ stems; per the segmentation convention
+  used by the gold sets (and this framework's JapaneseTokenizerFactory),
+  the adjective past ``〜かった`` is emitted FUSED (one token), while verb
+  た/て stay separate tokens — so adjectives also generate the fused
+  ``かった``/``くなかった`` surfaces.
+
+Only surfaces are produced — no POS lattice, no connection-cost matrix;
+the unigram model treats each generated form as an independent entry at a
+discounted frequency of its base form's corpus count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+# 五段 ending tables: conj_type -> (dictionary ending, [conjugated endings],
+# onbin stem ending used before た/て). Conjugated endings cover 未然形
+# (negative stem), 連用形 (masu stem), 終止/連体 (dictionary), 仮定形,
+# 命令形, 未然ウ接続 (volitional stem); the onbin form is the surface that
+# precedes た/て (emitted as its own entry — た/て are separate tokens).
+_GODAN: Dict[str, Tuple[str, List[str], str]] = {
+    "五段・カ行イ音便": ("く", ["か", "き", "く", "け", "こ"], "い"),
+    "五段・カ行促音便": ("く", ["か", "き", "く", "け", "こ"], "っ"),  # 行く
+    "五段・ガ行": ("ぐ", ["が", "ぎ", "ぐ", "げ", "ご"], "い"),
+    "五段・サ行": ("す", ["さ", "し", "す", "せ", "そ"], "し"),
+    "五段・タ行": ("つ", ["た", "ち", "つ", "て", "と"], "っ"),
+    "五段・ナ行": ("ぬ", ["な", "に", "ぬ", "ね", "の"], "ん"),
+    "五段・バ行": ("ぶ", ["ば", "び", "ぶ", "べ", "ぼ"], "ん"),
+    "五段・マ行": ("む", ["ま", "み", "む", "め", "も"], "ん"),
+    "五段・ラ行": ("る", ["ら", "り", "る", "れ", "ろ"], "っ"),
+    "五段・ラ行アル": ("る", ["ら", "り", "る", "れ", "ろ"], "っ"),  # ある
+    "五段・ワ行促音便": ("う", ["わ", "い", "う", "え", "お"], "っ"),
+    "五段・ワ行ウ音便": ("う", ["わ", "い", "う", "え", "お"], "う"),  # 問う
+}
+
+# i-adjective endings: dictionary 〜い; stems: 〜く (adverbial/te-form base),
+# 〜かっ (past base), 〜けれ (conditional), bare stem (〜さ/〜そう attach).
+# Fused per-convention surfaces: かった, くなかった (see module docstring).
+_ADJ_TYPES = ("形容詞・アウオ段", "形容詞・イ段", "形容詞・イイ")
+
+
+def expand(base: str, conj_type: str) -> List[str]:
+    """All conjugated surface forms for ``base`` under ipadic class
+    ``conj_type`` (including ``base`` itself). Unknown classes return just
+    the base — expansion is best-effort breadth, not a validator."""
+    out = [base]
+    g = _GODAN.get(conj_type)
+    if g is not None:
+        end, rows, onbin = g
+        if base.endswith(end):
+            stem = base[:-len(end)]
+            out += [stem + e for e in rows] + [stem + onbin]
+        return _dedup(out)
+    if conj_type == "一段" or conj_type.startswith("一段・"):
+        if base.endswith("る"):
+            stem = base[:-1]
+            # stem serves 未然/連用 (見, 起き); ろ/よ imperative
+            out += [stem, stem + "れ", stem + "ろ", stem + "よ"]
+        return _dedup(out)
+    if conj_type.startswith("カ変"):
+        k = base[:-2]
+        if base.endswith("来る"):
+            out += [k + s for s in ("来", "来い", "来れ")]
+        elif base.endswith("くる"):
+            out += [k + s for s in ("き", "こ", "こい", "くれ")]
+        return _dedup(out)
+    if conj_type.startswith("サ変"):
+        if base.endswith("する"):
+            stem = base[:-2]
+            out += [stem + s for s in ("し", "さ", "せ", "すれ", "しろ", "せよ")]
+        elif base.endswith("ずる"):
+            stem = base[:-2]
+            out += [stem + s for s in ("じ", "ぜ", "ずれ", "じろ")]
+        return _dedup(out)
+    if conj_type in _ADJ_TYPES:
+        if base.endswith("い"):
+            stem = base[:-1]
+            if conj_type == "形容詞・イイ" and base.endswith("いい"):
+                stem = base[:-2] + "よ"  # いい→よく/よかった
+            out += [stem + s for s in
+                    ("く", "かっ", "かった", "けれ", "ければ",
+                     "くて", "くない", "くなかった")]
+        return _dedup(out)
+    return _dedup(out)
+
+
+def _dedup(xs: Iterable[str]) -> List[str]:
+    seen, out = set(), []
+    for x in xs:
+        if x and x not in seen:
+            seen.add(x)
+            out.append(x)
+    return out

@@ -374,6 +374,42 @@ class TestCJKSegmentationQuality:
         assert s["f1"] >= 0.70, s  # honest 1.3k-lexicon number (r4: 0.717)
         assert s["gold_words"] >= 1000
 
+    def test_japanese_unigram_viterbi(self):
+        """The kuromoji-class path (r5): 54k-entry frequency lexicon
+        (ipadic-corpus + conjugation expansion + authored + mined) through
+        the mixed-script unigram Viterbi. Measured r5: F1 0.8954 on the
+        hand-authored gold — the floor asserts with margin, and the
+        unigram must strictly beat the r4 max-match (0.717)."""
+        from deeplearning4j_tpu.nlp.cjk import (JapaneseUnigramTokenizerFactory,
+                                                MaxMatchTokenizerFactory,
+                                                segmentation_scores)
+        from deeplearning4j_tpu.nlp.cjk_lexicon import JAPANESE_CORE
+
+        gold = self._gold("cjk_gold_ja.txt")
+        uni = segmentation_scores(JapaneseUnigramTokenizerFactory(), gold)
+        mm = segmentation_scores(MaxMatchTokenizerFactory(JAPANESE_CORE), gold)
+        assert uni["f1"] >= 0.87, uni
+        assert uni["f1"] > mm["f1"], (uni, mm)
+
+    def test_japanese_user_dictionary(self):
+        """User lexicon words must actually win segmentation (split-beating
+        injection), including kanji+kana compounds the zh factory would
+        reject; non-Japanese-script words warn and skip."""
+        import warnings
+
+        from deeplearning4j_tpu.nlp.cjk import JapaneseTokenizerFactory
+
+        f = JapaneseTokenizerFactory(lexicon=["お好み焼き屋"])
+        if f._engine is not None:
+            pytest.skip("external ja engine active")
+        toks = f.create("駅前のお好み焼き屋で食べた").get_tokens()
+        assert "お好み焼き屋" in toks, toks
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            f2 = JapaneseTokenizerFactory(lexicon=["ABC商事"])
+            assert any("non-Japanese-script" in str(x.message) for x in w)
+        assert f2.create("こんにちは").get_tokens()
+
     def test_korean_morpheme_floor(self):
         from deeplearning4j_tpu.nlp.cjk import (KoreanTokenizerFactory,
                                                 segmentation_scores)
@@ -403,6 +439,11 @@ class TestCJKSegmentationQuality:
         j = segmentation_scores(JapaneseTokenizerFactory(),
                                 self._gold("cjk_gold_ja.txt"))
         # with jieba importable the zh factory IS the gold's author (~1.0);
-        # without it the unigram-Viterbi fallback measured 0.886
+        # without it the unigram-Viterbi fallback measured 0.886. ja routes
+        # through the unigram lexicon path (r5 measured 0.8954) — but an
+        # external MeCab engine follows raw-ipadic conventions (まし/た
+        # split where the gold fuses ました), so the raised floor only
+        # applies to the in-repo path.
         assert z["f1"] >= 0.87, z
-        assert j["f1"] >= 0.70, j
+        jf = JapaneseTokenizerFactory()
+        assert j["f1"] >= (0.70 if jf._engine is not None else 0.87), j
