@@ -430,8 +430,11 @@ class JapaneseUnigramTokenizerFactory(TokenizerFactory):
 
         while i < n:
             ch = text[i]
-            b = _char_block(ch) if ch not in "ー々" else (
-                "han" if run_start is not None else "punct")
+            # ー/々 extend a run AND can start one (ーメン in line-broken
+            # text, 々 after punctuation) — _viterbi_over's block scan
+            # treats a leading extender as katakana, so create() must not
+            # drop it as punctuation
+            b = "han" if ch in "ー々" else _char_block(ch)
             if b in ("han", "hiragana", "katakana"):
                 if run_start is None:
                     run_start = i
@@ -638,6 +641,137 @@ class JapaneseTokenizerFactory(_ScriptFallbackFactory):
             return None
 
 
+class KoreanMorphemeTokenizerFactory(TokenizerFactory):
+    """Lexicon-scored eojeol-internal morpheme splitting — the r5
+    replacement for the bare josa suffix heuristic (r4 VERDICT #4,
+    reference: deeplearning4j-nlp-korean KoreanTokenizer → OpenKoreanText,
+    whose tokenizer also scores candidate (stem, josa) parses against a
+    noun dictionary).
+
+    Per eojeol (space-delimited hangul run), three candidate parses are
+    scored and the best wins:
+
+    - WHOLE, known: ``log f(eojeol) - log total`` (protects nouns whose
+      surface merely *ends* in a particle char — 회의, 아이, 구두 — the
+      class of systematic errors a suffix heuristic cannot avoid);
+    - WHOLE, unknown: ``-(unk_stem_first + unk_stem_char·(L-1))`` — the
+      default for verb/adjective eojeols, whose endings stay attached per
+      the convention (full verbal morphology needs konlpy, used when
+      importable);
+    - SPLIT stem + one trailing particle (longest-match from the particle
+      inventory, compounds like 에서/에는/까지 first): stem scored like a
+      whole (known or unknown), particle costs ``particle_cost``.
+
+    Penalties are tuned on tests/data/cjk_dev_ko.txt (an r5-authored dev
+    set) — never on the r4 gold."""
+
+    #: case/topic particles + copulas splittable off an eojeol tail.
+    PARTICLES = ("에서는", "에서", "으로", "부터", "까지", "에게",
+                 "한테", "처럼", "보다", "마다", "에는", "와의", "과의",
+                 "입니다", "이지만", "이다", "이에요", "예요",
+                 "은", "는", "이", "가", "을", "를", "의", "에", "도",
+                 "만", "와", "과", "로", "께")
+
+    def __init__(self, freqs: "Optional[dict]" = None,
+                 unk_stem_first: float = 10.0,
+                 unk_stem_char: float = 3.5,
+                 particle_cost: float = 2.0):
+        super().__init__()
+        import math
+
+        if freqs is None:
+            from .cjk_lexicon import korean_freqs
+
+            freqs = korean_freqs()
+        self._logtot = math.log(max(sum(freqs.values()), 1))
+        self._log = {w: math.log(f) for w, f in freqs.items() if f > 0}
+        self.unk_stem_first = unk_stem_first
+        self.unk_stem_char = unk_stem_char
+        self.particle_cost = particle_cost
+
+    def clone(self) -> "KoreanMorphemeTokenizerFactory":
+        c = object.__new__(type(self))
+        TokenizerFactory.__init__(c)
+        c._pre = self._pre
+        c._logtot = self._logtot
+        c._log = dict(self._log)
+        c.unk_stem_first = self.unk_stem_first
+        c.unk_stem_char = self.unk_stem_char
+        c.particle_cost = self.particle_cost
+        return c
+
+    def add_word(self, word: str) -> None:
+        """Register a noun so WHOLE-known beats any false particle split
+        (and so real splits of ``word+josa`` eojeols see a known stem)."""
+        if not word or any(_char_block(c) != "hangul" for c in word):
+            import warnings
+
+            warnings.warn(f"user word {word!r} is not hangul; the Korean "
+                          "morpheme splitter only scores hangul eojeols, "
+                          "so it was skipped", stacklevel=2)
+            return
+        # beating a split means out-scoring stem+particle; the strongest
+        # competitor is a known prefix-stem, so inject just above it
+        need = max((self._log.get(word[:-len(p)], -1e18)
+                    for p in self.PARTICLES if word.endswith(p)
+                    and len(word) > len(p)), default=-1e18)
+        self._log[word] = max(self._log.get(word, -1e18), need + 1e-9,
+                              self._logtot - 8.0)
+
+    def _stem_score(self, w: str) -> float:
+        lg = self._log.get(w)
+        if lg is not None:
+            return lg - self._logtot
+        return -(self.unk_stem_first + self.unk_stem_char * (len(w) - 1))
+
+    def split_eojeol(self, e: str) -> List[str]:
+        best_score = self._stem_score(e)
+        best = [e]
+        for p in self.PARTICLES:
+            if e.endswith(p) and len(e) > len(p):
+                stem = e[:-len(p)]
+                sc = self._stem_score(stem) - self.particle_cost
+                if sc > best_score:
+                    best_score, best = sc, [stem, p]
+        return best
+
+    def create(self, text: str) -> Tokenizer:
+        tokens: List[str] = []
+        for tok in text.split():
+            run: List[str] = []
+            for ch in tok:
+                b = _char_block(ch)
+                if b == "hangul":
+                    run.append(ch)
+                else:
+                    if run:
+                        tokens.extend(self.split_eojeol("".join(run)))
+                        run.clear()
+                    if b not in ("space", "punct"):
+                        tokens.append(ch)
+            if run:
+                tokens.extend(self.split_eojeol("".join(run)))
+        # merge adjacent non-hangul singles back into runs (latin/digits)
+        merged: List[str] = []
+        for t in tokens:
+            if (merged and len(t) == 1 and _char_block(t) == "latin"
+                    and _char_block(merged[-1][-1]) == "latin"
+                    and all(_char_block(c) == "latin" for c in merged[-1])):
+                merged[-1] += t
+            else:
+                merged.append(t)
+        return Tokenizer(merged, self._pre)
+
+
+@lru_cache(maxsize=None)
+def _shared_ko_morph() -> Optional["KoreanMorphemeTokenizerFactory"]:
+    """Default ko morpheme factory, built once per process."""
+    from .cjk_lexicon import korean_freqs
+
+    freqs = korean_freqs()
+    return KoreanMorphemeTokenizerFactory(freqs) if freqs else None
+
+
 # Josa (case/topic particle) suffixes for the no-deps Korean fallback:
 # compound forms first (longest match), then single-char. Genuinely
 # ambiguous single-char splits are accepted as the cost of morpheme-level
@@ -654,14 +788,30 @@ class KoreanTokenizerFactory(_ScriptFallbackFactory):
     """deeplearning4j-nlp-korean (OpenKoreanText) equivalent. Hangul is
     space-delimited into eojeol units; ``split_particles`` (default True —
     the reference's analyzer emits morphemes) additionally splits trailing
-    josa particles / copulas off each eojeol via suffix matching. Full
-    morphological analysis needs konlpy, used automatically when
-    importable."""
+    josa particles / copulas off each eojeol. Since r5 the split is
+    lexicon-scored (:class:`KoreanMorphemeTokenizerFactory` over the
+    shipped ``data/ko_lexicon.txt``) rather than a bare suffix heuristic,
+    so nouns that merely end in a particle character (회의, 아이) stay
+    whole; the suffix heuristic remains as the lexicon-less fallback.
+    Full morphological analysis needs konlpy, used when importable."""
 
     def __init__(self, lexicon: Optional[Iterable[str]] = None,
                  split_particles: bool = True):
         self.split_particles = split_particles
-        super().__init__(lexicon)
+        # NOTE: the user lexicon deliberately does NOT feed the
+        # _ScriptFallbackFactory max-match base — with no default ko core
+        # that base would cover ONLY the user words and shatter every
+        # other eojeol into single chars. Korean eojeols come from
+        # whitespace (script_segment); user words go into the morpheme
+        # splitter, where they belong.
+        super().__init__(None)
+        self._morph = None
+        if self._engine is None and split_particles:
+            self._morph = _shared_ko_morph()
+            if self._morph is not None and lexicon:
+                self._morph = self._morph.clone()
+                for w in lexicon:
+                    self._morph.add_word(w)
 
     def _load_engine(self):
         try:
@@ -689,7 +839,10 @@ class KoreanTokenizerFactory(_ScriptFallbackFactory):
         out: List[str] = []
         for tok in t.get_tokens():
             if tok and _char_block(tok[0]) == "hangul":
-                out.extend(self._split_josa(tok))
+                if self._morph is not None:
+                    out.extend(self._morph.split_eojeol(tok))
+                else:
+                    out.extend(self._split_josa(tok))
             else:
                 out.append(tok)
         return Tokenizer(out, self._pre)

@@ -194,7 +194,12 @@ def build(write=True, dev_every=10):
     lexemes = {}  # (base, conj_type) -> observed count
     for sent in train:
         for surface, pos, conj_type, _form, base in sent:
-            if not _is_cjk_word(surface):
+            if not _is_cjk_word(surface) or len(surface) > 8:
+                # >8 chars is never a real ipadic word — it is the ipadic
+                # unknown-word handler emitting a whole unanalyzable run
+                # as one 名詞 (e.g. a 17-char hiragana fragment in
+                # Botchan); shipping those as entries would also inflate
+                # the Viterbi's max_word_len scan window
                 continue
             freqs[surface] += 1
             if conj_type != "*" and base != "*" and _is_cjk_word(base):

@@ -420,12 +420,37 @@ class TestCJKSegmentationQuality:
                         "suffix-splitting gold")
         gold = self._gold("cjk_gold_ko.txt")
         s = segmentation_scores(factory, gold, sep=" ")
-        assert s["f1"] >= 0.93, s  # r4 measured: 0.95
+        # r5: lexicon-scored morpheme Viterbi measured 0.9665 held-out
+        # (penalties tuned only on cjk_dev_ko.txt), up from the r4 suffix
+        # heuristic's 0.9515 — and it must actually beat that heuristic
+        assert s["f1"] >= 0.955, s
+        h = KoreanTokenizerFactory()
+        h._morph = None  # force the r4 suffix-heuristic path
+        sh = segmentation_scores(h, gold, sep=" ")
+        assert s["f1"] > sh["f1"], (s, sh)
         # eojeol mode scores FAR lower against morpheme gold — recorded so
         # the gap (what a real analyzer adds) stays visible
         e = segmentation_scores(KoreanTokenizerFactory(split_particles=False),
                                 gold, sep=" ")
         assert e["f1"] < 0.6, e
+
+    def test_korean_lexicon_blocks_false_splits(self):
+        """The class of systematic suffix-heuristic errors the lexicon
+        fixes: nouns whose surface ends in a particle character must stay
+        whole, while genuine noun+josa eojeols still split."""
+        from deeplearning4j_tpu.nlp.cjk import KoreanTokenizerFactory
+
+        f = KoreanTokenizerFactory()
+        if f._engine is not None or f._morph is None:
+            pytest.skip("needs the in-repo morpheme path")
+        toks = f.create("아이 회의 시간").get_tokens()
+        assert toks == ["아이", "회의", "시간"], toks
+        toks = f.create("회의가 아이들은").get_tokens()
+        assert "회의" in toks and "가" in toks, toks
+        # user dictionary: unknown proper noun ending in a particle char
+        fu = KoreanTokenizerFactory(lexicon=["나리"])
+        toks = fu.create("나리 나리가").get_tokens()
+        assert toks[0] == "나리" and "나리" in toks[1:], toks
 
     def test_factory_path_floor(self):
         """The user-facing factories (engine when importable, else the
