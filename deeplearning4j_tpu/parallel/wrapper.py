@@ -53,14 +53,22 @@ class ParallelWrapper:
       mode only): ``threshold`` (quantization magnitude), ``capacity_frac``
       (max fraction of params per message), ``quantize`` (True: ND4J-parity
       ±threshold messages; False: exact top-k values — dense-equivalent as
-      threshold→0 with full capacity).
+      threshold→0 with full capacity), ``staleness`` (0: synchronous
+      exchange; 1: the DCN-oriented ASYNC option — each worker applies its
+      own update immediately and peers' updates one step late, so the
+      compressed all-gather's inputs are ready at step entry and XLA
+      overlaps the collective with the step's compute. Deterministic
+      bounded staleness replaces the reference's staleness-tolerant queues,
+      EncodedGradientsAccumulator.java:33/FancyBlockingQueue.java; the
+      in-flight round is drained on ``_sync_model`` so replicas are
+      bit-identical again before any evaluate/save).
     """
 
     def __init__(self, model, mesh: Optional[Mesh] = None, mode: str = "shared_gradients",
                  averaging_frequency: int = 5, average_updater_state: bool = True,
                  seed: int = 0, threshold: float = 1e-3,
                  capacity_frac: Optional[float] = None, quantize: bool = True,
-                 rules=None, grad_accum: int = 1):
+                 rules=None, grad_accum: int = 1, staleness: int = 0):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
@@ -94,6 +102,21 @@ class ParallelWrapper:
         self.capacity_frac = (capacity_frac if capacity_frac is not None
                               else auto_capacity_frac(self.n_dev))
         self.quantize = quantize
+        # staleness=1 (encoded_gradients only): the DCN-oriented async
+        # option — peers' compressed updates are applied one step LATE, so
+        # the all-gather's inputs are ready at step entry and XLA overlaps
+        # the collective with the step's forward/backward compute instead
+        # of serializing after it. This is the EncodedGradientsAccumulator
+        # staleness-tolerant semantics (own update applied immediately,
+        # peers' whenever they arrive — here: deterministically next step)
+        # without queues or threads.
+        self.staleness = int(staleness)
+        if self.staleness not in (0, 1):
+            raise ValueError("staleness must be 0 (synchronous exchange) or "
+                             "1 (apply peers' previous-step updates)")
+        if self.staleness and mode != "encoded_gradients":
+            raise ValueError("staleness applies to mode='encoded_gradients' "
+                             "only (sync modes are exact by definition)")
 
         if mode == "shared_gradients":
             self._init_sync()
@@ -336,9 +359,19 @@ class ParallelWrapper:
         self.opt_state = jax.device_put(stack(tx.init(model.params)), dev_sh)
         self.residual = jax.device_put(jnp.zeros((n, size), jnp.float32), dev_sh)
         self._batch_sharding = dev_sh
+        stale = self.staleness
+        # each worker's encoded update from the previous step, not yet
+        # applied by peers (index slot 0 + value 0.0 = harmless no-op for
+        # the zero-init first round). Allocated in both staleness modes so
+        # the step signature stays uniform; the sync step passes it through.
+        self.pending_idx = jax.device_put(
+            jnp.zeros((n, capacity), jnp.int32), dev_sh)
+        self.pending_val = jax.device_put(
+            jnp.zeros((n, capacity), jnp.float32), dev_sh)
 
         def make_step(with_fm: bool, with_lm: bool):
-            def local_step(params, opt_state, net_state, residual, x, y, rng, *masks):
+            def local_step(params, opt_state, net_state, residual,
+                           pend_idx, pend_val, x, y, rng, *masks):
                 params, opt_state, net_state = (jax.tree.map(lambda a: a[0], t)
                                                 for t in (params, opt_state, net_state))
                 residual, x, y = residual[0], x[0], y[0]
@@ -347,6 +380,22 @@ class ParallelWrapper:
                 mask_kw = ({"mask": fm, "label_mask": lm}
                            if isinstance(model, Sequential)
                            else {"masks": fm, "label_masks": lm})
+
+                if stale:
+                    # apply peers' PREVIOUS-step updates first. The gather's
+                    # inputs are ready at step entry, so XLA schedules the
+                    # collective concurrently with this step's compute — the
+                    # latency-hiding the reference gets from async queues,
+                    # with deterministic bounded staleness of exactly 1.
+                    gp_idx = jax.lax.all_gather(pend_idx[0], DATA_AXIS)
+                    gp_val = jax.lax.all_gather(pend_val[0], DATA_AXIS)
+                    w = jax.lax.axis_index(DATA_AXIS)
+                    keep = (jnp.arange(n) != w)[:, None]  # own prev update
+                    #                                       already applied
+                    dense_prev = jnp.zeros((size,), jnp.float32).at[
+                        gp_idx.ravel()].add(
+                        jnp.where(keep, gp_val, 0.0).ravel() / n)
+                    params = optax.apply_updates(params, unravel(dense_prev))
 
                 def loss_fn(p):
                     loss, new_state = model.score(p, net_state, x, y, training=True,
@@ -368,23 +417,56 @@ class ParallelWrapper:
                     enc, new_residual = topk_encode(flat, threshold,
                                                     capacity, residual)
                     values = enc.values
+                expand = lambda t: jax.tree.map(lambda a: a[None], t)
+                if stale:
+                    # own update applied immediately (reference parity:
+                    # storeUpdate applies locally right away); it ships to
+                    # peers at the NEXT step via the pending carry
+                    dense_own = jnp.zeros((size,), jnp.float32).at[
+                        enc.indices].add(values / n)
+                    params = optax.apply_updates(params, unravel(dense_own))
+                    return (expand(params), expand(opt_state),
+                            expand(new_state), new_residual[None],
+                            enc.indices[None], values[None], loss[None])
                 g_idx = jax.lax.all_gather(enc.indices, DATA_AXIS)   # (n, k)
                 g_val = jax.lax.all_gather(values, DATA_AXIS)        # (n, k)
                 dense = jnp.zeros((size,), jnp.float32).at[g_idx.ravel()].add(
                     g_val.ravel() / n)
                 params = optax.apply_updates(params, unravel(dense))
-                expand = lambda t: jax.tree.map(lambda a: a[None], t)
                 return (expand(params), expand(opt_state), expand(new_state),
-                        new_residual[None], loss[None])
+                        new_residual[None], pend_idx, pend_val, loss[None])
 
-            n_in = 7 + int(with_fm) + int(with_lm)
+            n_in = 9 + int(with_fm) + int(with_lm)
             sharded = jax.shard_map(
                 local_step, mesh=mesh,
                 in_specs=(P(DATA_AXIS),) * n_in,
-                out_specs=(P(DATA_AXIS),) * 5,
+                out_specs=(P(DATA_AXIS),) * 7,
                 check_vma=False)
-            return jax.jit(sharded, donate_argnums=(0, 1, 2, 3))
+            return jax.jit(sharded, donate_argnums=(0, 1, 2, 3, 4, 5))
 
+        def flush_body(params, pend_idx, pend_val):
+            """Deliver the last pending round to peers (staleness drain):
+            after this every worker has applied every update exactly once,
+            so replicas are bit-identical again."""
+            params = jax.tree.map(lambda a: a[0], params)
+            g_idx = jax.lax.all_gather(pend_idx[0], DATA_AXIS)
+            g_val = jax.lax.all_gather(pend_val[0], DATA_AXIS)
+            w = jax.lax.axis_index(DATA_AXIS)
+            keep = (jnp.arange(n) != w)[:, None]
+            dense = jnp.zeros((size,), jnp.float32).at[g_idx.ravel()].add(
+                jnp.where(keep, g_val, 0.0).ravel() / n)
+            params = optax.apply_updates(params, unravel(dense))
+            expand = lambda t: jax.tree.map(lambda a: a[None], t)
+            return (expand(params), jnp.zeros_like(pend_idx),
+                    jnp.zeros_like(pend_val))
+
+        # jitted ONCE here (like self._steps): _sync_model runs the flush
+        # on every fit-end/evaluate/save, and a per-call closure would
+        # recompile each time
+        self._flush_pending = jax.jit(jax.shard_map(
+            flush_body, mesh=mesh, in_specs=(P(DATA_AXIS),) * 3,
+            out_specs=(P(DATA_AXIS),) * 3, check_vma=False),
+            donate_argnums=(0, 1, 2))
         self._steps = {}
         self._make_step_masked = make_step
 
@@ -460,8 +542,9 @@ class ParallelWrapper:
             for m in (mask, label_mask) if m is not None)
         if self.mode == "encoded_gradients":
             (self.params, self.opt_state, self.state, self.residual,
-             loss) = step(
+             self.pending_idx, self.pending_val, loss) = step(
                 self.params, self.opt_state, self.state, self.residual,
+                self.pending_idx, self.pending_val,
                 jax.device_put(xr, self._batch_sharding),
                 jax.device_put(yr, self._batch_sharding), rngs, *extra)
             return loss
@@ -477,6 +560,12 @@ class ParallelWrapper:
 
     def _sync_model(self):
         """Write averaged/replicated params back to the model (host copy)."""
+        if self.mode == "encoded_gradients" and self.staleness:
+            # drain the in-flight round so every update reached every
+            # worker exactly once (replicas identical again)
+            self.params, self.pending_idx, self.pending_val = \
+                self._flush_pending(self.params, self.pending_idx,
+                                    self.pending_val)
         if self.mode in ("averaging", "encoded_gradients"):
             self.model.params = jax.tree.map(lambda a: jax.device_get(a)[0], self.params)
             self.model.state = jax.tree.map(lambda a: jax.device_get(a)[0], self.state)

@@ -219,26 +219,13 @@ class TestBundledRealWeights:
             i = int(np.nonzero(y == digit)[0][0])
             assert pred[i] == digit, f"digit {digit} at index {i} -> {pred[i]}"
 
-    def test_corrupt_bundled_copy_is_rejected_and_deleted(self, tmp_path,
-                                                          monkeypatch):
+    def test_corrupt_bundled_copy_is_rejected_and_deleted(self, bundled_cache):
         """ZooModel.java:62-66 parity on the real checkpoint: corrupt the
-        cached copy -> checksum mismatch -> deleted -> clear error."""
-        import shutil
-        from pathlib import Path
-
-        import deeplearning4j_tpu.models.zoo as zoo
-
-        bundled = Path(__file__).parent / "data" / "pretrained"
-        if not (bundled / "lenet_digits.zip").exists():
-            pytest.skip("bundled checkpoint missing")
-        cache = tmp_path / "pretrained"
-        cache.mkdir(parents=True)
-        for f in bundled.iterdir():
-            shutil.copy(f, cache / f.name)
-        with open(cache / "lenet_digits.zip", "r+b") as f:
+        cached copy -> checksum mismatch -> deleted -> clear error. Uses
+        the same tmp-staged cache as the happy path (one staging logic)."""
+        with open(bundled_cache / "lenet_digits.zip", "r+b") as f:
             f.seek(100)
             f.write(b"\x00" * 64)
-        monkeypatch.setattr(zoo, "CACHE_DIR", cache)
         with pytest.raises(FileNotFoundError):
             LeNet(num_classes=10, seed=0).init_pretrained("digits")
-        assert not (cache / "lenet_digits.zip").exists()
+        assert not (bundled_cache / "lenet_digits.zip").exists()
