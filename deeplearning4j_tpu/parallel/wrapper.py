@@ -360,18 +360,36 @@ class ParallelWrapper:
         self.residual = jax.device_put(jnp.zeros((n, size), jnp.float32), dev_sh)
         self._batch_sharding = dev_sh
         stale = self.staleness
-        # each worker's encoded update from the previous step, not yet
-        # applied by peers (index slot 0 + value 0.0 = harmless no-op for
-        # the zero-init first round). Allocated in both staleness modes so
-        # the step signature stays uniform; the sync step passes it through.
-        self.pending_idx = jax.device_put(
-            jnp.zeros((n, capacity), jnp.int32), dev_sh)
-        self.pending_val = jax.device_put(
-            jnp.zeros((n, capacity), jnp.float32), dev_sh)
+        if stale:
+            # each worker's encoded update from the previous step, not yet
+            # applied by peers (index slot 0 + value 0.0 = harmless no-op
+            # for the zero-init first round). Only the stale step carries
+            # these — at capacity_frac=1.0 on a big model they are
+            # (n, size)-shaped, real memory.
+            self.pending_idx = jax.device_put(
+                jnp.zeros((n, capacity), jnp.int32), dev_sh)
+            self.pending_val = jax.device_put(
+                jnp.zeros((n, capacity), jnp.float32), dev_sh)
+
+        def apply_pending(params, pend_idx, pend_val):
+            """Apply PEERS' pending compressed updates (own excluded — it
+            was applied the step it was produced). Shared by the stale
+            step and the flush so the two can't drift apart."""
+            g_idx = jax.lax.all_gather(pend_idx[0], DATA_AXIS)
+            g_val = jax.lax.all_gather(pend_val[0], DATA_AXIS)
+            w = jax.lax.axis_index(DATA_AXIS)
+            keep = (jnp.arange(n) != w)[:, None]
+            dense = jnp.zeros((size,), jnp.float32).at[g_idx.ravel()].add(
+                jnp.where(keep, g_val, 0.0).ravel() / n)
+            return optax.apply_updates(params, unravel(dense))
 
         def make_step(with_fm: bool, with_lm: bool):
             def local_step(params, opt_state, net_state, residual,
-                           pend_idx, pend_val, x, y, rng, *masks):
+                           *pend_xy_rng_masks):
+                if stale:
+                    pend_idx, pend_val, x, y, rng, *masks = pend_xy_rng_masks
+                else:
+                    x, y, rng, *masks = pend_xy_rng_masks
                 params, opt_state, net_state = (jax.tree.map(lambda a: a[0], t)
                                                 for t in (params, opt_state, net_state))
                 residual, x, y = residual[0], x[0], y[0]
@@ -387,15 +405,7 @@ class ParallelWrapper:
                     # collective concurrently with this step's compute — the
                     # latency-hiding the reference gets from async queues,
                     # with deterministic bounded staleness of exactly 1.
-                    gp_idx = jax.lax.all_gather(pend_idx[0], DATA_AXIS)
-                    gp_val = jax.lax.all_gather(pend_val[0], DATA_AXIS)
-                    w = jax.lax.axis_index(DATA_AXIS)
-                    keep = (jnp.arange(n) != w)[:, None]  # own prev update
-                    #                                       already applied
-                    dense_prev = jnp.zeros((size,), jnp.float32).at[
-                        gp_idx.ravel()].add(
-                        jnp.where(keep, gp_val, 0.0).ravel() / n)
-                    params = optax.apply_updates(params, unravel(dense_prev))
+                    params = apply_pending(params, pend_idx, pend_val)
 
                 def loss_fn(p):
                     loss, new_state = model.score(p, net_state, x, y, training=True,
@@ -434,28 +444,24 @@ class ParallelWrapper:
                     g_val.ravel() / n)
                 params = optax.apply_updates(params, unravel(dense))
                 return (expand(params), expand(opt_state), expand(new_state),
-                        new_residual[None], pend_idx, pend_val, loss[None])
+                        new_residual[None], loss[None])
 
-            n_in = 9 + int(with_fm) + int(with_lm)
+            n_in = (7 + 2 * stale) + int(with_fm) + int(with_lm)
+            n_out = 5 + 2 * stale
             sharded = jax.shard_map(
                 local_step, mesh=mesh,
                 in_specs=(P(DATA_AXIS),) * n_in,
-                out_specs=(P(DATA_AXIS),) * 7,
+                out_specs=(P(DATA_AXIS),) * n_out,
                 check_vma=False)
-            return jax.jit(sharded, donate_argnums=(0, 1, 2, 3, 4, 5))
+            return jax.jit(sharded, donate_argnums=tuple(
+                range(4 + 2 * stale)))
 
         def flush_body(params, pend_idx, pend_val):
             """Deliver the last pending round to peers (staleness drain):
             after this every worker has applied every update exactly once,
             so replicas are bit-identical again."""
             params = jax.tree.map(lambda a: a[0], params)
-            g_idx = jax.lax.all_gather(pend_idx[0], DATA_AXIS)
-            g_val = jax.lax.all_gather(pend_val[0], DATA_AXIS)
-            w = jax.lax.axis_index(DATA_AXIS)
-            keep = (jnp.arange(n) != w)[:, None]
-            dense = jnp.zeros((size,), jnp.float32).at[g_idx.ravel()].add(
-                jnp.where(keep, g_val, 0.0).ravel() / n)
-            params = optax.apply_updates(params, unravel(dense))
+            params = apply_pending(params, pend_idx, pend_val)
             expand = lambda t: jax.tree.map(lambda a: a[None], t)
             return (expand(params), jnp.zeros_like(pend_idx),
                     jnp.zeros_like(pend_val))
@@ -541,12 +547,18 @@ class ParallelWrapper:
                            self._batch_sharding)
             for m in (mask, label_mask) if m is not None)
         if self.mode == "encoded_gradients":
-            (self.params, self.opt_state, self.state, self.residual,
-             self.pending_idx, self.pending_val, loss) = step(
-                self.params, self.opt_state, self.state, self.residual,
-                self.pending_idx, self.pending_val,
-                jax.device_put(xr, self._batch_sharding),
-                jax.device_put(yr, self._batch_sharding), rngs, *extra)
+            xd = jax.device_put(xr, self._batch_sharding)
+            yd = jax.device_put(yr, self._batch_sharding)
+            if self.staleness:
+                (self.params, self.opt_state, self.state, self.residual,
+                 self.pending_idx, self.pending_val, loss) = step(
+                    self.params, self.opt_state, self.state, self.residual,
+                    self.pending_idx, self.pending_val, xd, yd, rngs, *extra)
+            else:
+                (self.params, self.opt_state, self.state, self.residual,
+                 loss) = step(
+                    self.params, self.opt_state, self.state, self.residual,
+                    xd, yd, rngs, *extra)
             return loss
         self.params, self.opt_state, self.state, loss = step(
             self.params, self.opt_state, self.state,

@@ -1,29 +1,55 @@
 #!/usr/bin/env python
-"""Sweep BERT-base fine-tune batch sizes (and seq lens) on the real chip to
-find the best MFU point; goal: >=0.70 MFU (north-star) on this config."""
+"""Sweep BERT-base fine-tune batch/seq/flash on the real chip to find the
+best MFU point; goal: >=0.70 MFU (the declared north-star carrier after
+the ResNet conv/BN envelope analysis, PERF.md r3). Flash variants matter:
+at T=512 the (B, 12, 512, 512) attention tensors are the non-matmul tax
+the Pallas kernel removes."""
 import json
-import os
 import sys
+import threading
 
 sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/repo/scripts")
 
+SMOKE = "--smoke" in sys.argv
+if SMOKE:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+else:
+    out = {}
+    def probe():
+        import jax
+        out["d"] = jax.devices()
+    t = threading.Thread(target=probe, daemon=True)
+    t.start(); t.join(90)
+    if "d" not in out:
+        print("WEDGED"); raise SystemExit(3)
+    print("devices:", out["d"])
+
 import model_benches as mb
 from deeplearning4j_tpu.models import BertBase
 
+CONFIGS = ([(2, 128, True), (2, 128, False)] if SMOKE else
+           [(128, 128, False), (256, 128, False), (256, 128, True),
+            (32, 512, False), (64, 512, False),
+            (32, 512, True), (64, 512, True), (128, 512, True)])
+
 results = {}
-for batch, T, flash in [(128, 128, False), (256, 128, False),
-                        (32, 512, False), (64, 512, False)]:
+for batch, T, flash in CONFIGS:
     name = f"bert_b{batch}_t{T}" + ("_flash" if flash else "")
     try:
         r = mb.bench_model(
             name,
             lambda T=T, flash=flash: BertBase(num_classes=2, seed=0,
                                               input_shape=(T,), flash=flash).build(),
-            batch, (T,), 2, token_vocab=30522, on_tpu=True)
+            batch, (T,), 2, token_vocab=30522, on_tpu=not SMOKE,
+            steps=2 if SMOKE else 20)
         results[name] = r
         print(json.dumps(r), flush=True)
     except Exception as e:
-        print(f"{name}: {type(e).__name__}: {str(e)[:200]}", flush=True)
+        results[name] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+        print(f"{name}: ERROR {results[name]['error']}", flush=True)
 
-print(json.dumps(results, indent=1))
+with open("/tmp/bert_sweep_results.json", "w") as f:
+    json.dump(results, f, indent=1)
+print("DONE -> /tmp/bert_sweep_results.json")

@@ -15,19 +15,35 @@ import threading
 sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/repo/scripts")
 
-out = {}
-def probe():
+SMOKE = "--smoke" in sys.argv  # CPU shakeout: same code path (flash +
+#                                remat + rope + window), toy sizes
+if SMOKE:
     import jax
-    out["d"] = jax.devices()
-t = threading.Thread(target=probe, daemon=True)
-t.start(); t.join(90)
-if "d" not in out:
-    print("WEDGED"); raise SystemExit(3)
-print("devices:", out["d"])
+    jax.config.update("jax_platforms", "cpu")
+else:
+    out = {}
+    def probe():
+        import jax
+        out["d"] = jax.devices()
+    t = threading.Thread(target=probe, daemon=True)
+    t.start(); t.join(90)
+    if "d" not in out:
+        print("WEDGED"); raise SystemExit(3)
+    print("devices:", out["d"])
 
 import model_benches as mb
 
-JOBS = [
+# seq stays tiny: the Pallas kernel runs INTERPRETED on CPU, so every
+# extra block costs minutes, and the point is signatures, not speed
+SMOKE_JOBS = [
+    ("smoke_full", dict(num_layers=1, d_model=32, batch=1, seq=128,
+                        vocab=64, flash=True, remat=True, pos="rope",
+                        steps=1)),
+    ("smoke_window", dict(num_layers=1, d_model=32, batch=1, seq=128,
+                          vocab=64, flash=True, remat=True, pos="rope",
+                          window=64, steps=1)),
+]
+JOBS = SMOKE_JOBS if SMOKE else [
     # 12-layer d=1536 (the 440M family): T=16k, batch 2. pos="rope": no
     # learned table (100M params at T=64k) — the long-context design.
     ("longctx_t16k", dict(num_layers=12, d_model=1536, batch=2, seq=16384,
@@ -40,11 +56,13 @@ JOBS = [
 ]
 
 # sliding-window variant: window=4096 cuts attention work ~16x at T=64k —
-# the local-attention throughput row (tokens/s comparison vs full causal)
-JOBS.append(("longctx_t64k_w4k", dict(num_layers=12, d_model=1536, batch=1,
-                                      seq=65536, vocab=8192, flash=True,
-                                      remat=True, pos="rope", window=4096,
-                                      steps=3)))
+# the local-attention throughput row (tokens/s comparison vs full causal).
+# (smoke mode has its own window job; the full-size one must NOT leak in)
+if not SMOKE:
+    JOBS.append(("longctx_t64k_w4k", dict(num_layers=12, d_model=1536,
+                                          batch=1, seq=65536, vocab=8192,
+                                          flash=True, remat=True, pos="rope",
+                                          window=4096, steps=3)))
 
 results = {}
 for name, kw in JOBS:

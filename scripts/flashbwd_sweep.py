@@ -15,15 +15,24 @@ import time
 sys.path.insert(0, "/root/repo")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dl4j_tpu_jax_cache")
 
-out = {}
-def probe():
+SMOKE = "--smoke" in sys.argv  # CPU shape/signature shakeout: tiny sizes,
+#                                no probe, xla backward only (the Mosaic
+#                                kernel is TPU-only) — run before a chip
+#                                window so the real sweep can't die on a
+#                                Python error
+if SMOKE:
     import jax
-    out["d"] = jax.devices()
-t = threading.Thread(target=probe, daemon=True)
-t.start(); t.join(90)
-if "d" not in out:
-    print("WEDGED"); raise SystemExit(3)
-print("devices:", out["d"])
+    jax.config.update("jax_platforms", "cpu")
+else:
+    out = {}
+    def probe():
+        import jax
+        out["d"] = jax.devices()
+    t = threading.Thread(target=probe, daemon=True)
+    t.start(); t.join(90)
+    if "d" not in out:
+        print("WEDGED"); raise SystemExit(3)
+    print("devices:", out["d"])
 
 import jax
 import jax.numpy as jnp
@@ -62,13 +71,16 @@ def timed(backend, B, T, H, D, iters=10, dtype=jnp.bfloat16):
     return dt * 1e3
 
 
-for B, T, H, D in [(32, 512, 12, 64), (2, 2048, 8, 64), (2, 4096, 8, 64),
-                   (1, 8192, 8, 64)]:
-    tx = timed("xla", B, T, H, D)
+CONFIGS = ([(1, 256, 2, 32)] if SMOKE
+           else [(32, 512, 12, 64), (2, 2048, 8, 64), (2, 4096, 8, 64),
+                 (1, 8192, 8, 64)])
+for B, T, H, D in CONFIGS:
+    kw = {"iters": 2, "dtype": jnp.float32} if SMOKE else {}
+    tx = timed("xla", B, T, H, D, **kw)
     print(f"B{B} T{T}: xla {tx:.2f}ms", flush=True)
-    for cap in (256, 512, 1024):
+    for cap in () if SMOKE else (256, 512, 1024):
         fa.BWD_BLOCK_CAP = cap
         jax.clear_caches()  # cap is a trace-time constant; force retrace
-        tp = timed("pallas", B, T, H, D)
+        tp = timed("pallas", B, T, H, D, **kw)
         print(f"  pallas@{cap} {tp:.2f}ms ({tx/tp:.2f}x)", flush=True)
 print("DONE")
