@@ -40,10 +40,15 @@ class BertBase(ZooModel):
     num_classes = 2  # default classification head
 
     def __init__(self, num_classes=None, seed=12345, input_shape=None, *, small=False,
-                 flash=False, remat=False, **kw):
+                 flash=False, remat=False, ragged=True, **kw):
         super().__init__(num_classes, seed, input_shape, **kw)
         self.flash = flash
         self.remat = remat
+        # ragged=True (default): (B, T) masks are treated as RIGHT-PADDED
+        # (how BERT tokenizers pad) and ride the flash kernel's faster
+        # per-example-lengths path. Pass ragged=False for gappy/packed
+        # masks — they then take the exact key_mask path bit-for-bit.
+        self.ragged = ragged
         if small:  # test-sized variant
             self.num_layers, self.d_model, self.num_heads, self.vocab, self.max_len = 2, 64, 4, 1000, 128
 
@@ -56,7 +61,8 @@ class BertBase(ZooModel):
              .layer(L.PositionalEmbedding(max_len=self.max_len)))
         for _ in range(self.num_layers):
             b.layer(L.TransformerEncoderBlock(num_heads=self.num_heads, causal=False,
-                                              flash=self.flash, remat=self.remat))
+                                              flash=self.flash, remat=self.remat,
+                                              ragged=self.ragged))
         return (b.layer(L.LayerNorm())
                 .layer(L.GlobalPooling(mode="avg"))
                 .layer(L.Output(n_out=self.num_classes, activation="softmax", loss="mcxent"))

@@ -6,25 +6,13 @@ at T=512 the (B, 12, 512, 512) attention tensors are the non-matmul tax
 the Pallas kernel removes."""
 import json
 import sys
-import threading
 
 sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/repo/scripts")
 
-SMOKE = "--smoke" in sys.argv
-if SMOKE:
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-else:
-    out = {}
-    def probe():
-        import jax
-        out["d"] = jax.devices()
-    t = threading.Thread(target=probe, daemon=True)
-    t.start(); t.join(90)
-    if "d" not in out:
-        print("WEDGED"); raise SystemExit(3)
-    print("devices:", out["d"])
+from chiputil import smoke_or_probe
+
+SMOKE = smoke_or_probe()
 
 import model_benches as mb
 from deeplearning4j_tpu.models import BertBase

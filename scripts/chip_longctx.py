@@ -10,26 +10,14 @@ HBM; MFU is computed from compiled cost_analysis flops either way.
 """
 import json
 import sys
-import threading
 
 sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/repo/scripts")
 
-SMOKE = "--smoke" in sys.argv  # CPU shakeout: same code path (flash +
-#                                remat + rope + window), toy sizes
-if SMOKE:
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-else:
-    out = {}
-    def probe():
-        import jax
-        out["d"] = jax.devices()
-    t = threading.Thread(target=probe, daemon=True)
-    t.start(); t.join(90)
-    if "d" not in out:
-        print("WEDGED"); raise SystemExit(3)
-    print("devices:", out["d"])
+from chiputil import smoke_or_probe
+
+SMOKE = smoke_or_probe()  # CPU shakeout: same code path (flash + remat +
+#                           rope + window), toy sizes
 
 import model_benches as mb
 

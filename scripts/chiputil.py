@@ -1,0 +1,46 @@
+"""Shared chip-script prologue: --smoke CPU pin or wedge-safe TPU probe.
+
+Every on-chip experiment script starts the same way; the copies had
+already drifted (one lost the compile-cache env var, exit styles
+differed), so the prologue lives here once. Import and call BEFORE
+importing jax anywhere else:
+
+    from chiputil import smoke_or_probe
+    SMOKE = smoke_or_probe()
+"""
+
+import os
+import sys
+import threading
+
+
+def smoke_or_probe(timeout: float = 90.0) -> bool:
+    """--smoke: pin jax to CPU, return True. Otherwise probe the chip via
+    a daemon-thread watchdog (a wedged tunnel hangs jax.devices()
+    machine-wide) and hard-exit 3 on WEDGED — ``os._exit``, because a
+    plain SystemExit can hang joining PJRT threads (tpu_probe.py).
+
+    Sets JAX_COMPILATION_CACHE_DIR before jax initializes either way, so
+    chip runs keep the persistent compile cache."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/dl4j_tpu_jax_cache")
+    if "--smoke" in sys.argv:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    out = {}
+
+    def probe():
+        import jax
+
+        out["d"] = jax.devices()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout)
+    if "d" not in out:
+        print("WEDGED", flush=True)
+        os._exit(3)
+    print("devices:", out["d"], flush=True)
+    return False

@@ -10,28 +10,15 @@ leaves layout headroom, as named (and not run) in PERF.md's r3 floor
 analysis. Timing: value-neutral carry chain + one readback (see
 flashbwd_sweep.py).
 """
-import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, "/root/repo")
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dl4j_tpu_jax_cache")
+sys.path.insert(0, "/root/repo/scripts")
 
-SMOKE = "--smoke" in sys.argv
-if SMOKE:
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-else:
-    out = {}
-    def probe():
-        import jax
-        out["d"] = jax.devices()
-    t = threading.Thread(target=probe, daemon=True)
-    t.start(); t.join(90)
-    if "d" not in out:
-        print("WEDGED"); raise SystemExit(3)
-    print("devices:", out["d"])
+from chiputil import smoke_or_probe
+
+SMOKE = smoke_or_probe()
 
 import jax
 import jax.numpy as jnp

@@ -7,32 +7,19 @@ Timing discipline: `jax.block_until_ready` proved unreliable through the
 axon tunnel (flat 0.04ms for workloads that differ 100x in FLOPs), so every
 measurement forces a scalar device->host readback that depends on all three
 gradients — that fetch cannot complete before the computation has."""
-import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, "/root/repo")
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dl4j_tpu_jax_cache")
+sys.path.insert(0, "/root/repo/scripts")
 
-SMOKE = "--smoke" in sys.argv  # CPU shape/signature shakeout: tiny sizes,
-#                                no probe, xla backward only (the Mosaic
-#                                kernel is TPU-only) — run before a chip
-#                                window so the real sweep can't die on a
-#                                Python error
-if SMOKE:
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-else:
-    out = {}
-    def probe():
-        import jax
-        out["d"] = jax.devices()
-    t = threading.Thread(target=probe, daemon=True)
-    t.start(); t.join(90)
-    if "d" not in out:
-        print("WEDGED"); raise SystemExit(3)
-    print("devices:", out["d"])
+from chiputil import smoke_or_probe
+
+SMOKE = smoke_or_probe()  # CPU shape/signature shakeout: tiny sizes,
+#                           no probe, xla backward only (the Mosaic
+#                           kernel is TPU-only) — run before a chip
+#                           window so the real sweep can't die on a
+#                           Python error
 
 import jax
 import jax.numpy as jnp

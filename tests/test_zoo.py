@@ -117,6 +117,23 @@ class TestInstantiation:
         assert y.shape == (2, 3)
         np.testing.assert_allclose(np.asarray(y.sum(-1)), 1.0, rtol=1e-5)
 
+    def test_bert_flash_ragged_matches_dense(self):
+        """BertBase(flash=True) declares ragged=True (BERT batches are
+        right-padded), so a padded batch must ride the flash lengths path
+        AND produce the dense model's logits."""
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(1, 1000, (3, 32)), jnp.int32)
+        mask = jnp.asarray((np.arange(32)[None, :]
+                            < np.array([32, 20, 7])[:, None]).astype(np.float32))
+        zf = BertBase(small=True, num_classes=3, input_shape=(32,), flash=True)
+        mf = zf.init()
+        zd = BertBase(small=True, num_classes=3, input_shape=(32,))
+        md = zd.init()
+        md.params, md.state = mf.params, mf.state  # same weights
+        yf = np.asarray(mf.output(tokens, mask=mask))
+        yd = np.asarray(md.output(tokens, mask=mask))
+        np.testing.assert_allclose(yf, yd, rtol=2e-4, atol=2e-5)
+
     def test_causal_lm_trains(self):
         zm = CausalLM(seed=0, input_shape=(32,), num_layers=2, d_model=32,
                       num_heads=2, vocab=50)
