@@ -49,3 +49,14 @@ from .cjk import (ChineseTokenizerFactory, JapaneseTokenizerFactory,
 __all__ += ["ChineseTokenizerFactory", "JapaneseTokenizerFactory",
             "KoreanTokenizerFactory", "MaxMatchTokenizerFactory",
             "script_segment"]
+
+from .annotation import (Annotation, AnnotationSentenceIterator,
+                         AnnotationTokenizerFactory, AnnotatorPipeline,
+                         PosFilterTokenizerFactory,
+                         ScriptAwareTokenizerFactory, SentenceAnnotator,
+                         StemmerAnnotator, TokenizerAnnotator, porter_stem)
+__all__ += ["Annotation", "AnnotationSentenceIterator",
+            "AnnotationTokenizerFactory", "AnnotatorPipeline",
+            "PosFilterTokenizerFactory", "ScriptAwareTokenizerFactory",
+            "SentenceAnnotator", "StemmerAnnotator", "TokenizerAnnotator",
+            "porter_stem"]

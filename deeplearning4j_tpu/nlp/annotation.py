@@ -1,0 +1,528 @@
+"""Text annotation pipeline — the deeplearning4j-nlp-uima equivalent.
+
+The reference's UIMA pack (deeplearning4j-nlp-uima/, ~3.2k LoC) wraps UIMA
+analysis engines for sentence segmentation, tokenization, POS tagging and
+stemming, and exposes them through the same TokenizerFactory /
+SentenceIterator SPIs the rest of the NLP stack consumes
+(UimaTokenizerFactory.java, PosUimaTokenizerFactory.java,
+UimaSentenceIterator.java, annotator/{SentenceAnnotator,TokenizerAnnotator,
+PoStagger,StemmerAnnotator}.java). The Java-ecosystem machinery (UIMA CAS,
+OpenNLP models, ClearTK type systems) is replaced here by a light
+annotator-pipeline of the same shape:
+
+- :class:`Annotation` — a typed text span with features (the CAS record),
+- :class:`AnnotatorPipeline` — an ordered annotator chain over a document
+  (the AnalysisEngine aggregate),
+- :class:`SentenceAnnotator` — rule-based boundary detection (latin
+  terminators with abbreviation/initial/number guards + CJK 。！？),
+- :class:`TokenizerAnnotator` — token spans inside each sentence via any
+  :class:`~.tokenization.TokenizerFactory` (so the CJK packs plug in),
+- :class:`PosAnnotator` — POS features per token: a compact suffix/lexicon
+  English tagger + a Japanese table derived from the ipadic-segmented
+  corpus (``data/ja_pos.txt``, built by scripts/grow_ja_lexicon.py),
+- :class:`StemmerAnnotator` — Porter stemmer (SnowballStemmer parity).
+
+API-parity adapters: :class:`AnnotationTokenizerFactory`
+(=UimaTokenizerFactory: sentence-aware tokenization through the pipeline),
+:class:`PosFilterTokenizerFactory` (=PosUimaTokenizerFactory: keep only
+tokens whose POS is in ``allowed`` — the reference uses this for
+noun-phrase extraction), :class:`AnnotationSentenceIterator`
+(=UimaSentenceIterator: stream sentences from documents).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from .tokenization import (DefaultTokenizerFactory, SentenceIterator,
+                           Tokenizer, TokenizerFactory)
+
+# ---------------------------------------------------------------- records
+
+
+@dataclass
+class Annotation:
+    """A typed span over the document text (the UIMA CAS annotation)."""
+
+    begin: int
+    end: int
+    type: str                      # "sentence" | "token" | ...
+    features: Dict[str, str] = field(default_factory=dict)
+
+    def covered_text(self, text: str) -> str:
+        return text[self.begin:self.end]
+
+
+class Document:
+    """Annotated document: raw text + annotations by type (the CAS)."""
+
+    def __init__(self, text: str):
+        self.text = text
+        self.annotations: List[Annotation] = []
+
+    def select(self, type_: str) -> List[Annotation]:
+        return [a for a in self.annotations if a.type == type_]
+
+    def covered(self, a: Annotation) -> str:
+        return a.covered_text(self.text)
+
+
+class AnnotatorPipeline:
+    """Ordered annotator chain (AnalysisEngineFactory.createEngine
+    aggregate parity): ``process`` runs each annotator over the document
+    in order; later annotators see earlier ones' annotations."""
+
+    def __init__(self, annotators: Sequence["Annotator"]):
+        self.annotators = list(annotators)
+
+    def process(self, text: str) -> Document:
+        doc = Document(text)
+        for a in self.annotators:
+            a.annotate(doc)
+        return doc
+
+    @staticmethod
+    def default(tokenizer_factory: Optional[TokenizerFactory] = None,
+                pos: bool = False) -> "AnnotatorPipeline":
+        """The reference's default engine: sentence + tokenizer
+        (+ optional POS), UimaTokenizerFactory.defaultAnalysisEngine()."""
+        chain: List[Annotator] = [SentenceAnnotator(),
+                                  TokenizerAnnotator(tokenizer_factory)]
+        if pos:
+            chain.append(PosAnnotator())
+        return AnnotatorPipeline(chain)
+
+
+class Annotator:
+    def annotate(self, doc: Document) -> None:
+        raise NotImplementedError
+
+
+# ------------------------------------------------------------- sentences
+
+#: abbreviations that end with '.' but do not terminate a sentence
+_ABBREV = frozenset("""
+mr mrs ms dr prof sr jr st vs etc e.g i.e cf al inc ltd co corp dept est
+fig no vol pp approx jan feb mar apr jun jul aug sep sept oct nov dec mon
+tue wed thu fri sat sun u.s u.k a.m p.m ph.d m.d b.a m.a d.c
+""".split())
+
+_TERMINATORS = ".!?。！？…"
+_CLOSERS = "\"')]}»」』）"
+
+
+class SentenceAnnotator(Annotator):
+    """Rule-based sentence boundary detection (annotator/SentenceAnnotator
+    parity — the reference delegates to ClearTK's sentence engine; this is
+    a self-contained rule engine honest about its scope):
+
+    - latin '.', '!', '?' terminate unless the preceding word is a known
+      abbreviation, a single initial (J.), or the dot sits between digits
+      (3.14),
+    - CJK 。！？ and ellipsis always terminate,
+    - trailing quotes/brackets attach to the finished sentence,
+    - newlines (paragraph breaks) always terminate."""
+
+    def annotate(self, doc: Document) -> None:
+        text = doc.text
+        n = len(text)
+        start = 0
+        i = 0
+        while i < n:
+            ch = text[i]
+            if ch == "\n":
+                self._emit(doc, start, i)
+                start = i + 1
+                i += 1
+                continue
+            if ch in _TERMINATORS:
+                if ch == "." and self._is_non_boundary_dot(text, i):
+                    i += 1
+                    continue
+                j = i + 1
+                while j < n and text[j] in _TERMINATORS:  # "?!", "..."
+                    j += 1
+                while j < n and text[j] in _CLOSERS:
+                    j += 1
+                self._emit(doc, start, j)
+                start = j
+                i = j
+                continue
+            i += 1
+        self._emit(doc, start, n)
+
+    @staticmethod
+    def _is_non_boundary_dot(text: str, i: int) -> bool:
+        # digit.digit (3.14) — not a boundary
+        if 0 < i < len(text) - 1 and text[i - 1].isdigit() and text[i + 1].isdigit():
+            return True
+        # preceding word is an abbreviation or a single initial
+        j = i - 1
+        while j >= 0 and (text[j].isalpha() or text[j] == "."):
+            j -= 1
+        word = text[j + 1:i].lower()
+        if not word:
+            return False
+        return word in _ABBREV or (len(word) == 1 and word.isalpha())
+
+    @staticmethod
+    def _emit(doc: Document, begin: int, end: int) -> None:
+        while begin < end and doc.text[begin].isspace():
+            begin += 1
+        while end > begin and doc.text[end - 1].isspace():
+            end -= 1
+        if end > begin:
+            doc.annotations.append(Annotation(begin, end, "sentence"))
+
+
+# ---------------------------------------------------------------- tokens
+
+
+class ScriptAwareTokenizerFactory(TokenizerFactory):
+    """The pipeline's default tokenizer: latin text splits on whitespace
+    with punctuation stripped; CJK runs route through the language packs
+    (hangul → Korean, kana present → Japanese, han-only → Chinese) — so
+    one annotator chain handles mixed-language documents, the role the
+    UIMA engine aggregate plays in the reference."""
+
+    def create(self, text: str) -> Tokenizer:
+        from .cjk import _char_block
+
+        toks: List[str] = []
+
+        def emit(seg: str, kind: str) -> None:
+            if kind == "cjk":
+                toks.extend(self._cjk_factory(seg).create(seg).get_tokens())
+            else:
+                stripped = (w.strip("'\".,;:!?()[]{}«»「」『』")
+                            for w in seg.split())
+                toks.extend(t for t in stripped if t)
+
+        run: List[str] = []
+        run_kind: Optional[str] = None
+        for ch in text:
+            b = _char_block(ch)
+            kind = ("cjk" if b in ("han", "hiragana", "katakana", "hangul")
+                    or ch in "ー々。、！？" else "latin")
+            if run_kind is not None and kind != run_kind:
+                emit("".join(run), run_kind)
+                run.clear()
+            run.append(ch)
+            run_kind = kind
+        if run:
+            emit("".join(run), run_kind)
+        return Tokenizer(toks, self._pre)
+
+    @staticmethod
+    @lru_cache(maxsize=None)
+    def _factories():
+        from .cjk import (ChineseTokenizerFactory, JapaneseTokenizerFactory,
+                          KoreanTokenizerFactory)
+
+        return (ChineseTokenizerFactory(), JapaneseTokenizerFactory(),
+                KoreanTokenizerFactory())
+
+    def _cjk_factory(self, seg: str):
+        from .cjk import _char_block
+
+        zh, ja, ko = self._factories()
+        blocks = {_char_block(c) for c in seg}
+        if "hangul" in blocks:
+            return ko
+        if "hiragana" in blocks or "katakana" in blocks:
+            return ja
+        return zh
+
+
+class TokenizerAnnotator(Annotator):
+    """Token spans inside each sentence (annotator/TokenizerAnnotator
+    parity). Tokens come from any TokenizerFactory — the span positions
+    are recovered by left-to-right alignment of the factory's tokens
+    against the sentence text (factories may drop punctuation)."""
+
+    def __init__(self, factory: Optional[TokenizerFactory] = None):
+        self.factory = factory or ScriptAwareTokenizerFactory()
+
+    def annotate(self, doc: Document) -> None:
+        sentences = doc.select("sentence") or [
+            Annotation(0, len(doc.text), "sentence")]
+        for s in sentences:
+            sent_text = doc.covered(s)
+            pos = 0
+            for tok in self.factory.create(sent_text).get_tokens():
+                at = sent_text.find(tok, pos)
+                if at < 0:  # preprocessed token (lowercased etc.): align
+                    at = sent_text.lower().find(tok.lower(), pos)
+                    if at < 0:
+                        continue
+                doc.annotations.append(
+                    Annotation(s.begin + at, s.begin + at + len(tok),
+                               "token"))
+                pos = at + len(tok)
+
+
+# ------------------------------------------------------------------ POS
+
+# Compact English tagger: closed-class lexicon + suffix rules. The
+# reference ships OpenNLP's statistical tagger; the honest scope here is
+# the POS-FILTERing use case (PosUimaTokenizerFactory keeps nouns/verbs),
+# which needs coarse tags, not treebank precision.
+_EN_CLOSED = {
+    **{w: "DT" for w in ("the", "a", "an", "this", "that", "these", "those")},
+    **{w: "IN" for w in ("in", "on", "at", "by", "for", "with", "of", "to",
+                         "from", "into", "over", "under", "about")},
+    **{w: "CC" for w in ("and", "or", "but", "nor", "so", "yet")},
+    **{w: "PRP" for w in ("i", "you", "he", "she", "it", "we", "they",
+                          "me", "him", "her", "us", "them")},
+    **{w: "MD" for w in ("can", "could", "will", "would", "shall",
+                         "should", "may", "might", "must")},
+    **{w: "VB" for w in ("is", "are", "was", "were", "be", "been", "am",
+                         "has", "have", "had", "do", "does", "did")},
+}
+
+
+def _en_pos(word: str) -> str:
+    w = word.lower()
+    if w in _EN_CLOSED:
+        return _EN_CLOSED[w]
+    if w[0].isdigit():
+        return "CD"
+    if w.endswith("ly"):
+        return "RB"
+    if w.endswith(("ing", "ed")):
+        return "VB"
+    if w.endswith(("ous", "ful", "ive", "able", "ible", "al", "ic")):
+        return "JJ"
+    if word[0].isupper():
+        return "NNP"
+    return "NN"
+
+
+@lru_cache(maxsize=None)
+def _ja_pos_table() -> dict:
+    from pathlib import Path
+
+    p = Path(__file__).parent / "data" / "ja_pos.txt"
+    out = {}
+    if p.exists():
+        for line in p.read_text(encoding="utf-8").splitlines():
+            if line and not line.startswith("#"):
+                parts = line.split()
+                if len(parts) == 2:
+                    out[parts[0]] = parts[1]
+    return out
+
+
+class PosAnnotator(Annotator):
+    """POS feature per token (annotator/PoStagger parity). Honest scope:
+
+    - English (latin-script) tokens: the suffix/lexicon tagger,
+    - Japanese surfaces: the ipadic-corpus table (名詞/動詞/助詞...),
+      with unseen all-han compounds defaulting to 名詞 (kanji compounds
+      outside the table are overwhelmingly nouns),
+    - Korean: particles from the morpheme inventory tag 조사, everything
+      else 'X' (no offline ko tagger exists in this environment),
+    - anything untaggable (incl. CJK punctuation): 'X' — so a
+      :class:`PosFilterTokenizerFactory` never passes tokens the tagger
+      has no evidence about."""
+
+    def annotate(self, doc: Document) -> None:
+        from .cjk import KoreanMorphemeTokenizerFactory, _char_block
+
+        ja = _ja_pos_table()
+        ko_particles = frozenset(KoreanMorphemeTokenizerFactory.PARTICLES)
+        for t in doc.select("token"):
+            w = doc.covered(t)
+            blocks = {_char_block(c) for c in w}
+            if blocks <= {"latin"}:
+                t.features["pos"] = _en_pos(w)
+            elif w in ja:
+                t.features["pos"] = ja[w]
+            elif "hangul" in blocks:
+                t.features["pos"] = "조사" if w in ko_particles else "X"
+            elif blocks <= {"han"} and len(w) >= 2:
+                t.features["pos"] = "名詞"  # unseen kanji compound
+            elif blocks <= {"katakana"} and len(w) >= 2:
+                t.features["pos"] = "名詞"  # katakana loanword (モデル,
+                #                            データ — overwhelmingly nouns;
+                #                            the corpus predates them)
+            else:
+                t.features["pos"] = "X"
+
+
+# -------------------------------------------------------------- stemming
+
+
+def porter_stem(word: str) -> str:
+    """Porter stemming algorithm (StemmerAnnotator / SnowballStemmer
+    parity) — the standard 1980 rule cascade, steps 1a-5b."""
+    w = word.lower()
+    if len(w) <= 2:
+        return w
+
+    def cons(s, i):
+        c = s[i]
+        if c in "aeiou":
+            return False
+        if c == "y":
+            return i == 0 or not cons(s, i - 1)
+        return True
+
+    def measure(s):
+        m, prev_v = 0, False
+        for i in range(len(s)):
+            v = not cons(s, i)
+            if prev_v and not v:
+                m += 1
+            prev_v = v
+        return m
+
+    def has_vowel(s):
+        return any(not cons(s, i) for i in range(len(s)))
+
+    def double_cons(s):
+        return len(s) >= 2 and s[-1] == s[-2] and cons(s, len(s) - 1)
+
+    def cvc(s):
+        return (len(s) >= 3 and cons(s, len(s) - 3)
+                and not cons(s, len(s) - 2) and cons(s, len(s) - 1)
+                and s[-1] not in "wxy")
+
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif w.endswith("s") and not w.endswith("ss"):
+        w = w[:-1]
+    # step 1b
+    if w.endswith("eed"):
+        if measure(w[:-3]) > 0:
+            w = w[:-1]
+    elif w.endswith("ed") and has_vowel(w[:-2]):
+        w = w[:-2]
+        w = _post1b(w, double_cons, cvc, measure)
+    elif w.endswith("ing") and has_vowel(w[:-3]):
+        w = w[:-3]
+        w = _post1b(w, double_cons, cvc, measure)
+    # step 1c
+    if w.endswith("y") and has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+    # step 2/3/4 suffix maps (m-conditioned)
+    for cond_m, pairs in ((0, _STEP2), (0, _STEP3), (1, _STEP4)):
+        for suf, rep in pairs:
+            if w.endswith(suf):
+                stem = w[:-len(suf)]
+                if measure(stem) > cond_m:
+                    w = stem + rep
+                break
+    # step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        m = measure(stem)
+        if m > 1 or (m == 1 and not cvc(stem)):
+            w = stem
+    # step 5b
+    if measure(w) > 1 and double_cons(w) and w.endswith("l"):
+        w = w[:-1]
+    return w
+
+
+def _post1b(w, double_cons, cvc, measure):
+    if w.endswith(("at", "bl", "iz")):
+        return w + "e"
+    if double_cons(w) and w[-1] not in "lsz":
+        return w[:-1]
+    if measure(w) == 1 and cvc(w):
+        return w + "e"
+    return w
+
+
+_STEP2 = [("ational", "ate"), ("tional", "tion"), ("enci", "ence"),
+          ("anci", "ance"), ("izer", "ize"), ("abli", "able"),
+          ("alli", "al"), ("entli", "ent"), ("eli", "e"), ("ousli", "ous"),
+          ("ization", "ize"), ("ation", "ate"), ("ator", "ate"),
+          ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
+          ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"),
+          ("biliti", "ble")]
+_STEP3 = [("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+          ("ical", "ic"), ("ful", ""), ("ness", "")]
+_STEP4 = [("ement", ""), ("ance", ""), ("ence", ""), ("able", ""),
+          ("ible", ""), ("ant", ""), ("ment", ""), ("ent", ""),
+          ("sion", "s"), ("tion", "t"), ("ou", ""), ("ism", ""),
+          ("ate", ""), ("iti", ""), ("ous", ""), ("ive", ""), ("ize", ""),
+          ("er", ""), ("ic", ""), ("al", "")]
+
+
+class StemmerAnnotator(Annotator):
+    """Adds a ``stem`` feature to every token (StemmerAnnotator parity)."""
+
+    def annotate(self, doc: Document) -> None:
+        for t in doc.select("token"):
+            w = doc.covered(t)
+            if w.isascii() and w.isalpha():
+                t.features["stem"] = porter_stem(w)
+
+
+# -------------------------------------------------- SPI parity adapters
+
+
+class AnnotationTokenizerFactory(TokenizerFactory):
+    """UimaTokenizerFactory parity: tokenization through the full
+    sentence+token pipeline, so tokens never straddle sentence
+    boundaries and the same engine drives iterators and factories."""
+
+    def __init__(self, pipeline: Optional[AnnotatorPipeline] = None):
+        super().__init__()
+        self.pipeline = pipeline or AnnotatorPipeline.default()
+
+    def create(self, text: str) -> Tokenizer:
+        doc = self.pipeline.process(text)
+        toks = [doc.covered(t) for t in doc.select("token")]
+        return Tokenizer(toks, self._pre)
+
+
+class PosFilterTokenizerFactory(TokenizerFactory):
+    """PosUimaTokenizerFactory parity: emit only tokens whose coarse POS
+    is in ``allowed`` (the reference's noun-phrase extraction path).
+    English tags are Penn-style prefixes (NN/NNP/VB/JJ/RB/...), Japanese
+    ipadic top-level classes (名詞/動詞/形容詞/...); matching is by
+    prefix, so allowed={"NN"} keeps NN and NNP."""
+
+    def __init__(self, allowed: Iterable[str],
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        super().__init__()
+        self.allowed = tuple(allowed)
+        self.pipeline = AnnotatorPipeline([
+            SentenceAnnotator(), TokenizerAnnotator(tokenizer_factory),
+            PosAnnotator()])
+
+    def create(self, text: str) -> Tokenizer:
+        doc = self.pipeline.process(text)
+        toks = [doc.covered(t) for t in doc.select("token")
+                if t.features.get("pos", "").startswith(self.allowed)]
+        return Tokenizer(toks, self._pre)
+
+
+class AnnotationSentenceIterator(SentenceIterator):
+    """UimaSentenceIterator parity: stream sentences from documents
+    through the SentenceAnnotator."""
+
+    def __init__(self, documents: Iterable[str],
+                 pipeline: Optional[AnnotatorPipeline] = None):
+        # keep only the document handles; sentences stream lazily per
+        # document in __iter__ (BasicLineIterator's pattern) — a large
+        # corpus never materializes all sentences at once
+        self.documents = list(documents)
+        self.pipeline = pipeline or AnnotatorPipeline([SentenceAnnotator()])
+
+    def __iter__(self):
+        for d in self.documents:
+            doc = self.pipeline.process(d)
+            for s in doc.select("sentence"):
+                yield doc.covered(s)
+
+    def reset(self) -> None:
+        pass

@@ -2,20 +2,14 @@
 """On-chip validation + A/B of the Mosaic flash backward vs the XLA scan
 backward. Small sizes, no external timeout (sized to finish)."""
 import sys
-import threading
 import time
 
 sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/scripts")
 
-out = {}
-def probe():
-    import jax
-    out["d"] = jax.devices()
-t = threading.Thread(target=probe, daemon=True)
-t.start(); t.join(90)
-if "d" not in out:
-    print("WEDGED"); raise SystemExit(3)
-print("devices:", out["d"])
+from chiputil import smoke_or_probe
+
+SMOKE = smoke_or_probe()
 
 import jax
 import jax.numpy as jnp
@@ -58,29 +52,34 @@ def timed_grads(backend, B, T, H, D, causal=True, iters=8, dtype=np.float32):
     float(carry)  # single sync point for the chain
     return r, (time.perf_counter() - t0) / iters * 1e3
 
-# 1. correctness: pallas vs xla on-chip (f32, T=1024)
+# --smoke: CPU shakeout at tiny sizes (the Pallas kernel runs
+# interpreted on CPU; minutes per extra block) — same code paths
+T1 = 128 if SMOKE else 1024
+IT = 1 if SMOKE else 8
+
+# 1. correctness: pallas vs xla on-chip (f32)
 try:
-    gp, tp_ms = timed_grads("pallas", 2, 1024, 4, 64)
+    gp, tp_ms = timed_grads("pallas", 2, T1, 4, 64, iters=IT)
     print(f"pallas bwd compiles on TPU: OK  ({tp_ms:.2f} ms @T=1024)")
 except Exception as e:
     print(f"pallas bwd FAILED on TPU: {type(e).__name__}: {str(e)[:400]}")
     raise SystemExit(1)
-gx, tx_ms = timed_grads("xla", 2, 1024, 4, 64)
+gx, tx_ms = timed_grads("xla", 2, T1, 4, 64, iters=IT)
 for a, b, n in zip(gp, gx, "qkv"):
     err = float(jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(b)) + 1e-9))
     print(f"d{n} rel-max-err pallas vs xla: {err:.2e}")
     assert err < 2e-3, (n, err)
-print(f"T=1024 f32: pallas {tp_ms:.2f} ms vs xla {tx_ms:.2f} ms")
+print(f"T={T1} f32: pallas {tp_ms:.2f} ms vs xla {tx_ms:.2f} ms")
 
 # 1b. ragged-lengths Mosaic lowering: the lens scalar load + dynamic
 # interior predicates must agree with the dense key-masked oracle on chip
 # (interpret-mode equivalence already proven in tests/test_flash_attention.py)
 def ragged_check():
     rng = np.random.RandomState(3)
-    B, T, H, D = 3, 384, 4, 64
+    B, T, H, D = 3, (128 if SMOKE else 384), 4, 64
     q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
                for _ in range(3))
-    lengths = jnp.asarray([384, 130, 277])
+    lengths = jnp.asarray([T, T // 3, (3 * T) // 4])
     key_mask = (jnp.arange(T)[None, :] < lengths[:, None])[:, None, None]
     mask = key_mask & jnp.tril(jnp.ones((T, T), bool))[None, None]
 
@@ -102,20 +101,27 @@ def ragged_check():
             print(f"ragged {backend} d{n}: rel-max-err {err:.2e}")
             assert err < 2e-3, (backend, n, err)
 
-        # exact key_mask path (arbitrary mask: left pad + holes)
+        # exact key_mask path (arbitrary mask: left pad + holes).
+        # Rows with NO visible key under mask&causal are degenerate (the
+        # dense oracle softmaxes a -1e30 row to uniform junk, the kernel
+        # emits zeros — neither is "correct"), so the comparison loss
+        # weights them out; everything defined must still match.
         km = np.ones((B, T), bool)
-        km[1, :120] = False          # left-padded
-        km[2, 100:180] = False       # mid-sequence hole
+        km[1, :T // 3] = False       # left-padded
+        km[2, T // 4:T // 2] = False  # mid-sequence hole
         kmj = jnp.asarray(km)
         maskx = kmj[:, None, None, :] & jnp.tril(jnp.ones((T, T), bool))[None, None]
+        valid_row = jnp.any(maskx, axis=-1).astype(jnp.float32)  # (B,1,T)
+        vw = valid_row[..., None].swapaxes(1, 2)                 # (B,T,1,1)
 
         def loss_fm(q, k, v):
             o = fa.flash_attention(q, k, v, causal=True, key_mask=kmj,
                                    backward=backend)
-            return jnp.sum(o ** 2)
+            return jnp.sum((o * vw) ** 2)
 
         def loss_dm(q, k, v):
-            return jnp.sum(attn.dot_product_attention(q, k, v, mask=maskx) ** 2)
+            o = attn.dot_product_attention(q, k, v, mask=maskx)
+            return jnp.sum((o * vw) ** 2)
 
         gf = jax.jit(jax.grad(loss_fm, argnums=(0, 1, 2)))(q, k, v)
         gd = jax.jit(jax.grad(loss_dm, argnums=(0, 1, 2)))(q, k, v)
@@ -124,7 +130,7 @@ def ragged_check():
             print(f"keymask {backend} d{n}: rel-max-err {err:.2e}")
             assert err < 2e-3, (backend, n, err)
         # sliding window band
-        W = 96
+        W = min(96, T // 2)
         d = jnp.arange(T)[:, None] - jnp.arange(T)[None, :]
         bandm = ((d >= 0) & (d < W))[None, None]
 
@@ -148,7 +154,7 @@ def ragged_check():
 ragged_check()
 
 # 2. long-context bf16 timing (the regime the kernel targets)
-for T in (2048, 4096):
+for T in (() if SMOKE else (2048, 4096)):
     _, tp_ms = timed_grads("pallas", 2, T, 8, 64, dtype=jnp.bfloat16, iters=5)
     _, tx_ms = timed_grads("xla", 2, T, 8, 64, dtype=jnp.bfloat16, iters=5)
     print(f"T={T} bf16 B=2 H=8: pallas {tp_ms:.2f} ms vs xla {tx_ms:.2f} ms "

@@ -3,23 +3,37 @@
 BERT T=512 flash. Probe-guarded; each job fenced; sized to finish."""
 import json
 import sys
-import threading
 
 sys.path.insert(0, "/root/repo")
 sys.path.insert(0, "/root/repo/scripts")
 
-out = {}
-def probe():
-    import jax
-    out["d"] = jax.devices()
-t = threading.Thread(target=probe, daemon=True)
-t.start(); t.join(90)
-if "d" not in out:
-    print("WEDGED"); raise SystemExit(3)
-print("devices:", out["d"])
+from chiputil import smoke_or_probe
+
+SMOKE = smoke_or_probe()
 
 import model_benches as mb
 from deeplearning4j_tpu.models import BertBase, GravesLSTMCharRNN, LeNet
+
+SMOKE_JOBS = [
+    # same code paths at toy sizes (megastep spe, scan_unroll, micro
+    # grad_accum, BERT flash) — the pre-window shakeout
+    ("smoke_transformer_micro2", lambda: mb.bench_transformer(
+        num_layers=2, d_model=64, batch=2, seq=32, vocab=128, flash=False,
+        steps=2, micro=2)),
+    ("smoke_charrnn_u4", lambda: mb.bench_model(
+        "smoke_charrnn_u4", lambda: GravesLSTMCharRNN(
+            seed=0, tbptt=0, scan_unroll=4).build(),
+        8, (16, 98), 98, seq=True, spe=2, steps=2, on_tpu=False)),
+    ("smoke_lenet_spe", lambda: mb.bench_model(
+        "smoke_lenet_spe", lambda: LeNet(num_classes=10, seed=0,
+                                         input_shape=(28, 28, 1)).build(),
+        16, (28, 28, 1), 10, spe=2, steps=2, on_tpu=False)),
+    ("smoke_bert_flash", lambda: mb.bench_model(
+        "smoke_bert_flash", lambda: BertBase(
+            small=True, num_classes=2, seed=0, input_shape=(128,),
+            flash=True).build(),
+        2, (128,), 2, token_vocab=1000, steps=2, on_tpu=False)),
+]
 
 JOBS = [
     # 738M: optimizer-amortization A/B (batch 4 microbatch, 1/2/4 accum)
@@ -106,7 +120,10 @@ def bench_bert_inference(batch=64, T=128, iters=30):
             "samples_per_sec": round(batch / dt, 1)}
 
 
-JOBS.append(("bert_infer", bench_bert_inference))
+if SMOKE:
+    JOBS = SMOKE_JOBS
+else:
+    JOBS.append(("bert_infer", bench_bert_inference))
 
 results = {}
 for name, fn in JOBS:

@@ -287,6 +287,23 @@ def build(write=True, dev_every=10):
     except ImportError:
         pass
 
+    # POS table for nlp/annotation.py's PosAnnotator: surface -> most
+    # frequent ipadic top-level POS observed in the corpus
+    if write:
+        pos_counts = {}
+        for sent in train:
+            for surface, pos, *_ in sent:
+                if _is_cjk_word(surface) and len(surface) <= 8:
+                    pos_counts.setdefault(surface, Counter())[pos] += 1
+        pos_out = os.path.join(os.path.dirname(OUT), "ja_pos.txt")
+        with open(pos_out, "w", encoding="utf-8") as f:
+            f.write("# surface -> most frequent ipadic top-level POS\n"
+                    "# (from the convention-merged Botchan corpus; built\n"
+                    "# by scripts/grow_ja_lexicon.py)\n")
+            for w, c in sorted(pos_counts.items()):
+                f.write(f"{w} {c.most_common(1)[0][0]}\n")
+        print(f"wrote {len(pos_counts)} POS entries -> {pos_out}")
+
     if write:
         entries = sorted(freqs.items(), key=lambda kv: (-kv[1], kv[0]))
         with open(OUT, "w", encoding="utf-8") as f:

@@ -472,3 +472,83 @@ class TestCJKSegmentationQuality:
         assert z["f1"] >= 0.87, z
         jf = JapaneseTokenizerFactory()
         assert j["f1"] >= (0.70 if jf._engine is not None else 0.87), j
+
+
+class TestAnnotationPipeline:
+    """nlp/annotation.py — the deeplearning4j-nlp-uima equivalent
+    (UimaTokenizerFactory / PosUimaTokenizerFactory /
+    UimaSentenceIterator / annotator chain)."""
+
+    def test_sentence_boundaries_with_abbreviations(self):
+        from deeplearning4j_tpu.nlp.annotation import AnnotationSentenceIterator
+
+        text = ("Dr. Smith went to Washington. He arrived at 3.14 p.m. on "
+                "Jan. 5! Was it late? 今日は晴れです。明日は雨です。")
+        sents = list(AnnotationSentenceIterator([text]))
+        assert sents == [
+            "Dr. Smith went to Washington.",
+            "He arrived at 3.14 p.m. on Jan. 5!",
+            "Was it late?",
+            "今日は晴れです。",
+            "明日は雨です。",
+        ], sents
+
+    def test_newline_terminates(self):
+        from deeplearning4j_tpu.nlp.annotation import AnnotationSentenceIterator
+
+        sents = list(AnnotationSentenceIterator(["line one\nline two"]))
+        assert sents == ["line one", "line two"]
+
+    def test_token_spans_are_exact(self):
+        from deeplearning4j_tpu.nlp.annotation import AnnotatorPipeline
+
+        doc = AnnotatorPipeline.default().process("Hello brave new world.")
+        toks = doc.select("token")
+        assert [doc.covered(t) for t in toks] == ["Hello", "brave", "new",
+                                                 "world"]
+        for t in toks:  # spans index the ORIGINAL text
+            assert doc.text[t.begin:t.end] == doc.covered(t)
+
+    def test_mixed_script_tokenization(self):
+        from deeplearning4j_tpu.nlp.annotation import AnnotationTokenizerFactory
+
+        toks = AnnotationTokenizerFactory().create(
+            "GPU計算はfastです。학생들은 공부한다.").get_tokens()
+        assert "GPU" in toks and "計算" in toks and "は" in toks
+        assert "fast" in toks and "학생들" in toks and "은" in toks
+
+    def test_pos_filter_keeps_nouns(self):
+        from deeplearning4j_tpu.nlp.annotation import PosFilterTokenizerFactory
+
+        f = PosFilterTokenizerFactory(allowed=("NN", "名詞"))
+        toks = f.create("The engineers built systems quickly in Tokyo. "
+                        "学生が図書館で本を読む。").get_tokens()
+        assert "engineers" in toks and "systems" in toks and "Tokyo" in toks
+        assert "The" not in toks and "quickly" not in toks
+        assert "学生" in toks and "図書館" in toks and "本" in toks
+        assert "が" not in toks and "読む" not in toks
+
+    def test_porter_stemmer_vectors(self):
+        from deeplearning4j_tpu.nlp.annotation import porter_stem
+
+        # canonical Porter test pairs
+        for w, s in [("caresses", "caress"), ("ponies", "poni"),
+                     ("cats", "cat"), ("feed", "feed"), ("agreed", "agre"),
+                     ("plastered", "plaster"), ("motoring", "motor"),
+                     ("sing", "sing"), ("conflated", "conflat"),
+                     ("hopping", "hop"), ("relational", "relat"),
+                     ("rational", "ration"), ("happy", "happi"),
+                     ("adjustable", "adjust")]:
+            assert porter_stem(w) == s, (w, porter_stem(w), s)
+
+    def test_stemmer_annotator_features(self):
+        from deeplearning4j_tpu.nlp.annotation import (AnnotatorPipeline,
+                                                       SentenceAnnotator,
+                                                       StemmerAnnotator,
+                                                       TokenizerAnnotator)
+
+        pipe = AnnotatorPipeline([SentenceAnnotator(), TokenizerAnnotator(),
+                                  StemmerAnnotator()])
+        doc = pipe.process("running dogs jumped")
+        stems = [t.features.get("stem") for t in doc.select("token")]
+        assert stems == ["run", "dog", "jump"]
