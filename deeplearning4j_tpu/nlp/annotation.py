@@ -36,8 +36,7 @@ from dataclasses import dataclass, field
 from functools import lru_cache
 from typing import Dict, Iterable, List, Optional, Sequence
 
-from .tokenization import (DefaultTokenizerFactory, SentenceIterator,
-                           Tokenizer, TokenizerFactory)
+from .tokenization import SentenceIterator, Tokenizer, TokenizerFactory
 
 # ---------------------------------------------------------------- records
 
@@ -102,11 +101,17 @@ class Annotator:
 
 # ------------------------------------------------------------- sentences
 
-#: abbreviations that end with '.' but do not terminate a sentence
+#: words that are ALWAYS abbreviations before a '.' (titles, latinisms)
 _ABBREV = frozenset("""
-mr mrs ms dr prof sr jr st vs etc e.g i.e cf al inc ltd co corp dept est
-fig no vol pp approx jan feb mar apr jun jul aug sep sept oct nov dec mon
-tue wed thu fri sat sun u.s u.k a.m p.m ph.d m.d b.a m.a d.c
+mr mrs ms dr prof sr jr vs etc e.g i.e cf inc ltd corp approx
+u.s u.k a.m p.m ph.d m.d b.a m.a d.c
+""".split())
+#: words that are abbreviations ONLY with right context (a following
+#: digit or lowercase continuation): months/weekdays before dates, and
+#: words that double as ordinary English ("no", "fig", "st", "est")
+_ABBREV_CTX = frozenset("""
+st co dept est fig no vol pp al jan feb mar apr jun jul aug sep sept oct
+nov dec mon tue wed thu fri sat sun
 """.split())
 
 _TERMINATORS = ".!?。！？…"
@@ -165,7 +170,16 @@ class SentenceAnnotator(Annotator):
         word = text[j + 1:i].lower()
         if not word:
             return False
-        return word in _ABBREV or (len(word) == 1 and word.isalpha())
+        if word in _ABBREV or (len(word) == 1 and word.isalpha()):
+            return True
+        if word in _ABBREV_CTX:
+            # "Jan. 5" / "fig. 3" / "no. 12" continue; "The answer was
+            # no. He left." terminates (next sentence starts uppercase)
+            k = i + 1
+            while k < len(text) and text[k].isspace():
+                k += 1
+            return k < len(text) and (text[k].isdigit() or text[k].islower())
+        return False
 
     @staticmethod
     def _emit(doc: Document, begin: int, end: int) -> None:
@@ -336,7 +350,9 @@ class PosAnnotator(Annotator):
         for t in doc.select("token"):
             w = doc.covered(t)
             blocks = {_char_block(c) for c in w}
-            if blocks <= {"latin"}:
+            if (blocks <= {"latin", "punct"} and "latin" in blocks):
+                # internal punctuation (John's, co-worker, 3.14) must not
+                # make an ordinary English token untaggable
                 t.features["pos"] = _en_pos(w)
             elif w in ja:
                 t.features["pos"] = ja[w]

@@ -60,7 +60,8 @@ IT = 1 if SMOKE else 8
 # 1. correctness: pallas vs xla on-chip (f32)
 try:
     gp, tp_ms = timed_grads("pallas", 2, T1, 4, 64, iters=IT)
-    print(f"pallas bwd compiles on TPU: OK  ({tp_ms:.2f} ms @T=1024)")
+    where = "CPU interpret (smoke)" if SMOKE else "TPU"
+    print(f"pallas bwd compiles on {where}: OK  ({tp_ms:.2f} ms @T={T1})")
 except Exception as e:
     print(f"pallas bwd FAILED on TPU: {type(e).__name__}: {str(e)[:400]}")
     raise SystemExit(1)
