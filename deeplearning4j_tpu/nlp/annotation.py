@@ -103,14 +103,17 @@ class Annotator:
 
 #: words that are ALWAYS abbreviations before a '.' (titles, latinisms)
 _ABBREV = frozenset("""
-mr mrs ms dr prof sr jr vs etc e.g i.e cf inc ltd corp approx
+mr mrs ms dr prof sr jr vs etc e.g i.e cf inc ltd corp approx st al
 u.s u.k a.m p.m ph.d m.d b.a m.a d.c
 """.split())
+# 'st'/'al' stay unconditional: their dominant uses continue with an
+# UPPERCASE name ("St. Louis") or a bracket ("et al. (2020)"), which the
+# right-context rule below would wrongly treat as a sentence start
 #: words that are abbreviations ONLY with right context (a following
 #: digit or lowercase continuation): months/weekdays before dates, and
 #: words that double as ordinary English ("no", "fig", "st", "est")
 _ABBREV_CTX = frozenset("""
-st co dept est fig no vol pp al jan feb mar apr jun jul aug sep sept oct
+co dept est fig no vol pp jan feb mar apr jun jul aug sep sept oct
 nov dec mon tue wed thu fri sat sun
 """.split())
 

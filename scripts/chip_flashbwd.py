@@ -58,12 +58,12 @@ T1 = 128 if SMOKE else 1024
 IT = 1 if SMOKE else 8
 
 # 1. correctness: pallas vs xla on-chip (f32)
+where = "CPU interpret (smoke)" if SMOKE else "TPU"
 try:
     gp, tp_ms = timed_grads("pallas", 2, T1, 4, 64, iters=IT)
-    where = "CPU interpret (smoke)" if SMOKE else "TPU"
     print(f"pallas bwd compiles on {where}: OK  ({tp_ms:.2f} ms @T={T1})")
 except Exception as e:
-    print(f"pallas bwd FAILED on TPU: {type(e).__name__}: {str(e)[:400]}")
+    print(f"pallas bwd FAILED on {where}: {type(e).__name__}: {str(e)[:400]}")
     raise SystemExit(1)
 gx, tx_ms = timed_grads("xla", 2, T1, 4, 64, iters=IT)
 for a, b, n in zip(gp, gx, "qkv"):
