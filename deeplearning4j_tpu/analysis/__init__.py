@@ -1,0 +1,29 @@
+"""jaxlint — AST-based JAX/TPU-correctness static analysis for this repo.
+
+Graph compilers (TF's grappler validators, TVM's relay passes) ship
+graph-level lint because the worst accelerator bugs are invisible to unit
+tests: a host sync inside a hot jitted step still *passes*, it is just 10x
+slower on real hardware; a constant PRNG key still "samples", it just
+samples the same thing forever. jaxlint is the equivalent for our jit/pjit
+idiom.
+
+Usage::
+
+    python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ [--json]
+
+or programmatically::
+
+    from deeplearning4j_tpu.analysis import analyze_paths, analyze_source
+    findings = analyze_paths(["deeplearning4j_tpu/"])
+
+Suppress a finding with ``# jaxlint: disable=<rule>`` on the offending line
+(``disable-next=`` / ``disable-file=`` variants exist). Rules are documented
+in ``deeplearning4j_tpu/analysis/README.md``.
+"""
+
+from .engine import (Finding, Rule, analyze_paths, analyze_source,
+                     iter_py_files, render_json, render_text)
+from .rules import ALL_RULES, rules_by_name
+
+__all__ = ["Finding", "Rule", "ALL_RULES", "rules_by_name", "analyze_paths",
+           "analyze_source", "iter_py_files", "render_json", "render_text"]

@@ -1,0 +1,51 @@
+"""CLI: ``python -m deeplearning4j_tpu.analysis <paths> [--json] [--select ...]``.
+
+Exit status: 0 when clean, 1 when any finding survives suppression, 2 on
+usage errors — so CI can gate on it directly (scripts/ci.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .engine import analyze_paths, render_json, render_text
+from .rules import ALL_RULES, rules_by_name
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="jaxlint: JAX/TPU-correctness static analysis")
+    ap.add_argument("paths", nargs="*", help=".py files or directories")
+    ap.add_argument("--json", action="store_true", help="machine-readable report")
+    ap.add_argument("--select", metavar="RULES",
+                    help="comma-separated rule names to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.name:20s} {r.description}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given (try: deeplearning4j_tpu/)")
+
+    rules = ALL_RULES
+    if args.select:
+        table = rules_by_name()
+        names = [n.strip() for n in args.select.split(",") if n.strip()]
+        unknown = [n for n in names if n not in table]
+        if unknown:
+            ap.error(f"unknown rule(s) {unknown}; known: {sorted(table)}")
+        rules = [table[n] for n in names]
+
+    findings = analyze_paths(args.paths, rules)
+    print(render_json(findings) if args.json else render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

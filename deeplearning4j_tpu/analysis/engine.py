@@ -1,0 +1,171 @@
+"""jaxlint engine — rule registry, suppression handling, reports.
+
+The analyzer is pure stdlib-``ast``: it never imports jax (or the package
+under analysis), so CI can run it in milliseconds before paying the jax
+import + trace cost of the test suite, and a broken runtime import can never
+take the linter down with it.
+
+Suppression grammar (pylint-style, per physical line):
+
+    x = float(n)              # jaxlint: disable=host-sync
+    # jaxlint: disable-next=broad-except
+    except Exception:
+    # jaxlint: disable-file=float64-dtype     (anywhere in the file)
+
+``disable=all`` silences every rule for that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*(disable|disable-next|disable-file)=([A-Za-z0-9_\-, ]+)")
+
+#: directories never descended into when a path argument is a directory
+SKIP_DIRS = {"__pycache__", "_build", ".git", ".ipynb_checkpoints"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class for jaxlint rules.
+
+    Subclasses set ``name`` (the kebab-case id used in reports and
+    suppression comments), ``description`` (one line, shown by
+    ``--list-rules``) and implement :meth:`check` yielding findings for one
+    parsed file.
+    """
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST, message: str) -> Finding:
+        return Finding(self.name, ctx.path, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), message)
+
+
+class FileContext:
+    """Everything a rule needs about one file: source, AST, import aliases,
+    jit-context map. Built once per file, shared across rules."""
+
+    def __init__(self, path: str, source: str):
+        from .jitgraph import ImportMap, JitContext
+
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.imports = ImportMap(self.tree)
+        self.jit = JitContext(self.tree, path, self.imports)
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path of a Name/Attribute chain (alias-aware),
+        e.g. ``np.asarray`` -> ``numpy.asarray``. None if not resolvable."""
+        return self.imports.resolve(node)
+
+    @property
+    def is_kernel_module(self) -> bool:
+        return self.jit.kernel_module
+
+
+def _suppressions(source: str) -> tuple[Dict[int, Set[str]], Set[str]]:
+    """(per-line disabled rule sets, file-level disabled rules)."""
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        kind, rules = m.group(1), {r.strip() for r in m.group(2).split(",") if r.strip()}
+        if kind == "disable":
+            per_line.setdefault(i, set()).update(rules)
+        elif kind == "disable-next":
+            per_line.setdefault(i + 1, set()).update(rules)
+        else:  # disable-file
+            per_file.update(rules)
+    return per_line, per_file
+
+
+def _suppressed(f: Finding, per_line: Dict[int, Set[str]], per_file: Set[str]) -> bool:
+    if "all" in per_file or f.rule in per_file:
+        return True
+    rules = per_line.get(f.line)
+    return bool(rules) and ("all" in rules or f.rule in rules)
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run ``rules`` (default: all registered) over one source string."""
+    if rules is None:
+        from .rules import ALL_RULES
+        rules = ALL_RULES
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        return [Finding("parse-error", path, e.lineno or 0, e.offset or 0,
+                        f"could not parse: {e.msg}")]
+    per_line, per_file = _suppressions(source)
+    out: List[Finding] = []
+    for rule in rules:
+        for f in rule.check(ctx):
+            if not _suppressed(f, per_line, per_file):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+        elif p.endswith(".py"):
+            yield p
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    out: List[Finding] = []
+    for fp in iter_py_files(paths):
+        with open(fp, "r", encoding="utf-8") as fh:
+            out.extend(analyze_source(fh.read(), fp, rules))
+    return out
+
+
+def render_text(findings: Sequence[Finding]) -> str:
+    body = "\n".join(f.render() for f in findings)
+    tail = f"\n{len(findings)} finding(s)" if findings else "jaxlint: clean"
+    return (body + tail) if body else tail
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps({"count": len(findings),
+                       "findings": [f.to_dict() for f in findings]}, indent=2)

@@ -1,0 +1,405 @@
+"""jaxlint rules — JAX/TPU correctness checks for this codebase's idiom.
+
+Each rule documents *why the pattern hurts on TPU* in its class docstring;
+``analysis/README.md`` has the long-form rationale and suppression guidance.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+from .engine import FileContext, Finding, Rule
+
+ALL_RULES: List[Rule] = []
+
+
+def register(cls):
+    ALL_RULES.append(cls())
+    return cls
+
+
+def rules_by_name() -> Dict[str, Rule]:
+    return {r.name: r for r in ALL_RULES}
+
+
+def _static_shape_arg(node: ast.AST) -> bool:
+    """Arguments to float()/int() that are provably host-side static values:
+    literals, len(...), ``.ndim``/``.size`` attributes, ``x.shape[i]``."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant):
+        return True
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "len"):
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in ("ndim", "size"):
+        return True
+    if isinstance(node, ast.Subscript):
+        v = node.value
+        if isinstance(v, ast.Attribute) and v.attr == "shape":
+            return True
+    return False
+
+
+@register
+class HostSyncRule(Rule):
+    """Host-device synchronization in traced code.
+
+    ``.item()``, ``float()``/``int()`` on array values, and
+    ``np.asarray``/``np.array`` on traced values either raise a
+    ConcretizationTypeError under jit or — worse, outside jit but inside the
+    training loop — silently block the host on the device stream, serializing
+    dispatch and collapsing TPU utilization.
+    """
+
+    name = "host-sync"
+    description = ("host-device sync (.item(), float()/int() on arrays, "
+                   "np.asarray on traced values) inside jit-context code")
+
+    _NP_MATERIALIZE = {"numpy.asarray", "numpy.array", "numpy.asanyarray"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not ctx.jit.in_jit(node):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
+                yield self.finding(ctx, node, ".item() forces a device->host "
+                                   "transfer and blocks until the value is ready")
+            elif isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+                yield self.finding(ctx, node, ".block_until_ready() stalls the "
+                                   "dispatch pipeline inside traced code")
+            elif (isinstance(f, ast.Name) and f.id in ("float", "int")
+                    and len(node.args) == 1 and not _static_shape_arg(node.args[0])):
+                yield self.finding(
+                    ctx, node, f"{f.id}() on a (possibly traced) array value "
+                    f"is a host sync; use jnp.asarray(x, dtype=...) or keep "
+                    f"the value on device")
+            else:
+                q = ctx.resolve(f)
+                if q in self._NP_MATERIALIZE:
+                    yield self.finding(
+                        ctx, node, f"{q}() materializes on host; inside a "
+                        f"trace use jnp.asarray instead")
+                elif q == "jax.device_get":
+                    yield self.finding(ctx, node, "jax.device_get inside "
+                                       "traced code is a host sync")
+
+
+@register
+class PrngConstantKeyRule(Rule):
+    """Hard-coded ``PRNGKey(<literal>)``.
+
+    A constant key baked into library code yields the *same* "random" stream
+    on every call — silently correlated dropout masks, identical sampling
+    across generate() calls, and irreproducible-looking-but-actually-frozen
+    experiments. Keys must flow in from the caller or from a documented
+    ``seed`` argument.
+    """
+
+    name = "prng-constant-key"
+    description = "hard-coded jax.random.PRNGKey(<const>) / jax.random.key(<const>)"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = ctx.resolve(node.func)
+            if q not in ("jax.random.PRNGKey", "jax.random.key"):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, (int, float)):
+                yield self.finding(
+                    ctx, node, f"{q}({node.args[0].value!r}) hard-codes the "
+                    f"random stream; thread a key or seed argument through "
+                    f"instead")
+
+
+_SAMPLER_EXEMPT = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data",
+                   "key_data", "clone", "key_impl", "bits"}
+
+
+def _key_uses(expr: ast.AST, resolve) -> Iterator[Tuple[str, ast.AST]]:
+    """(key var name, call node) for jax.random draws whose first arg is a
+    bare Name. Nested lambdas are included; nested defs are not reached here
+    (the rule scans each def separately)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and node.args \
+                and isinstance(node.args[0], ast.Name):
+            q = resolve(node.func)
+            if q and q.startswith("jax.random.") \
+                    and q.rsplit(".", 1)[1] not in _SAMPLER_EXEMPT:
+                yield node.args[0].id, node
+
+
+def _walrus_targets(expr: ast.AST) -> Iterator[str]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.NamedExpr) and isinstance(node.target, ast.Name):
+            yield node.target.id
+
+
+def _terminates(stmts: List[ast.stmt]) -> bool:
+    """Block ends by leaving the enclosing scope — its key counts never flow
+    into the code after the If (``if cond: return draw(key)`` is exclusive
+    with a later ``return draw(key)``)."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _assign_names(target: ast.AST) -> Iterator[str]:
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from _assign_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from _assign_names(target.value)
+
+
+@register
+class PrngKeyReuseRule(Rule):
+    """Same PRNG key consumed by more than one random draw.
+
+    Unlike stateful RNGs, jax keys are pure values: passing one key to two
+    draws gives two *identical* samples. Every consumption must be preceded
+    by a ``jax.random.split`` (or ``fold_in``). The check is a linear
+    per-function approximation: exclusive branches are merged, loop bodies
+    are scanned once.
+    """
+
+    name = "prng-key-reuse"
+    description = "PRNG key passed to multiple jax.random draws without a split"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan(ctx, node.body, {})
+
+    def _expr(self, ctx, expr, counts) -> Iterator[Finding]:
+        if expr is None:
+            return
+        for name, call in _key_uses(expr, ctx.resolve):
+            counts[name] = counts.get(name, 0) + 1
+            if counts[name] == 2:
+                yield self.finding(
+                    ctx, call, f"key '{name}' already consumed by an earlier "
+                    f"jax.random draw; split it first (identical samples "
+                    f"otherwise)")
+        for t in _walrus_targets(expr):
+            counts[t] = 0
+
+    def _branch(self, ctx, stmts, counts) -> Tuple[List[Finding], Dict[str, int]]:
+        c = dict(counts)
+        return list(self._scan(ctx, stmts, c)), c
+
+    def _scan(self, ctx, stmts, counts) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # separate scope, scanned on its own
+            if isinstance(stmt, ast.Assign):
+                yield from self._expr(ctx, stmt.value, counts)
+                for t in stmt.targets:
+                    for n in _assign_names(t):
+                        counts[n] = 0
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                yield from self._expr(ctx, stmt.value, counts)
+                for n in _assign_names(stmt.target):
+                    counts[n] = 0
+            elif isinstance(stmt, ast.If):
+                yield from self._expr(ctx, stmt.test, counts)
+                f1, c1 = self._branch(ctx, stmt.body, counts)
+                f2, c2 = self._branch(ctx, stmt.orelse, counts)
+                yield from f1
+                yield from f2
+                merged = [c for c, block in ((c1, stmt.body), (c2, stmt.orelse))
+                          if not _terminates(block)]
+                if merged:
+                    for k in set().union(*merged):
+                        counts[k] = max(c.get(k, 0) for c in merged)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield from self._expr(ctx, stmt.iter, counts)
+                for n in _assign_names(stmt.target):
+                    counts[n] = 0
+                f1, c1 = self._branch(ctx, stmt.body + stmt.orelse, counts)
+                yield from f1
+                for k in c1:
+                    counts[k] = max(counts.get(k, 0), c1[k])
+            elif isinstance(stmt, ast.While):
+                yield from self._expr(ctx, stmt.test, counts)
+                f1, c1 = self._branch(ctx, stmt.body + stmt.orelse, counts)
+                yield from f1
+                for k in c1:
+                    counts[k] = max(counts.get(k, 0), c1[k])
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    yield from self._expr(ctx, item.context_expr, counts)
+                    if item.optional_vars is not None:
+                        for n in _assign_names(item.optional_vars):
+                            counts[n] = 0
+                yield from self._scan(ctx, stmt.body, counts)
+            elif isinstance(stmt, ast.Try):
+                yield from self._scan(ctx, stmt.body, counts)
+                for h in stmt.handlers:
+                    fh, ch = self._branch(ctx, h.body, counts)
+                    yield from fh
+                    for k in ch:
+                        counts[k] = max(counts.get(k, 0), ch[k])
+                yield from self._scan(ctx, stmt.orelse + stmt.finalbody, counts)
+            else:
+                for expr in ast.iter_child_nodes(stmt):
+                    if isinstance(expr, ast.expr):
+                        yield from self._expr(ctx, expr, counts)
+
+
+_SIDE_EFFECT_PREFIXES = ("time.", "datetime.", "random.", "numpy.random.")
+
+
+@register
+class JitSideEffectRule(Rule):
+    """Python side effects under a trace.
+
+    Code under ``@jax.jit`` runs once at trace time, then never again:
+    ``print`` fires only on (re)compile, stdlib/``np.random`` draw a single
+    value that is baked into the compiled program as a constant, and mutating
+    a global both leaks tracers and desynchronizes across pjit hosts.
+    """
+
+    name = "jit-side-effect"
+    description = ("print/open/global/time/datetime/stdlib-random inside "
+                   "jit-context code")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not ctx.jit.in_jit(node):
+                continue
+            if isinstance(node, ast.Global):
+                yield self.finding(ctx, node, "mutating a global under jit "
+                                   "leaks tracers / bakes stale constants")
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in ("print", "input", "open"):
+                    yield self.finding(
+                        ctx, node, f"{f.id}() under jit runs at trace time "
+                        f"only; use jax.debug.print / move I/O out of the "
+                        f"traced function")
+                    continue
+                q = ctx.resolve(f)
+                if q and q.startswith(_SIDE_EFFECT_PREFIXES):
+                    yield self.finding(
+                        ctx, node, f"{q}() under jit is evaluated once at "
+                        f"trace time and baked in as a constant")
+
+
+def _step_shaped(name: str) -> bool:
+    tokens = name.lower().strip("_").split("_")
+    return name.lower().endswith("step") or "step" in tokens or "update" in tokens
+
+
+@register
+class MissingDonateRule(Rule):
+    """Train-step jit without buffer donation.
+
+    A step function that maps ``(params, opt_state, ...) -> (params,
+    opt_state, ...)`` keeps *two* copies of every donated-able buffer live on
+    TPU unless the inputs are donated — for large models that halves usable
+    HBM and forces XLA into extra copies. Name-based heuristic: functions
+    whose name contains a ``step``/``update`` token.
+    """
+
+    name = "missing-donate"
+    description = ("jitted *step/update function without donate_argnums/"
+                   "donate_argnames")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        from .jitgraph import jit_call_kwargs
+
+        seen = set()
+        for fn, expr in ctx.jit.jit_applications:
+            fname = getattr(fn, "name", "")
+            if not _step_shaped(fname) or id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            kwargs = jit_call_kwargs(expr, ctx.resolve) or []
+            if "donate_argnums" not in kwargs and "donate_argnames" not in kwargs:
+                yield self.finding(
+                    ctx, fn, f"step-shaped function '{fname}' is jitted "
+                    f"without donate_argnums — old input buffers stay live, "
+                    f"doubling HBM for the state pytree")
+
+
+@register
+class Float64DtypeRule(Rule):
+    """float64/int64 in op kernels.
+
+    TPUs have no native f64 ALUs: XLA emulates double precision at a large
+    multiple of the f32 cost, and a single f64 literal silently promotes a
+    whole expression tree. Kernel modules (``ops/``) must stay in
+    f32/bf16-land; this rule only fires there.
+    """
+
+    name = "float64-dtype"
+    description = "float64/int64 dtype reference inside an ops/ kernel module"
+
+    _BAD_ATTRS = {"numpy.float64", "jax.numpy.float64", "numpy.double",
+                  "numpy.int64", "jax.numpy.int64"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.is_kernel_module:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                q = ctx.resolve(node)
+                if q in self._BAD_ATTRS:
+                    yield self.finding(
+                        ctx, node, f"{q} in a kernel module: TPUs emulate "
+                        f"64-bit at a large slowdown; use f32/bf16")
+            elif isinstance(node, ast.Call):
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Constant) and arg.value in ("float64", "int64"):
+                        yield self.finding(
+                            ctx, arg, f"dtype string '{arg.value}' in a "
+                            f"kernel module: use f32/bf16 on TPU")
+                for k in node.keywords:
+                    if k.arg == "dtype" and isinstance(k.value, ast.Name) \
+                            and k.value.id == "float":
+                        yield self.finding(
+                            ctx, k.value, "dtype=float means float64; "
+                            "spell the 32-bit dtype explicitly")
+
+
+@register
+class BroadExceptRule(Rule):
+    """``except Exception`` that swallows.
+
+    Under jit, the errors worth seeing — ConcretizationTypeError from a
+    leaked tracer, XlaRuntimeError from a bad donation — are generic
+    ``Exception`` subclasses; a catch-all that logs-and-continues converts
+    them into silent wrong results. Handlers that re-raise (bare ``raise`` or
+    ``raise X from e``) preserve the failure and are allowed.
+    """
+
+    name = "broad-except"
+    description = "except Exception/BaseException (or bare except) that swallows"
+
+    def _is_broad(self, ctx, t) -> bool:
+        if t is None:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(self._is_broad(ctx, e) for e in t.elts)
+        return ctx.resolve(t) in ("Exception", "BaseException")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(ctx, node.type):
+                continue
+            reraises = any(
+                isinstance(n, ast.Raise) and (n.exc is None or n.cause is not None)
+                for n in ast.walk(node))
+            if not reraises:
+                yield self.finding(
+                    ctx, node, "broad except swallows tracer/runtime errors; "
+                    "narrow the type, re-raise with `from e`, or suppress "
+                    "with a justification if the loop must survive")

@@ -147,7 +147,7 @@ class AsyncIterator(DataSetIterator):
             try:
                 for ds in self.base:
                     q.put(ds.to_device(self.device) if self.to_device else ds)
-            except BaseException as e:  # propagate into consumer
+            except BaseException as e:  # propagated: consumer re-raises below  # jaxlint: disable=broad-except
                 err.append(e)
             finally:
                 q.put(self._SENTINEL)

@@ -21,8 +21,11 @@ import numpy as np
 from ..utils.distances import pairwise_sq_dists
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(jax.jit, donate_argnums=(1,), static_argnames=("k",))
 def _lloyd_step(points, centroids, k: int):
+    # centroids are loop-carried in fit() (and a temp copy in predict()), so
+    # their buffer is donated; points are reused across iterations — never
+    # donate them.
     d2 = pairwise_sq_dists(points, centroids)
     assign = jnp.argmin(d2, axis=1)
     one_hot = jax.nn.one_hot(assign, k, dtype=points.dtype)

@@ -67,7 +67,7 @@ class NearestNeighborsServer(JsonHTTPServerMixin):
                 except (KeyError, ValueError, IndexError, TypeError,
                         AttributeError, json.JSONDecodeError) as e:
                     self.reply(400, {"error": str(e)})
-                except Exception as e:  # unexpected: surface as 500, keep serving
+                except Exception as e:  # unexpected: surface as 500, keep serving  # jaxlint: disable=broad-except
                     self.reply(500, {"error": f"{type(e).__name__}: {e}"})
 
         return Handler

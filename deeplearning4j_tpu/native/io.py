@@ -122,7 +122,7 @@ class NativeBatchIterator(DataSetIterator):
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # finalizer must never raise (interpreter shutdown)  # jaxlint: disable=broad-except
             pass
 
 

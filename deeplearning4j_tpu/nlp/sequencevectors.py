@@ -147,7 +147,7 @@ _cbow_ns_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_cbow_ns_math)
 
 
 @jax.jit
-def _skipgram_ns_infer_step(vec, syn1, contexts, negatives, lr):
+def _skipgram_ns_infer_step(vec, syn1, contexts, negatives, lr):  # jaxlint: disable=missing-donate
     """Inference-only skip-gram NS: update a single doc vector ``vec`` (1, D)
     against a FROZEN output table (ParagraphVectors.inferVector). No donation
     so the caller's tables stay valid."""

@@ -85,8 +85,10 @@ class Layer:
     def has_params(self) -> bool:
         return True
 
-    def param_count(self, input_shape: Shape) -> int:
-        p, _ = self.init(jax.random.PRNGKey(0), input_shape)
+    def param_count(self, input_shape: Shape, seed: int = 0) -> int:
+        # shape-only probe: the key value cannot change the count, but it is
+        # surfaced as an argument so no constant key hides in the library
+        p, _ = self.init(jax.random.PRNGKey(seed), input_shape)
         return sum(int(jnp.size(v)) for v in jax.tree_util.tree_leaves(p))
 
     # --- serde ---

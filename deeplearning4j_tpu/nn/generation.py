@@ -187,7 +187,7 @@ def sample_logits(logits, rng, temperature: float = 1.0,
 
 def generate(model: Sequential, prompt, max_new_tokens: int, *,
              params=None, state=None, temperature: float = 1.0,
-             top_k: Optional[int] = None, rng=None,
+             top_k: Optional[int] = None, rng=None, seed: int = 0,
              capacity: Optional[int] = None) -> np.ndarray:
     """Autoregressively continue ``prompt`` for ``max_new_tokens`` tokens.
 
@@ -233,7 +233,10 @@ def generate(model: Sequential, prompt, max_new_tokens: int, *,
                 f"with the full forward pass)")
     out_layer = model.layers[-1]
     V = getattr(out_layer, "n_out", 0) or model._shapes[-1][-1]
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    # rng convention: pass an explicit key for streamed/nested sampling; with
+    # rng=None each call derives its stream from ``seed`` (deterministic,
+    # caller-controlled — never a library-internal constant key)
+    rng = rng if rng is not None else jax.random.PRNGKey(seed)
     caches = _init_caches(model, B, capacity, model.dtype)
 
     def embed(tok):  # (B,) int -> next input chunk

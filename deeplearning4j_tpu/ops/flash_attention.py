@@ -25,6 +25,7 @@ are handled with compile-time index masks.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -615,7 +616,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
         if not causal:
             raise ValueError("window= requires causal=True (sliding-window "
                              "attention is a causal-LM construct)")
-        window = int(window)
+        window = int(window)  # host-side hyperparameter  # jaxlint: disable=host-sync
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         if window >= T:
@@ -647,7 +648,9 @@ def flash_attention(q, k, v, *, causal: bool = False,
         raise ValueError(f"backward must be 'pallas' or 'xla', got {bw!r}")
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    # Python-float scale: embedded as an f32 scalar constant in the kernel —
+    # an np.float64 here would silently promote the whole QK^T tree.
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)  # jaxlint: disable=host-sync
     if interpret:
         # interpreter mode has no tiling constraints: shrink blocks toward T
         # so CPU tests stay fast

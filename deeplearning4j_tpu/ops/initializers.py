@@ -50,7 +50,7 @@ def compute_fans(shape: Sequence[int], kind: str = "dense"):
     dense:  (in, out) -> fan_in=in, fan_out=out
     conv:   (kh, kw, in, out) [HWIO] -> fan_in=kh*kw*in, fan_out=kh*kw*out
     """
-    shape = tuple(int(s) for s in shape)
+    shape = tuple(int(s) for s in shape)  # static dims, host-side  # jaxlint: disable=host-sync
     if len(shape) == 0:
         return 1, 1
     if len(shape) == 1:

@@ -19,6 +19,7 @@ series (DL4J per-timestep masking, see MaskedReductionUtil).
 
 from __future__ import annotations
 
+import math
 from typing import Callable, Dict, Optional
 
 import jax
@@ -101,10 +102,8 @@ def reduction_mass(labels, mask=None):
     if not pe_shape:
         pe_shape = (1,)
     if mask is None:
-        n = 1
-        for d in pe_shape:
-            n *= int(d)
-        return jnp.asarray(float(n), jnp.float32)
+        # static shape product — stays a Python int, no float()/int() host sync
+        return jnp.asarray(math.prod(pe_shape), jnp.float32)
     m = jnp.asarray(mask).astype(jnp.float32)
     m = jnp.broadcast_to(
         m.reshape(m.shape + (1,) * (len(pe_shape) - m.ndim)), pe_shape)

@@ -87,8 +87,8 @@ def apply_dropout_config(key, x: Array, cfg, training: bool) -> Array:
     """cfg: float (dropout rate) or {"type": name, ...kwargs}."""
     if cfg is None:
         return x
-    if isinstance(cfg, (int, float)):
-        return dropout(key, x, float(cfg), training)
+    if isinstance(cfg, (int, float)):  # guarded: cfg is a host-side number
+        return dropout(key, x, float(cfg), training)  # jaxlint: disable=host-sync
     cfg = dict(cfg)
     kind = cfg.pop("type")
     return DROPOUTS[kind](key, x, training=training, **cfg)

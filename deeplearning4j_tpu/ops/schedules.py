@@ -26,7 +26,7 @@ def constant(value: float) -> Schedule:
 
 def exponential(initial: float, gamma: float) -> Schedule:
     """value = initial * gamma^iter (ExponentialSchedule)."""
-    return lambda count: initial * jnp.power(gamma, count.astype(jnp.float32) if hasattr(count, "astype") else float(count))
+    return lambda count: initial * jnp.power(gamma, jnp.asarray(count, jnp.float32))
 
 
 def inverse(initial: float, gamma: float, power: float) -> Schedule:
@@ -105,7 +105,7 @@ def from_config(cfg: Union[float, dict, Schedule]) -> Schedule:
     if callable(cfg):
         return cfg
     if isinstance(cfg, (int, float)):
-        return constant(float(cfg))
+        return constant(cfg)  # constant() casts to f32 on device
     cfg = dict(cfg)
     kind = cfg.pop("type")
     return _BUILDERS[kind](**cfg)

@@ -170,12 +170,12 @@ class NDArrayConsumer:
                     arr = self.receive(timeout=0.25)
                 except queue.Empty:
                     continue
-                except Exception as e:  # corrupt frame: report, keep consuming
+                except Exception as e:  # corrupt frame: report, keep consuming  # jaxlint: disable=broad-except
                     (on_error or _default_on_error)(e)
                     continue
                 try:
                     on_array(arr)
-                except Exception as e:  # callback bug must not kill the stream
+                except Exception as e:  # callback bug must not kill the stream  # jaxlint: disable=broad-except
                     (on_error or _default_on_error)(e)
         self._cb_thread = threading.Thread(target=loop, daemon=True)
         self._cb_thread.start()

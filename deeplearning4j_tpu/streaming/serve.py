@@ -65,7 +65,7 @@ class InferenceRoute(JsonHTTPServerMixin):
                 except (KeyError, ValueError, TypeError, AttributeError,
                         json.JSONDecodeError) as e:
                     self.reply(400, {"error": str(e)})
-                except Exception as e:
+                except Exception as e:  # server must answer every request  # jaxlint: disable=broad-except
                     self.reply(500, {"error": f"{type(e).__name__}: {e}"})
 
         return Handler

@@ -193,11 +193,13 @@ class VAEReconErrorScoreCalculator(ScoreCalculator):
     with a fixed rng) on a held-out iterator."""
 
     iterator: Any
+    seed: int = 0  # scoring is deterministic by design; the stream is configurable
 
     def score(self, trainer):
         layer, key, idx = _vae_layer(trainer)
+        eval_key = jax.random.PRNGKey(self.seed)
         loss_fn = self._jitted(layer, lambda p, feats: layer.pretrain_loss(
-            p, feats, jax.random.PRNGKey(0)))
+            p, feats, eval_key))
         total, n = 0.0, 0
         for ds in self.iterator:
             feats = _features_up_to(trainer, ds, idx)
@@ -215,13 +217,15 @@ class VAEReconProbScoreCalculator(ScoreCalculator):
 
     iterator: Any
     num_samples: int = 16
+    seed: int = 0  # scoring is deterministic by design; the stream is configurable
 
     def score(self, trainer):
         layer, key, idx = _vae_layer(trainer)
+        eval_key = jax.random.PRNGKey(self.seed)
         lp_fn = self._jitted(
             layer, lambda p, feats: jnp.mean(
                 layer.reconstruction_log_probability(
-                    p, feats, jax.random.PRNGKey(0),
+                    p, feats, eval_key,
                     num_samples=self.num_samples)))
         total, n = 0.0, 0
         for ds in self.iterator:

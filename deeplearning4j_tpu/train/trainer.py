@@ -776,7 +776,7 @@ class Trainer:
         lp = self.params[key]
         opt = tx.init(lp)
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0, 1))  # lp/opt are loop-carried
         def pstep(lp, opt, x, rng):
             def loss_fn(p):
                 feats, _ = model.forward({**self.params, key: p}, self.state, x,

@@ -234,7 +234,9 @@ class UIServer(JsonHTTPServerMixin):
                         self.reply(200, server._model(sid))
                     else:
                         self.reply(404, {"error": "unknown endpoint"})
-                except Exception as e:
+                except (KeyError, ValueError, TypeError, AttributeError) as e:
+                    self.reply(400, {"error": str(e)})
+                except Exception as e:  # server must answer every request  # jaxlint: disable=broad-except
                     self.reply(500, {"error": f"{type(e).__name__}: {e}"})
 
             def do_POST(self):
@@ -263,7 +265,7 @@ class UIServer(JsonHTTPServerMixin):
                 except (KeyError, ValueError, TypeError, AttributeError,
                         json.JSONDecodeError) as e:
                     self.reply(400, {"error": str(e)})
-                except Exception as e:
+                except Exception as e:  # server must answer every request  # jaxlint: disable=broad-except
                     self.reply(500, {"error": f"{type(e).__name__}: {e}"})
 
         return Handler

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI gate: jaxlint first (milliseconds, catches TPU-correctness bugs the
+# CPU test suite cannot see), then the tier-1 pytest command from ROADMAP.md.
+# Fails the build on any jaxlint finding or tier-1 regression.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== jaxlint: deeplearning4j_tpu/ ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/
+
+echo "=== tier-1 tests ==="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

@@ -1,0 +1,432 @@
+"""jaxlint analyzer tests — one positive + one negative fixture per rule,
+plus suppression-comment, JSON-report, and CLI exit-code coverage.
+
+Pure-AST tests: nothing here touches jax at runtime, so the suite is
+milliseconds and platform-independent.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deeplearning4j_tpu.analysis import (ALL_RULES, analyze_paths,
+                                         analyze_source, render_json,
+                                         rules_by_name)
+
+
+def lint(src, rule=None, path="pkg/mod.py"):
+    rules = [rules_by_name()[rule]] if rule else None
+    return analyze_source(textwrap.dedent(src), path, rules)
+
+
+def names(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------- host-sync
+class TestHostSync:
+    def test_item_inside_jit_flagged(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.sum().item()
+            """, "host-sync")
+        assert names(fs) == ["host-sync"]
+
+    def test_float_on_array_in_jit_reachable_helper_flagged(self):
+        # helper is not decorated, but is called from a jitted function
+        fs = lint("""
+            import jax
+
+            def helper(x):
+                return float(x)
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+            """, "host-sync")
+        assert len(fs) == 1 and fs[0].line == 5
+
+    def test_np_asarray_in_kernel_module_flagged(self):
+        fs = lint("""
+            import numpy as np
+
+            def kernel(x):
+                return np.asarray(x)
+            """, "host-sync", path="pkg/ops/k.py")
+        assert names(fs) == ["host-sync"]
+
+    def test_outside_jit_not_flagged(self):
+        fs = lint("""
+            def host_code(x):
+                return float(x)
+            """, "host-sync")
+        assert fs == []
+
+    def test_static_shape_args_not_flagged(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                n = float(x.shape[0]) * int(x.ndim) * float(len(x))
+                return x * n
+            """, "host-sync")
+        assert fs == []
+
+
+# ------------------------------------------------------- prng-constant-key
+class TestPrngConstantKey:
+    def test_literal_key_flagged(self):
+        fs = lint("""
+            import jax
+
+            def f(rng=None):
+                return rng if rng is not None else jax.random.PRNGKey(0)
+            """, "prng-constant-key")
+        assert names(fs) == ["prng-constant-key"]
+
+    def test_aliased_import_flagged(self):
+        fs = lint("""
+            from jax import random
+
+            def f():
+                return random.PRNGKey(42)
+            """, "prng-constant-key")
+        assert names(fs) == ["prng-constant-key"]
+
+    def test_seed_variable_not_flagged(self):
+        fs = lint("""
+            import jax
+
+            def f(seed: int):
+                return jax.random.PRNGKey(seed)
+            """, "prng-constant-key")
+        assert fs == []
+
+
+# ---------------------------------------------------------- prng-key-reuse
+class TestPrngKeyReuse:
+    def test_double_draw_flagged(self):
+        fs = lint("""
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.uniform(key, (2,))
+                return a + b
+            """, "prng-key-reuse")
+        assert names(fs) == ["prng-key-reuse"]
+
+    def test_split_between_draws_not_flagged(self):
+        fs = lint("""
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (2,))
+                key, sub = jax.random.split(key)
+                b = jax.random.uniform(sub, (2,))
+                return a + b
+            """, "prng-key-reuse")
+        assert fs == []
+
+    def test_exclusive_early_return_branches_not_flagged(self):
+        # the initializers.py pattern: each call path draws exactly once
+        fs = lint("""
+            import jax
+
+            def f(key, dist):
+                if dist == "normal":
+                    return jax.random.normal(key, (2,))
+                return jax.random.uniform(key, (2,))
+            """, "prng-key-reuse")
+        assert fs == []
+
+    def test_if_else_branches_not_flagged(self):
+        fs = lint("""
+            import jax
+
+            def f(key, flag):
+                if flag:
+                    out = jax.random.normal(key, (2,))
+                else:
+                    out = jax.random.uniform(key, (2,))
+                return out
+            """, "prng-key-reuse")
+        assert fs == []
+
+
+# ---------------------------------------------------------- jit-side-effect
+class TestJitSideEffect:
+    def test_print_under_jit_flagged(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                print("loss", x)
+                return x
+            """, "jit-side-effect")
+        assert names(fs) == ["jit-side-effect"]
+
+    def test_stdlib_random_and_global_flagged(self):
+        fs = lint("""
+            import jax
+            import random
+
+            @jax.jit
+            def step(x):
+                global COUNTER
+                return x * random.random()
+            """, "jit-side-effect")
+        assert sorted(names(fs)) == ["jit-side-effect", "jit-side-effect"]
+
+    def test_jax_random_not_confused_with_stdlib(self):
+        fs = lint("""
+            import jax
+            from jax import random
+
+            @jax.jit
+            def step(x, key):
+                return x * random.normal(key, x.shape)
+            """, "jit-side-effect")
+        assert fs == []
+
+    def test_print_outside_jit_not_flagged(self):
+        fs = lint("""
+            def train_loop(x):
+                print("epoch done")
+            """, "jit-side-effect")
+        assert fs == []
+
+
+# ----------------------------------------------------------- missing-donate
+class TestMissingDonate:
+    def test_step_without_donation_flagged(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def train_step(params, opt_state, batch):
+                return params, opt_state
+            """, "missing-donate")
+        assert names(fs) == ["missing-donate"]
+
+    def test_wrap_call_without_donation_flagged(self):
+        fs = lint("""
+            import jax
+
+            def update(params, grads):
+                return params
+
+            update_fn = jax.jit(update)
+            """, "missing-donate")
+        assert names(fs) == ["missing-donate"]
+
+    def test_donated_step_not_flagged(self):
+        fs = lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def train_step(params, opt_state, batch):
+                return params, opt_state
+            """, "missing-donate")
+        assert fs == []
+
+    def test_non_step_function_not_flagged(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def infer(params, x):
+                return x
+            """, "missing-donate")
+        assert fs == []
+
+
+# ------------------------------------------------------------ float64-dtype
+class TestFloat64Dtype:
+    def test_float64_in_kernel_module_flagged(self):
+        fs = lint("""
+            import jax.numpy as jnp
+
+            def kernel(x):
+                return jnp.asarray(x, jnp.float64)
+            """, "float64-dtype", path="pkg/ops/k.py")
+        assert names(fs) == ["float64-dtype"]
+
+    def test_dtype_string_and_builtin_float_flagged(self):
+        fs = lint("""
+            import jax.numpy as jnp
+
+            def kernel(x):
+                a = x.astype("float64")
+                return jnp.zeros((2,), dtype=float) + a
+            """, "float64-dtype", path="pkg/ops/k.py")
+        assert len(fs) == 2
+
+    def test_outside_kernel_module_not_flagged(self):
+        fs = lint("""
+            import numpy as np
+
+            def io_path(x):
+                return np.float64(x)
+            """, "float64-dtype", path="pkg/data/io.py")
+        assert fs == []
+
+    def test_f32_kernel_not_flagged(self):
+        fs = lint("""
+            import jax.numpy as jnp
+
+            def kernel(x):
+                return jnp.asarray(x, jnp.float32)
+            """, "float64-dtype", path="pkg/ops/k.py")
+        assert fs == []
+
+
+# ------------------------------------------------------------- broad-except
+class TestBroadExcept:
+    def test_swallowing_handler_flagged(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+            """, "broad-except")
+        assert names(fs) == ["broad-except"]
+
+    def test_bare_except_flagged(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except:
+                    log()
+            """, "broad-except")
+        assert names(fs) == ["broad-except"]
+
+    def test_reraise_and_narrow_not_flagged(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except ValueError:
+                    pass
+                except Exception as e:
+                    cleanup()
+                    raise
+            """, "broad-except")
+        assert fs == []
+
+    def test_raise_from_not_flagged(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except Exception as e:
+                    raise RuntimeError("context") from e
+            """, "broad-except")
+        assert fs == []
+
+
+# ------------------------------------------------- suppression + reporting
+class TestSuppression:
+    SRC = """
+        import jax
+
+        @jax.jit
+        def fwd(x):
+            return x.sum().item(){tail}
+        """
+
+    def test_inline_disable(self):
+        fs = lint(self.SRC.format(tail="  # jaxlint: disable=host-sync"))
+        assert fs == []
+
+    def test_disable_wrong_rule_keeps_finding(self):
+        fs = lint(self.SRC.format(tail="  # jaxlint: disable=broad-except"))
+        assert names(fs) == ["host-sync"]
+
+    def test_disable_all(self):
+        fs = lint(self.SRC.format(tail="  # jaxlint: disable=all"))
+        assert fs == []
+
+    def test_disable_next_line(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def fwd(x):
+                # jaxlint: disable-next=host-sync
+                return x.sum().item()
+            """)
+        assert fs == []
+
+    def test_disable_file(self):
+        fs = lint("""
+            # jaxlint: disable-file=host-sync
+            import jax
+
+            @jax.jit
+            def fwd(x):
+                return x.sum().item()
+            """)
+        assert fs == []
+
+
+class TestReporting:
+    def test_json_report_shape(self):
+        fs = lint(TestSuppression.SRC.format(tail=""))
+        doc = json.loads(render_json(fs))
+        assert doc["count"] == 1
+        (f,) = doc["findings"]
+        assert f["rule"] == "host-sync"
+        assert f["path"] == "pkg/mod.py"
+        assert f["line"] > 0 and "message" in f
+
+    def test_parse_error_is_a_finding(self):
+        fs = lint("def broken(:\n")
+        assert names(fs) == ["parse-error"]
+
+    def test_all_rules_have_docs(self):
+        assert len(ALL_RULES) >= 6
+        for r in ALL_RULES:
+            assert r.name and r.description and r.__doc__
+
+
+class TestCliAndTree:
+    def test_analyze_paths_walks_files(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax\n\n@jax.jit\ndef fwd(x):\n"
+                       "    return x.sum().item()\n")
+        (tmp_path / "__pycache__").mkdir()
+        (tmp_path / "__pycache__" / "junk.py").write_text("def broken(:\n")
+        fs = analyze_paths([str(tmp_path)])
+        assert names(fs) == ["host-sync"]
+
+    @pytest.mark.slow
+    def test_cli_exit_codes(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text("def f():\n    return 1\n")
+        r = subprocess.run([sys.executable, "-m", "deeplearning4j_tpu.analysis",
+                            str(clean)], capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+        r = subprocess.run([sys.executable, "-m", "deeplearning4j_tpu.analysis",
+                            "--json", str(dirty)], capture_output=True, text=True)
+        assert r.returncode == 1
+        assert json.loads(r.stdout)["count"] == 1
+
+    def test_repo_tree_is_clean(self):
+        import os
+        pkg = os.path.join(os.path.dirname(__file__), "..", "deeplearning4j_tpu")
+        fs = analyze_paths([pkg])
+        assert fs == [], "\n".join(f.render() for f in fs)
