@@ -94,7 +94,8 @@ def _probe_devices(timeout_s: float):
 
 def _measure(batch: int, img: int, steps: int, on_tpu: bool):
     """Build + train-step ResNet-50 at one batch size; returns
-    (images_per_sec, final_loss). Raises on OOM/compile failure."""
+    (images_per_sec, final_loss, telemetry_snapshot). Raises on OOM/compile
+    failure."""
     import jax
 
     from deeplearning4j_tpu.data import BenchmarkIterator
@@ -133,7 +134,31 @@ def _measure(batch: int, img: int, steps: int, on_tpu: bool):
     t1, _, params, opt_state, state = run(k1, params, opt_state, state)
     t2, lf, params, opt_state, state = run(k2, params, opt_state, state)
     per_step = (t2 - t1) / (k2 - k1) if t2 > t1 else t2 / k2
-    return batch / per_step, lf
+
+    # fenced telemetry probe: per-step latency distribution + compile count
+    # for the BENCH_LAST.json trajectory. fence=False — the per-step
+    # float(loss) readback inside the thunk is the tunnel-safe fence (same
+    # reasoning as run(); block_until_ready is not reliable here), so the
+    # recorded train_step_seconds is still end-to-end per step.
+    from deeplearning4j_tpu.obs import StepTelemetry
+
+    tel = StepTelemetry(fence=False, memory_every=0)
+    sig = ("resnet50", batch, img)
+
+    def probe_step():
+        nonlocal params, opt_state, state, lf
+        params, opt_state, state, loss = step(params, opt_state, state, x, y, rng)
+        lf = float(loss)
+        return lf
+
+    for _ in range(max(k1, 3)):
+        tel.step(probe_step, sig=sig, batch_size=batch)
+    snap = tel.snapshot()
+    telemetry = {"steps_per_sec": round(snap["steps_per_sec"], 3),
+                 "p50_step_seconds": round(snap["p50_step_seconds"], 6),
+                 "p95_step_seconds": round(snap["p95_step_seconds"], 6),
+                 "compile_count": snap["compile_cache_misses"]}
+    return batch / per_step, lf, telemetry
 
 
 def _breadth(deadline: float, on_tpu: bool) -> dict:
@@ -237,8 +262,8 @@ def main():
     results = {}
     for b in batches:
         try:
-            ips, loss = _measure(b, img, steps, on_tpu)
-            results[b] = (ips, loss)
+            ips, loss, tel = _measure(b, img, steps, on_tpu)
+            results[b] = (ips, loss, tel)
         except Exception as e:  # OOM / compile failure at this batch size
             print(f"bench: batch {b} failed: {type(e).__name__}: "
                   f"{str(e)[:200]}", file=sys.stderr)
@@ -246,7 +271,7 @@ def main():
         print("bench: no batch size succeeded", file=sys.stderr)
         raise SystemExit(2)
     batch = max(results, key=lambda b: results[b][0])
-    images_per_sec, loss = results[batch]
+    images_per_sec, loss, telemetry = results[batch]
     # scale flops if benchmarking at reduced resolution (flops ~ HW)
     flops_per_image = RESNET50_TRAIN_FLOPS_PER_IMAGE * (img / 224.0) ** 2
     peak = next((v for k, v in PEAK_BF16.items() if str(dev.device_kind).startswith(k)), 197e12)
@@ -266,6 +291,9 @@ def main():
             "captured": time.strftime("%Y-%m-%d"),
             "swept": {str(b): round(r[0], 2) for b, r in results.items()},
             "flops_per_image": flops_per_image,
+            # fenced per-step snapshot at the winning batch (obs/ probe):
+            # steps/sec, p50/p95 step latency, compile count
+            "telemetry": telemetry,
             # exact-BN ResNet-50 envelope on this chip class is ~0.36-0.40
             # MFU (PERF.md floor analysis: BN backward at 86% of HBM peak,
             # conv MXU floor ~16ms of a ~44ms step); the matmul-dominated

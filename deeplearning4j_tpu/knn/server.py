@@ -8,6 +8,7 @@ Endpoints (JSON):
 - POST /knnnew  {"ndarray": [[...floats...]], "k": int}  — neighbors of new
   vectors (Base64NDArrayBody in the reference; plain JSON arrays here)
 - GET  /health
+- GET  /metrics — Prometheus scrape (request latency histograms; see obs/)
 
 A ``NearestNeighborsClient`` mirror lives in ``client.py``.
 """
@@ -18,17 +19,21 @@ import json
 
 import numpy as np
 
+from ..obs.metrics import MetricsRegistry
 from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
 from .brute import BruteForceKNN
 
 
 class NearestNeighborsServer(JsonHTTPServerMixin):
     def __init__(self, points, distance: str = "euclidean", port: int = 9000,
-                 default_k: int = 5, host: str = "127.0.0.1"):
+                 default_k: int = 5, host: str = "127.0.0.1",
+                 metrics: MetricsRegistry = None):
         self.index = BruteForceKNN(points, distance=distance)
         self.port = port
         self.host = host  # bind 0.0.0.0 to serve other hosts
         self.default_k = default_k
+        # per-endpoint latency + GET /metrics, provided by the httpd layer
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
 
     def _handler(self):
         server = self

@@ -1,0 +1,18 @@
+"""Observability: metrics registry, span tracer, JAX-aware step telemetry.
+
+``obs.metrics`` and ``obs.trace`` are stdlib-only and jax-free — servers
+import them directly so ``/metrics`` works in processes that never load jax.
+Importing this package pulls the full surface (including the jax-adjacent
+``StepTelemetry`` / ``TelemetryListener``).
+"""
+
+from .listener import TelemetryListener
+from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                      MetricsRegistry, default_registry)
+from .step import StepTelemetry
+from .trace import Tracer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
+    "default_registry", "Tracer", "StepTelemetry", "TelemetryListener",
+]

@@ -477,17 +477,36 @@ class ParallelWrapper:
         self._make_step_masked = make_step
 
     # --- fit loop (ParallelWrapper.fit :467) ---
-    def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = ()):
+    def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = (),
+            telemetry=None):
+        """``telemetry``: an ``obs.StepTelemetry``-shaped object (duck-typed,
+        see Trainer.fit); adopted from the first listener exposing
+        ``.telemetry`` when omitted. Steps route through
+        ``telemetry.parallel_step``, which additionally fences each loss
+        shard in device order to gauge per-replica skew
+        (``parallel_replica_step_seconds{replica=...}``) and aggregate
+        throughput (``parallel_samples_per_second``)."""
         from ..data.iterators import AsyncIterator
         from ..train.listeners import DeferredScoreReporter
 
         reporter = DeferredScoreReporter(
             self, listeners, reduce=lambda l: float(np.mean(jax.device_get(l))))
+        tel = telemetry
+        if tel is None:
+            for lst in listeners:
+                tel = getattr(lst, "telemetry", None)
+                if tel is not None:
+                    break
         for epoch in range(epochs):
             self.epoch = epoch
+            if tel is not None:
+                tel.tracer.instant("epoch_start", epoch=epoch)
             for lst in listeners:
                 lst.on_epoch_start(self, epoch)
-            for ds in AsyncIterator(iterator, to_device=False):
+            it = AsyncIterator(iterator, to_device=False)
+            if tel is not None:
+                it = tel.wrap_iterator(it)
+            for ds in it:
                 x = np.asarray(ds.features)
                 y = np.asarray(ds.labels)
                 mask = (np.asarray(ds.features_mask)
@@ -505,7 +524,12 @@ class ParallelWrapper:
                 for lst in listeners:
                     if isinstance(lst, PerformanceListener):
                         lst.step_begin(b)
-                loss = self._fit_batch(x, y, mask, lmask)
+                if tel is not None:
+                    loss = tel.parallel_step(
+                        lambda: self._fit_batch(x, y, mask, lmask),
+                        batch_size=b)
+                else:
+                    loss = self._fit_batch(x, y, mask, lmask)
                 reporter.report(self.iteration, epoch, loss)
                 self.iteration += 1
             reporter.flush()

@@ -11,6 +11,7 @@ gated, matching how the reference gates on a running broker.
 from __future__ import annotations
 
 import io
+import logging
 import queue
 import socket
 import struct
@@ -18,6 +19,10 @@ import threading
 from typing import Callable, List, Optional
 
 import numpy as np
+
+from ..obs.metrics import default_registry
+
+logger = logging.getLogger("deeplearning4j_tpu.streaming")
 
 
 def _encode(arr: np.ndarray) -> bytes:
@@ -31,10 +36,14 @@ def _decode(data: bytes) -> np.ndarray:
 
 
 def _default_on_error(e: Exception) -> None:
-    import sys
-
-    print(f"NDArrayConsumer: dropped frame/callback error: {e!r}",
-          file=sys.stderr)
+    """Default drop path: count it (process-global registry, so any server's
+    /metrics surfaces it) and log it — a stream quietly losing frames is a
+    production incident, not stderr noise."""
+    default_registry().counter(
+        "streaming_dropped_frames_total",
+        help="frames dropped by NDArrayConsumer (decode or callback error)"
+    ).inc()
+    logger.warning("NDArrayConsumer: dropped frame/callback error: %r", e)
 
 
 def kafka_available() -> bool:

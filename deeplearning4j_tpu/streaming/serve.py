@@ -6,6 +6,7 @@ backed by the dynamic-batching ``ParallelInference`` worker (SURVEY.md
 Endpoints:
 - POST /predict  {"ndarray": [[...]]}  → {"output": [[...]]}
 - GET  /health
+- GET  /metrics — Prometheus scrape (request latency histograms; see obs/)
 """
 
 from __future__ import annotations
@@ -14,18 +15,21 @@ import json
 
 import numpy as np
 
+from ..obs.metrics import MetricsRegistry
 from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
 
 
 class InferenceRoute(JsonHTTPServerMixin):
     def __init__(self, model, params=None, state=None, port: int = 9010,
                  host: str = "127.0.0.1", use_parallel_inference: bool = False,
-                 batch_limit: int = 32):
+                 batch_limit: int = 32, metrics: MetricsRegistry = None):
         self.model = model
         self.params = params if params is not None else model.params
         self.state = state if state is not None else model.state
         self.port = port
         self.host = host
+        # per-endpoint latency + GET /metrics, provided by the httpd layer
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._pi = None
         if use_parallel_inference:
             from ..parallel.inference import ParallelInference

@@ -540,7 +540,8 @@ class Trainer:
 
     # --- fit (MultiLayerNetwork.fit :1262 / ComputationGraph.fit :1010) ---
     def fit(self, iterator, epochs: int = 1, listeners: Sequence[TrainingListener] = (),
-            prefetch: bool = True, steps_per_execution: int = 1) -> "Trainer":
+            prefetch: bool = True, steps_per_execution: int = 1,
+            telemetry=None) -> "Trainer":
         """Streaming hot loop: the loss readback for iteration k happens only
         AFTER iteration k+1 has been dispatched, so the device never idles
         waiting on the host (the reference keeps the device busy with its
@@ -561,7 +562,15 @@ class Trainer:
         the next runs), and when any listener ``snapshots_state``
         (checkpoint/evaluative — under a megastep iteration i would observe
         params up to K steps ahead); ragged tail batches fall back to the
-        single step."""
+        single step.
+
+        ``telemetry``: an ``obs.StepTelemetry``-shaped object (duck-typed —
+        this module never imports obs, so the default path stays obs-free by
+        construction). When omitted, the first listener exposing a
+        ``.telemetry`` attribute (``obs.TelemetryListener``) is adopted.
+        Active telemetry times data-wait/dispatch/device-compute per step
+        (fencing each step) and disables the megastep — K steps compiled
+        into one program have no per-iteration boundaries to time."""
         from ..data.iterators import AsyncIterator
         from .listeners import DeferredScoreReporter
 
@@ -569,6 +578,12 @@ class Trainer:
             self._step_fn = self._make_step()
         tbptt = getattr(self.model.config, "tbptt_length", 0)
         reporter = DeferredScoreReporter(self, listeners)
+        tel = telemetry
+        if tel is None:
+            for lst in listeners:
+                tel = getattr(lst, "telemetry", None)
+                if tel is not None:
+                    break
         spe = max(1, int(steps_per_execution))
         # requires_sync listeners (e.g. DivergenceListener rollback) need
         # every iteration validated before the next mutates trainer state —
@@ -576,8 +591,10 @@ class Trainer:
         # snapshots_state listeners (checkpoint/evaluative) read trainer
         # params in iteration_done; under a megastep iteration i would see
         # params up to K steps ahead, so they too force the single step.
+        # Telemetry also forces the single step: per-iteration phase timing
+        # has nothing to clock inside one fused K-step program.
         use_mega = (spe > 1 and not tbptt and self.mesh is None
-                    and self.grad_accum == 1
+                    and self.grad_accum == 1 and tel is None
                     and not any(getattr(l, "requires_sync", False)
                                 or getattr(l, "snapshots_state", False)
                                 for l in listeners))
@@ -585,9 +602,13 @@ class Trainer:
 
         for epoch in range(epochs):
             self.epoch = epoch
+            if tel is not None:
+                tel.tracer.instant("epoch_start", epoch=epoch)
             for lst in listeners:
                 lst.on_epoch_start(self, epoch)
             it = AsyncIterator(iterator) if prefetch else iterator
+            if tel is not None:
+                it = tel.wrap_iterator(it)
             for ds in it:
                 bs = ds.num_examples
                 xb, yb, fmb, lmb = self._unpack_batch(ds)
@@ -610,7 +631,18 @@ class Trainer:
                 if xb_ndim is None:
                     xb_ndim = np.asarray(xb).ndim
                 if tbptt and xb_ndim >= 3:
-                    loss = self._fit_tbptt_batch(ds, tbptt)
+                    if tel is not None:
+                        loss = tel.step(
+                            lambda: self._fit_tbptt_batch(ds, tbptt),
+                            sig=self._batch_sig((xb, yb, fmb, lmb)),
+                            batch_size=bs, kind="tbptt")
+                    else:
+                        loss = self._fit_tbptt_batch(ds, tbptt)
+                elif tel is not None:
+                    loss = tel.step(
+                        lambda: self._dispatch_train_step(xb, yb, fmb, lmb),
+                        sig=self._batch_sig((xb, yb, fmb, lmb)),
+                        batch_size=bs)
                 else:
                     loss = self._dispatch_train_step(xb, yb, fmb, lmb)
                 reporter.report(self.iteration, epoch, loss)

@@ -9,6 +9,8 @@ Endpoints:
 - GET  /train/<sid>/overview?since=T  — per-worker score + timing series,
   incremental (only records with timestamp >= T)
 - GET  /train/<sid>/model             — static info + latest per-layer stats
+- GET  /metrics                       — Prometheus scrape (request latency
+  histograms per endpoint; see obs/)
 - POST /remote                        — remote stats receiver: JSON
   {"kind": "static"|"update", "session_id", "worker_id", ...} pushed from
   other processes/hosts (VanillaStatsStorageRouter → RemoteReceiverModule)
@@ -20,6 +22,7 @@ import json
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlparse
 
+from ..obs.metrics import MetricsRegistry
 from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
 from .storage import BaseStatsStorage, InMemoryStatsStorage
 
@@ -154,11 +157,23 @@ class UIServer(JsonHTTPServerMixin):
     """``UIServer.getInstance().attach(storage)`` parity."""
 
     def __init__(self, storage: Optional[BaseStatsStorage] = None, port: int = 9001,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", metrics: MetricsRegistry = None):
         self.storage = storage or InMemoryStatsStorage()
         self.port = port
         self.host = host  # bind 0.0.0.0 for the cross-host remote-receiver path
+        # per-endpoint latency + GET /metrics, provided by the httpd layer
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._tsne: dict = {}  # {"coords": [[x,y],...], "labels": [...]}
+
+    @staticmethod
+    def _metric_route(path: str) -> str:
+        """Collapse session-parameterized paths so the endpoint label stays
+        bounded-cardinality no matter how many sessions exist."""
+        parts = path.split("/")
+        if len(parts) == 4 and parts[1] == "train" and \
+                parts[3] in ("overview", "model"):
+            return f"/train/{{sid}}/{parts[3]}"
+        return path
 
     def upload_tsne(self, coords, labels=None) -> "UIServer":
         """Publish a 2-D embedding to the /tsne viewer (TsneModule parity:

@@ -1,15 +1,60 @@
 """Shared JSON-over-http.server scaffolding for the kNN and UI daemons.
 
 One place for the handler factory plumbing: reply encoding, port-0
-resolution, background-thread serve loop, and shutdown ordering.
+resolution, background-thread serve loop, and shutdown ordering — plus
+request telemetry: any server object exposing a ``metrics`` registry
+(``obs.metrics.MetricsRegistry``) gets per-endpoint request-latency
+histograms and a ``GET /metrics`` Prometheus scrape for free, with no
+changes to its handler code. The coupling is duck-typed so this module
+stays importable without obs.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import urlsplit
+
+PROMETHEUS_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _instrumented(fn, verb: str):
+    """Wrap a do_GET/do_POST with request telemetry against ``owner.metrics``.
+
+    GET /metrics is answered here (Prometheus text format) so every server
+    built on this scaffolding scrapes identically. Label cardinality is the
+    owner's problem: servers with parameterized paths provide
+    ``_metric_route(path)`` to collapse them (e.g. ``/train/{sid}/overview``)
+    — otherwise the raw path is the endpoint label.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(self):
+        reg = getattr(self.owner, "metrics", None)
+        if reg is None:
+            return fn(self)
+        path = urlsplit(self.path).path
+        if verb == "GET" and path == "/metrics":
+            self.reply(200, reg.to_prometheus(), PROMETHEUS_CTYPE)
+            return None
+        route = getattr(self.owner, "_metric_route", None)
+        endpoint = route(path) if route is not None else path
+        labels = {"method": verb, "endpoint": endpoint}
+        t0 = time.perf_counter()
+        try:
+            return fn(self)
+        finally:
+            reg.histogram("http_request_seconds", labels,
+                          help="HTTP request handling latency by endpoint"
+                          ).observe(time.perf_counter() - t0)
+            reg.counter("http_requests_total", labels,
+                        help="HTTP requests served by endpoint").inc()
+
+    return wrapper
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -17,6 +62,15 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     and reach their server object via ``self.owner``."""
 
     owner = None  # set by the subclass closure
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        for method in ("do_GET", "do_POST"):
+            fn = cls.__dict__.get(method)
+            if fn is not None and not getattr(fn, "_obs_wrapped", False):
+                wrapped = _instrumented(fn, method[3:])
+                wrapped._obs_wrapped = True
+                setattr(cls, method, wrapped)
 
     def log_message(self, *a):
         pass

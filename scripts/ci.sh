@@ -8,6 +8,16 @@ cd "$(dirname "$0")/.."
 echo "=== jaxlint: deeplearning4j_tpu/ ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/
 
+# obs/ must stay jaxlint-clean by construction (no suppressions needed):
+# telemetry that trips host-sync/jit-side-effect would poison the very hot
+# paths it measures. The tree-wide run above covers it; this explicit pass
+# keeps the guarantee visible even if the tree run's path set changes.
+echo "=== jaxlint: deeplearning4j_tpu/obs/ ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/obs/
+
+echo "=== smoke trace: 5-step instrumented train ==="
+CI_ARTIFACTS_DIR="${CI_ARTIFACTS_DIR:-ci-artifacts}" python scripts/smoke_trace.py
+
 echo "=== tier-1 tests ==="
 set -o pipefail
 rm -f /tmp/_t1.log
