@@ -21,9 +21,14 @@ Suppress a finding with ``# jaxlint: disable=<rule>`` on the offending line
 in ``deeplearning4j_tpu/analysis/README.md``.
 """
 
+from .callgraph import Program, build_program
 from .engine import (Finding, Rule, analyze_paths, analyze_source,
                      iter_py_files, render_json, render_text)
 from .rules import ALL_RULES, rules_by_name
+from .sarif import (fingerprints, load_baseline, new_findings, render_sarif,
+                    to_sarif, write_baseline)
 
 __all__ = ["Finding", "Rule", "ALL_RULES", "rules_by_name", "analyze_paths",
-           "analyze_source", "iter_py_files", "render_json", "render_text"]
+           "analyze_source", "iter_py_files", "render_json", "render_text",
+           "Program", "build_program", "to_sarif", "render_sarif",
+           "fingerprints", "write_baseline", "load_baseline", "new_findings"]

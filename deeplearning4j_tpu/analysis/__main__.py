@@ -1,34 +1,56 @@
-"""CLI: ``python -m deeplearning4j_tpu.analysis <paths> [--json] [--select ...]``.
+"""CLI: ``python -m deeplearning4j_tpu.analysis <paths> [options]``.
 
-Exit status: 0 when clean, 1 when any finding survives suppression, 2 on
-usage errors — so CI can gate on it directly (scripts/ci.sh).
+Options: ``--json`` (machine-readable report), ``--sarif FILE`` (SARIF 2.1.0
+for GitHub code scanning), ``--baseline FILE`` (record-then-ratchet: first
+run writes the current findings, later runs fail only on *new* ones),
+``--select RULES``, ``--exclude GLOB`` (adds to the default excludes:
+``tests``, ``__pycache__``), ``--list-rules``.
+
+Exit status: 0 when clean (or no finding is new vs. the baseline), 1 when
+any new finding survives suppression, 2 on usage errors — so CI can gate on
+it directly (scripts/ci.sh).
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional
 
 from .engine import analyze_paths, render_json, render_text
 from .rules import ALL_RULES, rules_by_name
+from .sarif import load_baseline, new_findings, render_sarif, write_baseline
+
+#: always-on walk excludes; --exclude adds to these
+DEFAULT_EXCLUDES = ["tests", "__pycache__"]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deeplearning4j_tpu.analysis",
-        description="jaxlint: JAX/TPU-correctness static analysis")
+        description="jaxlint: JAX/TPU-correctness static analysis "
+                    "(whole-program since v2)")
     ap.add_argument("paths", nargs="*", help=".py files or directories")
     ap.add_argument("--json", action="store_true", help="machine-readable report")
+    ap.add_argument("--sarif", metavar="FILE",
+                    help="also write a SARIF 2.1.0 report to FILE")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="missing FILE: record current findings and exit 0; "
+                         "existing FILE: fail only on findings not recorded")
     ap.add_argument("--select", metavar="RULES",
                     help="comma-separated rule names to run (default: all)")
+    ap.add_argument("--exclude", metavar="GLOB", action="append", default=[],
+                    help="glob matched against paths or single components; "
+                         "repeatable; adds to defaults "
+                         f"({', '.join(DEFAULT_EXCLUDES)})")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for r in ALL_RULES:
-            print(f"{r.name:20s} {r.description}")
+            print(f"{r.name:22s} {r.description}")
         return 0
     if not args.paths:
         ap.error("no paths given (try: deeplearning4j_tpu/)")
@@ -42,7 +64,31 @@ def main(argv: Optional[List[str]] = None) -> int:
             ap.error(f"unknown rule(s) {unknown}; known: {sorted(table)}")
         rules = [table[n] for n in names]
 
-    findings = analyze_paths(args.paths, rules)
+    exclude = DEFAULT_EXCLUDES + args.exclude
+    findings = analyze_paths(args.paths, rules, exclude=exclude)
+
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            fh.write(render_sarif(findings) + "\n")
+
+    if args.baseline:
+        if not os.path.exists(args.baseline):
+            write_baseline(args.baseline, findings)
+            print(f"jaxlint: baseline recorded ({len(findings)} finding(s) "
+                  f"-> {args.baseline})")
+            return 0
+        try:
+            baseline = load_baseline(args.baseline)
+        except (ValueError, OSError, KeyError) as e:
+            ap.error(f"cannot read baseline {args.baseline}: {e}")
+        fresh = new_findings(findings, baseline)
+        known = len(findings) - len(fresh)
+        print(render_json(fresh) if args.json else render_text(fresh))
+        if known:
+            print(f"({known} baselined finding(s) suppressed)",
+                  file=sys.stderr)
+        return 1 if fresh else 0
+
     print(render_json(findings) if args.json else render_text(findings))
     return 1 if findings else 0
 

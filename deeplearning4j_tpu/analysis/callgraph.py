@@ -1,0 +1,739 @@
+"""Whole-program call graph for jaxlint — cross-module import and alias
+resolution over every analyzed file.
+
+jaxlint v1 stopped at module boundaries: jit context propagated only through
+same-module calls by bare name, so a helper in ``nn/`` reached exclusively
+from another module's jitted step was invisible to every rule. This module
+replaces that approximation with a :class:`Program` — all analyzed files
+parsed once, a module table keyed by dotted name (derived from file paths,
+suffix-matched so absolute and relative invocations agree), per-module alias
+maps covering ``import``/``from``-imports including relative ones and one
+level of ``__init__`` re-exports, and a call-edge resolver that understands
+bare names, ``self.method()``, and aliased cross-module attributes.
+
+On top of the graph, the program computes the facts interprocedural rules
+query:
+
+- the **jit closure**: every function reachable (through resolvable call
+  edges, across modules) from a jit/pjit/shard_map/pmap root or defined in
+  an ``ops/`` kernel module;
+- **PRNG consumption summaries**: per function parameter, how many
+  independent ``jax.random`` draws consume it without an intervening
+  ``split``/``fold_in`` — propagated through call sites to a fixpoint
+  (capped at 2: the analysis only distinguishes 0 / 1 / "reused");
+- the **donation table**: which callables (decorated, ``jax.jit(fn, ...)``
+  wrap-assigned to a name or a ``self.`` attribute) donate which parameters.
+
+Everything is stdlib ``ast``; nothing here imports jax or the code under
+analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .dataflow import ForwardScan, assign_names
+
+# module roots whose canonical names we track through aliases (per-file
+# resolution of jax/numpy/stdlib names; the cross-module alias map in
+# ModuleInfo is separate and tracks *analyzed* modules)
+_CANON_MODULES = {
+    "numpy": "numpy",
+    "jax": "jax",
+    "jax.numpy": "jax.numpy",
+    "jax.random": "jax.random",
+    "random": "random",
+    "datetime": "datetime",
+    "time": "time",
+    "functools": "functools",
+    "contextlib": "contextlib",
+    "threading": "threading",
+    "collections": "collections",
+    "jax.experimental.pjit": "jax.experimental.pjit",
+    "jax.experimental.shard_map": "jax.experimental.shard_map",
+}
+
+JIT_WRAPPERS = {"jax.jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit"}
+
+#: transforms that trace their operand but take no donation kwargs —
+#: functions wrapped by these are jit context, not donation sites
+TRACE_ONLY_WRAPPERS = {"jax.shard_map", "shard_map", "jax.pmap",
+                       "jax.experimental.shard_map.shard_map"}
+
+
+class ImportMap:
+    """Maps local names to canonical dotted paths via one file's imports."""
+
+    def __init__(self, tree: ast.Module):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in _CANON_MODULES or a.name.split(".")[0] in _CANON_MODULES:
+                        self.aliases[(a.asname or a.name.split(".")[0])] = (
+                            a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    full = f"{node.module}.{a.name}"
+                    root = node.module.split(".")[0]
+                    if root in _CANON_MODULES:
+                        self.aliases[a.asname or a.name] = full
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+
+def is_jit_expr(node: ast.AST, resolve) -> bool:
+    """True for expressions evaluating to a jit transform: ``jax.jit``,
+    ``partial(jax.jit, ...)`` — in decorator position or as a wrap callee."""
+    q = resolve(node)
+    if q in JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        fq = resolve(node.func)
+        if fq in JIT_WRAPPERS:
+            return True
+        if fq == "functools.partial" and node.args and resolve(node.args[0]) in JIT_WRAPPERS:
+            return True
+    return False
+
+
+def is_trace_expr(node: ast.AST, resolve) -> bool:
+    """jit transforms plus trace-only wrappers (shard_map, pmap)."""
+    if is_jit_expr(node, resolve):
+        return True
+    q = resolve(node)
+    if q in TRACE_ONLY_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        fq = resolve(node.func)
+        if fq in TRACE_ONLY_WRAPPERS:
+            return True
+        if fq == "functools.partial" and node.args \
+                and resolve(node.args[0]) in TRACE_ONLY_WRAPPERS:
+            return True
+    return False
+
+
+def jit_call_kwargs(node: ast.AST, resolve) -> Optional[List[str]]:
+    """If ``node`` is a jit transform *call* (``jax.jit(...)``,
+    ``partial(jax.jit, ...)``), the keyword names passed to it; else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fq = resolve(node.func)
+    if fq in JIT_WRAPPERS:
+        return [k.arg for k in node.keywords if k.arg]
+    if fq == "functools.partial" and node.args and resolve(node.args[0]) in JIT_WRAPPERS:
+        return [k.arg for k in node.keywords if k.arg]
+    return None
+
+
+def _jit_donation(node: ast.AST, resolve) -> Tuple[Optional[List[int]],
+                                                   Optional[List[str]]]:
+    """Literal donate_argnums / donate_argnames of a jit expr, if present."""
+    if not isinstance(node, ast.Call):
+        return None, None
+    if jit_call_kwargs(node, resolve) is None:
+        return None, None
+    nums: Optional[List[int]] = None
+    names: Optional[List[str]] = None
+    for k in node.keywords:
+        v = k.value
+        if k.arg == "donate_argnums":
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums = [e.value for e in v.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+        elif k.arg == "donate_argnames":
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names = [e.value for e in v.elts
+                         if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return nums, names
+
+
+def module_name_for(path: str) -> Tuple[str, bool]:
+    """(dotted module name, is_package) for a file path. The name is built
+    from the trailing path components that are valid identifiers, so
+    ``deeplearning4j_tpu/parallel/mesh.py`` analyzed from the repo root gets
+    exactly the name its absolute imports use; absolute invocations are
+    reconciled by suffix matching in :meth:`Program.lookup_module`."""
+    parts = os.path.normpath(path).replace(os.sep, "/").split("/")
+    last = parts[-1]
+    if last.endswith(".py"):
+        last = last[:-3]
+    is_pkg = last == "__init__"
+    comps = parts[:-1] + ([] if is_pkg else [last])
+    mod: List[str] = []
+    for c in reversed(comps):
+        if c.isidentifier():
+            mod.append(c)
+        else:
+            break
+    return ".".join(reversed(mod)), is_pkg
+
+
+class FuncInfo:
+    """One function or method definition in the program."""
+
+    __slots__ = ("module", "node", "name", "qual", "cls", "params", "jit",
+                 "donated_idx", "donated_names", "prng_uses")
+
+    def __init__(self, module: "ModuleInfo", node: ast.AST, cls: Optional[str]):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.cls = cls
+        self.qual = f"{cls}.{node.name}" if cls else node.name
+        args = node.args
+        params = [a.arg for a in list(args.posonlyargs) + list(args.args)]
+        if cls and params and params[0] in ("self", "cls"):
+            params = params[1:]
+        #: positional parameter names as seen by callers (self dropped)
+        self.params: List[str] = params
+        self.jit = False
+        self.donated_idx: Set[int] = set()
+        self.donated_names: Set[str] = set()
+        #: param name -> 0 (untouched/opaque) | 1 (consumed once) | 2 (reused)
+        self.prng_uses: Dict[str, int] = {}
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donated_idx or self.donated_names)
+
+    def donated_params(self) -> Set[str]:
+        out = set(self.donated_names)
+        for i in self.donated_idx:
+            if i < len(self.params):
+                out.add(self.params[i])
+        return out
+
+    def __repr__(self):
+        return f"<FuncInfo {self.module.module}:{self.qual}>"
+
+
+class ModuleInfo:
+    """One analyzed file: AST, import maps, function tables, parents."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.module, self.is_package = module_name_for(path)
+        self.kernel = "ops" in os.path.normpath(path).split(os.sep)
+        self.imports = ImportMap(tree)
+
+        #: local name -> dotted target (module, or module.attr) — every
+        #: import, not just canonical ones; used for cross-module resolution
+        self.aliases: Dict[str, str] = {}
+        #: module-level string constants (axis names etc.): name -> value
+        self.str_consts: Dict[str, str] = {}
+        #: "f" / "Cls.m" -> FuncInfo (top-level defs and methods)
+        self.functions: Dict[str, FuncInfo] = {}
+        #: every def in the file by bare name, outermost-first (v1 semantics)
+        self.local_funcs: Dict[str, FuncInfo] = {}
+        self.all_funcs: List[FuncInfo] = []
+        #: (FuncInfo, jit expr) for every way a local function gets jitted
+        self.jit_applications: List[Tuple[FuncInfo, ast.AST]] = []
+        #: caller-visible donating callables: "name" / "Cls.attr" -> FuncInfo
+        self.donating_names: Dict[str, FuncInfo] = {}
+
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        self._collect_aliases()
+        self._collect_functions()
+
+    # -- construction -----------------------------------------------------
+    def _rel_base(self, level: int) -> Optional[str]:
+        base = self.module if self.is_package else \
+            ".".join(self.module.split(".")[:-1])
+        for _ in range(level - 1):
+            if not base:
+                return None
+            base = ".".join(base.split(".")[:-1])
+        return base or None
+
+    def _collect_aliases(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        self.aliases.setdefault(a.name.split(".")[0],
+                                                a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0:
+                    base = node.module
+                else:
+                    base = self._rel_base(node.level)
+                    if node.module:
+                        base = f"{base}.{node.module}" if base else node.module
+                if not base:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{base}.{a.name}"
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, str):
+                self.str_consts[stmt.targets[0].id] = stmt.value.value
+
+    def _collect_functions(self):
+        def visit(node, cls: Optional[str], top: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FuncInfo(self, child, cls)
+                    self.all_funcs.append(fi)
+                    if top or cls:
+                        self.functions.setdefault(fi.qual, fi)
+                    self.local_funcs.setdefault(fi.name, fi)
+                    visit(child, None, False)
+                elif isinstance(child, ast.ClassDef):
+                    # nested classes (the servers' closure-scoped Handler
+                    # classes) still register methods under their class name
+                    visit(child, child.name, False)
+                else:
+                    visit(child, cls, top and isinstance(node, ast.Module))
+
+        visit(self.tree, None, True)
+
+    def enclosing_class(self, node: ast.AST) -> Optional[str]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cur = self.parents.get(cur)
+        return cur
+
+
+class Program:
+    """All analyzed files as one unit: module table, call resolution, and
+    the whole-program facts (jit closure, PRNG summaries, donation table).
+    """
+
+    _MAX_ALIAS_HOPS = 6
+
+    def __init__(self, sources: Iterable[Tuple[str, str]]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_path: Dict[str, ModuleInfo] = {}
+        self.parse_errors: Dict[str, SyntaxError] = {}
+        #: scratch space for rules to memoize program-wide facts
+        self.cache: Dict[str, object] = {}
+        for path, source in sources:
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as e:
+                self.parse_errors[path] = e
+                continue
+            mi = ModuleInfo(path, source, tree)
+            self.modules[mi.module] = mi
+            self.by_path[os.path.normpath(path)] = mi
+        self._suffixes: Dict[str, Optional[ModuleInfo]] = {}
+        for name, mi in self.modules.items():
+            parts = name.split(".")
+            for i in range(len(parts)):
+                suf = ".".join(parts[i:])
+                if suf in self.modules:
+                    continue  # exact names always win
+                # ambiguous suffixes resolve to nothing
+                self._suffixes[suf] = None if suf in self._suffixes else mi
+        self._compute_jit()
+        self._compute_donations()
+        self._compute_prng_summaries()
+
+    # -- resolution -------------------------------------------------------
+    def module_for(self, path: str) -> Optional[ModuleInfo]:
+        return self.by_path.get(os.path.normpath(path))
+
+    def lookup_module(self, dotted: str) -> Optional[ModuleInfo]:
+        return self.modules.get(dotted) or self._suffixes.get(dotted)
+
+    def resolve_dotted(self, dotted: str, _hops: int = 0) -> Optional[FuncInfo]:
+        """``pkg.mod.fn`` / ``pkg.mod.Cls.m`` -> FuncInfo, chasing re-export
+        aliases (``from .mesh import make_mesh`` in an ``__init__``)."""
+        if _hops > self._MAX_ALIAS_HOPS:
+            return None
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mi = self.lookup_module(".".join(parts[:cut]))
+            if mi is None:
+                continue
+            rest = parts[cut:]
+            fi = mi.functions.get(".".join(rest))
+            if fi is not None:
+                return fi
+            tgt = mi.aliases.get(rest[0])
+            if tgt is not None:
+                return self.resolve_dotted(".".join([tgt] + rest[1:]), _hops + 1)
+            return None
+        return None
+
+    def resolve_call(self, mi: ModuleInfo, func: ast.AST,
+                     cls: Optional[str] = None) -> Optional[FuncInfo]:
+        """Resolve a call's callee expression to a FuncInfo, or None.
+
+        Handles: bare names (any def in the same file, v1 semantics),
+        ``self.method()`` within a class, and dotted paths through the
+        module's import aliases (``mesh.make_mesh`` / ``make_mesh`` after a
+        from-import, including relative imports and __init__ re-exports).
+        """
+        if isinstance(func, ast.Name):
+            fi = mi.local_funcs.get(func.id)
+            if fi is not None:
+                return fi
+            tgt = mi.aliases.get(func.id)
+            return self.resolve_dotted(tgt) if tgt else None
+        if isinstance(func, ast.Attribute):
+            parts: List[str] = []
+            node = func
+            while isinstance(node, ast.Attribute):
+                parts.append(node.attr)
+                node = node.value
+            if not isinstance(node, ast.Name):
+                return None
+            parts.reverse()
+            if node.id == "self":
+                if len(parts) == 1:
+                    if cls is None:
+                        cls = mi.enclosing_class(func)
+                    if cls:
+                        return mi.functions.get(f"{cls}.{parts[0]}")
+                return None
+            if len(parts) == 1 and node.id in mi.functions:
+                # Cls.method called through the class
+                return mi.functions.get(f"{node.id}.{parts[0]}")
+            tgt = mi.aliases.get(node.id)
+            if tgt is not None:
+                return self.resolve_dotted(".".join([tgt] + parts))
+            return None
+        return None
+
+    def map_call_args(self, call: ast.Call, callee: FuncInfo
+                      ) -> List[Tuple[str, ast.expr]]:
+        """(parameter name, argument expr) pairs for resolvable positions of
+        a call site — starred args stop positional matching, ``**kw`` is
+        skipped."""
+        out: List[Tuple[str, ast.expr]] = []
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                break
+            if i < len(callee.params):
+                out.append((callee.params[i], a))
+        for k in call.keywords:
+            if k.arg and k.arg in callee.params:
+                out.append((k.arg, k.value))
+        return out
+
+    # -- jit closure ------------------------------------------------------
+    def _compute_jit(self):
+        roots: List[FuncInfo] = []
+        for mi in self.modules.values():
+            resolve = mi.imports.resolve
+            if mi.kernel:
+                roots.extend(mi.all_funcs)
+            for fi in mi.all_funcs:
+                for dec in fi.node.decorator_list:
+                    if is_trace_expr(dec, resolve):
+                        roots.append(fi)
+                    if is_jit_expr(dec, resolve):
+                        mi.jit_applications.append((fi, dec))
+            for node in ast.walk(mi.tree):
+                if not (isinstance(node, ast.Call)
+                        and is_trace_expr(node.func, resolve)):
+                    continue
+                if not (node.args and isinstance(node.args[0], ast.Name)):
+                    continue
+                fi = mi.local_funcs.get(node.args[0].id)
+                if fi is None:
+                    continue
+                roots.append(fi)
+                if is_jit_expr(node.func, resolve) or (
+                        jit_call_kwargs(node, resolve) is not None):
+                    mi.jit_applications.append(
+                        (fi, node.func if isinstance(node.func, ast.Call)
+                         else node))
+        work = list(roots)
+        for fi in work:
+            fi.jit = True
+        while work:
+            fi = work.pop()
+            mi = fi.module
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self.resolve_call(mi, node.func,
+                                           mi.enclosing_class(node))
+                if callee is not None and not callee.jit:
+                    callee.jit = True
+                    work.append(callee)
+
+    # -- donation ---------------------------------------------------------
+    def _compute_donations(self):
+        for mi in self.modules.values():
+            resolve = mi.imports.resolve
+            for fi, expr in mi.jit_applications:
+                nums, names = _jit_donation(expr, resolve)
+                if nums:
+                    fi.donated_idx.update(nums)
+                if names:
+                    fi.donated_names.update(names)
+                if fi.donates:
+                    self._bind_donating_name(mi, fi)
+            # name = jax.jit(fn, donate_argnums=...) / self.X = jax.jit(...)
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                v = node.value
+                if not (isinstance(v, ast.Call) and v.args):
+                    continue
+                nums, names = _jit_donation(v, resolve)
+                if not (nums or names):
+                    continue
+                inner = v.args[0]
+                # unwrap jax.jit(jax.shard_map(fn, ...), donate_argnums=...)
+                while isinstance(inner, ast.Call) and inner.args and \
+                        is_trace_expr(inner.func, resolve):
+                    inner = inner.args[0]
+                if not isinstance(inner, ast.Name):
+                    continue
+                fi = mi.local_funcs.get(inner.id)
+                if fi is None:
+                    continue
+                if nums:
+                    fi.donated_idx.update(nums)
+                if names:
+                    fi.donated_names.update(names)
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    mi.donating_names[t.id] = fi
+                elif isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
+                    cls = mi.enclosing_class(node)
+                    if cls:
+                        mi.donating_names[f"{cls}.{t.attr}"] = fi
+
+    @staticmethod
+    def _bind_donating_name(mi: ModuleInfo, fi: FuncInfo):
+        mi.donating_names.setdefault(fi.qual, fi)
+        mi.donating_names.setdefault(fi.name, fi)
+
+    def donating_callee(self, mi: ModuleInfo, call: ast.Call
+                        ) -> Optional[FuncInfo]:
+        """The donating FuncInfo a call site invokes, or None."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            fi = mi.donating_names.get(f.id)
+            if fi is not None:
+                return fi
+        elif isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self":
+            cls = mi.enclosing_class(call)
+            if cls:
+                fi = mi.donating_names.get(f"{cls}.{f.attr}")
+                if fi is not None:
+                    return fi
+        fi = self.resolve_call(mi, f, mi.enclosing_class(call))
+        return fi if fi is not None and fi.donates else None
+
+    # -- PRNG summaries ---------------------------------------------------
+    _SAMPLER_EXEMPT = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data",
+                       "key_data", "clone", "key_impl", "bits"}
+
+    class _DrawCount(ForwardScan):
+        """Max draws per key name along any path — exclusive ``if d ==
+        "normal": return normal(key) ... return uniform(key)`` initializer
+        dispatch counts as one draw, not two."""
+
+        def __init__(self, resolve, exempt):
+            super().__init__()
+            self._resolve = resolve
+            self._exempt = exempt
+
+        def visit_expr(self, expr, state):
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call) and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    q = self._resolve(node.func)
+                    if q and q.startswith("jax.random.") \
+                            and q.rsplit(".", 1)[1] not in self._exempt:
+                        n = node.args[0].id
+                        state[n] = state.get(n, 0) + 1
+            return iter(())
+
+    def _compute_prng_summaries(self):
+        """Per-function raw facts: which params are split, which are rebound
+        (opaque to the analysis), how many jax.random draws consume each
+        directly, and which call sites forward a param to another analyzed
+        function. Transitive consumption is resolved at query time by
+        :meth:`prng_param_uses` (counts saturate at 2: 0 = untouched,
+        1 = consumed once, 2 = reused without a split)."""
+        self._prng_callsites: Dict[FuncInfo, List[Tuple[str, FuncInfo, str]]] = {}
+        self._prng_facts: Dict[FuncInfo, Tuple[Set[str], Set[str],
+                                               Dict[str, int]]] = {}
+        for mi in self.modules.values():
+            resolve = mi.imports.resolve
+            for fi in mi.all_funcs:
+                params = set(fi.params)
+                if not params:
+                    continue
+                reassigned: Set[str] = set()
+                split: Set[str] = set()
+                sites: List[Tuple[str, FuncInfo, str]] = []
+                # path-sensitive local draw counts (exclusive branches merge
+                # with max, early-return branches are excluded)
+                counts: Dict[str, int] = {}
+                scan = self._DrawCount(resolve, self._SAMPLER_EXEMPT)
+                for _ in scan.scan(fi.node.body, counts):
+                    pass
+                direct = {p: c for p, c in counts.items() if p in params}
+                for node in ast.walk(fi.node):
+                    if isinstance(node, (ast.Assign, ast.AugAssign,
+                                         ast.AnnAssign, ast.For)):
+                        tgts = node.targets if isinstance(node, ast.Assign) \
+                            else [node.target]
+                        for t in tgts:
+                            reassigned.update(
+                                n for n in assign_names(t) if n in params)
+                    if not isinstance(node, ast.Call):
+                        continue
+                    q = resolve(node.func)
+                    argname = node.args[0].id if node.args and \
+                        isinstance(node.args[0], ast.Name) else None
+                    if q and q.startswith("jax.random."):
+                        if argname in params \
+                                and q.rsplit(".", 1)[1] in ("split", "fold_in"):
+                            split.add(argname)
+                    else:
+                        callee = self.resolve_call(mi, node.func,
+                                                   mi.enclosing_class(node))
+                        if callee is not None and callee is not fi:
+                            for pname, arg in self.map_call_args(node, callee):
+                                if isinstance(arg, ast.Name) \
+                                        and arg.id in params:
+                                    sites.append((arg.id, callee, pname))
+                self._prng_facts[fi] = (split, reassigned, direct)
+                self._prng_callsites[fi] = sites
+        # resolve transitive summaries only after every module's facts exist
+        for fi in self._prng_facts:
+            for p in fi.params:
+                fi.prng_uses[p] = self.prng_param_uses(fi, p)
+
+    def prng_param_uses(self, fi: FuncInfo, param: str,
+                        _seen: Optional[Set[Tuple[int, str]]] = None) -> int:
+        """How many independent jax.random draws consume ``param`` when the
+        function is called — 0 (never / opaque), 1 (once, or split first so
+        downstream use is well-formed), 2 (reused without a split).
+        Transitive through call sites that forward the param."""
+        if _seen is None:
+            _seen = set()
+        key = (id(fi), param)
+        if key in _seen:
+            return 0
+        _seen.add(key)
+        facts = self._prng_facts.get(fi)
+        if facts is None:
+            return 0
+        split, reassigned, direct = facts
+        if param in reassigned:
+            return 0  # rebound locally: nothing provable about the original
+        if param in split:
+            return 1  # split gates every downstream draw
+        uses = direct.get(param, 0)
+        for argname, callee, pname in self._prng_callsites.get(fi, []):
+            if uses >= 2:
+                break
+            if argname == param:
+                uses += self.prng_param_uses(callee, pname, _seen)
+        return min(uses, 2)
+
+    def prng_callee_uses(self, mi: ModuleInfo, call: ast.Call
+                         ) -> List[Tuple[str, FuncInfo, int]]:
+        """For one call site: (caller-side arg name, callee, consumption)
+        for every bare-Name argument the callee draws from. Consumption 2
+        means the callee (transitively) reuses the key without splitting."""
+        callee = self.resolve_call(mi, call.func, mi.enclosing_class(call))
+        if callee is None:
+            return []
+        out = []
+        for pname, arg in self.map_call_args(call, callee):
+            if not isinstance(arg, ast.Name):
+                continue
+            uses = self.prng_param_uses(callee, pname)
+            if uses:
+                out.append((arg.id, callee, uses))
+        return out
+
+    # -- constants --------------------------------------------------------
+    def resolve_const_str(self, mi: ModuleInfo, node: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute (or string literal) to a module-level
+        string constant, chasing import aliases — ``mesh.DATA_AXIS`` or a
+        from-imported ``DATA_AXIS`` both resolve to ``"data"``."""
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        parts.append(cur.id)
+        parts.reverse()
+        if len(parts) == 1 and parts[0] in mi.str_consts:
+            return mi.str_consts[parts[0]]
+        tgt = mi.aliases.get(parts[0])
+        if tgt is None:
+            return None
+        return self._const_from_dotted(".".join([tgt] + parts[1:]), 1)
+
+    def _const_from_dotted(self, dotted: str, _hops: int) -> Optional[str]:
+        if _hops > self._MAX_ALIAS_HOPS:
+            return None
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mi = self.lookup_module(".".join(parts[:cut]))
+            if mi is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1 and rest[0] in mi.str_consts:
+                return mi.str_consts[rest[0]]
+            tgt = mi.aliases.get(rest[0])
+            if tgt is not None:
+                return self._const_from_dotted(
+                    ".".join([tgt] + rest[1:]), _hops + 1)
+            return None
+        return None
+
+    # -- convenience ------------------------------------------------------
+    def jit_func_nodes(self, mi: ModuleInfo) -> Set[ast.AST]:
+        return {fi.node for fi in mi.all_funcs if fi.jit}
+
+
+def build_program(sources: Sequence[Tuple[str, str]]) -> Program:
+    return Program(sources)

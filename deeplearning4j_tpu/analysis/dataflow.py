@@ -1,0 +1,226 @@
+"""Intraprocedural forward dataflow for jaxlint rules.
+
+A small abstract-interpretation framework over one function body: statements
+are visited in program order, branch states are forked and re-joined, and a
+per-variable state dict flows forward. "SSA-ish" in the pragmatic sense —
+every assignment kills the tracked fact for its targets, so a fact always
+describes the *current* binding of a name, never a shadowed one.
+
+Two layers:
+
+- :class:`ForwardScan` — the walker. Subclasses observe expressions
+  (:meth:`visit_expr`), define how facts merge at join points
+  (:meth:`join_value`) and die at assignments (:meth:`kill`). The walker
+  handles If/For/While/With/Try structure, exclusive early-return branches,
+  walrus targets, and maintains :attr:`with_stack` so rules can ask "what
+  context managers are held here?" (the lock rule).
+- :class:`ReachingDefs` — a ready-made analysis on top of it: for every
+  ``Name`` load in the function, the set of assignment lines that may reach
+  it. Used by tests as the framework's reference client; rules build their
+  own subclasses (key consumption, donation liveness) the same way.
+
+The branch semantics intentionally mirror the original prng-key-reuse
+walker (jaxlint v1), whose approximations were tuned on this repo: loop
+bodies are scanned once, exclusive ``if/else`` branches are forked and
+joined with :meth:`join_value`, and a branch ending in
+``return``/``raise``/``break``/``continue`` does not contribute to the join
+(its facts cannot flow into the code after the statement).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+
+def assign_names(target: ast.AST) -> Iterator[str]:
+    """Bare names bound by an assignment target (tuples/stars unpacked)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from assign_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from assign_names(target.value)
+
+
+def walrus_targets(expr: ast.AST) -> Iterator[str]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.NamedExpr) and isinstance(node.target, ast.Name):
+            yield node.target.id
+
+
+def terminates(stmts: List[ast.stmt]) -> bool:
+    """Block ends by leaving the enclosing scope — its facts never flow into
+    the code after the branch statement."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+class ForwardScan:
+    """Forward scan of one function body with a per-name fact dict.
+
+    Subclass hooks:
+
+    - ``visit_expr(expr, state)`` — yield findings, update facts. Called for
+      every expression in evaluation-ish order.
+    - ``kill(name, state)`` — an assignment rebinds ``name`` (default: drop
+      the fact).
+    - ``join_value(a, b)`` — merge one name's facts from two branches
+      (default: ``max``, matching counting analyses).
+    - ``bottom`` — the implicit fact for names a branch never touched.
+    """
+
+    bottom = 0
+
+    def __init__(self):
+        self.with_stack: List[ast.withitem] = []
+
+    # -- hooks ------------------------------------------------------------
+    def visit_expr(self, expr: ast.expr, state: Dict) -> Iterator:
+        return iter(())
+
+    def kill(self, name: str, state: Dict) -> None:
+        state.pop(name, None)
+
+    def join_value(self, a, b):
+        return max(a, b)
+
+    # -- driver -----------------------------------------------------------
+    def run(self, fn: ast.AST) -> Iterator:
+        yield from self.scan(fn.body, {})
+
+    def _expr(self, expr, state) -> Iterator:
+        if expr is None:
+            return
+        yield from self.visit_expr(expr, state)
+        for t in walrus_targets(expr):
+            self.kill(t, state)
+
+    def _branch(self, stmts, state) -> Tuple[list, Dict]:
+        c = dict(state)
+        return list(self.scan(stmts, c)), c
+
+    def _join(self, state, branch_states) -> None:
+        if not branch_states:
+            return
+        keys = set()
+        for c in branch_states:
+            keys.update(c)
+        for k in keys:
+            vals = [c.get(k, self.bottom) for c in branch_states]
+            v = vals[0]
+            for x in vals[1:]:
+                v = self.join_value(v, x)
+            state[k] = v
+
+    def scan(self, stmts, state: Dict) -> Iterator:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, scanned on its own
+            if isinstance(stmt, ast.Assign):
+                yield from self._expr(stmt.value, state)
+                for t in stmt.targets:
+                    for n in assign_names(t):
+                        self.kill(n, state)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                yield from self._expr(stmt.value, state)
+                for n in assign_names(stmt.target):
+                    self.kill(n, state)
+            elif isinstance(stmt, ast.If):
+                yield from self._expr(stmt.test, state)
+                f1, c1 = self._branch(stmt.body, state)
+                f2, c2 = self._branch(stmt.orelse, state)
+                yield from f1
+                yield from f2
+                self._join(state, [c for c, block in
+                                   ((c1, stmt.body), (c2, stmt.orelse))
+                                   if not terminates(block)])
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield from self._expr(stmt.iter, state)
+                for n in assign_names(stmt.target):
+                    self.kill(n, state)
+                f1, c1 = self._branch(stmt.body + stmt.orelse, state)
+                yield from f1
+                self._join(state, [state, c1])
+            elif isinstance(stmt, ast.While):
+                yield from self._expr(stmt.test, state)
+                f1, c1 = self._branch(stmt.body + stmt.orelse, state)
+                yield from f1
+                self._join(state, [state, c1])
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    yield from self._expr(item.context_expr, state)
+                    if item.optional_vars is not None:
+                        for n in assign_names(item.optional_vars):
+                            self.kill(n, state)
+                self.with_stack.extend(stmt.items)
+                yield from self.scan(stmt.body, state)
+                del self.with_stack[-len(stmt.items):]
+            elif isinstance(stmt, ast.Try):
+                yield from self.scan(stmt.body, state)
+                handler_states = []
+                for h in stmt.handlers:
+                    fh, ch = self._branch(h.body, state)
+                    yield from fh
+                    handler_states.append(ch)
+                self._join(state, [state] + handler_states)
+                yield from self.scan(stmt.orelse + stmt.finalbody, state)
+            else:
+                for expr in ast.iter_child_nodes(stmt):
+                    if isinstance(expr, ast.expr):
+                        yield from self._expr(expr, state)
+
+
+class ReachingDefs(ForwardScan):
+    """Reaching definitions per name: for every ``Name`` load, which
+    assignment lines may have produced the current binding.
+
+    ``defs_at(name_node)`` answers for a specific load;
+    ``uses_of(name)`` lists ``(load node, frozenset of def lines)``.
+    Parameters count as definitions at the ``def`` line.
+    """
+
+    bottom = frozenset()
+
+    def __init__(self, fn: ast.AST):
+        super().__init__()
+        self._fn = fn
+        self._uses: List[Tuple[ast.Name, frozenset]] = []
+        state: Dict[str, frozenset] = {}
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)
+                  + [x for x in (args.vararg, args.kwarg) if x]):
+            state[a.arg] = frozenset([fn.lineno])
+        self._pending_line: int = fn.lineno
+        for _ in self.scan(fn.body, state):
+            pass
+
+    def join_value(self, a, b):
+        return a | b
+
+    def kill(self, name, state):
+        state[name] = frozenset([self._pending_line])
+
+    def visit_expr(self, expr, state):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                self._uses.append((node, state.get(node.id, frozenset())))
+        return iter(())
+
+    def scan(self, stmts, state):
+        # one statement at a time so kill() knows which line redefined a name
+        for stmt in stmts:
+            self._pending_line = getattr(stmt, "lineno", self._pending_line)
+            yield from super().scan([stmt], state)
+
+    def uses_of(self, name: str) -> List[Tuple[ast.Name, frozenset]]:
+        return [(n, d) for n, d in self._uses if n.id == name]
+
+    def defs_at(self, name_node: ast.Name) -> frozenset:
+        for n, d in self._uses:
+            if n is name_node:
+                return d
+        return frozenset()

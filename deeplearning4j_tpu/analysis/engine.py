@@ -5,6 +5,13 @@ under analysis), so CI can run it in milliseconds before paying the jax
 import + trace cost of the test suite, and a broken runtime import can never
 take the linter down with it.
 
+Since v2 the engine is whole-program: :func:`analyze_paths` parses every
+file up front into a :class:`~.callgraph.Program` (cross-module call graph,
+jit closure, PRNG/donation summaries) and hands each rule a
+:class:`FileContext` that carries both the per-file view and the program.
+:func:`analyze_source` builds a one-file program, so single-file analysis
+keeps working — it just sees no cross-module edges.
+
 Suppression grammar (pylint-style, per physical line):
 
     x = float(n)              # jaxlint: disable=host-sync
@@ -19,11 +26,12 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import fnmatch
 import json
 import os
 import re
 from dataclasses import dataclass
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 _SUPPRESS_RE = re.compile(
     r"#\s*jaxlint:\s*(disable|disable-next|disable-file)=([A-Za-z0-9_\-, ]+)")
@@ -71,17 +79,27 @@ class Rule:
 
 class FileContext:
     """Everything a rule needs about one file: source, AST, import aliases,
-    jit-context map. Built once per file, shared across rules."""
+    jit-context map — plus the whole program it was analyzed as part of.
+    Built once per file, shared across rules."""
 
-    def __init__(self, path: str, source: str):
-        from .jitgraph import ImportMap, JitContext
+    def __init__(self, path: str, source: str, program=None):
+        from .callgraph import Program
+        from .jitgraph import JitContext
 
+        if program is None:
+            program = Program([(path, source)])
+        err = program.parse_errors.get(path)
+        if err is not None:
+            raise err
+        mi = program.module_for(path)
         self.path = path
         self.source = source
         self.lines = source.splitlines()
-        self.tree = ast.parse(source, filename=path)
-        self.imports = ImportMap(self.tree)
-        self.jit = JitContext(self.tree, path, self.imports)
+        self.program = program
+        self.module_info = mi
+        self.tree = mi.tree
+        self.imports = mi.imports
+        self.jit = JitContext(program, mi)
 
     def resolve(self, node: ast.AST) -> Optional[str]:
         """Canonical dotted path of a Name/Attribute chain (alias-aware),
@@ -118,14 +136,10 @@ def _suppressed(f: Finding, per_line: Dict[int, Set[str]], per_file: Set[str]) -
     return bool(rules) and ("all" in rules or f.rule in rules)
 
 
-def analyze_source(source: str, path: str = "<string>",
-                   rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
-    """Run ``rules`` (default: all registered) over one source string."""
-    if rules is None:
-        from .rules import ALL_RULES
-        rules = ALL_RULES
+def _check_file(path: str, source: str, program,
+                rules: Sequence[Rule]) -> List[Finding]:
     try:
-        ctx = FileContext(path, source)
+        ctx = FileContext(path, source, program)
     except SyntaxError as e:
         return [Finding("parse-error", path, e.lineno or 0, e.offset or 0,
                         f"could not parse: {e.msg}")]
@@ -139,24 +153,71 @@ def analyze_source(source: str, path: str = "<string>",
     return out
 
 
-def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run ``rules`` (default: all registered) over one source string. The
+    file is analyzed as a one-module program: cross-module rules degrade to
+    their same-module behavior."""
+    if rules is None:
+        from .rules import ALL_RULES
+        rules = ALL_RULES
+    return _check_file(path, source, None, rules)
+
+
+def _excluded(path: str, patterns: Sequence[str]) -> bool:
+    norm = os.path.normpath(path).replace(os.sep, "/")
+    parts = norm.split("/")
+    for pat in patterns:
+        if fnmatch.fnmatch(norm, pat) or \
+                any(fnmatch.fnmatch(p, pat) for p in parts):
+            return True
+    return False
+
+
+def iter_py_files(paths: Iterable[str],
+                  exclude: Sequence[str] = ()) -> Iterator[str]:
+    """Walk ``paths`` deterministically (sorted dirs and files, input order
+    preserved) yielding ``.py`` files. ``exclude`` globs match against the
+    normalized path or any single path component."""
     for p in paths:
         if os.path.isdir(p):
             for root, dirs, files in os.walk(p):
-                dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in SKIP_DIRS and not _excluded(
+                        os.path.join(root, d), exclude))
                 for fn in sorted(files):
-                    if fn.endswith(".py"):
-                        yield os.path.join(root, fn)
-        elif p.endswith(".py"):
+                    fp = os.path.join(root, fn)
+                    if fn.endswith(".py") and not _excluded(fp, exclude):
+                        yield fp
+        elif p.endswith(".py") and not _excluded(p, exclude):
             yield p
 
 
-def analyze_paths(paths: Iterable[str],
-                  rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
-    out: List[Finding] = []
-    for fp in iter_py_files(paths):
+def read_sources(paths: Iterable[str],
+                 exclude: Sequence[str] = ()) -> List[Tuple[str, str]]:
+    out = []
+    for fp in iter_py_files(paths, exclude):
         with open(fp, "r", encoding="utf-8") as fh:
-            out.extend(analyze_source(fh.read(), fp, rules))
+            out.append((fp, fh.read()))
+    return out
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Optional[Sequence[Rule]] = None,
+                  exclude: Sequence[str] = ()) -> List[Finding]:
+    """Whole-program analysis over every ``.py`` file under ``paths``."""
+    if rules is None:
+        from .rules import ALL_RULES
+        rules = ALL_RULES
+    from .callgraph import Program
+
+    sources = read_sources(paths, exclude)
+    program = Program(sources)
+    out: List[Finding] = []
+    for fp, src in sources:
+        out.extend(_check_file(fp, src, program, rules))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return out
 
 

@@ -1,163 +1,61 @@
-"""Import-alias resolution and jit-context detection for jaxlint.
+"""Jit-context detection for jaxlint — whole-program since v2.
 
 Jit context — "code that runs under a trace" — is where host-device sync
 and Python side effects actually hurt, so the host-sync / side-effect rules
-only fire there. A function is considered jit-context when it is:
+only fire there. A function is jit context when it is:
 
-1. decorated with ``@jax.jit`` / ``@pjit`` / ``@partial(jax.jit, ...)``,
-2. wrapped somewhere in the same module: ``jax.jit(fn)`` or
+1. decorated with ``@jax.jit`` / ``@pjit`` / ``@partial(jax.jit, ...)`` or a
+   trace-only wrapper (``jax.shard_map``, ``jax.pmap``),
+2. wrapped anywhere in its module: ``jax.jit(fn)``, ``jax.shard_map(fn)``,
    ``functools.partial(jax.jit, ...)(fn)``,
 3. lexically nested inside a jit-context function (closures traced with it),
-4. reachable from a jit-context function through same-module calls by bare
-   name (one-module approximation of the call graph), or
+4. reachable from a jit-context function through **resolvable call edges
+   across all analyzed modules** — bare names, ``self.method()``, and
+   aliased imports, including relative imports and ``__init__`` re-exports
+   (the whole-program call graph in :mod:`.callgraph`), or
 5. defined in a *kernel module* — any file under an ``ops/`` directory: op
    kernels exist to be called from jitted steps, so the whole module is
    treated as traced code.
 
-This is deliberately an approximation: cross-module reachability is not
-modelled. It is tuned so that everything it flags in this repo is a real
-hazard, and false negatives are accepted over false-positive noise.
+v1 stopped at module boundaries (same-module bare-name reachability only).
+The remaining approximations: calls through instance attributes other than
+``self`` (``model.score(...)``) and values returned from factories are not
+resolved — false negatives are still preferred over false-positive noise.
+
+The import-alias machinery and jit-expression helpers live in
+:mod:`.callgraph`; they are re-exported here for compatibility.
 """
 
 from __future__ import annotations
 
 import ast
-import os
-from typing import Dict, List, Optional, Set, Tuple
+from typing import List, Optional, Set, Tuple
 
-# module roots whose canonical names we track through aliases
-_CANON_MODULES = {
-    "numpy": "numpy",
-    "jax": "jax",
-    "jax.numpy": "jax.numpy",
-    "jax.random": "jax.random",
-    "random": "random",
-    "datetime": "datetime",
-    "time": "time",
-    "functools": "functools",
-    "jax.experimental.pjit": "jax.experimental.pjit",
-}
+from .callgraph import (JIT_WRAPPERS, TRACE_ONLY_WRAPPERS,  # noqa: F401
+                        ImportMap, ModuleInfo, Program, is_jit_expr,
+                        is_trace_expr, jit_call_kwargs)
 
-JIT_WRAPPERS = {"jax.jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit"}
-
-
-class ImportMap:
-    """Maps local names to canonical dotted paths via the file's imports."""
-
-    def __init__(self, tree: ast.Module):
-        self.aliases: Dict[str, str] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    if a.name in _CANON_MODULES or a.name.split(".")[0] in _CANON_MODULES:
-                        self.aliases[(a.asname or a.name.split(".")[0])] = (
-                            a.name if a.asname else a.name.split(".")[0])
-            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
-                for a in node.names:
-                    full = f"{node.module}.{a.name}"
-                    root = node.module.split(".")[0]
-                    if root in _CANON_MODULES:
-                        self.aliases[a.asname or a.name] = full
-
-    def resolve(self, node: ast.AST) -> Optional[str]:
-        parts: List[str] = []
-        while isinstance(node, ast.Attribute):
-            parts.append(node.attr)
-            node = node.value
-        if not isinstance(node, ast.Name):
-            return None
-        root = self.aliases.get(node.id, node.id)
-        parts.append(root)
-        return ".".join(reversed(parts))
-
-
-def _is_jit_expr(node: ast.AST, resolve) -> bool:
-    """True for expressions evaluating to a jit transform: ``jax.jit``,
-    ``partial(jax.jit, ...)`` — used both in decorator position and as the
-    callee of a wrap call."""
-    q = resolve(node)
-    if q in JIT_WRAPPERS:
-        return True
-    if isinstance(node, ast.Call):
-        fq = resolve(node.func)
-        if fq in JIT_WRAPPERS:
-            return True
-        if fq == "functools.partial" and node.args and resolve(node.args[0]) in JIT_WRAPPERS:
-            return True
-    return False
-
-
-def jit_call_kwargs(node: ast.AST, resolve) -> Optional[List[str]]:
-    """If ``node`` is a jit transform *call* (``jax.jit(...)``,
-    ``partial(jax.jit, ...)``), the keyword names passed to it; else None."""
-    if not isinstance(node, ast.Call):
-        return None
-    fq = resolve(node.func)
-    if fq in JIT_WRAPPERS:
-        return [k.arg for k in node.keywords if k.arg]
-    if fq == "functools.partial" and node.args and resolve(node.args[0]) in JIT_WRAPPERS:
-        return [k.arg for k in node.keywords if k.arg]
-    return None
+# compat alias for pre-v2 imports
+_is_jit_expr = is_jit_expr
 
 
 class JitContext:
-    """Per-file jit-context map. ``in_jit(node)`` answers whether a node sits
-    inside traced code; ``jit_applications`` lists every (function def,
-    jit expr) pair for rules that inspect jit options (donation)."""
+    """Per-file view of the whole-program jit closure. ``in_jit(node)``
+    answers whether a node sits inside traced code; ``jit_applications``
+    lists every (function def node, jit expr) pair for rules that inspect
+    jit options (donation)."""
 
-    def __init__(self, tree: ast.Module, path: str, imports: ImportMap):
-        self.kernel_module = "ops" in os.path.normpath(path).split(os.sep)
-        resolve = imports.resolve
-
-        funcs: Dict[str, ast.AST] = {}          # bare name -> def node
-        parents: Dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(tree):
-            for child in ast.iter_child_nodes(parent):
-                parents[child] = parent
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                funcs.setdefault(node.name, node)
-        self._funcs = funcs
-        self._parents = parents
-
-        # (def node, jit expr node or None): every way a function gets jitted
-        self.jit_applications: List[Tuple[ast.AST, Optional[ast.AST]]] = []
-
-        roots: Set[ast.AST] = set()
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    if _is_jit_expr(dec, resolve):
-                        roots.add(node)
-                        self.jit_applications.append((node, dec))
-            elif isinstance(node, ast.Call) and _is_jit_expr(node.func, resolve):
-                # jax.jit(fn, ...) / partial(jax.jit, ...)(fn)
-                if node.args and isinstance(node.args[0], ast.Name):
-                    fn = funcs.get(node.args[0].id)
-                    if fn is not None:
-                        roots.add(fn)
-                        self.jit_applications.append((fn, node.func if
-                                                      isinstance(node.func, ast.Call) else node))
-
-        # same-module call-graph closure by bare name
-        work = list(roots)
-        reached: Set[ast.AST] = set(roots)
-        while work:
-            fn = work.pop()
-            for node in ast.walk(fn):
-                callee = None
-                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-                    callee = funcs.get(node.func.id)
-                if callee is not None and callee not in reached:
-                    reached.add(callee)
-                    work.append(callee)
-        if self.kernel_module:
-            reached.update(funcs.values())
-        self._jit_funcs = reached
-
+    def __init__(self, program: Program, mi: ModuleInfo):
+        self.program = program
+        self.module = mi
+        self.kernel_module = mi.kernel
+        self.jit_applications: List[Tuple[ast.AST, Optional[ast.AST]]] = [
+            (fi.node, expr) for fi, expr in mi.jit_applications]
+        self._jit_funcs: Set[ast.AST] = program.jit_func_nodes(mi)
         # line intervals of traced code (nested defs are inside by construction)
         self._intervals = sorted(
-            (f.lineno, getattr(f, "end_lineno", f.lineno)) for f in reached)
+            (f.lineno, getattr(f, "end_lineno", f.lineno))
+            for f in self._jit_funcs)
 
     def in_jit(self, node: ast.AST) -> bool:
         line = getattr(node, "lineno", None)
@@ -166,7 +64,4 @@ class JitContext:
         return any(lo <= line <= hi for lo, hi in self._intervals)
 
     def enclosing_function(self, node: ast.AST):
-        cur = self._parents.get(node)
-        while cur is not None and not isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            cur = self._parents.get(cur)
-        return cur
+        return self.module.enclosing_function(node)

@@ -2,13 +2,21 @@
 
 Each rule documents *why the pattern hurts on TPU* in its class docstring;
 ``analysis/README.md`` has the long-form rationale and suppression guidance.
+
+Since v2 the rules see the whole program (:class:`~.callgraph.Program` via
+``ctx.program``): jit context propagates across modules, and the
+interprocedural families (prng-key-escape, donation-alias,
+sharding-consistency, unlocked-shared-state) query call-graph summaries.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Tuple
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
+from .dataflow import (ForwardScan, assign_names, terminates,
+                       walrus_targets)
 from .engine import FileContext, Finding, Rule
 
 ALL_RULES: List[Rule] = []
@@ -133,28 +141,31 @@ def _key_uses(expr: ast.AST, resolve) -> Iterator[Tuple[str, ast.AST]]:
                 yield node.args[0].id, node
 
 
-def _walrus_targets(expr: ast.AST) -> Iterator[str]:
-    for node in ast.walk(expr):
-        if isinstance(node, ast.NamedExpr) and isinstance(node.target, ast.Name):
-            yield node.target.id
+# compat aliases — pre-v2 these lived here; the framework owns them now
+_walrus_targets = walrus_targets
+_terminates = terminates
+_assign_names = assign_names
 
 
-def _terminates(stmts: List[ast.stmt]) -> bool:
-    """Block ends by leaving the enclosing scope — its key counts never flow
-    into the code after the If (``if cond: return draw(key)`` is exclusive
-    with a later ``return draw(key)``)."""
-    return bool(stmts) and isinstance(
-        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+class _KeyReuseScan(ForwardScan):
+    """Per-name consumption counter for local jax.random draws."""
 
+    def __init__(self, rule: "PrngKeyReuseRule", ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
 
-def _assign_names(target: ast.AST) -> Iterator[str]:
-    if isinstance(target, ast.Name):
-        yield target.id
-    elif isinstance(target, (ast.Tuple, ast.List)):
-        for e in target.elts:
-            yield from _assign_names(e)
-    elif isinstance(target, ast.Starred):
-        yield from _assign_names(target.value)
+    def kill(self, name, state):
+        state[name] = 0
+
+    def visit_expr(self, expr, state):
+        for name, call in _key_uses(expr, self.ctx.resolve):
+            state[name] = state.get(name, 0) + 1
+            if state[name] == 2:
+                yield self.rule.finding(
+                    self.ctx, call, f"key '{name}' already consumed by an "
+                    f"earlier jax.random draw; split it first (identical "
+                    f"samples otherwise)")
 
 
 @register
@@ -164,8 +175,9 @@ class PrngKeyReuseRule(Rule):
     Unlike stateful RNGs, jax keys are pure values: passing one key to two
     draws gives two *identical* samples. Every consumption must be preceded
     by a ``jax.random.split`` (or ``fold_in``). The check is a linear
-    per-function approximation: exclusive branches are merged, loop bodies
-    are scanned once.
+    per-function approximation (:class:`~.dataflow.ForwardScan`): exclusive
+    branches are merged, loop bodies are scanned once. Cross-function
+    consumption is the :class:`PrngKeyEscapeRule`'s job.
     """
 
     name = "prng-key-reuse"
@@ -174,82 +186,87 @@ class PrngKeyReuseRule(Rule):
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                yield from self._scan(ctx, node.body, {})
+                yield from _KeyReuseScan(self, ctx).run(node)
 
-    def _expr(self, ctx, expr, counts) -> Iterator[Finding]:
-        if expr is None:
-            return
-        for name, call in _key_uses(expr, ctx.resolve):
-            counts[name] = counts.get(name, 0) + 1
-            if counts[name] == 2:
-                yield self.finding(
-                    ctx, call, f"key '{name}' already consumed by an earlier "
-                    f"jax.random draw; split it first (identical samples "
-                    f"otherwise)")
-        for t in _walrus_targets(expr):
-            counts[t] = 0
 
-    def _branch(self, ctx, stmts, counts) -> Tuple[List[Finding], Dict[str, int]]:
-        c = dict(counts)
-        return list(self._scan(ctx, stmts, c)), c
+class _KeyEscapeScan(ForwardScan):
+    """Key consumption across call boundaries.
 
-    def _scan(self, ctx, stmts, counts) -> Iterator[Finding]:
-        for stmt in stmts:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-                continue  # separate scope, scanned on its own
-            if isinstance(stmt, ast.Assign):
-                yield from self._expr(ctx, stmt.value, counts)
-                for t in stmt.targets:
-                    for n in _assign_names(t):
-                        counts[n] = 0
-            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
-                yield from self._expr(ctx, stmt.value, counts)
-                for n in _assign_names(stmt.target):
-                    counts[n] = 0
-            elif isinstance(stmt, ast.If):
-                yield from self._expr(ctx, stmt.test, counts)
-                f1, c1 = self._branch(ctx, stmt.body, counts)
-                f2, c2 = self._branch(ctx, stmt.orelse, counts)
-                yield from f1
-                yield from f2
-                merged = [c for c, block in ((c1, stmt.body), (c2, stmt.orelse))
-                          if not _terminates(block)]
-                if merged:
-                    for k in set().union(*merged):
-                        counts[k] = max(c.get(k, 0) for c in merged)
-            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
-                yield from self._expr(ctx, stmt.iter, counts)
-                for n in _assign_names(stmt.target):
-                    counts[n] = 0
-                f1, c1 = self._branch(ctx, stmt.body + stmt.orelse, counts)
-                yield from f1
-                for k in c1:
-                    counts[k] = max(counts.get(k, 0), c1[k])
-            elif isinstance(stmt, ast.While):
-                yield from self._expr(ctx, stmt.test, counts)
-                f1, c1 = self._branch(ctx, stmt.body + stmt.orelse, counts)
-                yield from f1
-                for k in c1:
-                    counts[k] = max(counts.get(k, 0), c1[k])
-            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
-                for item in stmt.items:
-                    yield from self._expr(ctx, item.context_expr, counts)
-                    if item.optional_vars is not None:
-                        for n in _assign_names(item.optional_vars):
-                            counts[n] = 0
-                yield from self._scan(ctx, stmt.body, counts)
-            elif isinstance(stmt, ast.Try):
-                yield from self._scan(ctx, stmt.body, counts)
-                for h in stmt.handlers:
-                    fh, ch = self._branch(ctx, h.body, counts)
-                    yield from fh
-                    for k in ch:
-                        counts[k] = max(counts.get(k, 0), ch[k])
-                yield from self._scan(ctx, stmt.orelse + stmt.finalbody, counts)
-            else:
-                for expr in ast.iter_child_nodes(stmt):
-                    if isinstance(expr, ast.expr):
-                        yield from self._expr(ctx, expr, counts)
+    State per name: (count, escape seen, already fired, first consumer
+    description). Local draws weigh 1; a call forwarding the key into an
+    analyzed callee weighs that callee's transitive consumption (0/1/2 from
+    the program's PRNG summaries). A finding fires when the count crosses 2
+    with at least one call-boundary event involved — pure-local reuse is
+    :class:`PrngKeyReuseRule` territory and is not double-reported."""
+
+    bottom = (0, False, False, None)
+
+    def __init__(self, rule: "PrngKeyEscapeRule", ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.program = ctx.program
+        self.mi = ctx.module_info
+
+    def join_value(self, a, b):
+        return (max(a[0], b[0]), a[1] or b[1], a[2] or b[2], a[3] or b[3])
+
+    def visit_expr(self, expr, state):
+        events = []
+        for name, call in _key_uses(expr, self.ctx.resolve):
+            events.append((name, 1, False, call, None))
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                for argname, callee, uses in \
+                        self.program.prng_callee_uses(self.mi, node):
+                    events.append((argname, uses, True, node, callee))
+        events.sort(key=lambda e: (getattr(e[3], "lineno", 0),
+                                   getattr(e[3], "col_offset", 0)))
+        for name, weight, escape, node, callee in events:
+            count, saw, fired, who = state.get(name, self.bottom)
+            newc = count + weight
+            saw = saw or escape
+            if not fired and newc >= 2 and saw:
+                if escape and weight >= 2 and count == 0:
+                    msg = (f"key '{name}' is consumed by multiple jax.random "
+                           f"draws inside callee '{callee.name}' without a "
+                           f"split — identical samples; split the key before "
+                           f"the call or inside '{callee.name}'")
+                else:
+                    how = (f"passing it to '{callee.name}' re-consumes it"
+                           if escape else "this draw re-consumes it")
+                    msg = (f"key '{name}' already consumed by {who}; {how} "
+                           f"without a split — identical random streams "
+                           f"across the call boundary")
+                yield self.rule.finding(self.ctx, node, msg)
+                fired = True
+            if who is None:
+                who = (f"callee '{callee.name}' (line {node.lineno})" if escape
+                       else f"a jax.random draw (line {node.lineno})")
+            state[name] = (newc, saw, fired, who)
+
+
+@register
+class PrngKeyEscapeRule(Rule):
+    """PRNG key reused across a function boundary.
+
+    The per-function reuse rule cannot see that ``b.noise(key)`` consumes the
+    key inside ``b`` — each function looks innocent in isolation, yet the
+    caller's next draw from the same key repeats the callee's stream exactly
+    (correlated noise/dropout that no test of either function alone catches).
+    This rule charges every call site with the callee's *transitive* key
+    consumption from the whole-program PRNG summaries and fires when the
+    combined count reaches 2 with a call boundary involved.
+    """
+
+    name = "prng-key-escape"
+    description = ("PRNG key consumed again after being passed to a callee "
+                   "that draws from it (cross-function key reuse)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from _KeyEscapeScan(self, ctx).run(node)
 
 
 _SIDE_EFFECT_PREFIXES = ("time.", "datetime.", "random.", "numpy.random.")
@@ -328,6 +345,78 @@ class MissingDonateRule(Rule):
                     f"doubling HBM for the state pytree")
 
 
+class _DonationScan(ForwardScan):
+    """Caller-side liveness of donated buffers.
+
+    State per name: the (call node, callee FuncInfo) that donated it. A later
+    ``Name`` load of a still-donated binding is a read of a deleted buffer;
+    rebinding the name (the ``params, opt = step(params, opt, ...)`` idiom)
+    kills the fact.
+    """
+
+    bottom = None
+
+    def __init__(self, rule: "DonationAliasRule", ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.program = ctx.program
+        self.mi = ctx.module_info
+
+    def join_value(self, a, b):
+        return a or b
+
+    def visit_expr(self, expr, state):
+        # reads first: the donating call's own argument expressions are
+        # processed before the call marks them donated
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                rec = state.get(node.id)
+                if rec:
+                    call, callee = rec
+                    yield self.rule.finding(
+                        self.ctx, node, f"'{node.id}' was donated to jitted "
+                        f"'{callee.name}' (line {call.lineno}) and its buffer "
+                        f"is deleted; rebind the result "
+                        f"(`{node.id}, ... = {callee.name}(...)`) or copy "
+                        f"before donating")
+                    state.pop(node.id, None)  # one finding per donation
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.program.donating_callee(self.mi, node)
+            if callee is None:
+                continue
+            donated = callee.donated_params()
+            for pname, arg in self.program.map_call_args(node, callee):
+                if pname in donated and isinstance(arg, ast.Name):
+                    state[arg.id] = (node, callee)
+
+
+@register
+class DonationAliasRule(Rule):
+    """Donated buffer read after the jitted call.
+
+    ``donate_argnums`` tells XLA it may reuse the argument's HBM for the
+    output — after the call the Python binding still *looks* alive but the
+    buffer is deleted; touching it raises "Array has been deleted" at
+    runtime, and only on the donating path (tests that skip donation pass).
+    The donation table is whole-program, so calling another module's donating
+    step and reading the old state is caught too. Only non-traced callers are
+    scanned: inside a trace XLA ignores nested donation.
+    """
+
+    name = "donation-alias"
+    description = ("argument read after being donated to a jitted call "
+                   "(deleted buffer)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        jit_nodes = ctx.program.jit_func_nodes(ctx.module_info)
+        for fi in ctx.module_info.all_funcs:
+            if fi.node not in jit_nodes:
+                yield from _DonationScan(self, ctx).run(fi.node)
+
+
 @register
 class Float64DtypeRule(Rule):
     """float64/int64 in op kernels.
@@ -376,11 +465,15 @@ class BroadExceptRule(Rule):
     leaked tracer, XlaRuntimeError from a bad donation — are generic
     ``Exception`` subclasses; a catch-all that logs-and-continues converts
     them into silent wrong results. Handlers that re-raise (bare ``raise`` or
-    ``raise X from e``) preserve the failure and are allowed.
+    ``raise X from e``) preserve the failure and are allowed. A tuple
+    containing ``Exception`` is as broad as ``Exception`` alone, and
+    ``contextlib.suppress(Exception)`` is the same catch-all in context-
+    manager clothing.
     """
 
     name = "broad-except"
-    description = "except Exception/BaseException (or bare except) that swallows"
+    description = ("except Exception/BaseException (bare, in a tuple, or via "
+                   "contextlib.suppress) that swallows")
 
     def _is_broad(self, ctx, t) -> bool:
         if t is None:
@@ -391,15 +484,300 @@ class BroadExceptRule(Rule):
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            if not self._is_broad(ctx, node.type):
-                continue
-            reraises = any(
-                isinstance(n, ast.Raise) and (n.exc is None or n.cause is not None)
-                for n in ast.walk(node))
-            if not reraises:
+            if isinstance(node, ast.ExceptHandler):
+                if not self._is_broad(ctx, node.type):
+                    continue
+                reraises = any(
+                    isinstance(n, ast.Raise) and (n.exc is None or n.cause is not None)
+                    for n in ast.walk(node))
+                if not reraises:
+                    yield self.finding(
+                        ctx, node, "broad except swallows tracer/runtime "
+                        "errors; narrow the type, re-raise with `from e`, or "
+                        "suppress with a justification if the loop must "
+                        "survive")
+            elif isinstance(node, ast.Call) \
+                    and ctx.resolve(node.func) == "contextlib.suppress" \
+                    and any(ctx.resolve(a) in ("Exception", "BaseException")
+                            for a in node.args):
                 yield self.finding(
-                    ctx, node, "broad except swallows tracer/runtime errors; "
-                    "narrow the type, re-raise with `from e`, or suppress "
-                    "with a justification if the loop must survive")
+                    ctx, node, "contextlib.suppress(Exception) is a broad "
+                    "except in disguise — it silently drops tracer/runtime "
+                    "errors; narrow the exception type")
+
+
+_AXES_CACHE = "sharding-consistency:axes"
+_SPEC_CTORS = {"jax.sharding.PartitionSpec"}
+_MESH_CTORS = {"jax.sharding.Mesh", "jax.make_mesh",
+               "jax.experimental.mesh_utils.create_device_mesh"}
+_MAX_SPEC_RANK = 5
+
+
+def _declared_axes(program) -> Set[str]:
+    """Mesh axis names declared anywhere in the program: module-level
+    ``*_AXIS = "..."`` constants plus string literals in the axis-names
+    argument of ``jax.sharding.Mesh`` constructor calls."""
+    axes = program.cache.get(_AXES_CACHE)
+    if axes is not None:
+        return axes
+    axes = set()
+    for mi in program.modules.values():
+        for name, val in mi.str_consts.items():
+            if name.endswith("_AXIS"):
+                axes.add(val)
+        resolve = mi.imports.resolve
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Call) and len(node.args) >= 2 \
+                    and resolve(node.func) in _MESH_CTORS:
+                for sub in ast.walk(node.args[1]):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str):
+                        axes.add(sub.value)
+    program.cache[_AXES_CACHE] = axes
+    return axes
+
+
+@register
+class ShardingConsistencyRule(Rule):
+    """PartitionSpec axes that no mesh declares.
+
+    GSPMD resolves ``PartitionSpec`` axis names against the mesh at dispatch
+    time: a typo'd axis (``"modle"``) or one the mesh never declares fails
+    only when the jitted function first runs on the real topology — often
+    multi-host, where the stack trace points at XLA internals, not the spec.
+    Mentioning the same axis twice in one spec is an XLA hard error
+    (a dimension cannot be sharded over one axis twice), and a spec with more
+    entries than any array rank used here signals a drifted refactor. Checked
+    against the program-wide set of declared axes (``*_AXIS`` constants and
+    ``Mesh(...)`` axis-name literals) in ``parallel/`` and ``nn/`` modules.
+    """
+
+    name = "sharding-consistency"
+    description = ("PartitionSpec axis unknown to any declared mesh, "
+                   "duplicated in one spec, or of implausible rank")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parts = set(os.path.normpath(ctx.path).split(os.sep))
+        if not parts & {"parallel", "nn"}:
+            return
+        axes = _declared_axes(ctx.program)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) \
+                    or ctx.resolve(node.func) not in _SPEC_CTORS:
+                continue
+            if len(node.args) > _MAX_SPEC_RANK:
+                yield self.finding(
+                    ctx, node, f"PartitionSpec with {len(node.args)} entries "
+                    f"— no array in this codebase has rank > "
+                    f"{_MAX_SPEC_RANK}; stale spec?")
+            seen: Dict[str, ast.AST] = {}
+            for arg in node.args:
+                elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) \
+                    else [arg]
+                for e in elts:
+                    if isinstance(e, ast.Starred):
+                        continue
+                    if isinstance(e, ast.Constant):
+                        if not isinstance(e.value, str):
+                            continue
+                        val: Optional[str] = e.value
+                        if axes and val not in axes:
+                            yield self.finding(
+                                ctx, e, f"PartitionSpec axis '{val}' is not "
+                                f"declared by any mesh in the program "
+                                f"(known: {', '.join(sorted(axes))}) — "
+                                f"fails at dispatch on the real topology")
+                    else:
+                        # Name/Attribute resolving to a module-level string
+                        # constant (DATA_AXIS etc.); opaque values are skipped
+                        val = ctx.program.resolve_const_str(
+                            ctx.module_info, e)
+                        if val is None:
+                            continue
+                    if val in seen:
+                        yield self.finding(
+                            ctx, e, f"axis '{val}' appears twice in one "
+                            f"PartitionSpec — XLA rejects double sharding "
+                            f"over the same mesh axis")
+                    else:
+                        seen[val] = e
+
+
+_HANDLER_METHODS = {"do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD",
+                    "do_PATCH"}
+_MUTATORS = {"append", "add", "update", "extend", "insert", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "appendleft",
+             "extendleft"}
+_MUTABLE_CTORS = {"dict", "list", "set", "collections.defaultdict",
+                  "collections.deque", "collections.OrderedDict",
+                  "collections.Counter"}
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "threading.Semaphore", "threading.BoundedSemaphore"}
+_LOCK_TOKENS = ("lock", "mutex", "cond", "cv")
+_REACH_CACHE = "unlocked-shared-state:reachable"
+
+
+def _is_mutable_ctor(v: ast.AST, resolve) -> bool:
+    if isinstance(v, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(v, ast.Call):
+        return resolve(v.func) in _MUTABLE_CTORS
+    return False
+
+
+def _thread_reachable(program) -> Set:
+    """FuncInfos reachable from a concurrency entry point: an httpd
+    ``do_*`` handler method or a ``threading.Thread(target=...)``. Cached
+    program-wide."""
+    reach = program.cache.get(_REACH_CACHE)
+    if reach is not None:
+        return reach
+    entries = set()
+    for mi in program.modules.values():
+        resolve = mi.imports.resolve
+        for fi in mi.all_funcs:
+            if fi.cls and fi.name in _HANDLER_METHODS:
+                entries.add(fi)
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Call) \
+                    and resolve(node.func) == "threading.Thread":
+                for k in node.keywords:
+                    if k.arg != "target":
+                        continue
+                    fi = program.resolve_call(mi, k.value,
+                                              mi.enclosing_class(node))
+                    if fi is not None:
+                        entries.add(fi)
+    reach = set(entries)
+    work = list(entries)
+    while work:
+        fi = work.pop()
+        mi = fi.module
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = program.resolve_call(mi, node.func,
+                                          mi.enclosing_class(node))
+            if callee is not None and callee not in reach:
+                reach.add(callee)
+                work.append(callee)
+    program.cache[_REACH_CACHE] = reach
+    return reach
+
+
+@register
+class UnlockedSharedStateRule(Rule):
+    """Shared mutable state written from concurrent code without a lock.
+
+    The metrics/trace/KNN servers run request handlers and ``Thread``
+    targets concurrently with the training loop. CPython's GIL makes single
+    bytecodes atomic but not read-modify-write sequences —
+    ``events.append(...)`` racing ``events.clear()`` in a flush drops
+    telemetry, and dict resize during iteration raises. Any mutation of a
+    module-level container or a ``self.`` container (bound in ``__init__``)
+    from code reachable from a handler/Thread entry must hold a lock — a
+    ``with`` whose context is lock-named, a ``threading.Lock``-typed
+    attribute, or a module-level lock. Reachability is whole-program, so a
+    helper in another module called from a handler is still checked.
+    """
+
+    name = "unlocked-shared-state"
+    description = ("module-level or self. mutable container mutated from "
+                   "Thread/handler-reachable code without a held lock")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        mi = ctx.module_info
+        resolve = mi.imports.resolve
+        reach = _thread_reachable(ctx.program)
+        if not any(fi in reach for fi in mi.all_funcs):
+            return
+
+        module_shared: Set[str] = set()
+        module_locks: Set[str] = set()
+        for stmt in mi.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                if _is_mutable_ctor(stmt.value, resolve):
+                    module_shared.add(stmt.targets[0].id)
+                elif isinstance(stmt.value, ast.Call) \
+                        and resolve(stmt.value.func) in _LOCK_CTORS:
+                    module_locks.add(stmt.targets[0].id)
+
+        class_shared: Set[Tuple[str, str]] = set()
+        class_locks: Set[Tuple[str, str]] = set()
+        for fi in mi.all_funcs:
+            if fi.name != "__init__" or not fi.cls:
+                continue
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                    continue
+                t = node.targets[0]
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                if _is_mutable_ctor(node.value, resolve):
+                    class_shared.add((fi.cls, t.attr))
+                elif isinstance(node.value, ast.Call) \
+                        and resolve(node.value.func) in _LOCK_CTORS:
+                    class_locks.add((fi.cls, t.attr))
+
+        def shared_base(b: ast.AST, fi) -> Optional[str]:
+            if isinstance(b, ast.Name) and b.id in module_shared \
+                    and b.id not in fi.params:
+                return b.id
+            if isinstance(b, ast.Attribute) and isinstance(b.value, ast.Name) \
+                    and b.value.id == "self" and fi.cls \
+                    and (fi.cls, b.attr) in class_shared:
+                return f"self.{b.attr}"
+            return None
+
+        def lockish(e: ast.AST, cls: Optional[str]) -> bool:
+            seg = e.attr if isinstance(e, ast.Attribute) else (
+                e.id if isinstance(e, ast.Name) else None)
+            if seg and any(tok in seg.lower() for tok in _LOCK_TOKENS):
+                return True
+            if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+                    and e.value.id == "self" and cls \
+                    and (cls, e.attr) in class_locks:
+                return True
+            return isinstance(e, ast.Name) and e.id in module_locks
+
+        def lock_held(node: ast.AST, cls: Optional[str]) -> bool:
+            cur = mi.parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(cur, (ast.With, ast.AsyncWith)) \
+                        and any(lockish(item.context_expr, cls)
+                                for item in cur.items):
+                    return True
+                cur = mi.parents.get(cur)
+            return False
+
+        for fi in mi.all_funcs:
+            if fi not in reach:
+                continue
+            for node in ast.walk(fi.node):
+                hits: List[Tuple[str, ast.AST]] = []
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    tgts = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in tgts:
+                        if isinstance(t, ast.Subscript):
+                            name = shared_base(t.value, fi)
+                            if name:
+                                hits.append((name, t))
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATORS:
+                    name = shared_base(node.func.value, fi)
+                    if name:
+                        hits.append((name, node))
+                for name, loc in hits:
+                    if not lock_held(loc, fi.cls):
+                        yield self.finding(
+                            ctx, loc, f"shared container '{name}' is mutated "
+                            f"from Thread/handler-reachable code "
+                            f"('{fi.qual}') without a held lock — concurrent "
+                            f"request/flush access races; wrap in "
+                            f"`with <lock>:`")

@@ -1,0 +1,129 @@
+"""Machine-readable CI output for jaxlint: SARIF 2.1.0 + finding baselines.
+
+SARIF (Static Analysis Results Interchange Format) is what GitHub code
+scanning ingests — emitting it makes jaxlint findings appear as inline PR
+annotations with zero glue code. The baseline mechanism lets a *stricter*
+rule land before the tree is fully clean: record today's findings once,
+then fail CI only on findings that are not in the recorded set, so new
+regressions are caught while the documented backlog burns down.
+
+Baseline fingerprints are deliberately line-number-free —
+``sha1(rule | normalized path | message)`` plus an occurrence index for
+duplicates — so unrelated edits that shift code downward do not invalidate
+the baseline, while a genuinely new instance of a known finding kind in the
+same file still counts as new once it outnumbers the recorded occurrences.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Sequence, Set
+
+from .engine import Finding
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+SARIF_VERSION = "2.1.0"
+BASELINE_VERSION = 1
+
+
+def _uri(path: str) -> str:
+    return os.path.normpath(path).replace(os.sep, "/")
+
+
+def to_sarif(findings: Sequence[Finding]) -> dict:
+    """One-run SARIF document in the GitHub code-scanning dialect."""
+    from .rules import ALL_RULES
+
+    known = {r.name: r for r in ALL_RULES}
+    used = sorted({f.rule for f in findings})
+    rules = []
+    for name in used:
+        r = known.get(name)
+        desc = r.description if r is not None else name
+        rules.append({
+            "id": name,
+            "shortDescription": {"text": desc},
+            "helpUri": ("https://github.com/deeplearning4j-tpu/"
+                        "deeplearning4j-tpu/blob/main/deeplearning4j_tpu/"
+                        "analysis/README.md"),
+        })
+    index = {name: i for i, name in enumerate(used)}
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _uri(f.path),
+                                         "uriBaseId": "%SRCROOT%"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jaxlint",
+                "informationUri": ("https://github.com/deeplearning4j-tpu/"
+                                   "deeplearning4j-tpu"),
+                "rules": rules,
+            }},
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+        }],
+    }
+
+
+def render_sarif(findings: Sequence[Finding]) -> str:
+    return json.dumps(to_sarif(findings), indent=2, sort_keys=True)
+
+
+# -- baselines --------------------------------------------------------------
+
+def fingerprints(findings: Sequence[Finding]) -> List[str]:
+    """Stable per-finding fingerprints, order-aligned with ``findings``.
+    Identical (rule, path, message) triples get an occurrence suffix so a
+    *second* instance of a baselined finding still reads as new."""
+    counts: Dict[str, int] = {}
+    out = []
+    for f in findings:
+        h = hashlib.sha1(
+            f"{f.rule}|{_uri(f.path)}|{f.message}".encode()).hexdigest()[:16]
+        n = counts.get(h, 0)
+        counts[h] = n + 1
+        out.append(f"{h}:{n}")
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    doc = {"version": BASELINE_VERSION,
+           "count": len(findings),
+           "fingerprints": sorted(fingerprints(findings))}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{doc.get('version')!r}")
+    return set(doc.get("fingerprints", ()))
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Set[str]) -> List[Finding]:
+    """Findings whose fingerprint is not in the recorded baseline."""
+    return [f for f, fp in zip(findings, fingerprints(findings))
+            if fp not in baseline]

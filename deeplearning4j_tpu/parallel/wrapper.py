@@ -280,7 +280,7 @@ class ParallelWrapper:
                                                 for t in (params, opt_state, net_state))
                 x, y = x[0], y[0]
                 fm = masks[0][0] if with_fm else None
-                lm = masks[int(with_fm)][0] if with_lm else None
+                lm = masks[1 if with_fm else 0][0] if with_lm else None
                 mask_kw = ({"mask": fm, "label_mask": lm}
                            if isinstance(model, Sequential)
                            else {"masks": fm, "label_masks": lm})
@@ -394,7 +394,7 @@ class ParallelWrapper:
                                                 for t in (params, opt_state, net_state))
                 residual, x, y = residual[0], x[0], y[0]
                 fm = masks[0][0] if with_fm else None
-                lm = masks[int(with_fm)][0] if with_lm else None
+                lm = masks[1 if with_fm else 0][0] if with_lm else None
                 mask_kw = ({"mask": fm, "label_mask": lm}
                            if isinstance(model, Sequential)
                            else {"masks": fm, "label_masks": lm})

@@ -5,18 +5,26 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== jaxlint: deeplearning4j_tpu/ ==="
-python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/
+CI_ARTIFACTS_DIR="${CI_ARTIFACTS_DIR:-ci-artifacts}"
+mkdir -p "$CI_ARTIFACTS_DIR"
 
-# obs/ must stay jaxlint-clean by construction (no suppressions needed):
-# telemetry that trips host-sync/jit-side-effect would poison the very hot
-# paths it measures. The tree-wide run above covers it; this explicit pass
-# keeps the guarantee visible even if the tree run's path set changes.
-echo "=== jaxlint: deeplearning4j_tpu/obs/ ==="
+echo "=== jaxlint: deeplearning4j_tpu/ (whole-program, SARIF) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/ \
+  --sarif "$CI_ARTIFACTS_DIR/jaxlint.sarif"
+
+# obs/ and analysis/ must stay jaxlint-clean by construction — no
+# suppressions, no baseline entries permitted: telemetry that trips
+# host-sync/jit-side-effect would poison the very hot paths it measures,
+# and the linter linting itself dirty would be absurd. The tree-wide run
+# above covers both; these explicit passes keep the guarantee visible even
+# if the tree run's path set changes.
+echo "=== jaxlint: deeplearning4j_tpu/obs/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/obs/
+echo "=== jaxlint: deeplearning4j_tpu/analysis/ (no baseline permitted) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/analysis/
 
 echo "=== smoke trace: 5-step instrumented train ==="
-CI_ARTIFACTS_DIR="${CI_ARTIFACTS_DIR:-ci-artifacts}" python scripts/smoke_trace.py
+CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_trace.py
 
 echo "=== tier-1 tests ==="
 set -o pipefail

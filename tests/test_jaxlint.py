@@ -5,6 +5,7 @@ Pure-AST tests: nothing here touches jax at runtime, so the suite is
 milliseconds and platform-independent.
 """
 
+import ast
 import json
 import subprocess
 import sys
@@ -12,14 +13,31 @@ import textwrap
 
 import pytest
 
-from deeplearning4j_tpu.analysis import (ALL_RULES, analyze_paths,
-                                         analyze_source, render_json,
-                                         rules_by_name)
+from deeplearning4j_tpu.analysis import (ALL_RULES, Finding, analyze_paths,
+                                         analyze_source, build_program,
+                                         fingerprints, load_baseline,
+                                         new_findings, render_json,
+                                         rules_by_name, to_sarif,
+                                         write_baseline)
+from deeplearning4j_tpu.analysis.__main__ import main as cli_main
+from deeplearning4j_tpu.analysis.dataflow import ReachingDefs
+from deeplearning4j_tpu.analysis.engine import _check_file
 
 
 def lint(src, rule=None, path="pkg/mod.py"):
     rules = [rules_by_name()[rule]] if rule else None
     return analyze_source(textwrap.dedent(src), path, rules)
+
+
+def lint_program(files, rule=None):
+    """Analyze {path: source} as ONE whole program (the v2 model)."""
+    rules = [rules_by_name()[rule]] if rule else ALL_RULES
+    srcs = [(p, textwrap.dedent(s)) for p, s in files.items()]
+    program = build_program(srcs)
+    out = []
+    for p, s in srcs:
+        out.extend(_check_file(p, s, program, rules))
+    return out
 
 
 def names(findings):
@@ -430,3 +448,544 @@ class TestCliAndTree:
         pkg = os.path.join(os.path.dirname(__file__), "..", "deeplearning4j_tpu")
         fs = analyze_paths([pkg])
         assert fs == [], "\n".join(f.render() for f in fs)
+
+
+# ===================================================== whole-program (v2)
+class TestCrossModuleJit:
+    HELPER = """
+        def helper(x):
+            return float(x)
+        """
+    CALLER = """
+        import jax
+        from pkg import a
+
+        @jax.jit
+        def step(x):
+            return a.helper(x)
+        """
+
+    def test_cross_module_jit_propagation(self):
+        # the helper lives in a module with no jit anywhere — only the
+        # cross-module call edge from b.step makes it jit context
+        fs = lint_program({"pkg/a.py": self.HELPER, "pkg/b.py": self.CALLER},
+                          "host-sync")
+        assert [(f.rule, f.path) for f in fs] == [("host-sync", "pkg/a.py")]
+
+    def test_v1_single_module_cannot_produce_it(self):
+        # regression guard: analyzed alone (the v1 model), the helper module
+        # is clean — the finding above is strictly interprocedural
+        assert lint(self.HELPER, "host-sync", path="pkg/a.py") == []
+
+    def test_relative_import_edge(self):
+        caller = """
+            import jax
+            from .a import helper
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+            """
+        fs = lint_program({"pkg/a.py": self.HELPER, "pkg/b.py": caller},
+                          "host-sync")
+        assert names(fs) == ["host-sync"]
+
+    def test_init_reexport_edge(self):
+        # from pkg import helper, re-exported by pkg/__init__.py
+        init = "from .a import helper\n"
+        caller = """
+            import jax
+            import pkg
+
+            @jax.jit
+            def step(x):
+                return pkg.helper(x)
+            """
+        fs = lint_program({"pkg/__init__.py": init, "pkg/a.py": self.HELPER,
+                           "other/b.py": caller}, "host-sync")
+        assert [(f.rule, f.path) for f in fs] == [("host-sync", "pkg/a.py")]
+
+    def test_uncalled_helper_stays_clean(self):
+        caller = """
+            import jax
+            from pkg import a
+
+            @jax.jit
+            def step(x):
+                return x
+            """
+        fs = lint_program({"pkg/a.py": self.HELPER, "pkg/b.py": caller},
+                          "host-sync")
+        assert fs == []
+
+
+# --------------------------------------------------------- prng-key-escape
+class TestPrngKeyEscape:
+    NOISE = """
+        import jax
+
+        def noise(key, shape):
+            return jax.random.normal(key, shape)
+        """
+
+    def test_callee_then_local_draw_flagged(self):
+        # each function alone is innocent; together the key is consumed twice
+        use = """
+            import jax
+            from pkg import noisemod
+
+            def f(key):
+                n = noisemod.noise(key, (3,))
+                return n + jax.random.uniform(key, (3,))
+            """
+        fs = lint_program({"pkg/noisemod.py": self.NOISE, "pkg/use.py": use},
+                          "prng-key-escape")
+        assert [(f.rule, f.path) for f in fs] == [
+            ("prng-key-escape", "pkg/use.py")]
+
+    def test_split_before_sharing_not_flagged(self):
+        use = """
+            import jax
+            from pkg import noisemod
+
+            def f(key):
+                k1, k2 = jax.random.split(key)
+                n = noisemod.noise(k1, (3,))
+                return n + jax.random.uniform(k2, (3,))
+            """
+        fs = lint_program({"pkg/noisemod.py": self.NOISE, "pkg/use.py": use},
+                          "prng-key-escape")
+        assert fs == []
+
+    def test_pure_local_reuse_is_not_double_reported(self):
+        # same-function double draw belongs to prng-key-reuse only
+        src = """
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (2,))
+                return a + jax.random.uniform(key, (2,))
+            """
+        assert lint(src, "prng-key-escape") == []
+        assert names(lint(src, "prng-key-reuse")) == ["prng-key-reuse"]
+
+    def test_callee_that_draws_twice_flagged_at_call_site(self):
+        double = """
+            import jax
+
+            def double(key):
+                a = jax.random.normal(key, (2,))
+                return a + jax.random.uniform(key, (2,))
+            """
+        use = """
+            from pkg import m
+
+            def g(key):
+                return m.double(key)
+            """
+        fs = lint_program({"pkg/m.py": double, "pkg/use.py": use},
+                          "prng-key-escape")
+        assert [(f.rule, f.path) for f in fs] == [
+            ("prng-key-escape", "pkg/use.py")]
+
+    def test_exclusive_branch_callee_not_flagged(self):
+        # initializer dispatch: callee draws once on every path
+        init = """
+            import jax
+
+            def init(key, dist):
+                if dist == "normal":
+                    return jax.random.normal(key, (2,))
+                return jax.random.uniform(key, (2,))
+            """
+        use = """
+            from pkg import initmod
+
+            def g(key, dist):
+                return initmod.init(key, dist)
+            """
+        fs = lint_program({"pkg/initmod.py": init, "pkg/use.py": use},
+                          "prng-key-escape")
+        assert fs == []
+
+
+# ---------------------------------------------------------- donation-alias
+class TestDonationAlias:
+    def test_read_after_donation_flagged(self):
+        src = """
+            import jax
+
+            def _step(params, x):
+                return params * x
+
+            step = jax.jit(_step, donate_argnums=(0,))
+
+            def train(params, xs):
+                out = step(params, xs)
+                return params + out
+            """
+        fs = lint(src, "donation-alias")
+        assert names(fs) == ["donation-alias"]
+
+    def test_rebinding_idiom_not_flagged(self):
+        src = """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0, 1))
+            def train_step(params, opt, batch):
+                return params, opt
+
+            def fit(params, opt, batches):
+                for b in batches:
+                    params, opt = train_step(params, opt, b)
+                return params, opt
+            """
+        assert lint(src, "donation-alias") == []
+
+    def test_self_attribute_jit_wrap(self):
+        src = """
+            import jax
+
+            class Averager:
+                def __init__(self):
+                    def avg(p):
+                        return p
+                    self._avg = jax.jit(avg, donate_argnums=(0,))
+
+                def run(self, params):
+                    out = self._avg(params)
+                    return params
+            """
+        fs = lint(src, "donation-alias")
+        assert names(fs) == ["donation-alias"]
+
+    def test_cross_module_donating_callee(self):
+        stepmod = """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def update(params, grads):
+                return params
+            """
+        caller = """
+            from pkg import stepmod
+
+            def fit(params, grads):
+                new = stepmod.update(params, grads)
+                return params
+            """
+        fs = lint_program({"pkg/stepmod.py": stepmod, "pkg/fit.py": caller},
+                          "donation-alias")
+        assert [(f.rule, f.path) for f in fs] == [
+            ("donation-alias", "pkg/fit.py")]
+
+
+# ----------------------------------------------------- sharding-consistency
+class TestShardingConsistency:
+    def test_unknown_axis_flagged(self):
+        src = """
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            mesh = Mesh(np.arange(4), ("data", "model"))
+            SPEC = P("data", "modle")
+            """
+        fs = lint(src, "sharding-consistency", path="pkg/parallel/s.py")
+        assert names(fs) == ["sharding-consistency"]
+        assert "modle" in fs[0].message
+
+    def test_duplicate_axis_flagged(self):
+        src = """
+            from jax.sharding import PartitionSpec as P
+
+            DATA_AXIS = "data"
+            SPEC = P("data", "data")
+            """
+        fs = lint(src, "sharding-consistency", path="pkg/parallel/s.py")
+        assert names(fs) == ["sharding-consistency"]
+        assert "twice" in fs[0].message
+
+    def test_axis_constants_resolved_across_modules(self):
+        meshmod = """
+            MODEL_AXIS = "model"
+            DATA_AXIS = "data"
+            """
+        spec = """
+            from jax.sharding import PartitionSpec as P
+            from pkg.parallel import meshmod
+
+            GOOD = P(None, meshmod.MODEL_AXIS)
+            DUP = P(meshmod.MODEL_AXIS, meshmod.MODEL_AXIS)
+            """
+        fs = lint_program({"pkg/parallel/meshmod.py": meshmod,
+                           "pkg/parallel/spec.py": spec},
+                          "sharding-consistency")
+        assert names(fs) == ["sharding-consistency"]
+        assert "twice" in fs[0].message
+
+    def test_rank_sanity(self):
+        src = """
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P(None, None, None, None, None, None)
+            """
+        fs = lint(src, "sharding-consistency", path="pkg/parallel/s.py")
+        assert names(fs) == ["sharding-consistency"]
+        assert "rank" in fs[0].message
+
+    def test_outside_parallel_and_nn_not_checked(self):
+        src = """
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("data", "data")
+            """
+        assert lint(src, "sharding-consistency", path="pkg/data/io.py") == []
+
+
+# --------------------------------------------------- unlocked-shared-state
+class TestUnlockedSharedState:
+    def test_thread_target_mutation_flagged(self):
+        src = """
+            import threading
+
+            EVENTS = []
+
+            def worker():
+                EVENTS.append(1)
+
+            t = threading.Thread(target=worker)
+            """
+        fs = lint(src, "unlocked-shared-state")
+        assert names(fs) == ["unlocked-shared-state"]
+
+    def test_handler_method_self_container_flagged(self):
+        src = """
+            class Handler:
+                def __init__(self):
+                    self.events = []
+
+                def do_GET(self):
+                    self.events.append(1)
+            """
+        fs = lint(src, "unlocked-shared-state")
+        assert names(fs) == ["unlocked-shared-state"]
+
+    def test_lock_held_not_flagged(self):
+        src = """
+            import threading
+
+            class Handler:
+                def __init__(self):
+                    self.events = []
+                    self._lock = threading.Lock()
+
+                def do_GET(self):
+                    with self._lock:
+                        self.events.append(1)
+            """
+        assert lint(src, "unlocked-shared-state") == []
+
+    def test_unreachable_function_not_flagged(self):
+        src = """
+            EVENTS = []
+
+            def helper():
+                EVENTS.append(1)
+            """
+        assert lint(src, "unlocked-shared-state") == []
+
+    def test_cross_module_reachability(self):
+        shared = """
+            STATS = {}
+
+            def bump(k):
+                STATS[k] = STATS.get(k, 0) + 1
+            """
+        server = """
+            import threading
+            from pkg import shared
+
+            def serve():
+                shared.bump("req")
+
+            t = threading.Thread(target=serve)
+            """
+        fs = lint_program({"pkg/shared.py": shared, "pkg/server.py": server},
+                          "unlocked-shared-state")
+        assert [(f.rule, f.path) for f in fs] == [
+            ("unlocked-shared-state", "pkg/shared.py")]
+
+
+# -------------------------------------------------------- broad-except v2
+class TestBroadExceptV2:
+    def test_tuple_containing_exception_flagged(self):
+        fs = lint("""
+            def f():
+                try:
+                    work()
+                except (ValueError, Exception):
+                    pass
+            """, "broad-except")
+        assert names(fs) == ["broad-except"]
+
+    def test_contextlib_suppress_exception_flagged(self):
+        fs = lint("""
+            import contextlib
+
+            def f():
+                with contextlib.suppress(Exception):
+                    work()
+            """, "broad-except")
+        assert names(fs) == ["broad-except"]
+
+    def test_from_imported_suppress_flagged(self):
+        fs = lint("""
+            from contextlib import suppress
+
+            def f():
+                with suppress(BaseException):
+                    work()
+            """, "broad-except")
+        assert names(fs) == ["broad-except"]
+
+    def test_narrow_suppress_not_flagged(self):
+        fs = lint("""
+            import contextlib
+
+            def f():
+                with contextlib.suppress(KeyError):
+                    work()
+            """, "broad-except")
+        assert fs == []
+
+
+# ------------------------------------------------------------------ SARIF
+class TestSarif:
+    def test_sarif_schema_shape(self):
+        fs = lint("import jax\nk = jax.random.PRNGKey(0)\n")
+        doc = to_sarif(fs)
+        assert doc["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in doc["$schema"]
+        (run,) = doc["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "jaxlint"
+        assert [r["id"] for r in driver["rules"]] == ["prng-constant-key"]
+        (res,) = run["results"]
+        assert res["ruleId"] == "prng-constant-key"
+        assert res["ruleIndex"] == 0
+        assert res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "pkg/mod.py"
+        assert loc["region"]["startLine"] == 2
+        assert loc["region"]["startColumn"] >= 1
+
+    def test_empty_findings_is_valid_sarif(self):
+        doc = to_sarif([])
+        assert doc["runs"][0]["results"] == []
+        assert doc["runs"][0]["tool"]["driver"]["rules"] == []
+
+    def test_cli_writes_sarif(self, tmp_path, capsys):
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+        out = tmp_path / "report.sarif"
+        rc = cli_main([str(dirty), "--sarif", str(out)])
+        capsys.readouterr()
+        assert rc == 1
+        doc = json.loads(out.read_text())
+        assert doc["version"] == "2.1.0"
+        assert len(doc["runs"][0]["results"]) == 1
+
+
+# --------------------------------------------------------------- baseline
+class TestBaseline:
+    def test_fingerprints_are_line_number_free_but_occurrence_aware(self):
+        a = Finding("r", "p.py", 3, 0, "msg")
+        b = Finding("r", "p.py", 90, 4, "msg")
+        fa, fb = fingerprints([a, b])
+        assert fa.split(":")[0] == fb.split(":")[0]  # same hash
+        assert fa != fb  # distinct occurrences
+
+    def test_roundtrip(self, tmp_path):
+        fs = lint("import jax\nk = jax.random.PRNGKey(0)\n")
+        bl = tmp_path / "bl.json"
+        write_baseline(str(bl), fs)
+        assert new_findings(fs, load_baseline(str(bl))) == []
+        extra = fs + [Finding("host-sync", "pkg/mod.py", 9, 0, "new one")]
+        assert names(new_findings(extra, load_baseline(str(bl)))) == ["host-sync"]
+
+    def test_cli_record_then_ratchet(self, tmp_path, capsys):
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+        bl = tmp_path / "baseline.json"
+        # first run records and exits 0
+        assert cli_main([str(dirty), "--baseline", str(bl)]) == 0
+        assert bl.exists()
+        # re-run: nothing new
+        assert cli_main([str(dirty), "--baseline", str(bl)]) == 0
+        # inject a new finding: only it fails the run
+        dirty.write_text("import jax\nk = jax.random.PRNGKey(0)\n"
+                         "j = jax.random.PRNGKey(1)\n")
+        assert cli_main([str(dirty), "--baseline", str(bl)]) == 1
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------- exclude
+class TestExclude:
+    def test_analyze_paths_exclude_glob(self, tmp_path):
+        (tmp_path / "good.py").write_text(
+            "import jax\nk = jax.random.PRNGKey(0)\n")
+        gen = tmp_path / "generated"
+        gen.mkdir()
+        (gen / "bad.py").write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+        fs = analyze_paths([str(tmp_path)], exclude=["generated"])
+        assert len(fs) == 1 and "good.py" in fs[0].path
+
+    def test_cli_default_excludes_tests_dir(self, tmp_path, capsys):
+        t = tmp_path / "tests"
+        t.mkdir()
+        (t / "bad.py").write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        assert cli_main([str(tmp_path)]) == 0
+        capsys.readouterr()
+
+    def test_cli_exclude_flag_adds_to_defaults(self, tmp_path, capsys):
+        v = tmp_path / "vendored"
+        v.mkdir()
+        (v / "bad.py").write_text("import jax\nk = jax.random.PRNGKey(0)\n")
+        assert cli_main([str(tmp_path)]) == 1
+        assert cli_main([str(tmp_path), "--exclude", "vendored"]) == 0
+        capsys.readouterr()
+
+
+# --------------------------------------------------------------- dataflow
+class TestDataflow:
+    def test_reaching_defs_branch_join(self):
+        src = ("def f(a):\n"
+               "    x = 1\n"
+               "    if a:\n"
+               "        x = 2\n"
+               "    return x\n")
+        fn = ast.parse(src).body[0]
+        rd = ReachingDefs(fn)
+        ((_, defs),) = rd.uses_of("x")
+        assert defs == frozenset({2, 4})
+
+    def test_reaching_defs_kill(self):
+        src = ("def f():\n"
+               "    x = 1\n"
+               "    x = 2\n"
+               "    return x\n")
+        fn = ast.parse(src).body[0]
+        rd = ReachingDefs(fn)
+        ((_, defs),) = rd.uses_of("x")
+        assert defs == frozenset({3})
+
+    def test_params_count_as_defs(self):
+        src = ("def f(a):\n"
+               "    return a\n")
+        fn = ast.parse(src).body[0]
+        rd = ReachingDefs(fn)
+        ((_, defs),) = rd.uses_of("a")
+        assert defs == frozenset({1})
