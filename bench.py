@@ -242,6 +242,89 @@ def _breadth(deadline: float, on_tpu: bool) -> dict:
     return out
 
 
+def _bench_serving():
+    """``python bench.py --serve``: serving-path latency/throughput.
+
+    Closed-loop clients fire single-row predicts at a ServeEngine (the
+    ParallelInference/ModelServer hot path minus HTTP framing) plus greedy
+    generations at a ContinuousBatcher on a small CausalLM. Prints ONE JSON
+    line: p50/p99 request latency (ms) and sustained req/s, with the
+    compile counts that bound serving-tail latency in the detail block.
+    Env: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_SECONDS (5),
+    BENCH_SERVE_GENERATES (8).
+    """
+    import concurrent.futures as cf
+    import threading
+
+    import jax
+
+    from deeplearning4j_tpu.models import CausalLM
+    from deeplearning4j_tpu.serve import ContinuousBatcher, ServeEngine
+
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", 5))
+    n_gen = int(os.environ.get("BENCH_SERVE_GENERATES", 8))
+    dev = jax.devices()[0]
+
+    model = CausalLM(seed=0, input_shape=(32,), num_layers=2, d_model=64,
+                     num_heads=4, vocab=256).build()
+    model.init()
+    eng = ServeEngine(model, batch_buckets=(1, 2, 4, 8, 16),
+                      queue_limit=4 * clients, max_wait_ms=1.0)
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, 256, (64, 1, 16)).astype(np.int32)
+    eng.predict(prompts[0])  # warm the compile outside the timed window
+
+    lat_ms, stop_at = [], [0.0]
+    lock = threading.Lock()
+
+    def client(i):
+        n, r = 0, np.random.RandomState(i)
+        while time.perf_counter() < stop_at[0]:
+            x = prompts[r.randint(len(prompts))]
+            t0 = time.perf_counter()
+            eng.predict(x)
+            with lock:
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+            n += 1
+        return n
+
+    stop_at[0] = time.perf_counter() + seconds
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(clients) as ex:
+        total = sum(ex.map(client, range(clients)))
+    wall = time.perf_counter() - t0
+    eng.shutdown()
+
+    cb = ContinuousBatcher(model, slots=4, capacity=32,
+                           prompt_buckets=(8, 16), seed=0)
+    g0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(4) as ex:
+        toks = sum(len(t) for t in ex.map(
+            lambda i: cb.generate(
+                rng.randint(0, 256, (int(rng.randint(4, 13)),)), 16,
+                temperature=0.0), range(n_gen)))
+    gen_wall = time.perf_counter() - g0
+    cb.shutdown()
+
+    lat = np.sort(np.asarray(lat_ms))
+    print(json.dumps({
+        "metric": "serve_predict_requests_per_sec",
+        "value": round(total / wall, 2),
+        "unit": "req/s",
+        "detail": {
+            "clients": clients, "requests": total,
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "engine_compiles": len(eng.compile_signatures),
+            "gen_tokens_per_sec": round(toks / gen_wall, 2),
+            "gen_compiles": len(cb.compile_signatures),
+            "device": str(dev.device_kind),
+            "captured": time.strftime("%Y-%m-%d"),
+        },
+    }), flush=True)
+
+
 def main():
     t_start = time.time()
     _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
@@ -326,4 +409,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--serve" in sys.argv[1:]:
+        _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
+        _bench_serving()
+    else:
+        main()

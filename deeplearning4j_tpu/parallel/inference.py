@@ -1,34 +1,36 @@
-"""ParallelInference — dynamic-batching inference server.
+"""ParallelInference — dynamic-batching inference server (compat shim).
 
 Reference: ``parallelism/ParallelInference.java:32`` (404 LoC): N worker
 threads + InferenceMode.BATCHED (:52): queued requests are coalesced up to
 ``batch_limit`` and executed as one forward (ObservablesProvider :82-84).
 
-TPU-native: a single jitted forward amortizes best at large batch — so the
-server coalesces the queue into the largest bucket <= batch_limit, pads to a
-fixed set of bucket sizes (static shapes -> no recompiles), and runs on the
-mesh. Worker threads are unnecessary: one dispatcher feeds the device; XLA
-pipelines H2D/compute.
+This class is now a thin compatibility surface over
+:class:`~deeplearning4j_tpu.serve.engine.ServeEngine`, which carries the
+actual batching/bucketing/drain logic (plus deadlines, admission control,
+and metrics that this legacy API never exposed). Behavioral fixes inherited
+from the engine:
+
+- every partial batch — steady state AND queue-drain at shutdown — pads to
+  a compiled bucket (bounded executable set, no shutdown-path recompiles);
+- a request larger than the largest bucket is split across bucket-sized
+  sub-batches instead of silently truncated (the seed dropped its tail
+  rows);
+- ``update_model`` is a registry *publish*: a new generation swapped
+  atomically, never splitting a batch across params versions.
+
+Legacy semantics kept: ``output()`` blocks, and a full queue blocks the
+caller (``admission="block"``) rather than shedding — in-process callers
+want backpressure, not 503s.
 """
 
 from __future__ import annotations
 
-import queue
-import threading
-import time
-from dataclasses import dataclass, field
-from typing import Any, List, Optional, Sequence
+from typing import Sequence
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-
-@dataclass
-class _Request:
-    x: np.ndarray
-    event: threading.Event = field(default_factory=threading.Event)
-    result: Optional[np.ndarray] = None
+from ..serve.engine import ServeEngine
+from ..serve.registry import ModelRegistry
 
 
 class ParallelInference:
@@ -42,75 +44,37 @@ class ParallelInference:
                  queue_limit: int = 64, max_wait_ms: float = 2.0,
                  buckets: Sequence[int] = (1, 2, 4, 8, 16, 32)):
         self.model = model
-        self.params = params if params is not None else model.params
-        self.state = state if state is not None else model.state
-        assert self.params is not None, "model must be initialized"
+        params = params if params is not None else model.params
+        state = state if state is not None else model.state
+        assert params is not None, "model must be initialized"
         self.batch_limit = batch_limit
         self.max_wait_ms = max_wait_ms
-        self.buckets = sorted(b for b in buckets if b <= batch_limit) or [batch_limit]
-        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
-        self._stop = threading.Event()
+        self.buckets = sorted(b for b in buckets if b <= batch_limit) \
+            or [batch_limit]
+        self.registry = ModelRegistry(params, state)
+        self.engine = ServeEngine(model, registry=self.registry,
+                                  batch_buckets=self.buckets,
+                                  queue_limit=queue_limit,
+                                  max_wait_ms=max_wait_ms,
+                                  admission="block")
 
-        @jax.jit
-        def fwd(params, state, x):
-            out = model.forward(params, state, x, training=False)
-            y = out[0]
-            if isinstance(y, list):
-                y = y[0]
-            return y
+    @property
+    def params(self):
+        return self.registry.current().params
 
-        self._fwd = fwd
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+    @property
+    def state(self):
+        return self.registry.current().state
 
     def output(self, x) -> np.ndarray:
         """Blocking single-request API (ParallelInference.output parity)."""
-        x = np.asarray(x)
-        if x.ndim == len(self.model.input_shape):  # single example -> add batch dim
-            x = x[None]
-        req = _Request(x)
-        self._queue.put(req)
-        req.event.wait()
-        return req.result
-
-    def _loop(self):
-        while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            batch = [first]
-            n = first.x.shape[0]
-            deadline = time.perf_counter() + self.max_wait_ms / 1e3
-            while n < self.batch_limit and time.perf_counter() < deadline:
-                try:
-                    r = self._queue.get_nowait()
-                    batch.append(r)
-                    n += r.x.shape[0]
-                except queue.Empty:
-                    time.sleep(0.0002)
-            self._run_batch(batch, n)
-
-    def _run_batch(self, batch: List[_Request], n: int):
-        bucket = next((b for b in self.buckets if b >= n), self.buckets[-1])
-        x = np.concatenate([r.x for r in batch])[:bucket]
-        if x.shape[0] < bucket:
-            pad = np.zeros((bucket - x.shape[0],) + x.shape[1:], x.dtype)
-            x = np.concatenate([x, pad])
-        y = np.asarray(self._fwd(self.params, self.state, x))
-        off = 0
-        for r in batch:
-            k = r.x.shape[0]
-            r.result = y[off : off + k]
-            off += k
-            r.event.set()
+        return self.engine.predict(x)
 
     def update_model(self, params, state=None):
-        """Hot-swap weights (ParallelInference.updateModel parity)."""
-        self.params = params
-        if state is not None:
-            self.state = state
+        """Hot-swap weights (ParallelInference.updateModel parity) — now an
+        atomic registry publish that drains in-flight batches on the old
+        generation before returning."""
+        self.registry.publish(params, state=state, drain=True)
 
     def shutdown(self):
-        self._stop.set()
-        self._thread.join(timeout=2)
+        self.engine.shutdown(drain=True)

@@ -1,0 +1,27 @@
+"""Production inference serving (PAPER.md L5/L7 — ParallelInference +
+ModelServer, rebuilt TPU-native).
+
+Layering:
+
+- :mod:`~.registry` — versioned params with atomic hot-swap + drain
+- :mod:`~.engine` — deadline queue, admission control, shape-bucketed
+  micro-batching (bounded executable set)
+- :mod:`~.continuous` — fixed-slot continuous batching for autoregressive
+  generation over ``nn/generation`` KV caches
+- :mod:`~.http` — predict/generate/health/ready/metrics front door
+- :mod:`~.errors` — the typed failure surface
+
+``parallel.ParallelInference`` and ``streaming.InferenceRoute`` are
+compatibility shims over these.
+"""
+
+from .continuous import ContinuousBatcher
+from .engine import ServeEngine
+from .errors import (CapacityError, DeadlineExceededError, ServeError,
+                     ServerClosingError, ShedError)
+from .http import ModelServer
+from .registry import ModelRegistry, ModelSnapshot
+
+__all__ = ["CapacityError", "ContinuousBatcher", "DeadlineExceededError",
+           "ModelRegistry", "ModelServer", "ModelSnapshot", "ServeEngine",
+           "ServeError", "ServerClosingError", "ShedError"]

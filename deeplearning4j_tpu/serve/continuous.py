@@ -1,0 +1,481 @@
+"""Continuous batching for autoregressive generation.
+
+``nn/generation.generate`` is whole-batch lockstep: every sequence in the
+batch prefills together, decodes together, and finishes together — a short
+sequence waits for the longest one, and a new request waits for the whole
+batch. Serving wants the vLLM-style iteration-level schedule instead: a
+fixed number of decode *slots*, each holding one in-flight sequence with its
+own KV-cache rows; every engine tick decodes ALL slots one token; a
+sequence that finishes frees its slot immediately and a queued prompt
+prefills into it, joining the in-flight batch mid-stream.
+
+Static shapes throughout (the TPU contract):
+
+- the decode step is ONE executable for the life of the server: per-slot
+  position/temperature/top-k/PRNG-key are *traced* scalars, vmapped over the
+  slot axis, so slot heterogeneity never changes a shape;
+- prompts pad to a fixed set of ``prompt_buckets`` before prefill, and the
+  true length rides along as a traced scalar (the last-real-token logits are
+  gathered with it) — compile count is ``|prompt_buckets| + O(1)``;
+- caches are slot-major ``(slots, 1, capacity, ...)`` buffers written in
+  place with ``lax.dynamic_update_slice`` (donated every tick). Right-padded
+  prefill garbage beyond the true length is never read: the causal mask
+  shows position p only slots ``0..p``, and decode overwrites position p
+  before attending to it.
+
+Scope: embedding-front causal-attention stacks (the CausalLM family).
+Recurrent layers are rejected — a right-padded prefill would run the RNN
+carry over pad rows — and non-causal attention cannot decode incrementally
+at all; both families stay on whole-batch ``nn.generation.generate``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from .errors import (CapacityError, DeadlineExceededError, ServeError,
+                     ServerClosingError, ShedError)
+from .registry import ModelRegistry
+
+
+def _default_prompt_buckets(capacity: int) -> tuple:
+    buckets, b = [], 8
+    while b < capacity:
+        buckets.append(b)
+        b *= 2
+    buckets.append(capacity)
+    return tuple(sorted(set(buckets)))
+
+
+class _GenRequest:
+    """One queued/in-flight generation."""
+
+    __slots__ = ("prompt", "max_new", "temperature", "top_k", "eos_id",
+                 "deadline", "enq_t", "event", "result", "error", "out",
+                 "key", "slot")
+
+    def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
+                 top_k: Optional[int], eos_id: Optional[int],
+                 deadline: Optional[float]):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.enq_t = time.perf_counter()
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[ServeError] = None
+        self.out: List[int] = []
+        self.key = None       # per-request PRNG key, set at admission
+        self.slot: Optional[int] = None
+
+    def wait(self) -> np.ndarray:
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class ContinuousBatcher:
+    """Fixed-slot continuous-batching decode loop over a model registry.
+
+    ``slots``: concurrent in-flight sequences (the decode batch size).
+    ``capacity``: KV-cache length per slot; admission requires
+    ``len(prompt) + max_new_tokens <= capacity``. Each decode tick leases
+    the registry's current snapshot, so a hot-swap takes effect at the next
+    token boundary (a long generation may intentionally span generations —
+    that is continuous batching's nature; per-batch generation purity is the
+    *engine*'s guarantee for one-shot predict).
+    """
+
+    def __init__(self, model, registry: Optional[ModelRegistry] = None,
+                 params=None, state=None, *, slots: int = 4,
+                 capacity: int = 256,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 queue_limit: int = 64, seed: int = 0, metrics=None):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..nn.generation import _decode_forward, _init_caches
+        from ..nn.layers import (Embedding, EmbeddingSequence,
+                                 MultiHeadAttention, Output,
+                                 PositionalEmbedding, TransformerEncoderBlock)
+        from ..nn.layers.recurrent import RecurrentLayer
+        from ..obs.metrics import MetricsRegistry
+
+        self.model = model
+        if registry is None:
+            registry = ModelRegistry(
+                params if params is not None else model.params,
+                state if state is not None else model.state, metrics=metrics)
+        self.registry = registry
+        self.slots = int(slots)
+        self.capacity = int(capacity)
+        self.queue_limit = int(queue_limit)
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.prompt_buckets = tuple(sorted(set(
+            int(b) for b in (prompt_buckets
+                             or _default_prompt_buckets(self.capacity))
+            if b <= self.capacity))) or (self.capacity,)
+
+        # --- model contract: embedding-front, causal, no recurrence ---
+        first = model.layers[0]
+        if not isinstance(first, (Embedding, EmbeddingSequence)):
+            raise ValueError(
+                "continuous batching requires an embedding-front token model "
+                "(CausalLM family); one-hot char models stay on "
+                "nn.generation.generate")
+        for i, layer in enumerate(model.layers):
+            if isinstance(layer, RecurrentLayer):
+                raise ValueError(
+                    f"layer {i} {type(layer).__name__}: recurrent carries "
+                    f"cannot survive a right-padded prefill — use whole-batch "
+                    f"nn.generation.generate for RNN models")
+            if isinstance(layer, (TransformerEncoderBlock, MultiHeadAttention)) \
+                    and not layer.causal:
+                raise ValueError(
+                    f"layer {i} {type(layer).__name__}(causal=False) cannot "
+                    f"be decoded autoregressively")
+            if isinstance(layer, PositionalEmbedding) \
+                    and layer.max_len < self.capacity:
+                raise ValueError(
+                    f"PositionalEmbedding(max_len={layer.max_len}) is shorter "
+                    f"than cache capacity {self.capacity}")
+        out_layer = model.layers[-1]
+        if not isinstance(out_layer, Output):
+            raise ValueError("model must end in an Output layer")
+        self.vocab = int(getattr(out_layer, "n_out", 0)
+                         or model._shapes[-1][-1])
+
+        S, C, V = self.slots, self.capacity, self.vocab
+        mdl = model
+
+        def _sample_dynamic(logits, key, temperature, top_k):
+            """Fully-traced sampler: temperature 0 -> greedy, top_k as a
+            dynamic scalar (top_k == V disables the restriction)."""
+            greedy = jnp.argmax(logits, axis=-1)
+            t = jnp.maximum(temperature, 1e-6)
+            scaled = logits / t
+            srt = jnp.sort(scaled, axis=-1)  # ascending
+            k = jnp.clip(top_k, 1, V)
+            kth = jnp.take(srt, V - k, axis=-1)
+            masked = jnp.where(scaled >= kth, scaled, -1e30)
+            samp = jax.random.categorical(key, masked, axis=-1)
+            return jnp.where(temperature <= 0.0, greedy,
+                             samp).astype(jnp.int32)
+
+        def _prefill(params, state, ids, true_len):
+            """ids (1, Tb) right-padded prompt; logits are gathered at the
+            last REAL token so padding never leaks into sampling."""
+            caches = _init_caches(mdl, 1, C, mdl.dtype)
+            lg, c = _decode_forward(mdl, params, state, ids, caches, 0)
+            last = jnp.take(lg, true_len - 1, axis=1)  # (1, V)
+            return last, c
+
+        def _slot_insert(big, small, s):
+            def wr(b, sm):
+                return lax.dynamic_update_slice(
+                    b, sm.astype(b.dtype)[None], (s,) + (0,) * (b.ndim - 1))
+            return jax.tree.map(wr, big, small)
+
+        def _decode_step(params, state, toks, caches, pos, keys, temps, tks):
+            """One token for every slot. All per-slot scalars are traced and
+            vmapped, so this is ONE executable for the server's lifetime."""
+            def one(tok, cache, p, key, temp, tk):
+                x = tok.reshape(1, 1).astype(jnp.int32)
+                lg, c2 = _decode_forward(mdl, params, state, x, cache, p)
+                key, sub = jax.random.split(key)
+                nxt = _sample_dynamic(lg[0, 0], sub, temp, tk)
+                return nxt, c2, key
+
+            return jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0))(
+                toks, caches, pos, keys, temps, tks)
+
+        self._prefill = jax.jit(_prefill)
+        self._sample = jax.jit(_sample_dynamic)
+        self._slot_insert = jax.jit(_slot_insert, donate_argnums=(0,))
+        # caches are the loop-carried buffer: donate them every tick
+        self._decode = jax.jit(_decode_step, donate_argnums=(3,))
+
+        cache0 = _init_caches(model, 1, C, model.dtype)
+        self._caches = jax.tree.map(lambda z: jnp.stack([z] * S), cache0)
+        self._base_key = jax.random.PRNGKey(seed)
+
+        self._cond = threading.Condition()
+        self._queue: List[_GenRequest] = []
+        self._slot_req: List[Optional[_GenRequest]] = [None] * S
+        self._closing = False
+        self._admitted = 0
+        self._peak_active = 0
+        self._prefill_sigs = set()
+        self._decode_sigs = set()
+
+        self._next_tok = np.zeros(S, np.int32)
+        self._pos = np.zeros(S, np.int32)
+        self._temps = np.ones(S, np.float32)
+        self._topks = np.full(S, V, np.int32)
+        self._keys = np.zeros((S, 2), np.uint32)
+
+        m = self.metrics
+        self._m_active = m.gauge("serve_gen_active_slots",
+                                 help="in-flight generation slots")
+        self._m_qdepth = m.gauge("serve_gen_queue_depth",
+                                 help="generation requests waiting for a slot")
+        self._m_admitted = m.counter("serve_gen_admitted_total",
+                                     help="generation requests prefilled")
+        self._m_completed = m.counter("serve_gen_completed_total",
+                                      help="generation requests finished")
+        self._m_tokens = m.counter("serve_gen_tokens_total",
+                                   help="tokens decoded across all slots")
+        self._m_decode_s = m.histogram("serve_gen_decode_seconds",
+                                       help="one all-slots decode tick")
+        self._m_prefill_s = m.histogram("serve_gen_prefill_seconds",
+                                        help="prompt prefill device time")
+        self._m_occupancy = m.histogram(
+            "serve_gen_slot_occupancy",
+            buckets=tuple((i + 1) / S for i in range(S)),
+            help="active slots / total slots per decode tick")
+        self._m_compiles = m.counter(
+            "serve_compile_misses_total", {"component": "generate"},
+            help="new (bucket, shape) signatures — each is an XLA compile")
+
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-continuous-batcher")
+        self._thread.start()
+
+    # ------------------------------------------------------------------ admit
+    def _shed_counter(self, cause: str):
+        return self.metrics.counter(
+            "serve_shed_total", {"cause": cause},
+            help="requests refused at admission, by cause")
+
+    def submit(self, prompt, max_new_tokens: int, *, temperature: float = 1.0,
+               top_k: Optional[int] = None, eos_id: Optional[int] = None,
+               timeout_ms: Optional[float] = None) -> _GenRequest:
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.shape[0] == 0:
+            raise ValueError("submit() takes one non-empty 1-D token prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.shape[0] + int(max_new_tokens) > self.capacity:
+            raise CapacityError(
+                f"prompt ({prompt.shape[0]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds cache capacity {self.capacity}")
+        deadline = (time.perf_counter() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        req = _GenRequest(prompt, max_new_tokens, temperature, top_k,
+                          eos_id, deadline)
+        with self._cond:
+            if self._closing:
+                self._shed_counter("shutting_down").inc()
+                raise ServerClosingError("batcher is draining; not accepting "
+                                         "new requests")
+            if len(self._queue) >= self.queue_limit:
+                self._shed_counter("queue_full").inc()
+                raise ShedError(f"generation queue full "
+                                f"({self.queue_limit}); shedding load")
+            self._queue.append(req)
+            self._m_qdepth.set(len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 timeout_ms: Optional[float] = None) -> np.ndarray:
+        """Blocking generate. ``prompt``: (T,) ids -> returns (N,) ids;
+        (B, T) -> (B, N), rows eos-padded to the longest. Mirrors
+        ``nn.generation.generate`` (greedy chains match it exactly)."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 1:
+            return self.submit(prompt, max_new_tokens,
+                               temperature=temperature, top_k=top_k,
+                               eos_id=eos_id, timeout_ms=timeout_ms).wait()
+        reqs = [self.submit(p, max_new_tokens, temperature=temperature,
+                            top_k=top_k, eos_id=eos_id,
+                            timeout_ms=timeout_ms) for p in prompt]
+        outs = [r.wait() for r in reqs]
+        width = max(o.shape[0] for o in outs)
+        pad = eos_id if eos_id is not None else 0
+        full = np.full((len(outs), width), pad, np.int32)
+        for i, o in enumerate(outs):
+            full[i, :o.shape[0]] = o
+        return full
+
+    # ---------------------------------------------------------------- serving
+    def _bucket(self, t: int) -> int:
+        for b in self.prompt_buckets:
+            if b >= t:
+                return b
+        raise CapacityError(f"prompt length {t} exceeds largest prompt "
+                            f"bucket {self.prompt_buckets[-1]}")
+
+    def _admit_into_slot(self, s: int, req: _GenRequest, snap) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        tp = req.prompt.shape[0]
+        bucket = self._bucket(tp)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :tp] = req.prompt
+        t0 = time.perf_counter()
+        last, cache = self._prefill(snap.params, snap.state,
+                                    jnp.asarray(ids), np.int32(tp))
+        self._m_prefill_s.observe(time.perf_counter() - t0)
+        self._admitted += 1
+        key = jax.random.fold_in(self._base_key, self._admitted)
+        key, sub = jax.random.split(key)
+        tok0 = int(np.asarray(self._sample(
+            last[0], sub, np.float32(req.temperature),
+            np.int32(req.top_k if req.top_k else self.vocab))))
+        self._caches = self._slot_insert(self._caches, cache, np.int32(s))
+        with self._cond:
+            sig = ("prefill", bucket)
+            if sig not in self._prefill_sigs:
+                self._prefill_sigs.add(sig)
+                self._m_compiles.inc()
+            req.slot = s
+            req.key = None
+            req.out.append(tok0)
+            self._slot_req[s] = req
+            self._next_tok[s] = tok0
+            self._pos[s] = tp
+            self._temps[s] = req.temperature
+            self._topks[s] = req.top_k if req.top_k else self.vocab
+            self._keys[s] = np.asarray(key, np.uint32)
+            self._m_admitted.inc()
+            active = sum(1 for r in self._slot_req if r is not None)
+            self._peak_active = max(self._peak_active, active)
+            self._m_active.set(active)
+        # a 1-token request (or instant EOS) finishes without ever decoding
+        self._maybe_finish(s)
+
+    def _maybe_finish(self, s: int) -> None:
+        with self._cond:
+            req = self._slot_req[s]
+            if req is None:
+                return
+            done = (len(req.out) >= req.max_new
+                    or (req.eos_id is not None and req.out
+                        and req.out[-1] == req.eos_id))
+            if not done:
+                return
+            req.result = np.asarray(req.out, np.int32)
+            self._slot_req[s] = None
+            self._m_completed.inc()
+            self._m_active.set(sum(1 for r in self._slot_req if r is not None))
+        req.event.set()
+
+    def _tick(self, snap) -> None:
+        """Decode one token for every slot; bookkeep the active ones."""
+        import jax.numpy as jnp
+
+        with self._cond:
+            active = [s for s in range(self.slots)
+                      if self._slot_req[s] is not None]
+            toks = np.array(self._next_tok)
+            pos = np.array(self._pos)
+            temps = np.array(self._temps)
+            topks = np.array(self._topks)
+            keys = np.array(self._keys)
+        if not active:
+            return
+        t0 = time.perf_counter()
+        nxt, caches, new_keys = self._decode(
+            snap.params, snap.state, jnp.asarray(toks), self._caches,
+            jnp.asarray(pos), jnp.asarray(keys), jnp.asarray(temps),
+            jnp.asarray(topks))
+        self._caches = caches
+        nxt_np = np.asarray(nxt)
+        keys_np = np.asarray(new_keys, np.uint32)
+        self._m_decode_s.observe(time.perf_counter() - t0)
+        self._m_occupancy.observe(len(active) / self.slots)
+        self._m_tokens.inc(len(active))
+        with self._cond:
+            sig = ("decode", self.slots)
+            if sig not in self._decode_sigs:
+                self._decode_sigs.add(sig)
+                self._m_compiles.inc()
+            for s in active:
+                req = self._slot_req[s]
+                if req is None:
+                    continue
+                tok = int(nxt_np[s])
+                req.out.append(tok)
+                self._next_tok[s] = tok
+                self._pos[s] = self._pos[s] + 1
+                self._keys[s] = keys_np[s]
+        for s in active:
+            self._maybe_finish(s)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                has_active = any(r is not None for r in self._slot_req)
+                if self._closing and not self._queue and not has_active:
+                    return
+                if not self._queue and not has_active:
+                    self._cond.wait(0.05)
+                    continue
+                admits = []
+                for s in range(self.slots):
+                    if self._slot_req[s] is None and self._queue:
+                        admits.append((s, self._queue.pop(0)))
+                self._m_qdepth.set(len(self._queue))
+            now = time.perf_counter()
+            with self.registry.lease() as snap:
+                for s, req in admits:
+                    if req.deadline is not None and now > req.deadline:
+                        req.error = DeadlineExceededError(
+                            "deadline exceeded waiting for a decode slot")
+                        req.event.set()
+                        continue
+                    try:
+                        self._admit_into_slot(s, req, snap)
+                    except ServeError as e:
+                        req.error = e
+                        req.event.set()
+                    except Exception as e:  # slot loop must outlive any bad request  # jaxlint: disable=broad-except
+                        req.error = ServeError(f"{type(e).__name__}: {e}")
+                        req.event.set()
+                self._tick(snap)
+
+    # -------------------------------------------------------------- lifecycle
+    @property
+    def compile_signatures(self) -> set:
+        with self._cond:
+            return self._prefill_sigs | self._decode_sigs
+
+    @property
+    def peak_active_slots(self) -> int:
+        with self._cond:
+            return self._peak_active
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """``drain=True`` finishes every queued and in-flight generation
+        first; ``drain=False`` errors them out immediately."""
+        with self._cond:
+            self._closing = True
+            if not drain:
+                err = ServerClosingError("batcher shut down before dispatch")
+                for req in self._queue:
+                    req.error = err
+                    req.event.set()
+                self._queue.clear()
+                for s, req in enumerate(self._slot_req):
+                    if req is not None:
+                        req.error = err
+                        req.event.set()
+                        self._slot_req[s] = None
+                self._m_qdepth.set(0)
+                self._m_active.set(0)
+            self._cond.notify_all()
+        self._thread.join(timeout)

@@ -1,0 +1,377 @@
+"""Serving engine — deadline-aware queue, admission control, and
+shape-bucketed micro-batching.
+
+Generalizes ``ParallelInference``'s bucket trick (``ParallelInference.java
+:52``, ObservablesProvider :82-84) along BOTH static-shape axes: requests
+coalesce into the smallest *batch* bucket that fits, and (optionally) their
+time axis is padded to a *length* bucket — so arbitrary traffic drives a
+bounded executable set: at most ``|batch_buckets| x |length_buckets|``
+compiles, ever. That bound is the TPU serving contract; a recompile in the
+request path is a multi-second outage.
+
+Design points (TF-Serving / dataflow lineage, PAPERS.md arXiv 1605.08695):
+
+- **Admission control**: the queue is bounded in *rows*. Past the limit the
+  engine sheds instantly with a typed :class:`~.errors.ShedError` — overload
+  degrades into fast 503s, never into an unbounded latency cliff. Per-cause
+  counters (``serve_shed_total{cause=...}``) make the shed budget
+  observable. ``admission="block"`` restores the legacy blocking-put
+  behavior for in-process callers (:class:`ParallelInference` shim).
+- **Deadlines**: each request may carry one. Expiry is detected at dispatch
+  time and answered with a typed :class:`~.errors.DeadlineExceededError` —
+  a late answer is a wrong answer, and the device never spends a FLOP on it.
+- **One generation per batch**: the dispatcher takes a single
+  :meth:`~.registry.ModelRegistry.lease` per device batch, so a hot-swap
+  can never split a batch across params versions.
+- **Every path pads**: the drain-at-shutdown path runs the same
+  ``_run_batch`` as steady state, so partial batches are padded to a bucket
+  there too (the seed's ``parallel/inference.py`` truncated oversized
+  batches and could ship un-padded shapes at shutdown; oversized requests
+  are now split at admission instead).
+
+The dispatcher is one thread: a single jitted forward amortizes best at
+large batch, XLA pipelines H2D/compute, and worker fan-out would only
+shuffle queueing to the device stream.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .errors import (CapacityError, DeadlineExceededError, ServeError,
+                     ServerClosingError, ShedError)
+from .registry import ModelRegistry
+
+# batch-occupancy is a ratio in (0, 1]; latency-style buckets would waste
+# the whole axis
+_OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+class _Request:
+    """One admitted unit of work: ``rows`` examples sharing a shape key."""
+
+    __slots__ = ("x", "rows", "true_len", "padded_len", "shape_key", "enq_t",
+                 "deadline", "event", "result", "error", "generation",
+                 "batch_seq")
+
+    def __init__(self, x: np.ndarray, true_len: Optional[int],
+                 padded_len: Optional[int], deadline: Optional[float]):
+        self.x = x
+        self.rows = x.shape[0]
+        self.true_len = true_len        # pre-padding time length (or None)
+        self.padded_len = padded_len    # length bucket applied (or None)
+        self.shape_key = (x.shape[1:], str(x.dtype))
+        self.enq_t = time.perf_counter()
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[ServeError] = None
+        self.generation: Optional[int] = None   # set by the batch that ran it
+        self.batch_seq: Optional[int] = None
+
+    def wait(self) -> np.ndarray:
+        """Block for the outcome; raises the typed error on failure."""
+        if self.deadline is not None:
+            # the dispatcher resolves expiry itself; the extra slack only
+            # guards against a wedged dispatcher turning into a silent hang
+            if not self.event.wait(max(self.deadline - time.perf_counter(), 0)
+                                   + 5.0):
+                raise DeadlineExceededError("request timed out in queue")
+        else:
+            self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class ServeEngine:
+    """Micro-batching inference engine over a :class:`ModelRegistry`.
+
+    ``batch_buckets``: padded batch sizes compiled ahead of time; coalesced
+    work pads to the smallest bucket that fits. ``length_buckets`` (optional)
+    additionally pads the example time axis (axis 0 of each example) to a
+    fixed set of lengths — sound for causal/recurrent/token-local stacks,
+    where right-padding cannot influence earlier positions; results are
+    sliced back to the true length when the output keeps a time axis.
+
+    ``forward``: override the device function ``(params, state, x) -> y``;
+    by default ``model.forward`` is wrapped and jitted. A provided forward
+    is used as-is (callers jit — or deliberately don't, in tests).
+    """
+
+    def __init__(self, model, registry: Optional[ModelRegistry] = None,
+                 params=None, state=None, *,
+                 batch_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
+                 length_buckets: Optional[Sequence[int]] = None,
+                 queue_limit: int = 256, max_wait_ms: float = 2.0,
+                 default_timeout_ms: Optional[float] = None,
+                 admission: str = "shed", metrics=None, forward=None):
+        from ..obs.metrics import MetricsRegistry
+
+        if admission not in ("shed", "block"):
+            raise ValueError(f"admission must be 'shed' or 'block', "
+                             f"got {admission!r}")
+        self.model = model
+        if registry is None:
+            registry = ModelRegistry(
+                params if params is not None else model.params,
+                state if state is not None else model.state, metrics=metrics)
+        self.registry = registry
+        self.batch_buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
+        if not self.batch_buckets or self.batch_buckets[0] < 1:
+            raise ValueError("batch_buckets must be positive ints")
+        self.length_buckets = (tuple(sorted(set(int(b) for b in length_buckets)))
+                               if length_buckets else None)
+        self.queue_limit = int(queue_limit)
+        self.max_wait_ms = float(max_wait_ms)
+        self.default_timeout_ms = default_timeout_ms
+        self.admission = admission
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+
+        if forward is None:
+            import jax
+
+            @jax.jit
+            def fwd(params, state, x):
+                out = model.forward(params, state, x, training=False)
+                y = out[0]
+                if isinstance(y, list):
+                    y = y[0]
+                return y
+
+            forward = fwd
+        self._fwd = forward
+
+        self._cond = threading.Condition()
+        self._pending: List[_Request] = []
+        self._depth_rows = 0
+        self._closing = False
+        self._sigs = set()          # (bucket, shape_key) ever compiled
+        self._batch_count = 0
+
+        m = self.metrics
+        self._m_depth = m.gauge("serve_queue_depth",
+                                help="rows waiting for a device batch")
+        self._m_queue_s = m.histogram("serve_queue_seconds",
+                                      help="admission -> batch dispatch wait")
+        self._m_device_s = m.histogram("serve_device_seconds",
+                                       help="device forward wall time per batch")
+        self._m_occupancy = m.histogram(
+            "serve_batch_occupancy", buckets=_OCCUPANCY_BUCKETS,
+            help="real rows / padded bucket size per device batch")
+        self._m_batches = m.counter("serve_batches_total",
+                                    help="device batches executed")
+        self._m_requests = m.counter("serve_requests_total",
+                                     help="requests admitted")
+        self._m_compiles = m.counter(
+            "serve_compile_misses_total", {"component": "engine"},
+            help="new (bucket, shape) signatures — each is an XLA compile")
+        self._m_deadline = m.counter("serve_deadline_expired_total",
+                                     help="requests expired before dispatch")
+
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-engine-dispatch")
+        self._thread.start()
+
+    # ------------------------------------------------------------------ admit
+    def _shed_counter(self, cause: str):
+        return self.metrics.counter(
+            "serve_shed_total", {"cause": cause},
+            help="requests refused at admission, by cause")
+
+    def _bucket_length(self, t: int) -> int:
+        for b in self.length_buckets:
+            if b >= t:
+                return b
+        raise CapacityError(
+            f"sequence length {t} exceeds largest length bucket "
+            f"{self.length_buckets[-1]}")
+
+    def submit(self, x, timeout_ms: Optional[float] = None) -> _Request:
+        """Admit one request (rows must fit the largest batch bucket — use
+        :meth:`predict` for arbitrary sizes). Returns a waitable handle."""
+        x = np.asarray(x)
+        if x.ndim == 0 or x.shape[0] == 0:
+            raise ValueError("request must contain at least one row")
+        if x.shape[0] > self.batch_buckets[-1]:
+            raise ValueError(
+                f"request rows {x.shape[0]} exceed largest batch bucket "
+                f"{self.batch_buckets[-1]}; predict() splits automatically")
+        true_len = padded = None
+        if self.length_buckets is not None and x.ndim >= 2:
+            true_len = x.shape[1]
+            padded = self._bucket_length(true_len)
+            if padded > true_len:
+                pad = np.zeros((x.shape[0], padded - true_len) + x.shape[2:],
+                               x.dtype)
+                x = np.concatenate([x, pad], axis=1)
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        deadline = (time.perf_counter() + timeout_ms / 1e3
+                    if timeout_ms is not None else None)
+        req = _Request(x, true_len, padded, deadline)
+        with self._cond:
+            if self._closing:
+                self._shed_counter("shutting_down").inc()
+                raise ServerClosingError("server is draining; not accepting "
+                                         "new requests")
+            if self.admission == "block":
+                self._cond.wait_for(
+                    lambda: self._closing
+                    or self._depth_rows + req.rows <= self.queue_limit)
+                if self._closing:
+                    self._shed_counter("shutting_down").inc()
+                    raise ServerClosingError("server is draining; not "
+                                             "accepting new requests")
+            elif self._depth_rows + req.rows > self.queue_limit:
+                self._shed_counter("queue_full").inc()
+                raise ShedError(
+                    f"queue full ({self._depth_rows} rows >= "
+                    f"{self.queue_limit}); shedding load")
+            self._pending.append(req)
+            self._depth_rows += req.rows
+            self._m_depth.set(self._depth_rows)
+            self._m_requests.inc()
+            self._cond.notify_all()
+        return req
+
+    def predict(self, x, timeout_ms: Optional[float] = None) -> np.ndarray:
+        """Blocking inference. ``x``: one example or a row batch of any
+        size — oversized batches are split across bucket-sized requests (the
+        seed truncated them). Raises typed :class:`~.errors.ServeError`s."""
+        x = np.asarray(x)
+        if x.ndim == len(self.model.input_shape):  # single example
+            x = x[None]
+        cap = self.batch_buckets[-1]
+        if x.shape[0] <= cap:
+            return self.submit(x, timeout_ms=timeout_ms).wait()
+        reqs = [self.submit(x[i:i + cap], timeout_ms=timeout_ms)
+                for i in range(0, x.shape[0], cap)]
+        return np.concatenate([r.wait() for r in reqs])
+
+    # --------------------------------------------------------------- dispatch
+    def _next_batch(self) -> Optional[List[_Request]]:
+        """Pop a coalescible set of pending requests (same shape key, rows
+        within the largest bucket), waiting up to ``max_wait_ms`` to fill.
+        Returns None exactly once: closing and nothing left to drain."""
+        with self._cond:
+            while not self._pending:
+                if self._closing:
+                    return None
+                self._cond.wait(0.05)
+            first = self._pending.pop(0)
+            batch, rows = [first], first.rows
+            cap = self.batch_buckets[-1]
+            t_end = time.perf_counter() + self.max_wait_ms / 1e3
+            while rows < cap:
+                took = False
+                for i, r in enumerate(self._pending):
+                    if r.shape_key == first.shape_key and rows + r.rows <= cap:
+                        self._pending.pop(i)
+                        batch.append(r)
+                        rows += r.rows
+                        took = True
+                        break
+                if rows >= cap or self._closing:
+                    break
+                now = time.perf_counter()
+                if now >= t_end:
+                    break
+                if not took:
+                    self._cond.wait(min(t_end - now, 1e-3))
+            self._depth_rows -= rows
+            self._m_depth.set(self._depth_rows)
+            self._cond.notify_all()  # wake admission="block" submitters
+        return batch
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                r.error = DeadlineExceededError(
+                    f"deadline exceeded after "
+                    f"{(now - r.enq_t) * 1e3:.1f}ms in queue")
+                self._m_deadline.inc()
+                r.event.set()
+            else:
+                live.append(r)
+        if not live:
+            return
+        rows = sum(r.rows for r in live)
+        bucket = next((b for b in self.batch_buckets if b >= rows),
+                      self.batch_buckets[-1])
+        x = np.concatenate([r.x for r in live])
+        if x.shape[0] < bucket:  # ALWAYS pad to the bucket — drain path too
+            pad = np.zeros((bucket - x.shape[0],) + x.shape[1:], x.dtype)
+            x = np.concatenate([x, pad])
+        sig = (bucket,) + live[0].shape_key
+        with self._cond:
+            if sig not in self._sigs:
+                self._sigs.add(sig)
+                self._m_compiles.inc()
+            self._batch_count += 1
+            seq = self._batch_count
+        with self.registry.lease() as snap:  # ONE generation per batch
+            t0 = time.perf_counter()
+            try:
+                y = np.asarray(self._fwd(snap.params, snap.state, x))
+            except Exception as e:  # the dispatcher must outlive any bad batch  # jaxlint: disable=broad-except
+                err = ServeError(f"{type(e).__name__}: {e}", cause="internal")
+                for r in live:
+                    r.error = err
+                    r.event.set()
+                return
+            self._m_device_s.observe(time.perf_counter() - t0)
+        self._m_batches.inc()
+        self._m_occupancy.observe(rows / bucket)
+        off = 0
+        for r in live:
+            out = y[off:off + r.rows]
+            off += r.rows
+            if (r.true_len is not None and r.padded_len is not None
+                    and out.ndim >= 2 and out.shape[1] == r.padded_len):
+                out = out[:, :r.true_len]  # un-pad outputs that kept time
+            r.result = out
+            r.generation = snap.generation
+            r.batch_seq = seq
+            self._m_queue_s.observe(t0 - r.enq_t)
+            r.event.set()
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            self._run_batch(batch)
+
+    # -------------------------------------------------------------- lifecycle
+    @property
+    def compile_signatures(self) -> set:
+        """Distinct (bucket, example-shape, dtype) executables ever run."""
+        with self._cond:
+            return set(self._sigs)
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the engine. ``drain=True`` (default) completes everything
+        already admitted — through the same padded-bucket path as steady
+        state — before the dispatcher exits; new admissions shed with
+        ``cause="shutting_down"`` meanwhile. ``drain=False`` errors pending
+        requests out immediately."""
+        with self._cond:
+            self._closing = True
+            if not drain:
+                err = ServerClosingError("server shut down before dispatch")
+                for r in self._pending:
+                    r.error = err
+                    r.event.set()
+                self._pending.clear()
+                self._depth_rows = 0
+                self._m_depth.set(0)
+            self._cond.notify_all()
+        self._thread.join(timeout)

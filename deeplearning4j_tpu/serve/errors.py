@@ -1,0 +1,57 @@
+"""Typed serving failures.
+
+Every way a request can fail without a result is a distinct exception type
+carrying a stable machine-readable ``cause`` tag (the label on the
+``serve_shed_total{cause=...}`` counter) and the HTTP status the front-end
+maps it to. Clients — and tests — branch on type/cause, never on message
+text, and overload NEVER manifests as a hang: admission control raises
+:class:`ShedError` immediately, expiry raises
+:class:`DeadlineExceededError` at dispatch time.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base class for typed serving failures."""
+
+    cause: str = "internal"
+    http_status: int = 500
+
+    def __init__(self, message: str, cause: str = None):
+        super().__init__(message)
+        if cause is not None:
+            self.cause = cause
+
+
+class ShedError(ServeError):
+    """Request refused at admission — bounded queue full (load shedding).
+
+    Overload is answered instantly and cheaply: the client should back off
+    and retry (HTTP 503).
+    """
+
+    cause = "queue_full"
+    http_status = 503
+
+
+class ServerClosingError(ShedError):
+    """Request refused because the server is draining for shutdown."""
+
+    cause = "shutting_down"
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline passed before device work could start."""
+
+    cause = "deadline"
+    http_status = 504
+
+
+class CapacityError(ServeError):
+    """The request can never fit — e.g. prompt + max_new_tokens exceeds the
+    generation KV-cache capacity, or a sequence is longer than the largest
+    length bucket. Retrying will not help (HTTP 400)."""
+
+    cause = "over_capacity"
+    http_status = 400

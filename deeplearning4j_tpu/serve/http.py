@@ -1,0 +1,190 @@
+"""HTTP front-end — predict + generate endpoints over the serving engine.
+
+Built on ``utils/httpd.py`` so ``GET /metrics`` (Prometheus) and
+per-endpoint request-latency histograms come for free through the shared
+``owner.metrics`` duck-typing. The DL4J analogue is the ModelServer /
+``DL4jServeRouteBuilder`` layer (PAPER.md L7), upgraded with the things a
+production front door needs: typed overload answers (503 shed / 504
+deadline, never a hang), liveness vs readiness split, and graceful drain —
+``stop()`` flips readiness, lets every admitted request finish through the
+engine's padded-bucket path, then closes the listener.
+
+Endpoints:
+
+- ``POST /predict``  ``{"ndarray": [[...]], "timeout_ms": 250}``
+  -> ``{"output": [[...]], "generation": 3}``
+- ``POST /generate`` ``{"prompt": [1,2,3], "max_new_tokens": 16,
+  "temperature": 0.8, "top_k": 40, "eos_id": 2}`` -> ``{"tokens": [...]}``
+- ``GET /health`` (liveness) · ``GET /ready`` (readiness: 503 while
+  draining) · ``GET /models`` (registry generations) · ``GET /metrics``
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..obs.metrics import MetricsRegistry
+from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
+from .continuous import ContinuousBatcher
+from .engine import ServeEngine
+from .errors import ServeError
+from .registry import ModelRegistry
+
+_BAD_REQUEST = (KeyError, ValueError, TypeError, AttributeError,
+                json.JSONDecodeError)
+
+
+class ModelServer(JsonHTTPServerMixin):
+    """Serve one model (registry) over HTTP.
+
+    The generation stack (:class:`ContinuousBatcher`) is built lazily on the
+    first ``/generate`` — predict-only deployments of non-token models never
+    pay for it (nor hit its model-contract validation).
+    """
+
+    def __init__(self, model, params=None, state=None, *,
+                 host: str = "127.0.0.1", port: int = 9010,
+                 registry: Optional[ModelRegistry] = None,
+                 engine: Optional[ServeEngine] = None,
+                 batch_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),
+                 length_buckets: Optional[Sequence[int]] = None,
+                 queue_limit: int = 256, max_wait_ms: float = 2.0,
+                 default_timeout_ms: Optional[float] = None,
+                 input_dtype=np.float32, gen_slots: int = 4,
+                 gen_capacity: int = 256, gen_queue_limit: int = 64,
+                 seed: int = 0, metrics: Optional[MetricsRegistry] = None):
+        self.model = model
+        self.host = host
+        self.port = port
+        self.input_dtype = input_dtype
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if registry is None:
+            registry = (engine.registry if engine is not None else
+                        ModelRegistry(
+                            params if params is not None else model.params,
+                            state if state is not None else model.state,
+                            metrics=self.metrics))
+        self.registry = registry
+        self.engine = engine if engine is not None else ServeEngine(
+            model, registry=registry, batch_buckets=batch_buckets,
+            length_buckets=length_buckets, queue_limit=queue_limit,
+            max_wait_ms=max_wait_ms, default_timeout_ms=default_timeout_ms,
+            metrics=self.metrics)
+        self._gen_opts = dict(slots=gen_slots, capacity=gen_capacity,
+                              queue_limit=gen_queue_limit, seed=seed)
+        self._batcher: Optional[ContinuousBatcher] = None
+        self._lifecycle_lock = threading.Lock()
+        self._accepting = True
+
+    # --- lazy generation stack ---
+    def batcher(self) -> ContinuousBatcher:
+        with self._lifecycle_lock:
+            if self._batcher is None:
+                self._batcher = ContinuousBatcher(
+                    self.model, registry=self.registry, metrics=self.metrics,
+                    **self._gen_opts)
+            return self._batcher
+
+    # --- hot-swap convenience (in-process admin surface) ---
+    def publish(self, params, state=None, version: Optional[str] = None,
+                drain: bool = True):
+        """Publish new weights; by default waits for in-flight batches on
+        the old generation to retire (the ParallelInference.updateModel
+        upgrade: swap is atomic AND observable)."""
+        return self.registry.publish(params, state=state, version=version,
+                                     drain=drain)
+
+    def rollback(self, drain: bool = True):
+        return self.registry.rollback(drain=drain)
+
+    def ready(self) -> bool:
+        with self._lifecycle_lock:
+            return self._accepting
+
+    # --- handler ---
+    def _handler(self):
+        server = self
+
+        class Handler(JsonRequestHandler):
+            owner = server
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self.reply(200, {"status": "ok",
+                                     "model": type(server.model).__name__,
+                                     "generation":
+                                         server.registry.generation})
+                elif self.path == "/ready":
+                    if server.ready():
+                        self.reply(200, {"status": "ready"})
+                    else:
+                        self.reply(503, {"status": "draining"})
+                elif self.path == "/models":
+                    cur = server.registry.current()
+                    self.reply(200, {
+                        "generation": cur.generation, "version": cur.version,
+                        "history": [{"generation": g, "version": v}
+                                    for g, v in server.registry.history()]})
+                else:
+                    self.reply(404, {"error": "unknown endpoint"})
+
+            def do_POST(self):
+                try:
+                    req = self.read_json()
+                    if self.path == "/predict":
+                        self._predict(req)
+                    elif self.path == "/generate":
+                        self._generate(req)
+                    else:
+                        self.reply(404, {"error": "unknown endpoint"})
+                except ServeError as e:
+                    self.reply(e.http_status,
+                               {"error": str(e), "cause": e.cause})
+                except _BAD_REQUEST as e:
+                    self.reply(400, {"error": str(e)})
+                except Exception as e:  # server must answer every request  # jaxlint: disable=broad-except
+                    self.reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _predict(self, req):
+                x = np.asarray(req["ndarray"], server.input_dtype)
+                handle = None
+                if x.ndim > len(server.model.input_shape) \
+                        and x.shape[0] <= server.engine.batch_buckets[-1]:
+                    handle = server.engine.submit(
+                        x, timeout_ms=req.get("timeout_ms"))
+                    y = handle.wait()
+                else:
+                    y = server.engine.predict(
+                        x, timeout_ms=req.get("timeout_ms"))
+                body = {"output": np.asarray(y).tolist()}
+                if handle is not None and handle.generation is not None:
+                    body["generation"] = handle.generation
+                self.reply(200, body)
+
+            def _generate(self, req):
+                prompt = req["prompt"]
+                toks = server.batcher().generate(
+                    np.asarray(prompt, np.int32),
+                    int(req.get("max_new_tokens", 16)),
+                    temperature=float(req.get("temperature", 1.0)),
+                    top_k=req.get("top_k"), eos_id=req.get("eos_id"),
+                    timeout_ms=req.get("timeout_ms"))
+                self.reply(200, {"tokens": np.asarray(toks).tolist()})
+
+        return Handler
+
+    # --- lifecycle ---
+    def stop(self, drain: bool = True):
+        """Graceful by default: readiness flips first (load balancers stop
+        routing), admitted work completes, then the listener closes."""
+        with self._lifecycle_lock:
+            self._accepting = False
+            batcher = self._batcher
+        self.engine.shutdown(drain=drain)
+        if batcher is not None:
+            batcher.shutdown(drain=drain)
+        super().stop()

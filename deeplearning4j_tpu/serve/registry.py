@@ -1,0 +1,177 @@
+"""Versioned model registry with atomic hot-swap and in-flight draining.
+
+``ParallelInference.updateModel`` (``ParallelInference.java:140``) swaps the
+weight pointer under a lock and hopes: a batch mid-forward may read the new
+weights for its second half. Here publication is a *generation*: an
+immutable :class:`ModelSnapshot` swapped atomically, with lease accounting
+so a swap can wait until every batch dispatched against an older generation
+has retired. The serving engine takes one lease per device batch, which is
+what makes "no batch ever mixes two params generations" a structural
+property rather than a timing accident (the TF-Serving version-manager
+design, PAPERS.md arXiv 1605.08695).
+
+JAX makes the cheap part free: params are immutable pytrees, so an
+in-flight batch holding generation N is untouched by publishing N+1 — no
+copy, no read lock on the hot path beyond one pointer grab per batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+
+class ModelSnapshot(NamedTuple):
+    """One immutable published version. ``generation`` is monotonic across
+    publish AND rollback (a rollback re-publishes old params under a new
+    generation, so "which params ran this batch" is always a total order)."""
+
+    generation: int
+    version: str
+    params: Any
+    state: Any
+
+
+def _check_live(params) -> None:
+    """Reject params holding donated (deleted) device buffers.
+
+    The trainer's jitted step donates its param buffers, so a checkpoint
+    captured by reference before ``fit()`` points at freed memory; serving
+    it would 500 on the first request with a cryptic "Array has been
+    deleted". Publish-time is the place to say so, with the fix.
+    """
+    import jax
+
+    for leaf in jax.tree.leaves(params):
+        deleted = getattr(leaf, "is_deleted", None)
+        if deleted is not None and deleted():
+            raise ValueError(
+                "params contain deleted (donated) device buffers — the "
+                "training step donates its inputs, so snapshot checkpoints "
+                "by value (jax.tree.map(np.asarray, params)), not by "
+                "reference")
+
+
+class ModelRegistry:
+    """Thread-safe versioned params/state store.
+
+    - :meth:`current` / :meth:`lease` — readers. A lease pins the snapshot
+      for the duration of one unit of device work and is counted per
+      generation.
+    - :meth:`publish` / :meth:`rollback` — writers. Atomic swap; with
+      ``drain=True`` the call additionally blocks until all leases on
+      *older* generations are returned (in-flight work finished).
+
+    ``keep`` bounds the rollback history (oldest snapshots are dropped).
+    """
+
+    def __init__(self, params, state=None, version: str = "v0",
+                 keep: int = 8, metrics=None):
+        if params is None:
+            raise ValueError("registry needs initialized params")
+        _check_live(params)
+        self._cond = threading.Condition()
+        self._inflight: Dict[int, int] = {}
+        self._history: List[ModelSnapshot] = []
+        self._metrics = metrics
+        snap = ModelSnapshot(1, version, params, state if state is not None else {})
+        self._keep = max(int(keep), 1)
+        with self._cond:
+            self._history.append(snap)
+        self._gauge_generation(snap.generation)
+
+    # --- readers ---
+    def current(self) -> ModelSnapshot:
+        with self._cond:
+            return self._history[-1]
+
+    @property
+    def generation(self) -> int:
+        return self.current().generation
+
+    @contextmanager
+    def lease(self):
+        """Pin the current snapshot for one batch of device work."""
+        with self._cond:
+            snap = self._history[-1]
+            self._inflight[snap.generation] = \
+                self._inflight.get(snap.generation, 0) + 1
+        try:
+            yield snap
+        finally:
+            with self._cond:
+                n = self._inflight.get(snap.generation, 0) - 1
+                if n <= 0:
+                    self._inflight.pop(snap.generation, None)
+                else:
+                    self._inflight[snap.generation] = n
+                self._cond.notify_all()
+
+    def inflight(self) -> Dict[int, int]:
+        """Outstanding lease counts by generation (diagnostic)."""
+        with self._cond:
+            return dict(self._inflight)
+
+    # --- writers ---
+    def publish(self, params, state=None, version: Optional[str] = None,
+                drain: bool = False, timeout: Optional[float] = None
+                ) -> ModelSnapshot:
+        """Atomically publish a new generation; optionally wait for work
+        dispatched against older generations to retire."""
+        if params is None:
+            raise ValueError("cannot publish params=None")
+        _check_live(params)
+        with self._cond:
+            gen = self._history[-1].generation + 1
+            snap = ModelSnapshot(
+                gen, version if version is not None else f"v{gen - 1}",
+                params, state if state is not None else self._history[-1].state)
+            self._history.append(snap)
+            del self._history[:-self._keep]
+        self._gauge_generation(snap.generation)
+        self._count("serve_model_publishes_total",
+                    "model generations published (hot-swap)")
+        if drain:
+            self.drain(timeout=timeout)
+        return snap
+
+    def rollback(self, drain: bool = False,
+                 timeout: Optional[float] = None) -> ModelSnapshot:
+        """Re-publish the previous version under a fresh generation."""
+        with self._cond:
+            if len(self._history) < 2:
+                raise ValueError("nothing to roll back to")
+            prev = self._history[-2]
+        self._count("serve_model_rollbacks_total", "model rollbacks")
+        return self.publish(prev.params, state=prev.state,
+                            version=prev.version, drain=drain, timeout=timeout)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until no lease is held on a non-current generation.
+
+        Returns False on timeout. New leases (current generation) are not
+        blocked — drain is about retiring the *old* generation, not pausing
+        the server.
+        """
+        with self._cond:
+            def stale():
+                cur = self._history[-1].generation
+                return [g for g in self._inflight if g != cur]
+
+            return self._cond.wait_for(lambda: not stale(), timeout=timeout)
+
+    def history(self) -> List[Tuple[int, str]]:
+        with self._cond:
+            return [(s.generation, s.version) for s in self._history]
+
+    # --- metrics plumbing (no-op when the registry has no MetricsRegistry) ---
+    def _gauge_generation(self, gen: int) -> None:
+        if self._metrics is not None:
+            self._metrics.gauge("serve_model_generation",
+                                help="currently published model generation"
+                                ).set(gen)
+
+    def _count(self, name: str, help_: str) -> None:
+        if self._metrics is not None:
+            self._metrics.counter(name, help=help_).inc()

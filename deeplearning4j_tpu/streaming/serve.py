@@ -1,80 +1,36 @@
 """Model-serving route — ``streaming/routes/DL4jServeRouteBuilder.java``
-equivalent: expose a trained model as an HTTP inference endpoint, optionally
-backed by the dynamic-batching ``ParallelInference`` worker (SURVEY.md
-§2.4.6).
+equivalent (compat shim): the original 80-line single-request route is now
+a thin subclass of :class:`~deeplearning4j_tpu.serve.http.ModelServer`, so
+the HTTP path gets micro-batching, deadlines, admission control, graceful
+drain, and a ``/generate`` endpoint without any change to existing callers.
 
-Endpoints:
+Endpoints (superset of the old surface):
 - POST /predict  {"ndarray": [[...]]}  → {"output": [[...]]}
-- GET  /health
+- POST /generate {"prompt": [...], "max_new_tokens": n} → {"tokens": [...]}
+- GET  /health · GET /ready · GET /models
 - GET  /metrics — Prometheus scrape (request latency histograms; see obs/)
+
+``use_parallel_inference`` is kept for signature compatibility but is
+vestigial: every request now flows through the serving engine's bucketed
+batch path (with ``use_parallel_inference=False`` the engine still
+coalesces; there is no longer an unbatched fast path to preserve, and the
+outputs are identical).
 """
 
 from __future__ import annotations
 
-import json
-
-import numpy as np
-
 from ..obs.metrics import MetricsRegistry
-from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
+from ..serve.http import ModelServer
 
 
-class InferenceRoute(JsonHTTPServerMixin):
+class InferenceRoute(ModelServer):
     def __init__(self, model, params=None, state=None, port: int = 9010,
                  host: str = "127.0.0.1", use_parallel_inference: bool = False,
                  batch_limit: int = 32, metrics: MetricsRegistry = None):
-        self.model = model
-        self.params = params if params is not None else model.params
-        self.state = state if state is not None else model.state
-        self.port = port
-        self.host = host
-        # per-endpoint latency + GET /metrics, provided by the httpd layer
-        self.metrics = metrics if metrics is not None else MetricsRegistry()
-        self._pi = None
-        if use_parallel_inference:
-            from ..parallel.inference import ParallelInference
-
-            self._pi = ParallelInference(model, params=self.params,
-                                         state=self.state,
-                                         batch_limit=batch_limit)
-
-    def _predict(self, x: np.ndarray) -> np.ndarray:
-        if self._pi is not None:
-            return np.asarray(self._pi.output(x))
-        out = self.model.output(x, self.params, self.state)
-        return np.asarray(out[0] if isinstance(out, list) else out)
-
-    def _handler(self):
-        server = self
-
-        class Handler(JsonRequestHandler):
-            owner = server
-
-            def do_GET(self):
-                if self.path == "/health":
-                    self.reply(200, {"status": "ok",
-                                     "model": type(server.model).__name__})
-                else:
-                    self.reply(404, {"error": "unknown endpoint"})
-
-            def do_POST(self):
-                try:
-                    req = self.read_json()
-                    if self.path == "/predict":
-                        x = np.asarray(req["ndarray"], np.float32)
-                        y = server._predict(x)
-                        self.reply(200, {"output": y.tolist()})
-                    else:
-                        self.reply(404, {"error": "unknown endpoint"})
-                except (KeyError, ValueError, TypeError, AttributeError,
-                        json.JSONDecodeError) as e:
-                    self.reply(400, {"error": str(e)})
-                except Exception as e:  # server must answer every request  # jaxlint: disable=broad-except
-                    self.reply(500, {"error": f"{type(e).__name__}: {e}"})
-
-        return Handler
-
-    def stop(self):
-        super().stop()
-        if self._pi is not None:
-            self._pi.shutdown()
+        buckets = tuple(b for b in (1, 2, 4, 8, 16, 32) if b <= batch_limit) \
+            or (batch_limit,)
+        super().__init__(model, params=params, state=state, host=host,
+                         port=port, batch_buckets=buckets,
+                         queue_limit=max(64, 2 * batch_limit),
+                         metrics=metrics)
+        self.use_parallel_inference = use_parallel_inference

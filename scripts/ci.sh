@@ -22,9 +22,17 @@ echo "=== jaxlint: deeplearning4j_tpu/obs/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/obs/
 echo "=== jaxlint: deeplearning4j_tpu/analysis/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/analysis/
+# serve/ is new code with no legacy debt: it must ALSO stay clean with no
+# baseline — a recompile or unlocked mutation in the request path is an
+# outage, so the serving tree gets the same zero-suppression bar as obs/.
+echo "=== jaxlint: deeplearning4j_tpu/serve/ (no baseline permitted) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/serve/
 
 echo "=== smoke trace: 5-step instrumented train ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_trace.py
+
+echo "=== smoke serve: mixed predict/generate traffic over HTTP ==="
+CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_serve.py
 
 echo "=== tier-1 tests ==="
 set -o pipefail

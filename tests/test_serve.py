@@ -1,0 +1,574 @@
+"""Tests for the serve/ subsystem (ISSUE 4).
+
+The load-bearing properties, each tested directly:
+
+- coalescing: concurrent requests SHARE device batches (batch_seq collisions);
+- bounded executables: randomized traffic compiles at most
+  ``|batch buckets| x |length buckets|`` signatures — never one per shape;
+- overload is typed, never a hang: shed at admission (ShedError), expiry at
+  dispatch (DeadlineExceededError), drain at shutdown (ServerClosingError);
+- hot-swap atomicity: one registry generation per device batch, results
+  always match the generation that ran them;
+- continuous batching: greedy token chains are bit-identical to whole-batch
+  ``nn.generation.generate`` while slots are reused across > slots requests;
+- the ParallelInference shim regressions: padded partial batches on every
+  path (incl. shutdown drain) and no truncation of oversized requests.
+"""
+
+import concurrent.futures as cf
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers import Dense, Output
+from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+from deeplearning4j_tpu.parallel import ParallelInference
+from deeplearning4j_tpu.serve import (CapacityError, ContinuousBatcher,
+                                      DeadlineExceededError, ModelRegistry,
+                                      ModelServer, ServeEngine,
+                                      ServerClosingError, ShedError)
+
+
+def _dense_model(n_in=4, n_out=3, seed=0):
+    m = Sequential(NetConfig(seed=seed),
+                   [Dense(n_out=6, activation="tanh"),
+                    Output(n_out=n_out, loss="mcxent", activation="softmax")],
+                   (n_in,))
+    m.init()
+    return m
+
+
+def _slow_forward(model, delay_s):
+    """Un-jitted forward with a host-side stall — deterministic device-time
+    inflation for queue/deadline/shed tests."""
+
+    def fwd(params, state, x):
+        time.sleep(delay_s)
+        y, _ = model.forward(params, state, x, training=False)
+        return np.asarray(y)
+
+    return fwd
+
+
+@pytest.fixture(scope="module")
+def lm():
+    from deeplearning4j_tpu.models import CausalLM
+
+    zm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=32,
+                  num_heads=4, vocab=50)
+    model = zm.build()
+    model.init()
+    return model
+
+
+class TestModelRegistry:
+    def test_generations_monotonic_and_rollback(self):
+        m = _dense_model()
+        reg = ModelRegistry(m.params, m.state, version="base")
+        p2 = jax.tree.map(lambda a: a * 2.0, m.params)
+        s2 = reg.publish(p2, version="double")
+        assert s2.generation == 2
+        s3 = reg.rollback()
+        assert s3.generation == 3  # rollback is a fresh generation...
+        assert s3.version == "base"  # ...of the previous version
+        got = np.asarray(reg.current().params["layer_0"]["w"])
+        np.testing.assert_array_equal(got,
+                                      np.asarray(m.params["layer_0"]["w"]))
+        assert [g for g, _ in reg.history()][-1] == 3
+
+    def test_publish_drain_waits_for_old_leases(self):
+        m = _dense_model()
+        reg = ModelRegistry(m.params, m.state)
+        entered, release = threading.Event(), threading.Event()
+
+        def worker():
+            with reg.lease():
+                entered.set()
+                release.wait(5)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        assert entered.wait(5)
+        reg.publish(jax.tree.map(lambda a: a + 1.0, m.params))  # non-draining
+        assert reg.drain(timeout=0.2) is False  # old lease still out
+        release.set()
+        assert reg.drain(timeout=5) is True
+        t.join(5)
+
+    def test_publish_rejects_donated_buffers(self):
+        # the trainer's step donates param buffers; a checkpoint captured by
+        # reference would 500 at request time — publish must fail fast
+        import jax.numpy as jnp
+
+        m = _dense_model()
+        reg = ModelRegistry(m.params, m.state)
+        leaf = jnp.ones(8, jnp.float32)
+        jax.jit(lambda z: z * 2, donate_argnums=(0,))(leaf)  # deletes leaf
+        assert leaf.is_deleted()
+        with pytest.raises(ValueError, match="donated"):
+            reg.publish({"layer_0": {"w": leaf}})
+
+    def test_history_bounded(self):
+        m = _dense_model()
+        reg = ModelRegistry(m.params, m.state, keep=3)
+        for _ in range(6):
+            reg.publish(m.params)
+        assert len(reg.history()) == 3
+
+
+class TestServeEngine:
+    def test_predict_matches_direct(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(2, 4, 8))
+        try:
+            x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+            np.testing.assert_allclose(eng.predict(x),
+                                       np.asarray(m.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            one = eng.predict(x[0])  # single example grows a batch dim
+            np.testing.assert_allclose(one[0], np.asarray(m.output(x))[0],
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            eng.shutdown()
+
+    def test_concurrent_requests_coalesce(self):
+        m = _dense_model()
+        # long window so concurrent submits land in the same device batch
+        eng = ServeEngine(m, batch_buckets=(1, 2, 4, 8), max_wait_ms=60.0)
+        try:
+            x = np.random.RandomState(0).randn(8, 1, 4).astype(np.float32)
+            with cf.ThreadPoolExecutor(8) as ex:
+                handles = list(ex.map(lambda i: eng.submit(x[i]), range(8)))
+            for h in handles:
+                h.wait()
+            seqs = [h.batch_seq for h in handles]
+            batches = len(set(seqs))
+            assert batches < len(handles), \
+                f"no coalescing: {len(handles)} requests -> {batches} batches"
+            # at least one batch carried >= 2 requests
+            assert max(seqs.count(s) for s in set(seqs)) >= 2
+            assert eng.metrics.counter("serve_batches_total").value == batches
+        finally:
+            eng.shutdown()
+
+    def test_compile_count_bounded_under_randomized_traffic(self):
+        """Acceptance: executables <= |batch buckets| x |length buckets|."""
+        m = _dense_model()  # Dense acts on the last axis: (B, T, 4) works
+        batch_buckets, length_buckets = (2, 4), (8, 16)
+        eng = ServeEngine(m, batch_buckets=batch_buckets,
+                          length_buckets=length_buckets, max_wait_ms=1.0)
+        try:
+            rng = np.random.RandomState(7)
+            cases = [(int(rng.randint(1, 5)), int(rng.randint(1, 17)))
+                     for _ in range(25)]
+
+            def run(case):
+                rows, t = case
+                x = rng.randn(rows, t, 4).astype(np.float32)
+                return x, eng.predict(x)
+
+            with cf.ThreadPoolExecutor(4) as ex:
+                outs = list(ex.map(run, cases))
+            for x, y in outs:
+                assert y.shape[:2] == x.shape[:2]  # un-padded back to true T
+                np.testing.assert_allclose(y, np.asarray(m.output(x)),
+                                           rtol=1e-4, atol=1e-5)
+            limit = len(batch_buckets) * len(length_buckets)
+            sigs = eng.compile_signatures
+            assert len(sigs) <= limit, f"{len(sigs)} sigs > {limit}: {sigs}"
+            assert eng.metrics.counter(
+                "serve_compile_misses_total",
+                {"component": "engine"}).value == len(sigs)
+            # every signature is an exact (bucket, padded-length) pair
+            for bucket, shape, _ in sigs:
+                assert bucket in batch_buckets and shape[0] in length_buckets
+        finally:
+            eng.shutdown()
+
+    def test_over_length_is_typed_error(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(2,), length_buckets=(8,))
+        try:
+            with pytest.raises(CapacityError):
+                eng.predict(np.zeros((1, 9, 4), np.float32))
+        finally:
+            eng.shutdown()
+
+    def test_deadline_expiry_is_typed_error_not_hang(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1, 4), max_wait_ms=1.0,
+                          forward=_slow_forward(m, 0.08))
+        try:
+            x = np.zeros((1, 4), np.float32)
+            r1 = eng.submit(x)          # occupies the device ~80ms
+            time.sleep(0.02)            # ensure r1's batch has dispatched
+            r2 = eng.submit(x, timeout_ms=5.0)  # expires while queued
+            t0 = time.perf_counter()
+            with pytest.raises(DeadlineExceededError):
+                r2.wait()
+            assert time.perf_counter() - t0 < 5.0  # typed error, not a hang
+            r1.wait()  # undeadlined request unaffected
+            assert eng.metrics.counter(
+                "serve_deadline_expired_total").value >= 1
+        finally:
+            eng.shutdown()
+
+    def test_shed_past_queue_limit_zero_drops_below(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1, 2), max_wait_ms=1.0,
+                          queue_limit=2, forward=_slow_forward(m, 0.05))
+        try:
+            x = np.zeros((1, 4), np.float32)
+            handles, sheds = [], 0
+            for _ in range(12):  # flood far past queue_limit
+                try:
+                    handles.append(eng.submit(x))
+                except ShedError as e:
+                    assert e.cause == "queue_full"
+                    sheds += 1
+            assert sheds > 0, "queue never shed past its limit"
+            for h in handles:  # every admitted request completes
+                assert h.wait().shape == (1, 3)
+            assert eng.metrics.counter(
+                "serve_shed_total", {"cause": "queue_full"}).value == sheds
+            # sub-capacity traffic afterwards: zero dropped responses
+            outs = [eng.predict(x) for _ in range(3)]
+            assert all(o.shape == (1, 3) for o in outs)
+        finally:
+            eng.shutdown()
+
+    def test_hot_swap_under_load_never_mixes_generations(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1, 2, 4, 8), max_wait_ms=10.0,
+                          queue_limit=512)
+        try:
+            params_by_gen = {1: eng.registry.current().params}
+            stop = threading.Event()
+
+            def publisher():
+                g = 1
+                while not stop.is_set() and g < 6:
+                    time.sleep(0.01)
+                    scaled = jax.tree.map(
+                        lambda a, k=g: a * (1.0 + 0.5 * k),
+                        params_by_gen[1])
+                    snap = eng.registry.publish(scaled, drain=True)
+                    params_by_gen[snap.generation] = scaled
+                    g = snap.generation
+
+            pub = threading.Thread(target=publisher, daemon=True)
+            pub.start()
+            x = np.random.RandomState(3).randn(1, 4).astype(np.float32)
+            with cf.ThreadPoolExecutor(8) as ex:
+                handles = list(ex.map(lambda i: eng.submit(x), range(60)))
+            def done(h):  # wait() first: the batch run sets seq/generation
+                out = h.wait()
+                return h.batch_seq, h.generation, out
+
+            results = [done(h) for h in handles]
+            stop.set()
+            pub.join(10)
+            by_batch = {}
+            for seq, gen, out in results:
+                by_batch.setdefault(seq, set()).add(gen)
+                # the result matches the generation that claims to have run it
+                want = np.asarray(m.output(x, params_by_gen[gen], m.state))
+                np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+            for seq, gens in by_batch.items():
+                assert len(gens) == 1, \
+                    f"batch {seq} mixed params generations {gens}"
+        finally:
+            eng.shutdown()
+
+    def test_graceful_drain_completes_inflight(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1, 2), max_wait_ms=1.0,
+                          queue_limit=64, forward=_slow_forward(m, 0.02))
+        try:
+            x = np.random.RandomState(1).randn(1, 4).astype(np.float32)
+            handles = [eng.submit(x) for _ in range(6)]
+        finally:
+            eng.shutdown(drain=True)  # returns only after the queue drains
+        for h in handles:
+            assert h.wait().shape == (1, 3)  # no errors, no hangs
+        with pytest.raises(ServerClosingError):
+            eng.submit(x)
+        assert eng.metrics.counter(
+            "serve_shed_total", {"cause": "shutting_down"}).value == 1
+
+    def test_shutdown_without_drain_errors_pending(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1,), max_wait_ms=1.0,
+                          queue_limit=64, forward=_slow_forward(m, 0.05))
+        handles = [eng.submit(np.zeros((1, 4), np.float32))
+                   for _ in range(5)]
+        eng.shutdown(drain=False)
+        outcomes = []
+        for h in handles:
+            try:
+                h.wait()
+                outcomes.append("ok")
+            except ServerClosingError:
+                outcomes.append("closed")
+        assert "closed" in outcomes  # pending work answered, not hung
+
+
+class TestParallelInferenceShim:
+    """The ISSUE-4 satellite: partial-batch padding on every path and the
+    recompile-count regression, via the engine's signature tracking."""
+
+    def test_partial_batch_pads_even_on_shutdown_drain(self):
+        m = _dense_model()
+        pi = ParallelInference(m, batch_limit=8, buckets=(4, 8),
+                               max_wait_ms=1.0)
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        want = np.asarray(m.output(x))
+        req = pi.engine.submit(x)   # 3 rows: must pad to bucket 4
+        pi.shutdown()               # drain path runs the same padded code
+        np.testing.assert_allclose(req.wait(), want, rtol=1e-5, atol=1e-6)
+        for bucket, _, _ in pi.engine.compile_signatures:
+            assert bucket in (4, 8), \
+                f"un-padded batch shape {bucket} escaped to the device"
+
+    def test_oversized_request_not_truncated(self):
+        # seed bug: 10 rows with largest bucket 8 were cut to 8 and the
+        # tail requests got empty slices back
+        m = _dense_model()
+        pi = ParallelInference(m, batch_limit=8, buckets=(4, 8),
+                               max_wait_ms=1.0)
+        try:
+            x = np.random.RandomState(2).randn(10, 4).astype(np.float32)
+            out = pi.output(x)
+            assert out.shape == (10, 3)
+            np.testing.assert_allclose(out, np.asarray(m.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            pi.shutdown()
+
+    def test_recompile_count_regression(self):
+        """Compile-miss counting idiom from obs/ (_batch_sig-style): a new
+        signature == one XLA compile; arbitrary request sizes must stay
+        within the bucket set."""
+        m = _dense_model()
+        pi = ParallelInference(m, batch_limit=8, buckets=(1, 2, 4, 8),
+                               max_wait_ms=0.5)
+        try:
+            rng = np.random.RandomState(4)
+            for rows in (1, 3, 2, 7, 5, 8, 1, 6, 4):
+                x = rng.randn(rows, 4).astype(np.float32)
+                assert pi.output(x).shape == (rows, 3)
+            n_sigs = len(pi.engine.compile_signatures)
+            assert n_sigs <= 4
+            assert pi.engine.metrics.counter(
+                "serve_compile_misses_total",
+                {"component": "engine"}).value == n_sigs
+        finally:
+            pi.shutdown()
+
+    def test_update_model_swaps_atomically(self):
+        m = _dense_model()
+        pi = ParallelInference(m, batch_limit=4, max_wait_ms=0.5)
+        try:
+            x = np.random.RandomState(5).randn(2, 4).astype(np.float32)
+            before = pi.output(x)
+            p2 = jax.tree.map(lambda a: a * 3.0, pi.params)
+            pi.update_model(p2)
+            np.testing.assert_allclose(
+                pi.output(x), np.asarray(m.output(x, p2, m.state)),
+                rtol=1e-5, atol=1e-6)
+            assert not np.allclose(before, pi.output(x))
+            assert pi.registry.generation == 2
+        finally:
+            pi.shutdown()
+
+
+class TestContinuousBatcher:
+    def test_greedy_matches_lockstep_generate(self, lm):
+        from deeplearning4j_tpu.nn.generation import generate
+
+        cb = ContinuousBatcher(lm, slots=2, capacity=16, seed=0)
+        try:
+            rng = np.random.RandomState(0)
+            for tp in (8, 5):  # exact-bucket AND padded-prefill prompts
+                prompt = rng.randint(0, 50, (tp,)).astype(np.int32)
+                got = cb.generate(prompt, 6, temperature=0.0)
+                want = generate(lm, prompt[None], 6, temperature=0.0)[0]
+                assert np.array_equal(got, want), (got, want)
+        finally:
+            cb.shutdown()
+
+    def test_slot_reuse_serves_more_requests_than_slots(self, lm):
+        from deeplearning4j_tpu.nn.generation import generate
+
+        cb = ContinuousBatcher(lm, slots=2, capacity=16, queue_limit=16,
+                               seed=0)
+        try:
+            rng = np.random.RandomState(1)
+            prompts = [rng.randint(0, 50, (int(rng.randint(3, 9)),)
+                                   ).astype(np.int32) for _ in range(5)]
+            with cf.ThreadPoolExecutor(5) as ex:
+                outs = list(ex.map(
+                    lambda p: cb.generate(p, 5, temperature=0.0), prompts))
+            for p, o in zip(prompts, outs):
+                want = generate(lm, p[None], 5, temperature=0.0)[0]
+                assert np.array_equal(o, want)
+            assert cb.peak_active_slots <= 2  # never over-subscribed
+            m = cb.metrics
+            assert m.counter("serve_gen_admitted_total").value == 5
+            assert m.counter("serve_gen_completed_total").value == 5
+        finally:
+            cb.shutdown()
+
+    def test_eos_frees_slot_early(self, lm):
+        cb = ContinuousBatcher(lm, slots=1, capacity=16, seed=0)
+        try:
+            prompt = np.random.RandomState(2).randint(
+                0, 50, (6,)).astype(np.int32)
+            free_run = cb.generate(prompt, 5, temperature=0.0)
+            eos = int(free_run[0])
+            stopped = cb.generate(prompt, 5, temperature=0.0, eos_id=eos)
+            assert stopped.tolist() == [eos]  # stopped at the first token
+        finally:
+            cb.shutdown()
+
+    def test_compile_count_bounded(self, lm):
+        cb = ContinuousBatcher(lm, slots=2, capacity=16,
+                               prompt_buckets=(8, 16), seed=0)
+        try:
+            rng = np.random.RandomState(3)
+            for tp in (3, 5, 8, 11, 13, 4):
+                cb.generate(rng.randint(0, 50, (tp,)).astype(np.int32), 2,
+                            temperature=0.0)
+            sigs = cb.compile_signatures
+            # <= |prompt buckets| prefills + ONE decode executable
+            assert len(sigs) <= 3, sigs
+            assert ("decode", 2) in sigs
+        finally:
+            cb.shutdown()
+
+    def test_capacity_and_contract_errors_are_typed(self, lm):
+        cb = ContinuousBatcher(lm, slots=1, capacity=16, seed=0)
+        try:
+            with pytest.raises(CapacityError):
+                cb.submit(np.zeros(14, np.int32), 8)  # 14 + 8 > 16
+        finally:
+            cb.shutdown()
+        # non-token model is rejected up front, not at first request
+        with pytest.raises(ValueError, match="embedding-front"):
+            ContinuousBatcher(_dense_model(), slots=1, capacity=8)
+
+    def test_drain_completes_inflight_generations(self, lm):
+        cb = ContinuousBatcher(lm, slots=2, capacity=16, queue_limit=16,
+                               seed=0)
+        rng = np.random.RandomState(4)
+        reqs = [cb.submit(rng.randint(0, 50, (4,)).astype(np.int32), 4,
+                          temperature=0.0) for _ in range(4)]
+        cb.shutdown(drain=True)
+        for r in reqs:
+            assert r.wait().shape == (4,)
+        with pytest.raises(ServerClosingError):
+            cb.submit(np.zeros(4, np.int32), 2)
+
+
+class TestModelServerHTTP:
+    def _post(self, port, path, body, timeout=30):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def test_predict_generate_health_metrics(self, lm):
+        from deeplearning4j_tpu.nn.generation import generate
+
+        srv = ModelServer(lm, port=0, input_dtype=np.int32, gen_slots=2,
+                          gen_capacity=16).start()
+        try:
+            rng = np.random.RandomState(0)
+            ids = rng.randint(0, 50, (2, 8))
+            out = self._post(srv.port, "/predict", {"ndarray": ids.tolist()})
+            want = np.asarray(lm.output(ids.astype(np.int32)))
+            np.testing.assert_allclose(np.asarray(out["output"]), want,
+                                       rtol=1e-4, atol=1e-5)
+            assert out["generation"] == 1
+
+            prompt = rng.randint(0, 50, (6,)).tolist()
+            gen = self._post(srv.port, "/generate",
+                             {"prompt": prompt, "max_new_tokens": 4,
+                              "temperature": 0.0})
+            want_t = generate(lm, np.asarray([prompt], np.int32), 4,
+                              temperature=0.0)[0]
+            assert gen["tokens"] == want_t.tolist()
+
+            base = f"http://127.0.0.1:{srv.port}"
+            health = json.loads(urllib.request.urlopen(
+                base + "/health", timeout=10).read())
+            assert health["status"] == "ok" and health["generation"] == 1
+            ready = json.loads(urllib.request.urlopen(
+                base + "/ready", timeout=10).read())
+            assert ready["status"] == "ready"
+            scrape = urllib.request.urlopen(
+                base + "/metrics", timeout=10).read().decode()
+            for name in ("serve_queue_depth", "serve_batches_total",
+                         "serve_batch_occupancy", "serve_queue_seconds",
+                         "serve_device_seconds", "serve_gen_tokens_total",
+                         "serve_compile_misses_total", "http_request_seconds"):
+                assert name in scrape, f"{name} missing from /metrics"
+        finally:
+            srv.stop()
+
+    def test_bad_payload_400_overload_503(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1,), max_wait_ms=1.0,
+                          queue_limit=1, forward=_slow_forward(m, 0.05))
+        srv = ModelServer(m, port=0, engine=eng).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(srv.port, "/predict", {"x": 1})
+            assert ei.value.code == 400
+
+            codes = []
+
+            def fire(_):
+                try:
+                    self._post(srv.port, "/predict",
+                               {"ndarray": [[0.0] * 4]}, timeout=30)
+                    return 200
+                except urllib.error.HTTPError as e:
+                    return (e.code, json.loads(e.read())["cause"])
+
+            with cf.ThreadPoolExecutor(10) as ex:
+                codes = list(ex.map(fire, range(10)))
+            assert len(codes) == 10  # zero hangs: every request answered
+            assert 200 in codes
+            assert (503, "queue_full") in codes, codes
+        finally:
+            srv.stop()
+
+    def test_graceful_drain_over_http(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1, 2, 4), max_wait_ms=30.0,
+                          queue_limit=64, forward=_slow_forward(m, 0.03))
+        srv = ModelServer(m, port=0, engine=eng).start()
+        results = []
+
+        def fire(_):
+            results.append(self._post(srv.port, "/predict",
+                                      {"ndarray": [[0.1] * 4]}, timeout=30))
+
+        threads = [threading.Thread(target=fire, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)      # let every request get admitted
+        srv.stop(drain=True)  # flips readiness, drains, then closes
+        for t in threads:
+            t.join(30)
+        assert len(results) == 4  # all in-flight requests completed with 200
+        for r in results:
+            assert len(r["output"][0]) == 3
