@@ -242,14 +242,106 @@ def _breadth(deadline: float, on_tpu: bool) -> dict:
     return out
 
 
+def _bench_chunked_prefill(model, seconds):
+    """Mixed-traffic inter-token latency: chunked vs whole-prompt prefill.
+
+    A few closed-loop streaming decoders measure per-token gaps while a
+    burst client keeps ramming near-capacity prompts in. With whole-prompt
+    prefill each long prompt monopolizes the device and every in-flight
+    decode stalls behind it — the p99 inter-token gap is the cost of the
+    LONGEST prefill. Chunked prefill bounds that stall at one chunk.
+    Also tracks the paged pool's peak live-KV bytes so the O(live tokens)
+    HBM claim is captured next to the latency it buys."""
+    import concurrent.futures as cf
+    import threading
+
+    from deeplearning4j_tpu.serve import ContinuousBatcher, ServeError
+    from deeplearning4j_tpu.serve.paged import block_bytes, blocks_needed
+
+    per_block = block_bytes(model, 16, np.float32)
+
+    def run(prefill_chunk):
+        cb = ContinuousBatcher(model, slots=4, capacity=128, block_size=16,
+                               prompt_buckets=(16, 32, 64, 96),
+                               prefill_chunk=prefill_chunk, queue_limit=64,
+                               seed=0)
+        cb.generate(np.arange(1, 9, dtype=np.int32), 2,
+                    temperature=0.0)  # warm the executables untimed
+        gaps, lock, stop = [], threading.Lock(), threading.Event()
+        peak = {"blocks": 0, "bytes": 0}
+
+        def decoder(i):
+            r = np.random.RandomState(100 + i)
+            while not stop.is_set():
+                p = r.randint(0, 256, (8,)).astype(np.int32)
+                last, first = time.perf_counter(), True
+                try:
+                    for _ in cb.stream(p, 24, temperature=0.0):
+                        now = time.perf_counter()
+                        if not first:  # gap 0 is TTFT, not inter-token
+                            with lock:
+                                gaps.append((now - last) * 1e3)
+                        last, first = now, False
+                except ServeError:
+                    return
+
+        def burster():
+            r = np.random.RandomState(7)
+            while not stop.is_set():
+                p = r.randint(0, 256, (96,)).astype(np.int32)
+                try:
+                    cb.generate(p, 4, temperature=0.0)
+                except ServeError:
+                    return
+
+        def poller():
+            while not stop.is_set():
+                s = cb.kv_block_stats()
+                peak["blocks"] = max(peak["blocks"], s["blocks_used"])
+                peak["bytes"] = max(peak["bytes"], s["live_bytes"])
+                time.sleep(0.002)
+
+        workers = ([threading.Thread(target=decoder, args=(i,))
+                    for i in range(3)]
+                   + [threading.Thread(target=burster),
+                      threading.Thread(target=poller)])
+        for w in workers:
+            w.start()
+        time.sleep(seconds)
+        stop.set()
+        for w in workers:
+            w.join(60)
+        stats = cb.kv_block_stats()
+        sigs = sorted(map(str, cb.compile_signatures))
+        cb.shutdown()
+        lat = np.sort(np.asarray(gaps)) if gaps else np.asarray([0.0])
+        return {
+            "prefill_chunk": prefill_chunk,
+            "inter_token_p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "inter_token_p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "tokens_streamed": len(gaps),
+            "kv_peak_blocks_used": peak["blocks"],
+            "kv_peak_live_bytes": peak["bytes"],
+            "kv_blocks_total": stats["blocks_total"],
+            # what the dense layout would reserve for the same 4 slots
+            "kv_dense_equiv_bytes": 4 * blocks_needed(128, 16) * per_block,
+            "compile_signatures": sigs,
+        }
+
+    chunked = run(64)
+    whole = run(None)
+    return {"chunked": chunked, "unchunked": whole}
+
+
 def _bench_serving():
     """``python bench.py --serve``: serving-path latency/throughput.
 
     Closed-loop clients fire single-row predicts at a ServeEngine (the
     ParallelInference/ModelServer hot path minus HTTP framing) plus greedy
-    generations at a ContinuousBatcher on a small CausalLM. Prints ONE JSON
-    line: p50/p99 request latency (ms) and sustained req/s, with the
-    compile counts that bound serving-tail latency in the detail block.
+    generations at a ContinuousBatcher on a small CausalLM. Then a mixed
+    prompt-burst scenario compares chunked vs whole-prompt prefill on the
+    paged batcher (p99 inter-token latency + peak live-KV bytes). Prints
+    ONE JSON line and writes the full record to BENCH_serve_r01.json.
     Env: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_SECONDS (5),
     BENCH_SERVE_GENERATES (8).
     """
@@ -307,8 +399,10 @@ def _bench_serving():
     gen_wall = time.perf_counter() - g0
     cb.shutdown()
 
+    prefill = _bench_chunked_prefill(model, seconds)
+
     lat = np.sort(np.asarray(lat_ms))
-    print(json.dumps({
+    headline = {
         "metric": "serve_predict_requests_per_sec",
         "value": round(total / wall, 2),
         "unit": "req/s",
@@ -319,10 +413,17 @@ def _bench_serving():
             "engine_compiles": len(eng.compile_signatures),
             "gen_tokens_per_sec": round(toks / gen_wall, 2),
             "gen_compiles": len(cb.compile_signatures),
+            "chunked_prefill": prefill,
             "device": str(dev.device_kind),
             "captured": time.strftime("%Y-%m-%d"),
         },
-    }), flush=True)
+    }
+    print(json.dumps(headline), flush=True)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_serve_r01.json")
+    with open(out_path, "w") as f:
+        json.dump(headline, f, indent=1)
+    print(f"bench serve -> {out_path}", file=sys.stderr)
 
 
 def main():

@@ -46,10 +46,105 @@ _TOKEN_LOCAL = (ActivationLayer, AlphaDropout, Dense, DropoutLayer,
                 GaussianDropout, GaussianNoise, LayerNorm, PReLU, RMSNorm)
 
 
+# --------------------------------------------------------------------------
+# KV-cache layout contract
+#
+# Attention layers decode against one of two cache layouts, both plain
+# pytrees so they trace/vmap/donate like any other operand:
+#
+# dense  {"k": (B, C, Hkv, hd), "v": (B, C, Hkv, hd)}
+#     Position p of row b lives at [b, p]. C is the fixed capacity; HBM
+#     cost is O(B * C) regardless of live tokens.
+#
+# paged  {"k_pool": (N, bs, Hkv, hd), "v_pool": (N, bs, Hkv, hd),
+#         "tables": (B, maxb) int32}
+#     Position p of row b lives at pool[tables[b, p // bs], p % bs].
+#     The pool is shared across rows; ``tables`` maps each row's logical
+#     blocks to physical blocks, so HBM cost is O(allocated blocks) — the
+#     allocator (serve/paged.py) hands blocks out on demand. Physical
+#     block 0 is the TRASH block: unallocated table entries point at it,
+#     so writes past a row's live region land there harmlessly and reads
+#     of it are always causally masked. Appends whose logical block index
+#     falls past the table (right-padding overflow) are also routed to
+#     block 0.
+#
+# ``cache_append`` / ``cache_read`` are the only two operations either
+# layout supports; everything above them (masking, rope, GQA) is layout-
+# agnostic. ``pos`` may be a scalar (whole batch at one offset — prefill,
+# lockstep decode) or a (B,) vector (per-row offsets — continuous-batching
+# decode, where every slot sits at its own position).
+#
+# Invariant both layouts share: position p is WRITTEN before it is ever
+# unmasked-READ (prefill writes 0..T-1 then reads causally; decode writes
+# p then attends with mask <= p), so stale garbage beyond the live length
+# is never observable.
+# --------------------------------------------------------------------------
+
+
+def _pos_vec(pos):
+    """None if ``pos`` is a scalar offset, else the (B,) per-row vector."""
+    return pos if getattr(pos, "ndim", 0) == 1 else None
+
+
+def cache_append(cache, k, v, pos):
+    """Write a chunk's keys/values at absolute offset ``pos``.
+
+    ``k``/``v``: (B, Tq, Hkv, hd); ``pos``: scalar or (B,) vector. Returns
+    the updated cache (same layout, same shapes — never shape-changing, so
+    appends inside jit never trigger a recompile)."""
+    if "k_pool" in cache:  # paged
+        kp, vp, tables = cache["k_pool"], cache["v_pool"], cache["tables"]
+        B, Tq = k.shape[:2]
+        bs = kp.shape[1]
+        maxb = tables.shape[1]
+        pv = _pos_vec(pos)
+        p = pv if pv is not None else jnp.broadcast_to(
+            jnp.asarray(pos, jnp.int32), (B,))
+        wpos = p[:, None] + jnp.arange(Tq, dtype=jnp.int32)[None]  # (B, Tq)
+        blk, off = wpos // bs, wpos % bs
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        # logical blocks past the table (right-padded garbage) -> trash 0
+        phys = jnp.where(blk < maxb,
+                         tables[rows, jnp.minimum(blk, maxb - 1)], 0)
+        kp = kp.at[phys, off].set(k.astype(kp.dtype))
+        vp = vp.at[phys, off].set(v.astype(vp.dtype))
+        return {"k_pool": kp, "v_pool": vp, "tables": tables}
+    pv = _pos_vec(pos)
+    if pv is None:
+        ck = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
+                                      (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
+                                      (0, pos, 0, 0))
+    else:
+        B, Tq = k.shape[:2]
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        wpos = pv[:, None] + jnp.arange(Tq, dtype=jnp.int32)[None]
+        ck = cache["k"].at[rows, wpos].set(k.astype(cache["k"].dtype))
+        cv = cache["v"].at[rows, wpos].set(v.astype(cache["v"].dtype))
+    return {"k": ck, "v": cv}
+
+
+def cache_read(cache):
+    """Materialize the cache as (K, V), each (B, L, Hkv, hd) in logical
+    position order. Dense: the buffers themselves (L = C, no copy). Paged:
+    a block-table gather (L = maxb * bs); entries past a row's live length
+    are garbage the caller MUST mask causally (cache_append's invariant
+    guarantees every position <= the current offset holds real data)."""
+    if "k_pool" in cache:
+        kp, tables = cache["k_pool"], cache["tables"]
+        B, maxb = tables.shape
+        bs, Hkv, hd = kp.shape[1:]
+        ck = kp[tables].reshape(B, maxb * bs, Hkv, hd)
+        cv = cache["v_pool"][tables].reshape(B, maxb * bs, Hkv, hd)
+        return ck, cv
+    return cache["k"], cache["v"]
+
+
 def _mha_decode(num_heads: int, params, x, cache, pos, *, rope=False,
                 rope_base=10000.0, num_kv_heads=None, window=None):
     """Decode a query chunk ``x`` (B, Tq, D) at absolute offset ``pos``
-    against a KV cache {"k","v"}: (B, C, Hkv, hd). Returns (y, new_cache).
+    (scalar, or (B,) per-row) against a KV cache in either layout (see the
+    layout contract above). Returns (y, new_cache).
     Attention is causal by construction — the ``valid`` mask lets token t
     see cache slots 0..pos+t; generate() rejects non-causal attention
     layers up front (they cannot be decoded incrementally). With ``rope``,
@@ -69,23 +164,33 @@ def _mha_decode(num_heads: int, params, x, cache, pos, *, rope=False,
     q = q.reshape(B, Tq, H, hd)
     k = k.reshape(B, Tq, Hkv, hd)
     v = v.reshape(B, Tq, Hkv, hd)
+    pv = _pos_vec(pos)
     if rope:
-        abs_pos = pos + jnp.arange(Tq)
+        if pv is None:
+            abs_pos = pos + jnp.arange(Tq)
+        else:
+            abs_pos = pv[:, None] + jnp.arange(Tq)[None]  # (B, Tq)
         q = rope_rotate(q, abs_pos, rope_base)
         k = rope_rotate(k, abs_pos, rope_base)
-    ck = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
-                                  (0, pos, 0, 0))
-    cv = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
-                                  (0, pos, 0, 0))
+    cache = cache_append(cache, k, v, pos)
+    ck, cv = cache_read(cache)
     C = ck.shape[1]
     scale = 1.0 / np.sqrt(hd)
-    qpos = pos + jnp.arange(Tq)[:, None]
-    valid = jnp.arange(C)[None, :] <= qpos  # (Tq, C)
-    if window is not None:
-        # sliding window: only the last `window` cache slots are visible
-        # (cache stays full-capacity; the band mask honors the training
-        # semantics — a ring-buffer cache is a future memory optimization)
-        valid = valid & (qpos - jnp.arange(C)[None, :] < window)
+    if pv is None:
+        qpos = pos + jnp.arange(Tq)[:, None]
+        valid = jnp.arange(C)[None, :] <= qpos  # (Tq, C)
+        if window is not None:
+            # sliding window: only the last `window` cache slots are visible
+            # (cache stays full-capacity; the band mask honors the training
+            # semantics — a ring-buffer cache is a future memory optimization)
+            valid = valid & (qpos - jnp.arange(C)[None, :] < window)
+        vmask, vmask_g = valid[None, None], valid[None, None, None]
+    else:
+        qpos = pv[:, None, None] + jnp.arange(Tq)[None, :, None]  # (B,Tq,1)
+        valid = jnp.arange(C)[None, None, :] <= qpos  # (B, Tq, C)
+        if window is not None:
+            valid = valid & (qpos - jnp.arange(C)[None, None, :] < window)
+        vmask, vmask_g = valid[:, None], valid[:, None, None]
     if Hkv != H:
         # grouped einsum: query heads fold into (Hkv, G) so the cache is
         # consumed at Hkv heads directly — repeating it to H would
@@ -95,20 +200,38 @@ def _mha_decode(num_heads: int, params, x, cache, pos, *, rope=False,
         qg = q.reshape(B, Tq, Hkv, G, hd)
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck,
                             preferred_element_type=jnp.float32) * scale
-        scores = jnp.where(valid[None, None, None], scores, -1e30)
+        scores = jnp.where(vmask_g, scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
         y = jnp.einsum("bhgqk,bkhd->bqhgd", w, cv).reshape(B, Tq, D)
     else:
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
                             preferred_element_type=jnp.float32) * scale
-        scores = jnp.where(valid[None, None], scores, -1e30)
+        scores = jnp.where(vmask, scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
         y = jnp.einsum("bhqk,bkhd->bqhd", w, cv).reshape(B, Tq, D)
     y = y @ params["w_o"] + params["b_o"]
-    return y, {"k": ck, "v": cv}
+    return y, cache
 
 
-def _init_caches(model: Sequential, batch: int, capacity: int, dtype):
+def cache_spec(model: Sequential):
+    """The KV-cached attention layers of ``model`` as
+    ``[(layer_key, kv_heads, head_dim), ...]`` — everything a cache
+    builder (serve/paged.py block pools, external runtimes) needs without
+    walking layer internals. Recurrent carries are NOT listed: they are
+    opaque layer-owned state with no append/read contract."""
+    spec = []
+    for i, layer in enumerate(model.layers):
+        if isinstance(layer, (TransformerEncoderBlock, MultiHeadAttention)):
+            d = model._shapes[i][-1]
+            hd = d // layer.num_heads
+            hkv = layer.num_kv_heads or layer.num_heads  # GQA: smaller cache
+            spec.append((_layer_key(i, layer), hkv, hd))
+    return spec
+
+
+def init_caches(model: Sequential, batch: int, capacity: int, dtype):
+    """Dense-layout caches for every attention layer (+ recurrent carries).
+    For the paged layout, build pools from :func:`cache_spec` instead."""
     caches: Dict[str, Any] = {}
     for i, layer in enumerate(model.layers):
         k = _layer_key(i, layer)
@@ -123,11 +246,17 @@ def _init_caches(model: Sequential, batch: int, capacity: int, dtype):
     return caches
 
 
-def _decode_forward(model: Sequential, params, state, x, caches, pos):
+_init_caches = init_caches  # back-compat alias (pre-ISSUE-5 internal name)
+
+
+def decode_forward(model: Sequential, params, state, x, caches, pos):
     """Run one decode chunk through the stack. ``x``: (B, Tq) int ids or
-    (B, Tq, F) features at absolute offset ``pos``; returns
-    (logits (B, Tq, V), new_caches). The final Output layer contributes its
-    PRE-activation (logits) — sampling applies temperature in logit space."""
+    (B, Tq, F) features at absolute offset ``pos`` — a scalar, or a (B,)
+    vector when every row sits at its own offset (continuous batching);
+    returns (logits (B, Tq, V), new_caches). ``caches`` entries may be
+    dense or paged (see the layout contract above). The final Output layer
+    contributes its PRE-activation (logits) — sampling applies temperature
+    in logit space."""
     cdt = DTYPES[model.config.compute_dtype] if model.config.compute_dtype else None
     if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating):
         x = x.astype(cdt)
@@ -158,8 +287,14 @@ def _decode_forward(model: Sequential, params, state, x, caches, pos):
                                     window=layer.window)
         elif isinstance(layer, PositionalEmbedding):
             Tq = x.shape[1]
-            x = x + lax.dynamic_slice(p["pos"], (pos, 0),
-                                      (Tq, p["pos"].shape[1]))
+            pv = _pos_vec(pos)
+            if pv is None:
+                x = x + lax.dynamic_slice(p["pos"], (pos, 0),
+                                          (Tq, p["pos"].shape[1]))
+            else:  # per-row offsets; take() clips garbage positions past
+                # max_len (they are causally masked / discarded anyway)
+                idx = pv[:, None] + jnp.arange(Tq, dtype=jnp.int32)[None]
+                x = x + jnp.take(p["pos"], idx, axis=0)
         elif isinstance(layer, RecurrentLayer):
             x, new[k] = layer.apply_sequence(p, x, new[k])
         elif isinstance(layer, Output):  # incl. RnnOutput/CenterLossOutput
@@ -170,6 +305,9 @@ def _decode_forward(model: Sequential, params, state, x, caches, pos):
     if cdt is not None:
         x = x.astype(jnp.float32)
     return x, new
+
+
+_decode_forward = decode_forward  # back-compat alias (pre-ISSUE-5 name)
 
 
 def sample_logits(logits, rng, temperature: float = 1.0,
@@ -237,7 +375,7 @@ def generate(model: Sequential, prompt, max_new_tokens: int, *,
     # rng=None each call derives its stream from ``seed`` (deterministic,
     # caller-controlled — never a library-internal constant key)
     rng = rng if rng is not None else jax.random.PRNGKey(seed)
-    caches = _init_caches(model, B, capacity, model.dtype)
+    caches = init_caches(model, B, capacity, model.dtype)
 
     def embed(tok):  # (B,) int -> next input chunk
         if onehot:
@@ -245,14 +383,14 @@ def generate(model: Sequential, prompt, max_new_tokens: int, *,
         return tok[:, None].astype(prompt.dtype)
 
     def run(params, state, prompt, rng):
-        logits, c = _decode_forward(model, params, state, prompt, caches, 0)
+        logits, c = decode_forward(model, params, state, prompt, caches, 0)
         last = logits[:, -1]
 
         def body(carry, i):
             c, last, rng = carry
             rng, k1 = jax.random.split(rng)
             tok = sample_logits(last, k1, temperature, top_k)
-            lg, c = _decode_forward(model, params, state, embed(tok), c,
+            lg, c = decode_forward(model, params, state, embed(tok), c,
                                     Tp + i)
             return (c, lg[:, -1], rng), tok
 

@@ -46,7 +46,9 @@ def dot_product_attention(q, k, v, *, mask=None, scale=None,
 
 def rope_rotate(x, positions, base: float = 10000.0):
     """Rotary position embedding (RoFormer) on (B, T, H, Dh) at absolute
-    ``positions`` (T,). The long-context position scheme: no learned table
+    ``positions`` — (T,) shared across the batch, or (B, T) per-row (the
+    continuous-batching decode path, where every slot sits at its own
+    offset). The long-context position scheme: no learned table
     (a T=64k learned table is 100M params at d=1536), relative-distance
     attention by construction, and extrapolates past the training length.
     Rotation computed in f32 (bf16 angles at position ~64k lose the
@@ -56,9 +58,13 @@ def rope_rotate(x, positions, base: float = 10000.0):
         raise ValueError(f"rope needs an even head dim, got {Dh}")
     half = Dh // 2
     inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (T, half)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * inv  # (..., T, half)
+    if ang.ndim == 2:
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+    else:  # per-row positions: (B, T, half) -> broadcast over heads only
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     return jnp.concatenate([x1 * cos - x2 * sin,

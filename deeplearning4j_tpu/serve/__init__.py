@@ -16,12 +16,14 @@ compatibility shims over these.
 """
 
 from .continuous import ContinuousBatcher
-from .engine import ServeEngine
+from .engine import PrefillScheduler, ServeEngine
 from .errors import (CapacityError, DeadlineExceededError, ServeError,
                      ServerClosingError, ShedError)
 from .http import ModelServer
+from .paged import BlockAllocator, SlotPages
 from .registry import ModelRegistry, ModelSnapshot
 
-__all__ = ["CapacityError", "ContinuousBatcher", "DeadlineExceededError",
-           "ModelRegistry", "ModelServer", "ModelSnapshot", "ServeEngine",
-           "ServeError", "ServerClosingError", "ShedError"]
+__all__ = ["BlockAllocator", "CapacityError", "ContinuousBatcher",
+           "DeadlineExceededError", "ModelRegistry", "ModelServer",
+           "ModelSnapshot", "PrefillScheduler", "ServeEngine", "ServeError",
+           "ServerClosingError", "ShedError", "SlotPages"]

@@ -4,24 +4,43 @@
 batch prefills together, decodes together, and finishes together — a short
 sequence waits for the longest one, and a new request waits for the whole
 batch. Serving wants the vLLM-style iteration-level schedule instead: a
-fixed number of decode *slots*, each holding one in-flight sequence with its
-own KV-cache rows; every engine tick decodes ALL slots one token; a
-sequence that finishes frees its slot immediately and a queued prompt
-prefills into it, joining the in-flight batch mid-stream.
+fixed number of decode *slots*, each holding one in-flight sequence; every
+engine tick decodes ALL slots one token; a sequence that finishes frees its
+slot immediately and a queued prompt prefills into it, joining the
+in-flight batch mid-stream.
 
 Static shapes throughout (the TPU contract):
 
 - the decode step is ONE executable for the life of the server: per-slot
-  position/temperature/top-k/PRNG-key are *traced* scalars, vmapped over the
+  position/temperature/top-k/PRNG-key ride as *traced* vectors over the
   slot axis, so slot heterogeneity never changes a shape;
-- prompts pad to a fixed set of ``prompt_buckets`` before prefill, and the
-  true length rides along as a traced scalar (the last-real-token logits are
-  gathered with it) — compile count is ``|prompt_buckets| + O(1)``;
-- caches are slot-major ``(slots, 1, capacity, ...)`` buffers written in
-  place with ``lax.dynamic_update_slice`` (donated every tick). Right-padded
-  prefill garbage beyond the true length is never read: the causal mask
-  shows position p only slots ``0..p``, and decode overwrites position p
-  before attending to it.
+- prompts pad to a fixed set of ``prompt_buckets`` (and, chunked, to
+  ``prefill chunk buckets``) before prefill, with the true length traced —
+  compile count is ``<= |prompt_buckets| + 1``.
+
+Two KV layouts (``kv=`` constructor arg; contract in ``nn/generation.py``):
+
+``kv="paged"`` (default) — one shared block pool per attention layer
+  (``serve/paged.py``); each slot owns an ``int32`` block-table row that
+  maps logical block ``p // block_size`` to a physical block. The table is
+  a *traced operand* of the one decode executable, so allocation, growth,
+  and copy-free retirement (free the ids, zero the row) never recompile
+  anything. HBM cost is O(live tokens); per-request ``capacity`` is a
+  logical limit decoupled from any dense buffer — rope models (no
+  ``PositionalEmbedding`` table) can serve contexts far past their
+  training length. Admission commits worst-case blocks up front
+  (``ceil((prompt+max_new)/block_size)``), so a decode can never run out
+  of memory mid-flight; physical blocks are allocated lazily as tokens
+  materialize, which is what makes the live-KV-bytes gauge track live
+  data. Prefill is **chunked**: a long prompt advances ``prefill_chunk``
+  tokens per step, interleaved with decode ticks under a priority-aware
+  :class:`~.engine.PrefillScheduler`, so a prompt burst cannot stall
+  in-flight decodes for its whole prefill.
+
+``kv="dense"`` — the original slot-major ``(slots, 1, capacity, ...)``
+  buffers written with ``lax.dynamic_update_slice`` and a vmapped decode;
+  kept as the bit-exact baseline and for models where one big
+  un-chunked prefill is preferable.
 
 Scope: embedding-front causal-attention stacks (the CausalLM family).
 Recurrent layers are rejected — a right-padded prefill would run the RNN
@@ -33,12 +52,14 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, List, Optional, Sequence
+from typing import Any, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from .engine import PrefillScheduler
 from .errors import (CapacityError, DeadlineExceededError, ServeError,
                      ServerClosingError, ShedError)
+from .paged import BlockAllocator, SlotPages, block_bytes, blocks_needed
 from .registry import ModelRegistry
 
 
@@ -56,7 +77,7 @@ class _GenRequest:
 
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "eos_id",
                  "deadline", "enq_t", "event", "result", "error", "out",
-                 "key", "slot")
+                 "key", "slot", "_cv")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
                  top_k: Optional[int], eos_id: Optional[int],
@@ -74,6 +95,42 @@ class _GenRequest:
         self.out: List[int] = []
         self.key = None       # per-request PRNG key, set at admission
         self.slot: Optional[int] = None
+        self._cv = threading.Condition()
+
+    # --- token-at-a-time surface (SSE streaming rides on this) ---
+    def _push(self, tok: int) -> None:
+        with self._cv:
+            self.out.append(tok)
+            self._cv.notify_all()
+
+    def _finish(self, error: Optional[ServeError] = None) -> None:
+        if error is not None:
+            self.error = error
+        else:
+            self.result = np.asarray(self.out, np.int32)
+        self.event.set()
+        with self._cv:
+            self._cv.notify_all()
+
+    def stream(self) -> Iterator[int]:
+        """Yield tokens as they are decoded; returns when the request
+        completes. A terminal error (deadline, shutdown, ...) raises AFTER
+        every token decoded before it has been yielded — consumers see the
+        partial output, then the typed failure."""
+        i = 0
+        while True:
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: len(self.out) > i or self.event.is_set())
+                n = len(self.out)
+                done = self.event.is_set() and n <= i
+            while i < n:
+                yield self.out[i]
+                i += 1
+            if done:
+                if self.error is not None:
+                    raise self.error
+                return
 
     def wait(self) -> np.ndarray:
         self.event.wait()
@@ -82,44 +139,82 @@ class _GenRequest:
         return self.result
 
 
+class _PrefillJob:
+    """One prompt mid-prefill: its slot, block pages, and chunk cursor."""
+
+    __slots__ = ("req", "slot", "pages", "chunks", "idx", "worst", "last")
+
+    def __init__(self, req: _GenRequest, slot: int, pages: SlotPages,
+                 chunks: List[tuple], worst: int):
+        self.req = req
+        self.slot = slot
+        self.pages = pages
+        self.chunks = chunks    # [(offset, true_len, padded_bucket), ...]
+        self.idx = 0
+        self.worst = worst      # committed worst-case blocks
+        self.last = None        # logits at the last REAL token so far
+
+    @property
+    def deadline(self):
+        return self.req.deadline
+
+    @property
+    def enq_t(self):
+        return self.req.enq_t
+
+
 class ContinuousBatcher:
     """Fixed-slot continuous-batching decode loop over a model registry.
 
     ``slots``: concurrent in-flight sequences (the decode batch size).
-    ``capacity``: KV-cache length per slot; admission requires
-    ``len(prompt) + max_new_tokens <= capacity``. Each decode tick leases
-    the registry's current snapshot, so a hot-swap takes effect at the next
-    token boundary (a long generation may intentionally span generations —
-    that is continuous batching's nature; per-batch generation purity is the
-    *engine*'s guarantee for one-shot predict).
-    """
+    ``capacity``: max context per request (``len(prompt) + max_new_tokens
+    <= capacity``). With ``kv="paged"`` this is a *logical* bound backed by
+    ``kv_blocks`` shared physical blocks of ``block_size`` tokens — a pool
+    smaller than ``slots * capacity`` oversubscribes gracefully: requests
+    queue while blocks are committed elsewhere and shed with a typed
+    :class:`CapacityError` only when a request could never fit.
+    ``prefill_chunk`` bounds how many prompt tokens one prefill step may
+    process (``None`` = whole-prompt prefill); ``scheduler`` decides how
+    prefill chunks interleave with decode ticks. Each decode tick leases
+    the registry's current snapshot, so a hot-swap takes effect at the
+    next token boundary — and, chunked, at the next *chunk* boundary
+    during long prefills."""
 
     def __init__(self, model, registry: Optional[ModelRegistry] = None,
                  params=None, state=None, *, slots: int = 4,
-                 capacity: int = 256,
+                 capacity: int = 256, kv: str = "paged",
+                 block_size: int = 16, kv_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = 64,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 queue_limit: int = 64, seed: int = 0, metrics=None):
+                 queue_limit: int = 64, seed: int = 0, metrics=None,
+                 scheduler: Optional[PrefillScheduler] = None):
         import jax
         import jax.numpy as jnp
         from jax import lax
 
-        from ..nn.generation import _decode_forward, _init_caches
+        from ..nn.generation import cache_spec, decode_forward, init_caches
         from ..nn.layers import (Embedding, EmbeddingSequence,
                                  MultiHeadAttention, Output,
                                  PositionalEmbedding, TransformerEncoderBlock)
         from ..nn.layers.recurrent import RecurrentLayer
         from ..obs.metrics import MetricsRegistry
+        from .paged import build_pools
 
+        if kv not in ("paged", "dense"):
+            raise ValueError(f"kv must be 'paged' or 'dense', got {kv!r}")
         self.model = model
         if registry is None:
             registry = ModelRegistry(
                 params if params is not None else model.params,
                 state if state is not None else model.state, metrics=metrics)
         self.registry = registry
+        self.kv = kv
         self.slots = int(slots)
         self.capacity = int(capacity)
         self.queue_limit = int(queue_limit)
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.scheduler = scheduler if scheduler is not None \
+            else PrefillScheduler()
         self.prompt_buckets = tuple(sorted(set(
             int(b) for b in (prompt_buckets
                              or _default_prompt_buckets(self.capacity))
@@ -143,6 +238,8 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"layer {i} {type(layer).__name__}(causal=False) cannot "
                     f"be decoded autoregressively")
+            # a learned positional TABLE bounds context; rope models have no
+            # such layer, so paged capacity is free to exceed training length
             if isinstance(layer, PositionalEmbedding) \
                     and layer.max_len < self.capacity:
                 raise ValueError(
@@ -171,46 +268,130 @@ class ContinuousBatcher:
             return jnp.where(temperature <= 0.0, greedy,
                              samp).astype(jnp.int32)
 
-        def _prefill(params, state, ids, true_len):
-            """ids (1, Tb) right-padded prompt; logits are gathered at the
-            last REAL token so padding never leaks into sampling."""
-            caches = _init_caches(mdl, 1, C, mdl.dtype)
-            lg, c = _decode_forward(mdl, params, state, ids, caches, 0)
-            last = jnp.take(lg, true_len - 1, axis=1)  # (1, V)
-            return last, c
-
-        def _slot_insert(big, small, s):
-            def wr(b, sm):
-                return lax.dynamic_update_slice(
-                    b, sm.astype(b.dtype)[None], (s,) + (0,) * (b.ndim - 1))
-            return jax.tree.map(wr, big, small)
-
-        def _decode_step(params, state, toks, caches, pos, keys, temps, tks):
-            """One token for every slot. All per-slot scalars are traced and
-            vmapped, so this is ONE executable for the server's lifetime."""
-            def one(tok, cache, p, key, temp, tk):
-                x = tok.reshape(1, 1).astype(jnp.int32)
-                lg, c2 = _decode_forward(mdl, params, state, x, cache, p)
-                key, sub = jax.random.split(key)
-                nxt = _sample_dynamic(lg[0, 0], sub, temp, tk)
-                return nxt, c2, key
-
-            return jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0))(
-                toks, caches, pos, keys, temps, tks)
-
-        self._prefill = jax.jit(_prefill)
         self._sample = jax.jit(_sample_dynamic)
-        self._slot_insert = jax.jit(_slot_insert, donate_argnums=(0,))
-        # caches are the loop-carried buffer: donate them every tick
-        self._decode = jax.jit(_decode_step, donate_argnums=(3,))
 
-        cache0 = _init_caches(model, 1, C, model.dtype)
-        self._caches = jax.tree.map(lambda z: jnp.stack([z] * S), cache0)
+        if kv == "paged":
+            self.block_size = int(block_size)
+            self._maxb = blocks_needed(C, self.block_size)
+            if kv_blocks is None:
+                # dense-equivalent coverage + the reserved trash block
+                kv_blocks = S * self._maxb + 1
+            self.kv_blocks = int(kv_blocks)
+            if prefill_chunk is not None and prefill_chunk < 1:
+                raise ValueError("prefill_chunk must be >= 1 or None")
+            self.prefill_chunk = (int(prefill_chunk)
+                                  if prefill_chunk is not None else None)
+            if self.prefill_chunk is not None:
+                self._chunk_buckets = tuple(sorted(set(
+                    [b for b in self.prompt_buckets
+                     if b <= self.prefill_chunk] + [self.prefill_chunk])))
+            else:
+                self._chunk_buckets = self.prompt_buckets
+            self._alloc = BlockAllocator(self.kv_blocks)
+            self._pools = build_pools(mdl, self.kv_blocks, self.block_size,
+                                      mdl.dtype)
+            self._lks = [lk for lk, _, _ in cache_spec(mdl)]
+            self._tables_np = np.zeros((S, self._maxb), np.int32)
+            self._slot_pages: List[Optional[SlotPages]] = [None] * S
+            self._slot_worst = np.zeros(S, np.int64)
+            self._committed = 0
+            self._block_bytes = block_bytes(mdl, self.block_size, mdl.dtype)
+            lks = self._lks
+
+            def _as_caches(pools, tables):
+                return {lk: {"k_pool": pools[lk]["k"],
+                             "v_pool": pools[lk]["v"],
+                             "tables": tables} for lk in lks}
+
+            def _as_pools(caches):
+                return {lk: {"k": caches[lk]["k_pool"],
+                             "v": caches[lk]["v_pool"]} for lk in lks}
+
+            def _prefill_chunk_fn(params, state, ids, pools, table_row, pos,
+                                  true_len):
+                """One prompt chunk for one slot. ``ids`` (1, Tb)
+                right-padded; ``pos`` (1,) chunk offset; pad garbage writes
+                past the row's blocks land in the trash block. Logits are
+                gathered at the last REAL token of the chunk."""
+                lg, caches = decode_forward(
+                    mdl, params, state, ids,
+                    _as_caches(pools, table_row), pos)
+                last = jnp.take(lg, true_len - 1, axis=1)  # (1, V)
+                return last, _as_pools(caches)
+
+            def _decode_paged_fn(params, state, toks, pools, tables, pos,
+                                 keys, temps, tks):
+                """One token for every slot, batched over the slot axis
+                against the shared pools — ONE executable for the server's
+                lifetime (tables/pos are traced operands). Inactive slots
+                carry zeroed table rows, so their writes land in the trash
+                block and their sampled garbage is discarded host-side."""
+                lg, caches = decode_forward(
+                    mdl, params, state, toks[:, None].astype(jnp.int32),
+                    _as_caches(pools, tables), pos)
+
+                def one(l, key, temp, tk):
+                    key, sub = jax.random.split(key)
+                    return _sample_dynamic(l, sub, temp, tk), key
+
+                nxt, new_keys = jax.vmap(one)(lg[:, 0], keys, temps, tks)
+                return nxt, _as_pools(caches), new_keys
+
+            # pools are the loop-carried buffers: donated every step
+            self._prefill_paged = jax.jit(_prefill_chunk_fn,
+                                          donate_argnums=(3,))
+            self._decode = jax.jit(_decode_paged_fn, donate_argnums=(3,))
+        else:
+            self.block_size = None
+            self.kv_blocks = None
+            self.prefill_chunk = None
+            self._committed = 0
+
+            def _prefill(params, state, ids, true_len):
+                """ids (1, Tb) right-padded prompt; logits are gathered at
+                the last REAL token so padding never leaks into sampling."""
+                caches = init_caches(mdl, 1, C, mdl.dtype)
+                lg, c = decode_forward(mdl, params, state, ids, caches, 0)
+                last = jnp.take(lg, true_len - 1, axis=1)  # (1, V)
+                return last, c
+
+            def _slot_insert(big, small, s):
+                def wr(b, sm):
+                    return lax.dynamic_update_slice(
+                        b, sm.astype(b.dtype)[None],
+                        (s,) + (0,) * (b.ndim - 1))
+                return jax.tree.map(wr, big, small)
+
+            def _decode_step(params, state, toks, caches, pos, keys, temps,
+                             tks):
+                """One token for every slot. All per-slot scalars are traced
+                and vmapped, so this is ONE executable for the server's
+                lifetime."""
+                def one(tok, cache, p, key, temp, tk):
+                    x = tok.reshape(1, 1).astype(jnp.int32)
+                    lg, c2 = decode_forward(mdl, params, state, x, cache, p)
+                    key, sub = jax.random.split(key)
+                    nxt = _sample_dynamic(lg[0, 0], sub, temp, tk)
+                    return nxt, c2, key
+
+                return jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0))(
+                    toks, caches, pos, keys, temps, tks)
+
+            self._prefill = jax.jit(_prefill)
+            self._slot_insert = jax.jit(_slot_insert, donate_argnums=(0,))
+            # caches are the loop-carried buffer: donate them every tick
+            self._decode = jax.jit(_decode_step, donate_argnums=(3,))
+
+            cache0 = init_caches(model, 1, C, model.dtype)
+            self._caches = jax.tree.map(lambda z: jnp.stack([z] * S), cache0)
+
         self._base_key = jax.random.PRNGKey(seed)
 
         self._cond = threading.Condition()
         self._queue: List[_GenRequest] = []
+        self._jobs: List[_PrefillJob] = []
         self._slot_req: List[Optional[_GenRequest]] = [None] * S
+        self._slot_job: List[Optional[_PrefillJob]] = [None] * S
         self._closing = False
         self._admitted = 0
         self._peak_active = 0
@@ -237,7 +418,8 @@ class ContinuousBatcher:
         self._m_decode_s = m.histogram("serve_gen_decode_seconds",
                                        help="one all-slots decode tick")
         self._m_prefill_s = m.histogram("serve_gen_prefill_seconds",
-                                        help="prompt prefill device time")
+                                        help="prompt prefill device time "
+                                             "(per chunk when chunked)")
         self._m_occupancy = m.histogram(
             "serve_gen_slot_occupancy",
             buckets=tuple((i + 1) / S for i in range(S)),
@@ -245,6 +427,25 @@ class ContinuousBatcher:
         self._m_compiles = m.counter(
             "serve_compile_misses_total", {"component": "generate"},
             help="new (bucket, shape) signatures — each is an XLA compile")
+        if kv == "paged":
+            m.gauge("serve_kv_blocks_total",
+                    help="allocatable KV blocks (excl. trash block)"
+                    ).set(self._alloc.usable)
+            self._m_kv_used = m.gauge("serve_kv_blocks_used",
+                                      help="KV blocks currently allocated")
+            self._m_kv_util = m.gauge(
+                "serve_kv_block_utilization",
+                help="allocated / allocatable KV blocks")
+            self._m_kv_bytes = m.gauge(
+                "serve_kv_live_bytes",
+                help="bytes of KV pool backing live tokens (all layers)")
+            self._m_pf_depth = m.gauge(
+                "serve_prefill_queue_depth",
+                help="prompts mid-prefill (chunked jobs in flight)")
+            self._m_pf_chunks = m.counter(
+                "serve_prefill_chunks_total",
+                help="prefill chunks executed")
+            self._update_kv_gauges()
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-continuous-batcher")
@@ -268,6 +469,16 @@ class ContinuousBatcher:
             raise CapacityError(
                 f"prompt ({prompt.shape[0]}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds cache capacity {self.capacity}")
+        if self.kv == "paged":
+            worst = blocks_needed(prompt.shape[0] + int(max_new_tokens),
+                                  self.block_size)
+            if worst > self._alloc.usable:
+                # queueing can't help: this request can NEVER fit
+                self._shed_counter("over_capacity").inc()
+                raise CapacityError(
+                    f"request needs {worst} KV blocks but the pool only has "
+                    f"{self._alloc.usable} — raise kv_blocks or lower "
+                    f"max_new_tokens")
         deadline = (time.perf_counter() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
         req = _GenRequest(prompt, max_new_tokens, temperature, top_k,
@@ -309,6 +520,15 @@ class ContinuousBatcher:
             full[i, :o.shape[0]] = o
         return full
 
+    def stream(self, prompt, max_new_tokens: int, *,
+               temperature: float = 1.0, top_k: Optional[int] = None,
+               eos_id: Optional[int] = None,
+               timeout_ms: Optional[float] = None) -> Iterator[int]:
+        """Submit and yield tokens one at a time as they are decoded."""
+        return self.submit(np.asarray(prompt, np.int32), max_new_tokens,
+                           temperature=temperature, top_k=top_k,
+                           eos_id=eos_id, timeout_ms=timeout_ms).stream()
+
     # ---------------------------------------------------------------- serving
     def _bucket(self, t: int) -> int:
         for b in self.prompt_buckets:
@@ -317,6 +537,153 @@ class ContinuousBatcher:
         raise CapacityError(f"prompt length {t} exceeds largest prompt "
                             f"bucket {self.prompt_buckets[-1]}")
 
+    def _chunk_bucket(self, t: int) -> int:
+        for b in self._chunk_buckets:
+            if b >= t:
+                return b
+        return self._chunk_buckets[-1]
+
+    def _plan_chunks(self, tp: int) -> List[tuple]:
+        """Split a prompt into (offset, true_len, padded_bucket) chunks.
+        Full chunks run at exactly ``prefill_chunk``; the tail pads to the
+        smallest chunk bucket that covers it. ``prefill_chunk=None`` is one
+        whole-prompt chunk (the un-chunked baseline)."""
+        if self.prefill_chunk is None:
+            return [(0, tp, self._bucket(tp))]
+        chunks, off = [], 0
+        while tp - off > self.prefill_chunk:
+            chunks.append((off, self.prefill_chunk, self.prefill_chunk))
+            off += self.prefill_chunk
+        tail = tp - off
+        chunks.append((off, tail, self._chunk_bucket(tail)))
+        return chunks
+
+    def _update_kv_gauges(self) -> None:
+        used = self._alloc.used
+        self._m_kv_used.set(used)
+        self._m_kv_util.set(used / self._alloc.usable)
+        self._m_kv_bytes.set(used * self._block_bytes)
+
+    def _write_table_row(self, s: int, blocks: List[int]) -> None:
+        row = np.zeros(self._maxb, np.int32)
+        row[:len(blocks)] = blocks
+        self._tables_np[s] = row
+
+    # --- paged admission: commit worst-case blocks, start a prefill job ---
+    def _admit_locked(self) -> List[tuple]:
+        """Under ``self._cond``: hand free slots to queued requests. Dense
+        mode returns (slot, req) pairs to prefill under the caller's lease;
+        paged mode creates :class:`_PrefillJob` state machines (FIFO — a
+        head request waiting on blocks holds the line, so big requests
+        cannot be starved by a stream of small ones)."""
+        admits = []
+        for s in range(self.slots):
+            if not self._queue:
+                break
+            if self._slot_req[s] is not None or self._slot_job[s] is not None:
+                continue
+            if self.kv == "dense":
+                admits.append((s, self._queue.pop(0)))
+                continue
+            req = self._queue[0]
+            worst = blocks_needed(req.prompt.shape[0] + req.max_new,
+                                  self.block_size)
+            if self._committed + worst > self._alloc.usable:
+                break  # wait for in-flight sequences to release blocks
+            self._queue.pop(0)
+            self._committed += worst
+            job = _PrefillJob(req, s, SlotPages(self._alloc, self.block_size),
+                              self._plan_chunks(req.prompt.shape[0]), worst)
+            self._slot_job[s] = job
+            self._jobs.append(job)
+        if self.kv == "paged":
+            self._m_pf_depth.set(len(self._jobs))
+        return admits
+
+    def _abort_job(self, job: _PrefillJob, err: ServeError) -> None:
+        with self._cond:
+            if job in self._jobs:
+                self._jobs.remove(job)
+            self._slot_job[job.slot] = None
+            job.pages.release()
+            self._committed -= job.worst
+            self._write_table_row(job.slot, [])
+            self._update_kv_gauges()
+            self._m_pf_depth.set(len(self._jobs))
+        job.req._finish(err)
+
+    def _prefill_step(self, job: _PrefillJob, snap) -> None:
+        """Advance one chunk of one prompt (paged mode)."""
+        import jax.numpy as jnp
+
+        off, true_len, bucket = job.chunks[job.idx]
+        with self._cond:
+            if self._slot_job[job.slot] is not job:
+                return  # aborted (forced shutdown) since this tick was planned
+            job.pages.ensure(off + true_len)
+            self._write_table_row(job.slot, job.pages.blocks)
+            table_row = self._tables_np[job.slot:job.slot + 1].copy()
+            self._update_kv_gauges()
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :true_len] = job.req.prompt[off:off + true_len]
+        t0 = time.perf_counter()
+        last, self._pools = self._prefill_paged(
+            snap.params, snap.state, jnp.asarray(ids), self._pools,
+            jnp.asarray(table_row), np.full((1,), off, np.int32),
+            np.int32(true_len))
+        self._m_prefill_s.observe(time.perf_counter() - t0)
+        self._m_pf_chunks.inc()
+        job.last = last
+        job.idx += 1
+        with self._cond:
+            sig = ("prefill", bucket)
+            if sig not in self._prefill_sigs:
+                self._prefill_sigs.add(sig)
+                self._m_compiles.inc()
+        if job.idx == len(job.chunks):
+            self._finish_prefill(job)
+
+    def _finish_prefill(self, job: _PrefillJob) -> None:
+        """Last chunk done: sample the first token, flip the slot from
+        prefilling to decoding."""
+        import jax
+        import numpy as _np
+
+        req, s = job.req, job.slot
+        with self._cond:
+            if self._slot_job[s] is not job:
+                return  # aborted (forced shutdown) mid-prefill
+            self._admitted += 1
+            n = self._admitted
+        key = jax.random.fold_in(self._base_key, n)
+        key, sub = jax.random.split(key)
+        tok0 = int(_np.asarray(self._sample(
+            job.last[0], sub, np.float32(req.temperature),
+            np.int32(req.top_k if req.top_k else self.vocab))))
+        with self._cond:
+            if job in self._jobs:
+                self._jobs.remove(job)
+            self._slot_job[s] = None
+            self._slot_pages[s] = job.pages
+            self._slot_worst[s] = job.worst
+            self._m_pf_depth.set(len(self._jobs))
+            req.slot = s
+            req.key = None
+            self._slot_req[s] = req
+            self._next_tok[s] = tok0
+            self._pos[s] = req.prompt.shape[0]
+            self._temps[s] = req.temperature
+            self._topks[s] = req.top_k if req.top_k else self.vocab
+            self._keys[s] = np.asarray(key, np.uint32)
+            self._m_admitted.inc()
+            active = sum(1 for r in self._slot_req if r is not None)
+            self._peak_active = max(self._peak_active, active)
+            self._m_active.set(active)
+        req._push(tok0)
+        # a 1-token request (or instant EOS) finishes without ever decoding
+        self._maybe_finish(s)
+
+    # --- dense admission (whole-prompt prefill under the caller's lease) ---
     def _admit_into_slot(self, s: int, req: _GenRequest, snap) -> None:
         import jax
         import jax.numpy as jnp
@@ -343,7 +710,6 @@ class ContinuousBatcher:
                 self._m_compiles.inc()
             req.slot = s
             req.key = None
-            req.out.append(tok0)
             self._slot_req[s] = req
             self._next_tok[s] = tok0
             self._pos[s] = tp
@@ -354,6 +720,7 @@ class ContinuousBatcher:
             active = sum(1 for r in self._slot_req if r is not None)
             self._peak_active = max(self._peak_active, active)
             self._m_active.set(active)
+        req._push(tok0)
         # a 1-token request (or instant EOS) finishes without ever decoding
         self._maybe_finish(s)
 
@@ -367,11 +734,19 @@ class ContinuousBatcher:
                         and req.out[-1] == req.eos_id))
             if not done:
                 return
-            req.result = np.asarray(req.out, np.int32)
             self._slot_req[s] = None
+            if self.kv == "paged" and self._slot_pages[s] is not None:
+                # copy-free retirement: blocks go back to the free list and
+                # the table row zeroes (points at trash) — no device work
+                self._slot_pages[s].release()
+                self._slot_pages[s] = None
+                self._committed -= int(self._slot_worst[s])
+                self._slot_worst[s] = 0
+                self._write_table_row(s, [])
+                self._update_kv_gauges()
             self._m_completed.inc()
             self._m_active.set(sum(1 for r in self._slot_req if r is not None))
-        req.event.set()
+        req._finish()
 
     def _tick(self, snap) -> None:
         """Decode one token for every slot; bookkeep the active ones."""
@@ -380,24 +755,45 @@ class ContinuousBatcher:
         with self._cond:
             active = [s for s in range(self.slots)
                       if self._slot_req[s] is not None]
+            if not active:
+                return
+            if self.kv == "paged":
+                # grow lazily to cover the token this tick writes; the
+                # admission-time worst-case commitment guarantees success
+                for s in active:
+                    pages = self._slot_pages[s]
+                    pages.ensure(int(self._pos[s]) + 1)
+                    self._write_table_row(s, pages.blocks)
+                self._update_kv_gauges()
+                mask = np.zeros(self.slots, bool)
+                mask[active] = True
+                # inactive rows: zero tables (writes -> trash) + position 0
+                tables = np.where(mask[:, None], self._tables_np, 0)
+                pos = np.where(mask, self._pos, 0).astype(np.int32)
+            else:
+                pos = np.array(self._pos)
             toks = np.array(self._next_tok)
-            pos = np.array(self._pos)
             temps = np.array(self._temps)
             topks = np.array(self._topks)
             keys = np.array(self._keys)
-        if not active:
-            return
         t0 = time.perf_counter()
-        nxt, caches, new_keys = self._decode(
-            snap.params, snap.state, jnp.asarray(toks), self._caches,
-            jnp.asarray(pos), jnp.asarray(keys), jnp.asarray(temps),
-            jnp.asarray(topks))
-        self._caches = caches
+        if self.kv == "paged":
+            nxt, self._pools, new_keys = self._decode(
+                snap.params, snap.state, jnp.asarray(toks), self._pools,
+                jnp.asarray(tables), jnp.asarray(pos), jnp.asarray(keys),
+                jnp.asarray(temps), jnp.asarray(topks))
+        else:
+            nxt, caches, new_keys = self._decode(
+                snap.params, snap.state, jnp.asarray(toks), self._caches,
+                jnp.asarray(pos), jnp.asarray(keys), jnp.asarray(temps),
+                jnp.asarray(topks))
+            self._caches = caches
         nxt_np = np.asarray(nxt)
         keys_np = np.asarray(new_keys, np.uint32)
         self._m_decode_s.observe(time.perf_counter() - t0)
         self._m_occupancy.observe(len(active) / self.slots)
         self._m_tokens.inc(len(active))
+        pushes = []
         with self._cond:
             sig = ("decode", self.slots)
             if sig not in self._decode_sigs:
@@ -408,10 +804,12 @@ class ContinuousBatcher:
                 if req is None:
                     continue
                 tok = int(nxt_np[s])
-                req.out.append(tok)
                 self._next_tok[s] = tok
                 self._pos[s] = self._pos[s] + 1
                 self._keys[s] = keys_np[s]
+                pushes.append((req, tok))
+        for req, tok in pushes:
+            req._push(tok)
         for s in active:
             self._maybe_finish(s)
 
@@ -419,33 +817,51 @@ class ContinuousBatcher:
         while True:
             with self._cond:
                 has_active = any(r is not None for r in self._slot_req)
-                if self._closing and not self._queue and not has_active:
+                has_jobs = bool(self._jobs)
+                if self._closing and not self._queue and not has_active \
+                        and not has_jobs:
                     return
-                if not self._queue and not has_active:
+                if not self._queue and not has_active and not has_jobs:
                     self._cond.wait(0.05)
                     continue
-                admits = []
-                for s in range(self.slots):
-                    if self._slot_req[s] is None and self._queue:
-                        admits.append((s, self._queue.pop(0)))
+                admits = self._admit_locked()
                 self._m_qdepth.set(len(self._queue))
+                jobs = list(self._jobs)
+                decoding = any(r is not None for r in self._slot_req)
             now = time.perf_counter()
-            with self.registry.lease() as snap:
-                for s, req in admits:
-                    if req.deadline is not None and now > req.deadline:
-                        req.error = DeadlineExceededError(
-                            "deadline exceeded waiting for a decode slot")
-                        req.event.set()
+            if self.kv == "paged":
+                for job in self.scheduler.plan(jobs, decoding):
+                    if job.idx == 0 and job.req.deadline is not None \
+                            and now > job.req.deadline:
+                        self._abort_job(job, DeadlineExceededError(
+                            "deadline exceeded waiting for a decode slot"))
                         continue
                     try:
-                        self._admit_into_slot(s, req, snap)
+                        # one lease per chunk: hot-swap drains at chunk
+                        # granularity, not whole-prompt granularity
+                        with self.registry.lease(tag="gen_prefill") as snap:
+                            self._prefill_step(job, snap)
                     except ServeError as e:
-                        req.error = e
-                        req.event.set()
+                        self._abort_job(job, e)
                     except Exception as e:  # slot loop must outlive any bad request  # jaxlint: disable=broad-except
-                        req.error = ServeError(f"{type(e).__name__}: {e}")
-                        req.event.set()
-                self._tick(snap)
+                        self._abort_job(job,
+                                        ServeError(f"{type(e).__name__}: {e}"))
+                with self.registry.lease(tag="gen_decode") as snap:
+                    self._tick(snap)
+            else:
+                with self.registry.lease(tag="gen_decode") as snap:
+                    for s, req in admits:
+                        if req.deadline is not None and now > req.deadline:
+                            req._finish(DeadlineExceededError(
+                                "deadline exceeded waiting for a decode slot"))
+                            continue
+                        try:
+                            self._admit_into_slot(s, req, snap)
+                        except ServeError as e:
+                            req._finish(e)
+                        except Exception as e:  # slot loop must outlive any bad request  # jaxlint: disable=broad-except
+                            req._finish(ServeError(f"{type(e).__name__}: {e}"))
+                    self._tick(snap)
 
     # -------------------------------------------------------------- lifecycle
     @property
@@ -458,24 +874,54 @@ class ContinuousBatcher:
         with self._cond:
             return self._peak_active
 
+    def kv_block_stats(self) -> dict:
+        """Allocator snapshot (paged mode): totals, usage, live bytes."""
+        if self.kv != "paged":
+            return {}
+        with self._cond:
+            used = self._alloc.used
+            return {"block_size": self.block_size,
+                    "blocks_total": self._alloc.usable,
+                    "blocks_used": used,
+                    "blocks_committed": self._committed,
+                    "live_bytes": used * self._block_bytes}
+
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> None:
         """``drain=True`` finishes every queued and in-flight generation
         first; ``drain=False`` errors them out immediately."""
+        finish = []
         with self._cond:
             self._closing = True
             if not drain:
                 err = ServerClosingError("batcher shut down before dispatch")
                 for req in self._queue:
-                    req.error = err
-                    req.event.set()
+                    finish.append(req)
                 self._queue.clear()
+                for job in list(self._jobs):
+                    job.pages.release()
+                    self._slot_job[job.slot] = None
+                    self._committed -= job.worst
+                    finish.append(job.req)
+                self._jobs.clear()
                 for s, req in enumerate(self._slot_req):
                     if req is not None:
-                        req.error = err
-                        req.event.set()
+                        finish.append(req)
                         self._slot_req[s] = None
+                    if self.kv == "paged" and self._slot_pages[s] is not None:
+                        self._slot_pages[s].release()
+                        self._slot_pages[s] = None
+                        self._committed -= int(self._slot_worst[s])
+                        self._slot_worst[s] = 0
+                if self.kv == "paged":
+                    self._tables_np[:] = 0
+                    self._update_kv_gauges()
+                    self._m_pf_depth.set(0)
                 self._m_qdepth.set(0)
                 self._m_active.set(0)
+                err_out = err
             self._cond.notify_all()
+        if finish:
+            for req in finish:
+                req._finish(err_out)
         self._thread.join(timeout)

@@ -88,6 +88,38 @@ class _Request:
         return self.result
 
 
+class PrefillScheduler:
+    """Decides how prompt-prefill chunks interleave with decode ticks in the
+    continuous batcher (chunked prefill, ``serve/continuous.py``).
+
+    The problem it bounds: a burst of long prompts used to monopolize the
+    device for entire whole-prompt prefills while every in-flight decode
+    stalled — inter-token p99 under mixed traffic was a function of the
+    *longest queued prompt*. With chunked prefill, each engine tick runs at
+    most ``decode_chunks`` prefill chunks while decodes are active (decode
+    priority: in-flight tokens keep flowing), and up to ``idle_chunks``
+    when no decode is running (idle device: drain the prefill backlog
+    faster). Among runnable jobs, earliest-deadline-first, then FIFO — a
+    deadline-carrying request cannot be starved by deadline-less bulk work.
+    """
+
+    def __init__(self, decode_chunks: int = 1, idle_chunks: int = 4):
+        if decode_chunks < 1 or idle_chunks < 1:
+            raise ValueError("chunk budgets must be >= 1")
+        self.decode_chunks = int(decode_chunks)
+        self.idle_chunks = int(idle_chunks)
+
+    def plan(self, jobs: Sequence[Any], decoding: bool) -> List[Any]:
+        """Pick and order the prefill jobs to advance one chunk this tick.
+        ``jobs`` expose ``.deadline`` (optional) and ``.enq_t``."""
+        if not jobs:
+            return []
+        budget = self.decode_chunks if decoding else self.idle_chunks
+        order = sorted(jobs, key=lambda j: (
+            j.deadline if j.deadline is not None else float("inf"), j.enq_t))
+        return order[:budget]
+
+
 class ServeEngine:
     """Micro-batching inference engine over a :class:`ModelRegistry`.
 
@@ -316,7 +348,7 @@ class ServeEngine:
                 self._m_compiles.inc()
             self._batch_count += 1
             seq = self._batch_count
-        with self.registry.lease() as snap:  # ONE generation per batch
+        with self.registry.lease(tag="engine_batch") as snap:  # ONE generation per batch
             t0 = time.perf_counter()
             try:
                 y = np.asarray(self._fwd(snap.params, snap.state, x))

@@ -14,7 +14,15 @@ Endpoints:
 - ``POST /predict``  ``{"ndarray": [[...]], "timeout_ms": 250}``
   -> ``{"output": [[...]], "generation": 3}``
 - ``POST /generate`` ``{"prompt": [1,2,3], "max_new_tokens": 16,
-  "temperature": 0.8, "top_k": 40, "eos_id": 2}`` -> ``{"tokens": [...]}``
+  "temperature": 0.8, "top_k": 40, "eos_id": 2}`` — **streams by
+  default**: a Server-Sent-Events body flushed per decoded token
+  (``data: {"token": 5}`` events, then ``data: {"done": true,
+  "tokens": [...]}``). ``?stream=false`` keeps the buffered JSON answer
+  ``{"tokens": [...]}`` (batch prompts are always buffered). Admission
+  errors arrive BEFORE the stream starts as typed status codes (503/504/
+  400); an error after streaming began is delivered in-band as a final
+  ``data: {"error": ..., "cause": ...}`` event carrying the partial
+  output.
 - ``GET /health`` (liveness) · ``GET /ready`` (readiness: 503 while
   draining) · ``GET /models`` (registry generations) · ``GET /metrics``
 """
@@ -24,6 +32,7 @@ from __future__ import annotations
 import json
 import threading
 from typing import Optional, Sequence
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
@@ -56,6 +65,9 @@ class ModelServer(JsonHTTPServerMixin):
                  default_timeout_ms: Optional[float] = None,
                  input_dtype=np.float32, gen_slots: int = 4,
                  gen_capacity: int = 256, gen_queue_limit: int = 64,
+                 gen_kv: str = "paged", gen_block_size: int = 16,
+                 gen_kv_blocks: Optional[int] = None,
+                 gen_prefill_chunk: Optional[int] = 64,
                  seed: int = 0, metrics: Optional[MetricsRegistry] = None):
         self.model = model
         self.host = host
@@ -75,7 +87,14 @@ class ModelServer(JsonHTTPServerMixin):
             max_wait_ms=max_wait_ms, default_timeout_ms=default_timeout_ms,
             metrics=self.metrics)
         self._gen_opts = dict(slots=gen_slots, capacity=gen_capacity,
-                              queue_limit=gen_queue_limit, seed=seed)
+                              queue_limit=gen_queue_limit, kv=gen_kv,
+                              block_size=gen_block_size,
+                              kv_blocks=gen_kv_blocks,
+                              prefill_chunk=gen_prefill_chunk, seed=seed)
+        if gen_kv == "dense":
+            # dense batcher takes no paging knobs
+            for k in ("block_size", "kv_blocks", "prefill_chunk"):
+                self._gen_opts.pop(k)
         self._batcher: Optional[ContinuousBatcher] = None
         self._lifecycle_lock = threading.Lock()
         self._accepting = True
@@ -133,12 +152,13 @@ class ModelServer(JsonHTTPServerMixin):
                     self.reply(404, {"error": "unknown endpoint"})
 
             def do_POST(self):
+                split = urlsplit(self.path)
                 try:
                     req = self.read_json()
-                    if self.path == "/predict":
+                    if split.path == "/predict":
                         self._predict(req)
-                    elif self.path == "/generate":
-                        self._generate(req)
+                    elif split.path == "/generate":
+                        self._generate(req, parse_qs(split.query))
                     else:
                         self.reply(404, {"error": "unknown endpoint"})
                 except ServeError as e:
@@ -165,15 +185,48 @@ class ModelServer(JsonHTTPServerMixin):
                     body["generation"] = handle.generation
                 self.reply(200, body)
 
-            def _generate(self, req):
-                prompt = req["prompt"]
-                toks = server.batcher().generate(
-                    np.asarray(prompt, np.int32),
-                    int(req.get("max_new_tokens", 16)),
+            def _sse(self, payload):
+                self.wfile.write(
+                    b"data: " + json.dumps(payload).encode() + b"\n\n")
+                self.wfile.flush()  # one event per decoded token
+
+            def _generate(self, req, query):
+                prompt = np.asarray(req["prompt"], np.int32)
+                kwargs = dict(
                     temperature=float(req.get("temperature", 1.0)),
                     top_k=req.get("top_k"), eos_id=req.get("eos_id"),
                     timeout_ms=req.get("timeout_ms"))
-                self.reply(200, {"tokens": np.asarray(toks).tolist()})
+                mnt = int(req.get("max_new_tokens", 16))
+                stream = (query.get("stream", ["true"])[0].lower()
+                          not in ("false", "0", "no"))
+                if req.get("stream") is False:
+                    stream = False
+                if prompt.ndim != 1:  # batch prompts are always buffered
+                    stream = False
+                if not stream:
+                    toks = server.batcher().generate(prompt, mnt, **kwargs)
+                    self.reply(200, {"tokens": np.asarray(toks).tolist()})
+                    return
+                # submit BEFORE the stream starts: admission failures
+                # (shed/closing/capacity/deadline) surface as typed status
+                # codes via do_POST; after headers, errors go in-band
+                handle = server.batcher().submit(prompt, mnt, **kwargs)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.close_connection = True
+                out = []
+                try:
+                    for tok in handle.stream():
+                        out.append(int(tok))
+                        self._sse({"token": int(tok)})
+                    self._sse({"done": True, "tokens": out})
+                except ServeError as e:
+                    # mid-stream failure: partial output + the typed cause
+                    self._sse({"error": str(e), "cause": e.cause,
+                               "tokens": out})
 
         return Handler
 

@@ -1,0 +1,160 @@
+"""Paged KV-cache block management for the continuous batcher.
+
+The dense layout gives every decode slot a private ``(1, capacity, ...)``
+KV buffer, so HBM cost is ``O(slots x capacity)`` whether or not tokens are
+live, and a request can never be longer than the buffer it was born with.
+The paged layout (vLLM's PagedAttention scheme, adapted to the fixed-shape
+XLA contract) carves KV memory into fixed-size **token blocks** in one
+shared pool per attention layer:
+
+- ``k_pool`` / ``v_pool``: ``(num_blocks, block_size, Hkv, hd)`` device
+  arrays, donated through every decode tick / prefill chunk (loop-carried,
+  never copied);
+- a per-slot **block table** ``(slots, max_blocks)`` int32 mapping logical
+  block ``p // block_size`` to a physical block — a *traced operand* of
+  the one compiled decode step, so growing/retiring sequences never
+  changes a shape and never recompiles anything;
+- physical **block 0 is reserved as the trash block**: unallocated table
+  entries point at it, so the fixed-shape decode step can write every
+  slot every tick (inactive slots scribble on trash) and right-padded
+  prefill garbage lands there too. Nothing ever unmasked-reads block 0.
+
+HBM cost becomes ``O(allocated blocks)`` — proportional to live tokens —
+and per-request capacity is a *logical* limit (``max_blocks x
+block_size``), decoupled from any dense buffer. The allocator below is
+pure host-side bookkeeping: integer free lists, no device work, so slot
+retirement is copy-free (free the ids, zero the table row).
+
+The device-side layout contract (how positions map into pools, the trash
+block, append/read semantics) lives in ``nn/generation.py`` next to
+``cache_append`` / ``cache_read``; this module only decides *which*
+physical blocks a slot owns.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .errors import CapacityError
+
+TRASH_BLOCK = 0  # physical block 0 is never allocated; see module docstring
+
+
+class BlockAllocator:
+    """Free-list allocator over physical block ids ``1..num_blocks-1``.
+
+    LIFO reuse (a freed block is the next handed out) keeps the working
+    set compact. Pure host-side and NOT thread-safe by itself — the
+    batcher serializes calls under its own lock.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 usable + trash), "
+                             f"got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # LIFO: low ids at the tail so fresh pools fill from block 1 up
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._live: set = set()
+
+    @property
+    def usable(self) -> int:
+        """Total allocatable blocks (excludes the trash block)."""
+        return self.num_blocks - 1
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def used(self) -> int:
+        return len(self._live)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` blocks or raise :class:`CapacityError` (taking none).
+
+        Callers gate admission on worst-case commitment, so exhaustion here
+        means a bookkeeping bug — but it stays a *typed* failure either way.
+        """
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise CapacityError(
+                f"KV block pool exhausted: need {n}, {len(self._free)} of "
+                f"{self.usable} free")
+        ids = [self._free.pop() for _ in range(n)]
+        self._live.update(ids)
+        return ids
+
+    def free(self, ids) -> None:
+        """Return blocks to the pool; double-free is a hard error."""
+        for b in ids:
+            b = int(b)
+            if b == TRASH_BLOCK:
+                raise ValueError("attempted to free the trash block")
+            if b not in self._live:
+                raise ValueError(f"double free of block {b}")
+            self._live.discard(b)
+            self._free.append(b)
+
+
+def build_pools(model, num_blocks: int, block_size: int, dtype) -> Dict:
+    """Zero-filled per-attention-layer block pools:
+    ``{layer_key: {"k": (N, bs, Hkv, hd), "v": ...}}`` (device arrays)."""
+    import jax.numpy as jnp
+
+    from ..nn.generation import cache_spec
+
+    spec = cache_spec(model)
+    if not spec:
+        raise ValueError("model has no attention layers to page")
+    return {lk: {"k": jnp.zeros((num_blocks, block_size, hkv, hd), dtype),
+                 "v": jnp.zeros((num_blocks, block_size, hkv, hd), dtype)}
+            for lk, hkv, hd in spec}
+
+
+def block_bytes(model, block_size: int, dtype) -> int:
+    """Bytes of KV one block holds across ALL attention layers (k + v) —
+    the unit the live-KV-bytes gauge counts in."""
+    from ..nn.generation import cache_spec
+
+    itemsize = np.dtype(dtype).itemsize
+    return sum(2 * block_size * hkv * hd * itemsize
+               for _, hkv, hd in cache_spec(model))
+
+
+def blocks_needed(tokens: int, block_size: int) -> int:
+    """Blocks covering ``tokens`` positions (ceil division)."""
+    return -(-int(tokens) // int(block_size))
+
+
+class SlotPages:
+    """One slot's view of the pool: its allocated blocks, in logical order.
+
+    ``ensure(tokens)`` grows the mapping to cover ``tokens`` positions,
+    allocating lazily — so the pool's *used* count tracks live tokens, not
+    requested worst cases. The batcher writes the returned new block ids
+    into its host block-table row.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self._alloc = allocator
+        self.block_size = int(block_size)
+        self.blocks: List[int] = []
+
+    def ensure(self, tokens: int) -> List[int]:
+        """Cover ``tokens`` positions; returns the NEWLY allocated ids."""
+        need = blocks_needed(tokens, self.block_size) - len(self.blocks)
+        if need <= 0:
+            return []
+        new = self._alloc.alloc(need)
+        self.blocks.extend(new)
+        return new
+
+    def release(self) -> None:
+        """Copy-free retirement: hand every block back to the free list."""
+        if self.blocks:
+            self._alloc.free(self.blocks)
+            self.blocks = []

@@ -91,12 +91,22 @@ class ModelRegistry:
         return self.current().generation
 
     @contextmanager
-    def lease(self):
-        """Pin the current snapshot for one batch of device work."""
+    def lease(self, tag: Optional[str] = None):
+        """Pin the current snapshot for one unit of device work.
+
+        ``tag`` names the caller for accounting (``serve_lease_total{tag}``):
+        the engine leases per device batch, the continuous batcher per
+        decode tick (``gen_decode``) and per prefill *chunk*
+        (``gen_prefill``) — so a drain during a long chunked prefill waits
+        only for the current chunk, not the whole prompt."""
         with self._cond:
             snap = self._history[-1]
             self._inflight[snap.generation] = \
                 self._inflight.get(snap.generation, 0) + 1
+        if tag is not None and self._metrics is not None:
+            self._metrics.counter("serve_lease_total", {"tag": tag},
+                                  help="registry leases taken, by caller tag"
+                                  ).inc()
         try:
             yield snap
         finally:

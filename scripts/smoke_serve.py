@@ -1,10 +1,13 @@
 #!/usr/bin/env python
 """CI smoke serve: boot a ModelServer on a small CausalLM, fire mixed
-predict/generate traffic at it concurrently, and assert the ISSUE-4
+predict/generate traffic at it concurrently, and assert the ISSUE-4/5
 acceptance surface — every request answered (zero drops below capacity),
-greedy /generate matches whole-batch ``nn.generation.generate``, the
-executable set stays bounded, and the Prometheus scrape exposes the serving
-histograms/counters — so a regression in the serving path fails CI before
+greedy /generate matches whole-batch ``nn.generation.generate`` on both the
+buffered and the SSE-streamed path, the executable set stays bounded, a
+long-prompt burst that OVERCOMMITS the paged-KV pool queues and completes
+(with a truly-impossible request shed as a typed ``CapacityError``), and
+the Prometheus scrape exposes the serving histograms/counters plus the
+paged-KV block gauges — so a regression in the serving path fails CI before
 it reaches a real deployment.
 
 Artifacts land in $CI_ARTIFACTS_DIR (default: ./ci-artifacts/):
@@ -32,6 +35,10 @@ REQUIRED_METRICS = (
     "serve_compile_misses_total", "serve_model_generation",
     "serve_gen_admitted_total", "serve_gen_completed_total",
     "serve_gen_tokens_total", "http_request_seconds_bucket",
+    # paged-KV + chunked-prefill surface (ISSUE 5)
+    "serve_kv_blocks_total", "serve_kv_blocks_used",
+    "serve_kv_block_utilization", "serve_kv_live_bytes",
+    "serve_prefill_chunks_total", "serve_lease_total",
 )
 
 
@@ -41,6 +48,62 @@ def _post(port, path, body):
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=60) as r:
         return json.loads(r.read())
+
+
+def _sse_generate(port, body):
+    """POST /generate on the default (streaming) path; return the token
+    list from the per-token SSE events, cross-checked against the final
+    ``done`` event."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.headers["Content-Type"] == "text/event-stream", \
+            "/generate did not stream by default"
+        for line in r:
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[len(b"data: "):]))
+    assert events and events[-1].get("done"), events[-1:]
+    toks = [e["token"] for e in events[:-1]]
+    assert events[-1]["tokens"] == toks, "SSE final event disagrees"
+    return toks
+
+
+def _overcommit_burst(model):
+    """Long-prompt burst against a deliberately tiny block pool: total
+    demand (6 requests x 10 tokens) overcommits the 4-usable-block pool
+    (16 KV tokens), so requests queue on block availability and ALL must
+    still complete bit-exactly; a request that can NEVER fit is shed as a
+    typed CapacityError at submit."""
+    import concurrent.futures as cf
+
+    from deeplearning4j_tpu.nn.generation import generate
+    from deeplearning4j_tpu.serve import CapacityError, ContinuousBatcher
+
+    cb = ContinuousBatcher(model, slots=4, capacity=32, block_size=4,
+                           kv_blocks=5, prefill_chunk=8, queue_limit=16,
+                           seed=0)
+    try:
+        rng = np.random.RandomState(42)
+        prompts = [rng.randint(0, 50, (6,)).astype(np.int32)
+                   for _ in range(6)]
+        with cf.ThreadPoolExecutor(6) as ex:
+            outs = list(ex.map(
+                lambda p: cb.generate(p, 4, temperature=0.0), prompts))
+        for p, o in zip(prompts, outs):
+            want = generate(model, p[None], 4, temperature=0.0)[0]
+            assert o.tolist() == want.tolist(), "overcommit corrupted decode"
+        stats = cb.kv_block_stats()
+        assert stats["blocks_used"] == 0, stats  # everything retired
+        try:
+            cb.submit(np.zeros(12, np.int32), 8)  # 20 tokens > 16-token pool
+            raise AssertionError("impossible request was admitted")
+        except CapacityError:
+            pass
+        return stats["blocks_total"]
+    finally:
+        cb.shutdown()
 
 
 def main() -> int:
@@ -65,8 +128,9 @@ def main() -> int:
             jobs.append(("/predict", {"ndarray": ids}))
         for _ in range(GENERATES):
             prompt = rng.randint(0, 50, (int(rng.randint(3, 9)),)).tolist()
-            jobs.append(("/generate", {"prompt": prompt, "max_new_tokens": 4,
-                                       "temperature": 0.0}))
+            jobs.append(("/generate?stream=false",
+                         {"prompt": prompt, "max_new_tokens": 4,
+                          "temperature": 0.0}))
         rng.shuffle(jobs)
         with cf.ThreadPoolExecutor(8) as ex:
             replies = list(ex.map(lambda j: (j, _post(srv.port, *j)), jobs))
@@ -85,6 +149,14 @@ def main() -> int:
                 assert reply["tokens"] == want.tolist(), \
                     (path, body, reply, want)
 
+        # default /generate streams SSE, token-identical to the buffered path
+        sse_prompt = rng.randint(0, 50, (7,)).tolist()
+        sse_body = {"prompt": sse_prompt, "max_new_tokens": 4,
+                    "temperature": 0.0}
+        sse_toks = _sse_generate(srv.port, sse_body)
+        assert sse_toks == _post(srv.port, "/generate?stream=false",
+                                 sse_body)["tokens"], "SSE != buffered"
+
         # bounded executables: engine <= |batch buckets|, batcher <=
         # |prompt buckets| + one decode step
         n_eng = len(srv.engine.compile_signatures)
@@ -92,6 +164,10 @@ def main() -> int:
         bat = srv.batcher()
         n_gen = len(bat.compile_signatures)
         assert n_gen <= len(bat.prompt_buckets) + 1, bat.compile_signatures
+
+        # long-prompt burst overcommitting a tiny pool (separate batcher so
+        # the server's own pool sizing is untouched)
+        pool_blocks = _overcommit_burst(model)
 
         health = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/health", timeout=10).read())
@@ -104,7 +180,8 @@ def main() -> int:
         prom_path = os.path.join(out_dir, "smoke_serve_metrics.prom")
         with open(prom_path, "w") as f:
             f.write(scrape)
-        print(f"smoke_serve: {PREDICTS} predicts + {GENERATES} generates, "
+        print(f"smoke_serve: {PREDICTS} predicts + {GENERATES} generates "
+              f"+ SSE + overcommit burst ({pool_blocks}-block pool), "
               f"{n_eng} engine compile(s), {n_gen} generate compile(s), "
               f"generation {health['generation']} -> {prom_path}")
     finally:

@@ -306,3 +306,75 @@ class TestWindowedDecode:
         of = np.asarray(mf.output(prompt))
         np.testing.assert_allclose(ow[:, :3], of[:, :3], atol=1e-5)  # in-window
         assert np.abs(ow[:, 8:] - of[:, 8:]).max() > 1e-4  # band bites
+
+
+class TestKVCacheContract:
+    """cache_append/cache_read layout contract (serve/paged.py builds on
+    this): the paged pool+block-table cache is observationally identical to
+    the dense cache for every position actually written, and writes past
+    the table — right-padded prefill garbage — land ONLY in trash block 0,
+    never corrupting an allocated block."""
+
+    def _paged(self, B=2, Hkv=2, hd=4, bs=4, maxb=3, tables=None):
+        if tables is None:  # rows own disjoint blocks 1..B*maxb
+            tables = 1 + np.arange(B * maxb).reshape(B, maxb)
+        n = 1 + B * maxb
+        return {"k_pool": jnp.zeros((n, bs, Hkv, hd), jnp.float32),
+                "v_pool": jnp.zeros((n, bs, Hkv, hd), jnp.float32),
+                "tables": jnp.asarray(tables, jnp.int32)}
+
+    def test_paged_matches_dense_scalar_and_vector_pos(self):
+        from deeplearning4j_tpu.nn.generation import cache_append, cache_read
+
+        B, Hkv, hd = 2, 2, 4
+        paged = self._paged()
+        dense = {"k": jnp.zeros((B, 12, Hkv, hd), jnp.float32),
+                 "v": jnp.zeros((B, 12, Hkv, hd), jnp.float32)}
+        rng = np.random.RandomState(0)
+
+        def chunk(T):
+            return (jnp.asarray(rng.randn(B, T, Hkv, hd), jnp.float32),
+                    jnp.asarray(rng.randn(B, T, Hkv, hd), jnp.float32))
+
+        k, v = chunk(5)  # prefill chunk crossing a block edge, scalar pos
+        paged = cache_append(paged, k, v, 0)
+        dense = cache_append(dense, k, v, 0)
+        k, v = chunk(1)  # decode tick at per-row offsets (vector pos)
+        pos = jnp.asarray([5, 3], jnp.int32)
+        paged = cache_append(paged, k, v, pos)
+        dense = cache_append(dense, k, v, pos)
+        pk, pv = cache_read(paged)
+        dk, dv = cache_read(dense)
+        # both start zero-filled, so the FULL logical views must agree
+        assert pk.shape == dk.shape == (B, 12, Hkv, hd)
+        np.testing.assert_array_equal(np.asarray(pk), np.asarray(dk))
+        np.testing.assert_array_equal(np.asarray(pv), np.asarray(dv))
+
+    def test_out_of_table_writes_hit_only_the_trash_block(self):
+        from deeplearning4j_tpu.nn.generation import cache_append, cache_read
+
+        paged = self._paged(maxb=2)  # rows: [1,2], [3,4]; 8 logical slots
+        rng = np.random.RandomState(1)
+        k = jnp.asarray(rng.randn(2, 4, 2, 4), jnp.float32)
+        # positions 6..9: 6,7 are in-table (block row[1], offs 2,3);
+        # 8,9 overflow the table -> must be routed to trash block 0
+        out = cache_append(paged, k, k, 6)
+        rk, _ = cache_read(out)
+        np.testing.assert_array_equal(np.asarray(rk[:, 6:8]),
+                                      np.asarray(k[:, :2]))
+        kp = np.asarray(out["k_pool"])
+        assert np.all(kp[1] == 0) and np.all(kp[3] == 0)  # blocks 0..3 clean
+        assert np.all(kp[2, :2] == 0) and np.all(kp[4, :2] == 0)
+        assert np.abs(kp[0]).sum() > 0  # trash absorbed the overflow
+
+    def test_zero_table_entries_route_to_trash(self):
+        from deeplearning4j_tpu.nn.generation import cache_append
+
+        # second logical block unallocated (table entry 0 = trash): the
+        # batcher's lazy allocator leaves exactly this state mid-request
+        paged = self._paged(maxb=2, tables=[[1, 0], [2, 0]])
+        k = jnp.ones((2, 1, 2, 4), jnp.float32)
+        out = cache_append(paged, k, k, jnp.asarray([4, 4], jnp.int32))
+        kp = np.asarray(out["k_pool"])
+        assert np.all(kp[1] == 0) and np.all(kp[2] == 0)  # real blocks clean
+        assert np.abs(kp[0, 0]).sum() > 0  # landed in trash instead

@@ -13,6 +13,21 @@ The load-bearing properties, each tested directly:
   ``nn.generation.generate`` while slots are reused across > slots requests;
 - the ParallelInference shim regressions: padded partial batches on every
   path (incl. shutdown drain) and no truncation of oversized requests.
+
+Paged-KV + chunked-prefill properties (ISSUE 5):
+
+- block allocator: randomized alloc/free never double-hands a block, the
+  trash block is untouchable, exhaustion is a typed atomic failure;
+- paged greedy decode is BIT-identical to the dense-cache batcher across
+  prompt buckets, chunked and un-chunked;
+- executable bound: ONE decode executable + <= |prompt buckets| prefill
+  chunk executables, asserted on ``_decode_sigs``/``_prefill_sigs``;
+- overcommit: total requested tokens past the pool size queue and complete;
+  a typed ``CapacityError`` only when a single request can NEVER fit;
+- rope capacity decoupling: no ``PositionalEmbedding`` table => per-request
+  capacity may exceed the model's training context;
+- streaming: token-at-a-time ``stream()`` and the SSE ``/generate`` path,
+  including error-after-partial-output and graceful drain mid-stream.
 """
 
 import concurrent.futures as cf
@@ -29,10 +44,12 @@ import pytest
 from deeplearning4j_tpu.nn.layers import Dense, Output
 from deeplearning4j_tpu.nn.model import NetConfig, Sequential
 from deeplearning4j_tpu.parallel import ParallelInference
-from deeplearning4j_tpu.serve import (CapacityError, ContinuousBatcher,
+from deeplearning4j_tpu.serve import (BlockAllocator, CapacityError,
+                                      ContinuousBatcher,
                                       DeadlineExceededError, ModelRegistry,
-                                      ModelServer, ServeEngine,
-                                      ServerClosingError, ShedError)
+                                      ModelServer, PrefillScheduler,
+                                      ServeEngine, ServerClosingError,
+                                      ShedError)
 
 
 def _dense_model(n_in=4, n_out=3, seed=0):
@@ -499,7 +516,7 @@ class TestModelServerHTTP:
             assert out["generation"] == 1
 
             prompt = rng.randint(0, 50, (6,)).tolist()
-            gen = self._post(srv.port, "/generate",
+            gen = self._post(srv.port, "/generate?stream=false",
                              {"prompt": prompt, "max_new_tokens": 4,
                               "temperature": 0.0})
             want_t = generate(lm, np.asarray([prompt], np.int32), 4,
@@ -518,7 +535,10 @@ class TestModelServerHTTP:
             for name in ("serve_queue_depth", "serve_batches_total",
                          "serve_batch_occupancy", "serve_queue_seconds",
                          "serve_device_seconds", "serve_gen_tokens_total",
-                         "serve_compile_misses_total", "http_request_seconds"):
+                         "serve_compile_misses_total", "http_request_seconds",
+                         "serve_kv_blocks_total", "serve_kv_blocks_used",
+                         "serve_kv_block_utilization", "serve_kv_live_bytes",
+                         "serve_prefill_chunks_total"):
                 assert name in scrape, f"{name} missing from /metrics"
         finally:
             srv.stop()
@@ -572,3 +592,291 @@ class TestModelServerHTTP:
         assert len(results) == 4  # all in-flight requests completed with 200
         for r in results:
             assert len(r["output"][0]) == 3
+
+
+class TestBlockAllocator:
+    def test_randomized_alloc_free_invariants(self):
+        from deeplearning4j_tpu.serve.paged import TRASH_BLOCK
+
+        rng = np.random.RandomState(0)
+        a = BlockAllocator(33)  # 32 usable + trash
+        held = {}
+        for step in range(600):
+            if held and rng.rand() < 0.45:
+                key = list(held)[rng.randint(len(held))]
+                a.free(held.pop(key))
+            else:
+                n = int(rng.randint(1, 6))
+                if n <= a.available:
+                    ids = a.alloc(n)
+                    assert TRASH_BLOCK not in ids
+                    out = {b for blocks in held.values() for b in blocks}
+                    assert not set(ids) & out  # never double-handed
+                    held[step] = ids
+                else:
+                    before = (a.used, a.available)
+                    with pytest.raises(CapacityError):
+                        a.alloc(n)
+                    assert (a.used, a.available) == before  # atomic
+            total = sum(len(v) for v in held.values())
+            assert a.used == total
+            assert a.available == a.usable - total  # conservation
+        for ids in held.values():
+            a.free(ids)
+        assert a.available == a.usable == 32  # fully drained, nothing leaked
+
+    def test_lifo_reuse_and_trash_protection(self):
+        a = BlockAllocator(6)
+        ids = a.alloc(4)
+        a.free(ids[:2])
+        # a freed block is the next handed out (compact working set)
+        assert set(a.alloc(2)) == set(ids[:2])
+        with pytest.raises(ValueError, match="double free"):
+            a.free([ids[3], ids[3]])
+        with pytest.raises(ValueError, match="trash"):
+            a.free([0])
+
+    def test_exhaustion_is_typed(self):
+        a = BlockAllocator(4)  # 3 usable
+        a.alloc(2)
+        with pytest.raises(CapacityError):
+            a.alloc(2)
+        assert a.available == 1  # failed alloc took nothing
+
+
+class TestPrefillScheduler:
+    def test_edf_order_and_budget(self):
+        class J:
+            def __init__(self, deadline, enq_t):
+                self.deadline, self.enq_t = deadline, enq_t
+
+        jobs = [J(None, 3.0), J(9.0, 2.0), J(1.0, 4.0), J(None, 1.0)]
+        sched = PrefillScheduler(decode_chunks=2, idle_chunks=3)
+        # deadline-bearing jobs first (earliest deadline), then FIFO
+        busy = sched.plan(jobs, decoding=True)
+        assert [(j.deadline, j.enq_t) for j in busy] == [(1.0, 4.0),
+                                                         (9.0, 2.0)]
+        idle = sched.plan(jobs, decoding=False)
+        assert len(idle) == 3 and idle[-1].enq_t == 1.0
+        with pytest.raises(ValueError):
+            PrefillScheduler(decode_chunks=0)
+
+
+class TestPagedKV:
+    def test_paged_greedy_bit_identical_to_dense(self, lm):
+        """The tentpole equivalence claim: chunked paged decode produces
+        token-for-token identical greedy chains to the dense-cache batcher
+        across prompt buckets (padded AND exact, chunked AND un-chunked)."""
+        dense = ContinuousBatcher(lm, slots=2, capacity=16, kv="dense",
+                                  prompt_buckets=(8, 16), seed=0)
+        chunked = ContinuousBatcher(lm, slots=2, capacity=16, block_size=4,
+                                    prefill_chunk=8, prompt_buckets=(8, 16),
+                                    seed=0)
+        whole = ContinuousBatcher(lm, slots=2, capacity=16, block_size=4,
+                                  prefill_chunk=None, prompt_buckets=(8, 16),
+                                  seed=0)
+        try:
+            rng = np.random.RandomState(7)
+            for tp in (3, 5, 8, 10):  # bucket-8 padded/exact, bucket-16
+                prompt = rng.randint(0, 50, (tp,)).astype(np.int32)
+                want = dense.generate(prompt, 6, temperature=0.0).tolist()
+                assert chunked.generate(
+                    prompt, 6, temperature=0.0).tolist() == want, tp
+                if tp in (5, 8):  # un-chunked: padded + exact suffice
+                    assert whole.generate(
+                        prompt, 6, temperature=0.0).tolist() == want, tp
+        finally:
+            dense.shutdown()
+            chunked.shutdown()
+            whole.shutdown()
+
+    def test_one_decode_executable_bounded_prefill_chunks(self, lm):
+        buckets = (8, 16)
+        cb = ContinuousBatcher(lm, slots=3, capacity=16, block_size=4,
+                               prefill_chunk=8, prompt_buckets=buckets,
+                               queue_limit=16, seed=0)
+        try:
+            rng = np.random.RandomState(11)
+            prompts = [rng.randint(0, 50, (tp,)).astype(np.int32)
+                       for tp in (1, 3, 5, 8, 9, 10, 7, 2)]
+            with cf.ThreadPoolExecutor(8) as ex:
+                list(ex.map(
+                    lambda p: cb.generate(p, 4, temperature=0.0), prompts))
+            # ONE decode executable for the server's lifetime...
+            assert cb._decode_sigs == {("decode", 3)}, cb._decode_sigs
+            # ...and at most |prompt buckets| prefill-chunk executables
+            assert len(cb._prefill_sigs) <= len(buckets), cb._prefill_sigs
+        finally:
+            cb.shutdown()
+
+    def test_overcommit_queues_and_completes(self, lm):
+        from deeplearning4j_tpu.nn.generation import generate
+
+        # pool = 8 usable blocks x 4 tokens = 32 KV tokens, but slots x
+        # capacity = 64: the dense layout's reservation would not fit.
+        # 6 requests x 8 tokens = 48 live tokens demanded over the run —
+        # paging + worst-case admission makes them queue and ALL complete.
+        cb = ContinuousBatcher(lm, slots=4, capacity=16, block_size=4,
+                               kv_blocks=9, prefill_chunk=None,
+                               queue_limit=32, seed=0)
+        try:
+            rng = np.random.RandomState(13)
+            prompts = [rng.randint(0, 50, (4,)).astype(np.int32)
+                       for _ in range(6)]
+            with cf.ThreadPoolExecutor(6) as ex:
+                outs = list(ex.map(
+                    lambda p: cb.generate(p, 4, temperature=0.0), prompts))
+            for p, o in zip(prompts, outs):
+                want = generate(lm, p[None], 4, temperature=0.0)[0]
+                assert np.array_equal(o, want)
+            stats = cb.kv_block_stats()
+            assert stats["blocks_used"] == 0  # every block retired
+            assert stats["blocks_committed"] == 0
+        finally:
+            cb.shutdown()
+
+    def test_impossible_request_sheds_typed_capacity_error(self, lm):
+        # 2 usable blocks x 4 = 8 KV tokens total
+        cb = ContinuousBatcher(lm, slots=1, capacity=16, block_size=4,
+                               kv_blocks=3, seed=0)
+        try:
+            with pytest.raises(CapacityError, match="KV blocks"):
+                cb.submit(np.zeros(8, np.int32), 4)  # 12 tokens NEVER fit
+            # a fitting request on the same batcher still succeeds
+            out = cb.generate(np.arange(1, 5, dtype=np.int32), 4,
+                              temperature=0.0)
+            assert out.shape == (4,)
+        finally:
+            cb.shutdown()
+
+    def test_live_kv_gauges_track_allocation(self, lm):
+        from deeplearning4j_tpu.serve.paged import block_bytes
+
+        cb = ContinuousBatcher(lm, slots=1, capacity=64, block_size=4,
+                               seed=0)
+        try:
+            req = cb.submit(np.arange(1, 9, dtype=np.int32), 40,
+                            temperature=0.0)
+            peak, deadline = 0, time.time() + 30
+            while time.time() < deadline:
+                stats = cb.kv_block_stats()
+                peak = max(peak, stats["blocks_used"])
+                if req.event.is_set():
+                    break
+                time.sleep(0.001)
+            req.wait()
+            # mid-flight usage covered at least the prompt's blocks and
+            # live bytes scale with the allocator, not slots x capacity
+            assert peak >= 2, peak
+            assert cb.kv_block_stats()["blocks_used"] == 0
+            assert cb.kv_block_stats()["live_bytes"] == 0
+            per_block = block_bytes(lm, 4, np.float32)
+            assert cb.metrics.gauge("serve_kv_blocks_total").value \
+                == cb.kv_block_stats()["blocks_total"]
+            assert per_block > 0
+        finally:
+            cb.shutdown()
+
+    def test_rope_capacity_decoupled_from_positional_table(self, lm):
+        from deeplearning4j_tpu.models import CausalLM
+
+        # learned positions: capacity is pinned to the embedding table
+        with pytest.raises(ValueError, match="[Pp]ositional"):
+            ContinuousBatcher(lm, slots=1, capacity=1024)
+        # rope has NO table: per-request capacity may exceed the model's
+        # build-time sequence length (16), bounded only by KV blocks
+        rope = CausalLM(seed=0, input_shape=(16,), num_layers=1, d_model=32,
+                        num_heads=4, vocab=50, pos="rope").build()
+        rope.init()
+        cb = ContinuousBatcher(rope, slots=1, capacity=1024, block_size=16,
+                               prompt_buckets=(16,), seed=0)
+        try:
+            out = cb.generate(np.arange(1, 7, dtype=np.int32), 4,
+                              temperature=0.0)
+            assert out.shape == (4,)
+        finally:
+            cb.shutdown()
+
+
+class TestStreaming:
+    def test_stream_yields_tokens_matching_generate(self, lm):
+        cb = ContinuousBatcher(lm, slots=1, capacity=16, seed=0)
+        try:
+            p = np.arange(2, 8, dtype=np.int32)
+            want = cb.generate(p, 6, temperature=0.0).tolist()
+            assert list(cb.stream(p, 6, temperature=0.0)) == want
+        finally:
+            cb.shutdown()
+
+    def test_stream_raises_typed_error_while_queued(self, lm):
+        cb = ContinuousBatcher(lm, slots=1, capacity=16, queue_limit=8,
+                               seed=0)
+        try:
+            blocker = cb.submit(np.arange(1, 9, dtype=np.int32), 8,
+                                temperature=0.0)  # occupies the only slot
+            doomed = cb.submit(np.arange(1, 5, dtype=np.int32), 4,
+                               temperature=0.0, timeout_ms=0.5)
+            with pytest.raises(DeadlineExceededError):
+                list(doomed.stream())
+            assert blocker.wait().shape == (8,)
+        finally:
+            cb.shutdown()
+
+    def test_stream_completes_through_drain(self, lm):
+        cb = ContinuousBatcher(lm, slots=1, capacity=16, seed=0)
+        p = np.arange(3, 9, dtype=np.int32)
+        want = cb.generate(p, 8, temperature=0.0).tolist()
+        it = cb.stream(p, 8, temperature=0.0)
+        got = [next(it)]  # stream is live...
+        closer = threading.Thread(target=cb.shutdown, kwargs={"drain": True})
+        closer.start()     # ...when drain begins
+        got.extend(it)     # drain finishes the in-flight stream, not cuts it
+        closer.join(30)
+        assert got == want
+
+    def test_http_sse_streams_per_token(self, lm):
+        srv = ModelServer(lm, port=0, input_dtype=np.int32, gen_slots=2,
+                          gen_capacity=16).start()
+        try:
+            body = {"prompt": list(range(2, 8)), "max_new_tokens": 5,
+                    "temperature": 0.0}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            events = []
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.headers["Content-Type"] == "text/event-stream"
+                for line in r:
+                    if line.startswith(b"data: "):
+                        events.append(json.loads(line[len(b"data: "):]))
+            assert events[-1]["done"] is True
+            toks = [e["token"] for e in events[:-1]]
+            assert len(toks) == 5 and events[-1]["tokens"] == toks
+            # buffered answer agrees with the streamed one
+            breq = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate?stream=false",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(breq, timeout=30) as r:
+                assert json.loads(r.read())["tokens"] == toks
+        finally:
+            srv.stop()
+
+    def test_http_admission_error_is_typed_not_streamed(self, lm):
+        srv = ModelServer(lm, port=0, input_dtype=np.int32, gen_slots=1,
+                          gen_capacity=16).start()
+        try:
+            body = {"prompt": list(range(1, 15)), "max_new_tokens": 8}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            # 14 + 8 > capacity: refused BEFORE the stream starts as a
+            # typed status, not an SSE body
+            assert ei.value.code == 400  # CapacityError
+            assert json.loads(ei.value.read())["cause"] == "over_capacity"
+        finally:
+            srv.stop()
