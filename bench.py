@@ -333,6 +333,21 @@ def _bench_chunked_prefill(model, seconds):
     return {"chunked": chunked, "unchunked": whole}
 
 
+def _next_round_path(prefix: str) -> str:
+    """Next free ``<prefix>_rNN.json`` in the repo root: scans existing
+    rounds and increments, so successive captures never clobber each other
+    (the serve bench used to hardcode r01)."""
+    import glob
+    import re
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    pat = re.compile(rf"{re.escape(prefix)}_r(\d+)\.json$")
+    rounds = [int(m.group(1))
+              for p in glob.glob(os.path.join(root, f"{prefix}_r*.json"))
+              for m in [pat.search(os.path.basename(p))] if m]
+    return os.path.join(root, f"{prefix}_r{max(rounds, default=0) + 1:02d}.json")
+
+
 def _bench_serving():
     """``python bench.py --serve``: serving-path latency/throughput.
 
@@ -341,9 +356,9 @@ def _bench_serving():
     generations at a ContinuousBatcher on a small CausalLM. Then a mixed
     prompt-burst scenario compares chunked vs whole-prompt prefill on the
     paged batcher (p99 inter-token latency + peak live-KV bytes). Prints
-    ONE JSON line and writes the full record to BENCH_serve_r01.json.
-    Env: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_SECONDS (5),
-    BENCH_SERVE_GENERATES (8).
+    ONE JSON line and writes the full record to the next free
+    BENCH_serve_rNN.json. Env: BENCH_SERVE_CLIENTS (8),
+    BENCH_SERVE_SECONDS (5), BENCH_SERVE_GENERATES (8).
     """
     import concurrent.futures as cf
     import threading
@@ -419,11 +434,94 @@ def _bench_serving():
         },
     }
     print(json.dumps(headline), flush=True)
-    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_serve_r01.json")
+    out_path = _next_round_path("BENCH_serve")
     with open(out_path, "w") as f:
         json.dump(headline, f, indent=1)
     print(f"bench serve -> {out_path}", file=sys.stderr)
+
+
+def _bench_coldstart():
+    """``python bench.py --coldstart``: time-to-first-token, cold vs warm
+    AOT store.
+
+    Boots the full serving stacks (ServeEngine + paged ContinuousBatcher)
+    twice against ONE store directory (BENCH_COLDSTART_STORE or a fresh
+    temp dir). Run 1 is cold: every executable is traced and persisted.
+    Run 2 loads them back from disk — zero decode-path XLA compiles,
+    asserted via the compile-miss counter. Honesty note: run 2 also sees
+    the process-level JAX_COMPILATION_CACHE_DIR set at the top of this
+    file, which accelerates *re-tracing*; the store win measured here is
+    skipping tracing altogether, so both numbers are reported side by
+    side. Writes the next free BENCH_coldstart_rNN.json.
+    """
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.aot import AotStore
+    from deeplearning4j_tpu.models import CausalLM
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.serve import ContinuousBatcher, ServeEngine
+
+    store_dir = (os.environ.get("BENCH_COLDSTART_STORE")
+                 or tempfile.mkdtemp(prefix="dl4j_aot_"))
+    dev = jax.devices()[0]
+
+    def run():
+        model = CausalLM(seed=0, input_shape=(32,), num_layers=2, d_model=64,
+                         num_heads=4, vocab=256).build()
+        model.init()
+        m = MetricsRegistry()
+        store = AotStore(store_dir)
+        t0 = time.perf_counter()
+        eng = ServeEngine(model, batch_buckets=(1, 2, 4, 8), metrics=m,
+                          aot_store=store)
+        eng.warm(np.int32)
+        cb = ContinuousBatcher(model, slots=4, capacity=32,
+                               prompt_buckets=(8, 16), metrics=m,
+                               aot_store=store)
+        boot_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        handle = cb.submit(np.arange(12, dtype=np.int32) % 256, 8,
+                           temperature=0.0)
+        next(iter(handle.stream()))  # time-to-first-token
+        ttft = time.perf_counter() - t1
+        handle.wait()
+        t2 = time.perf_counter()
+        eng.predict(np.zeros((1, 32), np.int32))
+        predict_s = time.perf_counter() - t2
+        cb.shutdown()
+        eng.shutdown()
+        snap = m.snapshot()
+
+        def total(name):
+            return sum(s["value"]
+                       for s in snap.get(name, {}).get("series", []))
+
+        return {"boot_seconds": round(boot_s, 3),
+                "ttft_seconds": round(ttft, 4),
+                "first_predict_seconds": round(predict_s, 4),
+                "aot_hits": total("serve_aot_hits_total"),
+                "aot_misses": total("serve_aot_misses_total"),
+                "aot_fallbacks": total("serve_aot_fallback_total"),
+                "compile_misses": total("serve_compile_misses_total")}
+
+    cold = run()
+    warm = run()
+    headline = {
+        "metric": "serve_cold_start_speedup",
+        "value": round(cold["boot_seconds"] / max(warm["boot_seconds"], 1e-9),
+                       2),
+        "unit": "x",
+        "detail": {"store": store_dir, "cold": cold, "warm": warm,
+                   "device": str(dev.device_kind),
+                   "captured": time.strftime("%Y-%m-%d")},
+    }
+    print(json.dumps(headline), flush=True)
+    out_path = _next_round_path("BENCH_coldstart")
+    with open(out_path, "w") as f:
+        json.dump(headline, f, indent=1)
+    print(f"bench coldstart -> {out_path}", file=sys.stderr)
 
 
 def main():
@@ -513,5 +611,8 @@ if __name__ == "__main__":
     if "--serve" in sys.argv[1:]:
         _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
         _bench_serving()
+    elif "--coldstart" in sys.argv[1:]:
+        _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
+        _bench_coldstart()
     else:
         main()

@@ -1,0 +1,32 @@
+"""Persistent AOT executable store (the Relay/TVM compile-once,
+deploy-many lesson applied to the serving tier — PAPERS.md 1810.00952 /
+1802.04799).
+
+Every process start and registry hot-swap used to re-trace and re-compile
+the serving executables from scratch. This package lowers them once
+(``jax.jit(...).lower().compile()``), serializes the compiled artifacts
+(``jax.experimental.serialize_executable``), and keys them by everything
+that shaped the compile — so replicas boot from disk in seconds and a
+publish warms the incoming generation *before* traffic flips onto it.
+
+- :mod:`~.keys` — deterministic cache keys (jax/jaxlib, backend +
+  topology, model-arch hash, bucket signature, donation spec)
+- :mod:`~.store` — content-addressed on-disk store: atomic
+  write-then-rename, index manifest, LRU GC, corrupt-entry quarantine
+- :mod:`~.compile` — the serialize round-trip and :class:`AotFunction`,
+  the store-backed wrapper ``serve/`` executes through; any store failure
+  degrades to live tracing (counted on ``serve_aot_fallback_total``)
+
+``python -m deeplearning4j_tpu.aot`` prebuilds, lists, verifies, and GCs
+a store from the command line.
+"""
+
+from .compile import AotFunction, deserialize_compiled, serialize_compiled
+from .keys import arch_fingerprint, cache_key, call_signature, \
+    runtime_fingerprint
+from .store import AotCorruptEntry, AotStore, AotStoreError, AotVersionError
+
+__all__ = ["AotCorruptEntry", "AotFunction", "AotStore", "AotStoreError",
+           "AotVersionError", "arch_fingerprint", "cache_key",
+           "call_signature", "deserialize_compiled", "runtime_fingerprint",
+           "serialize_compiled"]

@@ -1,0 +1,155 @@
+"""CLI for the persistent AOT executable store.
+
+::
+
+    python -m deeplearning4j_tpu.aot --store DIR list
+    python -m deeplearning4j_tpu.aot --store DIR stats
+    python -m deeplearning4j_tpu.aot --store DIR verify
+    python -m deeplearning4j_tpu.aot --store DIR gc [--max-bytes N]
+    python -m deeplearning4j_tpu.aot --store DIR prebuild --model causallm \
+        --model-kwargs '{"input_shape":[16],"num_layers":2,"d_model":32,
+                         "num_heads":4,"vocab":50}' \
+        --slots 4 --capacity 16 --batch-buckets 1,2,4,8
+
+``prebuild`` boots the real serving stacks (``ServeEngine`` +
+``ContinuousBatcher``) against the store with warm-at-construction on, so
+the exact executables a replica will need are compiled and persisted
+*now* — a new replica (or the next hot-swap) then boots from disk instead
+of the tracer. Run it on the same jax/jaxlib + device topology the fleet
+serves on; the cache keys make a mismatched prebuild a harmless miss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .store import AotStore
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _cmd_list(store: AotStore, _args) -> int:
+    entries = store.entries()
+    if not entries:
+        print("(empty store)")
+        return 0
+    for key in sorted(entries, key=lambda k: -entries[k].get("used", 0.0)):
+        e = entries[key]
+        meta = e.get("meta") or {}
+        print(f"{key[:16]}  {_fmt_bytes(e['size']):>10}  "
+              f"tag={meta.get('tag', '?')}  arch={meta.get('arch', '?')}")
+    print(f"-- {len(entries)} entries, "
+          f"{_fmt_bytes(sum(e['size'] for e in entries.values()))}")
+    return 0
+
+
+def _cmd_stats(store: AotStore, _args) -> int:
+    print(json.dumps(store.stats(), indent=1))
+    return 0
+
+
+def _cmd_verify(store: AotStore, _args) -> int:
+    out = store.verify()
+    print(f"ok={len(out['ok'])} quarantined={len(out['quarantined'])}")
+    for key in out["quarantined"]:
+        print(f"quarantined: {key}")
+    return 1 if out["quarantined"] else 0
+
+
+def _cmd_gc(store: AotStore, args) -> int:
+    evicted = store.gc(max_bytes=args.max_bytes)
+    print(f"evicted {len(evicted)} entries")
+    return 0
+
+
+def _cmd_rebuild_index(store: AotStore, _args) -> int:
+    print(f"indexed {store.rebuild_index()} entries")
+    return 0
+
+
+def _cmd_prebuild(store: AotStore, args) -> int:
+    import numpy as np
+
+    from ..models import model_by_name
+    from ..obs.metrics import MetricsRegistry
+    from ..serve import ContinuousBatcher, ServeEngine
+
+    kwargs = json.loads(args.model_kwargs) if args.model_kwargs else {}
+    model = model_by_name(args.model, seed=args.seed, **kwargs).init()
+    metrics = MetricsRegistry()
+    buckets = tuple(int(b) for b in args.batch_buckets.split(","))
+
+    eng = ServeEngine(model, batch_buckets=buckets, aot_store=store,
+                      metrics=metrics)
+    try:
+        eng.warm(np.dtype(args.dtype))
+    finally:
+        eng.shutdown()
+    warmed = ["engine"]
+    try:
+        cb = ContinuousBatcher(model, slots=args.slots,
+                               capacity=args.capacity,
+                               block_size=args.block_size,
+                               prefill_chunk=args.prefill_chunk,
+                               aot_store=store, metrics=metrics)
+        cb.shutdown()  # warm-at-construction already persisted everything
+        warmed.append("generate")
+    except ValueError as e:
+        # non-token model: no generation stack to prebuild — predict only
+        print(f"prebuild: skipping generation stack ({e})", file=sys.stderr)
+    cold = {s["labels"].get("component"): s["value"]
+            for s in metrics.snapshot().get(
+                "serve_cold_start_seconds", {}).get("series", [])}
+    print(json.dumps({"model": args.model, "warmed": warmed,
+                      "cold_start_seconds": cold,
+                      "store": store.stats()}, indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.aot",
+        description="persistent AOT executable store maintenance")
+    p.add_argument("--store", default=os.environ.get("DL4J_TPU_AOT_STORE"),
+                   help="store root directory (or $DL4J_TPU_AOT_STORE)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list", help="list entries, most recently used first")
+    sub.add_parser("stats", help="entry/byte/quarantine totals as JSON")
+    sub.add_parser("verify", help="integrity-check (and quarantine) entries")
+    sub.add_parser("rebuild-index", help="regenerate the manifest from disk")
+    gc = sub.add_parser("gc", help="LRU-evict down to the size bound")
+    gc.add_argument("--max-bytes", type=int, default=None)
+    pb = sub.add_parser("prebuild",
+                        help="compile + persist a model's serving executables")
+    pb.add_argument("--model", required=True,
+                    help="zoo model name (e.g. causallm)")
+    pb.add_argument("--model-kwargs", default="",
+                    help="JSON kwargs for the zoo constructor")
+    pb.add_argument("--seed", type=int, default=0)
+    pb.add_argument("--slots", type=int, default=4)
+    pb.add_argument("--capacity", type=int, default=256)
+    pb.add_argument("--block-size", type=int, default=16)
+    pb.add_argument("--prefill-chunk", type=int, default=64)
+    pb.add_argument("--batch-buckets", default="1,2,4,8,16,32")
+    pb.add_argument("--dtype", default="int32",
+                    help="predict-path input dtype to warm")
+    args = p.parse_args(argv)
+    if not args.store:
+        p.error("--store (or $DL4J_TPU_AOT_STORE) is required")
+    store = AotStore(args.store)
+    return {"list": _cmd_list, "stats": _cmd_stats, "verify": _cmd_verify,
+            "gc": _cmd_gc, "rebuild-index": _cmd_rebuild_index,
+            "prebuild": _cmd_prebuild}[args.cmd](store, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

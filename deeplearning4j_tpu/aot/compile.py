@@ -1,0 +1,219 @@
+"""AOT compile/serialize round-trip and the store-backed function wrapper.
+
+The serving tier's contract is a *bounded executable set*; this module
+makes that set *persistent*. :class:`AotFunction` wraps one jitted
+function and resolves each call signature in order:
+
+1. in-memory executable map (steady state: one dict lookup),
+2. the persistent :class:`~.store.AotStore` — ``deserialize_and_load`` of
+   an executable some earlier process compiled (cold-start/hot-swap win),
+3. live ``jit(...).lower(...).compile()`` — the normal tracing path,
+   whose result is serialized back into the store for the next boot.
+
+The hard rule: **every failure in (2) degrades to (3)** — a corrupt
+entry, a jax/jaxlib version skew, an unpicklable payload, a store I/O
+error. Each is counted on ``serve_aot_fallback_total{cause=...}`` and
+costs one trace, exactly what the process would have paid with no store
+at all. ``serve_aot_hits_total`` / ``serve_aot_misses_total`` make the
+cold-start win measurable.
+
+``warm()`` ensures an executable *exists* (store hit or fresh compile)
+without executing it — safe for donated operands and abstract
+``jax.ShapeDtypeStruct`` arguments — which is what lets
+``ModelRegistry.publish`` precompile an incoming generation against every
+live bucket signature *before* flipping traffic onto it.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+from .keys import arch_fingerprint, cache_key, call_signature, \
+    runtime_fingerprint
+from .store import AotCorruptEntry, AotStore, AotStoreError, AotVersionError
+
+_BLOB_SCHEMA = 1
+
+
+def serialize_compiled(compiled) -> bytes:
+    """One compiled executable -> portable bytes (payload + arg pytrees +
+    the jax/jaxlib pair that built it, double-checked at load time)."""
+    import jax
+    import jaxlib
+    from jax.experimental import serialize_executable as se
+
+    payload, in_tree, out_tree = se.serialize(compiled)
+    return pickle.dumps({"schema": _BLOB_SCHEMA, "jax": jax.__version__,
+                         "jaxlib": jaxlib.__version__,
+                         "exe": (payload, in_tree, out_tree)})
+
+
+def deserialize_compiled(blob: bytes):
+    """Bytes -> loaded executable. Raises :class:`AotVersionError` on a
+    jax/jaxlib skew (the key scheme should already have missed; this is
+    defense in depth), or whatever the unpickler raises on garbage — the
+    caller maps every failure to a counted fallback."""
+    import jax
+    import jaxlib
+    from jax.experimental import serialize_executable as se
+
+    rec = pickle.loads(blob)
+    if not isinstance(rec, dict) or rec.get("schema") != _BLOB_SCHEMA:
+        raise AotStoreError("unrecognized AOT payload schema")
+    if rec.get("jax") != jax.__version__ \
+            or rec.get("jaxlib") != jaxlib.__version__:
+        raise AotVersionError(
+            f"executable built by jax {rec.get('jax')}/jaxlib "
+            f"{rec.get('jaxlib')}, running {jax.__version__}/"
+            f"{jaxlib.__version__}")
+    return se.deserialize_and_load(*rec["exe"])
+
+
+class AotFunction:
+    """Store-backed drop-in for a jitted function.
+
+    ``fn`` must expose ``.lower`` (a ``jax.jit`` result); anything else —
+    e.g. a test's plain-python forward override — passes through untouched
+    with the store disabled. ``donate_argnums`` only *keys* the cache (the
+    aliasing contract is baked into ``fn`` itself); ``compile_counter`` is
+    incremented on live traces only, so a warm boot reads as zero compile
+    misses on the serving counters.
+    """
+
+    def __init__(self, fn: Callable, *, tag: str,
+                 store: Optional[AotStore] = None, metrics=None,
+                 arch: str = "", component: str = "serve",
+                 donate_argnums: Sequence[int] = (),
+                 compile_counter=None):
+        self._fn = fn
+        self.tag = tag
+        self.store = store if hasattr(fn, "lower") else None
+        self.arch = arch
+        self.donate = tuple(donate_argnums)
+        self._compile_counter = compile_counter
+        self._runtime = None  # resolved lazily: jax may not be booted yet
+        self._exes: dict = {}
+        self._lock = threading.RLock()
+        self._acquire_seconds = 0.0
+        if metrics is not None and self.store is not None:
+            labels = {"component": component}
+            self._m_hits = metrics.counter(
+                "serve_aot_hits_total", labels,
+                help="executables loaded from the persistent AOT store")
+            self._m_misses = metrics.counter(
+                "serve_aot_misses_total", labels,
+                help="AOT store lookups that found no entry")
+            self._m_fallback = lambda cause: metrics.counter(
+                "serve_aot_fallback_total", {**labels, "cause": cause},
+                help="store entries abandoned for live tracing, by cause")
+        else:
+            from ..obs.metrics import MetricsRegistry
+
+            null = MetricsRegistry(enabled=False)
+            self._m_hits = null.counter("serve_aot_hits_total")
+            self._m_misses = null.counter("serve_aot_misses_total")
+            self._m_fallback = lambda cause: null.counter(
+                "serve_aot_fallback_total")
+
+    # ------------------------------------------------------------------ calls
+    def __call__(self, *args):
+        if self.store is None:
+            return self._fn(*args)
+        sig = call_signature(args)
+        with self._lock:
+            exe = self._exes.get(sig)
+        if exe is None:
+            exe = self._acquire(sig, args)
+        return exe(*args)
+
+    def warm(self, *args) -> bool:
+        """Ensure the executable for this signature exists (store hit or
+        fresh compile) WITHOUT executing it. Accepts
+        ``jax.ShapeDtypeStruct`` leaves. Returns True when AOT-capable."""
+        if self.store is None:
+            return False
+        sig = call_signature(args)
+        with self._lock:
+            if sig not in self._exes:
+                self._acquire(sig, args)
+        return True
+
+    @property
+    def executables(self) -> dict:
+        """Signature -> loaded executable (diagnostic)."""
+        with self._lock:
+            return dict(self._exes)
+
+    @property
+    def acquire_seconds(self) -> float:
+        """Cumulative wall time spent loading/compiling executables — the
+        cold-start cost this wrapper exists to amortize."""
+        with self._lock:
+            return self._acquire_seconds
+
+    # ---------------------------------------------------------------- acquire
+    def _key(self, sig: Tuple[str, ...]) -> str:
+        if self._runtime is None:
+            self._runtime = runtime_fingerprint()
+        return cache_key(self.tag, self.arch, sig, donate=self.donate,
+                         runtime=self._runtime)
+
+    def _acquire(self, sig: Tuple[str, ...], args: Sequence[Any]):
+        """Store -> live trace, under the lock (a concurrent publish warm
+        and the dispatch thread must not double-compile one signature)."""
+        with self._lock:
+            exe = self._exes.get(sig)
+            if exe is not None:
+                return exe
+            t0 = time.perf_counter()
+            key = self._key(sig)
+            exe = self._load(key)
+            if exe is None:
+                exe = self._fn.lower(*args).compile()
+                if self._compile_counter is not None:
+                    self._compile_counter.inc()  # a real trace happened
+                self._save(key, exe)
+            self._exes[sig] = exe
+            self._acquire_seconds += time.perf_counter() - t0
+            return exe
+
+    def _load(self, key: str):
+        try:
+            blob = self.store.get(key)
+        except AotCorruptEntry:
+            self._m_fallback("corrupt").inc()
+            return None
+        except AotStoreError:
+            self._m_fallback("store_read").inc()
+            return None
+        if blob is None:
+            self._m_misses.inc()
+            return None
+        try:
+            exe = deserialize_compiled(blob)
+        except AotVersionError:
+            self._m_fallback("version").inc()
+            return None
+        except Exception:  # any bad payload degrades to tracing, never crashes  # jaxlint: disable=broad-except
+            self._m_fallback("deserialize").inc()
+            return None
+        self._m_hits.inc()
+        return exe
+
+    def _save(self, key: str, exe) -> None:
+        try:
+            blob = serialize_compiled(exe)
+        except Exception:  # unserializable backend/executable: serve live  # jaxlint: disable=broad-except
+            self._m_fallback("serialize").inc()
+            return
+        if not self.store.put(key, blob,
+                              meta={"tag": self.tag, "arch": self.arch}):
+            self._m_fallback("store_write").inc()
+
+
+def arch_of(params, state=None) -> str:
+    """Convenience re-export: the model-architecture key component."""
+    return arch_fingerprint(params, state)

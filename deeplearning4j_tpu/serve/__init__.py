@@ -11,19 +11,23 @@ Layering:
 - :mod:`~.http` — predict/generate/health/ready/metrics front door
 - :mod:`~.errors` — the typed failure surface
 
+Every tier accepts ``aot_store=`` (an :class:`~..aot.AotStore`) to load
+its executables from disk before tracing — instant cold starts and
+publish-time warming of the incoming generation (see ``aot/README.md``).
+
 ``parallel.ParallelInference`` and ``streaming.InferenceRoute`` are
 compatibility shims over these.
 """
 
 from .continuous import ContinuousBatcher
 from .engine import PrefillScheduler, ServeEngine
-from .errors import (CapacityError, DeadlineExceededError, ServeError,
-                     ServerClosingError, ShedError)
+from .errors import (CapacityError, DeadlineExceededError, PublishError,
+                     ServeError, ServerClosingError, ShedError)
 from .http import ModelServer
 from .paged import BlockAllocator, SlotPages
 from .registry import ModelRegistry, ModelSnapshot
 
 __all__ = ["BlockAllocator", "CapacityError", "ContinuousBatcher",
            "DeadlineExceededError", "ModelRegistry", "ModelServer",
-           "ModelSnapshot", "PrefillScheduler", "ServeEngine", "ServeError",
-           "ServerClosingError", "ShedError", "SlotPages"]
+           "ModelSnapshot", "PrefillScheduler", "PublishError", "ServeEngine",
+           "ServeError", "ServerClosingError", "ShedError", "SlotPages"]

@@ -187,7 +187,8 @@ class ContinuousBatcher:
                  prefill_chunk: Optional[int] = 64,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  queue_limit: int = 64, seed: int = 0, metrics=None,
-                 scheduler: Optional[PrefillScheduler] = None):
+                 scheduler: Optional[PrefillScheduler] = None,
+                 aot_store=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -447,9 +448,88 @@ class ContinuousBatcher:
                 help="prefill chunks executed")
             self._update_kv_gauges()
 
+        # --- persistent AOT store (optional): every generation executable
+        # loads from disk before tracing, and is warmed eagerly so the
+        # decode loop never traces in the request path after boot ---
+        self._aot = None
+        if aot_store is not None:
+            from ..aot import AotFunction, arch_fingerprint
+
+            snap0 = self.registry.current()
+            arch = arch_fingerprint(snap0.params, snap0.state)
+
+            def _wrap(fn, tag, donate=()):
+                return AotFunction(
+                    fn, tag=tag, store=aot_store, metrics=m, arch=arch,
+                    component="generate", donate_argnums=donate,
+                    compile_counter=self._m_compiles)
+
+            self._sample = _wrap(self._sample, "gen_sample")
+            if kv == "paged":
+                self._prefill_paged = _wrap(self._prefill_paged,
+                                            "gen_prefill_chunk", (3,))
+                self._decode = _wrap(self._decode, "gen_decode_paged", (3,))
+            else:
+                self._prefill = _wrap(self._prefill, "gen_prefill_dense")
+                self._slot_insert = _wrap(self._slot_insert,
+                                          "gen_slot_insert", (0,))
+                self._decode = _wrap(self._decode, "gen_decode_dense", (3,))
+            self._aot = aot_store
+            t0 = time.perf_counter()
+            self._warm_for(snap0.params, snap0.state)
+            m.gauge("serve_cold_start_seconds", {"component": "generate"},
+                    help="wall time to materialize the serving executables"
+                    ).set(time.perf_counter() - t0)
+            # precompile-before-flip: publish warms the candidate against
+            # the full decode/prefill/sample executable set
+            self.registry.add_warmer(self._warm_for)
+
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-continuous-batcher")
         self._thread.start()
+
+    # ---------------------------------------------------------------- warming
+    def _warm_for(self, params, state) -> None:
+        """Load-or-compile the full static executable set for one params
+        generation — the lifetime decode step, every prefill bucket, and
+        the sampler — via abstract shapes (nothing executes, nothing is
+        donated). Runs at construction for the current generation and as a
+        registry warmer for each publish candidate."""
+        import jax
+
+        S, V = self.slots, self.vocab
+        sds = jax.ShapeDtypeStruct
+
+        def abstract(tree):
+            return jax.tree.map(lambda a: sds(a.shape, a.dtype), tree)
+
+        i32, f32, u32 = np.int32, np.float32, np.uint32
+        self._sample.warm(sds((V,), f32), sds((2,), u32), sds((), f32),
+                          sds((), i32))
+        if self.kv == "paged":
+            pools = abstract(self._pools)
+            self._decode.warm(params, state, sds((S,), i32), pools,
+                              sds((S, self._maxb), i32), sds((S,), i32),
+                              sds((S, 2), u32), sds((S,), f32),
+                              sds((S,), i32))
+            for b in self._chunk_buckets:
+                self._prefill_paged.warm(
+                    params, state, sds((1, b), i32), pools,
+                    sds((1, self._maxb), i32), sds((1,), i32),
+                    sds((), i32))
+        else:
+            from ..nn.generation import init_caches
+
+            caches = abstract(self._caches)
+            cache1 = abstract(init_caches(self.model, 1, self.capacity,
+                                          self.model.dtype))
+            self._decode.warm(params, state, sds((S,), i32), caches,
+                              sds((S,), i32), sds((S, 2), u32),
+                              sds((S,), f32), sds((S,), i32))
+            self._slot_insert.warm(caches, cache1, sds((), i32))
+            for b in self.prompt_buckets:
+                self._prefill.warm(params, state, sds((1, b), i32),
+                                   sds((), i32))
 
     # ------------------------------------------------------------------ admit
     def _shed_counter(self, cause: str):
@@ -639,7 +719,8 @@ class ContinuousBatcher:
             sig = ("prefill", bucket)
             if sig not in self._prefill_sigs:
                 self._prefill_sigs.add(sig)
-                self._m_compiles.inc()
+                if self._aot is None:  # with a store, AotFunction counts real traces
+                    self._m_compiles.inc()
         if job.idx == len(job.chunks):
             self._finish_prefill(job)
 
@@ -707,7 +788,8 @@ class ContinuousBatcher:
             sig = ("prefill", bucket)
             if sig not in self._prefill_sigs:
                 self._prefill_sigs.add(sig)
-                self._m_compiles.inc()
+                if self._aot is None:  # with a store, AotFunction counts real traces
+                    self._m_compiles.inc()
             req.slot = s
             req.key = None
             self._slot_req[s] = req
@@ -798,7 +880,8 @@ class ContinuousBatcher:
             sig = ("decode", self.slots)
             if sig not in self._decode_sigs:
                 self._decode_sigs.add(sig)
-                self._m_compiles.inc()
+                if self._aot is None:  # with a store, AotFunction counts real traces
+                    self._m_compiles.inc()
             for s in active:
                 req = self._slot_req[s]
                 if req is None:

@@ -141,7 +141,8 @@ class ServeEngine:
                  length_buckets: Optional[Sequence[int]] = None,
                  queue_limit: int = 256, max_wait_ms: float = 2.0,
                  default_timeout_ms: Optional[float] = None,
-                 admission: str = "shed", metrics=None, forward=None):
+                 admission: str = "shed", metrics=None, forward=None,
+                 aot_store=None):
         from ..obs.metrics import MetricsRegistry
 
         if admission not in ("shed", "block"):
@@ -204,6 +205,24 @@ class ServeEngine:
             help="new (bucket, shape) signatures — each is an XLA compile")
         self._m_deadline = m.counter("serve_deadline_expired_total",
                                      help="requests expired before dispatch")
+
+        # --- persistent AOT store (optional): consult disk before tracing ---
+        self._aot = None
+        if aot_store is not None:
+            from ..aot import AotFunction, arch_fingerprint
+
+            snap0 = self.registry.current()
+            wrapped = AotFunction(
+                self._fwd, tag="engine_forward", store=aot_store,
+                metrics=self.metrics,
+                arch=arch_fingerprint(snap0.params, snap0.state),
+                component="engine", compile_counter=self._m_compiles)
+            if wrapped.store is not None:  # plain-callable forwards opt out
+                self._fwd = wrapped
+                self._aot = wrapped
+                # precompile-before-flip: a publish warms the candidate
+                # against every signature this engine has ever served
+                self.registry.add_warmer(self._warm_candidate)
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-engine-dispatch")
@@ -345,7 +364,10 @@ class ServeEngine:
         with self._cond:
             if sig not in self._sigs:
                 self._sigs.add(sig)
-                self._m_compiles.inc()
+                # with an AOT store, a new signature may load from disk —
+                # AotFunction counts the misses that really trace
+                if self._aot is None:
+                    self._m_compiles.inc()
             self._batch_count += 1
             seq = self._batch_count
         with self.registry.lease(tag="engine_batch") as snap:  # ONE generation per batch
@@ -380,6 +402,50 @@ class ServeEngine:
             if batch is None:
                 return
             self._run_batch(batch)
+
+    # ---------------------------------------------------------------- warming
+    def _example_shapes(self) -> List[tuple]:
+        ex = tuple(int(d) for d in self.model.input_shape)
+        if self.length_buckets is not None and len(ex) >= 1:
+            return [(int(t),) + ex[1:] for t in self.length_buckets]
+        return [ex]
+
+    def warm(self, dtype=np.float32) -> float:
+        """Load-or-compile every (batch bucket × length bucket) forward
+        executable up front — from the AOT store when a previous boot
+        stored them, else traced once and persisted for the next boot.
+        Abstract shapes only; nothing executes. Returns the wall time,
+        also published as ``serve_cold_start_seconds{component="engine"}``.
+        No-op without an AOT store (the lazy per-signature path stands)."""
+        if self._aot is None:
+            return 0.0
+        import jax
+
+        snap = self.registry.current()
+        t0 = time.perf_counter()
+        for b in self.batch_buckets:
+            for shp in self._example_shapes():
+                self._aot.warm(snap.params, snap.state,
+                               jax.ShapeDtypeStruct((b,) + shp,
+                                                    np.dtype(dtype)))
+        elapsed = time.perf_counter() - t0
+        self.metrics.gauge(
+            "serve_cold_start_seconds", {"component": "engine"},
+            help="wall time to materialize the serving executables"
+            ).set(elapsed)
+        return elapsed
+
+    def _warm_candidate(self, params, state) -> None:
+        """Registry warmer: precompile a candidate generation against every
+        signature this engine has served, BEFORE traffic flips onto it."""
+        import jax
+
+        with self._cond:
+            sigs = set(self._sigs)
+        for bucket, ex_shape, dtype in sigs:
+            self._aot.warm(params, state,
+                           jax.ShapeDtypeStruct((bucket,) + tuple(ex_shape),
+                                                np.dtype(dtype)))
 
     # -------------------------------------------------------------- lifecycle
     @property

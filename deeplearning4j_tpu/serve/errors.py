@@ -55,3 +55,14 @@ class CapacityError(ServeError):
 
     cause = "over_capacity"
     http_status = 400
+
+
+class PublishError(ServeError):
+    """A model publish aborted BEFORE the generation flip — e.g.
+    precompiling/warming the candidate against the live bucket signatures
+    failed. The previous generation keeps serving; registry history, lease
+    accounting and the generation counter are untouched, so the caller can
+    fix the candidate and re-publish."""
+
+    cause = "publish_failed"
+    http_status = 500

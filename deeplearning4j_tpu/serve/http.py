@@ -68,11 +68,13 @@ class ModelServer(JsonHTTPServerMixin):
                  gen_kv: str = "paged", gen_block_size: int = 16,
                  gen_kv_blocks: Optional[int] = None,
                  gen_prefill_chunk: Optional[int] = 64,
-                 seed: int = 0, metrics: Optional[MetricsRegistry] = None):
+                 seed: int = 0, metrics: Optional[MetricsRegistry] = None,
+                 aot_store=None):
         self.model = model
         self.host = host
         self.port = port
         self.input_dtype = input_dtype
+        self.aot_store = aot_store
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         if registry is None:
             registry = (engine.registry if engine is not None else
@@ -85,12 +87,17 @@ class ModelServer(JsonHTTPServerMixin):
             model, registry=registry, batch_buckets=batch_buckets,
             length_buckets=length_buckets, queue_limit=queue_limit,
             max_wait_ms=max_wait_ms, default_timeout_ms=default_timeout_ms,
-            metrics=self.metrics)
+            metrics=self.metrics, aot_store=aot_store)
+        if engine is None and aot_store is not None:
+            # materialize the predict executables now (store hit or traced
+            # once and persisted) — the first request never waits on XLA
+            self.engine.warm(input_dtype)
         self._gen_opts = dict(slots=gen_slots, capacity=gen_capacity,
                               queue_limit=gen_queue_limit, kv=gen_kv,
                               block_size=gen_block_size,
                               kv_blocks=gen_kv_blocks,
-                              prefill_chunk=gen_prefill_chunk, seed=seed)
+                              prefill_chunk=gen_prefill_chunk, seed=seed,
+                              aot_store=aot_store)
         if gen_kv == "dense":
             # dense batcher takes no paging knobs
             for k in ("block_size", "kv_blocks", "prefill_chunk"):
@@ -144,10 +151,13 @@ class ModelServer(JsonHTTPServerMixin):
                         self.reply(503, {"status": "draining"})
                 elif self.path == "/models":
                     cur = server.registry.current()
-                    self.reply(200, {
+                    body = {
                         "generation": cur.generation, "version": cur.version,
                         "history": [{"generation": g, "version": v}
-                                    for g, v in server.registry.history()]})
+                                    for g, v in server.registry.history()]}
+                    if server.aot_store is not None:
+                        body["aot_store"] = server.aot_store.stats()
+                    self.reply(200, body)
                 else:
                     self.reply(404, {"error": "unknown endpoint"})
 

@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import threading
 from contextlib import contextmanager
-from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from .errors import PublishError
 
 
 class ModelSnapshot(NamedTuple):
@@ -72,8 +74,10 @@ class ModelRegistry:
             raise ValueError("registry needs initialized params")
         _check_live(params)
         self._cond = threading.Condition()
+        self._publish_lock = threading.Lock()  # serializes publish/rollback
         self._inflight: Dict[int, int] = {}
         self._history: List[ModelSnapshot] = []
+        self._warmers: List[Callable[[Any, Any], None]] = []
         self._metrics = metrics
         snap = ModelSnapshot(1, version, params, state if state is not None else {})
         self._keep = max(int(keep), 1)
@@ -124,21 +128,56 @@ class ModelRegistry:
             return dict(self._inflight)
 
     # --- writers ---
+    def add_warmer(self, fn: Callable[[Any, Any], None]) -> None:
+        """Register a pre-flip hook ``fn(params, state)``.
+
+        Every warmer runs against the *candidate* snapshot inside
+        :meth:`publish`, BEFORE the generation flips — the serving tiers
+        register hooks that precompile the candidate against their live
+        bucket signatures (``aot.AotFunction.warm``), so the first batch on
+        a new generation never pays a trace. A warmer that raises aborts
+        the publish with a typed :class:`~.errors.PublishError` and the old
+        generation keeps serving untouched."""
+        with self._cond:
+            self._warmers.append(fn)
+
     def publish(self, params, state=None, version: Optional[str] = None,
                 drain: bool = False, timeout: Optional[float] = None
                 ) -> ModelSnapshot:
         """Atomically publish a new generation; optionally wait for work
-        dispatched against older generations to retire."""
+        dispatched against older generations to retire.
+
+        Publication is two-phase: (1) validate + run every registered
+        warmer against the candidate (precompile-before-flip), (2) the
+        atomic history append. Phase 1 failing raises
+        :class:`~.errors.PublishError` with registry state untouched."""
         if params is None:
             raise ValueError("cannot publish params=None")
         _check_live(params)
-        with self._cond:
-            gen = self._history[-1].generation + 1
-            snap = ModelSnapshot(
-                gen, version if version is not None else f"v{gen - 1}",
-                params, state if state is not None else self._history[-1].state)
-            self._history.append(snap)
-            del self._history[:-self._keep]
+        with self._publish_lock:
+            with self._cond:
+                # resolve the effective state now: the publish lock pins
+                # history[-1] (no concurrent publish can move it)
+                eff_state = (state if state is not None
+                             else self._history[-1].state)
+                warmers = list(self._warmers)
+            try:
+                for warm in warmers:
+                    warm(params, eff_state)
+            except Exception as e:  # ANY warm failure must leave the old generation serving  # jaxlint: disable=broad-except
+                self._count("serve_model_publish_failures_total",
+                            "publishes aborted before the flip")
+                raise PublishError(
+                    f"candidate generation failed precompile/warm — old "
+                    f"generation keeps serving ({type(e).__name__}: {e})"
+                    ) from e
+            with self._cond:
+                gen = self._history[-1].generation + 1
+                snap = ModelSnapshot(
+                    gen, version if version is not None else f"v{gen - 1}",
+                    params, eff_state)
+                self._history.append(snap)
+                del self._history[:-self._keep]
         self._gauge_generation(snap.generation)
         self._count("serve_model_publishes_total",
                     "model generations published (hot-swap)")

@@ -27,6 +27,11 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/analysis/
 # outage, so the serving tree gets the same zero-suppression bar as obs/.
 echo "=== jaxlint: deeplearning4j_tpu/serve/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/serve/
+# aot/ persists compiled executables across processes: a lint-dirty store
+# layer (unlocked shared state, swallowed errors) would corrupt every
+# replica that mounts it, so it holds the same zero-suppression bar.
+echo "=== jaxlint: deeplearning4j_tpu/aot/ (no baseline permitted) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/aot/
 
 echo "=== smoke trace: 5-step instrumented train ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_trace.py

@@ -10,8 +10,15 @@ the Prometheus scrape exposes the serving histograms/counters plus the
 paged-KV block gauges — so a regression in the serving path fails CI before
 it reaches a real deployment.
 
+ISSUE-6 addition: the server is then booted TWICE against one persistent
+AOT store directory — the second boot must serve identical results with
+ZERO decode-path XLA compiles (``serve_compile_misses_total`` stays 0) and
+``serve_aot_hits_total > 0`` in its scrape.
+
 Artifacts land in $CI_ARTIFACTS_DIR (default: ./ci-artifacts/):
-smoke_serve_metrics.prom (the final /metrics scrape).
+smoke_serve_metrics.prom (the final /metrics scrape of the main server),
+smoke_serve_warmboot.prom (the warm second boot's scrape), aot_store/
+(the store both boots shared).
 """
 
 import concurrent.futures as cf
@@ -106,6 +113,67 @@ def _overcommit_burst(model):
         cb.shutdown()
 
 
+def _prom_total(scrape, name):
+    """Sum every series of one metric in a Prometheus text scrape."""
+    total = 0.0
+    for line in scrape.splitlines():
+        if line.startswith(name) and len(line) > len(name) \
+                and line[len(name)] in "{ ":
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _aot_warm_boot(out_dir):
+    """Boot a server twice against ONE persistent AOT store. Boot 1 traces
+    live and persists every executable; boot 2 must load them all back —
+    identical greedy output, serve_aot_hits_total > 0, and ZERO XLA
+    compiles on the compile-miss counter (the ISSUE-6 acceptance gate)."""
+    from deeplearning4j_tpu.aot import AotStore
+    from deeplearning4j_tpu.models import CausalLM
+    from deeplearning4j_tpu.serve import ModelServer
+
+    store_dir = os.path.join(out_dir, "aot_store")
+
+    def boot():
+        model = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=32,
+                         num_heads=4, vocab=50).build()
+        model.init()
+        srv = ModelServer(model, port=0, input_dtype=np.int32,
+                          batch_buckets=(1, 2, 4, 8), gen_slots=2,
+                          gen_capacity=16,
+                          aot_store=AotStore(store_dir)).start()
+        try:
+            pred = _post(srv.port, "/predict",
+                         {"ndarray": [[1] * 8, [2] * 8]})["output"]
+            toks = _post(srv.port, "/generate?stream=false",
+                         {"prompt": [1, 2, 3], "max_new_tokens": 3,
+                          "temperature": 0.0})["tokens"]
+            models = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/models", timeout=10).read())
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=10).read().decode()
+        finally:
+            srv.stop()
+        return pred, toks, models, scrape
+
+    pred1, toks1, _, _ = boot()          # cold: trace + persist
+    pred2, toks2, models, scrape = boot()  # warm: disk only
+    assert toks1 == toks2 and pred1 == pred2, \
+        "warm boot changed serving output"
+    assert models.get("aot_store", {}).get("entries", 0) > 0, models
+    hits = _prom_total(scrape, "serve_aot_hits_total")
+    compiles = _prom_total(scrape, "serve_compile_misses_total")
+    fallbacks = _prom_total(scrape, "serve_aot_fallback_total")
+    assert hits > 0, "second boot took no AOT store hits"
+    assert compiles == 0, \
+        f"second boot traced ({compiles} compile misses) despite warm store"
+    assert fallbacks == 0, f"warm store fell back {fallbacks} time(s)"
+    with open(os.path.join(out_dir, "smoke_serve_warmboot.prom"), "w") as f:
+        f.write(scrape)
+    return int(hits)
+
+
 def main() -> int:
     out_dir = os.environ.get("CI_ARTIFACTS_DIR", "ci-artifacts")
     os.makedirs(out_dir, exist_ok=True)
@@ -186,6 +254,12 @@ def main() -> int:
               f"generation {health['generation']} -> {prom_path}")
     finally:
         srv.stop()
+
+    # cold-start acceptance: second boot against a warm AOT store serves
+    # with zero XLA compiles
+    aot_hits = _aot_warm_boot(out_dir)
+    print(f"smoke_serve: warm second boot served from the AOT store "
+          f"({aot_hits} executable loads, 0 compiles)")
     return 0
 
 

@@ -1,0 +1,370 @@
+"""Tests for the aot/ persistent executable store (ISSUE 6).
+
+The load-bearing properties, each tested directly:
+
+- keys: every compilation-shaping component (tag, arch, signature,
+  donation, jax/jaxlib + topology) re-keys the store — a version skew is a
+  clean MISS, never a crash and never a wrong executable;
+- store: atomic publish, content verification, corrupt entries quarantined
+  and surfaced as typed errors, manifest rebuildable from entry files,
+  LRU GC bounded by bytes, readers racing GC see clean misses;
+- AotFunction: a second process-alike (fresh wrapper, same store) loads
+  every executable with ZERO compiles; every store failure (corrupt blob,
+  version skew, bad pickle) degrades to live tracing counted on
+  ``serve_aot_fallback_total{cause}``;
+- publish warming: ``ModelRegistry.publish`` runs warmers against the
+  candidate BEFORE the flip; a failing warmer raises a typed
+  ``PublishError`` with history, generation counter and lease accounting
+  untouched — the old generation keeps serving;
+- the ``python -m deeplearning4j_tpu.aot`` CLI: list/stats/verify/gc
+  against a real store, verify exit code flips on quarantine.
+"""
+
+import hashlib
+import pickle
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.aot import (AotCorruptEntry, AotFunction, AotStore,
+                                    arch_fingerprint, cache_key,
+                                    call_signature, runtime_fingerprint)
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+
+def _key(i=0):
+    return hashlib.sha256(f"entry-{i}".encode()).hexdigest()
+
+
+def _series(metrics, name):
+    return {tuple(sorted(s["labels"].items())): s["value"]
+            for s in metrics.snapshot().get(name, {}).get("series", [])}
+
+
+def _fallbacks_by_cause(metrics):
+    return {dict(k)["cause"]: v for k, v in
+            _series(metrics, "serve_aot_fallback_total").items()}
+
+
+class TestKeys:
+    def test_deterministic_and_component_sensitive(self):
+        rt = {"jax": "1", "jaxlib": "1", "backend": "cpu",
+              "device_kind": "cpu", "device_count": 1, "process_count": 1}
+        base = cache_key("decode", "abc", ("(4,):int32",), runtime=rt)
+        assert base == cache_key("decode", "abc", ("(4,):int32",), runtime=rt)
+        assert base != cache_key("prefill", "abc", ("(4,):int32",), runtime=rt)
+        assert base != cache_key("decode", "xyz", ("(4,):int32",), runtime=rt)
+        assert base != cache_key("decode", "abc", ("(8,):int32",), runtime=rt)
+        assert base != cache_key("decode", "abc", ("(4,):int32",),
+                                 donate=(3,), runtime=rt)
+
+    def test_version_or_topology_skew_rekeys(self):
+        # a jaxlib upgrade (or moving CPU -> TPU slice) must be a clean miss
+        rt = runtime_fingerprint()
+        sig = ("(2, 4):float32",)
+        base = cache_key("fwd", "a", sig, runtime=rt)
+        for field, value in (("jaxlib", "999.0"), ("jax", "999.0"),
+                             ("backend", "tpu"), ("device_kind", "TPU v5e"),
+                             ("device_count", rt["device_count"] + 8),
+                             ("process_count", rt["process_count"] + 1)):
+            skewed = cache_key("fwd", "a", sig, runtime={**rt, field: value})
+            assert skewed != base, f"{field} skew did not re-key"
+
+    def test_arch_fingerprint_shapes_not_values(self):
+        p1 = {"a": np.zeros((3, 4), np.float32), "b": np.ones(5, np.int32)}
+        p2 = {"a": np.full((3, 4), 7.0, np.float32),
+              "b": np.arange(5, dtype=np.int32)}
+        assert arch_fingerprint(p1) == arch_fingerprint(p2)  # values free
+        p3 = {"a": np.zeros((3, 5), np.float32), "b": np.ones(5, np.int32)}
+        assert arch_fingerprint(p1) != arch_fingerprint(p3)  # shapes bind
+        p4 = {"a": np.zeros((3, 4), np.float64), "b": np.ones(5, np.int32)}
+        assert arch_fingerprint(p1) != arch_fingerprint(p4)  # dtypes bind
+        assert arch_fingerprint(p1, {"s": np.zeros(2)}) \
+            != arch_fingerprint(p1)  # state binds
+
+    def test_call_signature_hashable_and_shape_exact(self):
+        a = call_signature((np.zeros((2, 3), np.float32), np.int32(7)))
+        b = call_signature((np.ones((2, 3), np.float32), np.int32(9)))
+        assert a == b and hash(a)  # values/scalars traced, not keyed
+        c = call_signature((np.zeros((2, 4), np.float32), np.int32(7)))
+        assert a != c
+        # abstract shapes produce the SAME signature as concrete arrays —
+        # what makes warm() interchangeable with a real call
+        d = call_signature((jax.ShapeDtypeStruct((2, 3), jnp.float32),
+                            jax.ShapeDtypeStruct((), jnp.int32)))
+        assert a == d
+
+
+class TestStore:
+    def test_roundtrip_and_manifest(self, tmp_path):
+        store = AotStore(tmp_path)
+        blob = b"executable-bytes" * 100
+        assert store.put(_key(), blob, meta={"tag": "decode"})
+        assert store.get(_key()) == blob
+        assert store.get(_key(1)) is None  # clean miss
+        entry = store.entries()[_key()]
+        assert entry["meta"]["tag"] == "decode"
+        st = store.stats()
+        assert st["entries"] == 1 and st["quarantined"] == 0
+
+    def test_corrupt_entry_quarantined(self, tmp_path):
+        store = AotStore(tmp_path)
+        store.put(_key(), b"payload" * 50)
+        path = store._entry_path(_key())
+        with open(path, "r+b") as f:
+            f.seek(45)
+            f.write(b"\xde\xad\xbe\xef")
+        with pytest.raises(AotCorruptEntry):
+            store.get(_key())
+        # moved aside atomically: re-reads are clean misses, stats see it
+        assert store.get(_key()) is None
+        assert store.stats()["quarantined"] == 1
+        assert _key() not in store.entries()
+
+    def test_index_rebuilt_from_entries(self, tmp_path):
+        store = AotStore(tmp_path)
+        for i in range(3):
+            store.put(_key(i), f"blob-{i}".encode())
+        (tmp_path / "index.json").write_text("{ not json")
+        assert sorted(AotStore(tmp_path).entries()) == sorted(
+            _key(i) for i in range(3))
+        assert store.rebuild_index() == 3
+        assert store.get(_key(1)) == b"blob-1"
+
+    def test_lru_gc_bounded(self, tmp_path):
+        store = AotStore(tmp_path, max_bytes=0)  # no eviction at write time
+        for i in range(6):
+            store.put(_key(i), bytes(200))
+        for i in (0, 3):  # touch -> most recently used
+            store.get(_key(i))
+        per_entry = store.entries()[_key(0)]["size"]
+        evicted = store.gc(max_bytes=3 * per_entry)
+        assert len(evicted) == 3
+        assert _key(0) not in evicted and _key(3) not in evicted
+        assert store.stats()["entries"] == 3
+
+    def test_concurrent_readers_during_gc(self, tmp_path):
+        # an evicted-underfoot entry is a clean miss, never an exception
+        store = AotStore(tmp_path, max_bytes=0)
+        keys = [_key(i) for i in range(16)]
+        for k in keys:
+            store.put(k, bytes(300))
+        errors, stop = [], threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                for k in keys:
+                    try:
+                        got = store.get(k)
+                        assert got is None or got == bytes(300)
+                    except Exception as e:  # noqa: BLE001 — the assertion
+                        errors.append(e)
+                        return
+
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for bound in (12, 8, 4, 0):
+            store.gc(max_bytes=max(bound, 1) * 400)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors, errors
+
+    def test_verify_quarantines_and_reports(self, tmp_path):
+        store = AotStore(tmp_path)
+        store.put(_key(0), b"good")
+        store.put(_key(1), b"bad")
+        with open(store._entry_path(_key(1)), "r+b") as f:
+            f.seek(41)
+            f.write(b"\x00\x00")
+        out = store.verify()
+        assert out["ok"] == [_key(0)] and out["quarantined"] == [_key(1)]
+
+    def test_malformed_key_rejected(self, tmp_path):
+        store = AotStore(tmp_path)
+        with pytest.raises(ValueError):
+            store.put("../../escape", b"x")
+
+
+@pytest.fixture()
+def jitted():
+    return jax.jit(lambda p, x: x @ p + 1.0)
+
+
+_P = np.ones((4, 4), np.float32)
+_X = np.arange(8, dtype=np.float32).reshape(2, 4)
+
+
+def _wrapper(jitted, store, metrics, tag="fwd"):
+    return AotFunction(jitted, tag=tag, store=store, metrics=metrics,
+                       arch=arch_fingerprint(_P), component="generate",
+                       compile_counter=metrics.counter(
+                           "serve_compile_misses_total",
+                           {"component": "generate"}))
+
+
+class TestAotFunction:
+    def test_second_boot_zero_compiles(self, tmp_path, jitted):
+        m1 = MetricsRegistry()
+        f1 = _wrapper(jitted, AotStore(tmp_path), m1)
+        y1 = np.asarray(f1(_P, _X))
+        assert m1.counter("serve_compile_misses_total",
+                          {"component": "generate"}).value == 1
+        # fresh wrapper + fresh store handle = a process restart
+        m2 = MetricsRegistry()
+        f2 = _wrapper(jitted, AotStore(tmp_path), m2)
+        y2 = np.asarray(f2(_P, _X))
+        np.testing.assert_array_equal(y1, y2)
+        assert m2.counter("serve_compile_misses_total",
+                          {"component": "generate"}).value == 0
+        assert _series(m2, "serve_aot_hits_total")[
+            (("component", "generate"),)] == 1
+
+    def test_warm_is_abstract_and_sufficient(self, tmp_path, jitted):
+        m = MetricsRegistry()
+        f = _wrapper(jitted, AotStore(tmp_path), m)
+        assert f.warm(jax.ShapeDtypeStruct((4, 4), jnp.float32),
+                      jax.ShapeDtypeStruct((5, 4), jnp.float32))
+        assert f.acquire_seconds > 0
+        counter = m.counter("serve_compile_misses_total",
+                            {"component": "generate"})
+        before = counter.value
+        f(_P, np.ones((5, 4), np.float32))  # same signature: no new compile
+        assert counter.value == before
+
+    def test_corrupt_entry_degrades_to_tracing(self, tmp_path, jitted):
+        store = AotStore(tmp_path)
+        m1 = MetricsRegistry()
+        f1 = _wrapper(jitted, store, m1)
+        want = np.asarray(f1(_P, _X))
+        key = store.keys()[0]
+        with open(store._entry_path(key), "r+b") as fo:
+            fo.seek(60)
+            fo.write(b"\xff\xff\xff\xff")
+        m2 = MetricsRegistry()
+        f2 = _wrapper(jitted, AotStore(tmp_path), m2)
+        np.testing.assert_array_equal(np.asarray(f2(_P, _X)), want)
+        assert _fallbacks_by_cause(m2) == {"corrupt": 1}
+        assert AotStore(tmp_path).stats()["quarantined"] == 1
+        # the traced fallback re-persisted the entry: third boot hits again
+        m3 = MetricsRegistry()
+        f3 = _wrapper(jitted, AotStore(tmp_path), m3)
+        np.testing.assert_array_equal(np.asarray(f3(_P, _X)), want)
+        assert _fallbacks_by_cause(m3) == {}
+
+    def test_jaxlib_version_mismatch_key_is_miss_not_crash(
+            self, tmp_path, jitted, monkeypatch):
+        store = AotStore(tmp_path)
+        m1 = MetricsRegistry()
+        _wrapper(jitted, store, m1)(_P, _X)  # populate under the real key
+        # simulate the NEXT boot running an upgraded jaxlib: keys re-derive
+        from deeplearning4j_tpu.aot import compile as aot_compile
+
+        real = runtime_fingerprint()
+        monkeypatch.setattr(aot_compile, "runtime_fingerprint",
+                            lambda: {**real, "jaxlib": "999.0.0"})
+        m2 = MetricsRegistry()
+        f2 = _wrapper(jitted, AotStore(tmp_path), m2)
+        np.asarray(f2(_P, _X))  # miss -> live trace, NOT a crash
+        assert _series(m2, "serve_aot_misses_total")[
+            (("component", "generate"),)] == 1
+        assert _fallbacks_by_cause(m2) == {}
+
+    def test_blob_version_skew_falls_back(self, tmp_path, jitted):
+        # defense in depth: a blob whose embedded jax/jaxlib pair disagrees
+        # (same key — e.g. a hand-copied store) degrades with cause=version
+        store = AotStore(tmp_path)
+        m1 = MetricsRegistry()
+        _wrapper(jitted, store, m1)(_P, _X)
+        key = store.keys()[0]
+        rec = pickle.loads(store.get(key))
+        rec["jaxlib"] = "0.0.1"
+        store.put(key, pickle.dumps(rec))
+        m2 = MetricsRegistry()
+        f2 = _wrapper(jitted, AotStore(tmp_path), m2)
+        np.asarray(f2(_P, _X))
+        assert _fallbacks_by_cause(m2) == {"version": 1}
+
+    def test_garbage_pickle_falls_back(self, tmp_path, jitted):
+        store = AotStore(tmp_path)
+        m1 = MetricsRegistry()
+        _wrapper(jitted, store, m1)(_P, _X)
+        key = store.keys()[0]
+        store.put(key, b"not a pickle at all")  # valid checksum, bad payload
+        m2 = MetricsRegistry()
+        f2 = _wrapper(jitted, AotStore(tmp_path), m2)
+        np.asarray(f2(_P, _X))
+        assert _fallbacks_by_cause(m2) == {"deserialize": 1}
+
+    def test_plain_callable_passes_through(self, tmp_path):
+        f = AotFunction(lambda p, x: x @ p, tag="plain",
+                        store=AotStore(tmp_path))
+        assert f.store is None
+        np.testing.assert_array_equal(np.asarray(f(_P, _X)), _X @ _P)
+        assert AotStore(tmp_path).stats()["entries"] == 0
+
+
+class TestPublishWarming:
+    def test_failed_publish_leaves_registry_intact(self):
+        from deeplearning4j_tpu.serve import ModelRegistry, PublishError
+
+        params = {"w": np.ones((2, 2), np.float32)}
+        reg = ModelRegistry(params, {})
+        warmed = []
+        reg.add_warmer(lambda p, s: warmed.append(np.asarray(p["w"]).sum()))
+        reg.add_warmer(lambda p, s: (_ for _ in ()).throw(
+            RuntimeError("candidate cannot compile")))
+        before = reg.history()
+        with pytest.raises(PublishError, match="old generation keeps"):
+            reg.publish({"w": np.full((2, 2), 5.0, np.float32)})
+        assert reg.history() == before
+        assert reg.generation == 1
+        assert not reg.inflight()  # no leaked leases
+        assert warmed == [20.0]  # first warmer DID see the candidate
+        with reg.lease() as snap:  # still serving the old params
+            assert np.asarray(snap.params["w"]).sum() == 4.0
+
+    def test_warmers_run_before_flip(self):
+        from deeplearning4j_tpu.serve import ModelRegistry
+
+        params = {"w": np.ones(3, np.float32)}
+        reg = ModelRegistry(params, {})
+        gen_at_warm = []
+        reg.add_warmer(lambda p, s: gen_at_warm.append(reg.generation))
+        snap = reg.publish({"w": np.zeros(3, np.float32)})
+        assert snap.generation == 2
+        assert gen_at_warm == [1]  # candidate warmed while gen 1 still live
+
+
+class TestCli:
+    def _run(self, *argv):
+        from deeplearning4j_tpu.aot.__main__ import main
+        return main(list(argv))
+
+    def test_list_stats_verify_gc(self, tmp_path, capsys):
+        store = AotStore(tmp_path)
+        for i in range(3):
+            store.put(_key(i), bytes(150), meta={"tag": f"t{i}", "arch": "a"})
+        root = str(tmp_path)
+        assert self._run("--store", root, "list") == 0
+        assert "3 entries" in capsys.readouterr().out
+        assert self._run("--store", root, "stats") == 0
+        assert '"entries": 3' in capsys.readouterr().out
+        assert self._run("--store", root, "verify") == 0
+        assert self._run("--store", root, "rebuild-index") == 0
+        capsys.readouterr()
+        assert self._run("--store", root, "gc", "--max-bytes", "200") == 0
+        assert "evicted 2" in capsys.readouterr().out
+
+    def test_verify_exit_code_flags_quarantine(self, tmp_path, capsys):
+        store = AotStore(tmp_path)
+        store.put(_key(), b"data")
+        with open(store._entry_path(_key()), "r+b") as f:
+            f.seek(41)
+            f.write(b"\x00")
+        assert self._run("--store", str(tmp_path), "verify") == 1
+        assert "quarantined" in capsys.readouterr().out

@@ -44,12 +44,14 @@ import pytest
 from deeplearning4j_tpu.nn.layers import Dense, Output
 from deeplearning4j_tpu.nn.model import NetConfig, Sequential
 from deeplearning4j_tpu.parallel import ParallelInference
+from deeplearning4j_tpu.aot import AotStore
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
 from deeplearning4j_tpu.serve import (BlockAllocator, CapacityError,
                                       ContinuousBatcher,
                                       DeadlineExceededError, ModelRegistry,
                                       ModelServer, PrefillScheduler,
-                                      ServeEngine, ServerClosingError,
-                                      ShedError)
+                                      PublishError, ServeEngine,
+                                      ServerClosingError, ShedError)
 
 
 def _dense_model(n_in=4, n_out=3, seed=0):
@@ -880,3 +882,53 @@ class TestStreaming:
             assert json.loads(ei.value.read())["cause"] == "over_capacity"
         finally:
             srv.stop()
+
+
+class TestAotPublishUnderLoad:
+    """ISSUE 6: hot-swap against a live AOT-backed batcher. A same-
+    architecture publish must reuse the already-warm executables — ZERO
+    stray compiles after the flip — and a candidate that cannot compile
+    must abort as a typed PublishError while the old generation serves."""
+
+    def test_publish_under_load_zero_stray_compiles(self, lm, tmp_path):
+        m = MetricsRegistry()
+        cb = ContinuousBatcher(lm, slots=2, capacity=16, prompt_buckets=(8,),
+                               metrics=m, aot_store=AotStore(tmp_path),
+                               seed=0)
+        try:
+            compiles = m.counter("serve_compile_misses_total",
+                                 {"component": "generate"})
+            rng = np.random.RandomState(0)
+            prompts = [rng.randint(0, 50, (5,)).astype(np.int32)
+                       for _ in range(8)]
+            with cf.ThreadPoolExecutor(4) as ex:
+                futs = [ex.submit(cb.generate, p, 3, temperature=0.0)
+                        for p in prompts[:4]]
+                # warm-at-construction traced everything; the flip (same
+                # architecture -> same cache keys) must add NOTHING
+                before = compiles.value
+                scaled = jax.tree.map(lambda a: a * 1.25,
+                                      cb.registry.current().params)
+                snap = cb.registry.publish(scaled, drain=True)
+                futs += [ex.submit(cb.generate, p, 3, temperature=0.0)
+                         for p in prompts[4:]]
+                outs = [f.result(timeout=120) for f in futs]
+            assert snap.generation == 2
+            assert all(len(o) == 3 for o in outs)
+            assert compiles.value == before, \
+                "publish traced new executables despite the pre-flip warm"
+
+            # a candidate whose shapes cannot run the warmers aborts BEFORE
+            # the flip: typed error, generation unchanged, still serving,
+            # and the failed warm did not inflate the compile counter
+            bad = jax.tree.map(
+                lambda a: np.zeros(tuple(s + 1 for s in np.shape(a)),
+                                   np.asarray(a).dtype), snap.params)
+            with pytest.raises(PublishError):
+                cb.registry.publish(bad)
+            assert cb.registry.generation == 2
+            assert compiles.value == before
+            out = cb.generate(prompts[0], 3, temperature=0.0)
+            assert len(out) == 3
+        finally:
+            cb.shutdown()
