@@ -524,6 +524,214 @@ def _bench_coldstart():
     print(f"bench coldstart -> {out_path}", file=sys.stderr)
 
 
+def _bench_fleet():
+    """``python bench.py --fleet``: multi-model multi-tenant fleet serving.
+
+    Three named CausalLM models share an HBM weight budget sized for ~2.2
+    of them, so the LRU pager churns under mixed traffic. Closed-loop
+    clients ride three tenants: ``gold`` (predict on alpha/beta, 1s SLO),
+    ``standard`` (generate on gamma), and ``free`` (2 req/s — exists to be
+    throttled), plus a ``knn`` tenant whose BruteForceKNN queries are gated
+    through the SAME tenant admission (quota machinery is not
+    model-specific). Every response is checked against a precomputed
+    reference — the headline is only honest if ``wrong_responses == 0``
+    across page-out/page-in cycles. A shared AOT store is warmed before
+    the timed window so page-ins transfer weights instead of re-tracing.
+    Writes the next free BENCH_fleet_rNN.json. Env: BENCH_FLEET_SECONDS
+    (5), BENCH_FLEET_TOKENS (8).
+    """
+    import tempfile
+    import threading
+
+    import jax
+
+    from deeplearning4j_tpu.aot import AotStore
+    from deeplearning4j_tpu.fleet import FleetRegistry, QuotaError
+    from deeplearning4j_tpu.knn import BruteForceKNN
+    from deeplearning4j_tpu.models import CausalLM
+    from deeplearning4j_tpu.nn.generation import generate as refgen
+    from deeplearning4j_tpu.serve import ServeError
+
+    seconds = float(os.environ.get("BENCH_FLEET_SECONDS", 5))
+    gen_tokens = int(os.environ.get("BENCH_FLEET_TOKENS", 8))
+    dev = jax.devices()[0]
+
+    models = {}
+    for name, seed in (("alpha", 0), ("beta", 1), ("gamma", 2)):
+        m = CausalLM(seed=seed, input_shape=(16,), num_layers=2, d_model=32,
+                     num_heads=4, vocab=50).build()
+        m.init()
+        models[name] = m
+    weight_bytes = sum(int(np.asarray(leaf).nbytes) for leaf in
+                       jax.tree.leaves((models["alpha"].params,
+                                        models["alpha"].state)))
+    budget = int(2.2 * weight_bytes)  # fits 2 of 3 — paging is mandatory
+
+    store_dir = tempfile.mkdtemp(prefix="dl4j_fleet_aot_")
+    fleet = FleetRegistry(hbm_budget_bytes=budget,
+                          aot_store=AotStore(store_dir))
+    for name, m in models.items():
+        gen = {"slots": 2, "capacity": 32} if name == "gamma" else None
+        fleet.add(name, m, input_dtype=np.int32,
+                  engine_opts={"batch_buckets": (1, 2, 4),
+                               "queue_limit": 64},
+                  gen_opts=gen)
+    fleet.tenants.register("gold", rate_per_s=500, slo="gold")
+    fleet.tenants.register("standard", rate_per_s=500, slo="standard")
+    fleet.tenants.register("free", rate_per_s=2.0, burst=2.0, slo="batch")
+    fleet.tenants.register("knn", rate_per_s=200, slo="standard")
+
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, 50, (4, 1, 16)).astype(np.int32)
+    refs = {n: [np.asarray(m.output(p)) for p in prompts]
+            for n, m in models.items() if n != "gamma"}
+    gen_prompt = rng.randint(0, 50, (6,)).astype(np.int32)
+    gen_want = refgen(models["gamma"], gen_prompt[None], gen_tokens,
+                      temperature=0.0)[0].tolist()
+    knn_points = rng.rand(512, 16).astype(np.float32)
+    knn_index = BruteForceKNN(knn_points)
+    knn_query = rng.rand(16).astype(np.float32)
+    knn_want = np.argsort(
+        np.linalg.norm(knn_points - knn_query, axis=1))[:5].tolist()
+
+    # untimed warmup: page each model in once so the AOT store holds every
+    # executable — timed page-ins then measure drain + transfer, not tracing
+    for i, name in enumerate(("alpha", "beta", "gamma")):
+        if name == "gamma":
+            fleet.generate(name, gen_prompt, 2, tenant="standard",
+                           temperature=0.0)
+        else:
+            fleet.predict(name, prompts[i % len(prompts)], tenant="gold")
+    warm_stats = dict(fleet.pager.stats())
+
+    lat, lock = {}, threading.Lock()
+    counts = {"wrong": 0, "errors": 0, "quota_shed": 0, "knn_queries": 0}
+    stop_at = [0.0]
+
+    def record(tenant, ms):
+        with lock:
+            lat.setdefault(tenant, []).append(ms)
+
+    def predict_client(i):
+        r = np.random.RandomState(10 + i)
+        while time.perf_counter() < stop_at[0]:
+            name = ("alpha", "beta")[r.randint(2)]
+            j = r.randint(len(prompts))
+            t0 = time.perf_counter()
+            try:
+                res = fleet.predict(name, prompts[j], tenant="gold")
+            except ServeError:
+                with lock:
+                    counts["errors"] += 1
+                continue
+            record("gold", (time.perf_counter() - t0) * 1e3)
+            if not np.allclose(res.output, refs[name][j],
+                               rtol=1e-4, atol=1e-5):
+                with lock:
+                    counts["wrong"] += 1
+
+    def generate_client():
+        while time.perf_counter() < stop_at[0]:
+            t0 = time.perf_counter()
+            try:
+                toks = fleet.generate("gamma", gen_prompt, gen_tokens,
+                                      tenant="standard", temperature=0.0)
+            except ServeError:
+                with lock:
+                    counts["errors"] += 1
+                continue
+            record("standard", (time.perf_counter() - t0) * 1e3)
+            if list(toks) != gen_want:
+                with lock:
+                    counts["wrong"] += 1
+
+    def free_client():
+        while time.perf_counter() < stop_at[0]:
+            try:
+                fleet.predict("alpha", prompts[0], tenant="free")
+            except QuotaError:
+                with lock:
+                    counts["quota_shed"] += 1
+            except ServeError:
+                with lock:
+                    counts["errors"] += 1
+            time.sleep(0.05)  # 20 req/s offered against a 2 req/s quota
+
+    def knn_client():
+        while time.perf_counter() < stop_at[0]:
+            try:
+                fleet.tenants.admit("knn", model="knn")
+            except QuotaError:
+                with lock:
+                    counts["quota_shed"] += 1
+                time.sleep(0.01)
+                continue
+            t0 = time.perf_counter()
+            idx, _ = knn_index.search(knn_query, 5)
+            record("knn", (time.perf_counter() - t0) * 1e3)
+            if idx.tolist() != knn_want:
+                with lock:
+                    counts["wrong"] += 1
+            with lock:
+                counts["knn_queries"] += 1
+
+    workers = ([threading.Thread(target=predict_client, args=(i,))
+                for i in range(2)]
+               + [threading.Thread(target=generate_client),
+                  threading.Thread(target=free_client),
+                  threading.Thread(target=knn_client)])
+    stop_at[0] = time.perf_counter() + seconds
+    t0 = time.perf_counter()
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(120)
+    wall = time.perf_counter() - t0
+    pager = fleet.pager.stats()
+    tenants = fleet.tenants.stats()
+    fleet.shutdown()
+
+    def pct(tenant):
+        xs = np.sort(np.asarray(lat.get(tenant, [0.0])))
+        return {"requests": len(lat.get(tenant, [])),
+                "p50_ms": round(float(np.percentile(xs, 50)), 3),
+                "p99_ms": round(float(np.percentile(xs, 99)), 3)}
+
+    per_tenant = {t: pct(t) for t in ("gold", "standard", "knn")}
+    total = sum(v["requests"] for v in per_tenant.values())
+    gold_slo_ms = 1000.0
+    headline = {
+        "metric": "fleet_requests_per_sec",
+        "value": round(total / wall, 2),
+        "unit": "req/s",
+        "detail": {
+            "models": sorted(models),
+            "budget_bytes": budget,
+            "weights_sum_bytes": 3 * weight_bytes,
+            "seconds": round(wall, 2),
+            "tenants": per_tenant,
+            "wrong_responses": counts["wrong"],
+            "errors": counts["errors"],
+            "quota_sheds": counts["quota_shed"],
+            "free_tenant": {"admitted": tenants["free"]["admitted"],
+                            "shed": tenants["free"]["shed"]},
+            "page_ins": pager["page_ins"],
+            "page_outs": pager["page_outs"],
+            "timed_page_ins": pager["page_ins"] - warm_stats["page_ins"],
+            "gold_within_slo":
+                bool(per_tenant["gold"]["p99_ms"] <= gold_slo_ms),
+            "gold_slo_ms": gold_slo_ms,
+            "device": str(dev.device_kind),
+            "captured": time.strftime("%Y-%m-%d"),
+        },
+    }
+    print(json.dumps(headline), flush=True)
+    out_path = _next_round_path("BENCH_fleet")
+    with open(out_path, "w") as f:
+        json.dump(headline, f, indent=1)
+    print(f"bench fleet -> {out_path}", file=sys.stderr)
+
+
 def main():
     t_start = time.time()
     _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
@@ -614,5 +822,8 @@ if __name__ == "__main__":
     elif "--coldstart" in sys.argv[1:]:
         _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
         _bench_coldstart()
+    elif "--fleet" in sys.argv[1:]:
+        _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
+        _bench_fleet()
     else:
         main()

@@ -1,0 +1,28 @@
+"""Multi-model, multi-tenant fleet serving (PAPER.md north star: many
+heterogeneous workloads multiplexed over fixed accelerator memory).
+
+Layering over :mod:`~..serve`:
+
+- :mod:`~.tenants` — per-tenant token-bucket quotas + SLO deadline
+  classes; typed :class:`QuotaError` sheds (HTTP 429)
+- :mod:`~.pager`  — LRU paging of model weights host↔HBM under a byte
+  budget, with the hot-swap lease-drain discipline on eviction
+- :mod:`~.registry` — :class:`FleetRegistry` of named models, each its
+  own ModelRegistry/ServeEngine/ContinuousBatcher when resident
+- :mod:`~.http` — the routed front door
+  (``/v1/models/{name}/predict|generate``, ``X-Tenant``, ``/v1/fleet``)
+
+Attach a shared ``aot_store`` so a page-in warms executables from disk
+instead of recompiling — activation in seconds, zero traces.
+"""
+
+from .http import FleetServer
+from .pager import WeightPager
+from .registry import FleetEntry, FleetRegistry, FleetResult, \
+    UnknownModelError
+from .tenants import (DEFAULT_SLO_CLASSES, QuotaError, SLOClass, TenantTable,
+                      TokenBucket)
+
+__all__ = ["DEFAULT_SLO_CLASSES", "FleetEntry", "FleetRegistry",
+           "FleetResult", "FleetServer", "QuotaError", "SLOClass",
+           "TenantTable", "TokenBucket", "UnknownModelError", "WeightPager"]

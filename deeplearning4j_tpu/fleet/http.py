@@ -1,0 +1,221 @@
+"""Fleet HTTP front door — routed, tenant-aware serving over a
+:class:`~.registry.FleetRegistry`.
+
+One listener multiplexes every model in the fleet:
+
+- ``POST /v1/models/{name}/predict``  — body as ``ModelServer /predict``;
+  answers ``{"output": ..., "generation": N, "model": name}``.
+- ``POST /v1/models/{name}/generate`` — body as ``ModelServer /generate``;
+  **streams SSE by default** for 1-D prompts, ``?stream=false`` (or batch
+  prompts) buffers.
+- ``GET /v1/models`` (names + residency) · ``GET /v1/models/{name}``
+  (one entry) · ``GET /v1/fleet`` (models + pager + tenants + AOT store)
+  · ``GET /health`` · ``GET /ready`` · ``GET /metrics``.
+
+The tenant rides the ``X-Tenant`` header (default ``"anonymous"``, which
+gets the table's default policy — the front door never 500s on a new
+tenant). Typed failures map to their HTTP status; back-pressure answers
+carry ``Retry-After``: a 429 quota shed uses the tenant bucket's own
+refill estimate, a 503 queue shed scales with the target model's queue
+depth (:func:`~..serve.http.retry_after_s`).
+
+``/metrics`` label cardinality stays bounded: ``_metric_route`` collapses
+``/v1/models/<anything>/predict`` to ``/v1/models/{name}/predict`` for
+the shared per-endpoint latency histograms (model disaggregation lives on
+the ``model=`` label of the serving metrics, not the endpoint label).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..serve.errors import ServeError
+from ..serve.http import retry_after_s
+from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
+from .registry import FleetRegistry
+from .tenants import QuotaError
+
+_BAD_REQUEST = (KeyError, ValueError, TypeError, AttributeError,
+                json.JSONDecodeError)
+_MODEL_ROUTE = re.compile(r"^/v1/models/([^/]+)(?:/(predict|generate))?$")
+
+
+class FleetServer(JsonHTTPServerMixin):
+    """Serve a whole :class:`FleetRegistry` over one HTTP listener."""
+
+    def __init__(self, fleet: FleetRegistry, *, host: str = "127.0.0.1",
+                 port: int = 9020):
+        self.fleet = fleet
+        self.host = host
+        self.port = port
+        self.metrics = fleet.metrics  # httpd scaffolding serves /metrics
+        self._lifecycle_lock = threading.Lock()
+        self._accepting = True
+
+    def ready(self) -> bool:
+        with self._lifecycle_lock:
+            return self._accepting
+
+    def _metric_route(self, path: str) -> str:
+        m = _MODEL_ROUTE.match(path)
+        if m:
+            verb = f"/{m.group(2)}" if m.group(2) else ""
+            return f"/v1/models/{{name}}{verb}"
+        return path
+
+    def _retry_after(self, name: Optional[str]) -> int:
+        """503 back-off derived from the shedding model's queue depth; a
+        non-resident or unknown model reads as an idle queue (1s)."""
+        depth = limit = 0
+        try:
+            entry = self.fleet.get(name) if name else None
+        except ServeError:
+            entry = None
+        if entry is not None:
+            try:
+                eng = entry.engine()
+                depth, limit = eng.queue_depth(), eng.queue_limit
+            except ServeError:
+                pass
+        return retry_after_s(depth, limit)
+
+    # ------------------------------------------------------------- handler
+    def _handler(self):
+        server = self
+
+        class Handler(JsonRequestHandler):
+            owner = server
+
+            def _tenant(self) -> str:
+                return self.headers.get("X-Tenant", "anonymous")
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/health":
+                    self.reply(200, {"status": "ok",
+                                     "models": server.fleet.names()})
+                elif path == "/ready":
+                    if server.ready():
+                        self.reply(200, {"status": "ready"})
+                    else:
+                        self.reply(503, {"status": "draining"})
+                elif path == "/v1/fleet":
+                    self.reply(200, server.fleet.status())
+                elif path == "/v1/models":
+                    status = server.fleet.status()
+                    self.reply(200, {"models": status["models"]})
+                else:
+                    m = _MODEL_ROUTE.match(path)
+                    if m and m.group(2) is None:
+                        try:
+                            entry = server.fleet.get(m.group(1))
+                            self.reply(200, {"model": entry.name,
+                                             **entry.info()})
+                        except ServeError as e:
+                            self.reply(e.http_status,
+                                       {"error": str(e), "cause": e.cause})
+                    else:
+                        self.reply(404, {"error": "unknown endpoint"})
+
+            def do_POST(self):
+                path, _, query = self.path.partition("?")
+                m = _MODEL_ROUTE.match(path)
+                name = m.group(1) if m else None
+                try:
+                    if not server.ready():
+                        raise ServeError("fleet is draining",
+                                         cause="shutting_down")
+                    if m is None or m.group(2) is None:
+                        self.reply(404, {"error": "unknown endpoint"})
+                        return
+                    req = self.read_json()
+                    if m.group(2) == "predict":
+                        self._predict(name, req)
+                    else:
+                        self._generate(name, req, query)
+                except QuotaError as e:
+                    self.reply(e.http_status,
+                               {"error": str(e), "cause": e.cause,
+                                "tenant": self._tenant()},
+                               headers={"Retry-After":
+                                        max(1, int(e.retry_after_s + 0.999))})
+                except ServeError as e:
+                    headers = None
+                    if e.http_status == 503:
+                        headers = {"Retry-After": server._retry_after(name)}
+                    self.reply(e.http_status,
+                               {"error": str(e), "cause": e.cause},
+                               headers=headers)
+                except _BAD_REQUEST as e:
+                    self.reply(400, {"error": str(e)})
+                except Exception as e:  # front door answers every request  # jaxlint: disable=broad-except
+                    self.reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _predict(self, name, req):
+                res = server.fleet.predict(
+                    name, req["ndarray"], tenant=self._tenant(),
+                    timeout_ms=req.get("timeout_ms"))
+                body = {"output": np.asarray(res.output).tolist(),
+                        "model": name}
+                if res.generation is not None:
+                    body["generation"] = res.generation
+                self.reply(200, body)
+
+            def _sse(self, payload):
+                self.wfile.write(
+                    b"data: " + json.dumps(payload).encode() + b"\n\n")
+                self.wfile.flush()
+
+            def _generate(self, name, req, query):
+                prompt = np.asarray(req["prompt"], np.int32)
+                kwargs = dict(
+                    tenant=self._tenant(),
+                    temperature=float(req.get("temperature", 1.0)),
+                    top_k=req.get("top_k"), eos_id=req.get("eos_id"),
+                    timeout_ms=req.get("timeout_ms"))
+                mnt = int(req.get("max_new_tokens", 16))
+                stream = "stream=false" not in query \
+                    and "stream=0" not in query \
+                    and req.get("stream") is not False
+                if prompt.ndim != 1:  # batch prompts are always buffered
+                    stream = False
+                if not stream:
+                    toks = server.fleet.generate(name, prompt, mnt, **kwargs)
+                    self.reply(200, {"tokens": np.asarray(toks).tolist(),
+                                     "model": name})
+                    return
+                # admission errors surface as typed statuses BEFORE the
+                # stream opens; later failures are delivered in-band
+                handle = server.fleet.submit_generate(name, prompt, mnt,
+                                                      **kwargs)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.close_connection = True
+                out = []
+                try:
+                    for tok in handle.stream():
+                        out.append(int(tok))
+                        self._sse({"token": int(tok)})
+                    self._sse({"done": True, "tokens": out, "model": name})
+                except ServeError as e:
+                    self._sse({"error": str(e), "cause": e.cause,
+                               "tokens": out})
+
+        return Handler
+
+    # ----------------------------------------------------------- lifecycle
+    def stop(self, drain: bool = True):
+        """Flip readiness, drain every resident model, close the listener."""
+        with self._lifecycle_lock:
+            self._accepting = False
+        if drain:
+            self.fleet.shutdown()
+        super().stop()

@@ -1,0 +1,391 @@
+"""FleetRegistry — N named models multiplexed through one process.
+
+Each :class:`FleetEntry` owns the full single-model serving stack when
+resident — a :class:`~..serve.registry.ModelRegistry` (generations +
+leases + hot-swap), a :class:`~..serve.engine.ServeEngine` (predict), and
+a lazily-built :class:`~..serve.continuous.ContinuousBatcher` (generate)
+— and shrinks to a host-side numpy weight copy when paged out. The
+ground truth for a cold model is host RAM; activation is
+``device_put`` + executable warm from the shared ``aot/`` store, so a
+page-in costs seconds of transfer, not a recompile.
+
+Generation numbers survive paging: deactivation records
+``last generation + 1`` and the next activation's ModelRegistry starts
+there (``start_generation``), so "which params answered this request" is
+a total order per model across any number of page-out/page-in cycles —
+the same purity contract hot-swap gives within one residency.
+
+Request flow (:meth:`FleetRegistry.predict` / :meth:`~.generate`):
+tenant admission first (:class:`~.tenants.TenantTable` — an over-quota
+tenant is shed before any paging work), then ``pager.ensure`` (resident:
+one lock; cold: LRU eviction + activation), then the entry's engine.
+A request that loses the race with a concurrent eviction gets the
+engine's typed ``ServerClosingError`` and simply retries through the
+pager — bounded, because each retry pages the model back in.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, NamedTuple, Optional
+
+import numpy as np
+
+from ..serve.continuous import ContinuousBatcher
+from ..serve.engine import ServeEngine
+from ..serve.errors import ServeError, ServerClosingError
+from ..serve.registry import ModelRegistry
+from .pager import WeightPager
+from .tenants import TenantTable
+
+_EVICTION_RETRIES = 4
+
+
+class UnknownModelError(ServeError):
+    """No model with that name in the fleet (HTTP 404)."""
+
+    cause = "unknown_model"
+    http_status = 404
+
+
+class FleetResult(NamedTuple):
+    """One predict answer: the output rows and (when the request rode a
+    single engine batch) the params generation that produced them."""
+
+    output: np.ndarray
+    generation: Optional[int]
+
+
+def _tree_bytes(*trees) -> int:
+    import jax
+
+    return sum(int(leaf.nbytes) for tree in trees
+               for leaf in jax.tree.leaves(tree))
+
+
+class FleetEntry:
+    """One named model: host weight copy + (when resident) serving stack."""
+
+    def __init__(self, name: str, model, params, state=None, *,
+                 version: str = "v0", input_dtype=np.float32, metrics=None,
+                 aot_store=None, engine_opts: Optional[dict] = None,
+                 gen_opts: Optional[dict] = None):
+        import jax
+
+        self.name = name
+        self.model = model
+        self.input_dtype = input_dtype
+        self.metrics = metrics
+        self.aot_store = aot_store
+        self.engine_opts = dict(engine_opts or {})
+        self.gen_opts = dict(gen_opts or {})
+        self.version = version
+        # RLock held across the WHOLE of activate()/deactivate(): the pager
+        # may start re-activating a victim (new traffic arrived) while its
+        # drain is still completing — the lock serializes the lifecycles so
+        # the new stack always starts from the drained host copy
+        self._lock = threading.RLock()
+        self._host_params = jax.tree.map(np.asarray, params)
+        self._host_state = jax.tree.map(
+            np.asarray, state if state is not None else {})
+        self.weight_bytes = _tree_bytes(self._host_params, self._host_state)
+        self._next_generation = 1
+        self._registry: Optional[ModelRegistry] = None
+        self._engine: Optional[ServeEngine] = None
+        self._batcher: Optional[ContinuousBatcher] = None
+        self._had_batcher = False
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def resident(self) -> bool:
+        with self._lock:
+            return self._engine is not None
+
+    def activate(self) -> None:
+        """Host copy -> device, registry/engine up, executables warmed.
+        Called by the pager with residency bytes already reserved."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._engine is not None:
+                return
+            params = jax.tree.map(jnp.asarray, self._host_params)
+            state = jax.tree.map(jnp.asarray, self._host_state)
+            self._registry = ModelRegistry(
+                params, state, version=self.version, metrics=self.metrics,
+                model=self.name, start_generation=self._next_generation)
+            self._engine = ServeEngine(
+                self.model, registry=self._registry, metrics=self.metrics,
+                aot_store=self.aot_store, model_name=self.name,
+                **self.engine_opts)
+            if self.aot_store is not None:
+                # store hit on every re-activation: page-in never re-traces
+                self._engine.warm(self.input_dtype)
+            if self._had_batcher:
+                # the model served generate traffic last residency; rebuild
+                # eagerly so paged-in decode is warm before the next request
+                self._build_batcher_locked()
+
+    def deactivate(self) -> None:
+        """Lease-drain, pull current weights to host, drop device refs.
+
+        This is the hot-swap drain discipline applied to eviction:
+        ``shutdown(drain=True)`` completes every admitted batch/generation
+        against the old device params before they are released, so no
+        in-flight work ever loses its params. The *current* registry
+        snapshot (including any generations published while resident) is
+        what survives as the host copy."""
+        import jax
+
+        with self._lock:
+            if self._engine is None:
+                return
+            self._engine.shutdown(drain=True)
+            if self._batcher is not None:
+                self._batcher.shutdown(drain=True)
+            snap = self._registry.current()
+            self._host_params = jax.tree.map(np.asarray, snap.params)
+            self._host_state = jax.tree.map(np.asarray, snap.state)
+            self.weight_bytes = _tree_bytes(self._host_params,
+                                            self._host_state)
+            self.version = snap.version
+            self._next_generation = snap.generation + 1
+            self._registry = None
+            self._engine = None
+            self._batcher = None
+
+    # --------------------------------------------------------------- serving
+    def engine(self) -> ServeEngine:
+        with self._lock:
+            if self._engine is None:
+                raise ServerClosingError(
+                    f"model {self.name!r} is not resident")
+            return self._engine
+
+    def _build_batcher_locked(self) -> None:
+        self._batcher = ContinuousBatcher(
+            self.model, registry=self._registry, metrics=self.metrics,
+            aot_store=self.aot_store, model_name=self.name, **self.gen_opts)
+        self._had_batcher = True
+
+    def batcher(self) -> ContinuousBatcher:
+        with self._lock:
+            if self._engine is None:
+                raise ServerClosingError(
+                    f"model {self.name!r} is not resident")
+            if self._batcher is None:
+                self._build_batcher_locked()
+            return self._batcher
+
+    def publish(self, params, state=None, version: Optional[str] = None,
+                drain: bool = True) -> int:
+        """Hot-swap this model's weights; returns the new generation.
+        Resident: the full registry publish (warmers precompile the
+        candidate, atomic flip, lease drain). Cold: the host copy and
+        generation counter advance so the next activation serves the new
+        weights under the right generation number."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._registry is not None:
+                snap = self._registry.publish(
+                    jax.tree.map(jnp.asarray, params),
+                    state=(jax.tree.map(jnp.asarray, state)
+                           if state is not None else None),
+                    version=version, drain=drain)
+                self.version = snap.version
+                return snap.generation
+            self._host_params = jax.tree.map(np.asarray, params)
+            if state is not None:
+                self._host_state = jax.tree.map(np.asarray, state)
+            self.weight_bytes = _tree_bytes(self._host_params,
+                                            self._host_state)
+            gen = self._next_generation
+            self.version = version if version is not None else f"v{gen - 1}"
+            self._next_generation = gen + 1
+            return gen
+
+    def info(self) -> dict:
+        with self._lock:
+            resident = self._engine is not None
+            return {
+                "resident": resident,
+                "version": self.version,
+                "generation": (self._registry.generation if resident
+                               else self._next_generation - 1),
+                "weight_bytes": int(self.weight_bytes),
+                "generate_ready": self._batcher is not None,
+            }
+
+
+class FleetRegistry:
+    """Named models + tenant admission + weight paging, one front door.
+
+    ``hbm_budget_bytes`` caps summed resident weights (None = unbounded);
+    ``aot_store`` is shared across models (cache keys include the model's
+    architecture fingerprint, so entries never collide). Per-model
+    engine/batcher knobs ride in ``add(engine_opts=..., gen_opts=...)``.
+    """
+
+    def __init__(self, *, hbm_budget_bytes: Optional[int] = None,
+                 metrics=None, aot_store=None,
+                 tenants: Optional[TenantTable] = None):
+        from ..obs.metrics import MetricsRegistry
+
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.aot_store = aot_store
+        self.tenants = tenants if tenants is not None \
+            else TenantTable(metrics=self.metrics)
+        self.pager = WeightPager(hbm_budget_bytes, metrics=self.metrics)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, FleetEntry] = {}
+        self._closing = False
+
+    # ------------------------------------------------------------ membership
+    def add(self, name: str, model, params=None, state=None, *,
+            version: str = "v0", input_dtype=np.float32,
+            engine_opts: Optional[dict] = None,
+            gen_opts: Optional[dict] = None,
+            eager: bool = False) -> FleetEntry:
+        """Register a model under ``name``. Weights default to the model's
+        own initialized params. ``eager=True`` pages it in immediately;
+        otherwise the first request does."""
+        entry = FleetEntry(
+            name, model,
+            params if params is not None else model.params,
+            state if state is not None else model.state,
+            version=version, input_dtype=input_dtype, metrics=self.metrics,
+            aot_store=self.aot_store, engine_opts=engine_opts,
+            gen_opts=gen_opts)
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"model {name!r} already registered — "
+                                 f"publish() hot-swaps weights in place")
+            self._entries[name] = entry
+        if eager:
+            self.pager.ensure(entry)
+        return entry
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(name, None)
+        if entry is None:
+            raise UnknownModelError(f"no model named {name!r}")
+        self.pager.drop(entry)
+
+    def get(self, name: str) -> FleetEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise UnknownModelError(f"no model named {name!r}")
+        return entry
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._entries)
+
+    def ensure(self, name: str) -> FleetEntry:
+        """Page a model in without serving a request (prewarm)."""
+        entry = self.get(name)
+        self.pager.ensure(entry)
+        return entry
+
+    # --------------------------------------------------------------- serving
+    def _admit(self, tenant: str, name: str,
+               timeout_ms: Optional[float]) -> Optional[float]:
+        slo = self.tenants.admit(tenant, model=name)
+        return timeout_ms if timeout_ms is not None else slo.deadline_ms
+
+    def predict(self, name: str, x, *, tenant: str = "anonymous",
+                timeout_ms: Optional[float] = None) -> FleetResult:
+        """Tenant admission -> page-in -> engine predict. ``timeout_ms``
+        defaults to the tenant's SLO deadline."""
+        timeout_ms = self._admit(tenant, name, timeout_ms)
+        entry = self.get(name)
+        x = np.asarray(x, entry.input_dtype)
+        last: Optional[ServeError] = None
+        for _ in range(_EVICTION_RETRIES):
+            self.pager.ensure(entry)
+            try:
+                eng = entry.engine()
+                if x.ndim > len(entry.model.input_shape) \
+                        and x.shape[0] <= eng.batch_buckets[-1]:
+                    handle = eng.submit(x, timeout_ms=timeout_ms)
+                    return FleetResult(handle.wait(), handle.generation)
+                return FleetResult(
+                    eng.predict(x, timeout_ms=timeout_ms), None)
+            except ServerClosingError as e:
+                last = e  # lost the race with an eviction: page back in
+        raise last
+
+    def submit_generate(self, name: str, prompt, max_new_tokens: int, *,
+                        tenant: str = "anonymous", temperature: float = 1.0,
+                        top_k: Optional[int] = None,
+                        eos_id: Optional[int] = None,
+                        timeout_ms: Optional[float] = None):
+        """Admit one generation; returns the batcher's streamable handle."""
+        timeout_ms = self._admit(tenant, name, timeout_ms)
+        entry = self.get(name)
+        prompt = np.asarray(prompt, np.int32)
+        last: Optional[ServeError] = None
+        for _ in range(_EVICTION_RETRIES):
+            self.pager.ensure(entry)
+            try:
+                return entry.batcher().submit(
+                    prompt, max_new_tokens, temperature=temperature,
+                    top_k=top_k, eos_id=eos_id, timeout_ms=timeout_ms)
+            except ServerClosingError as e:
+                last = e
+        raise last
+
+    def generate(self, name: str, prompt, max_new_tokens: int, *,
+                 tenant: str = "anonymous", temperature: float = 1.0,
+                 top_k: Optional[int] = None, eos_id: Optional[int] = None,
+                 timeout_ms: Optional[float] = None) -> np.ndarray:
+        """Blocking generate; batch prompts fan out row-per-request like
+        :meth:`ContinuousBatcher.generate`."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 1:
+            return self.submit_generate(
+                name, prompt, max_new_tokens, tenant=tenant,
+                temperature=temperature, top_k=top_k, eos_id=eos_id,
+                timeout_ms=timeout_ms).wait()
+        handles = [self.submit_generate(
+            name, p, max_new_tokens, tenant=tenant, temperature=temperature,
+            top_k=top_k, eos_id=eos_id, timeout_ms=timeout_ms)
+            for p in prompt]
+        outs = [h.wait() for h in handles]
+        width = max(o.shape[0] for o in outs)
+        pad = eos_id if eos_id is not None else 0
+        full = np.full((len(outs), width), pad, np.int32)
+        for i, o in enumerate(outs):
+            full[i, :o.shape[0]] = o
+        return full
+
+    # ----------------------------------------------------------------- admin
+    def publish(self, name: str, params, state=None,
+                version: Optional[str] = None, drain: bool = True) -> int:
+        return self.get(name).publish(params, state=state, version=version,
+                                      drain=drain)
+
+    def status(self) -> dict:
+        with self._lock:
+            entries = dict(self._entries)
+        body: Dict[str, Any] = {
+            "models": {n: e.info() for n, e in sorted(entries.items())},
+            "pager": self.pager.stats(),
+            "tenants": self.tenants.stats(),
+        }
+        if self.aot_store is not None:
+            body["aot_store"] = self.aot_store.stats()
+        return body
+
+    def shutdown(self) -> None:
+        """Drain and deactivate every resident model."""
+        with self._lock:
+            self._closing = True
+            entries = list(self._entries.values())
+        for entry in entries:
+            self.pager.drop(entry)

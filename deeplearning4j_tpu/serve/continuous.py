@@ -188,7 +188,7 @@ class ContinuousBatcher:
                  prompt_buckets: Optional[Sequence[int]] = None,
                  queue_limit: int = 64, seed: int = 0, metrics=None,
                  scheduler: Optional[PrefillScheduler] = None,
-                 aot_store=None):
+                 aot_store=None, model_name: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -204,10 +204,14 @@ class ContinuousBatcher:
         if kv not in ("paged", "dense"):
             raise ValueError(f"kv must be 'paged' or 'dense', got {kv!r}")
         self.model = model
+        # fleet serving: model=<name> on every batcher metric; None keeps
+        # the historical single-model label sets (absent == empty label)
+        self.model_name = model_name
         if registry is None:
             registry = ModelRegistry(
                 params if params is not None else model.params,
-                state if state is not None else model.state, metrics=metrics)
+                state if state is not None else model.state, metrics=metrics,
+                model=model_name)
         self.registry = registry
         self.kv = kv
         self.slots = int(slots)
@@ -406,45 +410,46 @@ class ContinuousBatcher:
         self._keys = np.zeros((S, 2), np.uint32)
 
         m = self.metrics
-        self._m_active = m.gauge("serve_gen_active_slots",
+        self._m_active = m.gauge("serve_gen_active_slots", self._lbl(),
                                  help="in-flight generation slots")
-        self._m_qdepth = m.gauge("serve_gen_queue_depth",
+        self._m_qdepth = m.gauge("serve_gen_queue_depth", self._lbl(),
                                  help="generation requests waiting for a slot")
-        self._m_admitted = m.counter("serve_gen_admitted_total",
+        self._m_admitted = m.counter("serve_gen_admitted_total", self._lbl(),
                                      help="generation requests prefilled")
-        self._m_completed = m.counter("serve_gen_completed_total",
+        self._m_completed = m.counter("serve_gen_completed_total", self._lbl(),
                                       help="generation requests finished")
-        self._m_tokens = m.counter("serve_gen_tokens_total",
+        self._m_tokens = m.counter("serve_gen_tokens_total", self._lbl(),
                                    help="tokens decoded across all slots")
-        self._m_decode_s = m.histogram("serve_gen_decode_seconds",
+        self._m_decode_s = m.histogram("serve_gen_decode_seconds", self._lbl(),
                                        help="one all-slots decode tick")
         self._m_prefill_s = m.histogram("serve_gen_prefill_seconds",
+                                        self._lbl(),
                                         help="prompt prefill device time "
                                              "(per chunk when chunked)")
         self._m_occupancy = m.histogram(
-            "serve_gen_slot_occupancy",
+            "serve_gen_slot_occupancy", self._lbl(),
             buckets=tuple((i + 1) / S for i in range(S)),
             help="active slots / total slots per decode tick")
         self._m_compiles = m.counter(
-            "serve_compile_misses_total", {"component": "generate"},
+            "serve_compile_misses_total", self._lbl({"component": "generate"}),
             help="new (bucket, shape) signatures — each is an XLA compile")
         if kv == "paged":
-            m.gauge("serve_kv_blocks_total",
+            m.gauge("serve_kv_blocks_total", self._lbl(),
                     help="allocatable KV blocks (excl. trash block)"
                     ).set(self._alloc.usable)
-            self._m_kv_used = m.gauge("serve_kv_blocks_used",
+            self._m_kv_used = m.gauge("serve_kv_blocks_used", self._lbl(),
                                       help="KV blocks currently allocated")
             self._m_kv_util = m.gauge(
-                "serve_kv_block_utilization",
+                "serve_kv_block_utilization", self._lbl(),
                 help="allocated / allocatable KV blocks")
             self._m_kv_bytes = m.gauge(
-                "serve_kv_live_bytes",
+                "serve_kv_live_bytes", self._lbl(),
                 help="bytes of KV pool backing live tokens (all layers)")
             self._m_pf_depth = m.gauge(
-                "serve_prefill_queue_depth",
+                "serve_prefill_queue_depth", self._lbl(),
                 help="prompts mid-prefill (chunked jobs in flight)")
             self._m_pf_chunks = m.counter(
-                "serve_prefill_chunks_total",
+                "serve_prefill_chunks_total", self._lbl(),
                 help="prefill chunks executed")
             self._update_kv_gauges()
 
@@ -477,7 +482,8 @@ class ContinuousBatcher:
             self._aot = aot_store
             t0 = time.perf_counter()
             self._warm_for(snap0.params, snap0.state)
-            m.gauge("serve_cold_start_seconds", {"component": "generate"},
+            m.gauge("serve_cold_start_seconds",
+                    self._lbl({"component": "generate"}),
                     help="wall time to materialize the serving executables"
                     ).set(time.perf_counter() - t0)
             # precompile-before-flip: publish warms the candidate against
@@ -532,10 +538,21 @@ class ContinuousBatcher:
                                    sds((), i32))
 
     # ------------------------------------------------------------------ admit
+    def _lbl(self, labels: Optional[dict] = None) -> dict:
+        out = dict(labels or {})
+        if self.model_name is not None:
+            out["model"] = self.model_name
+        return out
+
     def _shed_counter(self, cause: str):
         return self.metrics.counter(
-            "serve_shed_total", {"cause": cause},
+            "serve_shed_total", self._lbl({"cause": cause}),
             help="requests refused at admission, by cause")
+
+    def queue_depth(self) -> int:
+        """Generation requests waiting for a slot (Retry-After input)."""
+        with self._cond:
+            return len(self._queue)
 
     def submit(self, prompt, max_new_tokens: int, *, temperature: float = 1.0,
                top_k: Optional[int] = None, eos_id: Optional[int] = None,

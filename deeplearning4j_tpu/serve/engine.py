@@ -142,17 +142,22 @@ class ServeEngine:
                  queue_limit: int = 256, max_wait_ms: float = 2.0,
                  default_timeout_ms: Optional[float] = None,
                  admission: str = "shed", metrics=None, forward=None,
-                 aot_store=None):
+                 aot_store=None, model_name: Optional[str] = None):
         from ..obs.metrics import MetricsRegistry
 
         if admission not in ("shed", "block"):
             raise ValueError(f"admission must be 'shed' or 'block', "
                              f"got {admission!r}")
         self.model = model
+        # fleet serving: stamp every engine metric with model=<name> so one
+        # registry scrape disaggregates per model; None (single-model) emits
+        # the historical label sets unchanged (absent == empty in Prometheus)
+        self.model_name = model_name
         if registry is None:
             registry = ModelRegistry(
                 params if params is not None else model.params,
-                state if state is not None else model.state, metrics=metrics)
+                state if state is not None else model.state, metrics=metrics,
+                model=model_name)
         self.registry = registry
         self.batch_buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
         if not self.batch_buckets or self.batch_buckets[0] < 1:
@@ -187,23 +192,24 @@ class ServeEngine:
         self._batch_count = 0
 
         m = self.metrics
-        self._m_depth = m.gauge("serve_queue_depth",
+        self._m_depth = m.gauge("serve_queue_depth", self._lbl(),
                                 help="rows waiting for a device batch")
-        self._m_queue_s = m.histogram("serve_queue_seconds",
+        self._m_queue_s = m.histogram("serve_queue_seconds", self._lbl(),
                                       help="admission -> batch dispatch wait")
-        self._m_device_s = m.histogram("serve_device_seconds",
+        self._m_device_s = m.histogram("serve_device_seconds", self._lbl(),
                                        help="device forward wall time per batch")
         self._m_occupancy = m.histogram(
-            "serve_batch_occupancy", buckets=_OCCUPANCY_BUCKETS,
+            "serve_batch_occupancy", self._lbl(), buckets=_OCCUPANCY_BUCKETS,
             help="real rows / padded bucket size per device batch")
-        self._m_batches = m.counter("serve_batches_total",
+        self._m_batches = m.counter("serve_batches_total", self._lbl(),
                                     help="device batches executed")
-        self._m_requests = m.counter("serve_requests_total",
+        self._m_requests = m.counter("serve_requests_total", self._lbl(),
                                      help="requests admitted")
         self._m_compiles = m.counter(
-            "serve_compile_misses_total", {"component": "engine"},
+            "serve_compile_misses_total", self._lbl({"component": "engine"}),
             help="new (bucket, shape) signatures — each is an XLA compile")
         self._m_deadline = m.counter("serve_deadline_expired_total",
+                                     self._lbl(),
                                      help="requests expired before dispatch")
 
         # --- persistent AOT store (optional): consult disk before tracing ---
@@ -229,10 +235,21 @@ class ServeEngine:
         self._thread.start()
 
     # ------------------------------------------------------------------ admit
+    def _lbl(self, labels: Optional[dict] = None) -> dict:
+        out = dict(labels or {})
+        if self.model_name is not None:
+            out["model"] = self.model_name
+        return out
+
     def _shed_counter(self, cause: str):
         return self.metrics.counter(
-            "serve_shed_total", {"cause": cause},
+            "serve_shed_total", self._lbl({"cause": cause}),
             help="requests refused at admission, by cause")
+
+    def queue_depth(self) -> int:
+        """Rows currently waiting for a device batch (Retry-After input)."""
+        with self._cond:
+            return self._depth_rows
 
     def _bucket_length(self, t: int) -> int:
         for b in self.length_buckets:
@@ -430,7 +447,7 @@ class ServeEngine:
                                                     np.dtype(dtype)))
         elapsed = time.perf_counter() - t0
         self.metrics.gauge(
-            "serve_cold_start_seconds", {"component": "engine"},
+            "serve_cold_start_seconds", self._lbl({"component": "engine"}),
             help="wall time to materialize the serving executables"
             ).set(elapsed)
         return elapsed

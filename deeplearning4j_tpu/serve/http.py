@@ -47,6 +47,15 @@ _BAD_REQUEST = (KeyError, ValueError, TypeError, AttributeError,
                 json.JSONDecodeError)
 
 
+def retry_after_s(depth: int, limit: int) -> int:
+    """Back-off hint for a 503/429 shed, derived from queue depth: an idle
+    queue says "retry in 1s", a full one scales up to 30s — so a fleet of
+    well-behaved clients spreads its retries instead of dog-piling the
+    instant the server sheds."""
+    frac = depth / max(int(limit), 1)
+    return int(max(1, min(30, round(1 + 29 * frac))))
+
+
 class ModelServer(JsonHTTPServerMixin):
     """Serve one model (registry) over HTTP.
 
@@ -131,6 +140,17 @@ class ModelServer(JsonHTTPServerMixin):
         with self._lifecycle_lock:
             return self._accepting
 
+    def _retry_after(self) -> int:
+        """Retry-After seconds for shed answers, scaled by how backed up
+        the predict queue and (if built) the generation queue are."""
+        depth, limit = self.engine.queue_depth(), self.engine.queue_limit
+        with self._lifecycle_lock:
+            batcher = self._batcher
+        if batcher is not None:
+            depth += batcher.queue_depth()
+            limit += batcher.queue_limit
+        return retry_after_s(depth, limit)
+
     # --- handler ---
     def _handler(self):
         server = self
@@ -172,8 +192,12 @@ class ModelServer(JsonHTTPServerMixin):
                     else:
                         self.reply(404, {"error": "unknown endpoint"})
                 except ServeError as e:
+                    headers = None
+                    if e.http_status == 503:
+                        headers = {"Retry-After": server._retry_after()}
                     self.reply(e.http_status,
-                               {"error": str(e), "cause": e.cause})
+                               {"error": str(e), "cause": e.cause},
+                               headers=headers)
                 except _BAD_REQUEST as e:
                     self.reply(400, {"error": str(e)})
                 except Exception as e:  # server must answer every request  # jaxlint: disable=broad-except

@@ -69,7 +69,8 @@ class ModelRegistry:
     """
 
     def __init__(self, params, state=None, version: str = "v0",
-                 keep: int = 8, metrics=None):
+                 keep: int = 8, metrics=None, model: Optional[str] = None,
+                 start_generation: int = 1):
         if params is None:
             raise ValueError("registry needs initialized params")
         _check_live(params)
@@ -79,7 +80,17 @@ class ModelRegistry:
         self._history: List[ModelSnapshot] = []
         self._warmers: List[Callable[[Any, Any], None]] = []
         self._metrics = metrics
-        snap = ModelSnapshot(1, version, params, state if state is not None else {})
+        # Fleet serving: name the model on every registry metric so one
+        # scrape disaggregates per model; single-model registries (model
+        # None) emit exactly the label sets they always did, which in
+        # Prometheus is equivalent to model="".
+        self.model = model
+        # A paged-out model resumes from where its last residency ended
+        # (fleet pager passes start_generation) so "which params ran this
+        # batch" stays a total order across page-out/page-in cycles.
+        start = max(int(start_generation), 1)
+        snap = ModelSnapshot(start, version,
+                             params, state if state is not None else {})
         self._keep = max(int(keep), 1)
         with self._cond:
             self._history.append(snap)
@@ -108,7 +119,8 @@ class ModelRegistry:
             self._inflight[snap.generation] = \
                 self._inflight.get(snap.generation, 0) + 1
         if tag is not None and self._metrics is not None:
-            self._metrics.counter("serve_lease_total", {"tag": tag},
+            self._metrics.counter("serve_lease_total",
+                                  self._labels({"tag": tag}),
                                   help="registry leases taken, by caller tag"
                                   ).inc()
         try:
@@ -215,12 +227,19 @@ class ModelRegistry:
             return [(s.generation, s.version) for s in self._history]
 
     # --- metrics plumbing (no-op when the registry has no MetricsRegistry) ---
+    def _labels(self, labels: Optional[Dict[str, str]] = None
+                ) -> Dict[str, str]:
+        out = dict(labels or {})
+        if self.model is not None:
+            out["model"] = self.model
+        return out
+
     def _gauge_generation(self, gen: int) -> None:
         if self._metrics is not None:
-            self._metrics.gauge("serve_model_generation",
+            self._metrics.gauge("serve_model_generation", self._labels(),
                                 help="currently published model generation"
                                 ).set(gen)
 
     def _count(self, name: str, help_: str) -> None:
         if self._metrics is not None:
-            self._metrics.counter(name, help=help_).inc()
+            self._metrics.counter(name, self._labels(), help=help_).inc()

@@ -75,12 +75,15 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
-    def reply(self, code: int, payload, ctype: str = "application/json"):
+    def reply(self, code: int, payload, ctype: str = "application/json",
+              headers: Optional[dict] = None):
         body = payload.encode() if isinstance(payload, str) \
             else json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 

@@ -32,6 +32,11 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/serve/
 # replica that mounts it, so it holds the same zero-suppression bar.
 echo "=== jaxlint: deeplearning4j_tpu/aot/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/aot/
+# fleet/ pages model weights and multiplexes tenants: an unlocked resident
+# map or a swallowed drain error serves one tenant another tenant's params,
+# so it holds the same zero-suppression bar as serve/.
+echo "=== jaxlint: deeplearning4j_tpu/fleet/ (no baseline permitted) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/fleet/
 
 echo "=== smoke trace: 5-step instrumented train ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_trace.py

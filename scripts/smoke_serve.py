@@ -174,6 +174,100 @@ def _aot_warm_boot(out_dir):
     return int(hits)
 
 
+def _fleet_scenario(out_dir):
+    """ISSUE-7 acceptance: two named models share an HBM budget that fits
+    only ONE, served over the routed fleet front door by two tenants.
+    Concurrent cross-model traffic forces page-ins UNDER LOAD and every
+    response must still match its own model (zero wrong-params answers);
+    the throttled tenant's sheds surface as HTTP 429 + Retry-After and as
+    ``serve_shed_total{cause="quota",tenant=...}`` on the shared scrape,
+    which lands in $CI_ARTIFACTS_DIR as smoke_serve_fleet.prom."""
+    import urllib.error
+
+    import jax
+
+    from deeplearning4j_tpu.fleet import FleetRegistry, FleetServer
+    from deeplearning4j_tpu.models import CausalLM
+
+    models = {}
+    for name, seed in (("alpha", 0), ("beta", 1)):
+        m = CausalLM(seed=seed, input_shape=(16,), num_layers=2, d_model=32,
+                     num_heads=4, vocab=50).build()
+        m.init()
+        models[name] = m
+    wb = sum(int(np.asarray(leaf).nbytes) for leaf in
+             jax.tree.leaves((models["alpha"].params,
+                              models["alpha"].state)))
+    fleet = FleetRegistry(hbm_budget_bytes=wb + wb // 2)  # one resident
+    for name, m in models.items():
+        fleet.add(name, m, input_dtype=np.int32,
+                  engine_opts={"batch_buckets": (1, 2, 4)})
+    fleet.tenants.register("pro", rate_per_s=500, slo="standard")
+    fleet.tenants.register("free", rate_per_s=1.0, burst=2.0, slo="batch")
+    srv = FleetServer(fleet, port=0).start()
+    try:
+        rng = np.random.RandomState(3)
+        prompts = rng.randint(0, 50, (4, 2, 16)).astype(np.int32)
+        refs = {n: [np.asarray(m.output(p)) for p in prompts]
+                for n, m in models.items()}
+
+        def post(name, j, tenant):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/models/{name}/predict",
+                data=json.dumps({"ndarray": prompts[j].tolist()}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Tenant": tenant})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        # interleaved cross-model traffic: every round trips a page cycle,
+        # and the paging happens while other requests are in flight
+        jobs = [(("alpha", "beta")[i % 2], i % len(prompts))
+                for i in range(12)]
+        with cf.ThreadPoolExecutor(4) as ex:
+            outs = list(ex.map(lambda nj: (nj, post(*nj, "pro")), jobs))
+        for (name, j), reply in outs:
+            assert reply["model"] == name
+            np.testing.assert_allclose(
+                np.asarray(reply["output"]), refs[name][j],
+                rtol=1e-4, atol=1e-5,
+                err_msg=f"wrong-params response from {name}")
+
+        # quota tenant: the bucket admits the burst, then 429 + Retry-After
+        quota = []
+        for _ in range(6):
+            try:
+                post("alpha", 0, "free")
+                quota.append(200)
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read())
+                quota.append((e.code, body["cause"],
+                              e.headers.get("Retry-After")))
+        sheds = [q for q in quota if q != 200]
+        assert 200 in quota and sheds, quota
+        assert all(q[0] == 429 and q[1] == "quota" and int(q[2]) >= 1
+                   for q in sheds), quota
+
+        status = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/fleet", timeout=10).read())
+        page_ins = status["pager"]["page_ins"]
+        assert page_ins >= 3, status["pager"]  # paging happened under load
+        assert status["tenants"]["free"]["shed"] >= 1, status["tenants"]
+
+        scrape = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10).read().decode()
+        for needle in ('serve_shed_total{cause="quota"', 'tenant="free"',
+                       "fleet_page_in_total{model=", "fleet_page_out_total",
+                       "fleet_resident_bytes", "fleet_hbm_budget_bytes",
+                       'serve_lease_total{model='):
+            assert needle in scrape, f"missing {needle} in fleet /metrics"
+        with open(os.path.join(out_dir, "smoke_serve_fleet.prom"), "w") as f:
+            f.write(scrape)
+        return page_ins, len(sheds)
+    finally:
+        srv.stop()
+
+
 def main() -> int:
     out_dir = os.environ.get("CI_ARTIFACTS_DIR", "ci-artifacts")
     os.makedirs(out_dir, exist_ok=True)
@@ -260,6 +354,12 @@ def main() -> int:
     aot_hits = _aot_warm_boot(out_dir)
     print(f"smoke_serve: warm second boot served from the AOT store "
           f"({aot_hits} executable loads, 0 compiles)")
+
+    # fleet acceptance: two models sharing a one-model budget, two tenants,
+    # page-ins under load, quota sheds on the scrape
+    page_ins, quota_sheds = _fleet_scenario(out_dir)
+    print(f"smoke_serve: fleet scenario OK — {page_ins} page-ins under "
+          f"load, {quota_sheds} quota shed(s) with Retry-After")
     return 0
 
 

@@ -1,0 +1,474 @@
+"""Tests for the fleet/ subsystem (ISSUE 7).
+
+The load-bearing properties, each tested directly:
+
+- token bucket: over any window of a simulated clock, grants never exceed
+  ``burst + rate * window`` (no tenant ever exceeds its rate), and a
+  starved tenant recovers as soon as tokens refill;
+- tenant admission: over-quota is a typed :class:`QuotaError` (429) with
+  ``serve_shed_total{cause="quota",tenant=...}`` incremented and a
+  bucket-derived ``retry_after_s``; SLO classes map to deadlines that feed
+  the engine's existing timeout machinery;
+- LRU pager: eviction order and byte accounting against stub entries;
+  a model that can never fit is a typed ``CapacityError``; concurrent
+  page-ins of one model dedupe to a single activation;
+- lease-drain eviction: a victim's in-flight request completes (with the
+  right params) BEFORE the incoming model's activation finishes;
+- paging correctness: >= 3 models under a budget smaller than their sum
+  serve concurrent traffic with zero wrong-params responses, and a
+  paged-out model's next request pages it back in and answers correctly
+  (predict and generate), with generation numbers continuing across the
+  page cycle;
+- zero recompiles on re-activation when an ``aot_store`` is attached:
+  the per-model compile-miss counters stay flat across page-out/page-in;
+- front door: routed predict/generate, ``X-Tenant``, 404 on unknown
+  models, 429 + ``Retry-After`` on quota sheds, ``/v1/fleet`` status.
+"""
+
+import concurrent.futures as cf
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.aot import AotStore
+from deeplearning4j_tpu.fleet import (FleetRegistry, FleetServer, QuotaError,
+                                      TenantTable, TokenBucket, WeightPager)
+from deeplearning4j_tpu.nn.layers import Dense, Output
+from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.serve import CapacityError
+
+
+def _dense_model(n_in=4, n_out=3, seed=0):
+    m = Sequential(NetConfig(seed=seed),
+                   [Dense(n_out=6, activation="tanh"),
+                    Output(n_out=n_out, loss="mcxent", activation="softmax")],
+                   (n_in,))
+    m.init()
+    return m
+
+
+def _slow_forward(model, delay_s):
+    def fwd(params, state, x):
+        time.sleep(delay_s)
+        y, _ = model.forward(params, state, x, training=False)
+        return np.asarray(y)
+
+    return fwd
+
+
+def _lm(seed=0):
+    from deeplearning4j_tpu.models import CausalLM
+
+    m = CausalLM(seed=seed, input_shape=(16,), num_layers=2, d_model=32,
+                 num_heads=4, vocab=50).build()
+    m.init()
+    return m
+
+
+def _weight_bytes(model) -> int:
+    return sum(int(np.asarray(leaf).nbytes)
+               for leaf in jax.tree.leaves((model.params, model.state)))
+
+
+class TestTokenBucket:
+    def test_rate_is_never_exceeded_over_any_window(self):
+        """Property: with a simulated clock and adversarially bursty
+        arrivals, the number of grants inside ANY window [t_i, t_j] is
+        bounded by burst + rate * (t_j - t_i)."""
+        rng = np.random.RandomState(7)
+        rate, burst = 10.0, 5.0
+        bucket = TokenBucket(rate, burst)
+        now, grants = 0.0, []
+        for _ in range(1500):
+            # mix of dense bursts and lulls
+            now += float(rng.exponential(0.02 if rng.rand() < 0.8 else 0.5))
+            if bucket.take(now=now):
+                grants.append(now)
+        assert len(grants) > 50  # the clock advanced; real traffic flowed
+        for i in range(len(grants)):
+            for j in range(i, len(grants)):
+                window = grants[j] - grants[i]
+                allowed = burst + rate * window
+                count = j - i + 1
+                assert count <= allowed + 1e-9, \
+                    f"{count} grants in {window:.3f}s exceeds {allowed:.2f}"
+
+    def test_starved_bucket_recovers(self):
+        bucket = TokenBucket(rate_per_s=2.0, burst=2.0)
+        now = 0.0
+        assert bucket.take(now=now) and bucket.take(now=now)
+        assert not bucket.take(now=now)          # starved
+        assert bucket.wait_s(now=now) == pytest.approx(0.5)
+        now += 0.6                               # one token refilled
+        assert bucket.take(now=now)              # recovered
+        assert not bucket.take(now=now)
+        now += 10.0                              # refill caps at burst
+        assert bucket.tokens <= bucket.burst
+        assert bucket.take(now=now) and bucket.take(now=now)
+        assert not bucket.take(now=now)
+
+    def test_rejects_nonpositive_config(self):
+        with pytest.raises(ValueError):
+            TokenBucket(0.0, 1.0)
+        with pytest.raises(ValueError):
+            TokenBucket(1.0, 0.0)
+
+
+class TestTenantTable:
+    def test_quota_shed_is_typed_and_counted(self):
+        metrics = MetricsRegistry()
+        table = TenantTable(metrics=metrics)
+        table.register("free", rate_per_s=1.0, burst=2.0, slo="batch")
+        now = 0.0
+        assert table.admit("free", model="m", now=now).name == "batch"
+        assert table.admit("free", model="m", now=now).name == "batch"
+        with pytest.raises(QuotaError) as ei:
+            table.admit("free", model="m", now=now)
+        assert ei.value.http_status == 429
+        assert ei.value.cause == "quota"
+        assert ei.value.retry_after_s == pytest.approx(1.0)
+        shed = metrics.counter(
+            "serve_shed_total",
+            {"cause": "quota", "tenant": "free", "model": "m"})
+        assert shed.value == 1
+        assert table.stats()["free"]["shed"] == 1
+        assert table.stats()["free"]["admitted"] == 2
+        # refill: the starved tenant recovers
+        assert table.admit("free", model="m", now=now + 1.5).name == "batch"
+
+    def test_unknown_tenant_gets_default_policy(self):
+        table = TenantTable(default_rate_per_s=2.0, default_burst=1.0)
+        slo = table.admit("never-seen-before", now=0.0)
+        assert slo.name == "standard" and slo.deadline_ms == 5000.0
+        with pytest.raises(QuotaError):
+            table.admit("never-seen-before", now=0.0)
+
+    def test_slo_classes_map_to_deadlines(self):
+        table = TenantTable()
+        table.register("vip", rate_per_s=100, slo="gold")
+        table.register("bulk", rate_per_s=100, slo="batch")
+        assert table.admit("vip", now=0.0).deadline_ms == 1000.0
+        assert table.admit("bulk", now=0.0).deadline_ms is None
+        with pytest.raises(ValueError):
+            table.register("x", rate_per_s=1, slo="platinum")
+
+
+class _StubEntry:
+    """Duck-typed pager entry recording its lifecycle."""
+
+    def __init__(self, name, nbytes, log, delay_s=0.0):
+        self.name = name
+        self.weight_bytes = nbytes
+        self._log = log
+        self._delay = delay_s
+
+    def activate(self):
+        if self._delay:
+            time.sleep(self._delay)
+        self._log.append(("in", self.name))
+
+    def deactivate(self):
+        self._log.append(("out", self.name))
+
+
+class TestWeightPager:
+    def test_lru_eviction_order_and_accounting(self):
+        log = []
+        pager = WeightPager(budget_bytes=250)
+        a, b, c = (_StubEntry(n, 100, log) for n in "abc")
+        pager.ensure(a)
+        pager.ensure(b)
+        pager.ensure(c)        # over budget: evicts a (LRU)
+        assert log == [("in", "a"), ("in", "b"), ("in", "c"), ("out", "a")] \
+            or log == [("in", "a"), ("in", "b"), ("out", "a"), ("in", "c")]
+        assert pager.resident() == ["b", "c"]
+        pager.ensure(b)        # touch: b becomes MRU
+        pager.ensure(a)        # evicts c, NOT b
+        assert pager.resident() == ["b", "a"]
+        assert pager.stats()["resident_bytes"] == 200
+        assert pager.stats()["page_ins"] == 4
+        assert pager.stats()["page_outs"] == 2
+
+    def test_model_bigger_than_budget_is_typed(self):
+        pager = WeightPager(budget_bytes=100)
+        with pytest.raises(CapacityError):
+            pager.ensure(_StubEntry("huge", 101, []))
+
+    def test_concurrent_ensures_dedupe_to_one_activation(self):
+        log = []
+        pager = WeightPager(budget_bytes=1000)
+        e = _StubEntry("m", 10, log, delay_s=0.05)
+        with cf.ThreadPoolExecutor(8) as ex:
+            list(ex.map(lambda _: pager.ensure(e), range(8)))
+        assert log == [("in", "m")]  # exactly one page-in
+        assert pager.stats()["page_ins"] == 1
+
+
+class TestFleetPaging:
+    def test_eviction_blocks_on_live_leases(self):
+        """The pager may only drop a victim's params after every in-flight
+        batch against them retires — the hot-swap drain discipline."""
+        ma, mb = _dense_model(seed=1), _dense_model(seed=2)
+        wb = _weight_bytes(ma)
+        fleet = FleetRegistry(hbm_budget_bytes=wb + wb // 2)  # one resident
+        fleet.add("a", ma, engine_opts={
+            "batch_buckets": (1, 2), "forward": _slow_forward(ma, 0.3)})
+        fleet.add("b", mb, engine_opts={"batch_buckets": (1, 2)})
+        x = np.random.RandomState(0).rand(1, 4).astype(np.float32)
+        fleet.ensure("a")
+        done = {}
+
+        def slow_request():
+            res = fleet.predict("a", x, tenant="t")
+            done["a"] = (time.perf_counter(), res.output)
+
+        t = threading.Thread(target=slow_request)
+        t.start()
+        time.sleep(0.1)  # request admitted, forward mid-sleep
+        res_b = fleet.predict("b", x, tenant="t")   # forces eviction of a
+        t_b = time.perf_counter()
+        t.join(10)
+        assert "a" in done, "victim's in-flight request was dropped"
+        t_a, out_a = done["a"]
+        # the victim's batch completed BEFORE b's page-in finished serving
+        assert t_a <= t_b, "eviction did not wait for live leases"
+        np.testing.assert_allclose(
+            out_a, np.asarray(ma.output(x)), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            res_b.output, np.asarray(mb.output(x)), rtol=1e-4, atol=1e-5)
+        assert fleet.pager.resident() == ["b"]
+
+    def test_three_models_under_budget_concurrent_purity(self):
+        """Acceptance: >= 3 named models under a budget smaller than their
+        sum, LRU churn under concurrent traffic, ZERO wrong-params
+        responses."""
+        models = {n: _dense_model(seed=s)
+                  for n, s in (("alpha", 1), ("beta", 2), ("gamma", 3))}
+        wb = _weight_bytes(models["alpha"])
+        fleet = FleetRegistry(hbm_budget_bytes=2 * wb + wb // 2)  # fits 2/3
+        for n, m in models.items():
+            fleet.add(n, m, engine_opts={"batch_buckets": (1, 2, 4)})
+        rng = np.random.RandomState(0)
+        xs = {n: rng.rand(2, 4).astype(np.float32) for n in models}
+        refs = {n: np.asarray(models[n].output(xs[n])) for n in models}
+        names = sorted(models) * 8
+        rng.shuffle(names)
+
+        def fire(name):
+            res = fleet.predict(name, xs[name], tenant="t")
+            np.testing.assert_allclose(res.output, refs[name],
+                                       rtol=1e-4, atol=1e-5)
+            return name
+
+        with cf.ThreadPoolExecutor(6) as ex:
+            assert sorted(ex.map(fire, names)) == sorted(names)
+        stats = fleet.pager.stats()
+        assert stats["page_outs"] >= 1, "budget never forced an eviction"
+        assert len(fleet.pager.resident()) <= 2
+        fleet.shutdown()
+
+    def test_paged_out_model_pages_back_in_generate(self):
+        """A paged-out LM's next generate pages it back in, decodes
+        correctly, and its generation counter continues (never resets)."""
+        from deeplearning4j_tpu.nn.generation import generate as refgen
+
+        la, lb = _lm(seed=0), _lm(seed=1)
+        wb = _weight_bytes(la)
+        fleet = FleetRegistry(hbm_budget_bytes=wb + wb // 2)  # one resident
+        gen_opts = {"slots": 2, "capacity": 32, "prefill_chunk": 8}
+        fleet.add("a", la, input_dtype=np.int32, gen_opts=gen_opts)
+        fleet.add("b", lb, input_dtype=np.int32, gen_opts=gen_opts)
+        prompt = np.asarray([1, 2, 3, 4], np.int32)
+        want_a = refgen(la, prompt[None], 4, temperature=0.0)[0].tolist()
+        want_b = refgen(lb, prompt[None], 4, temperature=0.0)[0].tolist()
+
+        toks = fleet.generate("a", prompt, 4, tenant="t", temperature=0.0)
+        assert toks.tolist() == want_a
+        gen_before = fleet.get("a").info()["generation"]
+        toks = fleet.generate("b", prompt, 4, tenant="t", temperature=0.0)
+        assert toks.tolist() == want_b
+        assert not fleet.get("a").resident          # a was paged out
+        toks = fleet.generate("a", prompt, 4, tenant="t", temperature=0.0)
+        assert toks.tolist() == want_a              # paged back in, correct
+        assert fleet.get("a").info()["generation"] > gen_before
+        fleet.shutdown()
+
+    def test_hot_swap_survives_page_cycle(self):
+        """Weights published while resident are what the next residency
+        serves; generations stay monotonic across the page cycle."""
+        ma, mb, donor = (_dense_model(seed=s) for s in (1, 2, 9))
+        wb = _weight_bytes(ma)
+        fleet = FleetRegistry(hbm_budget_bytes=wb + wb // 2)
+        fleet.add("a", ma, engine_opts={"batch_buckets": (1, 2)})
+        fleet.add("b", mb, engine_opts={"batch_buckets": (1, 2)})
+        x = np.random.RandomState(0).rand(1, 4).astype(np.float32)
+        r1 = fleet.predict("a", x, tenant="t")
+        assert r1.generation == 1
+        gen = fleet.publish("a", donor.params, donor.state)   # hot-swap
+        assert gen == 2
+        r2 = fleet.predict("a", x, tenant="t")
+        np.testing.assert_allclose(
+            r2.output, np.asarray(donor.output(x)), rtol=1e-4, atol=1e-5)
+        assert r2.generation == 2
+        fleet.predict("b", x, tenant="t")                     # pages a out
+        r3 = fleet.predict("a", x, tenant="t")                # pages a in
+        np.testing.assert_allclose(
+            r3.output, np.asarray(donor.output(x)), rtol=1e-4, atol=1e-5)
+        assert r3.generation == 3   # start_generation continued the order
+        fleet.shutdown()
+
+    def test_reactivation_zero_recompiles_with_aot_store(self, tmp_path):
+        """With a shared aot_store, paging a model back in loads every
+        executable from disk: the per-model compile-miss counter is flat
+        across the page cycle and the store takes hits."""
+        ma, mb = _dense_model(seed=1), _dense_model(seed=2)
+        wb = _weight_bytes(ma)
+        metrics = MetricsRegistry()
+        store = AotStore(str(tmp_path / "aot"))
+        fleet = FleetRegistry(hbm_budget_bytes=wb + wb // 2, metrics=metrics,
+                              aot_store=store)
+        opts = {"batch_buckets": (1, 2)}
+        fleet.add("a", ma, engine_opts=dict(opts))
+        fleet.add("b", mb, engine_opts=dict(opts))
+        x = np.random.RandomState(0).rand(1, 4).astype(np.float32)
+        ref = np.asarray(ma.output(x))
+
+        def compiles(model):
+            return metrics.counter("serve_compile_misses_total",
+                                   {"component": "engine",
+                                    "model": model}).value
+
+        np.testing.assert_allclose(fleet.predict("a", x, tenant="t").output,
+                                   ref, rtol=1e-4, atol=1e-5)
+        after_first = compiles("a")
+        hits0 = metrics.counter("serve_aot_hits_total",
+                                {"component": "engine"}).value
+        fleet.predict("b", x, tenant="t")           # pages a out
+        assert not fleet.get("a").resident
+        np.testing.assert_allclose(fleet.predict("a", x, tenant="t").output,
+                                   ref, rtol=1e-4, atol=1e-5)
+        assert compiles("a") == after_first, \
+            "re-activation traced instead of loading from the AOT store"
+        hits1 = metrics.counter("serve_aot_hits_total",
+                                {"component": "engine"}).value
+        assert hits1 > hits0, "re-activation took no AOT store hits"
+        fleet.shutdown()
+
+
+class TestFleetHTTP:
+    def _post(self, port, path, body, tenant=None, timeout=30):
+        headers = {"Content-Type": "application/json"}
+        if tenant is not None:
+            headers["X-Tenant"] = tenant
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+            headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def _get(self, port, path):
+        return json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10).read())
+
+    def test_routed_front_door(self):
+        ma, mb = _dense_model(seed=1), _dense_model(seed=2)
+        fleet = FleetRegistry()
+        fleet.tenants.register("free", rate_per_s=1.0, burst=2.0, slo="batch")
+        fleet.add("a", ma, engine_opts={"batch_buckets": (1, 2)})
+        fleet.add("b", mb, engine_opts={"batch_buckets": (1, 2)})
+        srv = FleetServer(fleet, port=0).start()
+        try:
+            x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+            for name, model in (("a", ma), ("b", mb)):
+                out = self._post(srv.port, f"/v1/models/{name}/predict",
+                                 {"ndarray": x.tolist()}, tenant="gold")
+                np.testing.assert_allclose(
+                    np.asarray(out["output"]), np.asarray(model.output(x)),
+                    rtol=1e-4, atol=1e-5)
+                assert out["model"] == name and out["generation"] >= 1
+
+            # unknown model: typed 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(srv.port, "/v1/models/nope/predict",
+                           {"ndarray": x.tolist()})
+            assert ei.value.code == 404
+            assert json.loads(ei.value.read())["cause"] == "unknown_model"
+
+            # X-Tenant rides into quota enforcement: 429 + Retry-After
+            codes = []
+            for _ in range(5):
+                try:
+                    self._post(srv.port, "/v1/models/a/predict",
+                               {"ndarray": x.tolist()}, tenant="free")
+                    codes.append(200)
+                except urllib.error.HTTPError as e:
+                    body = json.loads(e.read())
+                    codes.append((e.code, body["cause"],
+                                  e.headers.get("Retry-After")))
+            assert 200 in codes
+            quota = [c for c in codes if c != 200]
+            assert quota and all(
+                c[0] == 429 and c[1] == "quota" and int(c[2]) >= 1
+                for c in quota), codes
+
+            # fleet status: models + pager + tenants in one view
+            st = self._get(srv.port, "/v1/fleet")
+            assert set(st["models"]) == {"a", "b"}
+            assert st["models"]["a"]["resident"] is True
+            assert st["pager"]["page_ins"] >= 2
+            assert st["tenants"]["free"]["shed"] >= 1
+            assert self._get(srv.port, "/health")["models"] == ["a", "b"]
+            assert self._get(srv.port, "/ready")["status"] == "ready"
+            one = self._get(srv.port, "/v1/models/a")
+            assert one["model"] == "a" and one["resident"] is True
+
+            # quota sheds + model labels land on the shared scrape
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+                ).read().decode()
+            assert 'serve_shed_total{cause="quota"' in scrape
+            assert 'tenant="free"' in scrape
+            assert 'serve_lease_total{model="a",tag="engine_batch"}' in scrape
+        finally:
+            srv.stop()
+
+    def test_generate_routes_and_sse(self):
+        from deeplearning4j_tpu.nn.generation import generate as refgen
+
+        lm = _lm(seed=0)
+        fleet = FleetRegistry()
+        fleet.add("lm", lm, input_dtype=np.int32,
+                  gen_opts={"slots": 2, "capacity": 32})
+        srv = FleetServer(fleet, port=0).start()
+        try:
+            prompt = [1, 2, 3]
+            want = refgen(lm, np.asarray([prompt], np.int32), 3,
+                          temperature=0.0)[0].tolist()
+            out = self._post(srv.port, "/v1/models/lm/generate?stream=false",
+                             {"prompt": prompt, "max_new_tokens": 3,
+                              "temperature": 0.0})
+            assert out["tokens"] == want and out["model"] == "lm"
+
+            # default path streams SSE, token-identical
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/models/lm/generate",
+                data=json.dumps({"prompt": prompt, "max_new_tokens": 3,
+                                 "temperature": 0.0}).encode(),
+                headers={"Content-Type": "application/json"})
+            events = []
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.headers["Content-Type"] == "text/event-stream"
+                for line in r:
+                    if line.startswith(b"data: "):
+                        events.append(json.loads(line[len(b"data: "):]))
+            assert events[-1]["done"] and events[-1]["tokens"] == want
+            assert [e["token"] for e in events[:-1]] == want
+        finally:
+            srv.stop()

@@ -557,12 +557,16 @@ class TestModelServerHTTP:
 
             codes = []
 
+            retry_after = []
+
             def fire(_):
                 try:
                     self._post(srv.port, "/predict",
                                {"ndarray": [[0.0] * 4]}, timeout=30)
                     return 200
                 except urllib.error.HTTPError as e:
+                    if e.code == 503:
+                        retry_after.append(e.headers.get("Retry-After"))
                     return (e.code, json.loads(e.read())["cause"])
 
             with cf.ThreadPoolExecutor(10) as ex:
@@ -570,6 +574,10 @@ class TestModelServerHTTP:
             assert len(codes) == 10  # zero hangs: every request answered
             assert 200 in codes
             assert (503, "queue_full") in codes, codes
+            # every 503 tells well-behaved clients when to come back
+            assert retry_after and all(
+                ra is not None and int(ra) >= 1 for ra in retry_after), \
+                retry_after
         finally:
             srv.stop()
 
