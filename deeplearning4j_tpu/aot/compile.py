@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Any, Callable, Optional, Sequence, Tuple
 
+from ..chaos.retry import RetryPolicy
 from .keys import arch_fingerprint, cache_key, call_signature, \
     runtime_fingerprint
 from .store import AotCorruptEntry, AotStore, AotStoreError, AotVersionError
@@ -87,13 +88,18 @@ class AotFunction:
                  store: Optional[AotStore] = None, metrics=None,
                  arch: str = "", component: str = "serve",
                  donate_argnums: Sequence[int] = (),
-                 compile_counter=None):
+                 compile_counter=None, retry: Optional[RetryPolicy] = None):
         self._fn = fn
         self.tag = tag
         self.store = store if hasattr(fn, "lower") else None
         self.arch = arch
         self.donate = tuple(donate_argnums)
         self._compile_counter = compile_counter
+        # transient store-read failures (NFS hiccup, torn page cache) are
+        # retried before falling back to a live trace; corrupt entries are
+        # quarantined immediately — re-reading garbage can't help
+        self._retry = retry if retry is not None else RetryPolicy(
+            attempts=3, base_s=0.02, cap_s=0.5, metrics=metrics)
         self._runtime = None  # resolved lazily: jax may not be booted yet
         self._exes: dict = {}
         self._lock = threading.RLock()
@@ -182,7 +188,9 @@ class AotFunction:
 
     def _load(self, key: str):
         try:
-            blob = self.store.get(key)
+            blob = self._retry.call(
+                lambda: self.store.get(key), op="aot.store_read",
+                retry_on=(AotStoreError,), give_up=(AotCorruptEntry,))
         except AotCorruptEntry:
             self._m_fallback("corrupt").inc()
             return None

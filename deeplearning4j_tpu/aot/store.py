@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..chaos import faults as _faults
+
 _MAGIC = b"DL4JAOT1"
 _SUFFIX = ".aotx"
 _DIGEST_LEN = 32  # raw sha256
@@ -124,6 +126,11 @@ class AotStore:
         try:
             with open(path, "rb") as f:
                 body = f.read()
+            if _faults.ACTIVE is not None:
+                # inside the try so an injected OSError surfaces exactly as
+                # a real torn read would (typed AotStoreError); corrupt mode
+                # mangles the body and exercises quarantine below
+                body = _faults.ACTIVE.hit("aot.store_read", body)
         except FileNotFoundError:
             return None
         except OSError as e:

@@ -1,0 +1,24 @@
+"""chaos/ — deterministic fault injection and shared resilience primitives.
+
+Stdlib-only base layer (no JAX, no imports from other subsystems): the
+seeded :class:`FaultPlane` with its named injection points, and the
+:class:`RetryPolicy` that serve/, fleet/, and aot/ wrap around their
+fallible I/O. Off by default; see ``chaos/README.md``.
+"""
+
+# NOTE: faults.ACTIVE is deliberately NOT re-exported — a `from` import
+# would freeze the value at import time. Injection sites read it as a
+# module attribute: `from ..chaos import faults` ... `faults.ACTIVE`.
+from .faults import (POINTS, FaultPlane, install, parse_spec, scenario,
+                     uninstall)
+from .retry import RetryPolicy
+
+__all__ = [
+    "POINTS",
+    "FaultPlane",
+    "RetryPolicy",
+    "install",
+    "parse_spec",
+    "scenario",
+    "uninstall",
+]

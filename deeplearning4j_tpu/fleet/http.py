@@ -28,21 +28,26 @@ the ``model=`` label of the serving metrics, not the endpoint label).
 from __future__ import annotations
 
 import json
+import logging
 import re
 import threading
 from typing import Optional
 
 import numpy as np
 
+from ..chaos import faults as _faults
 from ..serve.errors import ServeError
 from ..serve.http import retry_after_s
 from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
 from .registry import FleetRegistry
 from .tenants import QuotaError
 
+log = logging.getLogger(__name__)
+
 _BAD_REQUEST = (KeyError, ValueError, TypeError, AttributeError,
                 json.JSONDecodeError)
 _MODEL_ROUTE = re.compile(r"^/v1/models/([^/]+)(?:/(predict|generate))?$")
+_HTTP_ERRORS_HELP = "non-2xx HTTP answers by endpoint and status code"
 
 
 class FleetServer(JsonHTTPServerMixin):
@@ -57,9 +62,15 @@ class FleetServer(JsonHTTPServerMixin):
         self._lifecycle_lock = threading.Lock()
         self._accepting = True
 
-    def ready(self) -> bool:
+    def accepting(self) -> bool:
         with self._lifecycle_lock:
             return self._accepting
+
+    def ready(self) -> bool:
+        # readiness (load-balancer rotation) flips on ANY degradation —
+        # breaker open, watchdog restart in progress — but a degraded
+        # server still ANSWERS requests: accepting() gates the handlers
+        return self.accepting() and self.fleet.health.ok()
 
     def _metric_route(self, path: str) -> str:
         m = _MODEL_ROUTE.match(path)
@@ -94,16 +105,36 @@ class FleetServer(JsonHTTPServerMixin):
             def _tenant(self) -> str:
                 return self.headers.get("X-Tenant", "anonymous")
 
+            def _err(self, code, body, headers=None):
+                """Non-2xx answer, counted per (endpoint, code) with the
+                model name collapsed out of the endpoint label."""
+                endpoint = server._metric_route(self.path.split("?", 1)[0])
+                server.metrics.counter(
+                    "serve_http_errors_total",
+                    {"endpoint": endpoint, "code": str(code)},
+                    help=_HTTP_ERRORS_HELP).inc()
+                self.reply(code, body, headers=headers)
+
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
                 if path == "/health":
-                    self.reply(200, {"status": "ok",
-                                     "models": server.fleet.names()})
+                    # liveness + worst-case state machine: failed (watchdog
+                    # gave up restarting) answers 503 so an orchestrator
+                    # replaces the process; degraded stays 200 — still alive
+                    snap = server.fleet.health.snapshot()
+                    snap["models"] = server.fleet.names()
+                    code = 200 if snap["status"] != "failed" else 503
+                    if code == 200:
+                        self.reply(code, snap)
+                    else:
+                        self._err(code, snap)
                 elif path == "/ready":
                     if server.ready():
                         self.reply(200, {"status": "ready"})
                     else:
-                        self.reply(503, {"status": "draining"})
+                        self._err(503, {
+                            "status": "not_ready",
+                            "health": server.fleet.health.snapshot()})
                 elif path == "/v1/fleet":
                     self.reply(200, server.fleet.status())
                 elif path == "/v1/models":
@@ -117,21 +148,23 @@ class FleetServer(JsonHTTPServerMixin):
                             self.reply(200, {"model": entry.name,
                                              **entry.info()})
                         except ServeError as e:
-                            self.reply(e.http_status,
-                                       {"error": str(e), "cause": e.cause})
+                            self._err(e.http_status,
+                                      {"error": str(e), "cause": e.cause})
                     else:
-                        self.reply(404, {"error": "unknown endpoint"})
+                        self._err(404, {"error": "unknown endpoint"})
 
             def do_POST(self):
                 path, _, query = self.path.partition("?")
                 m = _MODEL_ROUTE.match(path)
                 name = m.group(1) if m else None
                 try:
-                    if not server.ready():
+                    if _faults.ACTIVE is not None:
+                        _faults.ACTIVE.hit("http.handler")
+                    if not server.accepting():
                         raise ServeError("fleet is draining",
                                          cause="shutting_down")
                     if m is None or m.group(2) is None:
-                        self.reply(404, {"error": "unknown endpoint"})
+                        self._err(404, {"error": "unknown endpoint"})
                         return
                     req = self.read_json()
                     if m.group(2) == "predict":
@@ -139,22 +172,29 @@ class FleetServer(JsonHTTPServerMixin):
                     else:
                         self._generate(name, req, query)
                 except QuotaError as e:
-                    self.reply(e.http_status,
-                               {"error": str(e), "cause": e.cause,
-                                "tenant": self._tenant()},
-                               headers={"Retry-After":
-                                        max(1, int(e.retry_after_s + 0.999))})
+                    self._err(e.http_status,
+                              {"error": str(e), "cause": e.cause,
+                               "tenant": self._tenant()},
+                              headers={"Retry-After":
+                                       max(1, int(e.retry_after_s + 0.999))})
                 except ServeError as e:
                     headers = None
                     if e.http_status == 503:
-                        headers = {"Retry-After": server._retry_after(name)}
-                    self.reply(e.http_status,
-                               {"error": str(e), "cause": e.cause},
-                               headers=headers)
+                        # breaker/page-in errors know their own back-off;
+                        # queue sheds fall back to the depth-derived estimate
+                        retry = getattr(e, "retry_after_s", None)
+                        headers = {"Retry-After":
+                                   max(1, int(retry + 0.999))
+                                   if retry is not None
+                                   else server._retry_after(name)}
+                    self._err(e.http_status,
+                              {"error": str(e), "cause": e.cause},
+                              headers=headers)
                 except _BAD_REQUEST as e:
-                    self.reply(400, {"error": str(e)})
+                    self._err(400, {"error": str(e)})
                 except Exception as e:  # front door answers every request  # jaxlint: disable=broad-except
-                    self.reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    log.exception("unhandled error serving %s", self.path)
+                    self._err(500, {"error": f"{type(e).__name__}: {e}"})
 
             def _predict(self, name, req):
                 res = server.fleet.predict(

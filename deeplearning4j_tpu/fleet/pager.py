@@ -39,18 +39,35 @@ import time
 from collections import OrderedDict
 from typing import List, Optional
 
-from ..serve.errors import CapacityError
+from ..chaos import faults as _faults
+from ..chaos.retry import RetryPolicy
+from ..serve.errors import CapacityError, ServeError
+
+
+class PageInError(ServeError):
+    """Paging a model's weights onto the device failed even after bounded
+    retries. The model is not resident; the reservation was rolled back, so
+    a later request will retry the transfer from scratch (HTTP 503)."""
+
+    cause = "page_in_failed"
+    http_status = 503
 
 
 class WeightPager:
     """LRU resident-set manager over duck-typed fleet entries."""
 
-    def __init__(self, budget_bytes: Optional[int] = None, metrics=None):
+    def __init__(self, budget_bytes: Optional[int] = None, metrics=None,
+                 retry: Optional[RetryPolicy] = None):
         if budget_bytes is not None and budget_bytes <= 0:
             raise ValueError("budget_bytes must be positive (or None for "
                              "unbounded)")
         self.budget_bytes = int(budget_bytes) if budget_bytes else None
         self._metrics = metrics
+        # host->HBM transfers are retried with backoff: a transient DMA /
+        # allocator hiccup shouldn't shed the request when the next attempt
+        # would land (injectable for tests; chaos smoke relies on this)
+        self._retry = retry if retry is not None else RetryPolicy(
+            attempts=3, base_s=0.05, cap_s=1.0, metrics=metrics)
         self._cond = threading.Condition()
         self._resident: "OrderedDict[str, object]" = OrderedDict()
         self._used = 0
@@ -143,7 +160,20 @@ class WeightPager:
                 self._page_outs += 1
                 self._count("fleet_page_out_total", v.name,
                             "model weight page-outs (HBM -> host)")
-            entry.activate()
+            def _transfer():
+                if _faults.ACTIVE is not None:
+                    _faults.ACTIVE.hit("fleet.page_in_transfer")
+                entry.activate()
+
+            try:
+                self._retry.call(_transfer, op="fleet.page_in_transfer",
+                                 give_up=(CapacityError,))
+            except CapacityError:
+                raise
+            except Exception as e:  # jaxlint: disable=broad-except
+                raise PageInError(
+                    f"paging {entry.name!r} in failed after retries: "
+                    f"{e}") from e
             ok = True
             self._page_ins += 1
             self._count("fleet_page_in_total", entry.name,

@@ -34,11 +34,21 @@ import numpy as np
 from ..serve.continuous import ContinuousBatcher
 from ..serve.engine import ServeEngine
 from ..serve.errors import ServeError, ServerClosingError
+from ..serve.health import Health
 from ..serve.registry import ModelRegistry
+from ..serve.watchdog import Watchdog
+from .breaker import CircuitBreaker
 from .pager import WeightPager
 from .tenants import TenantTable
 
 _EVICTION_RETRIES = 4
+
+# ServeError causes that count against a model's circuit breaker: server-side
+# breakage only. Quota/capacity/queue-full sheds and client deadlines are
+# load signals, not path failures — tripping a breaker on them would turn an
+# overload into an outage.
+_BREAKER_CAUSES = frozenset({"internal", "page_in_failed", "worker_stall",
+                             "worker_dead", "drain_timeout"})
 
 
 class UnknownModelError(ServeError):
@@ -207,6 +217,17 @@ class FleetEntry:
             self._next_generation = gen + 1
             return gen
 
+    def components(self) -> list:
+        """Watchdog view: ``(name, worker-owning component)`` pairs for the
+        currently-resident serving stack (empty when paged out)."""
+        with self._lock:
+            if self._engine is None:
+                return []
+            comps = [(f"{self.name}.engine", self._engine)]
+            if self._batcher is not None:
+                comps.append((f"{self.name}.batcher", self._batcher))
+            return comps
+
     def info(self) -> dict:
         with self._lock:
             resident = self._engine is not None
@@ -231,7 +252,10 @@ class FleetRegistry:
 
     def __init__(self, *, hbm_budget_bytes: Optional[int] = None,
                  metrics=None, aot_store=None,
-                 tenants: Optional[TenantTable] = None):
+                 tenants: Optional[TenantTable] = None,
+                 breaker_failures: Optional[int] = 5,
+                 breaker_reset_s: float = 10.0, breaker_clock=None,
+                 watchdog_s: Optional[float] = None):
         from ..obs.metrics import MetricsRegistry
 
         self.metrics = metrics if metrics is not None else MetricsRegistry()
@@ -242,6 +266,29 @@ class FleetRegistry:
         self._lock = threading.Lock()
         self._entries: Dict[str, FleetEntry] = {}
         self._closing = False
+        self.health = Health(metrics=self.metrics, component="fleet")
+        # per-model circuit breakers; breaker_failures=None disables them
+        self._breaker_failures = breaker_failures
+        self._breaker_reset_s = float(breaker_reset_s)
+        self._breaker_clock = breaker_clock
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._watchdog: Optional[Watchdog] = None
+        if watchdog_s is not None:
+            self._watchdog = Watchdog(
+                self._watch_components, deadline_s=watchdog_s,
+                metrics=self.metrics, health=self.health).start()
+
+    def _watch_components(self) -> list:
+        with self._lock:
+            entries = list(self._entries.values())
+        comps: list = []
+        for entry in entries:
+            comps.extend(entry.components())
+        return comps
+
+    def _breaker(self, name: str) -> Optional[CircuitBreaker]:
+        with self._lock:
+            return self._breakers.get(name)
 
     # ------------------------------------------------------------ membership
     def add(self, name: str, model, params=None, state=None, *,
@@ -264,6 +311,14 @@ class FleetRegistry:
                 raise ValueError(f"model {name!r} already registered — "
                                  f"publish() hot-swaps weights in place")
             self._entries[name] = entry
+            if self._breaker_failures is not None:
+                kwargs = {}
+                if self._breaker_clock is not None:
+                    kwargs["clock"] = self._breaker_clock
+                self._breakers[name] = CircuitBreaker(
+                    failure_threshold=self._breaker_failures,
+                    reset_s=self._breaker_reset_s, metrics=self.metrics,
+                    model=name, health=self.health, **kwargs)
         if eager:
             self.pager.ensure(entry)
         return entry
@@ -271,8 +326,11 @@ class FleetRegistry:
     def remove(self, name: str) -> None:
         with self._lock:
             entry = self._entries.pop(name, None)
+            self._breakers.pop(name, None)
         if entry is None:
             raise UnknownModelError(f"no model named {name!r}")
+        # a removed model's open breaker must not keep readiness off
+        self.health.clear(f"breaker_open:{name}")
         self.pager.drop(entry)
 
     def get(self, name: str) -> FleetEntry:
@@ -298,47 +356,89 @@ class FleetRegistry:
         slo = self.tenants.admit(tenant, model=name)
         return timeout_ms if timeout_ms is not None else slo.deadline_ms
 
+    @staticmethod
+    def _breaker_counts(exc: BaseException) -> bool:
+        """Does this failure count against the model's breaker? Server-side
+        breakage only — see ``_BREAKER_CAUSES``."""
+        if isinstance(exc, ServeError):
+            return exc.cause in _BREAKER_CAUSES
+        return True
+
+    def _observed(self, br: Optional[CircuitBreaker], fn):
+        """Run one gated serving attempt, feeding its outcome back into the
+        model's breaker. ``br.allow()`` already passed for this request."""
+        if br is None:
+            return fn()
+        try:
+            out = fn()
+        except BaseException as e:
+            if self._breaker_counts(e):
+                br.record_failure()
+            else:
+                br.record_ignored()
+            raise
+        br.record_success()
+        return out
+
     def predict(self, name: str, x, *, tenant: str = "anonymous",
                 timeout_ms: Optional[float] = None) -> FleetResult:
-        """Tenant admission -> page-in -> engine predict. ``timeout_ms``
-        defaults to the tenant's SLO deadline."""
-        timeout_ms = self._admit(tenant, name, timeout_ms)
+        """Breaker gate -> tenant admission -> page-in -> engine predict.
+        ``timeout_ms`` defaults to the tenant's SLO deadline."""
         entry = self.get(name)
-        x = np.asarray(x, entry.input_dtype)
-        last: Optional[ServeError] = None
-        for _ in range(_EVICTION_RETRIES):
-            self.pager.ensure(entry)
-            try:
-                eng = entry.engine()
-                if x.ndim > len(entry.model.input_shape) \
-                        and x.shape[0] <= eng.batch_buckets[-1]:
-                    handle = eng.submit(x, timeout_ms=timeout_ms)
-                    return FleetResult(handle.wait(), handle.generation)
-                return FleetResult(
-                    eng.predict(x, timeout_ms=timeout_ms), None)
-            except ServerClosingError as e:
-                last = e  # lost the race with an eviction: page back in
-        raise last
+        br = self._breaker(name)
+        if br is not None:
+            br.allow()  # open breaker refuses before quota/paging work
+
+        def _serve() -> FleetResult:
+            nonlocal timeout_ms
+            timeout_ms = self._admit(tenant, name, timeout_ms)
+            x_ = np.asarray(x, entry.input_dtype)
+            last: Optional[ServeError] = None
+            for _ in range(_EVICTION_RETRIES):
+                self.pager.ensure(entry)
+                try:
+                    eng = entry.engine()
+                    if x_.ndim > len(entry.model.input_shape) \
+                            and x_.shape[0] <= eng.batch_buckets[-1]:
+                        handle = eng.submit(x_, timeout_ms=timeout_ms)
+                        return FleetResult(handle.wait(), handle.generation)
+                    return FleetResult(
+                        eng.predict(x_, timeout_ms=timeout_ms), None)
+                except ServerClosingError as e:
+                    last = e  # lost the race with an eviction: page back in
+            raise last
+
+        return self._observed(br, _serve)
 
     def submit_generate(self, name: str, prompt, max_new_tokens: int, *,
                         tenant: str = "anonymous", temperature: float = 1.0,
                         top_k: Optional[int] = None,
                         eos_id: Optional[int] = None,
                         timeout_ms: Optional[float] = None):
-        """Admit one generation; returns the batcher's streamable handle."""
-        timeout_ms = self._admit(tenant, name, timeout_ms)
+        """Admit one generation; returns the batcher's streamable handle.
+        The breaker observes the *submission* path (paging + admission into
+        the batcher) — a handle that later times out does not count."""
         entry = self.get(name)
-        prompt = np.asarray(prompt, np.int32)
-        last: Optional[ServeError] = None
-        for _ in range(_EVICTION_RETRIES):
-            self.pager.ensure(entry)
-            try:
-                return entry.batcher().submit(
-                    prompt, max_new_tokens, temperature=temperature,
-                    top_k=top_k, eos_id=eos_id, timeout_ms=timeout_ms)
-            except ServerClosingError as e:
-                last = e
-        raise last
+        br = self._breaker(name)
+        if br is not None:
+            br.allow()
+
+        def _serve():
+            nonlocal timeout_ms
+            timeout_ms = self._admit(tenant, name, timeout_ms)
+            prompt_ = np.asarray(prompt, np.int32)
+            last: Optional[ServeError] = None
+            for _ in range(_EVICTION_RETRIES):
+                self.pager.ensure(entry)
+                try:
+                    return entry.batcher().submit(
+                        prompt_, max_new_tokens, temperature=temperature,
+                        top_k=top_k, eos_id=eos_id, timeout_ms=timeout_ms)
+                except ServerClosingError as e:
+                    last = e
+            raise last
+
+        return self._observed(br, _serve)
 
     def generate(self, name: str, prompt, max_new_tokens: int, *,
                  tenant: str = "anonymous", temperature: float = 1.0,
@@ -373,10 +473,13 @@ class FleetRegistry:
     def status(self) -> dict:
         with self._lock:
             entries = dict(self._entries)
+            breakers = dict(self._breakers)
         body: Dict[str, Any] = {
             "models": {n: e.info() for n, e in sorted(entries.items())},
             "pager": self.pager.stats(),
             "tenants": self.tenants.stats(),
+            "health": self.health.snapshot(),
+            "breakers": {n: b.snapshot() for n, b in sorted(breakers.items())},
         }
         if self.aot_store is not None:
             body["aot_store"] = self.aot_store.stats()
@@ -384,6 +487,10 @@ class FleetRegistry:
 
     def shutdown(self) -> None:
         """Drain and deactivate every resident model."""
+        if self._watchdog is not None:
+            # stop the watchdog FIRST: a drain must not be mistaken for a
+            # stall and "restarted" mid-teardown
+            self._watchdog.stop()
         with self._lock:
             self._closing = True
             entries = list(self._entries.values())

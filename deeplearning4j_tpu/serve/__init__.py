@@ -10,6 +10,9 @@ Layering:
   generation over ``nn/generation`` KV caches
 - :mod:`~.http` — predict/generate/health/ready/metrics front door
 - :mod:`~.errors` — the typed failure surface
+- :mod:`~.health` / :mod:`~.watchdog` — ok/degraded/failed state machine
+  and crash-only worker restart on missed heartbeats (exercised by the
+  ``chaos/`` fault plane)
 
 Every tier accepts ``aot_store=`` (an :class:`~..aot.AotStore`) to load
 its executables from disk before tracing — instant cold starts and
@@ -21,13 +24,18 @@ compatibility shims over these.
 
 from .continuous import ContinuousBatcher
 from .engine import PrefillScheduler, ServeEngine
-from .errors import (CapacityError, DeadlineExceededError, PublishError,
-                     ServeError, ServerClosingError, ShedError)
+from .errors import (CapacityError, DeadlineExceededError, DrainTimeoutError,
+                     PublishError, ServeError, ServerClosingError, ShedError,
+                     WorkerStallError)
+from .health import Health
 from .http import ModelServer
 from .paged import BlockAllocator, SlotPages
 from .registry import ModelRegistry, ModelSnapshot
+from .watchdog import Watchdog
 
 __all__ = ["BlockAllocator", "CapacityError", "ContinuousBatcher",
-           "DeadlineExceededError", "ModelRegistry", "ModelServer",
-           "ModelSnapshot", "PrefillScheduler", "PublishError", "ServeEngine",
-           "ServeError", "ServerClosingError", "ShedError", "SlotPages"]
+           "DeadlineExceededError", "DrainTimeoutError", "Health",
+           "ModelRegistry", "ModelServer", "ModelSnapshot",
+           "PrefillScheduler", "PublishError", "ServeEngine", "ServeError",
+           "ServerClosingError", "ShedError", "SlotPages", "Watchdog",
+           "WorkerStallError"]

@@ -56,9 +56,11 @@ from typing import Any, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..chaos import faults as _faults
 from .engine import PrefillScheduler
-from .errors import (CapacityError, DeadlineExceededError, ServeError,
-                     ServerClosingError, ShedError)
+from .errors import (CapacityError, DeadlineExceededError, DrainTimeoutError,
+                     ServeError, ServerClosingError, ShedError,
+                     WorkerStallError)
 from .paged import BlockAllocator, SlotPages, block_bytes, blocks_needed
 from .registry import ModelRegistry
 
@@ -104,6 +106,10 @@ class _GenRequest:
             self._cv.notify_all()
 
     def _finish(self, error: Optional[ServeError] = None) -> None:
+        if self.event.is_set():
+            # idempotent: a request shed by a crash-only restart (or forced
+            # shutdown) must not be re-finished by a waking stale worker
+            return
         if error is not None:
             self.error = error
         else:
@@ -397,7 +403,12 @@ class ContinuousBatcher:
         self._jobs: List[_PrefillJob] = []
         self._slot_req: List[Optional[_GenRequest]] = [None] * S
         self._slot_job: List[Optional[_PrefillJob]] = [None] * S
+        self._admitting: List[_GenRequest] = []  # dense: popped, not slotted
         self._closing = False
+        # crash-only worker lifecycle (see ServeEngine): epoch stales a hung
+        # worker, restart sheds its in-flight sequences with typed errors
+        self._epoch = 0
+        self._hb = time.monotonic()
         self._admitted = 0
         self._peak_active = 0
         self._prefill_sigs = set()
@@ -490,8 +501,13 @@ class ContinuousBatcher:
             # the full decode/prefill/sample executable set
             self.registry.add_warmer(self._warm_for)
 
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="serve-continuous-batcher")
+        self._spawn_worker()
+
+    def _spawn_worker(self) -> None:
+        self._hb = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, args=(self._epoch,), daemon=True,
+            name=f"serve-continuous-batcher-{self._epoch}")
         self._thread.start()
 
     # ---------------------------------------------------------------- warming
@@ -585,6 +601,15 @@ class ContinuousBatcher:
                 self._shed_counter("shutting_down").inc()
                 raise ServerClosingError("batcher is draining; not accepting "
                                          "new requests")
+            if not self._thread.is_alive():
+                # fail fast: a dead decode loop means this request would
+                # queue forever — answer typed NOW; a watchdog (if running)
+                # will restart the worker for later traffic
+                self._shed_counter("worker_dead").inc()
+                raise ServerClosingError(
+                    "batcher worker thread is dead; request refused "
+                    "(run a Watchdog for automatic crash-only restart)",
+                    cause="worker_dead")
             if len(self._queue) >= self.queue_limit:
                 self._shed_counter("queue_full").inc()
                 raise ShedError(f"generation queue full "
@@ -847,11 +872,18 @@ class ContinuousBatcher:
             self._m_active.set(sum(1 for r in self._slot_req if r is not None))
         req._finish()
 
-    def _tick(self, snap) -> None:
+    def _tick(self, snap, epoch: int) -> None:
         """Decode one token for every slot; bookkeep the active ones."""
         import jax.numpy as jnp
 
+        # chaos seam, deliberately BEFORE any device dispatch or pool
+        # mutation: an injected error/hang here simulates a wedged or dying
+        # decode step without ever corrupting donated buffers
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.hit("serve.decode_step")
         with self._cond:
+            if self._epoch != epoch:
+                return  # staled by a crash-only restart; the new worker owns the slots
             active = [s for s in range(self.slots)
                       if self._slot_req[s] is not None]
             if not active:
@@ -894,6 +926,8 @@ class ContinuousBatcher:
         self._m_tokens.inc(len(active))
         pushes = []
         with self._cond:
+            if self._epoch != epoch:
+                return  # restart raced the device call; drop the bookkeeping
             sig = ("decode", self.slots)
             if sig not in self._decode_sigs:
                 self._decode_sigs.add(sig)
@@ -913,9 +947,32 @@ class ContinuousBatcher:
         for s in active:
             self._maybe_finish(s)
 
-    def _loop(self) -> None:
+    def _loop(self, epoch: int) -> None:
+        try:
+            self._run_loop(epoch)
+        except BaseException:
+            # the decode loop is dying (injected fault, bug): a silent
+            # death would hang every queued and in-flight caller — shed
+            # everything with a typed error before the thread exits.
+            # submit() fails fast afterwards; a watchdog restarts us.
+            finish: List[_GenRequest] = []
+            with self._cond:
+                if self._epoch == epoch and not self._closing:
+                    finish = self._shed_inflight_locked(include_queue=True)
+            if finish:
+                err = WorkerStallError(
+                    "batcher worker died; generation shed, safe to retry")
+                for req in finish:
+                    self._shed_counter("worker_stall").inc()
+                    req._finish(err)
+            raise
+
+    def _run_loop(self, epoch: int) -> None:
         while True:
             with self._cond:
+                if self._epoch != epoch:
+                    return  # staled by a crash-only restart
+                self._hb = time.monotonic()
                 has_active = any(r is not None for r in self._slot_req)
                 has_jobs = bool(self._jobs)
                 if self._closing and not self._queue and not has_active \
@@ -925,6 +982,9 @@ class ContinuousBatcher:
                     self._cond.wait(0.05)
                     continue
                 admits = self._admit_locked()
+                # dense admits are popped from the queue but not yet in a
+                # slot: track them so a restart can still answer them
+                self._admitting = [r for _, r in admits]
                 self._m_qdepth.set(len(self._queue))
                 jobs = list(self._jobs)
                 decoding = any(r is not None for r in self._slot_req)
@@ -947,10 +1007,12 @@ class ContinuousBatcher:
                         self._abort_job(job,
                                         ServeError(f"{type(e).__name__}: {e}"))
                 with self.registry.lease(tag="gen_decode") as snap:
-                    self._tick(snap)
+                    self._tick(snap, epoch)
             else:
                 with self.registry.lease(tag="gen_decode") as snap:
                     for s, req in admits:
+                        if req.event.is_set():
+                            continue  # already shed by a racing restart
                         if req.deadline is not None and now > req.deadline:
                             req._finish(DeadlineExceededError(
                                 "deadline exceeded waiting for a decode slot"))
@@ -961,7 +1023,75 @@ class ContinuousBatcher:
                             req._finish(e)
                         except Exception as e:  # slot loop must outlive any bad request  # jaxlint: disable=broad-except
                             req._finish(ServeError(f"{type(e).__name__}: {e}"))
-                    self._tick(snap)
+                    with self._cond:
+                        self._admitting = []
+                    self._tick(snap, epoch)
+
+    # ------------------------------------------------- watchdog + crash-only
+    def heartbeat(self) -> float:
+        """Monotonic timestamp of the decode loop's last liveness beat."""
+        return self._hb
+
+    def worker_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def _shed_inflight_locked(self, include_queue: bool
+                              ) -> List[_GenRequest]:
+        """Under ``self._cond``: strip every in-flight sequence (slots,
+        prefill jobs, dense mid-admission — plus the queue when asked) out
+        of the batcher state, releasing KV pages, and return the orphaned
+        requests for the caller to finish OUTSIDE the lock."""
+        finish: List[_GenRequest] = list(self._admitting)
+        self._admitting = []
+        if include_queue:
+            finish.extend(self._queue)
+            self._queue.clear()
+        for job in list(self._jobs):
+            job.pages.release()
+            self._slot_job[job.slot] = None
+            self._committed -= job.worst
+            finish.append(job.req)
+        self._jobs.clear()
+        for s, req in enumerate(self._slot_req):
+            if req is not None:
+                finish.append(req)
+                self._slot_req[s] = None
+            if self.kv == "paged" and self._slot_pages[s] is not None:
+                self._slot_pages[s].release()
+                self._slot_pages[s] = None
+                self._committed -= int(self._slot_worst[s])
+                self._slot_worst[s] = 0
+        if self.kv == "paged":
+            self._tables_np[:] = 0
+            self._update_kv_gauges()
+            self._m_pf_depth.set(0)
+        self._m_qdepth.set(len(self._queue))
+        self._m_active.set(0)
+        return finish
+
+    def restart_worker(self, reason: str = "watchdog") -> bool:
+        """Crash-only decode-loop restart: stale the current worker by
+        epoch, shed its in-flight sequences (slots + prefill jobs) with
+        typed :class:`~.errors.WorkerStallError`, reclaim its registry
+        leases, and spawn a fresh worker. Queued (not yet admitted)
+        requests survive and are served by the new worker. Returns False
+        if the batcher is shutting down."""
+        with self._cond:
+            if self._closing:
+                return False
+            old = self._thread
+            self._epoch += 1
+            finish = self._shed_inflight_locked(include_queue=False)
+            self._spawn_worker()
+            self._cond.notify_all()
+        err = WorkerStallError(
+            f"in-flight generation abandoned by batcher restart ({reason}); "
+            f"safe to retry")
+        for req in finish:
+            self._shed_counter("worker_stall").inc()
+            req._finish(err)
+        self.registry.release_thread(old.ident if old is not None else None)
+        return True
 
     # -------------------------------------------------------------- lifecycle
     @property
@@ -987,41 +1117,37 @@ class ContinuousBatcher:
                     "live_bytes": used * self._block_bytes}
 
     def shutdown(self, drain: bool = True,
-                 timeout: Optional[float] = None) -> None:
+                 timeout: Optional[float] = None) -> bool:
         """``drain=True`` finishes every queued and in-flight generation
-        first; ``drain=False`` errors them out immediately."""
+        first; ``drain=False`` errors them out immediately.
+
+        Returns True on a clean worker exit. If the worker is still alive
+        when ``timeout`` expires (a hung in-flight request), it is
+        abandoned crash-only style: all remaining work is answered with a
+        typed :class:`~.errors.DrainTimeoutError`, its registry leases are
+        reclaimed, and False is returned — shutdown never hangs."""
         finish = []
         with self._cond:
             self._closing = True
             if not drain:
-                err = ServerClosingError("batcher shut down before dispatch")
-                for req in self._queue:
-                    finish.append(req)
-                self._queue.clear()
-                for job in list(self._jobs):
-                    job.pages.release()
-                    self._slot_job[job.slot] = None
-                    self._committed -= job.worst
-                    finish.append(job.req)
-                self._jobs.clear()
-                for s, req in enumerate(self._slot_req):
-                    if req is not None:
-                        finish.append(req)
-                        self._slot_req[s] = None
-                    if self.kv == "paged" and self._slot_pages[s] is not None:
-                        self._slot_pages[s].release()
-                        self._slot_pages[s] = None
-                        self._committed -= int(self._slot_worst[s])
-                        self._slot_worst[s] = 0
-                if self.kv == "paged":
-                    self._tables_np[:] = 0
-                    self._update_kv_gauges()
-                    self._m_pf_depth.set(0)
-                self._m_qdepth.set(0)
-                self._m_active.set(0)
-                err_out = err
+                finish = self._shed_inflight_locked(include_queue=True)
             self._cond.notify_all()
         if finish:
+            err = ServerClosingError("batcher shut down before dispatch")
             for req in finish:
-                req._finish(err_out)
+                req._finish(err)
         self._thread.join(timeout)
+        if not self._thread.is_alive():
+            return True
+        with self._cond:
+            self._epoch += 1  # stale the wedged worker
+            finish = self._shed_inflight_locked(include_queue=True)
+            self._cond.notify_all()
+        err = DrainTimeoutError(
+            f"shutdown drain timed out after {timeout}s with generation "
+            f"in flight")
+        for req in finish:
+            self._shed_counter("drain_timeout").inc()
+            req._finish(err)
+        self.registry.release_thread(self._thread.ident)
+        return False

@@ -42,8 +42,10 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .errors import (CapacityError, DeadlineExceededError, ServeError,
-                     ServerClosingError, ShedError)
+from ..chaos import faults as _faults
+from .errors import (CapacityError, DeadlineExceededError, DrainTimeoutError,
+                     ServeError, ServerClosingError, ShedError,
+                     WorkerStallError)
 from .registry import ModelRegistry
 
 # batch-occupancy is a ratio in (0, 1]; latency-style buckets would waste
@@ -190,6 +192,13 @@ class ServeEngine:
         self._closing = False
         self._sigs = set()          # (bucket, shape_key) ever compiled
         self._batch_count = 0
+        # crash-only worker lifecycle: the dispatcher runs under an epoch;
+        # restart_worker() bumps it, sheds the abandoned in-flight batch with
+        # typed errors, and spawns a fresh thread — a stale thread notices
+        # its epoch and exits without touching shared state
+        self._epoch = 0
+        self._hb = time.monotonic()
+        self._inflight: List[_Request] = []
 
         m = self.metrics
         self._m_depth = m.gauge("serve_queue_depth", self._lbl(),
@@ -230,8 +239,13 @@ class ServeEngine:
                 # against every signature this engine has ever served
                 self.registry.add_warmer(self._warm_candidate)
 
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="serve-engine-dispatch")
+        self._spawn_worker()
+
+    def _spawn_worker(self) -> None:
+        self._hb = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, args=(self._epoch,), daemon=True,
+            name=f"serve-engine-dispatch-{self._epoch}")
         self._thread.start()
 
     # ------------------------------------------------------------------ admit
@@ -287,6 +301,15 @@ class ServeEngine:
                 self._shed_counter("shutting_down").inc()
                 raise ServerClosingError("server is draining; not accepting "
                                          "new requests")
+            if not self._thread.is_alive():
+                # fail fast: a dead dispatcher means this request would
+                # queue forever — answer typed NOW; a watchdog (if running)
+                # will restart the worker for later traffic
+                self._shed_counter("worker_dead").inc()
+                raise ServerClosingError(
+                    "dispatch worker thread is dead; request refused "
+                    "(run a Watchdog for automatic crash-only restart)",
+                    cause="worker_dead")
             if self.admission == "block":
                 self._cond.wait_for(
                     lambda: self._closing
@@ -322,16 +345,24 @@ class ServeEngine:
         return np.concatenate([r.wait() for r in reqs])
 
     # --------------------------------------------------------------- dispatch
-    def _next_batch(self) -> Optional[List[_Request]]:
+    def _next_batch(self, epoch: int) -> Optional[List[_Request]]:
         """Pop a coalescible set of pending requests (same shape key, rows
         within the largest bucket), waiting up to ``max_wait_ms`` to fill.
-        Returns None exactly once: closing and nothing left to drain."""
+        Returns None exactly once per worker: closing (nothing left to
+        drain) or this worker's epoch was staled by a crash-only restart.
+        Popped requests are tracked in ``_inflight`` incrementally so a
+        restart racing this pop can still answer every one of them."""
         with self._cond:
             while not self._pending:
-                if self._closing:
+                if self._closing or self._epoch != epoch:
                     return None
+                self._hb = time.monotonic()
                 self._cond.wait(0.05)
+            if self._epoch != epoch:
+                return None
+            self._hb = time.monotonic()
             first = self._pending.pop(0)
+            self._inflight.append(first)
             batch, rows = [first], first.rows
             cap = self.batch_buckets[-1]
             t_end = time.perf_counter() + self.max_wait_ms / 1e3
@@ -340,6 +371,7 @@ class ServeEngine:
                 for i, r in enumerate(self._pending):
                     if r.shape_key == first.shape_key and rows + r.rows <= cap:
                         self._pending.pop(i)
+                        self._inflight.append(r)
                         batch.append(r)
                         rows += r.rows
                         took = True
@@ -354,71 +386,162 @@ class ServeEngine:
             self._depth_rows -= rows
             self._m_depth.set(self._depth_rows)
             self._cond.notify_all()  # wake admission="block" submitters
+            if self._epoch != epoch:
+                # a restart raced the pop; it already answered these
+                return None
         return batch
 
-    def _run_batch(self, batch: List[_Request]) -> None:
-        now = time.perf_counter()
-        live: List[_Request] = []
-        for r in batch:
-            if r.deadline is not None and now > r.deadline:
-                r.error = DeadlineExceededError(
-                    f"deadline exceeded after "
-                    f"{(now - r.enq_t) * 1e3:.1f}ms in queue")
-                self._m_deadline.inc()
+    def _run_batch(self, batch: List[_Request], epoch: int) -> None:
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.hit("serve.dispatch")
+        try:
+            self._hb = time.monotonic()
+            now = time.perf_counter()
+            live: List[_Request] = []
+            for r in batch:
+                if r.event.is_set():    # already answered (restart raced)
+                    continue
+                if r.deadline is not None and now > r.deadline:
+                    r.error = DeadlineExceededError(
+                        f"deadline exceeded after "
+                        f"{(now - r.enq_t) * 1e3:.1f}ms in queue")
+                    self._m_deadline.inc()
+                    r.event.set()
+                else:
+                    live.append(r)
+            if not live:
+                return
+            rows = sum(r.rows for r in live)
+            bucket = next((b for b in self.batch_buckets if b >= rows),
+                          self.batch_buckets[-1])
+            x = np.concatenate([r.x for r in live])
+            if x.shape[0] < bucket:  # ALWAYS pad to the bucket — drain path too
+                pad = np.zeros((bucket - x.shape[0],) + x.shape[1:], x.dtype)
+                x = np.concatenate([x, pad])
+            sig = (bucket,) + live[0].shape_key
+            with self._cond:
+                if self._epoch != epoch:
+                    return  # staled mid-flight; restart answered the batch
+                if sig not in self._sigs:
+                    self._sigs.add(sig)
+                    # with an AOT store, a new signature may load from disk —
+                    # AotFunction counts the misses that really trace
+                    if self._aot is None:
+                        self._m_compiles.inc()
+                self._batch_count += 1
+                seq = self._batch_count
+            with self.registry.lease(tag="engine_batch") as snap:  # ONE generation per batch
+                t0 = time.perf_counter()
+                try:
+                    y = np.asarray(self._fwd(snap.params, snap.state, x))
+                except Exception as e:  # the dispatcher must outlive any bad batch  # jaxlint: disable=broad-except
+                    err = ServeError(f"{type(e).__name__}: {e}",
+                                     cause="internal")
+                    for r in live:
+                        if not r.event.is_set():
+                            r.error = err
+                            r.event.set()
+                    return
+                self._m_device_s.observe(time.perf_counter() - t0)
+            self._m_batches.inc()
+            self._m_occupancy.observe(rows / bucket)
+            off = 0
+            for r in live:
+                out = y[off:off + r.rows]
+                off += r.rows
+                if r.event.is_set():  # answered by a restart while we ran
+                    continue
+                if (r.true_len is not None and r.padded_len is not None
+                        and out.ndim >= 2 and out.shape[1] == r.padded_len):
+                    out = out[:, :r.true_len]  # un-pad outputs that kept time
+                r.result = out
+                r.generation = snap.generation
+                r.batch_seq = seq
+                self._m_queue_s.observe(t0 - r.enq_t)
                 r.event.set()
-            else:
-                live.append(r)
-        if not live:
-            return
-        rows = sum(r.rows for r in live)
-        bucket = next((b for b in self.batch_buckets if b >= rows),
-                      self.batch_buckets[-1])
-        x = np.concatenate([r.x for r in live])
-        if x.shape[0] < bucket:  # ALWAYS pad to the bucket — drain path too
-            pad = np.zeros((bucket - x.shape[0],) + x.shape[1:], x.dtype)
-            x = np.concatenate([x, pad])
-        sig = (bucket,) + live[0].shape_key
-        with self._cond:
-            if sig not in self._sigs:
-                self._sigs.add(sig)
-                # with an AOT store, a new signature may load from disk —
-                # AotFunction counts the misses that really trace
-                if self._aot is None:
-                    self._m_compiles.inc()
-            self._batch_count += 1
-            seq = self._batch_count
-        with self.registry.lease(tag="engine_batch") as snap:  # ONE generation per batch
-            t0 = time.perf_counter()
-            try:
-                y = np.asarray(self._fwd(snap.params, snap.state, x))
-            except Exception as e:  # the dispatcher must outlive any bad batch  # jaxlint: disable=broad-except
-                err = ServeError(f"{type(e).__name__}: {e}", cause="internal")
-                for r in live:
+        finally:
+            # retire the batch from in-flight tracking; anything still
+            # unanswered here was abandoned by an exception escaping the
+            # dispatch path (e.g. an injected fault) — answer it typed
+            # before the exception kills this worker, so no caller hangs
+            unanswered: List[_Request] = []
+            with self._cond:
+                for r in batch:
+                    try:
+                        self._inflight.remove(r)
+                    except ValueError:
+                        pass
+                    if not r.event.is_set():
+                        unanswered.append(r)
+            if unanswered:
+                err = WorkerStallError(
+                    "dispatch worker crashed before answering; request "
+                    "shed, safe to retry")
+                for r in unanswered:
+                    self._shed_counter("worker_stall").inc()
                     r.error = err
                     r.event.set()
-                return
-            self._m_device_s.observe(time.perf_counter() - t0)
-        self._m_batches.inc()
-        self._m_occupancy.observe(rows / bucket)
-        off = 0
-        for r in live:
-            out = y[off:off + r.rows]
-            off += r.rows
-            if (r.true_len is not None and r.padded_len is not None
-                    and out.ndim >= 2 and out.shape[1] == r.padded_len):
-                out = out[:, :r.true_len]  # un-pad outputs that kept time
-            r.result = out
-            r.generation = snap.generation
-            r.batch_seq = seq
-            self._m_queue_s.observe(t0 - r.enq_t)
-            r.event.set()
 
-    def _loop(self) -> None:
-        while True:
-            batch = self._next_batch()
-            if batch is None:
+    def _loop(self, epoch: int) -> None:
+        try:
+            while True:
+                batch = self._next_batch(epoch)
+                if batch is None:
+                    return
+                self._run_batch(batch, epoch)
+        except BaseException:
+            # backstop: a dying worker answers whatever it still owned
+            self._shed_inflight(epoch, WorkerStallError(
+                "dispatch worker died; request shed, safe to retry"))
+            raise
+
+    def _shed_inflight(self, epoch: Optional[int], err: ServeError) -> None:
+        with self._cond:
+            if epoch is not None and self._epoch != epoch:
                 return
-            self._run_batch(batch)
+            stalled, self._inflight = self._inflight, []
+        for r in stalled:
+            if not r.event.is_set():
+                self._shed_counter(err.cause).inc()
+                r.error = err
+                r.event.set()
+
+    # ------------------------------------------------- watchdog + crash-only
+    def heartbeat(self) -> float:
+        """Monotonic timestamp of the dispatcher's last liveness beat."""
+        return self._hb
+
+    def worker_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def restart_worker(self, reason: str = "watchdog") -> bool:
+        """Crash-only dispatcher restart: stale the current worker by epoch,
+        answer its abandoned in-flight batch with typed
+        :class:`~.errors.WorkerStallError`, reclaim its registry leases, and
+        spawn a fresh worker against the unchanged lease/queue state.
+        Pending (not yet popped) requests survive and are served by the new
+        worker. Returns False if the engine is shutting down."""
+        with self._cond:
+            if self._closing:
+                return False
+            old = self._thread
+            self._epoch += 1
+            stalled, self._inflight = self._inflight, []
+            self._spawn_worker()
+            self._cond.notify_all()
+        err = WorkerStallError(
+            f"in-flight batch abandoned by dispatcher restart ({reason}); "
+            f"safe to retry")
+        for r in stalled:
+            if not r.event.is_set():
+                self._shed_counter("worker_stall").inc()
+                r.error = err
+                r.event.set()
+        # a hung thread can never run its lease finally; reclaim so
+        # hot-swap drain cannot deadlock (reclaim is idempotent if the
+        # thread eventually wakes, notices its stale epoch, and exits)
+        self.registry.release_thread(old.ident if old is not None else None)
+        return True
 
     # ---------------------------------------------------------------- warming
     def _example_shapes(self) -> List[tuple]:
@@ -472,12 +595,19 @@ class ServeEngine:
             return set(self._sigs)
 
     def shutdown(self, drain: bool = True,
-                 timeout: Optional[float] = None) -> None:
+                 timeout: Optional[float] = None) -> bool:
         """Stop the engine. ``drain=True`` (default) completes everything
         already admitted — through the same padded-bucket path as steady
         state — before the dispatcher exits; new admissions shed with
         ``cause="shutting_down"`` meanwhile. ``drain=False`` errors pending
-        requests out immediately."""
+        requests out immediately.
+
+        Returns True on a clean worker exit. If the worker is still alive
+        when ``timeout`` expires (a wedged device call), it is abandoned
+        crash-only style: all remaining work is answered with typed
+        :class:`~.errors.DrainTimeoutError`, its registry leases are
+        reclaimed, and False is returned — a hung request can stall its
+        batch, never the shutdown (or the test suite)."""
         with self._cond:
             self._closing = True
             if not drain:
@@ -490,3 +620,21 @@ class ServeEngine:
                 self._m_depth.set(0)
             self._cond.notify_all()
         self._thread.join(timeout)
+        if not self._thread.is_alive():
+            return True
+        with self._cond:
+            self._epoch += 1  # stale the wedged worker
+            stalled = self._inflight + self._pending
+            self._inflight, self._pending = [], []
+            self._depth_rows = 0
+            self._m_depth.set(0)
+            self._cond.notify_all()
+        err = DrainTimeoutError(
+            f"shutdown drain timed out after {timeout}s with work in flight")
+        for r in stalled:
+            if not r.event.is_set():
+                self._shed_counter("drain_timeout").inc()
+                r.error = err
+                r.event.set()
+        self.registry.release_thread(self._thread.ident)
+        return False

@@ -41,6 +41,27 @@ class ServerClosingError(ShedError):
     cause = "shutting_down"
 
 
+class WorkerStallError(ServeError):
+    """In-flight work shed because the worker thread that owned it died or
+    stalled past its heartbeat deadline. The watchdog (or the dying worker
+    itself) answers every orphaned request with this instead of leaving
+    its caller to hang; a crash-only restart takes over, so the request is
+    safely retryable (HTTP 503)."""
+
+    cause = "worker_stall"
+    http_status = 503
+
+
+class DrainTimeoutError(ServeError):
+    """``shutdown(drain=True)`` hit its timeout with work still in flight
+    (e.g. a wedged device call). The work is abandoned and answered with
+    this typed error rather than hanging the shutdown — retry against
+    another replica (HTTP 503)."""
+
+    cause = "drain_timeout"
+    http_status = 503
+
+
 class DeadlineExceededError(ServeError):
     """The request's deadline passed before device work could start."""
 

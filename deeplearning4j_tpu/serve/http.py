@@ -30,18 +30,26 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from typing import Optional, Sequence
 from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
+from ..chaos import faults as _faults
 from ..obs.metrics import MetricsRegistry
 from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
 from .continuous import ContinuousBatcher
 from .engine import ServeEngine
 from .errors import ServeError
+from .health import Health
 from .registry import ModelRegistry
+from .watchdog import Watchdog
+
+log = logging.getLogger(__name__)
+
+_HTTP_ERRORS_HELP = "non-2xx HTTP answers by endpoint and status code"
 
 _BAD_REQUEST = (KeyError, ValueError, TypeError, AttributeError,
                 json.JSONDecodeError)
@@ -78,7 +86,7 @@ class ModelServer(JsonHTTPServerMixin):
                  gen_kv_blocks: Optional[int] = None,
                  gen_prefill_chunk: Optional[int] = 64,
                  seed: int = 0, metrics: Optional[MetricsRegistry] = None,
-                 aot_store=None):
+                 aot_store=None, watchdog_s: Optional[float] = None):
         self.model = model
         self.host = host
         self.port = port
@@ -114,6 +122,24 @@ class ModelServer(JsonHTTPServerMixin):
         self._batcher: Optional[ContinuousBatcher] = None
         self._lifecycle_lock = threading.Lock()
         self._accepting = True
+        # health state machine replaces the old boolean /health; components
+        # (watchdog, breakers) degrade/clear causes as they heal
+        self.health = Health(metrics=self.metrics, component="serve")
+        # opt-in (watchdog_s=None keeps the historical threading behavior):
+        # a heartbeat deadline must be chosen against the deployment's
+        # worst legitimate device-batch time
+        self._watchdog: Optional[Watchdog] = None
+        if watchdog_s is not None:
+            self._watchdog = Watchdog(
+                self._watch_components, deadline_s=watchdog_s,
+                metrics=self.metrics, health=self.health).start()
+
+    def _watch_components(self):
+        out = [("engine", self.engine)]
+        with self._lifecycle_lock:
+            if self._batcher is not None:
+                out.append(("batcher", self._batcher))
+        return out
 
     # --- lazy generation stack ---
     def batcher(self) -> ContinuousBatcher:
@@ -138,7 +164,10 @@ class ModelServer(JsonHTTPServerMixin):
 
     def ready(self) -> bool:
         with self._lifecycle_lock:
-            return self._accepting
+            accepting = self._accepting
+        # readiness flips off while a worker restart is in progress or a
+        # breaker is open — the balancer routes around us while we heal
+        return accepting and self.health.ok()
 
     def _retry_after(self) -> int:
         """Retry-After seconds for shed answers, scaled by how backed up
@@ -158,17 +187,32 @@ class ModelServer(JsonHTTPServerMixin):
         class Handler(JsonRequestHandler):
             owner = server
 
+            def _err(self, code, body, headers=None):
+                server.metrics.counter(
+                    "serve_http_errors_total",
+                    {"endpoint": urlsplit(self.path).path, "code": str(code)},
+                    help=_HTTP_ERRORS_HELP).inc()
+                self.reply(code, body, headers=headers)
+
             def do_GET(self):
                 if self.path == "/health":
-                    self.reply(200, {"status": "ok",
-                                     "model": type(server.model).__name__,
-                                     "generation":
-                                         server.registry.generation})
+                    # liveness: 200 while ok OR degraded (self-healing in
+                    # progress); 503 only when failed — the signal for an
+                    # orchestrator to replace the process
+                    snap = server.health.snapshot()
+                    snap["model"] = type(server.model).__name__
+                    snap["generation"] = server.registry.generation
+                    if snap["status"] != "failed":
+                        self.reply(200, snap)
+                    else:
+                        self._err(503, snap)
                 elif self.path == "/ready":
                     if server.ready():
                         self.reply(200, {"status": "ready"})
                     else:
-                        self.reply(503, {"status": "draining"})
+                        snap = server.health.snapshot()
+                        self._err(503, {"status": "not_ready",
+                                        "health": snap})
                 elif self.path == "/models":
                     cur = server.registry.current()
                     body = {
@@ -179,29 +223,38 @@ class ModelServer(JsonHTTPServerMixin):
                         body["aot_store"] = server.aot_store.stats()
                     self.reply(200, body)
                 else:
-                    self.reply(404, {"error": "unknown endpoint"})
+                    self._err(404, {"error": "unknown endpoint"})
 
             def do_POST(self):
                 split = urlsplit(self.path)
                 try:
+                    if _faults.ACTIVE is not None:
+                        _faults.ACTIVE.hit("http.handler")
                     req = self.read_json()
                     if split.path == "/predict":
                         self._predict(req)
                     elif split.path == "/generate":
                         self._generate(req, parse_qs(split.query))
                     else:
-                        self.reply(404, {"error": "unknown endpoint"})
+                        self._err(404, {"error": "unknown endpoint"})
                 except ServeError as e:
                     headers = None
                     if e.http_status == 503:
-                        headers = {"Retry-After": server._retry_after()}
-                    self.reply(e.http_status,
-                               {"error": str(e), "cause": e.cause},
-                               headers=headers)
+                        retry = getattr(e, "retry_after_s", None)
+                        headers = {"Retry-After":
+                                   int(retry + 0.999) if retry is not None
+                                   else server._retry_after()}
+                    self._err(e.http_status,
+                              {"error": str(e), "cause": e.cause},
+                              headers=headers)
                 except _BAD_REQUEST as e:
-                    self.reply(400, {"error": str(e)})
+                    self._err(400, {"error": str(e)})
                 except Exception as e:  # server must answer every request  # jaxlint: disable=broad-except
-                    self.reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    # unexpected == a bug: keep the full traceback (the
+                    # client only sees the summary) and make 5xx bursts
+                    # visible on /metrics
+                    log.exception("unhandled error serving %s", self.path)
+                    self._err(500, {"error": f"{type(e).__name__}: {e}"})
 
             def _predict(self, req):
                 x = np.asarray(req["ndarray"], server.input_dtype)
@@ -271,6 +324,8 @@ class ModelServer(JsonHTTPServerMixin):
         with self._lifecycle_lock:
             self._accepting = False
             batcher = self._batcher
+        if self._watchdog is not None:
+            self._watchdog.stop()
         self.engine.shutdown(drain=drain)
         if batcher is not None:
             batcher.shutdown(drain=drain)

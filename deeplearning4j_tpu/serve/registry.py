@@ -77,6 +77,10 @@ class ModelRegistry:
         self._cond = threading.Condition()
         self._publish_lock = threading.Lock()  # serializes publish/rollback
         self._inflight: Dict[int, int] = {}
+        # thread ident -> lease tokens it holds; lets release_thread()
+        # reclaim leases pinned by a hung/dead worker so hot-swap drain
+        # cannot deadlock on a thread that will never run its finally
+        self._thread_leases: Dict[int, List[dict]] = {}
         self._history: List[ModelSnapshot] = []
         self._warmers: List[Callable[[Any, Any], None]] = []
         self._metrics = metrics
@@ -114,10 +118,13 @@ class ModelRegistry:
         decode tick (``gen_decode``) and per prefill *chunk*
         (``gen_prefill``) — so a drain during a long chunked prefill waits
         only for the current chunk, not the whole prompt."""
+        ident = threading.get_ident()
         with self._cond:
             snap = self._history[-1]
             self._inflight[snap.generation] = \
                 self._inflight.get(snap.generation, 0) + 1
+            token = {"gen": snap.generation, "released": False}
+            self._thread_leases.setdefault(ident, []).append(token)
         if tag is not None and self._metrics is not None:
             self._metrics.counter("serve_lease_total",
                                   self._labels({"tag": tag}),
@@ -127,12 +134,53 @@ class ModelRegistry:
             yield snap
         finally:
             with self._cond:
-                n = self._inflight.get(snap.generation, 0) - 1
-                if n <= 0:
-                    self._inflight.pop(snap.generation, None)
-                else:
-                    self._inflight[snap.generation] = n
-                self._cond.notify_all()
+                self._release_token_locked(ident, token)
+
+    def _release_token_locked(self, ident: int, token: dict) -> None:
+        # idempotent: a lease reclaimed by release_thread() must not be
+        # double-decremented when the stalled thread eventually wakes and
+        # runs its own finally
+        if token["released"]:
+            return
+        token["released"] = True
+        toks = self._thread_leases.get(ident)
+        if toks is not None:
+            try:
+                toks.remove(token)
+            except ValueError:
+                pass
+            if not toks:
+                self._thread_leases.pop(ident, None)
+        gen = token["gen"]
+        n = self._inflight.get(gen, 0) - 1
+        if n <= 0:
+            self._inflight.pop(gen, None)
+        else:
+            self._inflight[gen] = n
+        self._cond.notify_all()
+
+    def release_thread(self, ident: Optional[int]) -> int:
+        """Reclaim every lease held by an abandoned worker thread.
+
+        A hung/dead dispatcher can never run its lease ``finally``; until
+        its leases are returned, :meth:`drain` (and therefore hot-swap
+        publish) would wait forever. The watchdog's crash-only restart and
+        forced shutdown call this with the old thread's ident AFTER the
+        thread has been staled, so the registry's lease state is correct
+        for the replacement worker. Returns the number reclaimed."""
+        if ident is None:
+            return 0
+        released = 0
+        with self._cond:
+            for token in list(self._thread_leases.get(ident, ())):
+                self._release_token_locked(ident, token)
+                released += 1
+        if released and self._metrics is not None:
+            self._metrics.counter(
+                "serve_lease_reclaimed_total", self._labels(),
+                help="leases reclaimed from dead/hung worker threads"
+                ).inc(released)
+        return released
 
     def inflight(self) -> Dict[int, int]:
         """Outstanding lease counts by generation (diagnostic)."""

@@ -37,12 +37,20 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/aot/
 # so it holds the same zero-suppression bar as serve/.
 echo "=== jaxlint: deeplearning4j_tpu/fleet/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/fleet/
+# chaos/ is the fault plane the hardening tests stand on: a lint-dirty
+# injector (unlocked spec state, swallowed errors) would make every chaos
+# result untrustworthy, so it holds the same zero-suppression bar.
+echo "=== jaxlint: deeplearning4j_tpu/chaos/ (no baseline permitted) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/chaos/
 
 echo "=== smoke trace: 5-step instrumented train ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_trace.py
 
 echo "=== smoke serve: mixed predict/generate traffic over HTTP ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_serve.py
+
+echo "=== smoke chaos: seeded fault scenario, self-healing fleet ==="
+CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_chaos.py
 
 echo "=== tier-1 tests ==="
 set -o pipefail

@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""CI smoke chaos: boot a fleet server, run a seeded fault scenario against
+it, and assert it SELF-HEALS — the ISSUE-8 acceptance surface.
+
+The scenario (deterministic, seeded, armed via the chaos/ CLI spec
+strings):
+
+- **A. transient AOT corruption** — one store read is corrupted on a
+  model's re-activation: the entry quarantines, the executable falls back
+  to a live trace, the request still answers correctly.
+- **B. transient page-in failure** — one weight transfer raises ``OSError``:
+  the pager's bounded retry recovers, ``fleet_retry_total{outcome=
+  "recovered"}`` counts it, tokens match the fault-free reference.
+- **C. hung decode tick** — one decode step hangs for 8 s under a 0.75 s
+  watchdog deadline: the in-flight generation is shed with a **typed** 503
+  (``worker_stall``, never a hang), the watchdog crash-only-restarts the
+  batcher, readiness returns, and the retried generation matches the
+  reference exactly.
+- **D. deterministic page-in failure** — every transfer for one model
+  fails until its circuit breaker opens (2 consecutive): requests shed
+  instantly with 503 ``breaker_open`` + ``Retry-After`` and NO new
+  transfer attempts; after ``reset_s`` the half-open probe succeeds and
+  the breaker closes.
+
+Final assertions: health is ``ok``, readiness is back, every error along
+the way was typed (no bare 500s), the watchdog/retry/breaker counters all
+moved, and no worker thread is left hanging. Artifact:
+$CI_ARTIFACTS_DIR/smoke_chaos_metrics.prom (the final /metrics scrape).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+WATCHDOG_S = 0.75
+BREAKER_FAILURES = 2
+BREAKER_RESET_S = 1.0
+X = [[0.1, -0.2, 0.3, -0.4]]
+PROMPT = [3, 1, 4, 1, 5]
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.status, r.read()
+
+
+def _typed_503(port, path, body):
+    """POST expecting a typed 503; returns (cause, retry_after_header)."""
+    try:
+        _post(port, path, body)
+    except urllib.error.HTTPError as e:
+        assert e.code == 503, f"expected 503 from {path}, got {e.code}"
+        payload = json.loads(e.read())
+        assert "cause" in payload, f"untyped 503 from {path}: {payload}"
+        return payload["cause"], e.headers.get("Retry-After")
+    raise AssertionError(f"{path} unexpectedly succeeded")
+
+
+def _wait_ready(port, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            status, _ = _get(port, "/ready")
+            if status == 200:
+                return
+        except urllib.error.HTTPError:
+            pass
+        time.sleep(0.1)
+    raise AssertionError(f"server not ready within {timeout_s}s")
+
+
+def _metric(scrape: str, name: str, **labels) -> float:
+    total = 0.0
+    found = False
+    for line in scrape.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest and rest[0] not in "{ ":
+            continue  # a longer metric name sharing this prefix
+        if not all(f'{k}="{v}"' in rest for k, v in labels.items()):
+            continue
+        total += float(line.rsplit(" ", 1)[1])
+        found = True
+    assert found, f"metric {name}{labels or ''} missing from scrape"
+    return total
+
+
+def main():
+    artifacts = os.environ.get("CI_ARTIFACTS_DIR", "ci-artifacts")
+    os.makedirs(artifacts, exist_ok=True)
+
+    from deeplearning4j_tpu.aot import AotStore
+    from deeplearning4j_tpu.chaos import FaultPlane, install, uninstall
+    from deeplearning4j_tpu.fleet import FleetRegistry, FleetServer
+    from deeplearning4j_tpu.models import CausalLM
+    from deeplearning4j_tpu.nn.layers import Dense, Output
+    from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+
+    dense = Sequential(NetConfig(seed=0),
+                       [Dense(n_out=6, activation="tanh"),
+                        Output(n_out=3, loss="mcxent", activation="softmax")],
+                       (4,))
+    dense.init()
+    lm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=32,
+                  num_heads=4, vocab=50).build()
+    lm.init()
+
+    store_dir = tempfile.mkdtemp(prefix="smoke_chaos_aot_")
+    fleet = FleetRegistry(aot_store=AotStore(store_dir),
+                          breaker_failures=BREAKER_FAILURES,
+                          breaker_reset_s=BREAKER_RESET_S,
+                          watchdog_s=WATCHDOG_S)
+    # a budget only one model fits under, so every phase exercises a real
+    # page cycle (drain the victim, transfer the incoming weights)
+    d = fleet.add("d", dense)
+    g = fleet.add("g", lm, gen_opts={"slots": 2, "capacity": 24, "seed": 0})
+    fleet.pager.budget_bytes = (max(d.weight_bytes, g.weight_bytes)
+                                + min(d.weight_bytes, g.weight_bytes) // 2)
+    assert d.weight_bytes + g.weight_bytes > fleet.pager.budget_bytes
+
+    srv = FleetServer(fleet, port=0).start()
+    port = srv.port
+    fp = install(FaultPlane(seed=0, metrics=fleet.metrics))
+    try:
+        gen_body = {"prompt": PROMPT, "max_new_tokens": 6,
+                    "temperature": 0.0, "stream": False}
+
+        # ---- fault-free reference pass (also populates the AOT store)
+        ref_pred = _post(port, "/v1/models/d/predict", {"ndarray": X})
+        ref_toks = _post(port, "/v1/models/g/generate?stream=false",
+                         gen_body)["tokens"]
+        _wait_ready(port)
+
+        # ---- A: one corrupted AOT store read during d's re-activation
+        print("=== phase A: transient AOT store corruption ===")
+        fp.inject_spec("aot.store_read:corrupt:times=1")
+        out = _post(port, "/v1/models/d/predict", {"ndarray": X})
+        assert np.allclose(out["output"], ref_pred["output"]), \
+            "corrupted store read changed a prediction"
+        assert fp.injected().get(("aot.store_read", "corrupt")) == 1
+
+        # ---- B: one torn page-in transfer; bounded retry recovers
+        print("=== phase B: transient page-in failure (retry recovers) ===")
+        fp.inject_spec("fleet.page_in_transfer:error:type=os,times=1")
+        toks = _post(port, "/v1/models/g/generate?stream=false",
+                     gen_body)["tokens"]
+        assert toks == ref_toks, "retried page-in changed generation output"
+        assert fp.injected().get(("fleet.page_in_transfer", "error")) == 1
+
+        # ---- C: hung decode tick; watchdog sheds typed + restarts
+        print("=== phase C: hung decode tick (watchdog restart) ===")
+        fp.inject_spec("serve.decode_step:hang:hang_s=8,times=1")
+        t0 = time.monotonic()
+        cause, _ = _typed_503(port, "/v1/models/g/generate?stream=false",
+                              gen_body)
+        assert cause == "worker_stall", f"expected worker_stall, got {cause}"
+        assert time.monotonic() - t0 < 6.0, "stall shed was not prompt"
+        _wait_ready(port)  # watchdog restarted the batcher, health cleared
+        toks = _post(port, "/v1/models/g/generate?stream=false",
+                     gen_body)["tokens"]
+        assert toks == ref_toks, "post-restart generation diverged"
+
+        # ---- D: deterministic page-in failure opens d's breaker
+        print("=== phase D: circuit breaker open -> probe -> closed ===")
+        fp.inject_spec(
+            f"fleet.page_in_transfer:error:type=os,times={3 * 2}")
+        for _ in range(BREAKER_FAILURES):
+            cause, _ = _typed_503(port, "/v1/models/d/predict",
+                                  {"ndarray": X})
+            assert cause == "page_in_failed", cause
+        transfers = fp.hits("fleet.page_in_transfer")
+        cause, retry_after = _typed_503(port, "/v1/models/d/predict",
+                                        {"ndarray": X})
+        assert cause == "breaker_open", cause
+        assert retry_after is not None and int(retry_after) >= 1
+        assert fp.hits("fleet.page_in_transfer") == transfers, \
+            "open breaker still attempted a page-in"
+        status, _ = _get(port, "/health")
+        assert status == 200, "degraded must stay live (not failed)"
+        time.sleep(BREAKER_RESET_S + 0.3)
+        out = _post(port, "/v1/models/d/predict", {"ndarray": X})  # probe
+        assert np.allclose(out["output"], ref_pred["output"])
+        assert fleet.status()["breakers"]["d"]["state"] == "closed"
+
+        # ---- final: healthy, ready, every counter moved
+        _wait_ready(port)
+        status, body = _get(port, "/health")
+        health = json.loads(body)
+        assert status == 200 and health["status"] == "ok", health
+        scrape = _get(port, "/metrics")[1].decode()
+        with open(os.path.join(artifacts, "smoke_chaos_metrics.prom"),
+                  "w") as f:
+            f.write(scrape)
+        assert _metric(scrape, "chaos_faults_injected_total") >= 5
+        assert _metric(scrape, "serve_watchdog_stalls_total") >= 1
+        assert _metric(scrape, "serve_watchdog_restarts_total") >= 1
+        assert _metric(scrape, "fleet_retry_total", outcome="recovered") >= 1
+        assert _metric(scrape, "fleet_breaker_transitions_total",
+                       to="open") >= 1
+        assert _metric(scrape, "fleet_breaker_transitions_total",
+                       to="closed") >= 1
+        assert _metric(scrape, "serve_http_errors_total", code="503") >= 4
+        assert _metric(scrape, "serve_aot_fallback_total") >= 1
+        assert _metric(scrape, "serve_health_state", component="fleet") == 0
+        print("final fault-plane stats:", json.dumps(fp.stats()["injected"]))
+    finally:
+        uninstall()  # release any parked hang before joining workers
+        srv.stop()
+
+    # no worker left wedged: everything the scenario stalled was either
+    # restarted (and drained by stop()) or released by uninstall()
+    import threading
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        hung = [t for t in threading.enumerate()
+                if t.name.startswith(("serve-", "fleet-")) and t.is_alive()]
+        if not hung:
+            break
+        time.sleep(0.1)
+    assert not hung, f"worker threads left hanging: {[t.name for t in hung]}"
+    print("smoke chaos OK: injected faults recovered, health ok, "
+          "no hung workers")
+
+
+if __name__ == "__main__":
+    main()

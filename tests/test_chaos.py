@@ -1,0 +1,779 @@
+"""Tests for chaos/ fault injection and the self-healing serving paths
+(ISSUE 8).
+
+The load-bearing properties, each tested directly:
+
+- fault plane: deterministic firing order (``after``/``times``/``prob``
+  under a fixed seed), exactly-one-mode validation, spec-string parsing,
+  corrupt flips exactly one byte, hangs are bounded AND released early by
+  ``uninstall()`` so no test can wedge the suite;
+- zero overhead when disabled: with no plane installed, serving a real
+  predict/generate and reading the AOT store makes **zero** fault-plane
+  calls (spy-asserted by booby-trapping ``FaultPlane.hit``);
+- bounded retry: transient failures recover, exhaustion re-raises the
+  last error, ``give_up`` exceptions pass straight through, outcomes
+  land on ``fleet_retry_total{op,outcome}``, full-jitter backoff stays
+  inside ``[0, min(cap, base * 2^i)]``;
+- circuit breaker: closed -> open on N consecutive failures, open sheds
+  instantly with ``Retry-After``, half-open admits exactly one probe,
+  probe success closes / probe failure re-opens — all on a simulated
+  clock; client-side sheds never trip it;
+- watchdog: a dead or heartbeat-silent worker is detected, counted,
+  crash-only restarted; restarts that stop converging mark health
+  ``failed``; recovery clears the cause;
+- engine/batcher self-healing: an injected worker death sheds in-flight
+  work with typed ``WorkerStallError`` (no hung callers), submissions
+  after death fail fast with ``ServerClosingError(worker_dead)``, and a
+  restart serves correct answers against unchanged registry state;
+- drain timeout: ``shutdown(drain=True, timeout=...)`` over an injected
+  hang answers in-flight work with typed ``DrainTimeoutError`` and
+  returns — the suite never hangs;
+- pager + AOT store: page-in transfers and store reads retry transient
+  faults and degrade typed (``PageInError`` / quarantine + fallback);
+- fleet breaker integration: repeated page-in failures open the model's
+  breaker (503 + ``Retry-After``, no more transfer attempts), a probe
+  after ``reset_s`` closes it, and health/readiness track the cycle.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.chaos import (FaultPlane, RetryPolicy, install,
+                                      parse_spec, scenario, uninstall)
+from deeplearning4j_tpu.chaos import faults as faults_mod
+from deeplearning4j_tpu.fleet import (CircuitBreaker, CircuitOpenError,
+                                      FleetRegistry, PageInError, WeightPager)
+from deeplearning4j_tpu.nn.layers import Dense, Output
+from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.serve import (ServeEngine, ServerClosingError,
+                                      Watchdog, WorkerStallError)
+from deeplearning4j_tpu.serve.errors import DrainTimeoutError
+from deeplearning4j_tpu.serve.health import Health
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plane():
+    """A failing test must never leave a fault plane installed (or a hang
+    armed) for the rest of the suite."""
+    yield
+    uninstall()
+
+
+def _dense_model(n_in=4, n_out=3, seed=0):
+    m = Sequential(NetConfig(seed=seed),
+                   [Dense(n_out=6, activation="tanh"),
+                    Output(n_out=n_out, loss="mcxent", activation="softmax")],
+                   (n_in,))
+    m.init()
+    return m
+
+
+def _counter_value(metrics, name, labels=None):
+    return metrics.counter(name, labels).value
+
+
+# --------------------------------------------------------------------------
+class TestFaultPlane:
+    def test_exactly_one_mode(self):
+        fp = FaultPlane()
+        with pytest.raises(ValueError):
+            fp.inject("serve.dispatch")
+        with pytest.raises(ValueError):
+            fp.inject("serve.dispatch", error=OSError, corrupt=True)
+        with pytest.raises(ValueError):
+            fp.inject("serve.dispatch", error=OSError, times=0)
+
+    def test_after_times_ordering(self):
+        fp = FaultPlane()
+        fp.inject("p", error=ValueError, after=2, times=2)
+        fp.hit("p")
+        fp.hit("p")           # first two hits skipped
+        with pytest.raises(ValueError):
+            fp.hit("p")
+        with pytest.raises(ValueError):
+            fp.hit("p")
+        fp.hit("p")           # times exhausted: clean again
+        assert fp.hits("p") == 5
+        assert fp.injected() == {("p", "error"): 2}
+
+    def test_unbounded_times(self):
+        fp = FaultPlane()
+        fp.inject("p", error=OSError, times=-1)
+        for _ in range(5):
+            with pytest.raises(OSError):
+                fp.hit("p")
+
+    def test_error_instance_passthrough(self):
+        fp = FaultPlane()
+        boom = ConnectionError("custom payload")
+        fp.inject("p", error=boom)
+        with pytest.raises(ConnectionError, match="custom payload"):
+            fp.hit("p")
+
+    def test_corrupt_flips_exactly_one_byte(self):
+        fp = FaultPlane(seed=7)
+        fp.inject("p", corrupt=True)
+        data = bytes(range(64))
+        out = fp.hit("p", data)
+        assert len(out) == len(data)
+        assert sum(a != b for a, b in zip(out, data)) == 1
+        # same seed -> same byte
+        fp2 = FaultPlane(seed=7)
+        fp2.inject("p", corrupt=True)
+        assert fp2.hit("p", data) == out
+
+    def test_prob_is_seeded_deterministic(self):
+        def fires(seed):
+            fp = FaultPlane(seed=seed)
+            fp.inject("p", error=ValueError, times=-1, prob=0.5)
+            out = []
+            for _ in range(32):
+                try:
+                    fp.hit("p")
+                    out.append(0)
+                except ValueError:
+                    out.append(1)
+            return out
+
+        a, b = fires(3), fires(3)
+        assert a == b
+        assert 0 < sum(a) < 32
+
+    def test_hang_released_by_uninstall(self):
+        fp = install(FaultPlane())
+        fp.inject("p", hang_s=60.0)
+        done = threading.Event()
+
+        def parked():
+            fp.hit("p")
+            done.set()
+
+        t = threading.Thread(target=parked, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not done.is_set()
+        uninstall()  # must release the park, not wait 60s
+        assert done.wait(2.0)
+
+    def test_scenario_context_uninstalls(self):
+        with scenario(FaultPlane()) as fp:
+            assert faults_mod.ACTIVE is fp
+        assert faults_mod.ACTIVE is None
+
+    def test_metrics_counted(self):
+        m = MetricsRegistry()
+        fp = FaultPlane(metrics=m)
+        fp.inject("p", delay_s=0.0)
+        fp.hit("p")
+        assert _counter_value(m, "chaos_faults_injected_total",
+                              {"point": "p", "mode": "delay"}) == 1
+
+
+class TestParseSpec:
+    def test_roundtrip(self):
+        point, kw = parse_spec("fleet.page_in_transfer:error:type=os,times=2")
+        assert point == "fleet.page_in_transfer"
+        assert kw["error"] is OSError and kw["times"] == 2
+        point, kw = parse_spec("aot.store_read:corrupt:times=1")
+        assert kw["corrupt"] is True
+        point, kw = parse_spec("serve.decode_step:hang:hang_s=5,after=1")
+        assert kw["hang_s"] == 5.0 and kw["after"] == 1
+        point, kw = parse_spec("http.handler:delay:delay_s=0.01")
+        assert kw["delay_s"] == 0.01
+
+    def test_rejects_garbage(self):
+        for bad in ("nocolon", "p:unknownmode", "p:error:type=nope",
+                    "p:error:bogus=1"):
+            with pytest.raises(ValueError):
+                parse_spec(bad)
+
+    def test_inject_spec_fires(self):
+        fp = FaultPlane()
+        fp.inject_spec("p:error:type=timeout")
+        with pytest.raises(TimeoutError):
+            fp.hit("p")
+
+
+# --------------------------------------------------------------------------
+class TestZeroOverheadWhenDisabled:
+    def test_no_fault_plane_calls_on_hot_path(self, monkeypatch, tmp_path):
+        """With no plane installed the injection sites must not even call
+        into the fault plane — booby-trap every entry point."""
+        from deeplearning4j_tpu.aot import AotStore
+
+        def boom(*a, **k):
+            raise AssertionError("fault plane touched while disabled")
+
+        monkeypatch.setattr(faults_mod.FaultPlane, "hit", boom)
+        assert faults_mod.ACTIVE is None
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1, 2), max_wait_ms=1.0)
+        try:
+            y = eng.predict(np.zeros((4,), np.float32))
+            assert np.asarray(y).shape[-1] == 3
+        finally:
+            eng.shutdown(drain=True)
+        store = AotStore(str(tmp_path))
+        store.put("ab" * 32, b"payload")
+        assert store.get("ab" * 32) == b"payload"
+
+
+# --------------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_recovers_and_counts(self):
+        m = MetricsRegistry()
+        pol = RetryPolicy(attempts=3, base_s=0.0, cap_s=0.0, metrics=m,
+                          sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert pol.call(flaky, op="x") == "ok"
+        assert calls["n"] == 3
+        assert _counter_value(m, "fleet_retry_total",
+                              {"op": "x", "outcome": "retry"}) == 2
+        assert _counter_value(m, "fleet_retry_total",
+                              {"op": "x", "outcome": "recovered"}) == 1
+
+    def test_exhaustion_reraises_last(self):
+        m = MetricsRegistry()
+        pol = RetryPolicy(attempts=2, base_s=0.0, cap_s=0.0, metrics=m,
+                          sleep=lambda s: None)
+        with pytest.raises(OSError, match="always"):
+            pol.call(lambda: (_ for _ in ()).throw(OSError("always")), op="x")
+        assert _counter_value(m, "fleet_retry_total",
+                              {"op": "x", "outcome": "exhausted"}) == 1
+
+    def test_give_up_wins_over_retry_on(self):
+        pol = RetryPolicy(attempts=5, base_s=0.0, cap_s=0.0,
+                          sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def fatal():
+            calls["n"] += 1
+            raise KeyError("do not retry me")
+
+        with pytest.raises(KeyError):
+            pol.call(fatal, op="x", retry_on=(Exception,), give_up=(KeyError,))
+        assert calls["n"] == 1
+
+    def test_non_matching_exception_not_retried(self):
+        pol = RetryPolicy(attempts=5, base_s=0.0, cap_s=0.0,
+                          sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def wrong_type():
+            calls["n"] += 1
+            raise ValueError("not retryable")
+
+        with pytest.raises(ValueError):
+            pol.call(wrong_type, op="x", retry_on=(OSError,))
+        assert calls["n"] == 1
+
+    def test_full_jitter_bounds(self):
+        import random
+
+        pol = RetryPolicy(attempts=8, base_s=0.1, cap_s=0.4,
+                          rng=random.Random(0))
+        for i in range(8):
+            b = pol.backoff_s(i)
+            assert 0.0 <= b <= min(0.4, 0.1 * 2 ** i)
+
+    def test_sleeps_between_attempts_only(self):
+        slept = []
+        pol = RetryPolicy(attempts=3, base_s=0.0, cap_s=0.0,
+                          sleep=slept.append)
+        with pytest.raises(OSError):
+            pol.call(lambda: (_ for _ in ()).throw(OSError()), op="x")
+        assert len(slept) == 2  # no sleep after the final attempt
+
+
+# --------------------------------------------------------------------------
+class TestCircuitBreaker:
+    def _breaker(self, clock, metrics=None, health=None, threshold=3,
+                 reset_s=10.0):
+        return CircuitBreaker(failure_threshold=threshold, reset_s=reset_s,
+                              clock=clock, metrics=metrics, model="m",
+                              health=health)
+
+    def test_full_cycle_on_simulated_clock(self):
+        t = [0.0]
+        m = MetricsRegistry()
+        h = Health(metrics=m, component="fleet")
+        br = self._breaker(lambda: t[0], metrics=m, health=h, threshold=2,
+                           reset_s=5.0)
+        br.allow(); br.record_failure()
+        assert br.state() == "closed"          # 1 < threshold
+        br.allow(); br.record_failure()
+        assert br.state() == "open" and not h.ok()
+        with pytest.raises(CircuitOpenError) as ei:
+            br.allow()
+        assert 0 < ei.value.retry_after_s <= 5.0
+        assert ei.value.http_status == 503 and ei.value.cause == "breaker_open"
+        t[0] = 5.01
+        br.allow()                              # the half-open probe
+        assert br.state() == "half_open" and not h.ok()
+        with pytest.raises(CircuitOpenError):
+            br.allow()                          # only ONE probe per window
+        br.record_success()
+        assert br.state() == "closed" and h.ok()
+        assert _counter_value(m, "fleet_breaker_transitions_total",
+                              {"model": "m", "to": "open"}) == 1
+        assert _counter_value(m, "fleet_breaker_transitions_total",
+                              {"model": "m", "to": "closed"}) == 1
+
+    def test_failed_probe_reopens_fresh_window(self):
+        t = [0.0]
+        br = self._breaker(lambda: t[0], threshold=1, reset_s=5.0)
+        br.allow(); br.record_failure()
+        assert br.state() == "open"
+        t[0] = 5.01
+        br.allow()
+        br.record_failure()                     # probe failed
+        assert br.state() == "open"
+        t[0] = 9.0                              # window restarted at t=5.01
+        with pytest.raises(CircuitOpenError):
+            br.allow()
+        t[0] = 10.1
+        br.allow()
+        br.record_success()
+        assert br.state() == "closed"
+
+    def test_success_resets_consecutive_count(self):
+        br = self._breaker(lambda: 0.0, threshold=2)
+        for _ in range(5):
+            br.allow(); br.record_failure()
+            br.allow(); br.record_success()
+        assert br.state() == "closed"
+
+    def test_record_ignored_releases_probe_only(self):
+        t = [0.0]
+        br = self._breaker(lambda: t[0], threshold=1, reset_s=1.0)
+        br.allow(); br.record_failure()
+        t[0] = 1.01
+        br.allow()                              # probe
+        br.record_ignored()                     # client-side outcome
+        assert br.state() == "half_open"
+        br.allow()                              # slot free again
+        br.record_success()
+        assert br.state() == "closed"
+
+
+# --------------------------------------------------------------------------
+class _FakeWorker:
+    """Duck-typed watchdog target with a controllable heartbeat."""
+
+    def __init__(self, beat=0.0, alive=True, restart_ok=True):
+        self.beat = beat
+        self.alive = alive
+        self.restart_ok = restart_ok
+        self.restarts = []
+
+    def heartbeat(self):
+        return self.beat
+
+    def worker_alive(self):
+        return self.alive
+
+    def restart_worker(self, reason):
+        self.restarts.append(reason)
+        return self.restart_ok
+
+
+class TestWatchdog:
+    def _dog(self, comp, clock, metrics=None, health=None, max_restarts=3):
+        return Watchdog(lambda: [("w", comp)], deadline_s=1.0, poll_s=0.01,
+                        metrics=metrics, health=health,
+                        max_restarts=max_restarts, clock=clock)
+
+    def test_detects_missed_heartbeat_and_restarts(self):
+        m = MetricsRegistry()
+        h = Health(metrics=m)
+        comp = _FakeWorker(beat=0.0)
+        t = [0.5]
+        dog = self._dog(comp, lambda: t[0], metrics=m, health=h)
+        assert dog.check_once() == 0            # fresh heartbeat
+        t[0] = 2.0
+        assert dog.check_once() == 1            # stale > deadline
+        assert len(comp.restarts) == 1 and "deadline" in comp.restarts[0]
+        assert not h.ok() and h.state() == "degraded"
+        assert _counter_value(m, "serve_watchdog_stalls_total",
+                              {"component": "w"}) == 1
+        assert _counter_value(m, "serve_watchdog_restarts_total",
+                              {"component": "w"}) == 1
+        comp.beat = 2.0                         # worker recovered
+        assert dog.check_once() == 0
+        assert h.ok()
+
+    def test_dead_thread_is_a_stall(self):
+        comp = _FakeWorker(beat=0.0, alive=False)
+        dog = self._dog(comp, lambda: 0.0)
+        assert dog.check_once() == 1
+        assert "dead" in comp.restarts[0]
+
+    def test_gives_up_after_max_restarts(self):
+        h = Health()
+        comp = _FakeWorker(beat=0.0)
+        dog = self._dog(comp, lambda: 10.0, health=h, max_restarts=2)
+        for _ in range(2):
+            dog.check_once()
+        assert h.state() == "degraded" and len(comp.restarts) == 2
+        dog.check_once()                        # third consecutive stall
+        assert h.state() == "failed"
+        assert len(comp.restarts) == 2          # stopped thrashing
+
+    def test_component_exceptions_do_not_kill_the_dog(self):
+        class Exploding:
+            def heartbeat(self):
+                raise RuntimeError("mid-teardown")
+
+            def worker_alive(self):
+                return True
+
+        dog = Watchdog(lambda: [("boom", Exploding())], deadline_s=1.0,
+                       clock=lambda: 0.0)
+        assert dog.check_once() == 0
+
+    def test_background_loop_runs(self):
+        comp = _FakeWorker(beat=0.0)
+        t = [100.0]
+        dog = self._dog(comp, lambda: t[0]).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not comp.restarts and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert comp.restarts
+        finally:
+            dog.stop()
+
+
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+class TestEngineSelfHealing:
+    def test_worker_death_sheds_typed_then_restart_recovers(self):
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1, 2), max_wait_ms=1.0)
+        try:
+            x = np.zeros((4,), np.float32)
+            ref = eng.predict(x)
+            fp = install(FaultPlane())
+            fp.inject("serve.dispatch", error=RuntimeError, times=1)
+            with pytest.raises(WorkerStallError) as ei:
+                eng.predict(x)
+            assert ei.value.cause == "worker_stall"
+            assert ei.value.http_status == 503
+            # the worker thread is dead: fail fast, don't queue forever
+            deadline = time.monotonic() + 5.0
+            while eng.worker_alive() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            with pytest.raises(ServerClosingError) as ei:
+                eng.submit(x[None])
+            assert ei.value.cause == "worker_dead"
+            # crash-only restart against unchanged registry state
+            assert eng.restart_worker(reason="test") is True
+            np.testing.assert_allclose(eng.predict(x), ref, rtol=1e-6)
+            assert eng.registry.inflight() == {}
+        finally:
+            uninstall()
+            eng.shutdown(drain=True)
+
+    def test_watchdog_restarts_dead_engine_worker(self):
+        m = _dense_model()
+        metrics = MetricsRegistry()
+        eng = ServeEngine(m, batch_buckets=(1,), max_wait_ms=1.0,
+                          metrics=metrics)
+        health = Health(metrics=metrics)
+        dog = Watchdog(lambda: [("engine", eng)], deadline_s=5.0,
+                       metrics=metrics, health=health)
+        try:
+            x = np.zeros((4,), np.float32)
+            ref = eng.predict(x)
+            fp = install(FaultPlane())
+            fp.inject("serve.dispatch", error=RuntimeError, times=1)
+            with pytest.raises(WorkerStallError):
+                eng.predict(x)
+            deadline = time.monotonic() + 5.0
+            while eng.worker_alive() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            uninstall()
+            assert dog.check_once() == 1        # dead thread -> restart
+            np.testing.assert_allclose(eng.predict(x), ref, rtol=1e-6)
+            assert dog.check_once() == 0        # healthy again
+            assert health.ok()
+            assert _counter_value(
+                metrics, "serve_watchdog_restarts_total",
+                {"component": "engine"}) == 1
+        finally:
+            uninstall()
+            dog.stop()
+            eng.shutdown(drain=True)
+
+    def test_drain_timeout_is_typed_and_bounded(self):
+        """An injected hang in the dispatcher must not hang shutdown: the
+        drain times out, in-flight work gets DrainTimeoutError, the suite
+        moves on."""
+        m = _dense_model()
+        eng = ServeEngine(m, batch_buckets=(1,), max_wait_ms=1.0)
+        x = np.zeros((1, 4), np.float32)
+        fp = install(FaultPlane())
+        fp.inject("serve.dispatch", hang_s=30.0)
+        handle = eng.submit(x)
+        t0 = time.monotonic()
+        try:
+            assert eng.shutdown(drain=True, timeout=0.5) is False
+            assert time.monotonic() - t0 < 10.0
+            with pytest.raises(DrainTimeoutError) as ei:
+                handle.wait()
+            assert ei.value.cause == "drain_timeout"
+            assert eng.registry.inflight() == {}
+        finally:
+            uninstall()  # release the parked worker thread
+
+
+@pytest.mark.slow
+class TestBatcherSelfHealing:
+    def _lm(self, seed=0):
+        from deeplearning4j_tpu.models import CausalLM
+
+        m = CausalLM(seed=seed, input_shape=(16,), num_layers=2, d_model=32,
+                     num_heads=4, vocab=50).build()
+        m.init()
+        return m
+
+    def test_decode_death_sheds_typed_then_restart_recovers(self):
+        from deeplearning4j_tpu.serve import ContinuousBatcher
+
+        lm = self._lm()
+        cb = ContinuousBatcher(lm, slots=2, capacity=16, seed=0)
+        try:
+            prompt = np.arange(4, dtype=np.int32)
+            ref = cb.generate(prompt, 4, temperature=0.0)
+            fp = install(FaultPlane())
+            fp.inject("serve.decode_step", error=RuntimeError, times=1)
+            with pytest.raises(WorkerStallError):
+                cb.generate(prompt, 4, temperature=0.0)
+            deadline = time.monotonic() + 5.0
+            while cb.worker_alive() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            with pytest.raises(ServerClosingError) as ei:
+                cb.submit(prompt, 4)
+            assert ei.value.cause == "worker_dead"
+            uninstall()
+            assert cb.restart_worker(reason="test") is True
+            out = cb.generate(prompt, 4, temperature=0.0)
+            np.testing.assert_array_equal(out, ref)
+            assert cb.registry.inflight() == {}
+        finally:
+            uninstall()
+            cb.shutdown(drain=True)
+
+    def test_drain_timeout_over_hung_decode(self):
+        from deeplearning4j_tpu.serve import ContinuousBatcher
+
+        lm = self._lm()
+        cb = ContinuousBatcher(lm, slots=1, capacity=16, seed=0)
+        prompt = np.arange(4, dtype=np.int32)
+        cb.generate(prompt, 2, temperature=0.0)   # warm the executables
+        fp = install(FaultPlane())
+        fp.inject("serve.decode_step", hang_s=30.0)
+        handle = cb.submit(prompt, 4)
+        try:
+            assert cb.shutdown(drain=True, timeout=0.5) is False
+            with pytest.raises(DrainTimeoutError):
+                handle.wait()
+            assert cb.registry.inflight() == {}
+        finally:
+            uninstall()
+
+
+# --------------------------------------------------------------------------
+class _StubEntry:
+    def __init__(self, name, nbytes=10, fail_activations=0):
+        self.name = name
+        self.weight_bytes = nbytes
+        self.fail_activations = fail_activations
+        self.activations = 0
+
+    def activate(self):
+        if self.fail_activations > 0:
+            self.fail_activations -= 1
+            raise OSError("transfer torn")
+        self.activations += 1
+
+    def deactivate(self):
+        pass
+
+
+class TestPagerRetry:
+    def _pager(self, metrics):
+        return WeightPager(100, metrics=metrics,
+                           retry=RetryPolicy(attempts=3, base_s=0.0,
+                                             cap_s=0.0, metrics=metrics,
+                                             sleep=lambda s: None))
+
+    def test_transient_transfer_recovers(self):
+        m = MetricsRegistry()
+        pager = self._pager(m)
+        entry = _StubEntry("a", fail_activations=2)
+        pager.ensure(entry)
+        assert pager.resident() == ["a"] and entry.activations == 1
+        assert _counter_value(
+            m, "fleet_retry_total",
+            {"op": "fleet.page_in_transfer", "outcome": "recovered"}) == 1
+
+    def test_exhaustion_is_typed_and_rolls_back(self):
+        m = MetricsRegistry()
+        pager = self._pager(m)
+        entry = _StubEntry("a", fail_activations=5)
+        with pytest.raises(PageInError) as ei:
+            pager.ensure(entry)
+        assert ei.value.cause == "page_in_failed"
+        assert ei.value.http_status == 503
+        assert pager.resident() == []
+        assert pager.stats()["resident_bytes"] == 0
+        pager.ensure(entry)  # 2 failures left: retries cover them
+        assert pager.resident() == ["a"]
+
+    def test_injected_transfer_faults(self):
+        m = MetricsRegistry()
+        pager = self._pager(m)
+        fp = install(FaultPlane())
+        fp.inject("fleet.page_in_transfer", error=OSError, times=2)
+        entry = _StubEntry("a")
+        pager.ensure(entry)
+        assert pager.resident() == ["a"]
+        assert fp.injected() == {("fleet.page_in_transfer", "error"): 2}
+
+    def test_capacity_error_never_retried(self):
+        from deeplearning4j_tpu.serve import CapacityError
+
+        m = MetricsRegistry()
+        pager = self._pager(m)
+        with pytest.raises(CapacityError):
+            pager.ensure(_StubEntry("huge", nbytes=1000))
+        assert _counter_value(
+            m, "fleet_retry_total",
+            {"op": "fleet.page_in_transfer", "outcome": "retry"}) == 0
+
+
+class TestAotStoreFaults:
+    def test_injected_corrupt_quarantines(self, tmp_path):
+        from deeplearning4j_tpu.aot import AotStore
+        from deeplearning4j_tpu.aot.store import AotCorruptEntry
+
+        store = AotStore(str(tmp_path))
+        key = "ab" * 32
+        store.put(key, b"executable-bytes")
+        fp = install(FaultPlane(seed=0))
+        fp.inject("aot.store_read", corrupt=True, times=1)
+        with pytest.raises(AotCorruptEntry):
+            store.get(key)
+        uninstall()
+        assert store.get(key) is None           # quarantined, clean miss
+        assert store.stats()["quarantined"] == 1
+
+    def test_injected_read_error_is_typed(self, tmp_path):
+        from deeplearning4j_tpu.aot import AotStore
+        from deeplearning4j_tpu.aot.store import AotStoreError
+
+        store = AotStore(str(tmp_path))
+        key = "cd" * 32
+        store.put(key, b"payload")
+        fp = install(FaultPlane())
+        fp.inject("aot.store_read", error=OSError, times=1)
+        with pytest.raises(AotStoreError):
+            store.get(key)
+        assert store.get(key) == b"payload"     # transient: next read fine
+
+    def test_aot_function_retries_store_reads(self, tmp_path, monkeypatch):
+        """AotFunction._load retries transient store errors before falling
+        back to a live trace."""
+        from deeplearning4j_tpu.aot import AotStore
+        from deeplearning4j_tpu.aot.compile import AotFunction
+
+        m = MetricsRegistry()
+        store = AotStore(str(tmp_path))
+
+        def traced(x):
+            return x
+
+        traced.lower = lambda *a: None  # store-capable marker
+        fn = AotFunction(traced, tag="t", store=store, metrics=m,
+                         retry=RetryPolicy(attempts=3, base_s=0.0, cap_s=0.0,
+                                           metrics=m, sleep=lambda s: None))
+        calls = {"n": 0}
+
+        def flaky_get(key):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                from deeplearning4j_tpu.aot.store import AotStoreError
+                raise AotStoreError("transient")
+            return None
+
+        monkeypatch.setattr(store, "get", flaky_get)
+        assert fn._load("ab" * 32) is None      # miss after recovery
+        assert calls["n"] == 3
+        assert _counter_value(
+            m, "fleet_retry_total",
+            {"op": "aot.store_read", "outcome": "recovered"}) == 1
+
+
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+class TestFleetBreakerIntegration:
+    def test_page_in_failures_open_then_probe_closes(self):
+        t = [0.0]
+        fleet = FleetRegistry(breaker_failures=2, breaker_reset_s=5.0,
+                              breaker_clock=lambda: t[0])
+        m = _dense_model()
+        fleet.add("a", m)
+        x = np.zeros((4,), np.float32)
+        fp = install(FaultPlane())
+        fp.inject("fleet.page_in_transfer", error=OSError, times=-1)
+        try:
+            for _ in range(2):
+                with pytest.raises(PageInError):
+                    fleet.predict("a", x)
+            assert fleet._breaker("a").state() == "open"
+            assert not fleet.health.ok()
+            transfers = fp.hits("fleet.page_in_transfer")
+            with pytest.raises(CircuitOpenError) as ei:
+                fleet.predict("a", x)
+            assert ei.value.retry_after_s > 0
+            # open breaker sheds BEFORE any paging work
+            assert fp.hits("fleet.page_in_transfer") == transfers
+            uninstall()
+            t[0] = 5.01
+            res = fleet.predict("a", x)         # the half-open probe
+            assert np.asarray(res.output).shape[-1] == 3
+            assert fleet._breaker("a").state() == "closed"
+            assert fleet.health.ok()
+            assert fleet.status()["breakers"]["a"]["state"] == "closed"
+        finally:
+            uninstall()
+            fleet.shutdown()
+
+    def test_quota_sheds_never_trip_the_breaker(self):
+        from deeplearning4j_tpu.fleet import QuotaError, TenantTable
+
+        table = TenantTable()
+        table.register("t0", rate_per_s=0.001, burst=1)
+        fleet = FleetRegistry(breaker_failures=1, tenants=table)
+        fleet.add("a", _dense_model())
+        x = np.zeros((4,), np.float32)
+        try:
+            fleet.predict("a", x, tenant="t0")
+            with pytest.raises(QuotaError):
+                fleet.predict("a", x, tenant="t0")
+            assert fleet._breaker("a").state() == "closed"
+            fleet.predict("a", x)               # other tenants unaffected
+        finally:
+            fleet.shutdown()
