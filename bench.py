@@ -80,7 +80,8 @@ def _probe_devices(timeout_s: float):
             last["stale"] = True
             last["metric"] = str(last.get("metric", "")) + "_stale"
             last.setdefault("detail", {})
-            last["detail"]["stale_from"] = last["detail"].get("captured", "?")
+            last["detail"]["stale_from"] = (
+                last.get("captured") or last["detail"].get("captured", "?"))
             last["detail"]["stale_reason"] = (
                 "TPU tunnel wedged at bench time; this is the last "
                 "successfully captured headline, not a fresh measurement")
@@ -333,6 +334,18 @@ def _bench_chunked_prefill(model, seconds):
     return {"chunked": chunked, "unchunked": whole}
 
 
+def _stamp(headline: dict, source: str) -> dict:
+    """Top-level provenance on every written round file: which bench entry
+    produced it and when. BENCH_LAST.json may be replayed as an explicitly
+    stale fallback when the TPU tunnel is wedged (_probe_devices), so the
+    capture date must ride at the top level of every artifact, not buried
+    in detail — a reader deciding whether a number is current should not
+    have to know each bench's detail schema."""
+    headline["source"] = source
+    headline["captured"] = time.strftime("%Y-%m-%d")
+    return headline
+
+
 def _next_round_path(prefix: str) -> str:
     """Next free ``<prefix>_rNN.json`` in the repo root: scans existing
     rounds and increments, so successive captures never clobber each other
@@ -433,6 +446,7 @@ def _bench_serving():
             "captured": time.strftime("%Y-%m-%d"),
         },
     }
+    _stamp(headline, "bench.py --serve")
     print(json.dumps(headline), flush=True)
     out_path = _next_round_path("BENCH_serve")
     with open(out_path, "w") as f:
@@ -517,6 +531,7 @@ def _bench_coldstart():
                    "device": str(dev.device_kind),
                    "captured": time.strftime("%Y-%m-%d")},
     }
+    _stamp(headline, "bench.py --coldstart")
     print(json.dumps(headline), flush=True)
     out_path = _next_round_path("BENCH_coldstart")
     with open(out_path, "w") as f:
@@ -725,6 +740,7 @@ def _bench_fleet():
             "captured": time.strftime("%Y-%m-%d"),
         },
     }
+    _stamp(headline, "bench.py --fleet")
     print(json.dumps(headline), flush=True)
     out_path = _next_round_path("BENCH_fleet")
     with open(out_path, "w") as f:
@@ -792,6 +808,7 @@ def main():
             **({"breadth_file": "BENCH_BREADTH.json"} if run_breadth else {}),
         },
     }
+    _stamp(headline, "bench.py")
     print(json.dumps(headline), flush=True)
     if on_tpu:  # wedge fallback source — real-chip captures only
         try:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from .dataflow import (ForwardScan, assign_names, terminates,
@@ -781,3 +782,139 @@ class UnlockedSharedStateRule(Rule):
                             f"('{fi.qual}') without a held lock — concurrent "
                             f"request/flush access races; wrap in "
                             f"`with <lock>:`")
+
+
+# --------------------------------------------------------------------------
+# metric label cardinality
+
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+#: identifier segments that smell like per-request (unbounded) values.
+#: Deliberately narrow: ``tenant``/``model``/``code``/``cause`` are bounded
+#: by configuration or an enum and stay clean.
+_UNBOUNDED_LABEL_RE = re.compile(
+    r"(?:^|_)(?:id|ids|uuid|guid|path|paths|url|urls|uri|uris|prompt|"
+    r"prompts|query|queries|trace|token|tokens)(?:_|$)")
+
+
+def _find_unbounded(expr: ast.AST) -> Optional[str]:
+    """Innermost Name/Attribute under ``expr`` whose identifier matches the
+    unbounded-input pattern (``request_id``, ``self.path``, ``trace``...)."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and _UNBOUNDED_LABEL_RE.search(n.id.lower()):
+            return n.id
+        if isinstance(n, ast.Attribute) \
+                and _UNBOUNDED_LABEL_RE.search(n.attr.lower()):
+            return n.attr
+    return None
+
+
+def _label_value_origin(value: ast.AST) -> Optional[Tuple[str, str]]:
+    """(source identifier, how it reached the label) when ``value`` is built
+    from an unbounded input; None for bounded/unknown provenance.
+
+    Only three shapes are trusted to *carry* the raw value into the label:
+    f-strings, ``str()``/``repr()``/``format()``, and the bare Name/Attribute
+    itself. Any other call (``_metric_route(path)``, ``_bucket(n)``) is
+    assumed to collapse its input to a bounded set — that is the sanctioned
+    fix for a finding from this rule.
+    """
+    if isinstance(value, ast.JoinedStr):
+        for part in value.values:
+            if isinstance(part, ast.FormattedValue):
+                src = _find_unbounded(part.value)
+                if src:
+                    return src, "an f-string of"
+    elif isinstance(value, ast.Call) and isinstance(value.func, ast.Name) \
+            and value.func.id in ("str", "repr", "format"):
+        for a in value.args:
+            src = _find_unbounded(a)
+            if src:
+                return src, f"{value.func.id}() of"
+    elif isinstance(value, ast.Name):
+        if _UNBOUNDED_LABEL_RE.search(value.id.lower()):
+            return value.id, "the raw value of"
+    elif isinstance(value, ast.Attribute):
+        if _UNBOUNDED_LABEL_RE.search(value.attr.lower()):
+            return value.attr, "the raw value of"
+    return None
+
+
+@register
+class MetricLabelCardinalityRule(Rule):
+    """Metric label values derived from unbounded per-request inputs.
+
+    Every distinct label value mints a new time series: a label fed from a
+    request id, URL path, prompt text, or trace id grows the registry (and
+    every scrape) without bound — the Prometheus cardinality explosion.
+    Flags ``counter``/``gauge``/``histogram`` call sites whose label dict
+    (inline, or a local ``labels = {...}`` passed by name) contains an
+    f-string over, ``str()``/``repr()`` of, or the raw value of an
+    identifier matching the unbounded pattern. The fix is structural: fold
+    the value through a bounded mapper (``_metric_route`` collapsing unknown
+    paths to ``"other"``) or attach ids as *exemplars* on histogram
+    observations instead of as labels.
+    """
+
+    name = "metric-label-cardinality"
+    description = ("metric label value built from an unbounded per-request "
+                   "input (id/path/prompt/...) — one time series per "
+                   "distinct value")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        mi = ctx.module_info
+        for node in ast.walk(mi.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_FACTORIES):
+                continue
+            # registry factories take the metric name as a string literal;
+            # this also skips look-alikes (np.histogram(data, bins=...))
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            for labels in self._label_dicts(mi, node):
+                for key, value in zip(labels.keys, labels.values):
+                    if key is None:  # **spread — provenance unknown
+                        continue
+                    hit = _label_value_origin(value)
+                    if hit is None:
+                        continue
+                    src, how = hit
+                    kname = key.value if isinstance(key, ast.Constant) \
+                        else ast.dump(key)
+                    yield self.finding(
+                        ctx, value,
+                        f"metric label {kname!r} is {how} '{src}', an "
+                        f"unbounded per-request value — each distinct value "
+                        f"creates a new time series; map it to a bounded set "
+                        f"first (e.g. a route table with an 'other' bucket) "
+                        f"or carry the id as a histogram exemplar instead")
+
+    @staticmethod
+    def _label_dicts(mi, call: ast.Call) -> List[ast.Dict]:
+        """Dict literals feeding the call's label argument: inline dicts in
+        any argument slot, plus a Name argument resolved to a single
+        ``labels = {...}`` assignment in the enclosing function."""
+        out: List[ast.Dict] = []
+        names: List[str] = []
+        for e in list(call.args[1:]) + [kw.value for kw in call.keywords
+                                        if kw.arg != "help"]:
+            if isinstance(e, ast.Dict):
+                out.append(e)
+            elif isinstance(e, ast.Name):
+                names.append(e.id)
+        if names:
+            fn = mi.parents.get(call)
+            while fn is not None and not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = mi.parents.get(fn)
+            if fn is not None:
+                for stmt in ast.walk(fn):
+                    if isinstance(stmt, ast.Assign) \
+                            and len(stmt.targets) == 1 \
+                            and isinstance(stmt.targets[0], ast.Name) \
+                            and stmt.targets[0].id in names \
+                            and isinstance(stmt.value, ast.Dict):
+                        out.append(stmt.value)
+        return out

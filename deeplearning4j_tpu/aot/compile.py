@@ -32,6 +32,7 @@ import time
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 from ..chaos.retry import RetryPolicy
+from ..obs import reqtrace as _rt
 from .keys import arch_fingerprint, cache_key, call_signature, \
     runtime_fingerprint
 from .store import AotCorruptEntry, AotStore, AotStoreError, AotVersionError
@@ -176,12 +177,14 @@ class AotFunction:
                 return exe
             t0 = time.perf_counter()
             key = self._key(sig)
-            exe = self._load(key)
-            if exe is None:
-                exe = self._fn.lower(*args).compile()
-                if self._compile_counter is not None:
-                    self._compile_counter.inc()  # a real trace happened
-                self._save(key, exe)
+            with _rt.span("aot.acquire", tag=self.tag):
+                exe = self._load(key)
+                if exe is None:
+                    with _rt.span("aot.trace", tag=self.tag):
+                        exe = self._fn.lower(*args).compile()
+                    if self._compile_counter is not None:
+                        self._compile_counter.inc()  # a real trace happened
+                    self._save(key, exe)
             self._exes[sig] = exe
             self._acquire_seconds += time.perf_counter() - t0
             return exe

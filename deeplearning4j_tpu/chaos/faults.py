@@ -214,6 +214,14 @@ class FaultPlane:
                 "chaos_faults_injected_total",
                 {"point": point, "mode": spec.mode},
                 help="faults fired by the installed chaos plane").inc()
+        # a fired fault is forensic gold: stamp it into the flight recorder
+        # ring and onto the Perfetto timeline (import deferred so the chaos
+        # plane stays importable stand-alone)
+        from ..obs import flight as _flight
+        from ..obs import reqtrace as _rt
+        if _flight.ACTIVE is not None:
+            _flight.ACTIVE.record_event("fault", point, spec.mode)
+        _rt.instant(f"fault:{point}", mode=spec.mode)
         if spec.mode == "error":
             exc = spec.error
             if isinstance(exc, type):

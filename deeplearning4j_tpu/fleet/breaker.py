@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..obs import flight as _flight
 from ..serve.errors import ShedError
 
 log = logging.getLogger(__name__)
@@ -99,6 +100,10 @@ class CircuitBreaker:
             self._metrics.counter(
                 "fleet_breaker_transitions_total", labels,
                 help="circuit breaker state transitions").inc()
+        if _flight.ACTIVE is not None:
+            _flight.ACTIVE.record_event("breaker", to,
+                                        model=self.model or "<model>",
+                                        failures=self._failures)
         cause = f"breaker_open:{self.model or 'model'}"
         if self._health is not None:
             # open AND half-open keep readiness off: the model is not
@@ -153,18 +158,24 @@ class CircuitBreaker:
             self._probing = False
 
     def record_failure(self) -> None:
+        opened = False
         with self._lock:
             self._probing = False
             if self._state == HALF_OPEN:
                 # failed probe: straight back to open, fresh window
                 self._opened_at = self._clock()
                 self._transition_locked(OPEN)
-                return
-            self._failures += 1
-            if self._state == CLOSED \
-                    and self._failures >= self.failure_threshold:
-                self._opened_at = self._clock()
-                self._transition_locked(OPEN)
+                opened = True
+            else:
+                self._failures += 1
+                if self._state == CLOSED \
+                        and self._failures >= self.failure_threshold:
+                    self._opened_at = self._clock()
+                    self._transition_locked(OPEN)
+                    opened = True
+        if opened and _flight.ACTIVE is not None:
+            # the dump (file I/O) happens outside the gating lock
+            _flight.ACTIVE.dump("breaker_open")
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -31,11 +31,14 @@ import json
 import logging
 import re
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
 from ..chaos import faults as _faults
+from ..obs import flight as _flight
+from ..obs import reqtrace as _rt
 from ..serve.errors import ServeError
 from ..serve.http import retry_after_s
 from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
@@ -115,6 +118,20 @@ class FleetServer(JsonHTTPServerMixin):
                     help=_HTTP_ERRORS_HELP).inc()
                 self.reply(code, body, headers=headers)
 
+            def reply(self, code, payload, ctype="application/json",
+                      headers=None):
+                # traced requests echo their identity on every answer and
+                # time the buffered write-out as the "flush" stage
+                ctx = getattr(self, "_obs_ctx", None)
+                if ctx is None:
+                    super().reply(code, payload, ctype, headers)
+                    return
+                headers = dict(headers or {})
+                headers.setdefault("X-Request-Id", ctx.request_id)
+                headers.setdefault("traceparent", ctx.traceparent())
+                with ctx.stage("flush", code=code):
+                    super().reply(code, payload, ctype, headers)
+
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
                 if path == "/health":
@@ -140,6 +157,16 @@ class FleetServer(JsonHTTPServerMixin):
                 elif path == "/v1/models":
                     status = server.fleet.status()
                     self.reply(200, {"models": status["models"]})
+                elif path == "/v1/debug/requests":
+                    recs = (_flight.ACTIVE.requests()
+                            if _flight.ACTIVE is not None else [])
+                    self.reply(200, {"requests": recs})
+                elif path == "/v1/debug/flight":
+                    if _flight.ACTIVE is None:
+                        self._err(404,
+                                  {"error": "flight recorder not installed"})
+                    else:
+                        self.reply(200, _flight.ACTIVE.snapshot())
                 else:
                     m = _MODEL_ROUTE.match(path)
                     if m and m.group(2) is None:
@@ -157,6 +184,18 @@ class FleetServer(JsonHTTPServerMixin):
                 path, _, query = self.path.partition("?")
                 m = _MODEL_ROUTE.match(path)
                 name = m.group(1) if m else None
+                ctx = None
+                if _rt.ACTIVE is not None:
+                    # ingress: join the caller's W3C trace (or start one),
+                    # echo X-Request-Id; a malformed traceparent yields a
+                    # fresh trace, never a failed request
+                    ctx = _rt.ACTIVE.begin(
+                        m.group(2) if m and m.group(2) else "post",
+                        traceparent=self.headers.get("traceparent"),
+                        request_id=self.headers.get("X-Request-Id"),
+                        model=name, tenant=self._tenant())
+                    self._obs_ctx = ctx
+                    self._obs_trace_id = ctx.trace_id
                 try:
                     if _faults.ACTIVE is not None:
                         _faults.ACTIVE.hit("http.handler")
@@ -165,6 +204,8 @@ class FleetServer(JsonHTTPServerMixin):
                                          cause="shutting_down")
                     if m is None or m.group(2) is None:
                         self._err(404, {"error": "unknown endpoint"})
+                        if ctx is not None:
+                            ctx.finish(error="bad_request")
                         return
                     req = self.read_json()
                     if m.group(2) == "predict":
@@ -177,6 +218,8 @@ class FleetServer(JsonHTTPServerMixin):
                                "tenant": self._tenant()},
                               headers={"Retry-After":
                                        max(1, int(e.retry_after_s + 0.999))})
+                    if ctx is not None:
+                        ctx.finish(error=e.cause)
                 except ServeError as e:
                     headers = None
                     if e.http_status == 503:
@@ -190,16 +233,26 @@ class FleetServer(JsonHTTPServerMixin):
                     self._err(e.http_status,
                               {"error": str(e), "cause": e.cause},
                               headers=headers)
+                    if ctx is not None:
+                        ctx.finish(error=e.cause)
                 except _BAD_REQUEST as e:
                     self._err(400, {"error": str(e)})
+                    if ctx is not None:
+                        ctx.finish(error="bad_request")
                 except Exception as e:  # front door answers every request  # jaxlint: disable=broad-except
                     log.exception("unhandled error serving %s", self.path)
                     self._err(500, {"error": f"{type(e).__name__}: {e}"})
+                    if ctx is not None:
+                        ctx.finish(error="internal")
+                finally:
+                    if ctx is not None:
+                        ctx.finish()  # idempotent: no-op after an error path
 
             def _predict(self, name, req):
                 res = server.fleet.predict(
                     name, req["ndarray"], tenant=self._tenant(),
-                    timeout_ms=req.get("timeout_ms"))
+                    timeout_ms=req.get("timeout_ms"),
+                    ctx=getattr(self, "_obs_ctx", None))
                 body = {"output": np.asarray(res.output).tolist(),
                         "model": name}
                 if res.generation is not None:
@@ -212,6 +265,7 @@ class FleetServer(JsonHTTPServerMixin):
                 self.wfile.flush()
 
             def _generate(self, name, req, query):
+                ctx = getattr(self, "_obs_ctx", None)
                 prompt = np.asarray(req["prompt"], np.int32)
                 kwargs = dict(
                     tenant=self._tenant(),
@@ -225,21 +279,27 @@ class FleetServer(JsonHTTPServerMixin):
                 if prompt.ndim != 1:  # batch prompts are always buffered
                     stream = False
                 if not stream:
-                    toks = server.fleet.generate(name, prompt, mnt, **kwargs)
+                    toks = server.fleet.generate(name, prompt, mnt, ctx=ctx,
+                                                 **kwargs)
                     self.reply(200, {"tokens": np.asarray(toks).tolist(),
                                      "model": name})
                     return
                 # admission errors surface as typed statuses BEFORE the
                 # stream opens; later failures are delivered in-band
                 handle = server.fleet.submit_generate(name, prompt, mnt,
-                                                      **kwargs)
+                                                      ctx=ctx, **kwargs)
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                if ctx is not None:
+                    self.send_header("X-Request-Id", ctx.request_id)
+                    self.send_header("traceparent", ctx.traceparent())
                 self.end_headers()
                 self.close_connection = True
+                t0f = time.perf_counter_ns() if ctx is not None else 0
                 out = []
+                err_cause = None
                 try:
                     for tok in handle.stream():
                         out.append(int(tok))
@@ -248,6 +308,13 @@ class FleetServer(JsonHTTPServerMixin):
                 except ServeError as e:
                     self._sse({"error": str(e), "cause": e.cause,
                                "tokens": out})
+                    err_cause = e.cause
+                if ctx is not None:
+                    # the streaming window: first header flush to last event
+                    ctx.add_stage("flush", t0f, time.perf_counter_ns(),
+                                  tokens=len(out))
+                    if err_cause is not None:
+                        ctx.finish(error=err_cause)
 
         return Handler
 

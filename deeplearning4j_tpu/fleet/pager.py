@@ -41,6 +41,7 @@ from typing import List, Optional
 
 from ..chaos import faults as _faults
 from ..chaos.retry import RetryPolicy
+from ..obs import reqtrace as _rt
 from ..serve.errors import CapacityError, ServeError
 
 
@@ -153,27 +154,29 @@ class WeightPager:
         ok = False
         try:
             t0 = time.perf_counter()
-            for v in victims:
-                # lease-drain: completes every in-flight batch on the
-                # victim before its device params drop
-                v.deactivate()
-                self._page_outs += 1
-                self._count("fleet_page_out_total", v.name,
-                            "model weight page-outs (HBM -> host)")
-            def _transfer():
-                if _faults.ACTIVE is not None:
-                    _faults.ACTIVE.hit("fleet.page_in_transfer")
-                entry.activate()
+            with _rt.span("fleet.page_in", model=entry.name,
+                          victims=len(victims)):
+                for v in victims:
+                    # lease-drain: completes every in-flight batch on the
+                    # victim before its device params drop
+                    v.deactivate()
+                    self._page_outs += 1
+                    self._count("fleet_page_out_total", v.name,
+                                "model weight page-outs (HBM -> host)")
+                def _transfer():
+                    if _faults.ACTIVE is not None:
+                        _faults.ACTIVE.hit("fleet.page_in_transfer")
+                    entry.activate()
 
-            try:
-                self._retry.call(_transfer, op="fleet.page_in_transfer",
-                                 give_up=(CapacityError,))
-            except CapacityError:
-                raise
-            except Exception as e:  # jaxlint: disable=broad-except
-                raise PageInError(
-                    f"paging {entry.name!r} in failed after retries: "
-                    f"{e}") from e
+                try:
+                    self._retry.call(_transfer, op="fleet.page_in_transfer",
+                                     give_up=(CapacityError,))
+                except CapacityError:
+                    raise
+                except Exception as e:  # jaxlint: disable=broad-except
+                    raise PageInError(
+                        f"paging {entry.name!r} in failed after retries: "
+                        f"{e}") from e
             ok = True
             self._page_ins += 1
             self._count("fleet_page_in_total", entry.name,

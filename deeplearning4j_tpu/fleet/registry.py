@@ -31,6 +31,7 @@ from typing import Any, Dict, NamedTuple, Optional
 
 import numpy as np
 
+from ..obs.slo import SloBurn
 from ..serve.continuous import ContinuousBatcher
 from ..serve.engine import ServeEngine
 from ..serve.errors import ServeError, ServerClosingError
@@ -49,6 +50,11 @@ _EVICTION_RETRIES = 4
 # overload into an outage.
 _BREAKER_CAUSES = frozenset({"internal", "page_in_failed", "worker_stall",
                              "worker_dead", "drain_timeout"})
+
+# ServeError causes that do not consume error budget: the *client* (or its
+# quota) failed, not our serving path. Everything else after admission —
+# deadline misses included — is a bad event for the tenant's SLO class.
+_SLO_EXCLUDED = frozenset({"quota", "over_capacity", "bad_request"})
 
 
 class UnknownModelError(ServeError):
@@ -267,6 +273,8 @@ class FleetRegistry:
         self._entries: Dict[str, FleetEntry] = {}
         self._closing = False
         self.health = Health(metrics=self.metrics, component="fleet")
+        # per (model, slo_class) error-budget burn; works with tracing off
+        self.slo = SloBurn(metrics=self.metrics)
         # per-model circuit breakers; breaker_failures=None disables them
         self._breaker_failures = breaker_failures
         self._breaker_reset_s = float(breaker_reset_s)
@@ -352,9 +360,22 @@ class FleetRegistry:
 
     # --------------------------------------------------------------- serving
     def _admit(self, tenant: str, name: str,
-               timeout_ms: Optional[float]) -> Optional[float]:
+               timeout_ms: Optional[float]) -> tuple:
+        """Tenant admission; returns ``(deadline_ms, slo_class_name)``."""
         slo = self.tenants.admit(tenant, model=name)
-        return timeout_ms if timeout_ms is not None else slo.deadline_ms
+        return (timeout_ms if timeout_ms is not None else slo.deadline_ms,
+                slo.name)
+
+    def _slo_record(self, name: str, slo_class: Optional[str],
+                    exc: Optional[BaseException]) -> None:
+        """One admitted request's outcome into the burn accounting.
+        ``slo_class`` is None when admission itself refused (quota) —
+        nothing to account."""
+        if slo_class is None:
+            return
+        if isinstance(exc, ServeError) and exc.cause in _SLO_EXCLUDED:
+            return
+        self.slo.record(name, slo_class, good=exc is None)
 
     @staticmethod
     def _breaker_counts(exc: BaseException) -> bool:
@@ -381,40 +402,61 @@ class FleetRegistry:
         return out
 
     def predict(self, name: str, x, *, tenant: str = "anonymous",
-                timeout_ms: Optional[float] = None) -> FleetResult:
+                timeout_ms: Optional[float] = None, ctx=None) -> FleetResult:
         """Breaker gate -> tenant admission -> page-in -> engine predict.
         ``timeout_ms`` defaults to the tenant's SLO deadline."""
         entry = self.get(name)
         br = self._breaker(name)
         if br is not None:
             br.allow()  # open breaker refuses before quota/paging work
+        slo_cls: list = [None]
 
         def _serve() -> FleetResult:
             nonlocal timeout_ms
-            timeout_ms = self._admit(tenant, name, timeout_ms)
+            if ctx is None:
+                timeout_ms, slo_cls[0] = self._admit(tenant, name,
+                                                     timeout_ms)
+            else:
+                with ctx.stage("admit", model=name):
+                    timeout_ms, slo_cls[0] = self._admit(tenant, name,
+                                                         timeout_ms)
+                ctx.tenant = tenant
+                ctx.slo_class = slo_cls[0]
             x_ = np.asarray(x, entry.input_dtype)
             last: Optional[ServeError] = None
             for _ in range(_EVICTION_RETRIES):
-                self.pager.ensure(entry)
+                if ctx is None:
+                    self.pager.ensure(entry)
+                else:
+                    with ctx.stage("page_in_wait", model=name):
+                        self.pager.ensure(entry)
                 try:
                     eng = entry.engine()
                     if x_.ndim > len(entry.model.input_shape) \
                             and x_.shape[0] <= eng.batch_buckets[-1]:
-                        handle = eng.submit(x_, timeout_ms=timeout_ms)
+                        handle = eng.submit(x_, timeout_ms=timeout_ms,
+                                            ctx=ctx)
                         return FleetResult(handle.wait(), handle.generation)
                     return FleetResult(
-                        eng.predict(x_, timeout_ms=timeout_ms), None)
+                        eng.predict(x_, timeout_ms=timeout_ms, ctx=ctx),
+                        None)
                 except ServerClosingError as e:
                     last = e  # lost the race with an eviction: page back in
             raise last
 
-        return self._observed(br, _serve)
+        try:
+            out = self._observed(br, _serve)
+        except BaseException as e:
+            self._slo_record(name, slo_cls[0], e)
+            raise
+        self._slo_record(name, slo_cls[0], None)
+        return out
 
     def submit_generate(self, name: str, prompt, max_new_tokens: int, *,
                         tenant: str = "anonymous", temperature: float = 1.0,
                         top_k: Optional[int] = None,
                         eos_id: Optional[int] = None,
-                        timeout_ms: Optional[float] = None):
+                        timeout_ms: Optional[float] = None, ctx=None):
         """Admit one generation; returns the batcher's streamable handle.
         The breaker observes the *submission* path (paging + admission into
         the batcher) — a handle that later times out does not count."""
@@ -422,28 +464,52 @@ class FleetRegistry:
         br = self._breaker(name)
         if br is not None:
             br.allow()
+        slo_cls: list = [None]
 
         def _serve():
             nonlocal timeout_ms
-            timeout_ms = self._admit(tenant, name, timeout_ms)
+            if ctx is None:
+                timeout_ms, slo_cls[0] = self._admit(tenant, name,
+                                                     timeout_ms)
+            else:
+                with ctx.stage("admit", model=name):
+                    timeout_ms, slo_cls[0] = self._admit(tenant, name,
+                                                         timeout_ms)
+                ctx.tenant = tenant
+                ctx.slo_class = slo_cls[0]
             prompt_ = np.asarray(prompt, np.int32)
             last: Optional[ServeError] = None
             for _ in range(_EVICTION_RETRIES):
-                self.pager.ensure(entry)
+                if ctx is None:
+                    self.pager.ensure(entry)
+                else:
+                    with ctx.stage("page_in_wait", model=name):
+                        self.pager.ensure(entry)
                 try:
                     return entry.batcher().submit(
                         prompt_, max_new_tokens, temperature=temperature,
-                        top_k=top_k, eos_id=eos_id, timeout_ms=timeout_ms)
+                        top_k=top_k, eos_id=eos_id, timeout_ms=timeout_ms,
+                        ctx=ctx)
                 except ServerClosingError as e:
                     last = e
             raise last
 
-        return self._observed(br, _serve)
+        try:
+            handle = self._observed(br, _serve)
+        except BaseException as e:
+            # the submission path itself failed after admission: account it
+            self._slo_record(name, slo_cls[0], e)
+            raise
+        # SLO outcome is decided when the batcher finishes the request —
+        # possibly much later, on the decode/watchdog thread
+        cls = slo_cls[0]
+        handle.set_on_done(lambda r: self._slo_record(name, cls, r.error))
+        return handle
 
     def generate(self, name: str, prompt, max_new_tokens: int, *,
                  tenant: str = "anonymous", temperature: float = 1.0,
                  top_k: Optional[int] = None, eos_id: Optional[int] = None,
-                 timeout_ms: Optional[float] = None) -> np.ndarray:
+                 timeout_ms: Optional[float] = None, ctx=None) -> np.ndarray:
         """Blocking generate; batch prompts fan out row-per-request like
         :meth:`ContinuousBatcher.generate`."""
         prompt = np.asarray(prompt, np.int32)
@@ -451,7 +517,7 @@ class FleetRegistry:
             return self.submit_generate(
                 name, prompt, max_new_tokens, tenant=tenant,
                 temperature=temperature, top_k=top_k, eos_id=eos_id,
-                timeout_ms=timeout_ms).wait()
+                timeout_ms=timeout_ms, ctx=ctx).wait()
         handles = [self.submit_generate(
             name, p, max_new_tokens, tenant=tenant, temperature=temperature,
             top_k=top_k, eos_id=eos_id, timeout_ms=timeout_ms)
@@ -480,6 +546,7 @@ class FleetRegistry:
             "tenants": self.tenants.stats(),
             "health": self.health.snapshot(),
             "breakers": {n: b.snapshot() for n, b in sorted(breakers.items())},
+            "slo": self.slo.snapshot(),
         }
         if self.aot_store is not None:
             body["aot_store"] = self.aot_store.stats()

@@ -1,18 +1,26 @@
-"""Observability: metrics registry, span tracer, JAX-aware step telemetry.
+"""Observability: metrics registry, span tracer, request-scoped tracing,
+flight recorder, SLO burn accounting, and JAX-aware step telemetry.
 
-``obs.metrics`` and ``obs.trace`` are stdlib-only and jax-free — servers
+``obs.metrics``, ``obs.trace``, ``obs.reqtrace``, ``obs.flight``,
+``obs.slo`` and ``obs.promcheck`` are stdlib-only and jax-free — servers
 import them directly so ``/metrics`` works in processes that never load jax.
 Importing this package pulls the full surface (including the jax-adjacent
 ``StepTelemetry`` / ``TelemetryListener``).
 """
 
+from .flight import FlightRecorder
 from .listener import TelemetryListener
 from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                       MetricsRegistry, default_registry)
+from .reqtrace import (RequestContext, RequestTracer, format_traceparent,
+                       parse_traceparent)
+from .slo import SloBurn
 from .step import StepTelemetry
 from .trace import Tracer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
     "default_registry", "Tracer", "StepTelemetry", "TelemetryListener",
+    "RequestContext", "RequestTracer", "FlightRecorder", "SloBurn",
+    "parse_traceparent", "format_traceparent",
 ]

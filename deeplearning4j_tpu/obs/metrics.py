@@ -21,6 +21,7 @@ import json
 import math
 import re
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 # Geometric-ish latency buckets (seconds): 100 us .. 60 s. Wide enough for a
@@ -105,7 +106,8 @@ class Histogram:
     (Prometheus histogram_quantile makes the same one).
     """
 
-    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_min", "_max", "_lock")
+    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_min", "_max",
+                 "_exemplars", "_lock")
 
     def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
         bounds = sorted(float(b) for b in buckets)
@@ -117,9 +119,13 @@ class Histogram:
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        # bucket index -> (observed value, trace_id, unix seconds): the last
+        # traced observation that landed in that bucket, exported as an
+        # OpenMetrics exemplar so a p99 bucket links straight to a trace
+        self._exemplars: Dict[int, Tuple[float, str, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         v = float(value)
         i = bisect.bisect_left(self._bounds, v)
         with self._lock:
@@ -130,6 +136,8 @@ class Histogram:
                 self._min = v
             if v > self._max:
                 self._max = v
+            if trace_id is not None:
+                self._exemplars[i] = (v, trace_id, time.time())
 
     @property
     def count(self) -> int:
@@ -179,12 +187,13 @@ class Histogram:
             total, s = self._count, self._sum
             mn = self._min if self._count else None
             mx = self._max if self._count else None
+            exemplars = dict(self._exemplars)
         cum, buckets = 0, []
         for bound, c in zip(list(self._bounds) + [math.inf], counts):
             cum += c
             buckets.append((bound, cum))
         return {"count": total, "sum": s, "min": mn, "max": mx,
-                "buckets": buckets}
+                "buckets": buckets, "exemplars": exemplars}
 
 
 class _NullCounter(Counter):
@@ -204,7 +213,7 @@ class _NullGauge(Gauge):
 
 
 class _NullHistogram(Histogram):
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         pass
 
 
@@ -303,6 +312,12 @@ class MetricsRegistry:
                     entry.update(snap)
                     entry["buckets"] = [["+Inf" if math.isinf(b) else b, c]
                                         for b, c in snap["buckets"]]
+                    ex = snap.get("exemplars") or {}
+                    if ex:
+                        entry["exemplars"] = {str(i): list(e)
+                                              for i, e in ex.items()}
+                    else:
+                        entry.pop("exemplars", None)
                     entry["quantiles"] = inst.percentiles()
                 else:
                     entry["value"] = inst.value
@@ -334,6 +349,50 @@ class MetricsRegistry:
                     lines.append(f"{name}{_label_str(key)} "
                                  f"{_fmt_value(inst.value)}")
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_openmetrics(self) -> str:
+        """OpenMetrics text exposition (version 1.0.0).
+
+        Same data as :meth:`to_prometheus` plus histogram *exemplars*
+        (``# {trace_id="..."} value ts`` after a bucket sample) — exemplars
+        are only legal in this format, which is why both exist. Counter
+        families drop their ``_total`` suffix in metadata (the OpenMetrics
+        family/sample-name split); the terminating ``# EOF`` is mandatory.
+        """
+        lines: List[str] = []
+        for name, fam in self._items():
+            fam_name = (name[:-len("_total")]
+                        if fam.kind == "counter" and name.endswith("_total")
+                        else name)
+            lines.append(f"# TYPE {fam_name} {fam.kind}")
+            if fam.help:
+                lines.append(f"# HELP {fam_name} {fam.help}")
+            for key in sorted(fam.series):
+                inst = fam.series[key]
+                if isinstance(inst, Histogram):
+                    snap = inst._snapshot()
+                    exemplars = snap["exemplars"]
+                    for i, (bound, cum) in enumerate(snap["buckets"]):
+                        lbl = _label_str(key + (("le", _fmt_value(bound)),))
+                        line = f"{name}_bucket{lbl} {cum}"
+                        ex = exemplars.get(i)
+                        if ex is not None:
+                            v, trace_id, ts = ex
+                            line += (f' # {{trace_id="'
+                                     f'{_escape_label_value(trace_id)}"}} '
+                                     f"{_fmt_value(v)} {ts:.3f}")
+                        lines.append(line)
+                    lbl = _label_str(key)
+                    lines.append(f"{name}_sum{lbl} {_fmt_value(snap['sum'])}")
+                    lines.append(f"{name}_count{lbl} {snap['count']}")
+                elif fam.kind == "counter":
+                    lines.append(f"{fam_name}_total{_label_str(key)} "
+                                 f"{_fmt_value(inst.value)}")
+                else:
+                    lines.append(f"{name}{_label_str(key)} "
+                                 f"{_fmt_value(inst.value)}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
 
 def _label_str(key: Iterable[Tuple[str, str]]) -> str:

@@ -40,18 +40,20 @@ _NULL_SPAN = _NullSpan()
 class _Span:
     """One live span; created by :meth:`Tracer.span`, records on ``__exit__``."""
 
-    __slots__ = ("tracer", "name", "args", "_t0")
+    __slots__ = ("tracer", "name", "args", "_t0", "_depth")
 
     def __init__(self, tracer: "Tracer", name: str, args: dict):
         self.tracer = tracer
         self.name = name
         self.args = args
         self._t0 = 0
+        self._depth = 0
 
     def __enter__(self):
         tr = self.tracer
         self._t0 = time.perf_counter_ns()
         stack = tr._stack()
+        self._depth = len(stack)
         if stack:
             self.args = dict(self.args, parent=stack[-1])
         stack.append(self.name)
@@ -61,8 +63,13 @@ class _Span:
         end = time.perf_counter_ns()
         tr = self.tracer
         stack = tr._stack()
-        if stack and stack[-1] == self.name:
-            stack.pop()
+        # Unwind to the depth recorded at __enter__: an exception thrown
+        # between our __enter__ and a nested span's __exit__ leaves orphan
+        # entries above us, so "pop only if stack[-1] == self.name" would
+        # skip the pop and corrupt parent attribution for every later span
+        # on this thread.
+        if len(stack) > self._depth:
+            del stack[self._depth:]
         tr._add({"name": self.name, "ph": "X", "cat": "obs",
                  "ts": (self._t0 - tr._epoch_ns) / 1e3,
                  "dur": (end - self._t0) / 1e3,
@@ -100,7 +107,11 @@ class Tracer:
     def _add(self, event: dict) -> None:
         tid = event.get("tid")
         with self._lock:
-            if tid is not None and tid not in self._named_tids:
+            # Name the track only when the event comes from its own thread:
+            # async events may carry a foreign tid (a stage closed on behalf
+            # of the thread that ran it) and must not steal its label.
+            if (tid is not None and tid not in self._named_tids
+                    and tid == threading.get_ident()):
                 self._named_tids.add(tid)
                 self._events.append(
                     {"name": "thread_name", "ph": "M", "pid": self._pid,
@@ -126,6 +137,29 @@ class Tracer:
                    "ts": (time.perf_counter_ns() - self._epoch_ns) / 1e3,
                    "pid": self._pid, "tid": threading.get_ident(),
                    **({"args": args} if args else {})})
+
+    def async_event(self, name: str, id_: str, t0_ns: int, end_ns: int,
+                    tid: Optional[int] = None, cat: str = "request",
+                    **args) -> None:
+        """Async begin/end pair (``ph: "b"/"e"``) keyed by ``id``.
+
+        Perfetto stitches every async event sharing ``(cat, id)`` into one
+        track regardless of which thread emitted it — this is how a request
+        whose stages run on the HTTP handler, the batcher worker, and the
+        watchdog becomes a single flow. Timestamps are explicit (the same
+        ``perf_counter_ns`` clock as spans) so a stage can be recorded after
+        the fact; ``tid`` may name the thread that actually *ran* the stage
+        when the recording thread differs.
+        """
+        if not self.enabled:
+            return
+        tid = threading.get_ident() if tid is None else tid
+        base = {"cat": cat, "id": id_, "pid": self._pid, "tid": tid}
+        self._add({**base, "name": name, "ph": "b",
+                   "ts": (t0_ns - self._epoch_ns) / 1e3,
+                   **({"args": args} if args else {})})
+        self._add({**base, "name": name, "ph": "e",
+                   "ts": (end_ns - self._epoch_ns) / 1e3})
 
     @property
     def events(self) -> List[dict]:

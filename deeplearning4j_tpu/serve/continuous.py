@@ -79,11 +79,11 @@ class _GenRequest:
 
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "eos_id",
                  "deadline", "enq_t", "event", "result", "error", "out",
-                 "key", "slot", "_cv")
+                 "key", "slot", "ctx", "on_done", "_cv")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
                  top_k: Optional[int], eos_id: Optional[int],
-                 deadline: Optional[float]):
+                 deadline: Optional[float], ctx=None):
         self.prompt = prompt
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -97,6 +97,12 @@ class _GenRequest:
         self.out: List[int] = []
         self.key = None       # per-request PRNG key, set at admission
         self.slot: Optional[int] = None
+        # request-trace context (obs/reqtrace); None whenever tracing is
+        # uninstalled — every consumer guards on that
+        self.ctx = ctx
+        # completion hook (fleet SLO burn accounting); runs once, on the
+        # thread that finished the request
+        self.on_done = None
         self._cv = threading.Condition()
 
     # --- token-at-a-time surface (SSE streaming rides on this) ---
@@ -114,9 +120,32 @@ class _GenRequest:
             self.error = error
         else:
             self.result = np.asarray(self.out, np.int32)
+        if self.ctx is not None:
+            # closes the decode stage; an error shed records its stage from
+            # THIS thread (decode loop, watchdog, or shutdown caller), so
+            # the thread that killed the request shows up in its flow
+            self.ctx.finish_work(
+                error=None if error is None else error.cause,
+                tokens=len(self.out))
         self.event.set()
         with self._cv:
             self._cv.notify_all()
+        cb = self.on_done
+        if cb is not None:
+            self.on_done = None
+            try:
+                cb(self)
+            except Exception:  # an accounting hook must never kill the decode loop  # jaxlint: disable=broad-except
+                pass
+
+    def set_on_done(self, cb) -> None:
+        """Attach the completion hook race-free: a request that already
+        finished (tiny prompt, instant EOS) fires ``cb`` immediately."""
+        with self._cv:
+            if not self.event.is_set():
+                self.on_done = cb
+                return
+        cb(self)
 
     def stream(self) -> Iterator[int]:
         """Yield tokens as they are decoded; returns when the request
@@ -572,7 +601,7 @@ class ContinuousBatcher:
 
     def submit(self, prompt, max_new_tokens: int, *, temperature: float = 1.0,
                top_k: Optional[int] = None, eos_id: Optional[int] = None,
-               timeout_ms: Optional[float] = None) -> _GenRequest:
+               timeout_ms: Optional[float] = None, ctx=None) -> _GenRequest:
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.shape[0] == 0:
             raise ValueError("submit() takes one non-empty 1-D token prompt")
@@ -595,7 +624,7 @@ class ContinuousBatcher:
         deadline = (time.perf_counter() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
         req = _GenRequest(prompt, max_new_tokens, temperature, top_k,
-                          eos_id, deadline)
+                          eos_id, deadline, ctx=ctx)
         with self._cond:
             if self._closing:
                 self._shed_counter("shutting_down").inc()
@@ -622,7 +651,8 @@ class ContinuousBatcher:
     def generate(self, prompt, max_new_tokens: int, *,
                  temperature: float = 1.0, top_k: Optional[int] = None,
                  eos_id: Optional[int] = None,
-                 timeout_ms: Optional[float] = None) -> np.ndarray:
+                 timeout_ms: Optional[float] = None,
+                 ctx=None) -> np.ndarray:
         """Blocking generate. ``prompt``: (T,) ids -> returns (N,) ids;
         (B, T) -> (B, N), rows eos-padded to the longest. Mirrors
         ``nn.generation.generate`` (greedy chains match it exactly)."""
@@ -630,7 +660,8 @@ class ContinuousBatcher:
         if prompt.ndim == 1:
             return self.submit(prompt, max_new_tokens,
                                temperature=temperature, top_k=top_k,
-                               eos_id=eos_id, timeout_ms=timeout_ms).wait()
+                               eos_id=eos_id, timeout_ms=timeout_ms,
+                               ctx=ctx).wait()
         reqs = [self.submit(p, max_new_tokens, temperature=temperature,
                             top_k=top_k, eos_id=eos_id,
                             timeout_ms=timeout_ms) for p in prompt]
@@ -645,11 +676,13 @@ class ContinuousBatcher:
     def stream(self, prompt, max_new_tokens: int, *,
                temperature: float = 1.0, top_k: Optional[int] = None,
                eos_id: Optional[int] = None,
-               timeout_ms: Optional[float] = None) -> Iterator[int]:
+               timeout_ms: Optional[float] = None,
+               ctx=None) -> Iterator[int]:
         """Submit and yield tokens one at a time as they are decoded."""
         return self.submit(np.asarray(prompt, np.int32), max_new_tokens,
                            temperature=temperature, top_k=top_k,
-                           eos_id=eos_id, timeout_ms=timeout_ms).stream()
+                           eos_id=eos_id, timeout_ms=timeout_ms,
+                           ctx=ctx).stream()
 
     # ---------------------------------------------------------------- serving
     def _bucket(self, t: int) -> int:
@@ -753,7 +786,17 @@ class ContinuousBatcher:
             snap.params, snap.state, jnp.asarray(ids), self._pools,
             jnp.asarray(table_row), np.full((1,), off, np.int32),
             np.int32(true_len))
-        self._m_prefill_s.observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        ctx = job.req.ctx
+        if ctx is None:
+            self._m_prefill_s.observe(t1 - t0)
+        else:
+            self._m_prefill_s.observe(t1 - t0, trace_id=ctx.trace_id)
+            if off == 0:  # first chunk closes the queue-wait stage
+                ctx.add_stage("queue", int(job.req.enq_t * 1e9),
+                              int(t0 * 1e9))
+            ctx.add_stage("prefill_chunk", int(t0 * 1e9), int(t1 * 1e9),
+                          offset=off, bucket=bucket)
         self._m_pf_chunks.inc()
         job.last = last
         job.idx += 1
@@ -778,6 +821,10 @@ class ContinuousBatcher:
                 return  # aborted (forced shutdown) mid-prefill
             self._admitted += 1
             n = self._admitted
+        if req.ctx is not None:
+            # decode starts with the token-0 sample, not the first tick — a
+            # request wedged before any tick completes still shows the stage
+            req.ctx.decode_begin()
         key = jax.random.fold_in(self._base_key, n)
         key, sub = jax.random.split(key)
         tok0 = int(_np.asarray(self._sample(
@@ -818,7 +865,15 @@ class ContinuousBatcher:
         t0 = time.perf_counter()
         last, cache = self._prefill(snap.params, snap.state,
                                     jnp.asarray(ids), np.int32(tp))
-        self._m_prefill_s.observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        if req.ctx is None:
+            self._m_prefill_s.observe(t1 - t0)
+        else:
+            self._m_prefill_s.observe(t1 - t0, trace_id=req.ctx.trace_id)
+            req.ctx.add_stage("queue", int(req.enq_t * 1e9), int(t0 * 1e9))
+            req.ctx.add_stage("prefill_chunk", int(t0 * 1e9), int(t1 * 1e9),
+                              offset=0, bucket=bucket)
+            req.ctx.decode_begin()
         self._admitted += 1
         key = jax.random.fold_in(self._base_key, self._admitted)
         key, sub = jax.random.split(key)
@@ -921,9 +976,11 @@ class ContinuousBatcher:
             self._caches = caches
         nxt_np = np.asarray(nxt)
         keys_np = np.asarray(new_keys, np.uint32)
-        self._m_decode_s.observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._m_decode_s.observe(t1 - t0)
         self._m_occupancy.observe(len(active) / self.slots)
         self._m_tokens.inc(len(active))
+        t0_ns = t1_ns = -1  # ns conversion done lazily: only for traced reqs
         pushes = []
         with self._cond:
             if self._epoch != epoch:
@@ -937,6 +994,10 @@ class ContinuousBatcher:
                 req = self._slot_req[s]
                 if req is None:
                     continue
+                if req.ctx is not None:
+                    if t1_ns < 0:
+                        t0_ns, t1_ns = int(t0 * 1e9), int(t1 * 1e9)
+                    req.ctx.decode_tick(t0_ns, t1_ns)
                 tok = int(nxt_np[s])
                 self._next_tok[s] = tok
                 self._pos[s] = self._pos[s] + 1

@@ -58,10 +58,11 @@ class _Request:
 
     __slots__ = ("x", "rows", "true_len", "padded_len", "shape_key", "enq_t",
                  "deadline", "event", "result", "error", "generation",
-                 "batch_seq")
+                 "batch_seq", "ctx")
 
     def __init__(self, x: np.ndarray, true_len: Optional[int],
-                 padded_len: Optional[int], deadline: Optional[float]):
+                 padded_len: Optional[int], deadline: Optional[float],
+                 ctx=None):
         self.x = x
         self.rows = x.shape[0]
         self.true_len = true_len        # pre-padding time length (or None)
@@ -74,6 +75,9 @@ class _Request:
         self.error: Optional[ServeError] = None
         self.generation: Optional[int] = None   # set by the batch that ran it
         self.batch_seq: Optional[int] = None
+        # request-trace context (obs/reqtrace) riding on the work item; None
+        # whenever tracing is uninstalled — every consumer guards on that
+        self.ctx = ctx
 
     def wait(self) -> np.ndarray:
         """Block for the outcome; raises the typed error on failure."""
@@ -273,9 +277,12 @@ class ServeEngine:
             f"sequence length {t} exceeds largest length bucket "
             f"{self.length_buckets[-1]}")
 
-    def submit(self, x, timeout_ms: Optional[float] = None) -> _Request:
+    def submit(self, x, timeout_ms: Optional[float] = None,
+               ctx=None) -> _Request:
         """Admit one request (rows must fit the largest batch bucket — use
-        :meth:`predict` for arbitrary sizes). Returns a waitable handle."""
+        :meth:`predict` for arbitrary sizes). Returns a waitable handle.
+        ``ctx`` is an optional ``obs.reqtrace.RequestContext`` that rides on
+        the work item so cross-thread stages stitch into one trace."""
         x = np.asarray(x)
         if x.ndim == 0 or x.shape[0] == 0:
             raise ValueError("request must contain at least one row")
@@ -295,7 +302,7 @@ class ServeEngine:
             timeout_ms = self.default_timeout_ms
         deadline = (time.perf_counter() + timeout_ms / 1e3
                     if timeout_ms is not None else None)
-        req = _Request(x, true_len, padded, deadline)
+        req = _Request(x, true_len, padded, deadline, ctx=ctx)
         with self._cond:
             if self._closing:
                 self._shed_counter("shutting_down").inc()
@@ -330,7 +337,8 @@ class ServeEngine:
             self._cond.notify_all()
         return req
 
-    def predict(self, x, timeout_ms: Optional[float] = None) -> np.ndarray:
+    def predict(self, x, timeout_ms: Optional[float] = None,
+                ctx=None) -> np.ndarray:
         """Blocking inference. ``x``: one example or a row batch of any
         size — oversized batches are split across bucket-sized requests (the
         seed truncated them). Raises typed :class:`~.errors.ServeError`s."""
@@ -339,8 +347,8 @@ class ServeEngine:
             x = x[None]
         cap = self.batch_buckets[-1]
         if x.shape[0] <= cap:
-            return self.submit(x, timeout_ms=timeout_ms).wait()
-        reqs = [self.submit(x[i:i + cap], timeout_ms=timeout_ms)
+            return self.submit(x, timeout_ms=timeout_ms, ctx=ctx).wait()
+        reqs = [self.submit(x[i:i + cap], timeout_ms=timeout_ms, ctx=ctx)
                 for i in range(0, x.shape[0], cap)]
         return np.concatenate([r.wait() for r in reqs])
 
@@ -406,6 +414,8 @@ class ServeEngine:
                         f"deadline exceeded after "
                         f"{(now - r.enq_t) * 1e3:.1f}ms in queue")
                     self._m_deadline.inc()
+                    if r.ctx is not None:
+                        r.ctx.finish_work(error="deadline")
                     r.event.set()
                 else:
                     live.append(r)
@@ -440,9 +450,15 @@ class ServeEngine:
                     for r in live:
                         if not r.event.is_set():
                             r.error = err
+                            if r.ctx is not None:
+                                r.ctx.finish_work(error="internal")
                             r.event.set()
                     return
-                self._m_device_s.observe(time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                self._m_device_s.observe(
+                    t1 - t0,
+                    trace_id=next((r.ctx.trace_id for r in live
+                                   if r.ctx is not None), None))
             self._m_batches.inc()
             self._m_occupancy.observe(rows / bucket)
             off = 0
@@ -457,7 +473,17 @@ class ServeEngine:
                 r.result = out
                 r.generation = snap.generation
                 r.batch_seq = seq
-                self._m_queue_s.observe(t0 - r.enq_t)
+                if r.ctx is None:
+                    self._m_queue_s.observe(t0 - r.enq_t)
+                else:
+                    self._m_queue_s.observe(t0 - r.enq_t,
+                                            trace_id=r.ctx.trace_id)
+                    # stage timestamps share the perf_counter epoch, so the
+                    # float-seconds enq_t converts exactly
+                    r.ctx.add_stage("queue", int(r.enq_t * 1e9),
+                                    int(t0 * 1e9))
+                    r.ctx.add_stage("device", int(t0 * 1e9), int(t1 * 1e9),
+                                    bucket=bucket, batch_seq=seq)
                 r.event.set()
         finally:
             # retire the batch from in-flight tracking; anything still
@@ -480,6 +506,8 @@ class ServeEngine:
                 for r in unanswered:
                     self._shed_counter("worker_stall").inc()
                     r.error = err
+                    if r.ctx is not None:
+                        r.ctx.finish_work(error="worker_stall")
                     r.event.set()
 
     def _loop(self, epoch: int) -> None:
@@ -504,6 +532,8 @@ class ServeEngine:
             if not r.event.is_set():
                 self._shed_counter(err.cause).inc()
                 r.error = err
+                if r.ctx is not None:
+                    r.ctx.finish_work(error=err.cause)
                 r.event.set()
 
     # ------------------------------------------------- watchdog + crash-only
@@ -536,6 +566,10 @@ class ServeEngine:
             if not r.event.is_set():
                 self._shed_counter("worker_stall").inc()
                 r.error = err
+                if r.ctx is not None:
+                    # recorded from the watchdog thread — deliberately: the
+                    # shed becomes part of the request's stitched flow
+                    r.ctx.finish_work(error="worker_stall")
                 r.event.set()
         # a hung thread can never run its lease finally; reclaim so
         # hot-swap drain cannot deadlock (reclaim is idempotent if the
@@ -635,6 +669,8 @@ class ServeEngine:
             if not r.event.is_set():
                 self._shed_counter("drain_timeout").inc()
                 r.error = err
+                if r.ctx is not None:
+                    r.ctx.finish_work(error="drain_timeout")
                 r.event.set()
         self.registry.release_thread(self._thread.ident)
         return False

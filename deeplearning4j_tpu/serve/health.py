@@ -26,6 +26,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+from ..obs import flight as _flight
+
 OK = "ok"
 DEGRADED = "degraded"
 FAILED = "failed"
@@ -49,10 +51,23 @@ class Health:
 
     def _set(self, cause: str, level: str) -> None:
         with self._lock:
+            prev = self._worst_locked()
             self._causes[cause] = level
             worst = self._worst_locked()
         if self._gauge is not None:
             self._gauge.set(_LEVEL[worst])
+        self._record(prev, worst, cause)
+
+    def _record(self, prev: str, worst: str, cause: str) -> None:
+        """Every transition goes into the flight recorder; the recorder
+        dumps itself the moment the process goes ``failed`` — the black box
+        is written while the evidence is still in memory."""
+        rec = _flight.ACTIVE
+        if rec is None or worst == prev:
+            return
+        rec.record_event("health", worst, cause)
+        if worst == FAILED:
+            rec.dump("health_failed")
 
     def degrade(self, cause: str) -> None:
         """Report a recoverable problem (readiness off, liveness intact).
@@ -70,10 +85,12 @@ class Health:
     def clear(self, cause: str) -> None:
         """Retract a cause (the component recovered)."""
         with self._lock:
+            prev = self._worst_locked()
             self._causes.pop(cause, None)
             worst = self._worst_locked()
         if self._gauge is not None:
             self._gauge.set(_LEVEL[worst])
+        self._record(prev, worst, cause)
 
     def _worst_locked(self) -> str:
         if not self._causes:

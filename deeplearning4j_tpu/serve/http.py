@@ -32,12 +32,15 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from typing import Optional, Sequence
 from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
 from ..chaos import faults as _faults
+from ..obs import flight as _flight
+from ..obs import reqtrace as _rt
 from ..obs.metrics import MetricsRegistry
 from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
 from .continuous import ContinuousBatcher
@@ -71,6 +74,16 @@ class ModelServer(JsonHTTPServerMixin):
     first ``/generate`` — predict-only deployments of non-token models never
     pay for it (nor hit its model-contract validation).
     """
+
+    _ROUTES = frozenset((
+        "/predict", "/generate", "/health", "/ready", "/models", "/metrics",
+        "/v1/debug/requests", "/v1/debug/flight"))
+
+    @classmethod
+    def _metric_route(cls, path: str) -> str:
+        """Collapse unknown paths to one label value — the ``endpoint``
+        label must stay bounded no matter what clients probe for."""
+        return path if path in cls._ROUTES else "other"
 
     def __init__(self, model, params=None, state=None, *,
                  host: str = "127.0.0.1", port: int = 9010,
@@ -190,9 +203,24 @@ class ModelServer(JsonHTTPServerMixin):
             def _err(self, code, body, headers=None):
                 server.metrics.counter(
                     "serve_http_errors_total",
-                    {"endpoint": urlsplit(self.path).path, "code": str(code)},
+                    {"endpoint": server._metric_route(urlsplit(self.path).path),
+                     "code": str(code)},
                     help=_HTTP_ERRORS_HELP).inc()
                 self.reply(code, body, headers=headers)
+
+            def reply(self, code, payload, ctype="application/json",
+                      headers=None):
+                # traced requests echo their identity on every answer and
+                # time the buffered write-out as the "flush" stage
+                ctx = getattr(self, "_obs_ctx", None)
+                if ctx is None:
+                    super().reply(code, payload, ctype, headers)
+                    return
+                headers = dict(headers or {})
+                headers.setdefault("X-Request-Id", ctx.request_id)
+                headers.setdefault("traceparent", ctx.traceparent())
+                with ctx.stage("flush", code=code):
+                    super().reply(code, payload, ctype, headers)
 
             def do_GET(self):
                 if self.path == "/health":
@@ -222,11 +250,33 @@ class ModelServer(JsonHTTPServerMixin):
                     if server.aot_store is not None:
                         body["aot_store"] = server.aot_store.stats()
                     self.reply(200, body)
+                elif self.path == "/v1/debug/requests":
+                    recs = (_flight.ACTIVE.requests()
+                            if _flight.ACTIVE is not None else [])
+                    self.reply(200, {"requests": recs})
+                elif self.path == "/v1/debug/flight":
+                    if _flight.ACTIVE is None:
+                        self._err(404,
+                                  {"error": "flight recorder not installed"})
+                    else:
+                        self.reply(200, _flight.ACTIVE.snapshot())
                 else:
                     self._err(404, {"error": "unknown endpoint"})
 
             def do_POST(self):
                 split = urlsplit(self.path)
+                ctx = None
+                if _rt.ACTIVE is not None:
+                    # ingress: join the caller's W3C trace (or start one),
+                    # echo X-Request-Id; a malformed traceparent yields a
+                    # fresh trace, never a failed request
+                    ctx = _rt.ACTIVE.begin(
+                        split.path.lstrip("/") or "post",
+                        traceparent=self.headers.get("traceparent"),
+                        request_id=self.headers.get("X-Request-Id"),
+                        model=type(server.model).__name__)
+                    self._obs_ctx = ctx
+                    self._obs_trace_id = ctx.trace_id
                 try:
                     if _faults.ACTIVE is not None:
                         _faults.ACTIVE.hit("http.handler")
@@ -237,6 +287,8 @@ class ModelServer(JsonHTTPServerMixin):
                         self._generate(req, parse_qs(split.query))
                     else:
                         self._err(404, {"error": "unknown endpoint"})
+                        if ctx is not None:
+                            ctx.finish(error="bad_request")
                 except ServeError as e:
                     headers = None
                     if e.http_status == 503:
@@ -247,26 +299,41 @@ class ModelServer(JsonHTTPServerMixin):
                     self._err(e.http_status,
                               {"error": str(e), "cause": e.cause},
                               headers=headers)
+                    if ctx is not None:
+                        ctx.finish(error=e.cause)
                 except _BAD_REQUEST as e:
                     self._err(400, {"error": str(e)})
+                    if ctx is not None:
+                        ctx.finish(error="bad_request")
                 except Exception as e:  # server must answer every request  # jaxlint: disable=broad-except
                     # unexpected == a bug: keep the full traceback (the
                     # client only sees the summary) and make 5xx bursts
                     # visible on /metrics
                     log.exception("unhandled error serving %s", self.path)
                     self._err(500, {"error": f"{type(e).__name__}: {e}"})
+                    if ctx is not None:
+                        ctx.finish(error="internal")
+                finally:
+                    if ctx is not None:
+                        ctx.finish()  # idempotent: no-op after an error path
 
             def _predict(self, req):
+                ctx = getattr(self, "_obs_ctx", None)
                 x = np.asarray(req["ndarray"], server.input_dtype)
                 handle = None
                 if x.ndim > len(server.model.input_shape) \
                         and x.shape[0] <= server.engine.batch_buckets[-1]:
-                    handle = server.engine.submit(
-                        x, timeout_ms=req.get("timeout_ms"))
+                    if ctx is None:
+                        handle = server.engine.submit(
+                            x, timeout_ms=req.get("timeout_ms"))
+                    else:
+                        with ctx.stage("admit"):
+                            handle = server.engine.submit(
+                                x, timeout_ms=req.get("timeout_ms"), ctx=ctx)
                     y = handle.wait()
                 else:
                     y = server.engine.predict(
-                        x, timeout_ms=req.get("timeout_ms"))
+                        x, timeout_ms=req.get("timeout_ms"), ctx=ctx)
                 body = {"output": np.asarray(y).tolist()}
                 if handle is not None and handle.generation is not None:
                     body["generation"] = handle.generation
@@ -278,6 +345,7 @@ class ModelServer(JsonHTTPServerMixin):
                 self.wfile.flush()  # one event per decoded token
 
             def _generate(self, req, query):
+                ctx = getattr(self, "_obs_ctx", None)
                 prompt = np.asarray(req["prompt"], np.int32)
                 kwargs = dict(
                     temperature=float(req.get("temperature", 1.0)),
@@ -291,20 +359,31 @@ class ModelServer(JsonHTTPServerMixin):
                 if prompt.ndim != 1:  # batch prompts are always buffered
                     stream = False
                 if not stream:
-                    toks = server.batcher().generate(prompt, mnt, **kwargs)
+                    toks = server.batcher().generate(prompt, mnt, ctx=ctx,
+                                                     **kwargs)
                     self.reply(200, {"tokens": np.asarray(toks).tolist()})
                     return
                 # submit BEFORE the stream starts: admission failures
                 # (shed/closing/capacity/deadline) surface as typed status
                 # codes via do_POST; after headers, errors go in-band
-                handle = server.batcher().submit(prompt, mnt, **kwargs)
+                if ctx is None:
+                    handle = server.batcher().submit(prompt, mnt, **kwargs)
+                else:
+                    with ctx.stage("admit"):
+                        handle = server.batcher().submit(prompt, mnt,
+                                                         ctx=ctx, **kwargs)
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                if ctx is not None:
+                    self.send_header("X-Request-Id", ctx.request_id)
+                    self.send_header("traceparent", ctx.traceparent())
                 self.end_headers()
                 self.close_connection = True
+                t0f = time.perf_counter_ns() if ctx is not None else 0
                 out = []
+                err_cause = None
                 try:
                     for tok in handle.stream():
                         out.append(int(tok))
@@ -314,6 +393,13 @@ class ModelServer(JsonHTTPServerMixin):
                     # mid-stream failure: partial output + the typed cause
                     self._sse({"error": str(e), "cause": e.cause,
                                "tokens": out})
+                    err_cause = e.cause
+                if ctx is not None:
+                    # the streaming window: first header flush to last event
+                    ctx.add_stage("flush", t0f, time.perf_counter_ns(),
+                                  tokens=len(out))
+                    if err_cause is not None:
+                        ctx.finish(error=err_cause)
 
         return Handler
 

@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, Optional, Tuple
 
+from ..obs import flight as _flight
+
 log = logging.getLogger(__name__)
 
 _STALLS_HELP = "worker stalls detected (missed heartbeat or dead thread)"
@@ -126,6 +128,12 @@ class Watchdog:
             self._health.degrade(f"watchdog:{name}")
         log.warning("watchdog: %s stalled (%s) — crash-only restart %d/%d",
                     name, why, n, self._max_restarts)
+        if _flight.ACTIVE is not None:
+            # dump BEFORE the restart sheds in-flight work: the black box
+            # captures the wedged state, not the cleaned-up aftermath
+            _flight.ACTIVE.record_event("watchdog", "stall", why,
+                                        component=name, restart=n)
+            _flight.ACTIVE.dump("watchdog_restart")
         try:
             restarted = bool(comp.restart_worker(reason=why))
         except Exception:  # restart failing must not kill the watchdog  # jaxlint: disable=broad-except

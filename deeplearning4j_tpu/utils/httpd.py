@@ -20,6 +20,7 @@ from typing import Optional
 from urllib.parse import urlsplit
 
 PROMETHEUS_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CTYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
 def _instrumented(fn, verb: str):
@@ -39,7 +40,12 @@ def _instrumented(fn, verb: str):
             return fn(self)
         path = urlsplit(self.path).path
         if verb == "GET" and path == "/metrics":
-            self.reply(200, reg.to_prometheus(), PROMETHEUS_CTYPE)
+            # content negotiation: OpenMetrics (exemplar-capable) on request,
+            # classic 0.0.4 text otherwise — exemplars are illegal in 0.0.4
+            if "application/openmetrics-text" in self.headers.get("Accept", ""):
+                self.reply(200, reg.to_openmetrics(), OPENMETRICS_CTYPE)
+            else:
+                self.reply(200, reg.to_prometheus(), PROMETHEUS_CTYPE)
             return None
         route = getattr(self.owner, "_metric_route", None)
         endpoint = route(path) if route is not None else path
@@ -48,9 +54,13 @@ def _instrumented(fn, verb: str):
         try:
             return fn(self)
         finally:
+            # handlers that trace requests leave their trace_id on the
+            # handler instance; it becomes the latency exemplar
             reg.histogram("http_request_seconds", labels,
                           help="HTTP request handling latency by endpoint"
-                          ).observe(time.perf_counter() - t0)
+                          ).observe(time.perf_counter() - t0,
+                                    trace_id=getattr(self, "_obs_trace_id",
+                                                     None))
             reg.counter("http_requests_total", labels,
                         help="HTTP requests served by endpoint").inc()
 

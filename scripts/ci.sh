@@ -52,6 +52,11 @@ CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_serve.py
 echo "=== smoke chaos: seeded fault scenario, self-healing fleet ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_chaos.py
 
+# every scrape artifact the smokes wrote must be an exposition a real
+# Prometheus would accept — promcheck is the gate, not just a warning
+echo "=== promcheck: validate every scraped .prom artifact ==="
+python -m deeplearning4j_tpu.obs.promcheck "$CI_ARTIFACTS_DIR"/*.prom
+
 echo "=== tier-1 tests ==="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -22,10 +22,18 @@ strings):
   transfer attempts; after ``reset_s`` the half-open probe succeeds and
   the breaker closes.
 
+The run is fully traced (ISSUE 9): a request tracer + flight recorder are
+installed, so phase C's faulted generation must reconstruct its whole life
+— admit -> queue -> prefill -> decode -> shed -> flush — from the flight
+dump the scenario triggers, and its trace id must stitch across >= 3
+distinct threads in the Perfetto export.
+
 Final assertions: health is ``ok``, readiness is back, every error along
 the way was typed (no bare 500s), the watchdog/retry/breaker counters all
-moved, and no worker thread is left hanging. Artifact:
-$CI_ARTIFACTS_DIR/smoke_chaos_metrics.prom (the final /metrics scrape).
+moved, and no worker thread is left hanging. Artifacts:
+$CI_ARTIFACTS_DIR/smoke_chaos_metrics.prom (the final /metrics scrape,
+validated by obs.promcheck), smoke_chaos_trace.json (Perfetto), and the
+flight_NN.json dumps the watchdog/breaker triggers wrote.
 """
 
 import json
@@ -63,14 +71,14 @@ def _get(port, path):
 
 
 def _typed_503(port, path, body):
-    """POST expecting a typed 503; returns (cause, retry_after_header)."""
+    """POST expecting a typed 503; returns (cause, retry_after, headers)."""
     try:
         _post(port, path, body)
     except urllib.error.HTTPError as e:
         assert e.code == 503, f"expected 503 from {path}, got {e.code}"
         payload = json.loads(e.read())
         assert "cause" in payload, f"untyped 503 from {path}: {payload}"
-        return payload["cause"], e.headers.get("Retry-After")
+        return payload["cause"], e.headers.get("Retry-After"), e.headers
     raise AssertionError(f"{path} unexpectedly succeeded")
 
 
@@ -114,6 +122,13 @@ def main():
     from deeplearning4j_tpu.models import CausalLM
     from deeplearning4j_tpu.nn.layers import Dense, Output
     from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+    from deeplearning4j_tpu.obs import flight as flight_mod
+    from deeplearning4j_tpu.obs import reqtrace as reqtrace_mod
+    from deeplearning4j_tpu.obs.flight import FlightRecorder
+    from deeplearning4j_tpu.obs.promcheck import check_text
+    from deeplearning4j_tpu.obs.reqtrace import (RequestTracer,
+                                                 parse_traceparent)
+    from deeplearning4j_tpu.obs.trace import Tracer
 
     dense = Sequential(NetConfig(seed=0),
                        [Dense(n_out=6, activation="tanh"),
@@ -136,6 +151,12 @@ def main():
     fleet.pager.budget_bytes = (max(d.weight_bytes, g.weight_bytes)
                                 + min(d.weight_bytes, g.weight_bytes) // 2)
     assert d.weight_bytes + g.weight_bytes > fleet.pager.budget_bytes
+
+    # full observability: request tracing + a black-box flight recorder
+    # dumping into the CI artifact dir on watchdog/breaker triggers
+    tracer = Tracer()
+    recorder = flight_mod.install(FlightRecorder(out_dir=artifacts))
+    reqtrace_mod.install(RequestTracer(tracer=tracer, flight=recorder))
 
     srv = FleetServer(fleet, port=0).start()
     port = srv.port
@@ -170,10 +191,13 @@ def main():
         print("=== phase C: hung decode tick (watchdog restart) ===")
         fp.inject_spec("serve.decode_step:hang:hang_s=8,times=1")
         t0 = time.monotonic()
-        cause, _ = _typed_503(port, "/v1/models/g/generate?stream=false",
-                              gen_body)
+        cause, _, hdrs = _typed_503(
+            port, "/v1/models/g/generate?stream=false", gen_body)
         assert cause == "worker_stall", f"expected worker_stall, got {cause}"
         assert time.monotonic() - t0 < 6.0, "stall shed was not prompt"
+        parsed = parse_traceparent(hdrs.get("traceparent"))
+        assert parsed is not None, "shed response carried no traceparent"
+        faulted_trace = parsed[0]
         _wait_ready(port)  # watchdog restarted the batcher, health cleared
         toks = _post(port, "/v1/models/g/generate?stream=false",
                      gen_body)["tokens"]
@@ -184,12 +208,12 @@ def main():
         fp.inject_spec(
             f"fleet.page_in_transfer:error:type=os,times={3 * 2}")
         for _ in range(BREAKER_FAILURES):
-            cause, _ = _typed_503(port, "/v1/models/d/predict",
-                                  {"ndarray": X})
+            cause, _, _ = _typed_503(port, "/v1/models/d/predict",
+                                     {"ndarray": X})
             assert cause == "page_in_failed", cause
         transfers = fp.hits("fleet.page_in_transfer")
-        cause, retry_after = _typed_503(port, "/v1/models/d/predict",
-                                        {"ndarray": X})
+        cause, retry_after, _ = _typed_503(port, "/v1/models/d/predict",
+                                           {"ndarray": X})
         assert cause == "breaker_open", cause
         assert retry_after is not None and int(retry_after) >= 1
         assert fp.hits("fleet.page_in_transfer") == transfers, \
@@ -221,10 +245,61 @@ def main():
         assert _metric(scrape, "serve_http_errors_total", code="503") >= 4
         assert _metric(scrape, "serve_aot_fallback_total") >= 1
         assert _metric(scrape, "serve_health_state", component="fleet") == 0
+
+        # ---- the scrape artifact must survive the exposition validator
+        errors = check_text(scrape, openmetrics=False)
+        assert not errors, f"invalid /metrics exposition: {errors[:5]}"
+        om = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics",
+            headers={"Accept": "application/openmetrics-text"}),
+            timeout=30).read().decode()
+        with open(os.path.join(artifacts,
+                               "smoke_chaos_metrics_om.prom"), "w") as f:
+            f.write(om)
+        errors = check_text(om)
+        assert not errors, f"invalid OpenMetrics exposition: {errors[:5]}"
+        assert '# {trace_id="' in om, "no exemplars in OpenMetrics scrape"
+
+        # ---- black box: the dumps the scenario triggered reconstruct the
+        # faulted request's whole life
+        dump_paths = recorder.dumps
+        assert dump_paths, "seeded scenario produced no flight dump"
+        reasons = set()
+        faulted_rec = None
+        for p in dump_paths:
+            with open(p) as f:
+                body = json.load(f)
+            reasons.add(body["reason"])
+            for rec in body["requests"]:
+                if rec["trace_id"] == faulted_trace:
+                    faulted_rec = rec
+        assert "watchdog_restart" in reasons, reasons
+        assert "breaker_open" in reasons, reasons
+        assert faulted_rec is not None, \
+            "faulted request's record missing from every flight dump"
+        assert faulted_rec["status"] == "error" \
+            and faulted_rec["error"] == "worker_stall"
+        stage_names = [s["name"] for s in faulted_rec["stages"]]
+        for want in ("admit", "queue", "prefill_chunk", "decode", "shed",
+                     "flush"):
+            assert want in stage_names, (want, stage_names)
+
+        # ---- Perfetto: one trace id, stitched across >= 3 threads
+        trace_path = os.path.join(artifacts, "smoke_chaos_trace.json")
+        tracer.export(trace_path)
+        tids = {e["tid"] for e in tracer.events
+                if e.get("id") == faulted_trace}
+        assert len(tids) >= 3, \
+            f"faulted trace crossed only {len(tids)} threads: {tids}"
+        print(f"flight dumps: {sorted(reasons)}; faulted request "
+              f"{faulted_rec['request_id']} reconstructed across "
+              f"{len(tids)} threads")
         print("final fault-plane stats:", json.dumps(fp.stats()["injected"]))
     finally:
         uninstall()  # release any parked hang before joining workers
         srv.stop()
+        reqtrace_mod.uninstall()
+        flight_mod.uninstall()
 
     # no worker left wedged: everything the scenario stalled was either
     # restarted (and drained by stop()) or released by uninstall()

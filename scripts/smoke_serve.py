@@ -274,7 +274,14 @@ def main() -> int:
 
     from deeplearning4j_tpu.models import CausalLM
     from deeplearning4j_tpu.nn.generation import generate
+    from deeplearning4j_tpu.obs import reqtrace as reqtrace_mod
+    from deeplearning4j_tpu.obs.reqtrace import RequestTracer
     from deeplearning4j_tpu.serve import ModelServer
+
+    # request tracing on for the whole run: every histogram observation in
+    # the serving path carries its request's trace_id, so the OpenMetrics
+    # artifact below must come out exemplar-bearing
+    reqtrace_mod.install(RequestTracer())
 
     model = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=32,
                      num_heads=4, vocab=50).build()
@@ -342,6 +349,16 @@ def main() -> int:
         prom_path = os.path.join(out_dir, "smoke_serve_metrics.prom")
         with open(prom_path, "w") as f:
             f.write(scrape)
+        # OpenMetrics negotiation: same registry, exemplar-bearing syntax
+        om = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/metrics",
+            headers={"Accept": "application/openmetrics-text"}),
+            timeout=10).read().decode()
+        assert om.rstrip("\n").endswith("# EOF"), "OM scrape not terminated"
+        assert '# {trace_id="' in om, "no exemplars in OpenMetrics scrape"
+        with open(os.path.join(out_dir, "smoke_serve_metrics_om.prom"),
+                  "w") as f:
+            f.write(om)
         print(f"smoke_serve: {PREDICTS} predicts + {GENERATES} generates "
               f"+ SSE + overcommit burst ({pool_blocks}-block pool), "
               f"{n_eng} engine compile(s), {n_gen} generate compile(s), "
@@ -360,6 +377,20 @@ def main() -> int:
     page_ins, quota_sheds = _fleet_scenario(out_dir)
     print(f"smoke_serve: fleet scenario OK — {page_ins} page-ins under "
           f"load, {quota_sheds} quota shed(s) with Retry-After")
+
+    reqtrace_mod.uninstall()
+
+    # every scrape artifact this run wrote must survive the exposition
+    # validator — a scrape Prometheus would reject is worse than none
+    import glob
+
+    from deeplearning4j_tpu.obs.promcheck import check_file
+
+    paths = sorted(glob.glob(os.path.join(out_dir, "smoke_serve*.prom")))
+    assert paths, "no scrape artifacts written"
+    bad = {p: check_file(p)[:3] for p in paths if check_file(p)}
+    assert not bad, f"invalid scrape artifacts: {bad}"
+    print(f"smoke_serve: promcheck OK over {len(paths)} scrape artifact(s)")
     return 0
 
 

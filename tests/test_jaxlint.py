@@ -989,3 +989,62 @@ class TestDataflow:
         rd = ReachingDefs(fn)
         ((_, defs),) = rd.uses_of("a")
         assert defs == frozenset({1})
+
+
+# ----------------------------------------------- metric-label-cardinality
+class TestMetricLabelCardinality:
+    def test_fstring_of_request_path_flagged(self):
+        fs = lint("""
+            def handle(metrics, self):
+                metrics.counter("http_requests_total",
+                                {"endpoint": f"{self.path}"}).inc()
+            """, "metric-label-cardinality")
+        assert names(fs) == ["metric-label-cardinality"]
+
+    def test_str_of_id_and_bare_attribute_flagged(self):
+        fs = lint("""
+            def handle(metrics, req):
+                metrics.gauge("inflight", {"req": str(req.request_id)}).set(1)
+                metrics.histogram("latency_seconds",
+                                  {"trace": req.trace_id}).observe(0.1)
+            """, "metric-label-cardinality")
+        assert names(fs) == ["metric-label-cardinality"] * 2
+
+    def test_labels_dict_passed_by_name_resolved(self):
+        fs = lint("""
+            def handle(metrics, verb, path):
+                labels = {"method": verb, "endpoint": path}
+                metrics.counter("http_requests_total", labels).inc()
+            """, "metric-label-cardinality")
+        assert names(fs) == ["metric-label-cardinality"]
+
+    def test_bounded_mapper_and_enum_labels_not_flagged(self):
+        fs = lint("""
+            def handle(metrics, server, path, code, tenant):
+                # a collapsing helper is the sanctioned fix: its output is
+                # assumed bounded even though its *input* is the raw path
+                metrics.counter("http_requests_total",
+                                {"endpoint": server._metric_route(path),
+                                 "code": str(code),
+                                 "tenant": tenant}).inc()
+            """, "metric-label-cardinality")
+        assert fs == []
+
+    def test_numpy_histogram_lookalike_not_flagged(self):
+        fs = lint("""
+            import numpy as np
+
+            def stats(data, request_id):
+                counts, edges = np.histogram(data, bins=16)
+                return counts
+            """, "metric-label-cardinality")
+        assert fs == []
+
+    def test_suppression_comment_honored(self):
+        fs = lint("""
+            def skew(reg, sh):
+                reg.gauge("replica_step_seconds",
+                          # jaxlint: disable-next=metric-label-cardinality
+                          {"replica": str(sh.device.id)}).set(0.0)
+            """, "metric-label-cardinality")
+        assert fs == []
