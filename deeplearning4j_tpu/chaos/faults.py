@@ -17,6 +17,12 @@ never corrupt donated buffers:
 - ``serve.decode_step``     — top of the continuous batcher's decode tick
 - ``serve.dispatch``        — top of the engine's batch dispatch
 - ``http.handler``          — front-door POST handlers (serve and fleet)
+- ``cluster.transport``     — the cluster router's per-replica proxy hop
+
+Multi-instance seams (one router talking to N in-process replicas) can be
+targeted individually: a site passes ``scope="replica-0"`` to :meth:`hit`
+and a spec armed with ``scope=replica-0`` fires only there, so the cluster
+smoke can partition exactly one replica while the other keeps serving.
 
 A fired fault **raises** a configured exception, **corrupts** one byte of
 the data flowing through the seam, **delays**, or **hangs** (bounded, and
@@ -48,6 +54,7 @@ POINTS = (
     "serve.decode_step",
     "serve.dispatch",
     "http.handler",
+    "cluster.transport",
 )
 
 #: The installed plane, or None (the zero-overhead default). Injection
@@ -70,13 +77,14 @@ class _Spec:
     """One armed fault: where, what, and how many times."""
 
     __slots__ = ("point", "mode", "error", "delay_s", "hang_s", "skip",
-                 "remaining", "prob", "fired")
+                 "remaining", "prob", "fired", "scope")
 
     def __init__(self, point: str, mode: str, *, error=None, delay_s=0.0,
                  hang_s=0.0, after: int = 0, times: int = 1,
-                 prob: float = 1.0):
+                 prob: float = 1.0, scope: Optional[str] = None):
         self.point = point
         self.mode = mode
+        self.scope = scope
         self.error = error
         self.delay_s = float(delay_s)
         self.hang_s = float(hang_s)
@@ -111,6 +119,8 @@ def parse_spec(text: str) -> Tuple[str, dict]:
         "after": int(opts.pop("after", 0)),
         "prob": float(opts.pop("prob", 1.0)),
     }
+    if "scope" in opts:
+        kw["scope"] = opts.pop("scope")
     if mode == "error":
         name = opts.pop("type", "runtime")
         if name not in _ERROR_TYPES:
@@ -150,14 +160,17 @@ class FaultPlane:
     def inject(self, point: str, *, error=None, corrupt: bool = False,
                delay_s: Optional[float] = None,
                hang_s: Optional[float] = None, times: int = 1,
-               after: int = 0, prob: float = 1.0) -> "FaultPlane":
+               after: int = 0, prob: float = 1.0,
+               scope: Optional[str] = None) -> "FaultPlane":
         """Arm one fault at ``point``. Exactly one of ``error`` (exception
         type or instance to raise), ``corrupt`` (flip one seeded byte of
         the data at the seam), ``delay_s``, or ``hang_s`` (bounded hang,
         released early by :meth:`release`). The fault skips its first
         ``after`` qualifying hits, then fires ``times`` times
         (``times=-1``: every hit); ``prob`` gates each firing on the
-        plane's seeded RNG. Returns self for chaining."""
+        plane's seeded RNG. ``scope`` narrows the fault to hits that pass
+        the same scope (e.g. one replica id); ``None`` matches every hit.
+        Returns self for chaining."""
         chosen = [m for m, on in (("error", error is not None),
                                   ("corrupt", corrupt),
                                   ("delay", delay_s is not None),
@@ -169,7 +182,7 @@ class FaultPlane:
             raise ValueError("times must be positive or -1 (unbounded)")
         spec = _Spec(point, chosen[0], error=error, delay_s=delay_s or 0.0,
                      hang_s=hang_s or 0.0, after=after, times=times,
-                     prob=prob)
+                     prob=prob, scope=scope)
         with self._lock:
             self._specs.append(spec)
         return self
@@ -180,17 +193,22 @@ class FaultPlane:
         return self.inject(point, **kw)
 
     # ------------------------------------------------------------------ fire
-    def hit(self, point: str, data: Optional[bytes] = None):
+    def hit(self, point: str, data: Optional[bytes] = None,
+            scope: Optional[str] = None):
         """One hit on an injection point. Fires the first armed, matching
         spec (raise / delay / hang / corrupt-and-return); passes ``data``
         through untouched otherwise. Sites that move bytes pass them in
-        and use the return value; control-flow sites ignore it."""
+        and use the return value; control-flow sites ignore it. A site at
+        a multi-instance seam passes its instance id as ``scope``;
+        scoped specs only fire on a matching scope."""
         spec = None
         idx = 0
         with self._lock:
             self._hit_counts[point] = self._hit_counts.get(point, 0) + 1
             for s in self._specs:
                 if s.point != point or s.remaining == 0:
+                    continue
+                if s.scope is not None and s.scope != scope:
                     continue
                 if s.skip > 0:
                     s.skip -= 1
@@ -264,7 +282,8 @@ class FaultPlane:
                 "injected": {f"{p}:{m}": n
                              for (p, m), n in sorted(self._injected.items())},
                 "armed": [{"point": s.point, "mode": s.mode,
-                           "remaining": s.remaining, "fired": s.fired}
+                           "remaining": s.remaining, "fired": s.fired,
+                           "scope": s.scope}
                           for s in self._specs],
             }
 

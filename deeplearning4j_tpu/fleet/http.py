@@ -11,6 +11,11 @@ One listener multiplexes every model in the fleet:
 - ``GET /v1/models`` (names + residency) · ``GET /v1/models/{name}``
   (one entry) · ``GET /v1/fleet`` (models + pager + tenants + AOT store)
   · ``GET /health`` · ``GET /ready`` · ``GET /metrics``.
+- ``GET /v1/replica`` — the cluster heartbeat self-report (identity,
+  residency, HBM budget, queue depth); ``POST /v1/admin/drain``
+  ``{"model": name}`` pages a model out on router demotion.
+- ``/v1/debug/chaos`` (GET echo / POST install-or-uninstall fault specs)
+  when constructed with ``chaos_admin=True`` — 404 otherwise.
 
 The tenant rides the ``X-Tenant`` header (default ``"anonymous"``, which
 gets the table's default policy — the front door never 500s on a new
@@ -40,7 +45,8 @@ from ..chaos import faults as _faults
 from ..obs import flight as _flight
 from ..obs import reqtrace as _rt
 from ..serve.errors import ServeError
-from ..serve.http import retry_after_s
+from ..serve.http import (chaos_apply, chaos_status, jitter_retry_after,
+                          retry_after_s)
 from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
 from .registry import FleetRegistry
 from .tenants import QuotaError
@@ -57,10 +63,18 @@ class FleetServer(JsonHTTPServerMixin):
     """Serve a whole :class:`FleetRegistry` over one HTTP listener."""
 
     def __init__(self, fleet: FleetRegistry, *, host: str = "127.0.0.1",
-                 port: int = 9020):
+                 port: int = 9020, replica_id: Optional[str] = None,
+                 chaos_admin: bool = False):
         self.fleet = fleet
         self.host = host
         self.port = port
+        # cluster identity: who this process is in a replica set. The id
+        # rides on every /v1/replica heartbeat answer so the router's
+        # membership table and placement speak one namespace.
+        self.replica_id = replica_id
+        # debug-only surface: /v1/debug/chaos answers 404 unless opted in,
+        # so a production front door never exposes fault injection
+        self.chaos_admin = bool(chaos_admin)
         self.metrics = fleet.metrics  # httpd scaffolding serves /metrics
         self._lifecycle_lock = threading.Lock()
         self._accepting = True
@@ -74,6 +88,22 @@ class FleetServer(JsonHTTPServerMixin):
         # breaker open, watchdog restart in progress — but a degraded
         # server still ANSWERS requests: accepting() gates the handlers
         return self.accepting() and self.fleet.health.ok()
+
+    def beat(self) -> dict:
+        """One cluster-heartbeat self-report: identity, readiness, model
+        residency, HBM budget, and queued load. The router's membership
+        table polls this (``GET /v1/replica``) and feeds placement."""
+        pager = self.fleet.pager.stats()
+        return {
+            "replica": self.replica_id,
+            "accepting": self.accepting(),
+            "ready": self.ready(),
+            "models": {n: self.fleet.get(n).info()
+                       for n in self.fleet.names()},
+            "hbm_budget_bytes": pager.get("budget_bytes"),
+            "resident_bytes": pager.get("resident_bytes"),
+            "queue_depth": self.fleet.queue_depth(),
+        }
 
     def _metric_route(self, path: str) -> str:
         m = _MODEL_ROUTE.match(path)
@@ -152,6 +182,10 @@ class FleetServer(JsonHTTPServerMixin):
                         self._err(503, {
                             "status": "not_ready",
                             "health": server.fleet.health.snapshot()})
+                elif path == "/v1/replica":
+                    self.reply(200, server.beat())
+                elif path == "/v1/debug/chaos" and server.chaos_admin:
+                    self.reply(200, chaos_status())
                 elif path == "/v1/fleet":
                     self.reply(200, server.fleet.status())
                 elif path == "/v1/models":
@@ -197,6 +231,21 @@ class FleetServer(JsonHTTPServerMixin):
                     self._obs_ctx = ctx
                     self._obs_trace_id = ctx.trace_id
                 try:
+                    if path == "/v1/debug/chaos" and server.chaos_admin:
+                        # admin surface stays usable even with a fault
+                        # armed at http.handler — it is how you disarm one
+                        self.reply(200, chaos_apply(self.read_json()))
+                        return
+                    if path == "/v1/admin/drain":
+                        # demotion from the router: page the model out
+                        # (lease-drained) so its weights stop holding HBM
+                        # on a replica the placement no longer targets
+                        req = self.read_json()
+                        entry = server.fleet.get(req["model"])
+                        server.fleet.pager.drop(entry)
+                        self.reply(200, {"model": entry.name,
+                                         "resident": entry.resident()})
+                        return
                     if _faults.ACTIVE is not None:
                         _faults.ACTIVE.hit("http.handler")
                     if not server.accepting():
@@ -217,17 +266,19 @@ class FleetServer(JsonHTTPServerMixin):
                               {"error": str(e), "cause": e.cause,
                                "tenant": self._tenant()},
                               headers={"Retry-After":
-                                       max(1, int(e.retry_after_s + 0.999))})
+                                       jitter_retry_after(e.retry_after_s)})
                     if ctx is not None:
                         ctx.finish(error=e.cause)
                 except ServeError as e:
                     headers = None
                     if e.http_status == 503:
-                        # breaker/page-in errors know their own back-off;
-                        # queue sheds fall back to the depth-derived estimate
+                        # breaker/page-in errors know their own back-off
+                        # (jittered so refused clients don't re-arrive in
+                        # one synchronized wave); queue sheds fall back to
+                        # the depth-derived estimate
                         retry = getattr(e, "retry_after_s", None)
                         headers = {"Retry-After":
-                                   max(1, int(retry + 0.999))
+                                   jitter_retry_after(retry)
                                    if retry is not None
                                    else server._retry_after(name)}
                     self._err(e.http_status,
@@ -239,6 +290,15 @@ class FleetServer(JsonHTTPServerMixin):
                     self._err(400, {"error": str(e)})
                     if ctx is not None:
                         ctx.finish(error="bad_request")
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client hung up while we were answering: nothing
+                    # left to write to, and a vanished reader is shed load,
+                    # not a server error
+                    server.metrics.counter(
+                        "serve_shed_total", {"cause": "client_gone"},
+                        help="requests refused at admission, by cause").inc()
+                    if ctx is not None:
+                        ctx.finish(error="client_gone")
                 except Exception as e:  # front door answers every request  # jaxlint: disable=broad-except
                     log.exception("unhandled error serving %s", self.path)
                     self._err(500, {"error": f"{type(e).__name__}: {e}"})
@@ -306,9 +366,18 @@ class FleetServer(JsonHTTPServerMixin):
                         self._sse({"token": int(tok)})
                     self._sse({"done": True, "tokens": out, "model": name})
                 except ServeError as e:
-                    self._sse({"error": str(e), "cause": e.cause,
-                               "tokens": out})
+                    try:
+                        self._sse({"error": str(e), "cause": e.cause,
+                                   "tokens": out})
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass  # nobody left to tell
                     err_cause = e.cause
+                except (BrokenPipeError, ConnectionResetError):
+                    # client dropped the socket mid-stream: free the decode
+                    # slot and KV pages NOW (the cancel path counts the shed
+                    # as cause="client_gone") instead of decoding to nobody
+                    server.fleet.cancel_generate(name, handle)
+                    err_cause = "client_gone"
                 if ctx is not None:
                     # the streaming window: first header flush to last event
                     ctx.add_stage("flush", t0f, time.perf_counter_ns(),

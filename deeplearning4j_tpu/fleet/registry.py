@@ -54,7 +54,9 @@ _BREAKER_CAUSES = frozenset({"internal", "page_in_failed", "worker_stall",
 # ServeError causes that do not consume error budget: the *client* (or its
 # quota) failed, not our serving path. Everything else after admission —
 # deadline misses included — is a bad event for the tenant's SLO class.
-_SLO_EXCLUDED = frozenset({"quota", "over_capacity", "bad_request"})
+# "client_gone" is the client dropping its own socket mid-stream.
+_SLO_EXCLUDED = frozenset({"quota", "over_capacity", "bad_request",
+                           "client_gone"})
 
 
 class UnknownModelError(ServeError):
@@ -223,6 +225,16 @@ class FleetEntry:
             self._next_generation = gen + 1
             return gen
 
+    def queue_depth(self) -> int:
+        """Requests waiting in this entry's resident stack (0 when cold)."""
+        with self._lock:
+            if self._engine is None:
+                return 0
+            depth = self._engine.queue_depth()
+            if self._batcher is not None:
+                depth += self._batcher.queue_depth()
+            return depth
+
     def components(self) -> list:
         """Watchdog view: ``(name, worker-owning component)`` pairs for the
         currently-resident serving stack (empty when paged out)."""
@@ -351,6 +363,13 @@ class FleetRegistry:
     def names(self) -> list:
         with self._lock:
             return sorted(self._entries)
+
+    def queue_depth(self) -> int:
+        """Fleet-wide queued work (sum over resident models) — the load
+        signal a replica self-reports on each cluster heartbeat."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return sum(e.queue_depth() for e in entries)
 
     def ensure(self, name: str) -> FleetEntry:
         """Page a model in without serving a request (prewarm)."""
@@ -505,6 +524,18 @@ class FleetRegistry:
         cls = slo_cls[0]
         handle.set_on_done(lambda r: self._slo_record(name, cls, r.error))
         return handle
+
+    def cancel_generate(self, name: str, handle,
+                        cause: str = "client_gone") -> bool:
+        """Abandon one streamed generation whose consumer vanished — frees
+        its decode slot and KV pages via the batcher's cancel path. Returns
+        False when the request already finished (including via a racing
+        page-out, which drains in-flight work)."""
+        try:
+            batcher = self.get(name).batcher()
+        except ServeError:
+            return False
+        return batcher.cancel(handle, cause=cause)
 
     def generate(self, name: str, prompt, max_new_tokens: int, *,
                  tenant: str = "anonymous", temperature: float = 1.0,

@@ -48,12 +48,18 @@ class SloBurn:
     :meth:`record` bumps ``fleet_slo_requests_total{model,slo_class,outcome}``
     and refreshes ``fleet_slo_burn_rate{model,slo_class,window}`` gauges.
     ``clock`` is injectable for tests (must return seconds, monotonic).
+
+    ``key_label`` renames the first dimension in the exported metrics: the
+    cluster router tracks a second burn per *replica* (same math, keyed by
+    replica id) and exports it as ``...{replica=...}`` so a per-replica
+    burn spike points at the sick instance, not just the sick model.
     """
 
     def __init__(self, metrics=None, windows: Sequence[float] = (60.0, 600.0),
                  targets: Optional[Dict[str, float]] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, key_label: str = "model"):
         self.metrics = metrics
+        self.key_label = str(key_label)
         self.windows = tuple(sorted(float(w) for w in windows))
         if not self.windows:
             raise ValueError("SloBurn needs at least one window")
@@ -89,12 +95,12 @@ class SloBurn:
         m = self.metrics
         if m is not None:
             m.counter("fleet_slo_requests_total",
-                      {"model": model, "slo_class": slo_class,
+                      {self.key_label: model, "slo_class": slo_class,
                        "outcome": "good" if good else "bad"},
                       help="SLO-classified request outcomes").inc()
             for w_s, burn in burns.items():
                 m.gauge("fleet_slo_burn_rate",
-                        {"model": model, "slo_class": slo_class,
+                        {self.key_label: model, "slo_class": slo_class,
                          "window": w_s},
                         help="windowed error-budget burn rate "
                              "(1.0 = budget consumed exactly on pace)"

@@ -79,7 +79,7 @@ class _GenRequest:
 
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "eos_id",
                  "deadline", "enq_t", "event", "result", "error", "out",
-                 "key", "slot", "ctx", "on_done", "_cv")
+                 "key", "slot", "ctx", "on_done", "cancelled", "_cv")
 
     def __init__(self, prompt: np.ndarray, max_new: int, temperature: float,
                  top_k: Optional[int], eos_id: Optional[int],
@@ -103,6 +103,9 @@ class _GenRequest:
         # completion hook (fleet SLO burn accounting); runs once, on the
         # thread that finished the request
         self.on_done = None
+        # set by ContinuousBatcher.cancel(): the typed error the worker
+        # finishes this request with at its next safe point
+        self.cancelled: Optional[ServeError] = None
         self._cv = threading.Condition()
 
     # --- token-at-a-time surface (SSE streaming rides on this) ---
@@ -684,6 +687,33 @@ class ContinuousBatcher:
                            eos_id=eos_id, timeout_ms=timeout_ms,
                            ctx=ctx).stream()
 
+    def cancel(self, req: _GenRequest, cause: str = "client_gone") -> bool:
+        """Abandon one request whose consumer vanished (e.g. the SSE client
+        dropped the socket mid-stream). A still-queued request is removed
+        and finished immediately; an admitted one is flagged and retired by
+        the worker at its next safe point (<= one decode tick), which
+        releases its KV pages — cancellation never frees blocks a
+        dispatched device call may still be writing. Counts
+        ``serve_shed_total{cause=...}``. Idempotent; returns False when the
+        request already finished."""
+        err = ShedError(f"request abandoned by its consumer ({cause})",
+                        cause=cause)
+        queued = False
+        with self._cond:
+            if req.event.is_set() or req.cancelled is not None:
+                return False
+            if req in self._queue:
+                self._queue.remove(req)
+                self._m_qdepth.set(len(self._queue))
+                queued = True
+            else:
+                req.cancelled = err
+                self._cond.notify_all()
+        self._shed_counter(cause).inc()
+        if queued:
+            req._finish(err)
+        return True
+
     # ---------------------------------------------------------------- serving
     def _bucket(self, t: int) -> int:
         for b in self.prompt_buckets:
@@ -908,7 +938,8 @@ class ContinuousBatcher:
             req = self._slot_req[s]
             if req is None:
                 return
-            done = (len(req.out) >= req.max_new
+            done = (req.cancelled is not None
+                    or len(req.out) >= req.max_new
                     or (req.eos_id is not None and req.out
                         and req.out[-1] == req.eos_id))
             if not done:
@@ -925,7 +956,7 @@ class ContinuousBatcher:
                 self._update_kv_gauges()
             self._m_completed.inc()
             self._m_active.set(sum(1 for r in self._slot_req if r is not None))
-        req._finish()
+        req._finish(req.cancelled)
 
     def _tick(self, snap, epoch: int) -> None:
         """Decode one token for every slot; bookkeep the active ones."""
@@ -1052,6 +1083,11 @@ class ContinuousBatcher:
             now = time.perf_counter()
             if self.kv == "paged":
                 for job in self.scheduler.plan(jobs, decoding):
+                    if job.req.cancelled is not None:
+                        # consumer vanished mid-prefill: abort here, where
+                        # no device call holds the job's table row
+                        self._abort_job(job, job.req.cancelled)
+                        continue
                     if job.idx == 0 and job.req.deadline is not None \
                             and now > job.req.deadline:
                         self._abort_job(job, DeadlineExceededError(
@@ -1074,6 +1110,9 @@ class ContinuousBatcher:
                     for s, req in admits:
                         if req.event.is_set():
                             continue  # already shed by a racing restart
+                        if req.cancelled is not None:
+                            req._finish(req.cancelled)
+                            continue
                         if req.deadline is not None and now > req.deadline:
                             req._finish(DeadlineExceededError(
                                 "deadline exceeded waiting for a decode slot"))

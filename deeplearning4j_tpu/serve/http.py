@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import threading
 import time
 from typing import Optional, Sequence
@@ -58,13 +59,62 @@ _BAD_REQUEST = (KeyError, ValueError, TypeError, AttributeError,
                 json.JSONDecodeError)
 
 
-def retry_after_s(depth: int, limit: int) -> int:
+#: Module RNG behind Retry-After jitter; tests reseed it for determinism.
+_JITTER_RNG = random.Random()
+
+
+def jitter_retry_after(seconds: float, rng=None) -> int:
+    """±20% jitter on a Retry-After hint, floored at 1 s. Clients that all
+    got shed (or breaker-refused) in the same instant would otherwise come
+    back on the same second and stampede the recovering server; a ~40%
+    spread de-synchronizes them (full-jitter rationale: ``chaos/retry.py``).
+    """
+    r = (rng if rng is not None else _JITTER_RNG).random()
+    return int(max(1, round(float(seconds) * (0.8 + 0.4 * r))))
+
+
+def retry_after_s(depth: int, limit: int, rng=None) -> int:
     """Back-off hint for a 503/429 shed, derived from queue depth: an idle
-    queue says "retry in 1s", a full one scales up to 30s — so a fleet of
+    queue says "retry in ~1s", a full one scales up to ~30s — so a fleet of
     well-behaved clients spreads its retries instead of dog-piling the
-    instant the server sheds."""
+    instant the server sheds. The ±20% jitter spreads even clients that
+    shed at the same depth."""
     frac = depth / max(int(limit), 1)
-    return int(max(1, min(30, round(1 + 29 * frac))))
+    return jitter_retry_after(max(1.0, min(30.0, 1 + 29 * frac)), rng)
+
+
+def chaos_status() -> dict:
+    """JSON echo of the process-global fault plane (GET /v1/debug/chaos)."""
+    plane = _faults.ACTIVE
+    if plane is None:
+        return {"installed": False, "armed": []}
+    st = plane.stats()
+    return {"installed": True, "armed": st["armed"],
+            "injected": st["injected"]}
+
+
+def chaos_apply(req: dict) -> dict:
+    """Apply one ``POST /v1/debug/chaos`` body to the process-global fault
+    plane: ``{"uninstall": true}`` removes it (releasing any hung sites);
+    ``{"specs": ["point:mode[:k=v,...]", ...], "seed": 0}`` installs a
+    plane if none is active and arms each spec on it. A malformed spec
+    raises ``ValueError`` (-> HTTP 400) with nothing partially armed."""
+    if req.get("uninstall"):
+        _faults.uninstall()
+        return chaos_status()
+    specs = req.get("specs") or []
+    if not isinstance(specs, list):
+        raise ValueError("'specs' must be a list of fault-spec strings")
+    # validate the whole batch before arming any of it
+    for s in specs:
+        _faults.parse_spec(str(s))
+    plane = _faults.ACTIVE
+    if plane is None:
+        plane = _faults.install(_faults.FaultPlane(
+            seed=int(req.get("seed", 0))))
+    for s in specs:
+        plane.inject_spec(str(s))
+    return chaos_status()
 
 
 class ModelServer(JsonHTTPServerMixin):
@@ -77,7 +127,7 @@ class ModelServer(JsonHTTPServerMixin):
 
     _ROUTES = frozenset((
         "/predict", "/generate", "/health", "/ready", "/models", "/metrics",
-        "/v1/debug/requests", "/v1/debug/flight"))
+        "/v1/debug/requests", "/v1/debug/flight", "/v1/debug/chaos"))
 
     @classmethod
     def _metric_route(cls, path: str) -> str:
@@ -99,8 +149,12 @@ class ModelServer(JsonHTTPServerMixin):
                  gen_kv_blocks: Optional[int] = None,
                  gen_prefill_chunk: Optional[int] = 64,
                  seed: int = 0, metrics: Optional[MetricsRegistry] = None,
-                 aot_store=None, watchdog_s: Optional[float] = None):
+                 aot_store=None, watchdog_s: Optional[float] = None,
+                 chaos_admin: bool = False):
         self.model = model
+        # debug-only surface: /v1/debug/chaos answers 404 unless opted in,
+        # so a production front door never exposes fault injection
+        self.chaos_admin = bool(chaos_admin)
         self.host = host
         self.port = port
         self.input_dtype = input_dtype
@@ -260,6 +314,8 @@ class ModelServer(JsonHTTPServerMixin):
                                   {"error": "flight recorder not installed"})
                     else:
                         self.reply(200, _flight.ACTIVE.snapshot())
+                elif self.path == "/v1/debug/chaos" and server.chaos_admin:
+                    self.reply(200, chaos_status())
                 else:
                     self._err(404, {"error": "unknown endpoint"})
 
@@ -278,6 +334,11 @@ class ModelServer(JsonHTTPServerMixin):
                     self._obs_ctx = ctx
                     self._obs_trace_id = ctx.trace_id
                 try:
+                    if split.path == "/v1/debug/chaos" and server.chaos_admin:
+                        # admin surface stays usable even with a fault
+                        # armed at http.handler — it is how you disarm one
+                        self.reply(200, chaos_apply(self.read_json()))
+                        return
                     if _faults.ACTIVE is not None:
                         _faults.ACTIVE.hit("http.handler")
                     req = self.read_json()
@@ -294,7 +355,8 @@ class ModelServer(JsonHTTPServerMixin):
                     if e.http_status == 503:
                         retry = getattr(e, "retry_after_s", None)
                         headers = {"Retry-After":
-                                   int(retry + 0.999) if retry is not None
+                                   jitter_retry_after(retry)
+                                   if retry is not None
                                    else server._retry_after()}
                     self._err(e.http_status,
                               {"error": str(e), "cause": e.cause},
@@ -305,6 +367,15 @@ class ModelServer(JsonHTTPServerMixin):
                     self._err(400, {"error": str(e)})
                     if ctx is not None:
                         ctx.finish(error="bad_request")
+                except (BrokenPipeError, ConnectionResetError):
+                    # the client hung up while we were answering: nothing
+                    # left to write to, and a vanished reader is shed load,
+                    # not a server error
+                    server.metrics.counter(
+                        "serve_shed_total", {"cause": "client_gone"},
+                        help="requests refused at admission, by cause").inc()
+                    if ctx is not None:
+                        ctx.finish(error="client_gone")
                 except Exception as e:  # server must answer every request  # jaxlint: disable=broad-except
                     # unexpected == a bug: keep the full traceback (the
                     # client only sees the summary) and make 5xx bursts
@@ -391,9 +462,19 @@ class ModelServer(JsonHTTPServerMixin):
                     self._sse({"done": True, "tokens": out})
                 except ServeError as e:
                     # mid-stream failure: partial output + the typed cause
-                    self._sse({"error": str(e), "cause": e.cause,
-                               "tokens": out})
+                    try:
+                        self._sse({"error": str(e), "cause": e.cause,
+                                   "tokens": out})
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass  # nobody left to tell
                     err_cause = e.cause
+                except (BrokenPipeError, ConnectionResetError):
+                    # client dropped the socket mid-stream: free the decode
+                    # slot and KV pages NOW (cancel counts the shed as
+                    # cause="client_gone") instead of decoding to nobody —
+                    # and never let the pipe error surface as a 5xx
+                    server.batcher().cancel(handle)
+                    err_cause = "client_gone"
                 if ctx is not None:
                     # the streaming window: first header flush to last event
                     ctx.add_stage("flush", t0f, time.perf_counter_ns(),

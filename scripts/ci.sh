@@ -42,6 +42,12 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/fleet/
 # result untrustworthy, so it holds the same zero-suppression bar.
 echo "=== jaxlint: deeplearning4j_tpu/chaos/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/chaos/
+# cluster/ is the one front door every replica hides behind: an unlocked
+# membership map or a swallowed failover error turns one replica's death
+# into a full outage, so the routing tier gets the same zero-suppression
+# bar as serve/ and fleet/.
+echo "=== jaxlint: deeplearning4j_tpu/cluster/ (no baseline permitted) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/cluster/
 
 echo "=== smoke trace: 5-step instrumented train ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_trace.py
@@ -51,6 +57,9 @@ CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_serve.py
 
 echo "=== smoke chaos: seeded fault scenario, self-healing fleet ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_chaos.py
+
+echo "=== smoke cluster: kill-a-replica drill behind the router ==="
+CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_cluster.py
 
 # every scrape artifact the smokes wrote must be an exposition a real
 # Prometheus would accept — promcheck is the gate, not just a warning

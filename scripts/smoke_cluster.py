@@ -1,0 +1,388 @@
+#!/usr/bin/env python
+"""CI smoke cluster: boot a 2-replica fleet behind the cluster router,
+kill a replica mid-traffic, and assert the cluster SURVIVES — the
+ISSUE-10 acceptance surface.
+
+The drill (deterministic, seeded, CPU-only; membership and SLO burn run
+on an injectable skewable clock so death detection and burn-window aging
+never wait on wall time):
+
+- **A. reference pass** — gold + standard tenants predict and generate
+  through the router; the fault-free answers become the ground truth every
+  later phase is compared against (zero wrong-params tolerance).
+- **B. hedge drill** — a scoped chaos delay makes the predict primary
+  slow; the gold request hedges to the other replica after ``hedge_ms``,
+  the hedge wins, and the Perfetto export shows BOTH attempts stitched
+  into the one request track (same trace id, ``hedge`` False and True).
+- **C. kill a replica mid-traffic** — the generate primary is crash-killed
+  (no drain) under mixed gold/standard load: every response is either
+  bit-correct or a typed error (no raw 500s ever), membership marks the
+  victim dead, placement re-plans onto the survivor, and the dead
+  replica's model serves again from its new home.
+- **D. partition the survivor** — a scoped connection fault makes the last
+  replica unreachable: requests shed with a typed 503
+  (``upstream_unreachable``) and the gold burn rate spikes above 1.0;
+  healing the partition and aging the window brings
+  ``fleet_slo_burn_rate{slo_class="gold",window="1m"}`` back below 1.0.
+- **E. global tenant bucket** — a tenant capped at the router is refused
+  with a typed 429 + Retry-After no matter which replica would serve it.
+
+Artifacts: $CI_ARTIFACTS_DIR/smoke_cluster_metrics.prom (+ _om.prom, both
+validated by obs.promcheck), smoke_cluster_trace.json (Perfetto), and a
+flight_NN.json dump of the drill's last requests.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+HEARTBEAT_S = 0.25
+SUSPECT_AFTER_S = 2.0
+DEAD_AFTER_S = 6.0
+HEDGE_MS = 150.0
+X = [[0.1, -0.2, 0.3, -0.4]]
+PROMPT = [3, 1, 4, 1, 5]
+GEN_BODY = {"prompt": PROMPT, "max_new_tokens": 6, "temperature": 0.0,
+            "stream": False}
+
+# membership + SLO burn share this skewable clock: bumping the skew ages
+# heartbeat leases (instant, deterministic death detection) and slides the
+# burn-rate window (bad events age out without waiting 60 real seconds)
+CLOCK_SKEW = [0.0]
+
+
+def _clock():
+    return time.monotonic() + CLOCK_SKEW[0]
+
+
+def _post(port, path, body, tenant=None):
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers["X-Tenant"] = tenant
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers=headers)
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read()), dict(r.headers)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.status, r.read()
+
+
+def _wait_ready(port, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            status, _ = _get(port, "/ready")
+            if status == 200:
+                return
+        except (urllib.error.HTTPError, OSError):
+            pass
+        time.sleep(0.1)
+    raise AssertionError(f"router not ready within {timeout_s}s")
+
+
+def _metric(scrape: str, name: str, **labels) -> float:
+    total = 0.0
+    found = False
+    for line in scrape.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest and rest[0] not in "{ ":
+            continue  # a longer metric name sharing this prefix
+        if not all(f'{k}="{v}"' in rest for k, v in labels.items()):
+            continue
+        total += float(line.rsplit(" ", 1)[1])
+        found = True
+    assert found, f"metric {name}{labels or ''} missing from scrape"
+    return total
+
+
+def _build_replica(rid, store_dir):
+    """One replica: its own fleet registry holding the dense model and the
+    LM, mounting the SHARED AOT store directory (each replica gets its own
+    handle over one directory, exactly as separate processes would). Seeds
+    are shared across replicas so every replica computes the same answers —
+    the smoke's wrong-params oracle."""
+    from deeplearning4j_tpu.aot import AotStore
+    from deeplearning4j_tpu.cluster import spawn_replica
+    from deeplearning4j_tpu.fleet import FleetRegistry
+    from deeplearning4j_tpu.models import CausalLM
+    from deeplearning4j_tpu.nn.layers import Dense, Output
+    from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+
+    dense = Sequential(NetConfig(seed=0),
+                       [Dense(n_out=6, activation="tanh"),
+                        Output(n_out=3, loss="mcxent", activation="softmax")],
+                       (4,))
+    dense.init()
+    lm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=32,
+                  num_heads=4, vocab=50).build()
+    lm.init()
+    fleet = FleetRegistry(aot_store=AotStore(store_dir))
+    fleet.add("d", dense)
+    fleet.add("g", lm, input_dtype=np.int32,
+              gen_opts={"slots": 2, "capacity": 24, "seed": 0})
+    return spawn_replica(rid, fleet)
+
+
+def _typed_error(port, path, body, tenant=None):
+    """POST expecting a typed error; returns (code, cause, headers)."""
+    try:
+        _post(port, path, body, tenant=tenant)
+    except urllib.error.HTTPError as e:
+        payload = json.loads(e.read())
+        assert "cause" in payload, f"untyped {e.code} from {path}: {payload}"
+        return e.code, payload["cause"], e.headers
+    raise AssertionError(f"{path} unexpectedly succeeded")
+
+
+def main():
+    artifacts = os.environ.get("CI_ARTIFACTS_DIR", "ci-artifacts")
+    os.makedirs(artifacts, exist_ok=True)
+
+    from deeplearning4j_tpu.chaos import FaultPlane, install, uninstall
+    from deeplearning4j_tpu.cluster import ClusterRouter
+    from deeplearning4j_tpu.obs import flight as flight_mod
+    from deeplearning4j_tpu.obs import reqtrace as reqtrace_mod
+    from deeplearning4j_tpu.obs.flight import FlightRecorder
+    from deeplearning4j_tpu.obs.promcheck import check_text
+    from deeplearning4j_tpu.obs.reqtrace import (RequestTracer,
+                                                 parse_traceparent)
+    from deeplearning4j_tpu.obs.trace import Tracer
+
+    # full observability: every routed request is traced end to end and the
+    # flight recorder keeps the last-N records for the post-mortem bundle
+    tracer = Tracer()
+    recorder = flight_mod.install(FlightRecorder(out_dir=artifacts))
+    reqtrace_mod.install(RequestTracer(tracer=tracer, flight=recorder))
+
+    store_dir = tempfile.mkdtemp(prefix="smoke_cluster_aot_")
+    replicas = {rid: _build_replica(rid, store_dir)
+                for rid in ("r1", "r2")}
+    router = ClusterRouter(port=0, heartbeat_s=HEARTBEAT_S,
+                           suspect_after_s=SUSPECT_AFTER_S,
+                           dead_after_s=DEAD_AFTER_S, hedge_ms=HEDGE_MS,
+                           clock=_clock)
+    for rid, h in replicas.items():
+        router.add_replica(rid, h.base_url)
+    # router-side GLOBAL buckets: gold + standard tenants with headroom,
+    # plus one tenant capped tightly enough to refuse inside the drill
+    router.tenants.register("vip", rate_per_s=100.0, slo="gold")
+    router.tenants.register("std", rate_per_s=100.0, slo="standard")
+    router.tenants.register("capped", rate_per_s=0.5, burst=2.0)
+    router.start()
+    port = router.port
+    try:
+        _wait_ready(port)
+        router.poll_once()  # first beat round: collect payloads, build plan
+        status, body = _get(port, "/v1/cluster")
+        assert status == 200
+        plan = json.loads(body)["placement"]
+        assert set(plan) == {"d", "g"} and all(len(c) == 2
+                                               for c in plan.values()), plan
+
+        # ---- A: fault-free reference pass (both tenants, both verbs)
+        print("=== phase A: reference pass ===", flush=True)
+        ref_pred, _ = _post(port, "/v1/models/d/predict", {"ndarray": X},
+                            tenant="vip")
+        ref_toks = _post(port, "/v1/models/g/generate?stream=false",
+                         GEN_BODY, tenant="std")[0]["tokens"]
+        assert ref_toks, "reference generation returned no tokens"
+
+        # ---- B: slow primary -> gold hedge wins, one stitched trace
+        print("=== phase B: gold hedge beats a slow primary ===", flush=True)
+        d_primary, d_backup = plan["d"][0], plan["d"][1]
+        fp = install(FaultPlane(seed=0, metrics=router.metrics))
+        fp.inject_spec(
+            f"cluster.transport:delay:delay_s=0.6,scope={d_primary},times=-1")
+        t0 = time.monotonic()
+        out, hdrs = _post(port, "/v1/models/d/predict", {"ndarray": X},
+                          tenant="vip")
+        hedge_elapsed = time.monotonic() - t0
+        uninstall()
+        assert np.allclose(out["output"], ref_pred["output"]), \
+            "hedged predict changed the answer"
+        assert hedge_elapsed < 0.55, \
+            f"hedge did not beat the delayed primary ({hedge_elapsed:.2f}s)"
+        parsed = parse_traceparent(hdrs.get("traceparent"))
+        assert parsed is not None, "hedged response carried no traceparent"
+        hedge_trace = parsed[0]
+        time.sleep(0.8)  # let the cancelled loser finish its attempt stage
+        atts = [e for e in tracer.events
+                if e.get("id") == hedge_trace and e.get("name") == "attempt"
+                and e.get("ph") == "b"]
+        assert len(atts) >= 2, \
+            f"hedged trace holds {len(atts)} attempt stage(s), wanted 2"
+        assert {a["args"]["hedge"] for a in atts} == {False, True}
+        assert {a["args"]["replica"] for a in atts} == {d_primary, d_backup}
+
+        # ---- C: crash-kill the generate primary under mixed load
+        print("=== phase C: kill a replica mid-traffic ===", flush=True)
+        victim = plan["g"][0]
+        survivor = plan["g"][1]
+        # park the background detector: from here the drill drives
+        # membership itself (poll_once), so the FIRST request after the
+        # kill deterministically meets a dead socket and must fail over
+        # rather than racing a heartbeat that already benched the victim
+        router.heartbeat_s = 3600.0
+        time.sleep(2 * HEARTBEAT_S)  # let any in-flight tick finish
+        errors = []
+        for i in range(24):
+            if i == 6:
+                replicas[victim].kill()
+            for path, body, tenant, check in (
+                    ("/v1/models/d/predict", {"ndarray": X}, "vip",
+                     lambda o: np.allclose(o["output"],
+                                           ref_pred["output"])),
+                    ("/v1/models/g/generate?stream=false", GEN_BODY, "std",
+                     lambda o: o["tokens"] == ref_toks)):
+                try:
+                    out, _ = _post(port, path, body, tenant=tenant)
+                except urllib.error.HTTPError as e:
+                    payload = json.loads(e.read())
+                    assert e.code != 500 and "cause" in payload, \
+                        f"raw/untyped error {e.code} from {path}: {payload}"
+                    errors.append((e.code, payload["cause"]))
+                else:
+                    assert check(out), \
+                        f"WRONG-PARAMS answer from {path} at iteration {i}"
+        print(f"typed refusals during the kill window: {errors or 'none'}",
+              flush=True)
+
+        # deterministic death: age the victim's lease past dead_after_s and
+        # run one poll round — the survivor's beat renews, the victim's
+        # cannot, placement re-plans onto the survivor alone
+        CLOCK_SKEW[0] += DEAD_AFTER_S + 1.0
+        states = router.poll_once()
+        assert states[victim] == "dead" and states[survivor] == "alive", \
+            states
+        status, body = _get(port, "/v1/cluster")
+        view = json.loads(body)
+        assert view["placement"]["g"] == [survivor], view["placement"]
+        assert view["membership"][victim]["state"] == "dead"
+        # ...and the dead replica's model is genuinely serving again
+        toks = _post(port, "/v1/models/g/generate?stream=false", GEN_BODY,
+                     tenant="std")[0]["tokens"]
+        assert toks == ref_toks, "re-placed model diverged from reference"
+
+        # ---- D: partition the survivor -> typed outage, burn spike, heal
+        print("=== phase D: partition, burn spike, recovery ===", flush=True)
+        fp = install(FaultPlane(seed=0, metrics=router.metrics))
+        fp.inject_spec(
+            f"cluster.transport:error:type=connection,scope={survivor},"
+            f"times=-1")
+        for _ in range(2):
+            code, cause, hdrs = _typed_error(
+                port, "/v1/models/d/predict", {"ndarray": X}, tenant="vip")
+            assert code == 503 and cause == "upstream_unreachable", \
+                (code, cause)
+            assert hdrs.get("Retry-After") is not None
+        uninstall()
+        scrape = _get(port, "/metrics")[1].decode()
+        burn = _metric(scrape, "fleet_slo_burn_rate", model="d",
+                       slo_class="gold", window="1m")
+        assert burn > 1.0, f"gold burn did not spike: {burn}"
+        # heal: age the bad events out of the 1m window, renew leases, and
+        # serve gold traffic again — the refreshed gauge must drop below 1
+        CLOCK_SKEW[0] += 61.0
+        router.poll_once()
+        for _ in range(5):
+            out, _ = _post(port, "/v1/models/d/predict", {"ndarray": X},
+                           tenant="vip")
+            assert np.allclose(out["output"], ref_pred["output"])
+        scrape = _get(port, "/metrics")[1].decode()
+        burn = _metric(scrape, "fleet_slo_burn_rate", model="d",
+                       slo_class="gold", window="1m")
+        assert burn < 1.0, f"gold burn did not recover: {burn}"
+
+        # ---- E: the router's tenant bucket is global, typed, and bounded
+        print("=== phase E: global tenant quota ===", flush=True)
+        for _ in range(2):
+            _post(port, "/v1/models/d/predict", {"ndarray": X},
+                  tenant="capped")
+        code, cause, hdrs = _typed_error(
+            port, "/v1/models/d/predict", {"ndarray": X}, tenant="capped")
+        assert code == 429 and cause == "quota", (code, cause)
+        assert hdrs.get("Retry-After") is not None
+
+        # ---- final: counters moved, expositions valid, artifacts written
+        scrape = _get(port, "/metrics")[1].decode()
+        with open(os.path.join(artifacts, "smoke_cluster_metrics.prom"),
+                  "w") as f:
+            f.write(scrape)
+        assert _metric(scrape, "cluster_replica_transitions_total",
+                       to="dead") >= 1
+        assert _metric(scrape, "cluster_heartbeats_total",
+                       outcome="miss") >= 1
+        assert _metric(scrape, "cluster_failover_total") >= 1
+        assert _metric(scrape, "cluster_hedges_total",
+                       outcome="launched") >= 1
+        assert _metric(scrape, "cluster_hedges_total", outcome="won") >= 1
+        assert _metric(scrape, "cluster_placement_rebuilds_total") >= 2
+        assert _metric(scrape, "cluster_retry_budget_spend_total",
+                       outcome="granted") >= 2
+        assert _metric(scrape, "cluster_requests_total", outcome="ok") >= 10
+        assert _metric(scrape, "serve_shed_total", cause="quota") >= 1
+        # per-replica burn is exported alongside the per-model burn
+        _metric(scrape, "fleet_slo_burn_rate", replica=survivor,
+                slo_class="gold", window="1m")
+        errors = check_text(scrape, openmetrics=False)
+        assert not errors, f"invalid /metrics exposition: {errors[:5]}"
+        om = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics",
+            headers={"Accept": "application/openmetrics-text"}),
+            timeout=30).read().decode()
+        with open(os.path.join(artifacts,
+                               "smoke_cluster_metrics_om.prom"), "w") as f:
+            f.write(om)
+        errors = check_text(om)
+        assert not errors, f"invalid OpenMetrics exposition: {errors[:5]}"
+
+        tracer.export(os.path.join(artifacts, "smoke_cluster_trace.json"))
+        dump_path = recorder.dump("cluster_drill")
+        assert dump_path is not None, "flight recorder refused to dump"
+        with open(dump_path) as f:
+            dumped = json.load(f)
+        assert any(r["trace_id"] == hedge_trace
+                   for r in dumped["requests"]), \
+            "hedged request's record missing from the flight dump"
+    finally:
+        uninstall()
+        router.stop()
+        for h in replicas.values():
+            if h.alive():
+                h.stop()
+        reqtrace_mod.uninstall()
+        flight_mod.uninstall()
+
+    # nothing left running: router, heartbeat, replicas, batchers all down
+    import threading
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        hung = [t for t in threading.enumerate()
+                if t.name.startswith(("serve-", "fleet-", "cluster-"))
+                and t.is_alive()]
+        if not hung:
+            break
+        time.sleep(0.1)
+    assert not hung, f"threads left hanging: {[t.name for t in hung]}"
+    print("smoke cluster OK: replica death survived, placement healed, "
+          "hedge stitched, burn recovered, no hung threads")
+
+
+if __name__ == "__main__":
+    main()

@@ -265,6 +265,25 @@ class TestRetryPolicy:
             pol.call(fatal, op="x", retry_on=(Exception,), give_up=(KeyError,))
         assert calls["n"] == 1
 
+    def test_give_up_subclass_wins_and_counts_nothing(self):
+        """Precedence holds even when the error matches BOTH tuples via
+        subclassing (FileNotFoundError is an OSError), and a give-up is
+        not a retry outcome: fleet_retry_total stays empty."""
+        m = MetricsRegistry()
+        pol = RetryPolicy(attempts=5, base_s=0.0, cap_s=0.0, metrics=m,
+                          sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def corrupt():
+            calls["n"] += 1
+            raise FileNotFoundError("the store entry is gone, not flaky")
+
+        with pytest.raises(FileNotFoundError):
+            pol.call(corrupt, op="x", retry_on=(OSError,),
+                     give_up=(FileNotFoundError,))
+        assert calls["n"] == 1
+        assert "fleet_retry_total" not in m.to_prometheus()
+
     def test_non_matching_exception_not_retried(self):
         pol = RetryPolicy(attempts=5, base_s=0.0, cap_s=0.0,
                           sleep=lambda s: None)
@@ -365,6 +384,31 @@ class TestCircuitBreaker:
         br.allow()                              # slot free again
         br.record_success()
         assert br.state() == "closed"
+
+    def test_record_ignored_changes_no_state_ever(self):
+        """record_ignored only releases the probe slot: it never closes,
+        opens, or re-opens the breaker — real outcomes do. A failed probe
+        AFTER an ignored one still re-opens a fresh window."""
+        t = [0.0]
+        m = MetricsRegistry()
+        br = self._breaker(lambda: t[0], metrics=m, threshold=1, reset_s=1.0)
+        br.record_ignored()                     # closed: nothing to release
+        assert br.state() == "closed"
+        br.allow(); br.record_failure()         # open
+        br.record_ignored()                     # open: still no transition
+        assert br.state() == "open"
+        t[0] = 1.01
+        br.allow()                              # probe taken
+        br.record_ignored()                     # released without verdict
+        assert br.state() == "half_open"
+        br.allow()                              # a second probe is allowed
+        br.record_failure()                     # ...and ITS verdict counts
+        assert br.state() == "open"
+        with pytest.raises(CircuitOpenError):
+            br.allow()                          # fresh window from t=1.01
+        # only real outcomes moved the state machine
+        assert _counter_value(m, "fleet_breaker_transitions_total",
+                              {"model": "m", "to": "open"}) == 2
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,483 @@
+"""Tests for the cluster/ subsystem (ISSUE 10).
+
+The load-bearing properties, each tested directly:
+
+- membership: lease ages on a fake clock drive ``alive -> suspect ->
+  dead``; a successful beat resurrects; an observed transport failure
+  demotes immediately; dead replicas are never routable;
+- placement: worst-fit bin-packing spreads models across budgets, an
+  oversized model still gets a primary, the failover tail prefers the
+  least-loaded replica, and a dead replica's models re-place onto the
+  survivors;
+- retry budget: deposits refill at the configured ratio, spends are
+  denied when dry — the property that caps total re-routes;
+- router failover (scripted stub replicas, so every upstream answer is
+  exact): predicts fail over on connect failure and on 5xx, NEVER on
+  4xx/quota; generates fail over ONLY on typed pre-admission refusals —
+  an ambiguous 500 from an admitted generate is surfaced, not retried;
+- the retry budget caps re-routes end to end (second failover denied);
+- gold-class hedging: first response wins, the hedge's two attempts are
+  stitched into one request trace, standard-class traffic never hedges;
+- the ``cluster.transport`` chaos seam: a ``scope=``-targeted partition
+  faults exactly one replica's hops and drives its membership demotion.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from deeplearning4j_tpu.chaos import faults as chaos_faults
+from deeplearning4j_tpu.cluster import (ALIVE, DEAD, SUSPECT, ClusterRouter,
+                                        Membership, Placement, RetryBudget)
+from deeplearning4j_tpu.obs import reqtrace
+from deeplearning4j_tpu.obs.flight import FlightRecorder
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+
+def _counter_value(m, name, labels=None):
+    return m.counter(name, labels or {}).value
+
+
+# --------------------------------------------------------------------------
+class TestMembership:
+    def test_lease_ages_drive_alive_suspect_dead(self):
+        t = [0.0]
+        m = MetricsRegistry()
+        mem = Membership(suspect_after_s=2.0, dead_after_s=6.0,
+                         clock=lambda: t[0], metrics=m)
+        mem.add("r1", "http://h:1")
+        assert mem.sweep() == {"r1": ALIVE}
+        t[0] = 2.5                               # lease past suspect_after
+        assert mem.sweep() == {"r1": SUSPECT}
+        t[0] = 6.5                               # ...past dead_after
+        assert mem.sweep() == {"r1": DEAD}
+        assert m.gauge("cluster_replica_state", {"replica": "r1"}).value == 2
+        mem.report("r1", {"queue_depth": 3})     # a beat resurrects
+        assert mem.state("r1") == ALIVE
+        assert mem.payload("r1") == {"queue_depth": 3}
+        assert _counter_value(
+            m, "cluster_replica_transitions_total",
+            {"replica": "r1", "to": "suspect"}) == 1
+
+    def test_miss_demotes_immediately_without_waiting_out_the_lease(self):
+        t = [0.0]
+        mem = Membership(suspect_after_s=10.0, dead_after_s=20.0,
+                         clock=lambda: t[0])
+        mem.add("r1", "http://h:1")
+        mem.miss("r1")                           # refused conn = evidence
+        assert mem.state("r1") == SUSPECT
+        mem.miss("r1")                           # suspect stays suspect;
+        assert mem.state("r1") == SUSPECT        # only the lease kills
+        mem.report("r1")
+        assert mem.state("r1") == ALIVE
+
+    def test_routable_orders_alive_first_and_never_dead(self):
+        t = [0.0]
+        mem = Membership(suspect_after_s=1.0, dead_after_s=2.0,
+                         clock=lambda: t[0])
+        for r in ("a", "b", "c"):
+            mem.add(r, f"http://h/{r}")
+        mem.miss("b")
+        assert mem.routable() == ["a", "c", "b"]
+        t[0] = 3.0
+        mem.report("c")
+        mem.sweep()                              # a and b age out to dead
+        assert mem.routable() == ["c"]
+
+    def test_rejects_duplicates_and_bad_thresholds(self):
+        mem = Membership()
+        mem.add("r1", "u")
+        with pytest.raises(ValueError):
+            mem.add("r1", "u")
+        with pytest.raises(ValueError):
+            Membership(suspect_after_s=5.0, dead_after_s=5.0)
+
+
+# --------------------------------------------------------------------------
+class TestPlacement:
+    def test_worst_fit_spreads_models_across_budgets(self):
+        plan = Placement().plan(
+            {"big": 80, "mid": 50, "small": 10},
+            {"r1": {"hbm_budget_bytes": 100, "queue_depth": 0},
+             "r2": {"hbm_budget_bytes": 100, "queue_depth": 0}})
+        # big -> one box, mid -> the OTHER (worst-fit), small -> next to mid
+        assert plan["big"][0] != plan["mid"][0]
+        prim = {n: c[0] for n, c in plan.items()}
+        used = {}
+        for n, w in (("big", 80), ("mid", 50), ("small", 10)):
+            used[prim[n]] = used.get(prim[n], 0) + w
+        assert all(v <= 100 for v in used.values())
+
+    def test_oversized_model_still_gets_a_primary(self):
+        plan = Placement().plan(
+            {"huge": 1000},
+            {"r1": {"hbm_budget_bytes": 100, "queue_depth": 0},
+             "r2": {"hbm_budget_bytes": 50, "queue_depth": 0}})
+        assert plan["huge"][0] == "r1"           # emptiest, not "nowhere"
+
+    def test_failover_tail_prefers_low_queue_depth(self):
+        plan = Placement().plan(
+            {"m": 10},
+            {"r1": {"hbm_budget_bytes": 100, "queue_depth": 9},
+             "r2": {"hbm_budget_bytes": 100, "queue_depth": 0},
+             "r3": {"hbm_budget_bytes": 100, "queue_depth": 4}})
+        primary = plan["m"][0]
+        tail = plan["m"][1:]
+        depths = {"r1": 9, "r2": 0, "r3": 4}
+        assert depths[tail[0]] == min(depths[r] for r in tail)
+        assert set([primary] + tail) == {"r1", "r2", "r3"}
+
+    def test_death_replaces_models_onto_survivors(self):
+        models = {"a": 60, "b": 60}
+        both = {"r1": {"hbm_budget_bytes": 100, "queue_depth": 0},
+                "r2": {"hbm_budget_bytes": 100, "queue_depth": 0}}
+        before = Placement().plan(models, both)
+        assert before["a"][0] != before["b"][0]  # one model per box
+        # r-dead replicas simply vanish from the input: everything lands
+        # on the survivor, and the plan never names the dead box
+        after = Placement().plan(models, {"r1": both["r1"]})
+        assert after["a"] == ["r1"] and after["b"] == ["r1"]
+
+    def test_empty_cluster_plans_nothing(self):
+        assert Placement().plan({"m": 1}, {}) == {}
+
+
+# --------------------------------------------------------------------------
+class TestRetryBudget:
+    def test_deposits_refill_and_spends_cap(self):
+        m = MetricsRegistry()
+        b = RetryBudget(ratio=0.5, cap=2.0, metrics=m)
+        assert b.spend() and b.spend()           # starts full (cap=2)
+        assert not b.spend()                     # dry: the cap binds
+        for _ in range(2):
+            b.deposit()                          # 2 * 0.5 = one token back
+        assert b.spend()
+        assert not b.spend()
+        assert _counter_value(m, "cluster_retry_budget_spend_total",
+                              {"outcome": "denied"}) == 2
+        for _ in range(100):
+            b.deposit()                          # refill caps at cap
+        assert b.snapshot()["tokens"] == 2.0
+
+    def test_rejects_bad_config(self):
+        with pytest.raises(ValueError):
+            RetryBudget(ratio=0.0)
+        with pytest.raises(ValueError):
+            RetryBudget(cap=0.5)
+
+
+# --------------------------------------------------------------------------
+def _stub_replica(rid, respond, *, weight_bytes=100, budget=1000):
+    """A replica-shaped scripted server: answers the heartbeat like a real
+    FleetServer and delegates model POSTs to ``respond(verb, body_bytes)
+    -> (status, payload_dict, delay_s)``. Returns (server, base_url,
+    hits) where ``hits`` records every model-route POST."""
+    hits = []
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/v1/replica":
+                self._send(200, {
+                    "replica": rid, "accepting": True, "ready": True,
+                    # resident=False so the router's demotion pass stays
+                    # quiet and `hits` records only routed traffic
+                    "models": {"m": {"resident": False,
+                                     "weight_bytes": weight_bytes}},
+                    "hbm_budget_bytes": budget, "resident_bytes": 0,
+                    "queue_depth": 0})
+            else:
+                self._send(404, {"error": "unknown"})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n)
+            verb = self.path.split("?", 1)[0].rsplit("/", 1)[-1]
+            hits.append(verb)
+            status, payload, delay = respond(verb, body)
+            if delay:
+                time.sleep(delay)
+            try:
+                self._send(status, payload)
+            except (BrokenPipeError, ConnectionResetError):
+                pass                             # cancelled hedge loser
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}", hits
+
+
+def _ok(rid):
+    return lambda verb, body: (200, {"output": [[1.0]], "served_by": rid,
+                                     "tokens": [1, 2]}, 0)
+
+
+class _RouterRig:
+    """Router + N scripted stubs with manual heartbeats (heartbeat thread
+    effectively inert at 60 s; tests drive poll_once deterministically)."""
+
+    def __init__(self, stubs, **router_kw):
+        self.metrics = MetricsRegistry()
+        kw = dict(port=0, heartbeat_s=60.0, hedge_ms=None,
+                  metrics=self.metrics)
+        kw.update(router_kw)
+        self.router = ClusterRouter(**kw)
+        self.stubs = {}
+        for rid, respond, stub_kw in stubs:
+            srv, url, hits = _stub_replica(rid, respond, **stub_kw)
+            self.stubs[rid] = (srv, hits)
+            self.router.add_replica(rid, url)
+        self.router.start()
+        self.router.poll_once()                  # beats + first plan
+
+    def hits(self, rid):
+        return self.stubs[rid][1]
+
+    def kill_stub(self, rid):
+        srv, _ = self.stubs[rid]
+        srv.shutdown()
+        srv.server_close()
+
+    def post(self, path, body, tenant="t"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.router.port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": tenant})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+
+    def close(self):
+        self.router.stop()
+        for srv, _ in self.stubs.values():
+            try:
+                srv.shutdown()
+                srv.server_close()
+            except OSError:
+                pass
+
+
+class TestRouterFailover:
+    """Scripted upstreams make every failover decision observable: which
+    replica was hit, how many times, and what the client finally saw."""
+
+    def test_predict_fails_over_on_connect_failure(self):
+        # rA gets the bigger budget -> primary for "m"
+        rig = _RouterRig([("rA", _ok("rA"), {"budget": 2000}),
+                          ("rB", _ok("rB"), {"budget": 1000})])
+        try:
+            assert rig.router.candidates("m")[0] == "rA"
+            rig.kill_stub("rA")                  # crash: connection refused
+            status, body = rig.post("/v1/models/m/predict", {"ndarray": []})
+            assert status == 200 and body["served_by"] == "rB"
+            assert _counter_value(rig.metrics, "cluster_failover_total",
+                                  {"reason": "connect"}) == 1
+            # the observed transport failure demoted the primary
+            assert rig.router.membership.state("rA") == SUSPECT
+        finally:
+            rig.close()
+
+    def test_predict_fails_over_on_5xx_but_counts_the_replica_bad(self):
+        sick = lambda verb, body: (500, {"error": "boom",
+                                         "cause": "internal"}, 0)
+        rig = _RouterRig([("rA", sick, {"budget": 2000}),
+                          ("rB", _ok("rB"), {"budget": 1000})])
+        try:
+            status, body = rig.post("/v1/models/m/predict", {"ndarray": []})
+            assert status == 200 and body["served_by"] == "rB"
+            assert rig.hits("rA") == ["predict"]  # exactly one try
+            assert _counter_value(rig.metrics, "cluster_failover_total",
+                                  {"reason": "status"}) == 1
+            # 5xx is a bad outcome for rA's burn, not a membership miss
+            assert rig.router.membership.state("rA") == ALIVE
+        finally:
+            rig.close()
+
+    def test_4xx_and_quota_never_fail_over(self):
+        answers = {"rA": (404, {"error": "unknown model",
+                                "cause": "unknown_model"}),
+                   "quota": (429, {"error": "over quota", "cause": "quota"})}
+        state = {"mode": "rA"}
+
+        def scripted(verb, body):
+            code, payload = answers[state["mode"]]
+            return code, payload, 0
+
+        rig = _RouterRig([("rA", scripted, {"budget": 2000}),
+                          ("rB", _ok("rB"), {"budget": 1000})])
+        try:
+            for mode, want in (("rA", 404), ("quota", 429)):
+                state["mode"] = mode
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    rig.post("/v1/models/m/predict", {"ndarray": []})
+                assert ei.value.code == want
+                assert json.loads(ei.value.read())["cause"] in (
+                    "unknown_model", "quota")
+            assert rig.hits("rB") == []          # never rerouted
+        finally:
+            rig.close()
+
+    def test_generate_fails_over_only_on_pre_admission_refusals(self):
+        """The acceptance property: a generate ACCEPTED by a replica is
+        never run twice. A typed queue_full (pre-admission) re-routes; an
+        ambiguous 500 internal — the replica may have started decoding —
+        surfaces to the client instead."""
+        state = {"cause": "queue_full", "code": 503}
+
+        def refusing(verb, body):
+            return state["code"], {"error": "x", "cause": state["cause"]}, 0
+
+        rig = _RouterRig([("rA", refusing, {"budget": 2000}),
+                          ("rB", _ok("rB"), {"budget": 1000})])
+        try:
+            # pre-admission refusal: safe, re-routed, client sees 200
+            status, body = rig.post("/v1/models/m/generate?stream=false",
+                                    {"prompt": [1]})
+            assert status == 200 and body["served_by"] == "rB"
+            assert rig.hits("rB") == ["generate"]
+            # ambiguous post-admission failure: surfaced, NOT re-routed
+            state.update(cause="internal", code=500)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                rig.post("/v1/models/m/generate?stream=false",
+                         {"prompt": [1]})
+            assert ei.value.code == 500
+            assert json.loads(ei.value.read())["cause"] == "internal"
+            assert rig.hits("rB") == ["generate"], \
+                "an admitted generate was retried on another replica"
+        finally:
+            rig.close()
+
+    def test_retry_budget_caps_total_reroutes(self):
+        """Whole-fleet outage (every replica 5xxing), one-token budget:
+        the first request spends it on a failover, the second gets NO
+        re-route — total upstream tries stay bounded at requests + budget,
+        so failover can never amplify an outage into a retry storm."""
+        sick = lambda verb, body: (500, {"error": "boom",
+                                         "cause": "internal"}, 0)
+        rig = _RouterRig([("rA", sick, {"budget": 2000}),
+                          ("rB", sick, {"budget": 1000})],
+                         retry_budget_cap=1.0, retry_budget_ratio=1e-6)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                rig.post("/v1/models/m/predict", {"ndarray": []})
+            assert ei.value.code == 500          # tried rA, then rB
+            assert rig.hits("rA") == ["predict"]
+            assert rig.hits("rB") == ["predict"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                rig.post("/v1/models/m/predict", {"ndarray": []})
+            assert ei.value.code == 500
+            assert len(rig.hits("rA")) == 2      # primary tried again...
+            assert len(rig.hits("rB")) == 1      # ...but NO second re-route
+            assert _counter_value(
+                rig.metrics, "cluster_retry_budget_spend_total",
+                {"outcome": "denied"}) == 1
+        finally:
+            rig.close()
+
+    def test_router_tenant_bucket_is_global(self):
+        """One bucket at the router: the 3rd request 429s without any
+        replica being consulted — quotas hold across the whole set."""
+        rig = _RouterRig([("rA", _ok("rA"), {"budget": 2000}),
+                          ("rB", _ok("rB"), {"budget": 1000})])
+        rig.router.tenants.register("capped", rate_per_s=0.001, burst=2.0)
+        try:
+            for _ in range(2):
+                status, _ = rig.post("/v1/models/m/predict",
+                                     {"ndarray": []}, tenant="capped")
+                assert status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                rig.post("/v1/models/m/predict", {"ndarray": []},
+                         tenant="capped")
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            assert len(rig.hits("rA")) + len(rig.hits("rB")) == 2
+        finally:
+            rig.close()
+
+
+class TestHedging:
+    def test_gold_hedge_first_response_wins_and_stitches_one_trace(self):
+        """A slow primary + hedge_ms=40: the hedge answers first, the
+        client sees its response well before the primary's sleep ends, and
+        the request's flight record holds BOTH attempt stages under one
+        trace id — the stitched-track acceptance shape."""
+        flight = FlightRecorder()
+        reqtrace.install(reqtrace.RequestTracer(flight=flight))
+        slow = lambda verb, body: (200, {"served_by": "rA"}, 0.8)
+        rig = _RouterRig([("rA", slow, {"budget": 2000}),
+                          ("rB", _ok("rB"), {"budget": 1000})],
+                         hedge_ms=40.0)
+        rig.router.tenants.register("vip", rate_per_s=100.0, slo="gold")
+        try:
+            t0 = time.monotonic()
+            status, body = rig.post("/v1/models/m/predict", {"ndarray": []},
+                                    tenant="vip")
+            elapsed = time.monotonic() - t0
+            assert status == 200 and body["served_by"] == "rB"
+            assert elapsed < 0.7, "winner was not first-response"
+            assert _counter_value(rig.metrics, "cluster_hedges_total",
+                                  {"outcome": "launched"}) == 1
+            assert _counter_value(rig.metrics, "cluster_hedges_total",
+                                  {"outcome": "won"}) == 1
+            rec = next(r for r in flight.requests()
+                       if r["kind"] == "route:predict")
+            attempts = [s for s in rec["stages"] if s["name"] == "attempt"]
+            assert len(attempts) >= 2, "hedge attempt missing from trace"
+            assert {a["args"]["replica"] for a in attempts} == {"rA", "rB"}
+            assert {a["args"]["hedge"] for a in attempts} == {False, True}
+        finally:
+            rig.close()
+            reqtrace.uninstall()
+
+    def test_standard_class_never_hedges(self):
+        slowish = lambda verb, body: (200, {"served_by": "rA"}, 0.2)
+        rig = _RouterRig([("rA", slowish, {"budget": 2000}),
+                          ("rB", _ok("rB"), {"budget": 1000})],
+                         hedge_ms=40.0)
+        try:
+            status, body = rig.post("/v1/models/m/predict", {"ndarray": []})
+            assert status == 200 and body["served_by"] == "rA"
+            assert rig.hits("rB") == []
+            assert "cluster_hedges_total" not in rig.metrics.to_prometheus()
+        finally:
+            rig.close()
+
+
+class TestChaosTransportScope:
+    def test_scoped_partition_faults_one_replica_only(self):
+        """``cluster.transport:error:type=connection,scope=rA`` makes every
+        hop to rA fail while rB keeps serving — the smoke's partition
+        drill, asserted at the seam."""
+        rig = _RouterRig([("rA", _ok("rA"), {"budget": 2000}),
+                          ("rB", _ok("rB"), {"budget": 1000})])
+        plane = chaos_faults.install(chaos_faults.FaultPlane(seed=0))
+        try:
+            plane.inject_spec(
+                "cluster.transport:error:type=connection,scope=rA,times=-1")
+            status, body = rig.post("/v1/models/m/predict", {"ndarray": []})
+            assert status == 200 and body["served_by"] == "rB"
+            assert rig.hits("rA") == []          # partitioned before TCP
+            # heartbeats run through the same seam: rA is demoted
+            states = rig.router.poll_once()
+            assert states["rA"] == SUSPECT and states["rB"] == ALIVE
+            chaos_faults.uninstall()
+            rig.router.poll_once()               # partition heals
+            assert rig.router.membership.state("rA") == ALIVE
+        finally:
+            chaos_faults.uninstall()
+            rig.close()

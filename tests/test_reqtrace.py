@@ -480,9 +480,10 @@ class TestFleetTracingEndToEnd:
         lm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=32,
                       num_heads=4, vocab=50).build()
         lm.init()
-        # deadline comfortably above a CPU compile pause, far below the
+        # deadline comfortably above a CPU compile pause (which can stretch
+        # past 2s when the whole suite loads the machine), far below the
         # injected hang — the warm pass must not trip a false stall
-        fleet = FleetRegistry(watchdog_s=2.0)
+        fleet = FleetRegistry(watchdog_s=3.0)
         fleet.add("g", lm, gen_opts={"slots": 2, "capacity": 24, "seed": 0})
         tracer = Tracer()
         reqtrace_mod.install(RequestTracer(

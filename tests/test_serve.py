@@ -32,6 +32,8 @@ Paged-KV + chunked-prefill properties (ISSUE 5):
 
 import concurrent.futures as cf
 import json
+import socket
+import struct
 import threading
 import time
 import urllib.error
@@ -888,6 +890,53 @@ class TestStreaming:
             # typed status, not an SSE body
             assert ei.value.code == 400  # CapacityError
             assert json.loads(ei.value.read())["cause"] == "over_capacity"
+        finally:
+            srv.stop()
+
+    def test_client_disconnect_mid_sse_frees_slot(self, lm):
+        """ISSUE 10 satellite: a client that drops the socket mid-stream is
+        shed load (``serve_shed_total{cause="client_gone"}``), the decode
+        slot is reclaimed, and nothing lands in serve_http_errors_total."""
+        srv = ModelServer(lm, port=0, input_dtype=np.int32, gen_slots=1,
+                          gen_capacity=64).start()
+        try:
+            body = json.dumps({"prompt": list(range(2, 8)),
+                               "max_new_tokens": 40}).encode()
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+            s.sendall(b"POST /generate HTTP/1.1\r\nHost: t\r\n"
+                      b"Content-Type: application/json\r\n"
+                      + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                      + body)
+            buf = b""
+            while buf.count(b"data: ") < 2:  # the stream is live
+                buf += s.recv(4096)
+            # SO_LINGER(0): close sends RST, so the server's next flush
+            # fails immediately instead of filling the kernel buffer
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct.pack("ii", 1, 0))
+            s.close()
+            shed = srv.metrics.counter("serve_shed_total",
+                                       {"cause": "client_gone"})
+            slots = srv.metrics.gauge("serve_gen_active_slots")
+            deadline = time.monotonic() + 15
+            while ((shed.value < 1 or slots.value > 0)
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert shed.value == 1, "disconnect was not counted as shed"
+            assert slots.value == 0, "decode slot still held by a dead client"
+            # slot actually reusable: a fresh generation completes
+            breq = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate?stream=false",
+                data=json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4,
+                                 "temperature": 0.0}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(breq, timeout=30) as r:
+                assert len(json.loads(r.read())["tokens"]) == 4
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=10).read().decode()
+            assert "serve_http_errors_total" not in scrape, \
+                "client disconnect was misfiled as a server error"
         finally:
             srv.stop()
 
