@@ -334,15 +334,20 @@ def _bench_chunked_prefill(model, seconds):
     return {"chunked": chunked, "unchunked": whole}
 
 
-def _stamp(headline: dict, source: str) -> dict:
+def _stamp(headline: dict, source: str,
+           workload_fp: "str | None" = None) -> dict:
     """Top-level provenance on every written round file: which bench entry
     produced it and when. BENCH_LAST.json may be replayed as an explicitly
     stale fallback when the TPU tunnel is wedged (_probe_devices), so the
     capture date must ride at the top level of every artifact, not buried
     in detail — a reader deciding whether a number is current should not
-    have to know each bench's detail schema."""
+    have to know each bench's detail schema. ``workload_fp`` (sim/
+    workload.py) additionally stamps WHICH offered-load mix produced the
+    numbers: two rounds are comparable iff their fingerprints match."""
     headline["source"] = source
     headline["captured"] = time.strftime("%Y-%m-%d")
+    if workload_fp is not None:
+        headline["workload_fingerprint"] = workload_fp
     return headline
 
 
@@ -740,7 +745,26 @@ def _bench_fleet():
             "captured": time.strftime("%Y-%m-%d"),
         },
     }
-    _stamp(headline, "bench.py --fleet")
+    # Scenario descriptor for comparability: a WorkloadSpec capturing the
+    # offered mix (models, tenant/SLO weights, fixed lengths, window).
+    # base_rate_rps=0 marks it closed-loop — the clients here are paced by
+    # service completions, not a trace — but the fingerprint still pins the
+    # mix, so two BENCH_fleet rounds are comparable iff fingerprints match.
+    from deeplearning4j_tpu.sim import LengthDist, WorkloadSpec
+    wl_spec = WorkloadSpec(
+        seed=0, duration_s=seconds, base_rate_rps=0.0,
+        prompt_len=LengthDist("fixed", 16, 0.0, 16),
+        output_len=LengthDist("fixed", gen_tokens, 0.0, max(1, gen_tokens)),
+        vocab=50,
+        tenants={"gold": {"weight": 2.0, "slo": "gold"},
+                 "standard": {"weight": 1.0, "slo": "standard"},
+                 "free": {"weight": 1.0, "slo": "batch"},
+                 "knn": {"weight": 1.0, "slo": "standard"}},
+        models={"alpha": {"weight": 1.0, "generate_frac": 0.0},
+                "beta": {"weight": 1.0, "generate_frac": 0.0},
+                "gamma": {"weight": 1.0, "generate_frac": 1.0},
+                "knn": {"weight": 1.0, "generate_frac": 0.0}})
+    _stamp(headline, "bench.py --fleet", workload_fp=wl_spec.fingerprint())
     print(json.dumps(headline), flush=True)
     out_path = _next_round_path("BENCH_fleet")
     with open(out_path, "w") as f:

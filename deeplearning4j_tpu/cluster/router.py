@@ -156,9 +156,13 @@ class ClusterRouter(JsonHTTPServerMixin):
                  heartbeat_s: float = 0.5, hedge_ms: Optional[float] = 250.0,
                  retry_budget_ratio: float = 0.1,
                  retry_budget_cap: float = 10.0,
-                 http_timeout_s: float = 30.0, clock=time.monotonic):
+                 http_timeout_s: float = 30.0, clock=time.monotonic,
+                 jitter_rng=None):
         self.host = host
         self.port = port
+        # injectable Retry-After jitter source (None = process-global RNG);
+        # replays pass random.Random(seed) for bit-deterministic backoff
+        self.jitter_rng = jitter_rng
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.membership = Membership(
             suspect_after_s=suspect_after_s, dead_after_s=dead_after_s,
@@ -504,7 +508,8 @@ class ClusterRouter(JsonHTTPServerMixin):
             handler.route_err(503, {
                 "error": f"no replica reachable for model {name!r}",
                 "cause": "upstream_unreachable"},
-                headers={"Retry-After": jitter_retry_after(1.0)})
+                headers={"Retry-After": jitter_retry_after(
+                    1.0, self.jitter_rng)})
         return "error"
 
     def _reply_upstream(self, handler, att: _Attempt,
@@ -587,7 +592,8 @@ class ClusterRouter(JsonHTTPServerMixin):
             handler.route_err(503, {
                 "error": f"no replica reachable for model {name!r}",
                 "cause": "upstream_unreachable"},
-                headers={"Retry-After": jitter_retry_after(1.0)})
+                headers={"Retry-After": jitter_retry_after(
+                    1.0, self.jitter_rng)})
         return "error"
 
     def _pump_sse(self, handler, conn, resp, ctx, t0_ns: int,
@@ -744,7 +750,8 @@ class ClusterRouter(JsonHTTPServerMixin):
                         {"error": str(e), "cause": e.cause,
                          "tenant": self._tenant()},
                         headers={"Retry-After":
-                                 jitter_retry_after(e.retry_after_s)})
+                                 jitter_retry_after(e.retry_after_s,
+                                                    server.jitter_rng)})
                     server._requests_total("quota").inc()
                     if ctx is not None:
                         ctx.finish(error=e.cause)
@@ -752,7 +759,8 @@ class ClusterRouter(JsonHTTPServerMixin):
                     headers = None
                     if e.http_status == 503:
                         headers = {"Retry-After": jitter_retry_after(
-                            getattr(e, "retry_after_s", None) or 1.0)}
+                            getattr(e, "retry_after_s", None) or 1.0,
+                            server.jitter_rng)}
                     self.route_err(e.http_status,
                                    {"error": str(e), "cause": e.cause},
                                    headers=headers)

@@ -64,8 +64,11 @@ class FleetServer(JsonHTTPServerMixin):
 
     def __init__(self, fleet: FleetRegistry, *, host: str = "127.0.0.1",
                  port: int = 9020, replica_id: Optional[str] = None,
-                 chaos_admin: bool = False):
+                 chaos_admin: bool = False, jitter_rng=None):
         self.fleet = fleet
+        # injectable Retry-After jitter source (None = process-global RNG);
+        # replays pass random.Random(seed) for bit-deterministic backoff
+        self.jitter_rng = jitter_rng
         self.host = host
         self.port = port
         # cluster identity: who this process is in a replica set. The id
@@ -126,7 +129,7 @@ class FleetServer(JsonHTTPServerMixin):
                 depth, limit = eng.queue_depth(), eng.queue_limit
             except ServeError:
                 pass
-        return retry_after_s(depth, limit)
+        return retry_after_s(depth, limit, self.jitter_rng)
 
     # ------------------------------------------------------------- handler
     def _handler(self):
@@ -266,7 +269,9 @@ class FleetServer(JsonHTTPServerMixin):
                               {"error": str(e), "cause": e.cause,
                                "tenant": self._tenant()},
                               headers={"Retry-After":
-                                       jitter_retry_after(e.retry_after_s)})
+                                       jitter_retry_after(
+                                           e.retry_after_s,
+                                           server.jitter_rng)})
                     if ctx is not None:
                         ctx.finish(error=e.cause)
                 except ServeError as e:
@@ -278,7 +283,8 @@ class FleetServer(JsonHTTPServerMixin):
                         # the depth-derived estimate
                         retry = getattr(e, "retry_after_s", None)
                         headers = {"Retry-After":
-                                   jitter_retry_after(retry)
+                                   jitter_retry_after(retry,
+                                                      server.jitter_rng)
                                    if retry is not None
                                    else server._retry_after(name)}
                     self._err(e.http_status,

@@ -273,11 +273,24 @@ class FleetRegistry:
                  tenants: Optional[TenantTable] = None,
                  breaker_failures: Optional[int] = 5,
                  breaker_reset_s: float = 10.0, breaker_clock=None,
-                 watchdog_s: Optional[float] = None):
+                 watchdog_s: Optional[float] = None,
+                 tuned_for: Optional[str] = None):
         from ..obs.metrics import MetricsRegistry
 
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.aot_store = aot_store
+        # tuned_for: a workload fingerprint (sim/workload.py). When set, the
+        # boot resolves the autotuner's winning knob set for (this runtime,
+        # that workload) from the AOT store — the same place the compiled
+        # executables come from — and every add() starts from those knobs.
+        # A miss (counted on sim_tuned_config_misses_total) means hand-picked
+        # defaults, exactly as before.
+        self.tuned_config: Optional[dict] = None
+        if tuned_for is not None:
+            from ..aot.tuned import get_tuned
+
+            self.tuned_config = get_tuned(aot_store, tuned_for,
+                                          metrics=self.metrics)
         self.tenants = tenants if tenants is not None \
             else TenantTable(metrics=self.metrics)
         self.pager = WeightPager(hbm_budget_bytes, metrics=self.metrics)
@@ -318,7 +331,20 @@ class FleetRegistry:
             eager: bool = False) -> FleetEntry:
         """Register a model under ``name``. Weights default to the model's
         own initialized params. ``eager=True`` pages it in immediately;
-        otherwise the first request does."""
+        otherwise the first request does. With a resolved tuned config
+        (``tuned_for=``), its engine/gen groups become the per-model
+        defaults — explicit ``engine_opts``/``gen_opts`` keys still win."""
+        if self.tuned_config is not None:
+            from ..serve.continuous import gen_opts_from_config
+            from ..serve.engine import ENGINE_KNOBS
+
+            tuned_engine = {
+                k: v
+                for k, v in (self.tuned_config.get("engine") or {}).items()
+                if k in ENGINE_KNOBS}
+            engine_opts = {**tuned_engine, **(engine_opts or {})}
+            gen_opts = {**gen_opts_from_config(self.tuned_config),
+                        **(gen_opts or {})}
         entry = FleetEntry(
             name, model,
             params if params is not None else model.params,

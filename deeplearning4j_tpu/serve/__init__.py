@@ -28,7 +28,8 @@ from .errors import (CapacityError, DeadlineExceededError, DrainTimeoutError,
                      PublishError, ServeError, ServerClosingError, ShedError,
                      WorkerStallError)
 from .health import Health
-from .http import ModelServer
+from .http import (ModelServer, jitter_retry_after, retry_after_s,
+                   seed_retry_jitter)
 from .paged import BlockAllocator, SlotPages
 from .registry import ModelRegistry, ModelSnapshot
 from .watchdog import Watchdog
@@ -38,4 +39,5 @@ __all__ = ["BlockAllocator", "CapacityError", "ContinuousBatcher",
            "ModelRegistry", "ModelServer", "ModelSnapshot",
            "PrefillScheduler", "PublishError", "ServeEngine", "ServeError",
            "ServerClosingError", "ShedError", "SlotPages", "Watchdog",
-           "WorkerStallError"]
+           "WorkerStallError", "jitter_retry_after", "retry_after_s",
+           "seed_retry_jitter"]

@@ -74,6 +74,32 @@ def _default_prompt_buckets(capacity: int) -> tuple:
     return tuple(sorted(set(buckets)))
 
 
+# Constructor knobs a tuned config (aot/tuned.py) may set on the batcher.
+# Unknown keys in a stored "gen" group are dropped, so configs written by a
+# newer tuner never break an older binary at boot.
+GEN_KNOBS = frozenset({"slots", "capacity", "kv", "block_size", "kv_blocks",
+                       "prefill_chunk", "prompt_buckets", "queue_limit",
+                       "seed"})
+
+
+def gen_opts_from_config(config: Optional[dict]) -> dict:
+    """The ``gen`` group of a tuned config as ContinuousBatcher kwargs.
+
+    The scheduler's ``decode_chunks``/``idle_chunks`` are stored as plain
+    values (the config is JSON) and folded into a ``PrefillScheduler``
+    here; everything else passes through filtered by :data:`GEN_KNOBS`.
+    """
+    group = dict((config or {}).get("gen") or {})
+    decode_chunks = group.pop("decode_chunks", None)
+    idle_chunks = group.pop("idle_chunks", None)
+    opts = {k: v for k, v in group.items() if k in GEN_KNOBS}
+    if decode_chunks is not None or idle_chunks is not None:
+        opts["scheduler"] = PrefillScheduler(
+            decode_chunks=int(1 if decode_chunks is None else decode_chunks),
+            idle_chunks=int(4 if idle_chunks is None else idle_chunks))
+    return opts
+
+
 class _GenRequest:
     """One queued/in-flight generation."""
 
@@ -534,6 +560,23 @@ class ContinuousBatcher:
             self.registry.add_warmer(self._warm_for)
 
         self._spawn_worker()
+
+    @classmethod
+    def from_tuned(cls, model, aot_store, workload_fingerprint: str, *,
+                   registry=None, params=None, state=None, metrics=None,
+                   model_name=None, **overrides) -> "ContinuousBatcher":
+        """Boot with knobs resolved from the AOT store's tuned config for
+        (current runtime fingerprint, ``workload_fingerprint``) — see
+        ``aot/tuned.py``. Explicit ``overrides`` win; a miss boots the
+        constructor defaults."""
+        from ..aot.tuned import get_tuned
+
+        config = get_tuned(aot_store, workload_fingerprint, metrics=metrics)
+        opts = gen_opts_from_config(config)
+        opts.update(overrides)
+        return cls(model, registry=registry, params=params, state=state,
+                   metrics=metrics, aot_store=aot_store,
+                   model_name=model_name, **opts)
 
     def _spawn_worker(self) -> None:
         self._hb = time.monotonic()

@@ -126,6 +126,13 @@ class PrefillScheduler:
         return order[:budget]
 
 
+# Constructor knobs a tuned config (aot/tuned.py) may set on ServeEngine.
+# Anything else in a stored "engine" group is ignored, so an old binary can
+# resolve a config written by a newer tuner without crashing on boot.
+ENGINE_KNOBS = frozenset({"batch_buckets", "length_buckets", "queue_limit",
+                          "max_wait_ms", "default_timeout_ms", "admission"})
+
+
 class ServeEngine:
     """Micro-batching inference engine over a :class:`ModelRegistry`.
 
@@ -244,6 +251,25 @@ class ServeEngine:
                 self.registry.add_warmer(self._warm_candidate)
 
         self._spawn_worker()
+
+    @classmethod
+    def from_tuned(cls, model, aot_store, workload_fingerprint: str, *,
+                   registry=None, params=None, state=None, metrics=None,
+                   model_name=None, **overrides) -> "ServeEngine":
+        """Boot with knobs resolved from the AOT store's tuned config for
+        (current runtime fingerprint, ``workload_fingerprint``) — see
+        ``aot/tuned.py``. Explicit keyword ``overrides`` always win over
+        the stored config; a miss boots the constructor defaults, so this
+        is safe to call unconditionally."""
+        from ..aot.tuned import get_tuned
+
+        config = get_tuned(aot_store, workload_fingerprint, metrics=metrics)
+        opts = {k: v for k, v in ((config or {}).get("engine") or {}).items()
+                if k in ENGINE_KNOBS}
+        opts.update(overrides)
+        return cls(model, registry=registry, params=params, state=state,
+                   metrics=metrics, aot_store=aot_store,
+                   model_name=model_name, **opts)
 
     def _spawn_worker(self) -> None:
         self._hb = time.monotonic()

@@ -59,8 +59,18 @@ _BAD_REQUEST = (KeyError, ValueError, TypeError, AttributeError,
                 json.JSONDecodeError)
 
 
-#: Module RNG behind Retry-After jitter; tests reseed it for determinism.
+#: Module RNG behind Retry-After jitter — the fallback when a server was
+#: built without its own ``jitter_rng``. Replays/tuner evaluations inject a
+#: seeded ``random.Random`` per server (or call :func:`seed_retry_jitter`)
+#: so backoff hints are bit-deterministic by seed.
 _JITTER_RNG = random.Random()
+
+
+def seed_retry_jitter(seed: int) -> None:
+    """Reseed the module-level fallback jitter RNG (process-global). For
+    per-server determinism without cross-talk, pass ``jitter_rng=`` to the
+    server/router constructors instead."""
+    _JITTER_RNG.seed(int(seed))
 
 
 def jitter_retry_after(seconds: float, rng=None) -> int:
@@ -150,8 +160,11 @@ class ModelServer(JsonHTTPServerMixin):
                  gen_prefill_chunk: Optional[int] = 64,
                  seed: int = 0, metrics: Optional[MetricsRegistry] = None,
                  aot_store=None, watchdog_s: Optional[float] = None,
-                 chaos_admin: bool = False):
+                 chaos_admin: bool = False, jitter_rng=None):
         self.model = model
+        # injectable Retry-After jitter source (None = process-global RNG);
+        # replays pass random.Random(seed) for bit-deterministic backoff
+        self.jitter_rng = jitter_rng
         # debug-only surface: /v1/debug/chaos answers 404 unless opted in,
         # so a production front door never exposes fault injection
         self.chaos_admin = bool(chaos_admin)
@@ -245,7 +258,7 @@ class ModelServer(JsonHTTPServerMixin):
         if batcher is not None:
             depth += batcher.queue_depth()
             limit += batcher.queue_limit
-        return retry_after_s(depth, limit)
+        return retry_after_s(depth, limit, self.jitter_rng)
 
     # --- handler ---
     def _handler(self):
@@ -355,7 +368,8 @@ class ModelServer(JsonHTTPServerMixin):
                     if e.http_status == 503:
                         retry = getattr(e, "retry_after_s", None)
                         headers = {"Retry-After":
-                                   jitter_retry_after(retry)
+                                   jitter_retry_after(retry,
+                                                      server.jitter_rng)
                                    if retry is not None
                                    else server._retry_after()}
                     self._err(e.http_status,

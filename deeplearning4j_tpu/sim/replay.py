@@ -1,0 +1,440 @@
+"""Open-loop trace replay — virtual (deterministic) and live modes.
+
+Both replayers consume the same :class:`~.workload.Trace` and emit the
+same :func:`~.score.summarize` report, but they answer different
+questions:
+
+- :class:`VirtualReplayer` is a **discrete-event model** of the serving
+  stack (batch-window predict queue, slot/KV-block generate path with
+  chunked prefill, LRU weight paging). It is bit-deterministic — same
+  trace + same knobs + same cost model ⇒ byte-identical report — and
+  runs thousands of events per millisecond, which is what makes
+  successive-halving autotuning (``sim/tune.py``) affordable. Its cost
+  model is calibrated roughly to the CPU smoke stack; it predicts knob
+  *orderings*, not absolute latencies.
+- :class:`LiveReplayer` drives a real in-process
+  :class:`~..fleet.registry.FleetRegistry` (via :class:`FleetTarget`)
+  at trace-scheduled wall times, **never closed-loop**: an event fires
+  at ``t0 + time_scale * event.t_s`` whether or not earlier requests
+  have finished, so queue growth under overload is visible exactly as
+  production would see it. Fates come back as the same typed causes the
+  HTTP tier maps (``serve/errors.py``), so one scorer serves both modes.
+
+The knob dictionary mirrors the real constructor surfaces: the
+``engine`` group splats into :class:`~..serve.engine.ServeEngine`, the
+``gen`` group into :class:`~..serve.continuous.ContinuousBatcher`
+(``decode_chunks``/``idle_chunks`` fold into a ``PrefillScheduler``),
+``fleet``/``cluster`` groups carry pager and router knobs. The same
+nested dict is what the tuner persists into the AOT store.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from .score import Outcome, summarize
+from .workload import Event, Trace, prompt_tokens
+
+# Hand-picked defaults — the same values the serve/fleet constructors
+# default to today. Candidate 0 of every tuner search.
+DEFAULT_KNOBS: Dict[str, dict] = {
+    "engine": {"batch_buckets": [1, 2, 4, 8, 16, 32],
+               "queue_limit": 256, "max_wait_ms": 2.0},
+    "gen": {"slots": 4, "capacity": 256, "block_size": 16, "kv_blocks": None,
+            "prefill_chunk": 64, "queue_limit": 64,
+            "decode_chunks": 1, "idle_chunks": 4},
+    # resident_models: how many models fit the pager's HBM budget at once
+    # (None = all of them — paging never evicts)
+    "fleet": {"resident_models": None},
+    # recorded pass-through for the router tier; the virtual model does not
+    # differentiate them (documented in sim/README.md)
+    "cluster": {"hedge_ms": 30.0, "retry_budget_per_s": 2.0},
+}
+
+
+def merge_knobs(base: dict, override: Optional[dict]) -> dict:
+    """Two-level merge: override group/key wins, base fills the rest."""
+    out = {g: dict(v) for g, v in base.items()}
+    for g, v in (override or {}).items():
+        out.setdefault(g, {}).update(v or {})
+    return out
+
+
+def flatten_knobs(knobs: dict) -> Dict[str, object]:
+    """``{"gen": {"slots": 4}}`` -> ``{"gen.slots": 4}`` (tuner space keys)."""
+    flat: Dict[str, object] = {}
+    for g in sorted(knobs):
+        for k in sorted(knobs[g]):
+            flat[f"{g}.{k}"] = knobs[g][k]
+    return flat
+
+
+def set_flat(knobs: dict, dotted: str, value) -> None:
+    group, key = dotted.split(".", 1)
+    knobs.setdefault(group, {})[key] = value
+
+
+class CostModel(NamedTuple):
+    """Virtual-time costs. Defaults are CPU-smoke-ish (PERF.md): they rank
+    configs the way the live CPU stack does; recalibrate on real TPUs."""
+
+    predict_row_s: float = 2e-4       # per padded batch row
+    predict_dispatch_s: float = 1.5e-3  # per device dispatch
+    prefill_tok_s: float = 4000.0     # prefill throughput, tokens/s
+    chunk_dispatch_s: float = 1e-3    # per prefill chunk overhead
+    decode_base_s: float = 4e-3       # decode step, empty batch
+    decode_slot_s: float = 1e-3       # decode step marginal cost per slot
+    page_in_s: float = 0.5            # weight page-in (host -> device + warm)
+
+
+def _blocks_needed(tokens: int, block_size: int) -> int:
+    return -(-max(1, tokens) // max(1, block_size))
+
+
+def _shed(ev: Event, cause: str) -> Outcome:
+    return Outcome(False, cause, ev.slo, ev.model, ev.kind,
+                   None, None, None, 0)
+
+
+class VirtualReplayer:
+    """Deterministic discrete-event replay of a trace against one knob set.
+
+    Per model, the predict path is a single batching server (window =
+    first-arrival + ``max_wait_ms``, dispatch pads to the smallest bucket
+    that fits) and the generate path is a slot + KV-block pool with
+    chunked prefill contending against running decodes — the same shape,
+    sheds, and knob tradeoffs as the live engine, in virtual time.
+    """
+
+    def __init__(self, trace: Trace, knobs: Optional[dict] = None,
+                 cost_model: Optional[CostModel] = None):
+        self.trace = trace
+        self.knobs = merge_knobs(DEFAULT_KNOBS, knobs)
+        self.cm = cost_model if cost_model is not None else CostModel()
+
+    # ---------------------------------------------------------------- paging
+    def _residency_adjusted(self) -> List[Tuple[float, Event]]:
+        """Effective arrival times after LRU weight paging: a request to a
+        cold model waits for the (serial) pager before it reaches a queue."""
+        budget = self.knobs["fleet"].get("resident_models")
+        models = {e.model for e in self.trace}
+        if not budget or int(budget) >= len(models):
+            return [(e.t_s, e) for e in self.trace]
+        budget = int(budget)
+        resident: "OrderedDict[str, float]" = OrderedDict()
+        pager_free = 0.0
+        out: List[Tuple[float, Event]] = []
+        for ev in self.trace:
+            t = ev.t_s
+            if ev.model in resident:
+                resident.move_to_end(ev.model)
+                out.append((max(t, resident[ev.model]), ev))
+                continue
+            ready = max(t, pager_free) + self.cm.page_in_s
+            pager_free = ready
+            if len(resident) >= budget:
+                resident.popitem(last=False)
+            resident[ev.model] = ready
+            out.append((ready, ev))
+        return out
+
+    # --------------------------------------------------------------- predict
+    def _sim_predict(self, items: List[Tuple[float, Event]],
+                     out: List[Outcome]) -> None:
+        eng = self.knobs["engine"]
+        cm = self.cm
+        buckets = sorted(int(b) for b in eng["batch_buckets"])
+        maxb = buckets[-1]
+        qlimit = int(eng["queue_limit"])
+        wait = float(eng["max_wait_ms"]) / 1e3
+        pending: deque = deque()
+        t_free = 0.0
+        i, n = 0, len(items)
+        while i < n or pending:
+            if not pending:
+                pending.append(items[i])
+                i += 1
+                continue
+            first_t = pending[0][0]
+            ready = max(t_free,
+                        first_t if len(pending) >= maxb else first_t + wait)
+            if i < n and items[i][0] <= ready:
+                eff, ev = items[i]
+                i += 1
+                if len(pending) >= qlimit:
+                    out.append(_shed(ev, "queue_full"))
+                else:
+                    pending.append((eff, ev))
+                continue
+            take = min(len(pending), maxb)
+            batch = [pending.popleft() for _ in range(take)]
+            live = []
+            for eff, ev in batch:
+                dl = ev.deadline_s()
+                if dl is not None and ready > dl:
+                    out.append(_shed(ev, "deadline"))
+                else:
+                    live.append(ev)
+            if not live:
+                continue
+            bucket = next(b for b in buckets if b >= len(live))
+            t_free = ready + cm.predict_dispatch_s + bucket * cm.predict_row_s
+            for ev in live:
+                dl = ev.deadline_s()
+                if dl is not None and t_free > dl:
+                    out.append(_shed(ev, "deadline"))
+                else:
+                    out.append(Outcome(True, None, ev.slo, ev.model,
+                                       "predict", t_free - ev.t_s,
+                                       None, None, 0))
+
+    # -------------------------------------------------------------- generate
+    def _sim_generate(self, items: List[Tuple[float, Event]],
+                      out: List[Outcome],
+                      util: List[float]) -> None:
+        g = self.knobs["gen"]
+        cm = self.cm
+        slots = max(1, int(g["slots"]))
+        capacity = max(1, int(g["capacity"]))
+        bs = max(1, int(g["block_size"]))
+        per_seq = _blocks_needed(capacity, bs)
+        total_blocks = (int(g["kv_blocks"]) if g.get("kv_blocks")
+                        else slots * per_seq + 1)
+        chunk = max(1, int(g["prefill_chunk"] or capacity))
+        dc = max(1, int(g.get("decode_chunks", 1)))
+        qlimit = max(1, int(g["queue_limit"]))
+        active: list = []          # heap of (done_t, seq, blocks)
+        blocks_used = 0
+        waiting: deque = deque()
+
+        def release(upto: float) -> None:
+            nonlocal blocks_used
+            while active and active[0][0] <= upto:
+                _, _, b = heapq.heappop(active)
+                blocks_used -= b
+
+        def try_start(now: float) -> None:
+            nonlocal blocks_used
+            while waiting:
+                eff, ev = waiting[0]
+                need = _blocks_needed(ev.prompt_len + ev.max_new_tokens, bs)
+                if len(active) >= slots or blocks_used + need > total_blocks:
+                    return
+                waiting.popleft()
+                start = max(now, eff)
+                dl = ev.deadline_s()
+                if dl is not None and start > dl:
+                    out.append(_shed(ev, "deadline"))
+                    continue
+                nact = len(active) + 1
+                decode_tick = cm.decode_base_s + cm.decode_slot_s * nact
+                nchunks = _blocks_needed(ev.prompt_len, chunk)
+                prefill = (ev.prompt_len / cm.prefill_tok_s
+                           + nchunks * cm.chunk_dispatch_s)
+                if len(active) > 0:
+                    # chunked prefill yields to running decodes every
+                    # `decode_chunks` chunks — small chunks prefill slower
+                    prefill += (nchunks / dc) * decode_tick
+                # decode ticks stretch while *other* requests prefill:
+                # large chunks stall decodes longer, queue pressure makes
+                # overlap more likely
+                pressure = min(1.0, len(waiting) / slots)
+                stall = pressure * (chunk / cm.prefill_tok_s
+                                    + cm.chunk_dispatch_s) / dc
+                itl = decode_tick + stall
+                ttft = (start - ev.t_s) + prefill + itl
+                done = start + prefill + ev.max_new_tokens * itl
+                heapq.heappush(active, (done, ev.seq, need))
+                blocks_used += need
+                util.append(blocks_used / total_blocks)
+                if dl is not None and done > dl:
+                    out.append(Outcome(False, "deadline", ev.slo, ev.model,
+                                       "generate", None, ttft, itl, 0))
+                else:
+                    out.append(Outcome(True, None, ev.slo, ev.model,
+                                       "generate", done - ev.t_s, ttft, itl,
+                                       ev.max_new_tokens))
+
+        for eff, ev in items:
+            release(eff)
+            try_start(eff)  # completions freed slots: drain the queue first
+            need = _blocks_needed(ev.prompt_len + ev.max_new_tokens, bs)
+            if (ev.prompt_len + ev.max_new_tokens > capacity
+                    or need > total_blocks):
+                out.append(_shed(ev, "over_capacity"))
+                continue
+            if len(waiting) >= qlimit:
+                out.append(_shed(ev, "queue_full"))
+                continue
+            waiting.append((eff, ev))
+            try_start(eff)
+        while waiting:
+            if active:
+                done_t = active[0][0]
+                release(done_t)
+                try_start(done_t)
+                continue
+            # idle engine, non-empty queue: start from the queued arrival
+            try_start(waiting[0][0])
+            if not active and waiting:
+                # nothing startable even when idle — impossible given the
+                # admission capacity check, but never spin
+                _, ev = waiting.popleft()
+                out.append(_shed(ev, "over_capacity"))
+
+    # ------------------------------------------------------------------- run
+    def run(self) -> dict:
+        arrivals = self._residency_adjusted()
+        by_mk: Dict[Tuple[str, str], List[Tuple[float, Event]]] = {}
+        for eff, ev in arrivals:
+            by_mk.setdefault((ev.model, ev.kind), []).append((eff, ev))
+        outcomes: List[Outcome] = []
+        util: List[float] = []
+        for key in sorted(by_mk):
+            items = sorted(by_mk[key], key=lambda p: (p[0], p[1].seq))
+            if key[1] == "generate":
+                self._sim_generate(items, outcomes, util)
+            else:
+                self._sim_predict(items, outcomes)
+        return summarize(
+            self.trace.fingerprint(), outcomes, mode="virtual",
+            knobs=self.knobs,
+            kv_peak_utilization=max(util) if util else 0.0,
+            kv_mean_utilization=(sum(util) / len(util)) if util else 0.0)
+
+
+class FleetTarget:
+    """Adapter: trace events -> in-process :class:`FleetRegistry` calls.
+
+    Predict prompts are padded/cropped to the model's fixed input length;
+    generate prompts keep their traced lengths (prompt buckets pad).
+    Every failure maps to its typed ``ServeError.cause`` — an untyped
+    exception is recorded as ``internal`` and fails the smoke's
+    typed-errors-only gate.
+    """
+
+    def __init__(self, registry, *, input_len: int = 16, vocab: int = 50):
+        self.registry = registry
+        self.input_len = int(input_len)
+        self.vocab = int(vocab)
+
+    def kv_utilization(self) -> Tuple[float, float]:
+        """(peak, mean) of serve_kv_block_utilization over resident models."""
+        try:
+            snap = self.registry.metrics.snapshot()
+        except Exception:  # scrape is best-effort  # jaxlint: disable=broad-except
+            return (0.0, 0.0)
+        fam = snap.get("serve_kv_block_utilization") or {}
+        vals = [float(s.get("value", 0.0)) for s in fam.get("series", [])]
+        if not vals:
+            return (0.0, 0.0)
+        return (max(vals), sum(vals) / len(vals))
+
+    def _outcome(self, ev: Event, t0: float, err: Optional[BaseException],
+                 ttft: Optional[float] = None,
+                 itl: Optional[float] = None,
+                 tokens: int = 0) -> Outcome:
+        from ..serve.errors import ServeError
+
+        if err is None:
+            return Outcome(True, None, ev.slo, ev.model, ev.kind,
+                           time.monotonic() - t0, ttft, itl, tokens)
+        cause = err.cause if isinstance(err, ServeError) else "internal"
+        return Outcome(False, cause, ev.slo, ev.model, ev.kind,
+                       None, None, None, 0)
+
+    def predict(self, ev: Event) -> Outcome:
+        import numpy as np
+
+        toks = prompt_tokens(ev, self.vocab)[:self.input_len]
+        x = np.zeros((self.input_len,), dtype=np.int64)
+        x[:len(toks)] = toks
+        t0 = time.monotonic()
+        try:
+            self.registry.predict(ev.model, x, tenant=ev.tenant)
+        except Exception as e:  # mapped to a typed cause below  # jaxlint: disable=broad-except
+            return self._outcome(ev, t0, e)
+        return self._outcome(ev, t0, None)
+
+    def generate(self, ev: Event) -> Outcome:
+        import numpy as np
+
+        prompt = np.asarray(prompt_tokens(ev, self.vocab), dtype=np.int32)
+        t0 = time.monotonic()
+        try:
+            handle = self.registry.submit_generate(
+                ev.model, prompt, ev.max_new_tokens, tenant=ev.tenant)
+            ticks: List[float] = []
+            for _ in handle.stream():
+                ticks.append(time.monotonic())
+            handle.wait()
+        except Exception as e:  # mapped to a typed cause below  # jaxlint: disable=broad-except
+            return self._outcome(ev, t0, e)
+        ttft = (ticks[0] - t0) if ticks else None
+        itl = ((ticks[-1] - ticks[0]) / (len(ticks) - 1)
+               if len(ticks) > 1 else None)
+        return self._outcome(ev, t0, None, ttft=ttft, itl=itl,
+                             tokens=len(ticks))
+
+
+class LiveReplayer:
+    """Open-loop replay against a live target at trace-scheduled times.
+
+    Each event fires at ``t0 + time_scale * event.t_s`` on its own thread
+    regardless of whether earlier requests completed — the defining
+    property of open-loop load (a closed-loop client self-throttles under
+    overload and hides exactly the queueing the simulator exists to
+    expose). Wall-clock results are *not* deterministic; determinism
+    claims live in the virtual mode. ``time_scale`` defaults to the
+    spec's own compression factor.
+    """
+
+    def __init__(self, trace: Trace, target, *,
+                 time_scale: Optional[float] = None,
+                 join_timeout_s: float = 60.0):
+        self.trace = trace
+        self.target = target
+        self.time_scale = (trace.spec.time_scale if time_scale is None
+                           else float(time_scale))
+        self.join_timeout_s = float(join_timeout_s)
+        self._lock = threading.Lock()
+        self._outcomes: Dict[int, Outcome] = {}
+
+    def _fire(self, idx: int, ev: Event) -> None:
+        try:
+            out = (self.target.generate(ev) if ev.kind == "generate"
+                   else self.target.predict(ev))
+        except Exception:  # a target bug scores as untyped, never hangs the run  # jaxlint: disable=broad-except
+            out = _shed(ev, "internal")
+        with self._lock:
+            self._outcomes[idx] = out
+
+    def run(self) -> dict:
+        t0 = time.monotonic()
+        threads: List[threading.Thread] = []
+        for idx, ev in enumerate(self.trace):
+            delay = t0 + ev.t_s * self.time_scale - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=self._fire, args=(idx, ev),
+                                  daemon=True, name=f"sim-replay-{idx}")
+            th.start()
+            threads.append(th)
+        deadline = time.monotonic() + self.join_timeout_s
+        for th in threads:
+            th.join(timeout=max(0.0, deadline - time.monotonic()))
+        with self._lock:
+            outcomes = [self._outcomes.get(i, _shed(ev, "client_gone"))
+                        for i, ev in enumerate(self.trace)]
+        peak, mean = (self.target.kv_utilization()
+                      if hasattr(self.target, "kv_utilization")
+                      else (0.0, 0.0))
+        return summarize(
+            self.trace.fingerprint(), outcomes, mode="live",
+            kv_peak_utilization=peak, kv_mean_utilization=mean,
+            extra={"time_scale": self.time_scale,
+                   "wall_s": time.monotonic() - t0})

@@ -1,0 +1,181 @@
+"""Deterministic scoring — one replay (virtual or live) in, one JSON out.
+
+The report schema is fixed (``sim-report-v1``) and every float is rounded
+to 6 decimal places before serialization, so two runs of the same seeded
+virtual replay produce **byte-identical** ``report_json`` strings — that
+equality is the determinism gate in CI, not an eyeballed "close enough".
+
+Scoring folds the same signals the obs stack exports for live fleets —
+TTFT / inter-token p50/p99, SLO burn per class (``fleet_slo_burn_rate``
+definition from ``obs.slo``: bad-fraction over the error budget), typed
+shed counts by cause (``serve_shed_total``), and peak/mean KV-block
+utilization (``serve_kv_block_utilization``) — into one higher-is-better
+scalar so the tuner can rank configs:
+
+    score = goodput_frac
+            - 0.25 * min(burn_max, 4) / 4      # SLO budget overspend
+            - 0.05 * min(ttft_p99_s, 2) / 2    # tail first-token latency
+            - 0.05 * min(itl_p99_s, 0.5) / 0.5 # tail inter-token latency
+            - 0.02 * kv_peak_utilization       # HBM headroom pressure
+
+Goodput dominates: a config that sheds half the trace can't win on
+latency. The latency and KV terms break ties between configs with equal
+goodput, which is exactly the regime successive halving operates in.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, NamedTuple, Optional
+
+from ..obs.slo import DEFAULT_TARGET, DEFAULT_TARGETS
+
+REPORT_SCHEMA = "sim-report-v1"
+
+# Causes that never count against the SLO budget (client-attributable or
+# policy refusals) — mirrors fleet.registry._SLO_EXCLUDED.
+SLO_EXCLUDED_CAUSES = frozenset(
+    {"quota", "over_capacity", "bad_request", "client_gone"})
+
+# Every cause a replay may legally record; anything else means an untyped
+# failure leaked through (the smoke's "typed-errors-only" assertion).
+TYPED_CAUSES = frozenset({
+    "queue_full", "deadline", "over_capacity", "quota", "shutting_down",
+    "worker_stall", "drain_timeout", "publish_failed", "breaker_open",
+    "no_replica", "bad_request", "client_gone"})
+
+
+class Outcome(NamedTuple):
+    """One request's fate: ``ok`` with latencies, or a typed shed cause."""
+
+    ok: bool
+    cause: Optional[str]        # typed cause when not ok (or deadline-miss)
+    slo: str
+    model: str
+    kind: str                   # "predict" | "generate"
+    latency_s: Optional[float]  # arrival -> last byte (completed only)
+    ttft_s: Optional[float]     # generate only
+    itl_s: Optional[float]      # mean inter-token interval (generate only)
+    tokens: int
+
+
+def _pctile(sorted_vals: List[float], q: float) -> float:
+    """Linear-interpolated percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def _round(obj):
+    """Recursively round floats so serialization is bit-stable."""
+    if isinstance(obj, float):
+        return round(obj, 6)
+    if isinstance(obj, dict):
+        return {k: _round(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_round(v) for v in obj]
+    return obj
+
+
+def summarize(workload_fp: str, outcomes: List[Outcome], *, mode: str,
+              knobs: Optional[dict] = None,
+              kv_peak_utilization: float = 0.0,
+              kv_mean_utilization: float = 0.0,
+              extra: Optional[dict] = None) -> dict:
+    """Fold a replay's outcomes into the deterministic report dict."""
+    sheds: Dict[str, int] = {}
+    untyped = 0
+    per_class: Dict[str, Dict[str, int]] = {}
+    latencies: List[float] = []
+    ttfts: List[float] = []
+    itls: List[float] = []
+    completed = 0
+    tokens_out = 0
+    for o in outcomes:
+        cls = per_class.setdefault(o.slo, {"good": 0, "bad": 0})
+        if o.ok:
+            completed += 1
+            tokens_out += o.tokens
+            cls["good"] += 1
+            if o.latency_s is not None:
+                latencies.append(o.latency_s)
+            if o.ttft_s is not None:
+                ttfts.append(o.ttft_s)
+            if o.itl_s is not None:
+                # weight the mean interval by its token count so a long
+                # generation influences the percentile like the stream of
+                # per-token observations the live histogram records
+                itls.extend([o.itl_s] * max(1, o.tokens))
+        else:
+            cause = o.cause or "internal"
+            sheds[cause] = sheds.get(cause, 0) + 1
+            if cause not in TYPED_CAUSES:
+                untyped += 1
+            if cause not in SLO_EXCLUDED_CAUSES:
+                cls["bad"] += 1
+    latencies.sort()
+    ttfts.sort()
+    itls.sort()
+
+    slo: Dict[str, dict] = {}
+    burn_max = 0.0
+    for name in sorted(per_class):
+        c = per_class[name]
+        total = c["good"] + c["bad"]
+        target = DEFAULT_TARGETS.get(name, DEFAULT_TARGET)
+        bad_frac = (c["bad"] / total) if total else 0.0
+        burn = bad_frac / max(1e-9, 1.0 - target)
+        burn_max = max(burn_max, burn)
+        slo[name] = {"good": c["good"], "bad": c["bad"],
+                     "target": target, "burn": burn}
+
+    n = len(outcomes)
+    report = {
+        "schema": REPORT_SCHEMA,
+        "mode": mode,
+        "workload_fingerprint": workload_fp,
+        "requests": n,
+        "completed": completed,
+        "tokens_out": tokens_out,
+        "goodput_frac": (completed / n) if n else 0.0,
+        "shed": {k: sheds[k] for k in sorted(sheds)},
+        "untyped_errors": untyped,
+        "latency_ms": {"p50": _pctile(latencies, 0.50) * 1e3,
+                       "p99": _pctile(latencies, 0.99) * 1e3},
+        "ttft_ms": {"p50": _pctile(ttfts, 0.50) * 1e3,
+                    "p99": _pctile(ttfts, 0.99) * 1e3},
+        "inter_token_ms": {"p50": _pctile(itls, 0.50) * 1e3,
+                           "p99": _pctile(itls, 0.99) * 1e3},
+        "slo": slo,
+        "burn_max": burn_max,
+        "kv": {"peak_utilization": kv_peak_utilization,
+               "mean_utilization": kv_mean_utilization},
+    }
+    if knobs is not None:
+        report["knobs"] = knobs
+    if extra:
+        report.update(extra)
+    report["score"] = score(report)
+    return _round(report)
+
+
+def score(report: dict) -> float:
+    """Higher-is-better scalar over a report (see module docstring)."""
+    goodput = float(report.get("goodput_frac", 0.0))
+    burn = min(float(report.get("burn_max", 0.0)), 4.0) / 4.0
+    ttft_p99 = min(float(report["ttft_ms"]["p99"]) / 1e3, 2.0) / 2.0
+    itl_p99 = min(float(report["inter_token_ms"]["p99"]) / 1e3, 0.5) / 0.5
+    kv_peak = float(report.get("kv", {}).get("peak_utilization", 0.0))
+    return (goodput - 0.25 * burn - 0.05 * ttft_p99 - 0.05 * itl_p99
+            - 0.02 * kv_peak)
+
+
+def report_json(report: dict) -> str:
+    """Canonical serialization — the byte-identity surface for determinism."""
+    return json.dumps(_round(report), sort_keys=True, indent=1)

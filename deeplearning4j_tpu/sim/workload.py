@@ -1,0 +1,395 @@
+"""Deterministic workload synthesis — seed-replayable open-loop traces.
+
+A :class:`WorkloadSpec` describes offered load the way a capacity planner
+would: a base request rate shaped by a time-compressed **diurnal** sinusoid,
+**Markov-modulated bursts** (an on/off process multiplies the rate while
+"on"), **heavy-tailed lengths** (lognormal prompts, Pareto output lengths —
+the shapes measured in production LLM traces), and weighted tenant/model
+mixes with per-tenant SLO classes. :func:`generate_trace` expands a spec
+into a :class:`Trace` of absolute-time :class:`Event`\\ s via Lewis
+thinning of a non-homogeneous Poisson process.
+
+Everything is deterministic by construction:
+
+- all randomness flows from ``random.Random(seed)`` (Mersenne Twister —
+  identical across processes and platforms, unlike builtin ``hash()``
+  which varies with ``PYTHONHASHSEED``);
+- weighted choices iterate mixes in sorted key order, never dict order;
+- event times are integer **microseconds**, so no float-formatting drift;
+- each event carries its own sha256-derived seed so prompt *content* can
+  be regenerated anywhere without replaying the arrival process;
+- ``Trace.to_bytes()`` is a fixed line format, so byte-equality is the
+  determinism test, and the **workload fingerprint** is a sha256 over the
+  canonical spec JSON plus those bytes.
+
+Stdlib only — no jax, no numpy — so traces can be synthesized and
+fingerprinted in processes that never load an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import random
+from typing import Dict, Iterator, List, NamedTuple, Optional, Tuple
+
+_TRACE_SCHEMA = "sim-trace-v1"
+
+# Deadlines per SLO class (ms); mirrors fleet.tenants.DEFAULT_SLO_CLASSES
+# without importing fleet/ (sim sits above it in the layering, but the
+# workload layer stays stdlib-light so the virtual path never loads jax).
+CLASS_DEADLINES_MS: Dict[str, Optional[float]] = {
+    "gold": 1000.0, "standard": 5000.0, "batch": None}
+
+
+class LengthDist(NamedTuple):
+    """A token-length distribution: ``lognormal``, ``pareto`` or ``fixed``.
+
+    ``p1``/``p2`` are (median, sigma) for lognormal, (scale, shape alpha)
+    for Pareto, (value, unused) for fixed. Samples are clipped to
+    ``[1, max_len]`` — heavy tails are the point, but the serving stack
+    has a hard capacity, and clipping keeps the tail mass *at* the cap
+    instead of silently discarding it.
+    """
+
+    kind: str
+    p1: float
+    p2: float
+    max_len: int
+
+    def sample(self, rng: random.Random) -> int:
+        if self.kind == "lognormal":
+            v = rng.lognormvariate(math.log(max(self.p1, 1e-9)), self.p2)
+        elif self.kind == "pareto":
+            v = self.p1 * rng.paretovariate(self.p2)
+        elif self.kind == "fixed":
+            v = self.p1
+        else:
+            raise ValueError(f"unknown length distribution kind {self.kind!r}")
+        return max(1, min(int(self.max_len), int(round(v))))
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "p1": self.p1, "p2": self.p2,
+                "max_len": self.max_len}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LengthDist":
+        return cls(str(d["kind"]), float(d["p1"]), float(d["p2"]),
+                   int(d["max_len"]))
+
+
+class Event(NamedTuple):
+    """One scheduled request. ``t_us`` is microseconds from trace start."""
+
+    t_us: int
+    seq: int
+    tenant: str
+    slo: str
+    model: str
+    kind: str           # "predict" | "generate"
+    prompt_len: int
+    max_new_tokens: int
+    seed: int           # per-event content seed (sha256-derived)
+
+    @property
+    def t_s(self) -> float:
+        return self.t_us / 1e6
+
+    def deadline_s(self) -> Optional[float]:
+        """Absolute deadline in trace time, or None for the batch class."""
+        ms = CLASS_DEADLINES_MS.get(self.slo)
+        return None if ms is None else self.t_s + ms / 1e3
+
+    def to_line(self) -> str:
+        return (f"{self.t_us} {self.seq} {self.tenant} {self.slo} "
+                f"{self.model} {self.kind} {self.prompt_len} "
+                f"{self.max_new_tokens} {self.seed}")
+
+    @classmethod
+    def from_line(cls, line: str) -> "Event":
+        p = line.split()
+        if len(p) != 9:
+            raise ValueError(f"bad trace line: {line!r}")
+        return cls(int(p[0]), int(p[1]), p[2], p[3], p[4], p[5],
+                   int(p[6]), int(p[7]), int(p[8]))
+
+
+class WorkloadSpec:
+    """Declarative description of an offered-load scenario.
+
+    ``tenants`` maps tenant name -> ``{"weight", "slo"}`` and ``models``
+    maps model name -> ``{"weight", "generate_frac"}``; weights are
+    relative. ``time_scale`` compresses wall time for *live* replay only —
+    it is part of the spec (and fingerprint) because a compressed replay
+    offers different instantaneous concurrency than a real-time one.
+    """
+
+    def __init__(self, *, seed: int = 0, duration_s: float = 60.0,
+                 base_rate_rps: float = 4.0,
+                 diurnal_amplitude: float = 0.5,
+                 diurnal_period_s: Optional[float] = None,
+                 diurnal_phase: float = -0.25,
+                 burst_rate_mult: float = 1.0,
+                 burst_mean_on_s: float = 0.0,
+                 burst_mean_off_s: float = 0.0,
+                 prompt_len: LengthDist = LengthDist("lognormal", 8.0, 0.7, 48),
+                 output_len: LengthDist = LengthDist("pareto", 2.0, 1.6, 16),
+                 vocab: int = 50,
+                 time_scale: float = 1.0,
+                 tenants: Optional[Dict[str, dict]] = None,
+                 models: Optional[Dict[str, dict]] = None):
+        self.seed = int(seed)
+        self.duration_s = float(duration_s)
+        self.base_rate_rps = float(base_rate_rps)
+        self.diurnal_amplitude = min(1.0, max(0.0, float(diurnal_amplitude)))
+        self.diurnal_period_s = float(
+            duration_s if diurnal_period_s is None else diurnal_period_s)
+        self.diurnal_phase = float(diurnal_phase)
+        self.burst_rate_mult = max(1.0, float(burst_rate_mult))
+        self.burst_mean_on_s = max(0.0, float(burst_mean_on_s))
+        self.burst_mean_off_s = max(0.0, float(burst_mean_off_s))
+        self.prompt_len = prompt_len
+        self.output_len = output_len
+        self.vocab = int(vocab)
+        self.time_scale = float(time_scale)
+        self.tenants = tenants or {"default": {"weight": 1.0,
+                                               "slo": "standard"}}
+        self.models = models or {"default": {"weight": 1.0,
+                                             "generate_frac": 0.0}}
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": _TRACE_SCHEMA,
+            "seed": self.seed,
+            "duration_s": self.duration_s,
+            "base_rate_rps": self.base_rate_rps,
+            "diurnal_amplitude": self.diurnal_amplitude,
+            "diurnal_period_s": self.diurnal_period_s,
+            "diurnal_phase": self.diurnal_phase,
+            "burst_rate_mult": self.burst_rate_mult,
+            "burst_mean_on_s": self.burst_mean_on_s,
+            "burst_mean_off_s": self.burst_mean_off_s,
+            "prompt_len": self.prompt_len.to_dict(),
+            "output_len": self.output_len.to_dict(),
+            "vocab": self.vocab,
+            "time_scale": self.time_scale,
+            "tenants": self.tenants,
+            "models": self.models,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkloadSpec":
+        d = dict(d)
+        d.pop("schema", None)
+        d["prompt_len"] = LengthDist.from_dict(d["prompt_len"])
+        d["output_len"] = LengthDist.from_dict(d["output_len"])
+        return cls(**d)
+
+    def canonical(self) -> bytes:
+        """Canonical JSON — sorted keys, no whitespace drift."""
+        return json.dumps(self.to_dict(), sort_keys=True,
+                          separators=(",", ":")).encode("utf-8")
+
+    def fingerprint(self) -> str:
+        """Stable spec-level fingerprint (the *workload fingerprint*).
+
+        Hash of the canonical spec alone: the arrival process is a pure
+        function of the spec, so hashing the expanded events again would
+        add cost without adding information — and it lets callers key
+        tuned configs before paying for trace expansion. ``Trace.
+        fingerprint()`` additionally covers the event bytes as a
+        self-check that expansion really was deterministic.
+        """
+        return hashlib.sha256(self.canonical()).hexdigest()[:16]
+
+    def rate_at(self, t_s: float) -> float:
+        """Un-modulated (no burst) offered rate at trace time ``t_s``."""
+        theta = 2.0 * math.pi * (t_s / self.diurnal_period_s
+                                 + self.diurnal_phase)
+        r = self.base_rate_rps * (1.0
+                                  + self.diurnal_amplitude * math.sin(theta))
+        return max(r, 0.02 * self.base_rate_rps)
+
+
+class Trace:
+    """An expanded event stream plus the spec that produced it."""
+
+    def __init__(self, spec: WorkloadSpec, events: List[Event]):
+        self.spec = spec
+        self.events = events
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __iter__(self) -> Iterator[Event]:
+        return iter(self.events)
+
+    def fingerprint(self) -> str:
+        """Workload fingerprint (spec-derived; see WorkloadSpec)."""
+        return self.spec.fingerprint()
+
+    def content_hash(self) -> str:
+        """sha256 over spec canonical + event bytes — expansion self-check."""
+        h = hashlib.sha256(self.spec.canonical())
+        h.update(b"\n")
+        h.update(self._event_bytes())
+        return h.hexdigest()[:16]
+
+    def _event_bytes(self) -> bytes:
+        return "\n".join(e.to_line() for e in self.events).encode("utf-8")
+
+    def to_bytes(self) -> bytes:
+        """Fixed serialization; byte-equality == trace equality."""
+        header = (f"# {_TRACE_SCHEMA} fp={self.fingerprint()} "
+                  f"events={len(self.events)}\n").encode("utf-8")
+        spec_line = b"# spec " + self.spec.canonical() + b"\n"
+        return header + spec_line + self._event_bytes() + b"\n"
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(self.to_bytes())
+
+    @classmethod
+    def load(cls, path: str) -> "Trace":
+        with open(path, "rb") as f:
+            lines = f.read().decode("utf-8").splitlines()
+        spec = None
+        events: List[Event] = []
+        for line in lines:
+            if line.startswith("# spec "):
+                spec = WorkloadSpec.from_dict(json.loads(line[len("# spec "):]))
+            elif line.startswith("#") or not line.strip():
+                continue
+            else:
+                events.append(Event.from_line(line))
+        if spec is None:
+            raise ValueError(f"no spec header in trace file {path}")
+        return cls(spec, events)
+
+    def slice(self, n_events: int) -> "Trace":
+        """Prefix of the trace — the tuner's successive-halving rungs.
+
+        The slice keeps the parent spec (and therefore the parent
+        fingerprint): rung evaluations are *of* the parent workload,
+        just truncated.
+        """
+        return Trace(self.spec, self.events[:max(0, int(n_events))])
+
+
+def _event_seed(spec_fp: str, seq: int) -> int:
+    """Per-event content seed, stable across processes."""
+    digest = hashlib.sha256(f"{spec_fp}:{seq}".encode("utf-8")).digest()
+    return int.from_bytes(digest[:4], "big")
+
+
+def _weighted_pick(rng: random.Random, mix: Dict[str, dict]) -> str:
+    """Weighted choice iterating keys in sorted order (never dict order)."""
+    names = sorted(mix)
+    total = sum(float(mix[n].get("weight", 1.0)) for n in names)
+    x = rng.random() * total
+    acc = 0.0
+    for n in names:
+        acc += float(mix[n].get("weight", 1.0))
+        if x < acc:
+            return n
+    return names[-1]
+
+
+def _burst_windows(rng: random.Random,
+                   spec: WorkloadSpec) -> List[Tuple[float, float]]:
+    """Markov on/off burst intervals: exponential off then on holding times."""
+    if (spec.burst_rate_mult <= 1.0 or spec.burst_mean_on_s <= 0.0
+            or spec.burst_mean_off_s <= 0.0):
+        return []
+    windows: List[Tuple[float, float]] = []
+    t = 0.0
+    while t < spec.duration_s:
+        t += rng.expovariate(1.0 / spec.burst_mean_off_s)
+        if t >= spec.duration_s:
+            break
+        end = t + rng.expovariate(1.0 / spec.burst_mean_on_s)
+        windows.append((t, min(end, spec.duration_s)))
+        t = end
+    return windows
+
+
+def generate_trace(spec: WorkloadSpec) -> Trace:
+    """Expand a spec into a trace via Lewis thinning.
+
+    Candidate arrivals come from a homogeneous Poisson process at the
+    rate envelope ``base * (1 + amplitude) * burst_mult``; each candidate
+    survives with probability ``rate(t) / envelope``. The thinned stream
+    is exactly the non-homogeneous process with intensity ``rate(t)``,
+    and — because every candidate consumes the same number of RNG draws —
+    the stream is bit-stable under any spec change that only *lowers*
+    local intensity.
+    """
+    rng = random.Random(spec.seed)
+    windows = _burst_windows(rng, spec)
+    spec_fp = spec.fingerprint()
+
+    def modulated_rate(t: float) -> float:
+        r = spec.rate_at(t)
+        for (a, b) in windows:
+            if a <= t < b:
+                return r * spec.burst_rate_mult
+        return r
+
+    envelope = (spec.base_rate_rps * (1.0 + spec.diurnal_amplitude)
+                * spec.burst_rate_mult)
+    events: List[Event] = []
+    t = 0.0
+    seq = 0
+    while True:
+        t += rng.expovariate(envelope)
+        if t >= spec.duration_s:
+            break
+        keep = rng.random() * envelope <= modulated_rate(t)
+        # Draw the per-event attributes unconditionally so thinning
+        # decisions don't shift the RNG stream of later events.
+        tenant = _weighted_pick(rng, spec.tenants)
+        model = _weighted_pick(rng, spec.models)
+        gen_frac = float(spec.models[model].get("generate_frac", 0.0))
+        kind = "generate" if rng.random() < gen_frac else "predict"
+        plen = spec.prompt_len.sample(rng)
+        ntok = spec.output_len.sample(rng) if kind == "generate" else 0
+        if not keep:
+            continue
+        events.append(Event(
+            t_us=int(round(t * 1e6)), seq=seq, tenant=tenant,
+            slo=str(spec.tenants[tenant].get("slo", "standard")),
+            model=model, kind=kind, prompt_len=plen, max_new_tokens=ntok,
+            seed=_event_seed(spec_fp, seq)))
+        seq += 1
+    return Trace(spec, events)
+
+
+def prompt_tokens(event: Event, vocab: int) -> List[int]:
+    """Regenerate the event's prompt content from its embedded seed."""
+    r = random.Random(event.seed)
+    return [r.randrange(max(2, int(vocab))) for _ in range(event.prompt_len)]
+
+
+def smoke_spec(seed: int = 0, duration_s: float = 60.0,
+               base_rate_rps: float = 6.0,
+               time_scale: float = 0.1) -> WorkloadSpec:
+    """The CI smoke workload: one compressed diurnal day over a 2-model,
+    3-tenant fleet with a bursty gold tier and heavy-tailed lengths."""
+    return WorkloadSpec(
+        seed=seed, duration_s=duration_s, base_rate_rps=base_rate_rps,
+        diurnal_amplitude=0.6, diurnal_period_s=duration_s,
+        diurnal_phase=-0.25,
+        burst_rate_mult=2.5, burst_mean_on_s=4.0, burst_mean_off_s=12.0,
+        prompt_len=LengthDist("lognormal", 6.0, 0.7, 12),
+        output_len=LengthDist("pareto", 2.0, 1.6, 4),
+        vocab=50, time_scale=time_scale,
+        tenants={
+            "acme": {"weight": 0.5, "slo": "gold"},
+            "globex": {"weight": 0.35, "slo": "standard"},
+            "free": {"weight": 0.15, "slo": "batch"},
+        },
+        models={
+            "alpha": {"weight": 0.6, "generate_frac": 0.0},
+            "beta": {"weight": 0.4, "generate_frac": 0.5},
+        })

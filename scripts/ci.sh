@@ -48,6 +48,12 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/chaos/
 # bar as serve/ and fleet/.
 echo "=== jaxlint: deeplearning4j_tpu/cluster/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/cluster/
+# sim/ decides which serving config every replica boots with: a lint-dirty
+# simulator (hidden nondeterminism, swallowed errors) would tune the fleet
+# against a workload that never existed, so it holds the same
+# zero-suppression bar.
+echo "=== jaxlint: deeplearning4j_tpu/sim/ (no baseline permitted) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/sim/
 
 echo "=== smoke trace: 5-step instrumented train ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_trace.py
@@ -60,6 +66,9 @@ CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_chaos.py
 
 echo "=== smoke cluster: kill-a-replica drill behind the router ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_cluster.py
+
+echo "=== smoke sim: trace replay determinism + autotuned boot ==="
+CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_sim.py
 
 # every scrape artifact the smokes wrote must be an exposition a real
 # Prometheus would accept — promcheck is the gate, not just a warning

@@ -1,0 +1,366 @@
+"""Tests for the sim/ subsystem (ISSUE 11).
+
+The load-bearing properties, each tested directly:
+
+- trace determinism: one seed expands to a byte-identical trace in two
+  FRESH PROCESSES with different ``PYTHONHASHSEED`` values (the classic
+  way "deterministic" synthesis silently isn't), a different seed
+  produces a different trace, and save/load roundtrips exactly;
+- virtual replay determinism: two fresh ``VirtualReplayer`` runs emit
+  byte-identical ``report_json``, and every shed under overload carries
+  a typed cause;
+- tuner: the winner's full-trace score is never below the hand-picked
+  default's (the default is candidate 0 and rides every rung), and the
+  same (trace, seed) reproduces the same winner;
+- tuned-config store: put/get roundtrip with hit/miss counters, a
+  corrupt entry and a runtime-fingerprint skew both degrade to a miss
+  (never an exception), and a ``FleetRegistry(tuned_for=...)`` boot
+  applies the resolved engine/gen groups with explicit opts winning;
+- open-loop live replay: events fire at trace-scheduled times against a
+  stub target, fates aggregate per cause, and a target bug scores as an
+  untyped error instead of hanging the run;
+- satellites: Retry-After jitter is deterministic under an injected RNG,
+  and bench headline stamping carries the workload fingerprint.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+from deeplearning4j_tpu.aot import AotStore, get_tuned, put_tuned, tuned_key
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.sim import (DEFAULT_KNOBS, TYPED_CAUSES, LiveReplayer,
+                                    Outcome, Trace, Tuner, VirtualReplayer,
+                                    generate_trace, report_json, score,
+                                    smoke_spec)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec(seed=0, duration_s=15.0, rate=8.0):
+    return smoke_spec(seed=seed, duration_s=duration_s, base_rate_rps=rate)
+
+
+# --------------------------------------------------------------------- traces
+class TestTraceDeterminism:
+    def test_same_seed_byte_identical(self):
+        spec = _spec()
+        a, b = generate_trace(spec), generate_trace(spec)
+        assert a.to_bytes() == b.to_bytes()
+        assert a.content_hash() == b.content_hash()
+
+    def test_different_seed_differs(self):
+        a = generate_trace(_spec(seed=0))
+        b = generate_trace(_spec(seed=1))
+        assert a.to_bytes() != b.to_bytes()
+        assert a.fingerprint() != b.fingerprint()
+
+    def test_fingerprint_is_spec_level(self):
+        spec = _spec()
+        assert generate_trace(spec).fingerprint() == spec.fingerprint()
+
+    def test_hashseed_immunity_across_processes(self):
+        """Two fresh interpreters with DIFFERENT PYTHONHASHSEED values must
+        expand the same spec to byte-identical events — any reliance on
+        builtin hash()/dict-iteration order shows up here."""
+        prog = ("import hashlib\n"
+                "from deeplearning4j_tpu.sim.workload import (generate_trace,"
+                " smoke_spec)\n"
+                "t = generate_trace(smoke_spec(seed=3, duration_s=10.0))\n"
+                "print(hashlib.sha256(t.to_bytes()).hexdigest(),"
+                " t.fingerprint())\n")
+        outs = []
+        for hashseed in ("0", "4242"):
+            env = dict(os.environ, PYTHONHASHSEED=hashseed,
+                       JAX_PLATFORMS="cpu")
+            r = subprocess.run([sys.executable, "-c", prog], cwd=_REPO,
+                               env=env, capture_output=True, text=True,
+                               timeout=120)
+            assert r.returncode == 0, r.stderr
+            outs.append(r.stdout.strip())
+        assert outs[0] == outs[1], f"hash-seed sensitive trace: {outs}"
+
+    def test_save_load_roundtrip(self, tmp_path):
+        t = generate_trace(_spec())
+        path = str(tmp_path / "trace.txt")
+        t.save(path)
+        loaded = Trace.load(path)
+        assert loaded.to_bytes() == t.to_bytes()
+        assert loaded.fingerprint() == t.fingerprint()
+
+    def test_slice_keeps_workload_fingerprint(self):
+        t = generate_trace(_spec())
+        head = t.slice(10)
+        assert len(head) == 10
+        assert head.fingerprint() == t.fingerprint()
+
+    def test_events_are_ordered_and_seeded(self):
+        t = generate_trace(_spec())
+        assert len(t) > 0
+        times = [ev.t_us for ev in t]
+        assert times == sorted(times)
+        assert len({ev.seed for ev in t}) == len(t)  # per-event content seeds
+
+
+# ------------------------------------------------------------- virtual replay
+class TestVirtualReplay:
+    def test_report_byte_identical(self):
+        t = generate_trace(_spec())
+        r1 = report_json(VirtualReplayer(t).run())
+        r2 = report_json(VirtualReplayer(t).run())
+        assert r1 == r2
+
+    def test_score_matches_report(self):
+        rep = VirtualReplayer(generate_trace(_spec())).run()
+        assert rep["score"] == pytest.approx(score(rep), abs=1e-6)
+
+    def test_overload_sheds_are_typed(self):
+        # the full 60 s day at 80 rps: queues build through the diurnal
+        # peak until deadline/queue_full sheds appear (a short burst alone
+        # drains before the default queue limits bite)
+        rep = VirtualReplayer(
+            generate_trace(_spec(rate=80.0, duration_s=60.0))).run()
+        assert rep["shed"], "overload produced no sheds"
+        assert set(rep["shed"]) <= set(TYPED_CAUSES)
+        assert rep["untyped_errors"] == 0
+        assert rep["completed"] + sum(rep["shed"].values()) \
+            == rep["requests"]
+
+
+# --------------------------------------------------------------------- tuner
+class TestTuner:
+    def test_winner_never_below_default_and_deterministic(self):
+        t = generate_trace(_spec(rate=40.0, duration_s=20.0))
+        res = Tuner(t, seed=0).search(candidates=8, eta=3, min_events=64)
+        assert res.winner_score >= res.default_score
+        assert res.evaluated >= 8  # every candidate saw at least one rung
+        assert res.rungs[-1]["events"] == len(t)  # final rung = full trace
+
+        res2 = Tuner(t, seed=0).search(candidates=8, eta=3, min_events=64)
+        assert res2.winner == res.winner
+        assert res2.winner_score == res.winner_score
+
+    def test_different_search_seed_same_guarantee(self):
+        t = generate_trace(_spec(rate=40.0, duration_s=15.0))
+        res = Tuner(t, seed=9).search(candidates=6, eta=3, min_events=64)
+        assert res.winner_score >= res.default_score
+
+
+# ----------------------------------------------------------- tuned-cfg store
+class TestTunedStore:
+    WINNER = {"engine": {"max_wait_ms": 5.0, "queue_limit": 128},
+              "gen": {"slots": 8, "decode_chunks": 2, "idle_chunks": 3}}
+
+    def test_roundtrip_counts_hit(self, tmp_path):
+        store = AotStore(str(tmp_path))
+        m = MetricsRegistry()
+        assert put_tuned(store, "fp1234", self.WINNER)
+        assert get_tuned(store, "fp1234", metrics=m) == self.WINNER
+        snap = m.snapshot()
+        assert sum(s["value"] for s in
+                   snap["sim_tuned_config_hits_total"]["series"]) == 1
+        assert "sim_tuned_config_misses_total" not in snap
+
+    def test_unknown_workload_is_miss(self, tmp_path):
+        m = MetricsRegistry()
+        assert get_tuned(AotStore(str(tmp_path)), "nope", metrics=m) is None
+        assert sum(s["value"] for s in m.snapshot()
+                   ["sim_tuned_config_misses_total"]["series"]) == 1
+
+    def test_none_store_is_miss(self):
+        assert get_tuned(None, "fp") is None
+
+    def test_runtime_skew_is_miss(self, tmp_path):
+        """A config tuned on one runtime must not resolve on another — the
+        runtime fingerprint is part of the key, exactly like executables."""
+        store = AotStore(str(tmp_path))
+        put_tuned(store, "fp", self.WINNER, runtime={"device": "cpu"})
+        assert get_tuned(store, "fp", runtime={"device": "cpu"}) \
+            == self.WINNER
+        assert get_tuned(store, "fp", runtime={"device": "tpu_v5e"}) is None
+
+    def test_corrupt_entry_is_miss_not_crash(self, tmp_path):
+        store = AotStore(str(tmp_path))
+        put_tuned(store, "fp", self.WINNER)
+        with open(store._entry_path(tuned_key("fp")), "r+b") as f:
+            f.seek(40)
+            f.write(b"\xff\xff\xff\xff")
+        m = MetricsRegistry()
+        assert get_tuned(store, "fp", metrics=m) is None
+        assert sum(s["value"] for s in m.snapshot()
+                   ["sim_tuned_config_misses_total"]["series"]) == 1
+
+    def test_non_dict_blob_is_miss(self, tmp_path):
+        store = AotStore(str(tmp_path))
+        store.put(tuned_key("fp"), b"[1,2,3]", meta={})
+        assert get_tuned(store, "fp") is None
+
+
+# ------------------------------------------------------------- tuned boot
+class TestTunedBoot:
+    def _model(self):
+        from deeplearning4j_tpu.nn.layers import Dense, Output
+        from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+
+        m = Sequential(NetConfig(seed=0),
+                       [Dense(n_out=6, activation="tanh"),
+                        Output(n_out=3, loss="mcxent",
+                               activation="softmax")],
+                       (4,))
+        m.init()
+        return m
+
+    def test_boot_resolves_and_explicit_opts_win(self, tmp_path):
+        from deeplearning4j_tpu.fleet import FleetRegistry
+
+        store = AotStore(str(tmp_path))
+        winner = TestTunedStore.WINNER
+        put_tuned(store, "wl-fp", winner)
+
+        fleet = FleetRegistry(aot_store=store, tuned_for="wl-fp")
+        try:
+            assert fleet.tuned_config == winner
+            hits = sum(s["value"] for s in fleet.metrics.snapshot()
+                       ["sim_tuned_config_hits_total"]["series"])
+            assert hits == 1
+            entry = fleet.add("m", self._model(), gen_opts={"slots": 2})
+            # tuned engine/gen groups became the defaults...
+            assert entry.engine_opts["max_wait_ms"] == 5.0
+            assert entry.engine_opts["queue_limit"] == 128
+            sched = entry.gen_opts["scheduler"]
+            assert (sched.decode_chunks, sched.idle_chunks) == (2, 3)
+            # ...but an explicit opt still wins over the tuned value
+            assert entry.gen_opts["slots"] == 2
+        finally:
+            fleet.shutdown()
+
+    def test_boot_without_store_uses_defaults(self):
+        from deeplearning4j_tpu.fleet import FleetRegistry
+
+        fleet = FleetRegistry(tuned_for="wl-fp")  # no store: counted miss
+        try:
+            assert fleet.tuned_config is None
+            misses = sum(s["value"] for s in fleet.metrics.snapshot()
+                         ["sim_tuned_config_misses_total"]["series"])
+            assert misses == 1
+            entry = fleet.add("m", self._model())
+            assert "scheduler" not in entry.gen_opts
+        finally:
+            fleet.shutdown()
+
+    def test_gen_opts_from_config_filters_and_folds(self):
+        from deeplearning4j_tpu.serve.continuous import gen_opts_from_config
+
+        opts = gen_opts_from_config(
+            {"gen": {"slots": 8, "decode_chunks": 4, "idle_chunks": 2,
+                     "not_a_knob": 1, "queue_limit": 32}})
+        assert opts["slots"] == 8 and opts["queue_limit"] == 32
+        assert "not_a_knob" not in opts and "decode_chunks" not in opts
+        sched = opts["scheduler"]
+        assert (sched.decode_chunks, sched.idle_chunks) == (4, 2)
+        assert gen_opts_from_config(None) == {}
+
+
+# ---------------------------------------------------------------- live replay
+class _StubTarget:
+    """Scripted fates: predicts succeed, generates for the 'beta' model
+    shed typed, and one scripted event index raises (an untyped bug)."""
+
+    def __init__(self, boom_seq=None):
+        self.boom_seq = boom_seq
+        self.calls = []
+
+    def kv_utilization(self):
+        return (0.25, 0.125)
+
+    def predict(self, ev):
+        self.calls.append(ev.seq)
+        if ev.seq == self.boom_seq:
+            raise RuntimeError("scripted target bug")
+        return Outcome(True, None, ev.slo, ev.model, ev.kind,
+                       0.002, None, None, 0)
+
+    def generate(self, ev):
+        self.calls.append(ev.seq)
+        if ev.model == "beta":
+            return Outcome(False, "queue_full", ev.slo, ev.model, ev.kind,
+                           None, None, None, 0)
+        return Outcome(True, None, ev.slo, ev.model, ev.kind,
+                       0.01, 0.004, 0.002, ev.max_new_tokens)
+
+
+class TestLiveReplay:
+    def test_open_loop_aggregation(self):
+        t = generate_trace(_spec(duration_s=8.0))
+        rep = LiveReplayer(t, _StubTarget(), time_scale=0.01).run()
+        assert rep["mode"] == "live"
+        assert rep["requests"] == len(t)
+        assert rep["untyped_errors"] == 0
+        gen_beta = sum(1 for ev in t
+                       if ev.kind == "generate" and ev.model == "beta")
+        assert rep["shed"].get("queue_full", 0) == gen_beta
+        assert rep["completed"] == len(t) - gen_beta
+        assert rep["kv"]["peak_utilization"] == 0.25
+        assert rep["wall_s"] > 0
+
+    def test_target_bug_scores_untyped(self):
+        t = generate_trace(_spec(duration_s=8.0))
+        boom = next(ev.seq for ev in t if ev.kind == "predict")
+        rep = LiveReplayer(t, _StubTarget(boom_seq=boom),
+                           time_scale=0.01).run()
+        assert rep["untyped_errors"] == 1
+        assert rep["shed"].get("internal") == 1
+
+
+# ----------------------------------------------------------------- satellites
+class TestRetryJitter:
+    def test_injected_rng_is_deterministic(self):
+        from deeplearning4j_tpu.serve import (jitter_retry_after,
+                                              retry_after_s)
+
+        a = [retry_after_s(d, 10, random.Random(7)) for d in range(10)]
+        b = [retry_after_s(d, 10, random.Random(7)) for d in range(10)]
+        assert a == b
+        for v in (jitter_retry_after(10.0, random.Random(i))
+                  for i in range(50)):
+            assert 8 <= v <= 12  # ±20% band
+
+    def test_floor_is_one_second(self):
+        from deeplearning4j_tpu.serve import jitter_retry_after
+
+        assert all(jitter_retry_after(0.1, random.Random(i)) >= 1
+                   for i in range(20))
+
+    def test_seed_retry_jitter_reseeds_fallback(self):
+        from deeplearning4j_tpu.serve import (jitter_retry_after,
+                                              seed_retry_jitter)
+
+        seed_retry_jitter(3)
+        a = [jitter_retry_after(20.0) for _ in range(5)]
+        seed_retry_jitter(3)
+        assert [jitter_retry_after(20.0) for _ in range(5)] == a
+
+
+class TestBenchStamp:
+    def test_headline_carries_workload_fingerprint(self):
+        sys.path.insert(0, _REPO)
+        try:
+            import bench
+        finally:
+            sys.path.pop(0)
+        h = bench._stamp({"x": 1}, "bench.py --fleet", workload_fp="ab12")
+        assert h["workload_fingerprint"] == "ab12"
+        assert h["source"] == "bench.py --fleet"
+        h2 = bench._stamp({}, "bench.py")
+        assert "workload_fingerprint" not in h2
+
+
+class TestDefaultKnobs:
+    def test_default_knobs_are_json_safe(self):
+        # the tuner persists knob dicts as canonical JSON; the defaults
+        # must survive the same encoding
+        assert json.loads(json.dumps(DEFAULT_KNOBS)) == DEFAULT_KNOBS
