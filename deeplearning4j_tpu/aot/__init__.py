@@ -25,10 +25,10 @@ from .compile import AotFunction, deserialize_compiled, serialize_compiled
 from .keys import arch_fingerprint, cache_key, call_signature, \
     runtime_fingerprint
 from .store import AotCorruptEntry, AotStore, AotStoreError, AotVersionError
-from .tuned import get_tuned, put_tuned, tuned_key
+from .tuned import get_tuned, put_tuned, tuned_group, tuned_key
 
 __all__ = ["AotCorruptEntry", "AotFunction", "AotStore", "AotStoreError",
            "AotVersionError", "arch_fingerprint", "cache_key",
            "call_signature", "deserialize_compiled", "get_tuned",
            "put_tuned", "runtime_fingerprint", "serialize_compiled",
-           "tuned_key"]
+           "tuned_group", "tuned_key"]

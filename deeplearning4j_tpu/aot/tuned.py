@@ -35,6 +35,18 @@ _HELP_MISSES = ("Tuned-config lookups that missed (no entry for this "
                 "runtime+workload, or corrupt).")
 
 
+def tuned_group(config: Optional[dict], group: str) -> dict:
+    """One group of a resolved tuned config as a plain dict (empty on a
+    miss or malformed entry) — the accessor every consumer shares (the
+    fleet's ``engine``/``gen`` knob groups, the autoscale policy's
+    ``autoscale`` group), so a corrupt or partial config degrades to
+    defaults at each call site instead of raising."""
+    if not isinstance(config, dict):
+        return {}
+    g = config.get(group)
+    return dict(g) if isinstance(g, dict) else {}
+
+
 def tuned_key(workload_fp: str, runtime: Optional[dict] = None) -> str:
     """Store key for one (runtime fingerprint, workload fingerprint) pair."""
     return cache_key(_TAG, "config", (str(workload_fp),), runtime=runtime)

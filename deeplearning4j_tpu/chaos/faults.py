@@ -18,6 +18,9 @@ never corrupt donated buffers:
 - ``serve.dispatch``        — top of the engine's batch dispatch
 - ``http.handler``          — front-door POST handlers (serve and fleet)
 - ``cluster.transport``     — the cluster router's per-replica proxy hop
+- ``autoscale.spawn``       — the autoscale controller, just before it
+  provisions a scale-out replica (a fired fault = a failed provision;
+  the controller must survive it and retry on a later tick)
 
 Multi-instance seams (one router talking to N in-process replicas) can be
 targeted individually: a site passes ``scope="replica-0"`` to :meth:`hit`
@@ -55,6 +58,7 @@ POINTS = (
     "serve.dispatch",
     "http.handler",
     "cluster.transport",
+    "autoscale.spawn",
 )
 
 #: The installed plane, or None (the zero-overhead default). Injection

@@ -24,7 +24,10 @@ they hold" can never disagree about which snapshot they came from.
 Exported metrics: ``cluster_replica_state{replica}`` (0 alive / 1 suspect
 / 2 dead), ``cluster_heartbeats_total{replica,outcome}`` and
 ``cluster_replica_transitions_total{replica,to}`` — replica ids are a
-small fixed set per deployment, so the label stays bounded.
+small fixed set per deployment, so the label stays bounded. A replica
+retired via :meth:`Membership.remove` (autoscaler scale-in, dead-replica
+cleanup) has its state gauge series *deleted* — only live instances are
+scraped — while the transitions counter keeps a ``to="retired"`` record.
 """
 
 from __future__ import annotations
@@ -124,6 +127,32 @@ class Membership:
                     "cluster_replica_state", {"replica": rid},
                     help="replica membership state: 0=alive 1=suspect 2=dead"
                 ).set(_STATE_N[ALIVE])
+
+    def remove(self, replica_id: str) -> None:
+        """Retire a replica: drop its record AND its
+        ``cluster_replica_state`` gauge series, so scrapes never show a
+        ghost instance (a retired replica is not *dead* — it is gone, and
+        a state gauge for something gone is a lie). The transitions
+        counter records the retirement instead: counters keep history,
+        gauges describe the present. This is the autoscaler's scale-in
+        path and the cleanup for replicas that died mid-sweep."""
+        with self._lock:
+            info = self._replicas.pop(replica_id, None)
+        if info is None:
+            raise KeyError(f"replica {replica_id!r} not registered")
+        # bounded label set: ids only ever come from explicit add()
+        rid = replica_id
+        if self._metrics is not None:
+            self._metrics.remove_series("cluster_replica_state",
+                                        {"replica": rid})
+            self._metrics.counter(
+                "cluster_replica_transitions_total",
+                {"replica": rid, "to": "retired"},
+                help="replica membership state transitions").inc()
+        if _flight.ACTIVE is not None:
+            _flight.ACTIVE.record_event("membership", "retired",
+                                        replica=replica_id)
+        log.info("replica %s retired", replica_id)
 
     def report(self, replica_id: str, payload: Optional[dict] = None) -> None:
         """One successful heartbeat: renew the lease, store the

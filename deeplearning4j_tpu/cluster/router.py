@@ -187,11 +187,26 @@ class ClusterRouter(JsonHTTPServerMixin):
         self._accepting = True
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        #: The attached AutoscaleController, if any (it registers itself);
+        #: surfaced on ``/v1/cluster`` so one GET shows fleet + policy state.
+        self.autoscaler = None
 
     # ------------------------------------------------------------ membership
     def add_replica(self, replica_id: str, base_url: str) -> None:
         """Register one replica (``base_url`` like ``http://127.0.0.1:9021``)."""
         self.membership.add(replica_id, base_url)
+
+    def remove_replica(self, replica_id: str) -> None:
+        """Retire one replica: its membership record and state-gauge series
+        go away (scrapes must not show ghost instances) and placement
+        re-plans immediately over the survivors. Removal only stops NEW
+        traffic — the caller owns draining the replica itself (the
+        autoscale controller removes first, then drains, so anything
+        already admitted finishes against leased params)."""
+        self.membership.remove(replica_id)
+        with self._plan_lock:
+            self._plan_sig = None  # live set shrank: force a rebuild
+        self._replan()
 
     def start(self, background: bool = True):
         out = super().start(background=background)
@@ -688,13 +703,16 @@ class ClusterRouter(JsonHTTPServerMixin):
                 elif path == "/v1/cluster":
                     with server._plan_lock:
                         plan = {n: list(c) for n, c in server._plan.items()}
-                    self.reply(200, {
+                    view = {
                         "membership": server.membership.snapshot(),
                         "placement": plan,
                         "retry_budget": server.retry_budget.snapshot(),
                         "tenants": server.tenants.stats(),
                         "slo": server.slo.snapshot(),
-                        "replica_slo": server.replica_slo.snapshot()})
+                        "replica_slo": server.replica_slo.snapshot()}
+                    if server.autoscaler is not None:
+                        view["autoscale"] = server.autoscaler.snapshot()
+                    self.reply(200, view)
                 else:
                     self.route_err(404, {"error": "unknown endpoint"})
 

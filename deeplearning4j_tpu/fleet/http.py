@@ -106,6 +106,7 @@ class FleetServer(JsonHTTPServerMixin):
             "hbm_budget_bytes": pager.get("budget_bytes"),
             "resident_bytes": pager.get("resident_bytes"),
             "queue_depth": self.fleet.queue_depth(),
+            "kv_utilization": self.fleet.kv_pressure(),
         }
 
     def _metric_route(self, path: str) -> str:
@@ -247,7 +248,7 @@ class FleetServer(JsonHTTPServerMixin):
                         entry = server.fleet.get(req["model"])
                         server.fleet.pager.drop(entry)
                         self.reply(200, {"model": entry.name,
-                                         "resident": entry.resident()})
+                                         "resident": entry.resident})
                         return
                     if _faults.ACTIVE is not None:
                         _faults.ACTIVE.hit("http.handler")

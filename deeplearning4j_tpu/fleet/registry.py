@@ -235,6 +235,16 @@ class FleetEntry:
                 depth += self._batcher.queue_depth()
             return depth
 
+    def kv_utilization(self) -> float:
+        """Fraction of this entry's KV blocks in use — 0.0 when cold,
+        predict-only, or the batcher runs the dense (non-paged) path."""
+        with self._lock:
+            if self._batcher is None:
+                return 0.0
+            stats = self._batcher.kv_block_stats()
+        total = int(stats.get("blocks_total") or 0)
+        return (int(stats.get("blocks_used") or 0) / total) if total else 0.0
+
     def components(self) -> list:
         """Watchdog view: ``(name, worker-owning component)`` pairs for the
         currently-resident serving stack (empty when paged out)."""
@@ -335,12 +345,13 @@ class FleetRegistry:
         (``tuned_for=``), its engine/gen groups become the per-model
         defaults — explicit ``engine_opts``/``gen_opts`` keys still win."""
         if self.tuned_config is not None:
+            from ..aot.tuned import tuned_group
             from ..serve.continuous import gen_opts_from_config
             from ..serve.engine import ENGINE_KNOBS
 
             tuned_engine = {
                 k: v
-                for k, v in (self.tuned_config.get("engine") or {}).items()
+                for k, v in tuned_group(self.tuned_config, "engine").items()
                 if k in ENGINE_KNOBS}
             engine_opts = {**tuned_engine, **(engine_opts or {})}
             gen_opts = {**gen_opts_from_config(self.tuned_config),
@@ -396,6 +407,14 @@ class FleetRegistry:
         with self._lock:
             entries = list(self._entries.values())
         return sum(e.queue_depth() for e in entries)
+
+    def kv_pressure(self) -> float:
+        """Worst KV-block utilization across resident models — the memory
+        half of the load signal a replica self-reports on each cluster
+        heartbeat (the autoscaler's KV-pressure input)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return max((e.kv_utilization() for e in entries), default=0.0)
 
     def ensure(self, name: str) -> FleetEntry:
         """Page a model in without serving a request (prewarm)."""

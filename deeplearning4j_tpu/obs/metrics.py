@@ -292,6 +292,27 @@ class MetricsRegistry:
         return self._get("histogram", name, labels, help,
                          lambda: Histogram(buckets))
 
+    def remove_series(self, name: str,
+                      labels: Optional[Dict[str, str]] = None) -> bool:
+        """Delete one (name, labels) series — the retire path for gauges
+        whose labelled subject (a replica, a worker) no longer exists, so
+        scrapes stop showing ghosts. Counters should generally NOT be
+        removed (their history is the point); gauges describe present
+        state, and a gauge for something gone is a lie. Dropping the last
+        series drops the family too — no orphan ``# TYPE`` metadata.
+        Returns True iff a series was actually removed."""
+        if not self.enabled:
+            return False
+        key = tuple(sorted((k, str(v)) for k, v in (labels or {}).items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or key not in fam.series:
+                return False
+            del fam.series[key]
+            if not fam.series:
+                del self._families[name]
+            return True
+
     # --- export ---
     def _items(self) -> List[Tuple[str, _Family]]:
         with self._lock:

@@ -15,7 +15,8 @@ import.
 """
 
 from .replay import (CostModel, DEFAULT_KNOBS, FleetTarget, LiveReplayer,
-                     VirtualReplayer, flatten_knobs, merge_knobs, set_flat)
+                     RouterTarget, VirtualReplayer, flatten_knobs,
+                     merge_knobs, set_flat)
 from .score import Outcome, REPORT_SCHEMA, TYPED_CAUSES, report_json, score, \
     summarize
 from .tune import DEFAULT_SPACE, TuneResult, Tuner, record_winner
@@ -26,8 +27,9 @@ from .workload import (CLASS_DEADLINES_MS, Event, LengthDist, Trace,
 __all__ = [
     "CLASS_DEADLINES_MS", "CostModel", "DEFAULT_KNOBS", "DEFAULT_SPACE",
     "Event", "FleetTarget", "LengthDist", "LiveReplayer", "Outcome",
-    "REPORT_SCHEMA", "TYPED_CAUSES", "Trace", "TuneResult", "Tuner",
-    "VirtualReplayer", "WorkloadSpec", "flatten_knobs", "generate_trace",
+    "REPORT_SCHEMA", "RouterTarget", "TYPED_CAUSES", "Trace", "TuneResult",
+    "Tuner", "VirtualReplayer", "WorkloadSpec", "flatten_knobs",
+    "generate_trace",
     "merge_knobs", "prompt_tokens", "record_winner", "report_json", "score",
     "set_flat", "smoke_spec", "summarize",
 ]

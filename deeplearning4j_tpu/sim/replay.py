@@ -317,10 +317,23 @@ class FleetTarget:
     typed-errors-only gate.
     """
 
-    def __init__(self, registry, *, input_len: int = 16, vocab: int = 50):
+    def __init__(self, registry, *, input_len: int = 16, vocab: int = 50,
+                 autoscaler=None):
         self.registry = registry
         self.input_len = int(input_len)
         self.vocab = int(vocab)
+        #: Optional AutoscaleController-shaped hook: anything with a
+        #: ``replica_stats() -> {min, max, final}`` surface. When set, the
+        #: replay's report records how the fleet size moved — a single
+        #: registry doesn't scale itself, but the hook lets one replayer
+        #: code path serve both fixed and elastic targets.
+        self.autoscaler = autoscaler
+
+    def replica_stats(self) -> Optional[Dict[str, int]]:
+        """Fleet-size envelope from the attached autoscaler, if any."""
+        if self.autoscaler is None:
+            return None
+        return self.autoscaler.replica_stats()
 
     def kv_utilization(self) -> Tuple[float, float]:
         """(peak, mean) of serve_kv_block_utilization over resident models."""
@@ -381,6 +394,95 @@ class FleetTarget:
                              tokens=len(ticks))
 
 
+class RouterTarget:
+    """Adapter: trace events -> HTTP through a ClusterRouter front door.
+
+    The cluster analogue of :class:`FleetTarget`: the same trace drives
+    the whole serving stack — router admission, placement, failover,
+    and (with an ``autoscaler=`` attached) an *elastic* fleet — instead
+    of one in-process registry. Failures come back as the typed causes
+    in the router's JSON error bodies, so the scorer's typed-errors-only
+    gate applies unchanged; a transport failure to the router itself
+    records ``upstream_unreachable``. KV utilization is a replica-local
+    gauge the router does not aggregate, so this target reports none.
+    """
+
+    def __init__(self, host: str, port: int, *, input_len: int = 16,
+                 vocab: int = 50, timeout_s: float = 30.0, autoscaler=None):
+        self.host = str(host)
+        self.port = int(port)
+        self.input_len = int(input_len)
+        self.vocab = int(vocab)
+        self.timeout_s = float(timeout_s)
+        self.autoscaler = autoscaler
+
+    def replica_stats(self) -> Optional[Dict[str, int]]:
+        """Fleet-size envelope from the attached autoscaler, if any."""
+        if self.autoscaler is None:
+            return None
+        return self.autoscaler.replica_stats()
+
+    def _post(self, path: str, body: dict,
+              tenant: str) -> Tuple[int, dict]:
+        import http.client
+        import json as _json
+
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+        try:
+            conn.request("POST", path,
+                         body=_json.dumps(body).encode("utf-8"),
+                         headers={"Content-Type": "application/json",
+                                  "X-Tenant": tenant})
+            resp = conn.getresponse()
+            status, data = resp.status, resp.read()
+        finally:
+            conn.close()
+        try:
+            payload = _json.loads(data) if data else {}
+        except ValueError:
+            payload = {}
+        return status, payload if isinstance(payload, dict) else {}
+
+    @staticmethod
+    def _cause(payload: dict) -> str:
+        cause = payload.get("cause")
+        # an error body without a typed cause is an untyped failure and
+        # must score as one — that is the gate working, not a bug here
+        return str(cause) if cause else "internal"
+
+    def predict(self, ev: Event) -> Outcome:
+        toks = prompt_tokens(ev, self.vocab)[:self.input_len]
+        row = toks + [0] * (self.input_len - len(toks))
+        t0 = time.monotonic()
+        try:
+            status, payload = self._post(
+                f"/v1/models/{ev.model}/predict", {"ndarray": row},
+                ev.tenant)
+        except OSError:
+            return _shed(ev, "upstream_unreachable")
+        if status >= 400:
+            return _shed(ev, self._cause(payload))
+        return Outcome(True, None, ev.slo, ev.model, "predict",
+                       time.monotonic() - t0, None, None, 0)
+
+    def generate(self, ev: Event) -> Outcome:
+        t0 = time.monotonic()
+        try:
+            status, payload = self._post(
+                f"/v1/models/{ev.model}/generate?stream=false",
+                {"prompt": prompt_tokens(ev, self.vocab),
+                 "max_new_tokens": ev.max_new_tokens, "temperature": 0.0},
+                ev.tenant)
+        except OSError:
+            return _shed(ev, "upstream_unreachable")
+        if status >= 400:
+            return _shed(ev, self._cause(payload))
+        tokens = payload.get("tokens") or []
+        return Outcome(True, None, ev.slo, ev.model, "generate",
+                       time.monotonic() - t0, None, None, len(tokens))
+
+
 class LiveReplayer:
     """Open-loop replay against a live target at trace-scheduled times.
 
@@ -433,8 +535,17 @@ class LiveReplayer:
         peak, mean = (self.target.kv_utilization()
                       if hasattr(self.target, "kv_utilization")
                       else (0.0, 0.0))
+        extra = {"time_scale": self.time_scale,
+                 "wall_s": time.monotonic() - t0}
+        stats = (self.target.replica_stats()
+                 if hasattr(self.target, "replica_stats") else None)
+        if stats is not None:
+            # integer fleet-size envelope: how elastic capacity moved over
+            # the replay (6-dp float rounding rules untouched)
+            extra["replicas"] = {"min": int(stats["min"]),
+                                 "max": int(stats["max"]),
+                                 "final": int(stats["final"])}
         return summarize(
             self.trace.fingerprint(), outcomes, mode="live",
             kv_peak_utilization=peak, kv_mean_utilization=mean,
-            extra={"time_scale": self.time_scale,
-                   "wall_s": time.monotonic() - t0})
+            extra=extra)

@@ -41,8 +41,10 @@ SLO_EXCLUDED_CAUSES = frozenset(
 # failure leaked through (the smoke's "typed-errors-only" assertion).
 TYPED_CAUSES = frozenset({
     "queue_full", "deadline", "over_capacity", "quota", "shutting_down",
-    "worker_stall", "drain_timeout", "publish_failed", "breaker_open",
-    "no_replica", "bad_request", "client_gone"})
+    "worker_stall", "worker_dead", "drain_timeout", "publish_failed",
+    "breaker_open", "no_replica", "bad_request", "client_gone",
+    # router-tier causes (a replay through a ClusterRouter front door)
+    "upstream_unreachable", "upstream_gone"})
 
 
 class Outcome(NamedTuple):

@@ -123,9 +123,21 @@ class WorkloadSpec:
     relative. ``time_scale`` compresses wall time for *live* replay only —
     it is part of the spec (and fingerprint) because a compressed replay
     offers different instantaneous concurrency than a real-time one.
+
+    ``days`` repeats the compressed diurnal curve: one "day" is
+    ``duration_s`` long, the sinusoid repeats naturally (its default
+    period IS the day), and each later day re-seeds the Markov burst
+    process from a sha256-derived day seed — so a 3-day trace has three
+    *different* burst patterns over the same diurnal shape, which is what
+    makes multi-day autoscaler replays informative instead of three
+    copies of day one. ``days=1`` (the default) is bit-identical to the
+    legacy single-day expansion and is omitted from the canonical spec,
+    so every existing fingerprint (and every tuned config keyed by one)
+    survives unchanged.
     """
 
     def __init__(self, *, seed: int = 0, duration_s: float = 60.0,
+                 days: int = 1,
                  base_rate_rps: float = 4.0,
                  diurnal_amplitude: float = 0.5,
                  diurnal_period_s: Optional[float] = None,
@@ -141,6 +153,9 @@ class WorkloadSpec:
                  models: Optional[Dict[str, dict]] = None):
         self.seed = int(seed)
         self.duration_s = float(duration_s)
+        self.days = int(days)
+        if self.days < 1:
+            raise ValueError("need days >= 1")
         self.base_rate_rps = float(base_rate_rps)
         self.diurnal_amplitude = min(1.0, max(0.0, float(diurnal_amplitude)))
         self.diurnal_period_s = float(
@@ -158,7 +173,20 @@ class WorkloadSpec:
         self.models = models or {"default": {"weight": 1.0,
                                              "generate_frac": 0.0}}
 
+    @property
+    def total_duration_s(self) -> float:
+        """Full trace span: ``days`` diurnal days of ``duration_s`` each."""
+        return self.duration_s * self.days
+
     def to_dict(self) -> dict:
+        d = self._to_dict()
+        if self.days != 1:
+            # a single-day spec's canonical form predates `days`: omitting
+            # the default keeps every legacy fingerprint byte-stable
+            d["days"] = self.days
+        return d
+
+    def _to_dict(self) -> dict:
         return {
             "schema": _TRACE_SCHEMA,
             "seed": self.seed,
@@ -314,6 +342,13 @@ def _burst_windows(rng: random.Random,
     return windows
 
 
+def _day_seed(seed: int, day: int) -> int:
+    """Per-day burst-process seed, stable across processes (sha256, not
+    ``hash()`` — the same discipline as per-event content seeds)."""
+    digest = hashlib.sha256(f"{seed}:day:{day}".encode("utf-8")).digest()
+    return int.from_bytes(digest[:4], "big")
+
+
 def generate_trace(spec: WorkloadSpec) -> Trace:
     """Expand a spec into a trace via Lewis thinning.
 
@@ -324,9 +359,18 @@ def generate_trace(spec: WorkloadSpec) -> Trace:
     and — because every candidate consumes the same number of RNG draws —
     the stream is bit-stable under any spec change that only *lowers*
     local intensity.
+
+    Multi-day specs draw day 0's burst windows from the main RNG stream
+    (so ``days=1`` stays bit-identical to the legacy expansion) and each
+    later day's from its own sha256-derived seed, offset into that day.
     """
     rng = random.Random(spec.seed)
-    windows = _burst_windows(rng, spec)
+    windows = list(_burst_windows(rng, spec))
+    for day in range(1, spec.days):
+        day_rng = random.Random(_day_seed(spec.seed, day))
+        offset = day * spec.duration_s
+        windows.extend((a + offset, b + offset)
+                       for a, b in _burst_windows(day_rng, spec))
     spec_fp = spec.fingerprint()
 
     def modulated_rate(t: float) -> float:
@@ -343,7 +387,7 @@ def generate_trace(spec: WorkloadSpec) -> Trace:
     seq = 0
     while True:
         t += rng.expovariate(envelope)
-        if t >= spec.duration_s:
+        if t >= spec.total_duration_s:
             break
         keep = rng.random() * envelope <= modulated_rate(t)
         # Draw the per-event attributes unconditionally so thinning

@@ -54,6 +54,12 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/cluster/
 # zero-suppression bar.
 echo "=== jaxlint: deeplearning4j_tpu/sim/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/sim/
+# autoscale/ spends money and kills replicas on its own authority: a
+# lint-dirty controller (unlocked managed map, swallowed actuation errors)
+# would flap the fleet it is supposed to steady, so it holds the same
+# zero-suppression bar.
+echo "=== jaxlint: deeplearning4j_tpu/autoscale/ (no baseline permitted) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/autoscale/
 
 echo "=== smoke trace: 5-step instrumented train ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_trace.py
@@ -69,6 +75,9 @@ CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_cluster.py
 
 echo "=== smoke sim: trace replay determinism + autotuned boot ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_sim.py
+
+echo "=== smoke autoscale: burn-driven scale-out, drain-based scale-in ==="
+CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_autoscale.py
 
 # every scrape artifact the smokes wrote must be an exposition a real
 # Prometheus would accept — promcheck is the gate, not just a warning

@@ -1,0 +1,435 @@
+#!/usr/bin/env python
+"""CI smoke autoscale: one seeded drill through the whole elastic loop —
+floor repair, scale-out under SLO burn, drain-based scale-in with
+requests in flight, and dead-replica reap + same-tick repair. The
+ISSUE-12 acceptance surface.
+
+The drill (deterministic, seeded, CPU-only; membership leases, the SLO
+burn window, AND the autoscaler's signal/cooldown clocks all run on one
+skewable clock, so nothing ever waits on wall time):
+
+- **0. floor repair** — the drill boots ONE replica under a policy floor
+  of two: the first tick spawns a second replica via ``below_min``,
+  which bypasses cooldown (a capacity floor is a hard constraint).
+- **A. reference pass** — gold predict + generate answers become the
+  ground truth; every later response must match bit-for-bit or be a
+  typed error (zero wrong-params tolerance).
+- **B. scale-out under burn** — a scoped chaos partition takes BOTH
+  replicas off the air; gold traffic sheds typed, the 1m gold burn
+  spikes above 1.0, and once the burn has *sustained* past the policy
+  window the controller scales out. The first provision attempt is
+  chaos-failed at the ``autoscale.spawn`` seam (counted, no cooldown
+  burned) and the retry on the next tick succeeds: the newcomer
+  AOT-warms from the shared store, beats into membership, placement
+  re-plans, and gold traffic serves again THROUGH the partition (the
+  newcomer is the only reachable replica). Aging the 1m window brings
+  ``fleet_slo_burn_rate{slo_class="gold",window="1m"}`` back below 1.0.
+- **C. idle scale-in drains first** — with the fleet idle and generates
+  IN FLIGHT through the router, the controller picks the emptiest
+  replica, removes it from membership (no new traffic), drains its
+  models over ``/v1/admin/drain`` lease discipline, then stops it. Every
+  in-flight generate completes token-identical to the reference: zero
+  dropped, zero wrong-params. The retired replica's
+  ``cluster_replica_state`` gauge series is DELETED — no ghost scrapes.
+- **D. kill under load, reap + repair on one tick** — a replica is
+  crash-killed under mixed traffic (every response typed or correct),
+  its lease ages out, and a single tick reaps the corpse AND repairs the
+  floor breach (``below_min`` again) — the fleet is back at two with no
+  ghost series for the dead replica.
+
+Artifacts: $CI_ARTIFACTS_DIR/smoke_autoscale_metrics.prom (+ _om.prom,
+both validated by obs.promcheck), smoke_autoscale_decisions.jsonl (the
+controller's canonical decision log), and a flight_NN.json dump.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+SUSPECT_AFTER_S = 2.0
+DEAD_AFTER_S = 45.0        # generous: spawns take real seconds mid-drill
+X = [[0.1, -0.2, 0.3, -0.4]]
+GEN_BODY = {"prompt": [3, 1, 4, 1, 5], "max_new_tokens": 6,
+            "temperature": 0.0, "stream": False}
+
+# one skewable clock for membership leases, the burn wheel, and the
+# autoscaler's signals/cooldowns: bumping the skew ages all three in
+# lockstep, so "sustained for 2s" and "1m window" never wait on wall time
+CLOCK_SKEW = [0.0]
+
+
+def _clock():
+    return time.monotonic() + CLOCK_SKEW[0]
+
+
+def _post(port, path, body, tenant=None):
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers["X-Tenant"] = tenant
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers=headers)
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read()), dict(r.headers)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.status, r.read()
+
+
+def _wait_ready(port, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            status, _ = _get(port, "/ready")
+            if status == 200:
+                return
+        except (urllib.error.HTTPError, OSError):
+            pass
+        time.sleep(0.1)
+    raise AssertionError(f"router not ready within {timeout_s}s")
+
+
+def _metric(scrape: str, name: str, **labels) -> float:
+    total = 0.0
+    found = False
+    for line in scrape.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest and rest[0] not in "{ ":
+            continue  # a longer metric name sharing this prefix
+        if not all(f'{k}="{v}"' in rest for k, v in labels.items()):
+            continue
+        total += float(line.rsplit(" ", 1)[1])
+        found = True
+    assert found, f"metric {name}{labels or ''} missing from scrape"
+    return total
+
+
+def _state_series(scrape: str) -> set:
+    """Replica ids that still own a ``cluster_replica_state`` series."""
+    out = set()
+    for line in scrape.splitlines():
+        if line.startswith("cluster_replica_state{"):
+            label = line[len("cluster_replica_state{"):].split("}")[0]
+            for item in label.split(","):
+                k, _, v = item.partition("=")
+                if k == "replica":
+                    out.add(v.strip('"'))
+    return out
+
+
+def _typed_error(port, path, body, tenant=None):
+    """POST expecting a typed error; returns (code, cause)."""
+    try:
+        _post(port, path, body, tenant=tenant)
+    except urllib.error.HTTPError as e:
+        payload = json.loads(e.read())
+        assert "cause" in payload, f"untyped {e.code} from {path}: {payload}"
+        return e.code, payload["cause"]
+    raise AssertionError(f"{path} unexpectedly succeeded")
+
+
+def _tick(ctl, step_s=1.0):
+    """One control turn, one second later on the drill clock."""
+    CLOCK_SKEW[0] += step_s
+    return ctl.tick()
+
+
+def main():
+    artifacts = os.environ.get("CI_ARTIFACTS_DIR", "ci-artifacts")
+    os.makedirs(artifacts, exist_ok=True)
+
+    from deeplearning4j_tpu.aot import AotStore
+    from deeplearning4j_tpu.autoscale import (AutoscaleController,
+                                              AutoscalePolicy)
+    from deeplearning4j_tpu.chaos import FaultPlane, install, uninstall
+    from deeplearning4j_tpu.cluster import ClusterRouter, spawn_replica
+    from deeplearning4j_tpu.fleet import FleetRegistry
+    from deeplearning4j_tpu.models import CausalLM
+    from deeplearning4j_tpu.nn.layers import Dense, Output
+    from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+    from deeplearning4j_tpu.obs import flight as flight_mod
+    from deeplearning4j_tpu.obs.flight import FlightRecorder
+    from deeplearning4j_tpu.obs.promcheck import check_text
+
+    recorder = flight_mod.install(FlightRecorder(out_dir=artifacts))
+
+    store_dir = tempfile.mkdtemp(prefix="smoke_autoscale_aot_")
+    handles = {}
+
+    def factory(rid):
+        """One replica: dense model + LM over the SHARED AOT store; seeds
+        shared across replicas, so every replica computes the same
+        answers — the drill's wrong-params oracle."""
+        dense = Sequential(NetConfig(seed=0),
+                           [Dense(n_out=6, activation="tanh"),
+                            Output(n_out=3, loss="mcxent",
+                                   activation="softmax")], (4,))
+        dense.init()
+        lm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=32,
+                      num_heads=4, vocab=50).build()
+        lm.init()
+        fleet = FleetRegistry(aot_store=AotStore(store_dir))
+        fleet.add("d", dense)
+        fleet.add("g", lm, input_dtype=np.int32,
+                  gen_opts={"slots": 2, "capacity": 24, "seed": 0})
+        handles[rid] = spawn_replica(rid, fleet)
+        return handles[rid]
+
+    # heartbeat parked at 1h: the controller's ticks drive every poll, so
+    # membership, burn, and decisions advance only when the drill says so
+    router = ClusterRouter(port=0, heartbeat_s=3600.0, hedge_ms=None,
+                           suspect_after_s=SUSPECT_AFTER_S,
+                           dead_after_s=DEAD_AFTER_S, clock=_clock)
+    router.tenants.register("vip", rate_per_s=1000.0, slo="gold")
+    router.tenants.register("std", rate_per_s=1000.0, slo="standard")
+    seed = factory("r1")
+    router.add_replica("r1", seed.base_url)
+    router.start()
+    port = router.port
+
+    policy = AutoscalePolicy(min_replicas=2, max_replicas=3,
+                             sustain_out_s=1.5, sustain_in_s=2.0,
+                             cooldown_out_s=4.0, cooldown_in_s=4.0,
+                             queue_high=1e9, queue_low=10.0)
+    ctl = AutoscaleController(router, factory, policy=policy,
+                              clock=_clock, beat_wait_s=2.0)
+    ctl.adopt("r1", seed)
+    try:
+        _wait_ready(port)
+
+        # ---- 0: one replica under a floor of two -> immediate repair
+        print("=== phase 0: below_min floor repair ===", flush=True)
+        d = _tick(ctl)
+        assert (d.direction, d.reason) == ("out", "below_min"), d
+        assert sorted(handles) == ["as-0", "r1"]
+        assert router.membership.state("as-0") == "alive"
+
+        # ---- A: fault-free reference pass
+        print("=== phase A: reference pass ===", flush=True)
+        ref_pred = _post(port, "/v1/models/d/predict", {"ndarray": X},
+                         tenant="vip")[0]
+        ref_toks = _post(port, "/v1/models/g/generate?stream=false",
+                         GEN_BODY, tenant="std")[0]["tokens"]
+        assert ref_toks, "reference generation returned no tokens"
+
+        # ---- B: partition both replicas -> burn spike -> scale out
+        print("=== phase B: scale-out under sustained gold burn ===",
+              flush=True)
+        fp = install(FaultPlane(seed=0, metrics=router.metrics))
+        for rid in ("r1", "as-0"):
+            fp.inject_spec(
+                f"cluster.transport:error:type=connection,scope={rid},"
+                f"times=-1")
+        # the FIRST provision attempt fails at the chaos seam — the
+        # controller must count it, burn no cooldown, and retry
+        fp.inject_spec("autoscale.spawn:error:type=runtime,times=1")
+
+        decisions = []
+        for _ in range(5):
+            if ctl.replica_stats()["final"] >= 3:
+                break
+            for _ in range(3):
+                code, cause = _typed_error(
+                    port, "/v1/models/d/predict", {"ndarray": X},
+                    tenant="vip")
+                assert code in (502, 503) and cause in (
+                    "upstream_unreachable", "no_replica"), (code, cause)
+            scrape = _get(port, "/metrics")[1].decode()
+            burn = _metric(scrape, "fleet_slo_burn_rate", model="d",
+                           slo_class="gold", window="1m")
+            assert burn > 1.0, f"gold burn did not spike: {burn}"
+            decisions.append(_tick(ctl))
+        reasons = [(d.direction, d.reason) for d in decisions]
+        assert ("out", "burn") in reasons, reasons
+        assert ctl.replica_stats()["final"] == 3, reasons
+        # the failed attempt must not consume an id: the retry IS "as-1"
+        assert "as-1" in handles and "as-2" not in handles, sorted(handles)
+        scrape = _get(port, "/metrics")[1].decode()
+        assert _metric(scrape, "autoscale_spawn_failures_total") == 1
+
+        # elastic capacity arrived: the newcomer is the ONLY reachable
+        # replica, and gold traffic serves through the partition
+        out = _post(port, "/v1/models/d/predict", {"ndarray": X},
+                    tenant="vip")[0]
+        assert np.allclose(out["output"], ref_pred["output"]), \
+            "newcomer served wrong params"
+        uninstall()
+        # age the bad events out of the 1m gold window and serve traffic:
+        # burn must recover below 1.0 — the ROADMAP drill's exit criterion
+        CLOCK_SKEW[0] += 61.0
+        router.poll_once()  # resurrect the healed replicas (no decision)
+        for _ in range(5):
+            out = _post(port, "/v1/models/d/predict", {"ndarray": X},
+                        tenant="vip")[0]
+            assert np.allclose(out["output"], ref_pred["output"])
+        scrape = _get(port, "/metrics")[1].decode()
+        burn = _metric(scrape, "fleet_slo_burn_rate", model="d",
+                       slo_class="gold", window="1m")
+        assert burn < 1.0, f"gold burn did not recover: {burn}"
+
+        # ---- C: idle scale-in drains before retiring, in-flight survives
+        print("=== phase C: drain-based scale-in with requests in flight ===",
+              flush=True)
+        results, errors = [], []
+
+        def fire():
+            try:
+                results.append(_post(
+                    port, "/v1/models/g/generate?stream=false", GEN_BODY,
+                    tenant="std")[0]["tokens"])
+            except Exception as e:  # any failure fails the drill below  # jaxlint: disable=broad-except
+                errors.append(e)
+
+        before = set(router.membership.ids())
+        for _ in range(4):
+            if ctl.replica_stats()["final"] <= 2:
+                break
+            threads = [threading.Thread(target=fire) for _ in range(3)]
+            for t in threads:
+                t.start()
+            d = _tick(ctl)
+            for t in threads:
+                t.join(timeout=60)
+        assert ctl.replica_stats()["final"] == 2, d
+        assert not errors, f"requests dropped during scale-in: {errors}"
+        assert results and all(r == ref_toks for r in results), \
+            "wrong params served during drain-then-retire"
+        retired = before - set(router.membership.ids())
+        assert len(retired) == 1, retired
+        victim = retired.pop()
+        assert not handles[victim].alive(), "victim still running"
+        scrape = _get(port, "/metrics")[1].decode()
+        assert victim not in _state_series(scrape), \
+            f"retired {victim} left a ghost cluster_replica_state series"
+        assert _metric(scrape, "autoscale_retired_total",
+                       cause="scale_in") == 1
+        # the lease-drain handshake itself must succeed — a 400 here means
+        # stop() is silently doing all the draining (regression: the drain
+        # handler once called the .resident property as a method)
+        assert _metric(scrape, "autoscale_drains_total", outcome="ok") >= 1
+        assert "autoscale_drains_total{outcome=\"error\"}" not in scrape, \
+            "some /v1/admin/drain calls failed"
+
+        # ---- D: crash-kill under load -> reap + floor repair on one tick
+        print("=== phase D: kill, reap, same-tick repair ===", flush=True)
+        alive = sorted(set(router.membership.ids()))
+        dead_rid = next(r for r in alive if r != "r1")
+        handles[dead_rid].kill()
+        for _ in range(6):  # mixed load across the kill: typed or correct
+            try:
+                out = _post(port, "/v1/models/d/predict", {"ndarray": X},
+                            tenant="vip")[0]
+            except urllib.error.HTTPError as e:
+                payload = json.loads(e.read())
+                assert e.code != 500 and "cause" in payload, \
+                    f"raw/untyped error {e.code}: {payload}"
+            else:
+                assert np.allclose(out["output"], ref_pred["output"]), \
+                    "WRONG-PARAMS answer during the kill window"
+        CLOCK_SKEW[0] += DEAD_AFTER_S  # age the corpse's lease out
+        d = ctl.tick()
+        assert (d.direction, d.reason) == ("out", "below_min"), d
+        assert dead_rid not in router.membership.ids()
+        assert ctl.replica_stats()["final"] == 2
+        toks = _post(port, "/v1/models/g/generate?stream=false", GEN_BODY,
+                     tenant="std")[0]["tokens"]
+        assert toks == ref_toks, "repaired fleet diverged from reference"
+
+        # ---- final: metrics moved, no ghosts, expositions valid
+        scrape = _get(port, "/metrics")[1].decode()
+        with open(os.path.join(artifacts, "smoke_autoscale_metrics.prom"),
+                  "w") as f:
+            f.write(scrape)
+        assert _metric(scrape, "autoscale_replicas_actual") == 2
+        assert _metric(scrape, "autoscale_replicas_desired") == 2
+        assert _metric(scrape, "autoscale_decisions_total",
+                       direction="out") >= 3
+        assert _metric(scrape, "autoscale_decisions_total",
+                       direction="in", reason="idle") >= 1
+        assert _metric(scrape, "autoscale_scale_seconds_count",
+                       direction="out") >= 2
+        assert _metric(scrape, "autoscale_scale_seconds_count",
+                       direction="in") >= 1
+        assert _metric(scrape, "autoscale_retired_total", cause="dead") == 1
+        # the scrape shows EXACTLY the live fleet — retired and dead
+        # replicas own no state series
+        assert _state_series(scrape) == set(router.membership.ids())
+        errs = check_text(scrape, openmetrics=False)
+        assert not errs, f"invalid /metrics exposition: {errs[:5]}"
+        om = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics",
+            headers={"Accept": "application/openmetrics-text"}),
+            timeout=30).read().decode()
+        with open(os.path.join(artifacts,
+                               "smoke_autoscale_metrics_om.prom"), "w") as f:
+            f.write(om)
+        errs = check_text(om)
+        assert not errs, f"invalid OpenMetrics exposition: {errs[:5]}"
+
+        # the autoscaler is observable on the cluster surface
+        view = json.loads(_get(port, "/v1/cluster")[1])
+        assert view["autoscale"]["actual"] == 2
+        assert view["autoscale"]["policy"]["min_replicas"] == 2
+        assert view["autoscale"]["last_decision"] is not None
+
+        # canonical decision log -> artifact (the byte-identity surface)
+        log_bytes = ctl.decision_log_bytes()
+        with open(os.path.join(artifacts, "smoke_autoscale_decisions.jsonl"),
+                  "wb") as f:
+            f.write(log_bytes)
+        lines = [json.loads(ln) for ln in log_bytes.decode().splitlines()]
+        assert len(lines) == ctl.snapshot()["ticks"]
+        assert all("decision" in ln and "evidence" in ln["decision"]
+                   for ln in lines)
+
+        dump_path = recorder.dump("autoscale_drill")
+        assert dump_path is not None, "flight recorder refused to dump"
+        with open(dump_path) as f:
+            dumped = json.load(f)
+        kinds = {(e.get("kind"), e.get("name"))
+                 for e in dumped.get("events", [])}
+        for what in ("spawned", "retired", "reaped"):
+            assert ("autoscale", what) in kinds, \
+                f"flight recorder missing autoscale/{what}: {sorted(kinds)}"
+    finally:
+        uninstall()
+        ctl.stop()
+        router.stop()
+        for h in handles.values():
+            if h.alive():
+                h.stop()
+        flight_mod.uninstall()
+
+    # nothing left running: router, controller, replicas, batchers all down
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        hung = [t for t in threading.enumerate()
+                if t.name.startswith(("serve-", "fleet-", "cluster-",
+                                      "autoscale-"))
+                and t.is_alive()]
+        if not hung:
+            break
+        time.sleep(0.1)
+    assert not hung, f"threads left hanging: {[t.name for t in hung]}"
+    print("smoke autoscale OK: floor repaired, scaled out under burn, "
+          "burn recovered < 1.0, drain-based scale-in dropped nothing, "
+          "dead replica reaped with no ghost series")
+
+
+if __name__ == "__main__":
+    main()

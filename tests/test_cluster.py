@@ -97,6 +97,28 @@ class TestMembership:
         with pytest.raises(ValueError):
             Membership(suspect_after_s=5.0, dead_after_s=5.0)
 
+    def test_remove_retires_and_deletes_the_ghost_gauge_series(self):
+        """A retired replica (autoscaler scale-in) must vanish from the
+        scrape: its ``cluster_replica_state`` series is deleted — not left
+        behind as a ghost instance — while the transitions counter keeps
+        a ``to="retired"`` record."""
+        t = [0.0]
+        m = MetricsRegistry()
+        mem = Membership(clock=lambda: t[0], metrics=m)
+        mem.add("r1", "u1")
+        mem.add("r2", "u2")
+        assert 'cluster_replica_state{replica="r1"}' in m.to_prometheus()
+        mem.remove("r1")
+        scrape = m.to_prometheus()
+        assert 'cluster_replica_state{replica="r1"}' not in scrape
+        assert 'cluster_replica_state{replica="r2"}' in scrape
+        assert _counter_value(
+            m, "cluster_replica_transitions_total",
+            {"replica": "r1", "to": "retired"}) == 1
+        assert mem.ids() == ["r2"]
+        with pytest.raises(KeyError):
+            mem.remove("r1")                     # already gone: typed error
+
 
 # --------------------------------------------------------------------------
 class TestPlacement:

@@ -439,6 +439,31 @@ class TestFleetHTTP:
         finally:
             srv.stop()
 
+    def test_admin_drain_pages_out_and_answers_200(self):
+        # regression: the handler once called the .resident property as a
+        # method, so every drain answered 400 ('bool' is not callable) and
+        # callers silently fell back to stop()-time draining
+        fleet = FleetRegistry()
+        fleet.add("a", _dense_model(seed=1),
+                  engine_opts={"batch_buckets": (1, 2)})
+        srv = FleetServer(fleet, port=0).start()
+        try:
+            x = np.random.RandomState(0).rand(1, 4).astype(np.float32)
+            self._post(srv.port, "/v1/models/a/predict",
+                       {"ndarray": x.tolist()})
+            out = self._post(srv.port, "/v1/admin/drain", {"model": "a"})
+            assert out == {"model": "a", "resident": False}
+            assert self._get(srv.port, "/v1/models/a")["resident"] is False
+            # drained, not deleted: the pager pages it back in on demand
+            out = self._post(srv.port, "/v1/models/a/predict",
+                             {"ndarray": x.tolist()})
+            assert out["model"] == "a"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(srv.port, "/v1/admin/drain", {"model": "nope"})
+            assert ei.value.code != 500
+        finally:
+            srv.stop()
+
     def test_generate_routes_and_sse(self):
         from deeplearning4j_tpu.nn.generation import generate as refgen
 

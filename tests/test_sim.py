@@ -35,8 +35,8 @@ from deeplearning4j_tpu.aot import AotStore, get_tuned, put_tuned, tuned_key
 from deeplearning4j_tpu.obs.metrics import MetricsRegistry
 from deeplearning4j_tpu.sim import (DEFAULT_KNOBS, TYPED_CAUSES, LiveReplayer,
                                     Outcome, Trace, Tuner, VirtualReplayer,
-                                    generate_trace, report_json, score,
-                                    smoke_spec)
+                                    WorkloadSpec, generate_trace, report_json,
+                                    score, smoke_spec)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -104,6 +104,69 @@ class TestTraceDeterminism:
         times = [ev.t_us for ev in t]
         assert times == sorted(times)
         assert len({ev.seed for ev in t}) == len(t)  # per-event content seeds
+
+
+# ------------------------------------------------------------ multi-day specs
+class TestMultiDay:
+    def _days(self, n, **kw):
+        d = _spec(**kw).to_dict()
+        d["days"] = n
+        return WorkloadSpec.from_dict(d)
+
+    def test_single_day_canonical_form_is_legacy(self):
+        """``days`` is omitted from the canonical dict at its default, so
+        every pre-`days` fingerprint (and every tuned-config key derived
+        from one) stays byte-stable."""
+        spec = _spec()
+        assert "days" not in spec.to_dict()
+        d1 = self._days(1)
+        assert "days" not in d1.to_dict()
+        assert d1.fingerprint() == spec.fingerprint()
+        assert self._days(3).fingerprint() != spec.fingerprint()
+
+    def test_roundtrip_keeps_days(self):
+        spec3 = self._days(3)
+        again = WorkloadSpec.from_dict(spec3.to_dict())
+        assert again.days == 3
+        assert again.total_duration_s == 3 * spec3.duration_s
+        assert again.fingerprint() == spec3.fingerprint()
+
+    def test_rejects_bad_days(self):
+        with pytest.raises(ValueError):
+            self._days(0)
+
+    def test_multi_day_is_deterministic_and_spans_every_day(self):
+        spec3 = self._days(3)
+        a, b = generate_trace(spec3), generate_trace(spec3)
+        assert a.to_bytes() == b.to_bytes()
+        assert a.events[-1].t_s > 2 * spec3.duration_s  # day 3 has traffic
+        days_hit = {int(ev.t_s // spec3.duration_s) for ev in a}
+        assert days_hit == {0, 1, 2}
+
+    def test_day_one_prefix_matches_single_day_trace(self):
+        """Day 0 of a multi-day expansion consumes the identical rng
+        stream as the legacy single-day expansion, so its arrival prefix
+        (times, tenants, models, kinds, lengths) is identical — extending
+        a study to more days never reshapes the day you already measured.
+        Only the per-event *content* seed differs, because it is keyed to
+        the full spec fingerprint (which includes ``days``)."""
+        spec1, spec3 = _spec(), self._days(3)
+        t1 = generate_trace(spec1)
+        t3 = generate_trace(spec3)
+        prefix = [ev for ev in t3 if ev.t_s < spec1.duration_s]
+        assert [ev._replace(seed=0).to_line() for ev in prefix] == \
+            [ev._replace(seed=0).to_line() for ev in t1]
+
+    def test_days_reseed_the_burst_process(self):
+        """Later days are not copies of day one: the per-day Markov
+        re-seed gives each day its own burst windows (arrival counts per
+        day differ — identical counts would mean a copied process)."""
+        spec3 = self._days(3, duration_s=30.0, rate=12.0)
+        t3 = generate_trace(spec3)
+        per_day = [0, 0, 0]
+        for ev in t3:
+            per_day[int(ev.t_s // spec3.duration_s)] += 1
+        assert len(set(per_day)) > 1, per_day
 
 
 # ------------------------------------------------------------- virtual replay
@@ -314,6 +377,21 @@ class TestLiveReplay:
                            time_scale=0.01).run()
         assert rep["untyped_errors"] == 1
         assert rep["shed"].get("internal") == 1
+
+    def test_elastic_target_stamps_replicas_block(self):
+        """A target with ``replica_stats`` (an autoscaled fleet) gets its
+        min/max/final fleet sizes stamped into the report; a fixed-size
+        target's report is unchanged — and both stay deterministic."""
+        class _Elastic(_StubTarget):
+            def replica_stats(self):
+                return {"min": 1, "max": 3, "final": 2}
+
+        t = generate_trace(_spec(duration_s=8.0))
+        rep = LiveReplayer(t, _Elastic(), time_scale=0.01).run()
+        assert rep["replicas"] == {"min": 1, "max": 3, "final": 2}
+        assert report_json(rep)  # still serializes canonically
+        fixed = LiveReplayer(t, _StubTarget(), time_scale=0.01).run()
+        assert "replicas" not in fixed
 
 
 # ----------------------------------------------------------------- satellites
