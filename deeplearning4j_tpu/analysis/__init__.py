@@ -24,11 +24,14 @@ in ``deeplearning4j_tpu/analysis/README.md``.
 from .callgraph import Program, build_program
 from .engine import (Finding, Rule, analyze_paths, analyze_source,
                      iter_py_files, render_json, render_text)
+from .locks import LockModel, get_lock_model
 from .rules import ALL_RULES, rules_by_name
 from .sarif import (fingerprints, load_baseline, new_findings, render_sarif,
                     to_sarif, write_baseline)
+from .typeinfo import Types, get_types
 
 __all__ = ["Finding", "Rule", "ALL_RULES", "rules_by_name", "analyze_paths",
            "analyze_source", "iter_py_files", "render_json", "render_text",
            "Program", "build_program", "to_sarif", "render_sarif",
-           "fingerprints", "write_baseline", "load_baseline", "new_findings"]
+           "fingerprints", "write_baseline", "load_baseline", "new_findings",
+           "Types", "get_types", "LockModel", "get_lock_model"]

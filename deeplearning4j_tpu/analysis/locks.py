@@ -1,0 +1,419 @@
+"""Lock model for jaxlint's concurrency rules.
+
+Classic static deadlock/blocking analysis in the Eraser / lock-order-graph
+tradition, adapted to this codebase's idiom: every lock is an attribute
+bound once in ``__init__`` (``self._lock = threading.Lock()``) or a
+module-level constant, and every acquisition is a ``with`` block (plus the
+occasional explicit ``.acquire()``). Lock *identity* is therefore nominal:
+
+- ``<module>.<Class>.<attr>`` for instance locks — one identity per
+  (class, attribute), not per object. Two instances of the same class
+  share an identity, so self-edges are never reported (an RLock re-enter
+  and a two-instance ABBA look identical at this resolution);
+- ``<module>.<NAME>`` for module-level locks.
+
+On top of identity the model computes, to a fixpoint over the typed call
+graph (:mod:`.typeinfo` resolves ``self._pager.ensure(...)``-style edges
+the core resolver cannot):
+
+- ``acquires``: every lock a function may take, directly or transitively;
+- ``block_chain``: a witness chain ("f calls g (line n); g: time.sleep")
+  when a function may block — socket/HTTP I/O, ``time.sleep``,
+  ``block_until_ready``, device transfers, ``subprocess``,
+  ``Event.wait``/``Thread.join``, ``Condition.wait``;
+- the **lock-order graph**: an edge A -> B with a witness site whenever a
+  function holding A acquires B (directly or through a callee). Cycles in
+  this graph are potential ABBA deadlocks.
+
+``Condition.wait`` releases the condition it waits on, so waiting on the
+*held* condition is the sanctioned wait-loop idiom and is exempt at the
+direct site — but the function still blocks its callers, so the fact
+propagates. A helper that deliberately blocks under its own discipline
+(the pager's reserve-under-lock / transfer-outside-it pattern) opts out
+with a sanction comment on its ``def`` line::
+
+    def ensure(self, name):  # jaxlint: sanction=blocking-call-under-lock
+
+Sanctioning clears the helper's blocking summary for callers *and* skips
+its body — unlike ``disable=``, which only mutes one report line. Use it
+for helpers whose blocking is a designed contract, with a justification
+comment alongside.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .typeinfo import LOCK_CTORS, dotted_expr, get_types
+
+_LOCKS_CACHE = "locks:model"
+
+_SANCTION_RE = re.compile(r"#\s*jaxlint:\s*sanction=([A-Za-z0-9_\-, ]+)")
+
+#: dotted-path prefixes that block the calling thread on I/O or a child
+_BLOCKING_PREFIXES = ("socket.", "urllib.request.", "http.client.",
+                      "requests.", "subprocess.")
+
+#: exact dotted paths that block
+_BLOCKING_CALLS = {"time.sleep", "jax.device_put", "jax.device_get",
+                   "subprocess.run", "subprocess.check_output"}
+
+
+class BlockSite:
+    """One direct blocking operation inside a function."""
+
+    __slots__ = ("node", "desc", "exempt_lock")
+
+    def __init__(self, node: ast.AST, desc: str,
+                 exempt_lock: Optional[str] = None):
+        self.node = node
+        self.desc = desc
+        #: lock id whose *being held* makes this site sanctioned —
+        #: Condition.wait on the held condition (the wait releases it)
+        self.exempt_lock = exempt_lock
+
+
+class LockModel:
+    """Program-wide lock facts. Build via :func:`get_lock_model`."""
+
+    def __init__(self, program):
+        self.program = program
+        self.types = get_types(program)
+        #: module qual -> {NAME: ctor qual} for module-level locks
+        self.module_locks: Dict[str, Dict[str, str]] = {}
+        #: FuncInfo -> rule names sanctioned on its def line
+        self.sanctions: Dict[object, Set[str]] = {}
+        #: FuncInfo -> [(call node, callee FuncInfo)]
+        self.call_edges: Dict[object, List[Tuple[ast.Call, object]]] = {}
+        #: FuncInfo -> transitive set of lock ids it may acquire
+        self.acquires: Dict[object, Set[str]] = {}
+        #: FuncInfo -> witness chain (list of strings) if it may block
+        self.block_chain: Dict[object, List[str]] = {}
+        #: (lock A, lock B) -> (path, line, via-description) first witness
+        self.order_edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self._events: Dict[object, list] = {}
+
+        self._collect_module_locks()
+        self._collect_sanctions()
+        self._all_funcs = sorted(
+            (fi for mi in program.modules.values() for fi in mi.all_funcs),
+            key=lambda fi: (fi.module.module, fi.qual, fi.node.lineno))
+        for fi in self._all_funcs:
+            self.call_edges[fi] = self._edges_of(fi)
+        self._fixpoint_acquires()
+        self._fixpoint_blocking()
+        self._build_order_graph()
+
+    # -- construction -----------------------------------------------------
+    def _collect_module_locks(self):
+        for mi in self.program.modules.values():
+            table: Dict[str, str] = {}
+            for stmt in mi.tree.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name) \
+                        and isinstance(stmt.value, ast.Call):
+                    q = dotted_expr(mi, stmt.value.func)
+                    if q in LOCK_CTORS:
+                        table[stmt.targets[0].id] = q
+            self.module_locks[mi.module] = table
+
+    def _collect_sanctions(self):
+        for mi in self.program.modules.values():
+            lines = mi.source.splitlines()
+            for fi in mi.all_funcs:
+                start = min([fi.node.lineno]
+                            + [d.lineno for d in fi.node.decorator_list])
+                rules: Set[str] = set()
+                for ln in range(start, fi.node.lineno + 1):
+                    if 0 < ln <= len(lines):
+                        m = _SANCTION_RE.search(lines[ln - 1])
+                        if m:
+                            rules.update(r.strip()
+                                         for r in m.group(1).split(",")
+                                         if r.strip())
+                if rules:
+                    self.sanctions[fi] = rules
+
+    def sanctioned(self, fi, rule: str) -> bool:
+        return rule in self.sanctions.get(fi, ())
+
+    def _edges_of(self, fi) -> List[Tuple[ast.Call, object]]:
+        out = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                callee = self.types.method_callee(fi, node)
+                if callee is not None and callee is not fi:
+                    out.append((node, callee))
+        return out
+
+    # -- lock identity ----------------------------------------------------
+    def lock_id(self, fi, expr: ast.AST) -> Optional[str]:
+        """Nominal identity of a lock expression, or None if the
+        expression is not provably a lock."""
+        mi = fi.module
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks.get(mi.module, ()):
+                return f"{mi.module}.{expr.id}"
+            return None  # function-local locks have no nominal identity
+        if isinstance(expr, ast.Attribute):
+            base_t = self.types.type_of(fi, expr.value)
+            ci = self.types.class_of(base_t)
+            if ci is not None and expr.attr in ci.lock_attrs:
+                return f"{ci.qual}.{expr.attr}"
+        return None
+
+    def lock_ctor(self, lock_id: str) -> Optional[str]:
+        """The threading ctor qual behind a lock id (None if unknown)."""
+        head, _, attr = lock_id.rpartition(".")
+        ci = self.types.classes.get(head)
+        if ci is not None:
+            return ci.lock_attrs.get(attr)
+        mod, _, name = lock_id.rpartition(".")
+        return self.module_locks.get(mod, {}).get(name)
+
+    # -- per-function events ----------------------------------------------
+    def direct_blocks(self, fi) -> List[BlockSite]:
+        """Blocking operations appearing directly in ``fi``'s body."""
+        mi = fi.module
+        out: List[BlockSite] = []
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            q = dotted_expr(mi, node.func)
+            if q in _BLOCKING_CALLS or (
+                    q and q.startswith(_BLOCKING_PREFIXES)):
+                out.append(BlockSite(node, f"{q}()"))
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr == "block_until_ready":
+                out.append(BlockSite(node, ".block_until_ready()"))
+            elif f.attr in ("wait", "wait_for", "join", "getresponse",
+                            "communicate"):
+                recv_t = self.types.type_of(fi, f.value)
+                lid = self.lock_id(fi, f.value)
+                ctor = self.lock_ctor(lid) if lid else None
+                if ctor == "threading.Condition":
+                    # waiting on the held condition releases it: exempt at
+                    # the direct site, but callers still see the block
+                    out.append(BlockSite(node, f"Condition.{f.attr}()",
+                                         exempt_lock=lid))
+                elif recv_t == "threading.Event" and f.attr == "wait":
+                    out.append(BlockSite(node, "Event.wait()"))
+                elif recv_t == "threading.Thread" and f.attr == "join":
+                    out.append(BlockSite(node, "Thread.join()"))
+                elif recv_t == "http.client.HTTPConnection" \
+                        or (recv_t or "").startswith("subprocess."):
+                    out.append(BlockSite(node, f".{f.attr}()"))
+        return out
+
+    def events(self, fi) -> list:
+        """Structural event stream for ``fi``: ``("acquire", lock_id,
+        node, held_before)`` and ``("call", node, held)`` tuples, with
+        ``held`` the tuple of lock ids held at that point (innermost
+        last). ``with``-acquired locks scope over their body; bare
+        ``.acquire()`` holds to end of function (approximation)."""
+        cached = self._events.get(fi)
+        if cached is not None:
+            return cached
+        out: list = []
+        held: List[str] = []
+
+        def expr_calls(e: Optional[ast.AST]):
+            if e is None:
+                return
+            for n in ast.walk(e):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                        lid = self.lock_id(fi, f.value)
+                        if lid is not None:
+                            out.append(("acquire", lid, n, tuple(held)))
+                            held.append(lid)
+                            continue
+                    out.append(("call", n, tuple(held)))
+
+        def walk(stmts):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue  # separate scope
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    ids = []
+                    for item in st.items:
+                        expr_calls(item.context_expr)
+                        lid = self.lock_id(fi, item.context_expr)
+                        if lid is not None:
+                            out.append(("acquire", lid, item.context_expr,
+                                        tuple(held)))
+                            held.append(lid)
+                            ids.append(lid)
+                    walk(st.body)
+                    for _ in ids:
+                        held.pop()
+                elif isinstance(st, ast.If):
+                    expr_calls(st.test)
+                    walk(st.body)
+                    walk(st.orelse)
+                elif isinstance(st, (ast.For, ast.AsyncFor)):
+                    expr_calls(st.iter)
+                    walk(st.body)
+                    walk(st.orelse)
+                elif isinstance(st, ast.While):
+                    expr_calls(st.test)
+                    walk(st.body)
+                    walk(st.orelse)
+                elif isinstance(st, ast.Try):
+                    walk(st.body)
+                    for h in st.handlers:
+                        walk(h.body)
+                    walk(st.orelse)
+                    walk(st.finalbody)
+                else:
+                    for e in ast.iter_child_nodes(st):
+                        if isinstance(e, ast.expr):
+                            expr_calls(e)
+
+        walk(fi.node.body)
+        self._events[fi] = out
+        return out
+
+    # -- fixpoints ---------------------------------------------------------
+    def _fixpoint_acquires(self):
+        for fi in self._all_funcs:
+            direct = {ev[1] for ev in self.events(fi) if ev[0] == "acquire"}
+            self.acquires[fi] = direct
+        changed = True
+        while changed:
+            changed = False
+            for fi in self._all_funcs:
+                acc = self.acquires[fi]
+                before = len(acc)
+                for _, callee in self.call_edges.get(fi, ()):
+                    acc |= self.acquires.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+
+    def _fixpoint_blocking(self):
+        rule = "blocking-call-under-lock"
+        for fi in self._all_funcs:
+            if self.sanctioned(fi, rule):
+                continue
+            sites = self.direct_blocks(fi)
+            if sites:
+                s = sites[0]
+                self.block_chain[fi] = [
+                    f"{fi.qual} ({s.desc} at "
+                    f"{fi.module.path}:{s.node.lineno})"]
+        changed = True
+        while changed:
+            changed = False
+            for fi in self._all_funcs:
+                if fi in self.block_chain or self.sanctioned(fi, rule):
+                    continue
+                for call, callee in self.call_edges.get(fi, ()):
+                    chain = self.block_chain.get(callee)
+                    if chain and len(chain) < 6:
+                        self.block_chain[fi] = [
+                            f"{fi.qual} calls {callee.qual} "
+                            f"(line {call.lineno})"] + chain
+                        changed = True
+                        break
+
+    # -- order graph -------------------------------------------------------
+    def _build_order_graph(self):
+        for fi in self._all_funcs:
+            callee_at = {id(call): callee
+                         for call, callee in self.call_edges.get(fi, ())}
+            for ev in self.events(fi):
+                if ev[0] == "acquire":
+                    _, lid, node, held = ev
+                    for h in held:
+                        self._edge(h, lid, fi, node, f"{fi.qual} acquires")
+                else:
+                    _, node, held = ev
+                    if not held:
+                        continue
+                    callee = callee_at.get(id(node))
+                    if callee is None:
+                        continue
+                    for lid in sorted(self.acquires.get(callee, ())):
+                        for h in held:
+                            self._edge(h, lid, fi, node,
+                                       f"{fi.qual} -> {callee.qual} "
+                                       f"acquires")
+
+    def _edge(self, a: str, b: str, fi, node, via: str):
+        if a == b:
+            return  # one nominal id per (class, attr): self-edges are
+            # indistinguishable from RLock re-entry / two instances
+        self.order_edges.setdefault(
+            (a, b), (fi.module.path, getattr(node, "lineno", 0), via))
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary lock-order cycles, each as the sorted list of lock
+        ids in one strongly connected component of size >= 2."""
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self.order_edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str):
+            # iterative Tarjan: (node, child-iterator) frames
+            frames = [(v, iter(sorted(graph[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while frames:
+                node, it = frames[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        frames.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    elif w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                frames.pop()
+                if frames:
+                    p = frames[-1][0]
+                    low[p] = min(low[p], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) >= 2:
+                        sccs.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        return sorted(sccs)
+
+
+def get_lock_model(program) -> LockModel:
+    m = program.cache.get(_LOCKS_CACHE)
+    if m is None:
+        m = LockModel(program)
+        program.cache[_LOCKS_CACHE] = m
+    return m

@@ -918,3 +918,8 @@ class MetricLabelCardinalityRule(Rule):
                             and isinstance(stmt.value, ast.Dict):
                         out.append(stmt.value)
         return out
+
+
+# v3 concurrency & resource-discipline family registers itself on import.
+# Imported last: it needs `register` and must not win name clashes above.
+from . import rules_concurrency  # noqa: E402,F401  (registration side effect)

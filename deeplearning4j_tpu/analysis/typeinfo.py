@@ -1,0 +1,313 @@
+"""Nominal type inference for jaxlint's concurrency rules.
+
+The v2 call graph resolves bare names, ``self.method()`` and aliased
+module attributes — enough for jit/PRNG facts, but blind to the serving
+stack's dominant call shape: a method invoked through a *typed object
+attribute* (``self._pager.ensure(...)``, ``entry.activate()`` where
+``entry`` came from ``registry.get(name) -> FleetEntry``). The lock and
+resource rules need those edges, so this module builds a small nominal
+type table over the program:
+
+- :class:`ClassInfo` per class definition: methods, ``@property``
+  attributes, and the inferred type of every ``self.X`` attribute —
+  from constructor calls (``self.X = Cls(...)``), annotated assignments
+  (``self.X: Optional[Cls] = None``), and class-body annotations;
+- a per-function local environment (:meth:`Types.local_env`): parameter
+  annotations, ``x = Cls(...)`` constructor bindings, ``x = self.attr``
+  reads, and return annotations of resolvable method calls;
+- :meth:`Types.type_of` / :meth:`Types.method_callee` to answer "what
+  class is this expression, and which FuncInfo does this attribute call
+  land on".
+
+Deliberately *nominal and flow-insensitive*: a name bound to two
+different classes is dropped (no unions), unknown types resolve to
+``None`` and downstream rules stay silent rather than guess. Everything
+is stdlib ``ast``; nothing imports the code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+_TYPES_CACHE = "typeinfo:types"
+
+#: decorators that make an attribute access out of a def
+_PROPERTY_DECOS = {"property", "functools.cached_property",
+                   "cached_property"}
+
+#: threading primitives the lock rules key on (qual -> kind)
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+              "threading.Semaphore", "threading.BoundedSemaphore"}
+EVENT_CTOR = "threading.Event"
+THREAD_CTOR = "threading.Thread"
+
+
+def dotted_expr(mi, node: ast.AST) -> Optional[str]:
+    """Alias-aware dotted path of a Name/Attribute chain using the
+    module's *full* alias map (every import, not just canonical ones):
+    ``b.B`` after ``from pkg import b`` -> ``pkg.b.B``."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(mi.aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+class ClassInfo:
+    """One class definition: methods, properties, typed attributes."""
+
+    __slots__ = ("module", "name", "qual", "node", "methods", "properties",
+                 "attr_types", "lock_attrs")
+
+    def __init__(self, mi, node: ast.ClassDef):
+        self.module = mi
+        self.name = node.name
+        self.qual = f"{mi.module}.{node.name}"
+        self.node = node
+        #: method name -> FuncInfo (properties excluded)
+        self.methods: Dict[str, object] = {}
+        self.properties: Set[str] = set()
+        #: self.X -> dotted type qual (program class or opaque stdlib path)
+        self.attr_types: Dict[str, str] = {}
+        #: self.X -> lock ctor qual (threading.Lock/RLock/Condition/...)
+        self.lock_attrs: Dict[str, str] = {}
+
+
+class Types:
+    """Program-wide class table + expression typing. Build via
+    :func:`get_types` so the table is computed once per program."""
+
+    def __init__(self, program):
+        self.program = program
+        #: "<module>.<Class>" -> ClassInfo
+        self.classes: Dict[str, ClassInfo] = {}
+        #: per module: class name -> ClassInfo
+        self._by_module: Dict[str, Dict[str, ClassInfo]] = {}
+        self._env_cache: Dict[int, Dict[str, Optional[str]]] = {}
+        for mi in program.modules.values():
+            table: Dict[str, ClassInfo] = {}
+            for node in ast.walk(mi.tree):
+                if isinstance(node, ast.ClassDef):
+                    ci = ClassInfo(mi, node)
+                    self.classes.setdefault(ci.qual, ci)
+                    table.setdefault(ci.name, ci)
+            self._by_module[mi.module] = table
+        # methods/properties and attribute types need the class table
+        # complete first (annotations reference other modules' classes)
+        for ci in self.classes.values():
+            self._collect_members(ci)
+        for ci in self.classes.values():
+            self._collect_attrs(ci)
+
+    # -- construction -----------------------------------------------------
+    def _collect_members(self, ci: ClassInfo):
+        mi = ci.module
+        for child in ci.node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                decos = {dotted_expr(mi, d) for d in child.decorator_list
+                         if not isinstance(d, ast.Call)}
+                if decos & _PROPERTY_DECOS:
+                    ci.properties.add(child.name)
+                else:
+                    fi = mi.functions.get(f"{ci.name}.{child.name}")
+                    if fi is not None:
+                        ci.methods[child.name] = fi
+            elif isinstance(child, ast.AnnAssign) \
+                    and isinstance(child.target, ast.Name):
+                t = self.resolve_annotation(mi, child.annotation)
+                if t:
+                    ci.attr_types.setdefault(child.target.id, t)
+
+    def _collect_attrs(self, ci: ClassInfo):
+        mi = ci.module
+        for node in ast.walk(ci.node):
+            target = value = ann = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                target, value, ann = node.target, node.value, node.annotation
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            # the assignment must belong to one of *this* class's methods,
+            # not a nested class's (walk() has no scope)
+            if mi.enclosing_class(node) != ci.name:
+                continue
+            attr = target.attr
+            if ann is not None:
+                t = self.resolve_annotation(mi, ann)
+                if t in LOCK_CTORS:
+                    ci.lock_attrs.setdefault(attr, t)
+                elif t:
+                    ci.attr_types.setdefault(attr, t)
+            if isinstance(value, ast.Call):
+                q = dotted_expr(mi, value.func)
+                if q in LOCK_CTORS:
+                    ci.lock_attrs.setdefault(attr, q)
+                    continue
+                t = self.resolve_class_expr(mi, value.func)
+                if t:
+                    ci.attr_types.setdefault(attr, t)
+
+    # -- class resolution -------------------------------------------------
+    def resolve_class_expr(self, mi, node: ast.AST) -> Optional[str]:
+        """Type qual a constructor/annotation expression names: a program
+        class's ``<module>.<Class>``, or the raw dotted path for opaque
+        externals (``threading.Event``)."""
+        d = dotted_expr(mi, node)
+        if d is None:
+            return None
+        return self.resolve_class_dotted(mi, d)
+
+    def resolve_class_dotted(self, mi, dotted: str,
+                             _hops: int = 0) -> Optional[str]:
+        if _hops > 4:
+            return None
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            ci = self._by_module.get(mi.module, {}).get(parts[0])
+            return ci.qual if ci else None
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = self.program.lookup_module(".".join(parts[:cut]))
+            if mod is None:
+                continue
+            rest = parts[cut:]
+            ci = self._by_module.get(mod.module, {}).get(rest[0])
+            if ci is not None and len(rest) == 1:
+                return ci.qual
+            tgt = mod.aliases.get(rest[0])
+            if tgt is not None:
+                return self.resolve_class_dotted(
+                    mi, ".".join([tgt] + rest[1:]), _hops + 1)
+            return None
+        # no analyzed module owns the prefix: opaque external (threading.X)
+        return dotted
+
+    def resolve_annotation(self, mi, node: ast.AST) -> Optional[str]:
+        """Annotation expression -> type qual. Unwraps ``Optional[X]`` and
+        string annotations; unions/generics beyond that are dropped."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            try:
+                node = ast.parse(node.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(node, ast.Subscript):
+            base = dotted_expr(mi, node.value)
+            if base in ("Optional", "typing.Optional"):
+                return self.resolve_annotation(mi, node.slice)
+            return None
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            return self.resolve_class_expr(mi, node)
+        return None
+
+    def class_of(self, qual: Optional[str]) -> Optional[ClassInfo]:
+        return self.classes.get(qual) if qual else None
+
+    # -- expression typing ------------------------------------------------
+    def local_env(self, fi) -> Dict[str, Optional[str]]:
+        """Flow-insensitive local name -> type qual for one function.
+        A name bound to two distinct types maps to None (unknown)."""
+        env = self._env_cache.get(id(fi))
+        if env is not None:
+            return env
+        mi = fi.module
+        env = {}
+
+        def bind(name: str, t: Optional[str]):
+            if t is None:
+                return
+            if name in env and env[name] != t:
+                env[name] = None  # conflicting bindings: unknown
+            else:
+                env.setdefault(name, t)
+
+        args = fi.node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            if a.annotation is not None and a.arg not in ("self", "cls"):
+                bind(a.arg, self.resolve_annotation(mi, a.annotation))
+        self._env_cache[id(fi)] = env  # publish early: type_of may recurse
+        for node in ast.walk(fi.node):
+            target = value = ann = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                target, value, ann = node.target, node.value, node.annotation
+            if not isinstance(target, ast.Name):
+                continue
+            if ann is not None:
+                bind(target.id, self.resolve_annotation(mi, ann))
+            if isinstance(value, ast.Call):
+                t = self.resolve_class_expr(mi, value.func)
+                if t in self.classes:
+                    bind(target.id, t)
+                else:
+                    callee = self._callee_of(fi, value, env)
+                    ret = getattr(callee, "node", None)
+                    ret = getattr(ret, "returns", None) if ret else None
+                    if ret is not None and callee is not None:
+                        bind(target.id, self.resolve_annotation(
+                            callee.module, ret))
+            elif isinstance(value, (ast.Name, ast.Attribute)):
+                bind(target.id, self.type_of(fi, value, env))
+        return env
+
+    def type_of(self, fi, expr: ast.AST,
+                env: Optional[Dict[str, Optional[str]]] = None
+                ) -> Optional[str]:
+        """Type qual of an expression inside ``fi``: local names via the
+        inferred environment, ``self.X`` via the class table, attribute
+        chains one hop at a time (``self.a.b``)."""
+        if env is None:
+            env = self.local_env(fi)
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and fi.cls:
+                return f"{fi.module.module}.{fi.cls}"
+            return env.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self.type_of(fi, expr.value, env)
+            ci = self.class_of(base)
+            if ci is not None:
+                return ci.attr_types.get(expr.attr)
+            return None
+        if isinstance(expr, ast.Call):
+            callee = self._callee_of(fi, expr, env)
+            ret = getattr(getattr(callee, "node", None), "returns", None)
+            if callee is not None and ret is not None:
+                return self.resolve_annotation(callee.module, ret)
+        return None
+
+    def _callee_of(self, fi, call: ast.Call, env):
+        f = call.func
+        callee = self.program.resolve_call(
+            fi.module, f, fi.cls or fi.module.enclosing_class(call))
+        if callee is not None:
+            return callee
+        if isinstance(f, ast.Attribute):
+            ci = self.class_of(self.type_of(fi, f.value, env))
+            if ci is not None:
+                return ci.methods.get(f.attr)
+        return None
+
+    def method_callee(self, fi, call: ast.Call):
+        """FuncInfo an attribute call resolves to — the call-graph resolver
+        first, then typed-receiver lookup. None when the type is unknown."""
+        return self._callee_of(fi, call, self.local_env(fi))
+
+    def receiver_class(self, fi, call: ast.Call) -> Optional[ClassInfo]:
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        return self.class_of(self.type_of(fi, call.func.value))
+
+
+def get_types(program) -> Types:
+    t = program.cache.get(_TYPES_CACHE)
+    if t is None:
+        t = Types(program)
+        program.cache[_TYPES_CACHE] = t
+    return t

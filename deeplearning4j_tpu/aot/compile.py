@@ -120,10 +120,13 @@ class AotFunction:
             from ..obs.metrics import MetricsRegistry
 
             null = MetricsRegistry(enabled=False)
-            self._m_hits = null.counter("serve_aot_hits_total")
-            self._m_misses = null.counter("serve_aot_misses_total")
+            # same label shape as the live registry above: a disabled
+            # series is still part of the family's one-labelset contract
+            labels = {"component": component}
+            self._m_hits = null.counter("serve_aot_hits_total", labels)
+            self._m_misses = null.counter("serve_aot_misses_total", labels)
             self._m_fallback = lambda cause: null.counter(
-                "serve_aot_fallback_total")
+                "serve_aot_fallback_total", {**labels, "cause": cause})
 
     # ------------------------------------------------------------------ calls
     def __call__(self, *args):

@@ -158,7 +158,16 @@ class AutoscaleController:
 
     # ------------------------------------------------------------------- tick
     def tick(self, poll: bool = True) -> ScaleDecision:
-        """One control turn: poll, reap, sample, decide, actuate, record."""
+        """One control turn: poll, reap, sample, decide, actuate, record.
+
+        Scale-in actuation is split around the lock: victims are picked,
+        unmanaged, and unrouted under ``_lock`` (the decision stays
+        atomic), but the per-model ``/v1/admin/drain`` round-trips and
+        the handle stop run *outside* it — a drain can take its full
+        30 s timeout, and holding the lock that long freezes every
+        ``snapshot()``/``decision_log_bytes()`` reader (the /v1/cluster
+        surface). Ticks themselves stay serial: the production loop is
+        one thread, and the drills call ``tick()`` sequentially."""
         with self._lock:
             if poll:
                 self.router.poll_once()
@@ -172,10 +181,14 @@ class AutoscaleController:
                 {"direction": decision.direction, "reason": decision.reason},
                 help=_DECISIONS_HELP).inc()
             actuated = 0
+            plan: List[dict] = []
             if decision.direction == OUT and decision.amount > 0:
                 actuated = self._scale_out_locked(decision.amount)
             elif decision.direction == IN and decision.amount > 0:
-                actuated = self._scale_in_locked(decision.amount)
+                plan = self._plan_scale_in_locked(decision.amount)
+        if plan:
+            actuated = self._execute_scale_in(plan)
+        with self._lock:
             if actuated:
                 # cooldowns arm only on success: a failed spawn leaves the
                 # policy free to retry on the very next tick
@@ -304,10 +317,11 @@ class AutoscaleController:
         return False
 
     # --------------------------------------------------------------- scale-in
-    def _scale_in_locked(self, amount: int) -> int:
-        done = 0
+    def _plan_scale_in_locked(self, amount: int) -> List[dict]:
+        """Pick victims and atomically unmanage + unroute them. Returns
+        the drain work list :meth:`_execute_scale_in` runs lock-free."""
+        plan: List[dict] = []
         for rid in self._pick_victims_locked(int(amount)):
-            t0 = time.perf_counter()
             handle = self._managed.pop(rid)
             try:
                 base_url = self.router.membership.base_url(rid)
@@ -321,11 +335,23 @@ class AutoscaleController:
                 self.router.remove_replica(rid)
             except KeyError:
                 pass
-            for name in models:
-                if base_url is None:
+            plan.append({"rid": rid, "handle": handle,
+                         "base_url": base_url, "models": models})
+        return plan
+
+    def _execute_scale_in(self, plan: List[dict]) -> int:
+        """Drain and stop already-unrouted victims. Runs WITHOUT the
+        controller lock: nothing here touches controller state, and the
+        HTTP drains can legitimately take their full timeout."""
+        done = 0
+        for item in plan:
+            rid, handle = item["rid"], item["handle"]
+            t0 = time.perf_counter()
+            for name in item["models"]:
+                if item["base_url"] is None:
                     break
                 try:
-                    self._drain_model(base_url, name)
+                    self._drain_model(item["base_url"], name)
                 except OSError:
                     self._drain_counter("error").inc()
                     log.warning("drain of %s on %s failed; stop() drains "

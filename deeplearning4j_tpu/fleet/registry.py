@@ -145,7 +145,11 @@ class FleetEntry:
                 # eagerly so paged-in decode is warm before the next request
                 self._build_batcher_locked()
 
-    def deactivate(self) -> None:
+    # Deliberate: the entry RLock is held across the whole drain (see the
+    # __init__ comment) so a re-activation can never interleave with a
+    # half-finished eviction. The join/wait inside shutdown(drain=True) is
+    # the contract, not an accident — sanctioned, with eyes open.
+    def deactivate(self) -> None:  # jaxlint: sanction=blocking-call-under-lock
         """Lease-drain, pull current weights to host, drop device refs.
 
         This is the hot-swap drain discipline applied to eviction:
@@ -196,7 +200,10 @@ class FleetEntry:
                 self._build_batcher_locked()
             return self._batcher
 
-    def publish(self, params, state=None, version: Optional[str] = None,
+    # Deliberate: publish-with-drain waits out in-flight leases while the
+    # entry RLock serializes it against eviction/re-activation — same
+    # lifecycle contract as deactivate(). Sanctioned, not overlooked.
+    def publish(self, params, state=None, version: Optional[str] = None,  # jaxlint: sanction=blocking-call-under-lock
                 drain: bool = True) -> int:
         """Hot-swap this model's weights; returns the new generation.
         Resident: the full registry publish (warmers precompile the

@@ -61,6 +61,18 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/sim/
 echo "=== jaxlint: deeplearning4j_tpu/autoscale/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/autoscale/
 
+# The v3 concurrency family (lock-order-cycle, blocking-call-under-lock,
+# acquire-release, property-vs-call, metric-docs-drift) rides every run
+# above — the five serving subsystems hold it at zero findings with no
+# baseline. Legacy surface modules (ui/, knn/) run against a committed
+# ratchet baseline instead: currently empty (they are clean too), so the
+# file exists purely to pin the ratchet — any NEW finding there fails CI,
+# and the baseline may only ever shrink.
+echo "=== jaxlint: ui/ + knn/ (ratchet baseline) ==="
+python -m deeplearning4j_tpu.analysis \
+  deeplearning4j_tpu/ui/ deeplearning4j_tpu/knn/ \
+  --baseline scripts/jaxlint_legacy_baseline.json
+
 echo "=== smoke trace: 5-step instrumented train ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_trace.py
 

@@ -1048,3 +1048,510 @@ class TestMetricLabelCardinality:
                           {"replica": str(sh.device.id)}).set(0.0)
             """, "metric-label-cardinality")
         assert fs == []
+
+
+# ===================================================== concurrency (v3)
+class TestLockOrderCycle:
+    # Two modules, each taking its OWN lock then calling into the other,
+    # which takes ITS lock: a.A._lock -> b.B._lock and b.B._lock ->
+    # a.A._lock. Neither file is suspicious alone — only the
+    # whole-program order graph sees the ABBA cycle.
+    MOD_A = """
+        import threading
+        from pkg import b
+
+        class A:
+            def __init__(self, peer):
+                self._lock = threading.Lock()
+                self.peer: b.B = peer
+
+            def push(self):
+                with self._lock:
+                    self.peer.poke()
+
+            def ping(self):
+                with self._lock:
+                    return 1
+        """
+    MOD_B = """
+        import threading
+        from pkg import a
+
+        class B:
+            def __init__(self, back):
+                self._lock = threading.Lock()
+                self.back: a.A = back
+
+            def poke(self):
+                with self._lock:
+                    return 2
+
+            def pull(self):
+                with self._lock:
+                    self.back.ping()
+        """
+
+    def test_two_module_abba_flagged_once(self):
+        fs = lint_program({"pkg/a.py": self.MOD_A, "pkg/b.py": self.MOD_B},
+                          "lock-order-cycle")
+        assert names(fs) == ["lock-order-cycle"]
+        assert "pkg.a.A._lock" in fs[0].message
+        assert "pkg.b.B._lock" in fs[0].message
+
+    def test_consistent_order_not_flagged(self):
+        # both call paths take A then B: a DAG, no cycle
+        mod_b = """
+            import threading
+
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poke(self):
+                    with self._lock:
+                        return 2
+            """
+        mod_a = """
+            import threading
+            from pkg import b
+
+            class A:
+                def __init__(self, peer):
+                    self._lock = threading.Lock()
+                    self.peer: b.B = peer
+
+                def push(self):
+                    with self._lock:
+                        self.peer.poke()
+
+                def also_push(self):
+                    with self._lock:
+                        self.peer.poke()
+            """
+        fs = lint_program({"pkg/a.py": mod_a, "pkg/b.py": mod_b},
+                          "lock-order-cycle")
+        assert fs == []
+
+    def test_same_lock_reentry_not_flagged(self):
+        # one nominal identity (RLock re-enter / two instances of one
+        # class) is deliberately not reported as a cycle
+        fs = lint("""
+            import threading
+
+            class C:
+                def __init__(self, other):
+                    self._lock = threading.RLock()
+                    self.other: "C" = other
+
+                def f(self):
+                    with self._lock:
+                        self.other.g()
+
+                def g(self):
+                    with self._lock:
+                        return 1
+            """, "lock-order-cycle")
+        assert fs == []
+
+
+class TestBlockingUnderLock:
+    def test_direct_sleep_under_lock_flagged(self):
+        fs = lint("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        time.sleep(1.0)
+            """, "blocking-call-under-lock")
+        assert names(fs) == ["blocking-call-under-lock"]
+        assert "time.sleep" in fs[0].message
+
+    def test_sleep_outside_lock_not_flagged(self):
+        fs = lint("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        n = 1
+                    time.sleep(n)
+            """, "blocking-call-under-lock")
+        assert fs == []
+
+    def test_transitive_block_through_callee_flagged(self):
+        # the lock holder never blocks directly — its helper does
+        fs = lint("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def helper(self):
+                    time.sleep(0.1)
+
+                def f(self):
+                    with self._lock:
+                        self.helper()
+            """, "blocking-call-under-lock")
+        assert len(fs) == 1
+        assert "C.helper" in fs[0].message and "time.sleep" in fs[0].message
+
+    def test_sanctioned_helper_not_flagged(self):
+        fs = lint("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def helper(self):  # jaxlint: sanction=blocking-call-under-lock
+                    time.sleep(0.1)
+
+                def f(self):
+                    with self._lock:
+                        self.helper()
+            """, "blocking-call-under-lock")
+        assert fs == []
+
+    def test_condition_wait_on_held_condition_exempt(self):
+        # the wait-loop idiom: waiting RELEASES the held condition
+        fs = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+
+                def f(self):
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait()
+            """, "blocking-call-under-lock")
+        assert fs == []
+
+    def test_event_wait_under_lock_flagged(self):
+        # an Event.wait does NOT release anything — real stall
+        fs = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._go = threading.Event()
+
+                def f(self):
+                    with self._lock:
+                        self._go.wait()
+            """, "blocking-call-under-lock")
+        assert names(fs) == ["blocking-call-under-lock"]
+        assert "Event.wait" in fs[0].message
+
+
+class TestAcquireRelease:
+    ALLOCATOR = """
+        import threading
+
+        class BlockAllocator:
+            def alloc(self, n):
+                return list(range(n))
+
+            def free(self, blocks):
+                pass
+        """
+
+    def test_exception_path_leak_flagged(self):
+        # the ISSUE's exception-path lease-leak shape: released on the
+        # straight line, leaked when the call in between raises
+        fs = lint(self.ALLOCATOR + """
+            class Pages:
+                def __init__(self):
+                    self.alloc = BlockAllocator()
+
+                def compute(self, n):
+                    return n * 2
+
+                def use(self, n):
+                    blocks = self.alloc.alloc(n)
+                    self.compute(n)
+                    self.alloc.free(blocks)
+            """, "acquire-release")
+        assert names(fs) == ["acquire-release"]
+        assert "leaks if" in fs[0].message and "blocks" in fs[0].message
+
+    def test_try_finally_release_not_flagged(self):
+        fs = lint(self.ALLOCATOR + """
+            class Pages:
+                def __init__(self):
+                    self.alloc = BlockAllocator()
+
+                def compute(self, n):
+                    return n * 2
+
+                def use(self, n):
+                    blocks = self.alloc.alloc(n)
+                    try:
+                        self.compute(n)
+                    finally:
+                        self.alloc.free(blocks)
+            """, "acquire-release")
+        assert fs == []
+
+    def test_never_released_flagged(self):
+        fs = lint(self.ALLOCATOR + """
+            class Pages:
+                def __init__(self):
+                    self.alloc = BlockAllocator()
+
+                def use(self, n):
+                    blocks = self.alloc.alloc(n)
+                    return n
+            """, "acquire-release")
+        assert len(fs) == 1 and "never released" in fs[0].message
+
+    def test_ownership_transfer_not_flagged(self):
+        # returning or storing the allocation hands ownership off
+        fs = lint(self.ALLOCATOR + """
+            class Pages:
+                def __init__(self):
+                    self.alloc = BlockAllocator()
+                    self.ids = []
+
+                def grow(self, n):
+                    new = self.alloc.alloc(n)
+                    self.ids.extend(new)
+                    return new
+            """, "acquire-release")
+        assert fs == []
+
+    def test_contextmanager_bare_call_flagged(self):
+        fs = lint("""
+            import contextlib
+
+            class Reg:
+                @contextlib.contextmanager
+                def lease(self):
+                    yield 1
+
+            class S:
+                def __init__(self):
+                    self.reg = Reg()
+
+                def bad(self):
+                    self.reg.lease()
+
+                def good(self):
+                    with self.reg.lease() as snap:
+                        return snap
+            """, "acquire-release")
+        assert len(fs) == 1 and "bare statement" in fs[0].message
+
+    def test_must_use_spend_discarded_flagged(self):
+        fs = lint("""
+            class RetryBudget:
+                def spend(self):
+                    return True
+
+            class R:
+                def __init__(self):
+                    self.budget = RetryBudget()
+
+                def bad(self):
+                    self.budget.spend()
+
+                def good(self):
+                    if self.budget.spend():
+                        return 1
+                    return 0
+            """, "acquire-release")
+        assert len(fs) == 1 and "discarded" in fs[0].message
+
+
+class TestPropertyVsCall:
+    def test_property_called_flagged(self):
+        # the PR 12 drain-bug shape: entry.resident() where resident is
+        # a @property — TypeError at runtime, 400 on every drain
+        fs = lint("""
+            class Entry:
+                @property
+                def resident(self):
+                    return True
+
+            class Fleet:
+                def get(self) -> Entry:
+                    return Entry()
+
+                def drain(self):
+                    entry = self.get()
+                    if entry.resident():
+                        return "draining"
+                    return "cold"
+            """, "property-vs-call")
+        assert names(fs) == ["property-vs-call"]
+        assert "resident" in fs[0].message and "@property" in fs[0].message
+
+    def test_property_read_not_flagged(self):
+        fs = lint("""
+            class Entry:
+                @property
+                def resident(self):
+                    return True
+
+            class Fleet:
+                def get(self) -> Entry:
+                    return Entry()
+
+                def drain(self):
+                    entry = self.get()
+                    if entry.resident:
+                        return "draining"
+                    return "cold"
+            """, "property-vs-call")
+        assert fs == []
+
+    def test_method_truth_tested_flagged(self):
+        # the mirror bug: a bound method is always truthy
+        fs = lint("""
+            class Gauge:
+                def ready(self):
+                    return True
+
+            class W:
+                def __init__(self):
+                    self.g = Gauge()
+
+                def poll(self):
+                    if self.g.ready:
+                        return 1
+                    return 0
+            """, "property-vs-call")
+        assert names(fs) == ["property-vs-call"]
+        assert "always truthy" in fs[0].message
+
+    def test_method_called_not_flagged(self):
+        fs = lint("""
+            class Gauge:
+                def ready(self):
+                    return True
+
+            class W:
+                def __init__(self):
+                    self.g = Gauge()
+
+                def poll(self):
+                    if self.g.ready():
+                        return 1
+                    return 0
+            """, "property-vs-call")
+        assert fs == []
+
+    def test_same_name_property_and_method_distinguished(self):
+        # `resident` is a property on Entry but a METHOD on Pager —
+        # nominal receivers keep the two apart (name-based matching
+        # could not)
+        fs = lint("""
+            class Entry:
+                @property
+                def resident(self):
+                    return True
+
+            class Pager:
+                def resident(self):
+                    return ["m"]
+
+            class Host:
+                def __init__(self):
+                    self.pager = Pager()
+
+                def names(self):
+                    return self.pager.resident()
+            """, "property-vs-call")
+        assert fs == []
+
+
+class TestMetricDocsDrift:
+    def test_labelset_fork_flagged_at_minority_site(self):
+        fs = lint("""
+            class M:
+                def __init__(self, metrics):
+                    self.metrics = metrics
+
+                def a(self):
+                    self.metrics.counter("x_total", {"model": "m"}).inc()
+
+                def b(self):
+                    self.metrics.counter(
+                        "x_total", {"model": "m", "replica": "r"}).inc()
+
+                def c(self):
+                    self.metrics.counter("x_total", {"model": "m2"}).inc()
+            """, "metric-docs-drift")
+        assert names(fs) == ["metric-docs-drift"]
+        assert "replica" in fs[0].message  # the minority site is flagged
+
+    def test_consistent_labels_not_flagged(self):
+        fs = lint("""
+            class M:
+                def __init__(self, metrics):
+                    self.metrics = metrics
+
+                def a(self):
+                    self.metrics.counter("x_total", {"model": "m"}).inc()
+
+                def b(self):
+                    self.metrics.counter("x_total", {"model": "n"}).inc()
+            """, "metric-docs-drift")
+        assert fs == []
+
+    def test_dynamic_labels_skipped(self):
+        # a mutated labels dict cannot be proven either way: no finding
+        fs = lint("""
+            class M:
+                def __init__(self, metrics):
+                    self.metrics = metrics
+
+                def a(self, extra):
+                    labels = {"model": "m"}
+                    if extra:
+                        labels["tenant"] = extra
+                    self.metrics.counter("x_total", labels).inc()
+
+                def b(self):
+                    self.metrics.counter("x_total", {"model": "m"}).inc()
+            """, "metric-docs-drift")
+        assert fs == []
+
+    def test_undocumented_family_flagged_against_readme(self, tmp_path):
+        obs = tmp_path / "obs"
+        obs.mkdir()
+        (obs / "README.md").write_text("- `y_total` — documented family\n")
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(textwrap.dedent("""
+            class M:
+                def __init__(self, metrics):
+                    self.metrics = metrics
+
+                def a(self):
+                    self.metrics.counter("x_total", {"m": "1"}).inc()
+
+                def b(self):
+                    self.metrics.counter("y_total", {"m": "1"}).inc()
+            """))
+        rules = [rules_by_name()["metric-docs-drift"]]
+        fs = analyze_paths([str(tmp_path)], rules)
+        assert len(fs) == 1
+        assert "x_total" in fs[0].message
+        assert "not documented" in fs[0].message
