@@ -76,7 +76,8 @@ class AutoscaleController:
                  signals: Optional[SignalReader] = None,
                  clock: Optional[Callable[[], float]] = None,
                  id_prefix: str = "as-", beat_wait_s: float = 5.0,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 forecaster=None):
         self.router = router
         self.factory = factory
         self.metrics = router.metrics
@@ -84,6 +85,11 @@ class AutoscaleController:
         self.policy = policy if policy is not None else AutoscalePolicy()
         self.signals = signals if signals is not None else SignalReader(
             slo=router.slo, membership=router.membership, clock=self._clock)
+        #: Optional :class:`~..obs.forecast.BurnForecaster`-shaped hook
+        #: (``forecast_burn(slo_class) -> Forecast | None``); when set,
+        #: every tick hands the policy a per-class burn forecast so it
+        #: can pre-spawn ahead of a predicted ramp.
+        self.forecaster = forecaster
         self.id_prefix = str(id_prefix)
         self.beat_wait_s = float(beat_wait_s)
         self._sleep = sleep
@@ -175,7 +181,14 @@ class AutoscaleController:
             s = self.signals.sample()
             now = s.t
             current = self._actual_locked()
-            decision = self.policy.decide(self.signals, current, now)
+            forecast = None
+            if self.forecaster is not None:
+                # pure in-memory store reads — safe under the tick lock
+                forecast = {
+                    cls: self.forecaster.forecast_burn(cls)
+                    for cls in sorted(self.policy.burn_out)}
+            decision = self.policy.decide(self.signals, current, now,
+                                          forecast=forecast)
             self.metrics.counter(
                 "autoscale_decisions_total",
                 {"direction": decision.direction, "reason": decision.reason},

@@ -54,9 +54,10 @@ def _r(x: float) -> float:
 class ScaleDecision(NamedTuple):
     """One policy verdict plus the inputs that produced it.
 
-    ``reason`` is typed: a trigger (``burn``, ``queue``, ``idle``,
-    ``below_min``, ``above_max``) or a hold cause (``steady``, ``spike``,
-    ``cooldown_out``, ``cooldown_in``, ``max_clamp``, ``min_clamp``).
+    ``reason`` is typed: a trigger (``burn``, ``queue``, ``forecast``,
+    ``idle``, ``below_min``, ``above_max``) or a hold cause (``steady``,
+    ``spike``, ``cooldown_out``, ``cooldown_in``, ``max_clamp``,
+    ``min_clamp``).
     """
 
     direction: str   # "out" | "in" | "hold"
@@ -88,6 +89,7 @@ class AutoscalePolicy:
         "min_replicas", "max_replicas", "burn_out", "hysteresis",
         "queue_high", "queue_low", "sustain_out_s", "sustain_in_s",
         "cooldown_out_s", "cooldown_in_s", "step_out", "step_in",
+        "forecast_confidence",
     })
 
     def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
@@ -96,7 +98,8 @@ class AutoscalePolicy:
                  queue_high: float = 16.0, queue_low: float = 1.0,
                  sustain_out_s: float = 2.0, sustain_in_s: float = 10.0,
                  cooldown_out_s: float = 30.0, cooldown_in_s: float = 60.0,
-                 step_out: int = 1, step_in: int = 1):
+                 step_out: int = 1, step_in: int = 1,
+                 forecast_confidence: float = 0.5):
         if not 1 <= int(min_replicas) <= int(max_replicas):
             raise ValueError("need 1 <= min_replicas <= max_replicas")
         if not 0.0 < float(hysteresis) < 1.0:
@@ -112,6 +115,8 @@ class AutoscalePolicy:
                         ("cooldown_in_s", cooldown_in_s)):
             if float(v) < 0.0:
                 raise ValueError(f"need {name} >= 0")
+        if not 0.0 <= float(forecast_confidence) <= 1.0:
+            raise ValueError("need 0 <= forecast_confidence <= 1")
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.burn_out = {str(k): float(v)
@@ -127,6 +132,7 @@ class AutoscalePolicy:
         self.cooldown_in_s = float(cooldown_in_s)
         self.step_out = int(step_out)
         self.step_in = int(step_in)
+        self.forecast_confidence = float(forecast_confidence)
         self._last_out_t: Optional[float] = None
         self._last_in_t: Optional[float] = None
 
@@ -162,11 +168,34 @@ class AutoscalePolicy:
                 return False
         return s.queue_depth / max(1, s.alive) <= self.queue_low
 
+    def _forecast_breach(self, forecast) -> Optional[str]:
+        """First tracked class (sorted) whose forecast predicts burning at
+        or past its scale-out threshold with enough confidence, or None.
+        ``forecast`` maps class -> :class:`~..obs.forecast.Forecast`."""
+        if not forecast:
+            return None
+        for cls in sorted(self.burn_out):
+            f = forecast.get(cls)
+            if (f is not None
+                    and f.confidence >= self.forecast_confidence
+                    and f.value >= self.burn_out[cls]):
+                return cls
+        return None
+
     # ---------------------------------------------------------- decision
-    def decide(self, signals, current: int, now: float) -> ScaleDecision:
+    def decide(self, signals, current: int, now: float,
+               forecast=None) -> ScaleDecision:
         """One verdict from the signal window. Pure in the signals — no
         sampling, no clock reads, no state writes; cooldowns advance only
-        via :meth:`commit` after the controller actually actuated."""
+        via :meth:`commit` after the controller actually actuated.
+
+        ``forecast`` (optional) maps SLO class -> a typed
+        :class:`~..obs.forecast.Forecast` of that class's burn at the
+        forecaster's horizon. A confident predicted breach pre-spawns
+        *before* the ramp trips the live thresholds; the sustain /
+        cooldown / clamp machinery is unchanged, and a ``None`` forecast
+        reproduces the legacy decision stream byte for byte.
+        """
         window = signals.window()
         last = window[-1] if window else None
         ev = {
@@ -178,6 +207,12 @@ class AutoscalePolicy:
             "queue_depth": int(last.queue_depth) if last else 0,
             "kv_pressure": _r(last.kv_pressure) if last else 0.0,
         }
+        if forecast is not None:
+            ev["forecast"] = {
+                str(cls): {"horizon_s": _r(f.horizon_s),
+                           "value": _r(f.value),
+                           "confidence": _r(f.confidence)}
+                for cls, f in sorted(forecast.items()) if f is not None}
 
         def verdict(direction: str, amount: int, reason: str,
                     **extra) -> ScaleDecision:
@@ -204,6 +239,24 @@ class AutoscalePolicy:
                                     self.max_replicas - current), trigger)
         if hot_now:
             return verdict(HOLD, 0, "spike")
+
+        # predictive pre-spawn: not hot NOW, but a confident forecast says
+        # a tracked class breaches its threshold within the horizon — act
+        # while there is still spawn+warm latency to hide. Same clamps and
+        # cooldown as a reactive scale-out; no sustain window (the horizon
+        # plays that role, and the forecaster's confidence floor gates
+        # noise the way sustain gates spikes).
+        fc_cls = self._forecast_breach(forecast)
+        if fc_cls is not None:
+            if current >= self.max_replicas:
+                return verdict(HOLD, 0, "max_clamp", trigger="forecast",
+                               forecast_class=fc_cls)
+            if self._cooling(self._last_out_t, self.cooldown_out_s, now):
+                return verdict(HOLD, 0, "cooldown_out", trigger="forecast",
+                               forecast_class=fc_cls)
+            return verdict(OUT, min(self.step_out,
+                                    self.max_replicas - current),
+                           "forecast", forecast_class=fc_cls)
 
         if (last is not None and self._idle(last)
                 and signals.sustained(self._idle, self.sustain_in_s, now)):
@@ -243,6 +296,7 @@ class AutoscalePolicy:
             "cooldown_s": {"out": self.cooldown_out_s,
                            "in": self.cooldown_in_s},
             "step": {"out": self.step_out, "in": self.step_in},
+            "forecast_confidence": self.forecast_confidence,
             "last_scale_t": {"out": self._last_out_t,
                              "in": self._last_in_t},
         }

@@ -45,7 +45,7 @@ import socket
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
-from urllib.parse import urlsplit
+from urllib.parse import parse_qs, urlsplit
 
 from ..chaos import faults as _faults
 from ..fleet.tenants import QuotaError, TenantTable
@@ -61,6 +61,20 @@ from .placement import Placement
 log = logging.getLogger(__name__)
 
 _MODEL_ROUTE = re.compile(r"^/v1/models/([^/]+)/(predict|generate)$")
+
+
+def _qfloat(q: Dict[str, list], key: str) -> Optional[float]:
+    """First query-string value as float, or None when absent."""
+    vals = q.get(key)
+    return float(vals[0]) if vals else None
+
+
+def _qflag(q: Dict[str, list], key: str) -> bool:
+    vals = q.get(key)
+    return bool(vals) and vals[0] in ("1", "true", "yes")
+
+
+
 _BAD_REQUEST = (KeyError, ValueError, TypeError, AttributeError,
                 json.JSONDecodeError)
 _HTTP_ERRORS_HELP = "non-2xx HTTP answers by endpoint and status code"
@@ -190,6 +204,9 @@ class ClusterRouter(JsonHTTPServerMixin):
         #: The attached AutoscaleController, if any (it registers itself);
         #: surfaced on ``/v1/cluster`` so one GET shows fleet + policy state.
         self.autoscaler = None
+        #: The attached FederatedScraper, if any (it registers itself);
+        #: backs ``/v1/tsdb`` range queries and ``/v1/alerts``.
+        self.telemetry = None
 
     # ------------------------------------------------------------ membership
     def add_replica(self, replica_id: str, base_url: str) -> None:
@@ -239,6 +256,13 @@ class ClusterRouter(JsonHTTPServerMixin):
             except (OSError, ValueError):
                 self.membership.miss(rid)
         states = self.membership.sweep()
+        for rid, st in states.items():
+            if st == DEAD:
+                # a dead replica records no more outcomes, so its burn
+                # gauges would freeze at their last value forever — retire
+                # them so dashboards and alert rules see absence, not a
+                # permanently stale spike
+                self.replica_slo.forget(rid)
         self._replan()
         self._demote()
         return states
@@ -713,6 +737,40 @@ class ClusterRouter(JsonHTTPServerMixin):
                     if server.autoscaler is not None:
                         view["autoscale"] = server.autoscaler.snapshot()
                     self.reply(200, view)
+                elif path == "/v1/tsdb":
+                    if server.telemetry is None:
+                        self.route_err(
+                            404, {"error": "telemetry plane not attached"})
+                        return
+                    q = parse_qs(self.path.partition("?")[2])
+                    name = (q.get("name") or [None])[0]
+                    if not name:
+                        self.reply(
+                            200,
+                            {"families": server.telemetry.store.families(),
+                             "stats": server.telemetry.store.stats()})
+                        return
+                    try:
+                        labels = {k[6:]: v[0] for k, v in q.items()
+                                  if k.startswith("label.")}
+                        series = server.telemetry.store.query(
+                            name, labels=labels or None,
+                            track=(q.get("track") or [None])[0],
+                            t_min=_qfloat(q, "t_min"),
+                            t_max=_qfloat(q, "t_max"),
+                            rate=_qflag(q, "rate"),
+                            include_stale=_qflag(q, "stale"))
+                    except ValueError:
+                        self.route_err(400, {"error": "bad range parameter"})
+                        return
+                    self.reply(200, {"name": name, "series": series})
+                elif path == "/v1/alerts":
+                    t = server.telemetry
+                    if t is None or t.alerts is None:
+                        self.route_err(
+                            404, {"error": "alert engine not attached"})
+                    else:
+                        self.reply(200, t.alerts.snapshot())
                 else:
                     self.route_err(404, {"error": "unknown endpoint"})
 

@@ -188,6 +188,11 @@ class FleetServer(JsonHTTPServerMixin):
                             "health": server.fleet.health.snapshot()})
                 elif path == "/v1/replica":
                     self.reply(200, server.beat())
+                elif path == "/v1/metrics":
+                    # structured registry snapshot for the federated
+                    # scraper — JSON keeps the histogram quantile tracks
+                    # the Prometheus text exposition cannot carry
+                    self.reply(200, server.metrics.snapshot())
                 elif path == "/v1/debug/chaos" and server.chaos_admin:
                     self.reply(200, chaos_status())
                 elif path == "/v1/fleet":

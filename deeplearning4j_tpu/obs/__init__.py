@@ -1,26 +1,36 @@
 """Observability: metrics registry, span tracer, request-scoped tracing,
-flight recorder, SLO burn accounting, and JAX-aware step telemetry.
+flight recorder, SLO burn accounting, the cluster telemetry plane
+(time-series store, federated scrape, alerting, burn forecasting), and
+JAX-aware step telemetry.
 
 ``obs.metrics``, ``obs.trace``, ``obs.reqtrace``, ``obs.flight``,
-``obs.slo`` and ``obs.promcheck`` are stdlib-only and jax-free — servers
-import them directly so ``/metrics`` works in processes that never load jax.
-Importing this package pulls the full surface (including the jax-adjacent
-``StepTelemetry`` / ``TelemetryListener``).
+``obs.slo``, ``obs.tsdb``, ``obs.scrape``, ``obs.alerts``,
+``obs.forecast`` and ``obs.promcheck`` are stdlib-only and jax-free —
+servers import them directly so ``/metrics`` works in processes that
+never load jax. Importing this package pulls the full surface (including
+the jax-adjacent ``StepTelemetry`` / ``TelemetryListener``).
 """
 
+from .alerts import AlertEngine, AlertRule, default_rules
 from .flight import FlightRecorder
+from .forecast import BurnForecaster, Forecast
 from .listener import TelemetryListener
 from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                       MetricsRegistry, default_registry)
 from .reqtrace import (RequestContext, RequestTracer, format_traceparent,
                        parse_traceparent)
+from .scrape import FederatedScraper
 from .slo import SloBurn
 from .step import StepTelemetry
 from .trace import Tracer
+from .tsdb import TimeSeriesStore
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
     "default_registry", "Tracer", "StepTelemetry", "TelemetryListener",
     "RequestContext", "RequestTracer", "FlightRecorder", "SloBurn",
     "parse_traceparent", "format_traceparent",
+    "TimeSeriesStore", "FederatedScraper",
+    "AlertEngine", "AlertRule", "default_rules",
+    "BurnForecaster", "Forecast",
 ]

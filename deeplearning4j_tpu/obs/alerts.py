@@ -1,0 +1,264 @@
+"""Declarative alerting over the time-series store.
+
+An :class:`AlertEngine` evaluates a fixed set of :class:`AlertRule`\\ s
+against a :class:`~.tsdb.TimeSeriesStore` on every ``evaluate`` call and
+runs each rule through the classic state machine::
+
+    ok -> pending -> firing -> (resolved) -> ok
+
+``for_s`` is the sustain horizon: a rule whose condition is first seen
+violated goes *pending* and only fires once the condition has held for
+``for_s`` seconds of evaluation time — a spike shorter than the horizon
+cancels back to ok and never pages. Resolution requires the *condition
+to clear* (the rule re-reads the store's live last-values every pass);
+old samples sliding out of a rate window never resolves a threshold
+alert by themselves. Stale series are invisible to rules by
+construction — :meth:`~.tsdb.TimeSeriesStore.latest` is live-only — so
+a tombstoned ``cluster_replica_state`` cannot keep a replica-dead alert
+firing after the replica was deliberately reaped.
+
+Every transition emits a flight-recorder event (kind ``alert``), counts
+``alert_transitions_total{rule,to}`` and updates ``alert_state{rule}``
+(0 ok, 1 pending, 2 firing); the full state surfaces on the router's
+``GET /v1/alerts``. :meth:`firings` returns the begin/end log the sim
+replayer stamps into replay reports so the tuner can penalize configs
+that page humans.
+
+Rule kinds:
+
+- ``threshold``: worst live last-value vs ``value`` under ``op``;
+- ``rate_of_change``: worst per-second rate over ``window_s`` vs
+  ``value`` (for counters — e.g. spawn failures per second);
+- ``absence``: fires when NO live series matches (a scrape target that
+  should exist but does not).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from . import flight as _flight
+
+OK = "ok"
+PENDING = "pending"
+FIRING = "firing"
+RESOLVED = "resolved"
+
+THRESHOLD = "threshold"
+RATE_OF_CHANGE = "rate_of_change"
+ABSENCE = "absence"
+
+_STATE_N = {OK: 0, PENDING: 1, FIRING: 2}
+
+
+class AlertRule(NamedTuple):
+    """One declarative rule over a single metric family."""
+
+    name: str
+    metric: str
+    kind: str = THRESHOLD
+    op: str = ">"                       # ">" | "<"
+    value: float = 0.0
+    for_s: float = 0.0                  # sustain horizon before firing
+    labels: Optional[Dict[str, str]] = None   # subset match on series
+    track: Optional[str] = None         # histogram track, e.g. "p99"
+    window_s: float = 120.0             # rate_of_change lookback
+    severity: str = "page"
+    summary: str = ""
+
+
+def default_rules() -> Tuple[AlertRule, ...]:
+    """The shipped ruleset — the pages a serving fleet cannot not have."""
+    return (
+        AlertRule("gold_burn_high", "fleet_slo_burn_rate",
+                  op=">", value=1.0, for_s=20.0,
+                  labels={"slo_class": "gold", "window": "1m"},
+                  severity="page",
+                  summary="gold error budget burning faster than it refills"),
+        AlertRule("breaker_open", "fleet_breaker_state",
+                  op=">", value=1.5, for_s=0.0, severity="page",
+                  summary="a model circuit breaker is open"),
+        AlertRule("replica_dead", "cluster_replica_state",
+                  op=">", value=1.5, for_s=0.0, severity="page",
+                  summary="a replica's membership lease expired"),
+        AlertRule("kv_pressure", "serve_kv_block_utilization",
+                  op=">", value=0.95, for_s=10.0, severity="warn",
+                  summary="KV block pool nearly exhausted"),
+        AlertRule("spawn_failures", "autoscale_spawn_failures_total",
+                  kind=RATE_OF_CHANGE, op=">", value=0.0, window_s=120.0,
+                  for_s=0.0, severity="warn",
+                  summary="autoscale replica provisions are failing"),
+    )
+
+
+class _RuleState:
+    __slots__ = ("state", "pending_since", "fired_at", "last_value")
+
+    def __init__(self):
+        self.state = OK
+        self.pending_since: Optional[float] = None
+        self.fired_at: Optional[float] = None
+        self.last_value: Optional[float] = None
+
+
+class AlertEngine:
+    """Evaluate declarative rules against a store on an injectable clock.
+
+    State mutation happens under one lock; flight events and metric
+    updates for the collected transitions are emitted after the lock is
+    released, so alert bookkeeping never blocks a concurrent scrape.
+    """
+
+    def __init__(self, store, *, rules: Optional[Tuple[AlertRule, ...]] = None,
+                 metrics=None, clock=time.monotonic,
+                 max_firings: int = 256):
+        self._store = store
+        self._metrics = metrics
+        self._clock = clock
+        self.rules: Tuple[AlertRule, ...] = (
+            tuple(rules) if rules is not None else default_rules())
+        self._lock = threading.Lock()
+        self._states: Dict[str, _RuleState] = {
+            r.name: _RuleState() for r in self.rules}
+        self._firings: deque = deque(maxlen=max(1, int(max_firings)))
+
+    # ---------------------------------------------------------- condition
+    def _worst(self, rule: AlertRule,
+               now: float) -> Tuple[Optional[float], bool]:
+        """(observed value, violated?) for one rule, live series only."""
+        if rule.kind == RATE_OF_CHANGE:
+            vals = [v for (_, v) in self._store.window_rate(
+                rule.metric, labels=rule.labels, track=rule.track,
+                window_s=rule.window_s, now=now)]
+        else:
+            vals = [v for (_, _, v) in self._store.latest(
+                rule.metric, labels=rule.labels, track=rule.track)]
+        if rule.kind == ABSENCE:
+            return (float(len(vals)), not vals)
+        if not vals:
+            return (None, False)
+        worst = min(vals) if rule.op == "<" else max(vals)
+        violated = worst < rule.value if rule.op == "<" \
+            else worst > rule.value
+        return (worst, violated)
+
+    # ----------------------------------------------------------- evaluate
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """One pass over every rule; returns the transitions it caused."""
+        t = self._clock() if now is None else float(now)
+        transitions: List[dict] = []
+        gauges: List[Tuple[str, int]] = []
+        with self._lock:
+            for rule in self.rules:
+                st = self._states[rule.name]
+                value, violated = self._worst(rule, t)
+                st.last_value = value
+                prev = st.state
+                if violated:
+                    if st.state == OK:
+                        st.pending_since = t
+                        if rule.for_s <= 0.0:
+                            st.state = FIRING
+                            st.fired_at = t
+                        else:
+                            st.state = PENDING
+                    elif st.state == PENDING:
+                        # explicit None check: 0.0 is a valid pending_since
+                        # on a fake clock that starts at zero
+                        since = t if st.pending_since is None \
+                            else st.pending_since
+                        if t - since >= rule.for_s:
+                            st.state = FIRING
+                            st.fired_at = t
+                else:
+                    # resolution requires the CONDITION to clear; nothing
+                    # here consults window ages, so a sliding window alone
+                    # can never resolve (or un-pend) an alert
+                    if st.state in (PENDING, FIRING):
+                        st.state = OK
+                        st.pending_since = None
+                if st.state != prev:
+                    to = st.state
+                    if prev == FIRING and st.state == OK:
+                        to = RESOLVED
+                        for rec in reversed(self._firings):
+                            if (rec["rule"] == rule.name
+                                    and rec["resolved_at_s"] is None):
+                                rec["resolved_at_s"] = round(t, 6)
+                                break
+                    elif st.state == FIRING:
+                        self._firings.append({
+                            "rule": rule.name,
+                            "severity": rule.severity,
+                            "fired_at_s": round(t, 6),
+                            "resolved_at_s": None,
+                        })
+                    transitions.append({
+                        "rule": rule.name, "from": prev, "to": to,
+                        "severity": rule.severity, "at_s": round(t, 6),
+                        "value": (None if value is None
+                                  else round(value, 6)),
+                    })
+                gauges.append((rule.name, _STATE_N[st.state]))
+        self._emit(transitions, gauges)
+        return transitions
+
+    def _emit(self, transitions: List[dict],
+              gauges: List[Tuple[str, int]]) -> None:
+        """Flight + metrics for one pass — outside the engine lock."""
+        for tr in transitions:
+            if _flight.ACTIVE is not None:
+                _flight.ACTIVE.record_event(
+                    "alert", tr["rule"], detail=tr["to"],
+                    severity=tr["severity"], value=tr["value"])
+            if self._metrics is not None:
+                self._metrics.counter(
+                    "alert_transitions_total",
+                    {"rule": tr["rule"], "to": tr["to"]},
+                    help="Alert state-machine transitions by rule").inc()
+        if self._metrics is not None:
+            for rule_name, n in gauges:
+                self._metrics.gauge(
+                    "alert_state", {"rule": rule_name},
+                    help="Alert state per rule (0 ok, 1 pending, 2 firing)"
+                    ).set(float(n))
+
+    # ------------------------------------------------------------ surface
+    def firings(self) -> List[dict]:
+        """Chronological firing log (open firings have resolved_at None)."""
+        with self._lock:
+            return [dict(rec) for rec in self._firings]
+
+    def active(self) -> List[str]:
+        """Names of rules currently firing, sorted."""
+        with self._lock:
+            return sorted(name for name, st in self._states.items()
+                          if st.state == FIRING)
+
+    def snapshot(self) -> dict:
+        """JSON-ready state for ``GET /v1/alerts``."""
+        with self._lock:
+            rules = {}
+            for rule in self.rules:
+                st = self._states[rule.name]
+                rules[rule.name] = {
+                    "state": st.state,
+                    "severity": rule.severity,
+                    "summary": rule.summary,
+                    "metric": rule.metric,
+                    "kind": rule.kind,
+                    "value": (None if st.last_value is None
+                              else round(st.last_value, 6)),
+                    "threshold": round(float(rule.value), 6),
+                    "for_s": round(float(rule.for_s), 6),
+                    "pending_since_s": (
+                        None if st.pending_since is None
+                        else round(st.pending_since, 6)),
+                    "fired_at_s": (None if st.fired_at is None
+                                   else round(st.fired_at, 6)),
+                }
+            return {"rules": rules,
+                    "firings": [dict(rec) for rec in self._firings]}

@@ -122,6 +122,33 @@ class SloBurn:
             out[_fmt_window(win)] = frac / budget if budget > 0 else 0.0
         return out
 
+    def forget(self, key: str) -> None:
+        """Drop every series for one key and retire its exported burn
+        gauges.
+
+        A subject that stops receiving traffic (a dead replica, a removed
+        model) stops calling :meth:`record`, so its last exported burn
+        value would freeze — a 1m-window spike frozen above threshold
+        holds alert rules hostage long after the window slid past the bad
+        events. Deleting the gauge turns that lie into honest absence,
+        and a federated TSDB sees the deletion as a presence diff and
+        tombstones the series (deliberately removed, never resurrected).
+        The ``fleet_slo_requests_total`` counters stay: history is their
+        point.
+        """
+        with self._lock:
+            classes = [cls for (k, cls) in self._series if k == key]
+            for cls in classes:
+                del self._series[(key, cls)]
+        m = self.metrics
+        if m is None:
+            return
+        for cls in classes:
+            for win in self.windows:
+                m.remove_series("fleet_slo_burn_rate",
+                                {self.key_label: key, "slo_class": cls,
+                                 "window": _fmt_window(win)})
+
     def snapshot(self) -> dict:
         """JSON-safe ``{model: {slo_class: {good, bad, target, burn}}}`` for
         ``/v1/fleet``."""

@@ -1,0 +1,329 @@
+"""In-process time-series store — bounded history over registry snapshots.
+
+A :class:`TimeSeriesStore` turns the point-in-time ``MetricsRegistry.
+snapshot()`` the stack already exports into *history*: each ``ingest``
+appends one sample per series to a bounded ring, on an injectable clock,
+so alerting and forecasting can ask "what has this gauge done over the
+last ten minutes" without a Prometheus deployment. Stdlib only, like the
+rest of obs/.
+
+Materialization follows the metric kind:
+
+- **gauges** keep raw last-values per sample;
+- **counters** store the raw cumulative points and materialize
+  per-second *rates* at query time (``rate=True``), clamping negative
+  deltas to zero so process restarts read as a flat spot, not a cliff;
+- **histograms** are decomposed into quantile *tracks* (``p50``/``p95``/
+  ``p99`` plus the cumulative ``count``) — the JSON snapshot carries the
+  streaming quantile estimates the text exposition cannot.
+
+Staleness has two deliberately different tiers:
+
+- a source that stops answering (dead/suspect replica, failed scrape) is
+  **soft-stale** via :meth:`mark_stale`: its series drop out of live
+  queries but resurrect the moment the source answers again;
+- a series that disappears from a snapshot the source *did* answer was
+  removed on purpose (``MetricsRegistry.remove_series`` — e.g. a reaped
+  replica's ``cluster_replica_state``) and is **tombstoned**: it never
+  resurrects, even if a later snapshot re-reports the same key. Ghost
+  gauges outliving their subject is exactly the lie remove_series
+  exists to prevent, and the store must not un-tell it.
+
+Every mutation happens under one internal lock; self-describing
+``tsdb_*`` metrics are updated outside it so the store never blocks a
+scrape of the registry that contains them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+# Histogram quantile tracks materialized from snapshot entries, plus the
+# cumulative count (rate-queryable like a counter).
+_HIST_TRACKS = ("p50", "p95", "p99")
+
+
+class _Series:
+    """One (name, labels, track) ring of (t, value) points."""
+
+    __slots__ = ("kind", "labels", "track", "points", "stale_at")
+
+    def __init__(self, kind: str, labels: Dict[str, str], track: str,
+                 maxlen: int):
+        self.kind = kind
+        self.labels = labels
+        self.track = track
+        self.points: deque = deque(maxlen=maxlen)
+        self.stale_at: Optional[float] = None   # None == live
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _match(labels: Dict[str, str], want: Optional[Dict[str, str]]) -> bool:
+    """Subset match: every wanted (k, v) must be present in the series."""
+    if not want:
+        return True
+    for k, v in want.items():
+        if labels.get(k) != str(v):
+            return False
+    return True
+
+
+class TimeSeriesStore:
+    """Bounded multi-source time-series store over registry snapshots.
+
+    ``retention_points`` caps every series ring; ``retention_s`` prunes
+    points older than the horizon on ingest, so a slow-ticking series
+    cannot pin arbitrarily old samples just because its ring never
+    filled. ``clock`` is injectable — the smokes and tests drive the
+    store on a fake clock and pass explicit ``now`` values for
+    byte-stable histories.
+    """
+
+    def __init__(self, *, clock=time.monotonic, retention_points: int = 720,
+                 retention_s: float = 3600.0, metrics=None):
+        self._clock = clock
+        self.retention_points = max(2, int(retention_points))
+        self.retention_s = float(retention_s)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        # (name, label_key, track) -> _Series
+        self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...], str],
+                           _Series] = {}
+        # source -> keys seen in that source's last answered snapshot
+        self._by_source: Dict[str, frozenset] = {}
+        self._tombstones: set = set()
+        self._points_total: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ ingest
+    def ingest(self, source: str, snapshot: Dict[str, dict],
+               now: Optional[float] = None,
+               extra_labels: Optional[Dict[str, str]] = None) -> int:
+        """Append one sample per series of an answered snapshot.
+
+        ``extra_labels`` are merged into every series' labels *without*
+        overriding keys the snapshot already carries (the scraper's
+        ``replica`` relabel must not clobber ``cluster_replica_state``'s
+        own ``replica`` label). Series present in the source's previous
+        answered snapshot but absent from this one are tombstoned.
+        Returns the number of points appended.
+        """
+        t = self._clock() if now is None else float(now)
+        added = 0
+        with self._lock:
+            prev = self._by_source.get(source, frozenset())
+            seen = set()
+            for name, fam in snapshot.items():
+                kind = str(fam.get("type", "gauge"))
+                for entry in fam.get("series", ()):
+                    labels = dict(entry.get("labels") or {})
+                    for k, v in (extra_labels or {}).items():
+                        labels.setdefault(k, v)
+                    if kind == "histogram":
+                        tracks = [(q, (entry.get("quantiles") or {}).get(q))
+                                  for q in _HIST_TRACKS]
+                        tracks.append(("count", entry.get("count")))
+                    else:
+                        tracks = [("", entry.get("value"))]
+                    lkey = _label_key(labels)
+                    for track, val in tracks:
+                        if val is None:
+                            continue
+                        key = (name, lkey, track)
+                        seen.add(key)
+                        if key in self._tombstones:
+                            continue
+                        rec = self._series.get(key)
+                        if rec is None:
+                            rec = self._series[key] = _Series(
+                                kind, labels, track, self.retention_points)
+                        rec.stale_at = None
+                        rec.points.append((t, float(val)))
+                        horizon = t - self.retention_s
+                        while rec.points and rec.points[0][0] < horizon:
+                            rec.points.popleft()
+                        added += 1
+            # an answered snapshot is authoritative for its source: keys it
+            # used to report and no longer does were removed on purpose
+            for key in prev - seen:
+                self._tombstones.add(key)
+                rec = self._series.get(key)
+                if rec is not None and rec.stale_at is None:
+                    rec.stale_at = t
+            self._by_source[source] = frozenset(seen)
+            self._points_total[source] = (
+                self._points_total.get(source, 0) + added)
+            live, stale = self._counts_locked()
+        self._export(source, added, live, stale)
+        return added
+
+    def mark_stale(self, source: str, now: Optional[float] = None) -> int:
+        """Soft-stale every series of an unreachable source.
+
+        Unlike tombstoning this is reversible: the next answered ingest
+        for the source revives its series. Returns how many went stale.
+        """
+        t = self._clock() if now is None else float(now)
+        n = 0
+        with self._lock:
+            for key in self._by_source.get(source, frozenset()):
+                rec = self._series.get(key)
+                if rec is not None and rec.stale_at is None:
+                    rec.stale_at = t
+                    n += 1
+            live, stale = self._counts_locked()
+        self._export(source, 0, live, stale)
+        return n
+
+    def _counts_locked(self) -> Tuple[int, int]:
+        stale = sum(1 for s in self._series.values()
+                    if s.stale_at is not None)
+        return len(self._series) - stale, stale
+
+    def _export(self, source: str, added: int, live: int,
+                stale: int) -> None:
+        """Self-metrics — called outside the store lock by design."""
+        m = self._metrics
+        if m is None:
+            return
+        if added:
+            m.counter("tsdb_points_total", {"source": source},
+                      help="Samples appended to the time-series store"
+                      ).inc(added)
+        m.gauge("tsdb_series", help="Live (non-stale) stored series"
+                ).set(float(live))
+        m.gauge("tsdb_stale_series",
+                help="Stored series currently marked stale or tombstoned"
+                ).set(float(stale))
+
+    # ------------------------------------------------------------- query
+    @staticmethod
+    def _rate_points(points: List[Tuple[float, float]]
+                     ) -> List[Tuple[float, float]]:
+        out: List[Tuple[float, float]] = []
+        for i in range(1, len(points)):
+            t0, v0 = points[i - 1]
+            t1, v1 = points[i]
+            dt = t1 - t0
+            if dt <= 0.0:
+                continue
+            # counter reset (process restart) reads as zero, not a cliff
+            out.append((t1, max(0.0, v1 - v0) / dt))
+        return out
+
+    def query(self, name: str, labels: Optional[Dict[str, str]] = None,
+              track: Optional[str] = None, t_min: Optional[float] = None,
+              t_max: Optional[float] = None, rate: bool = False,
+              include_stale: bool = False) -> List[dict]:
+        """JSON-ready range query: list of matching series with points.
+
+        ``labels`` is a subset match; ``track`` of None matches every
+        track. ``rate=True`` materializes per-second deltas (meaningful
+        for counters and histogram ``count`` tracks). Floats are rounded
+        to 6 dp so serialized query results are byte-stable.
+        """
+        out: List[dict] = []
+        with self._lock:
+            for key in sorted(self._series):
+                if key[0] != name:
+                    continue
+                rec = self._series[key]
+                if rec.stale_at is not None and not include_stale:
+                    continue
+                if not _match(rec.labels, labels):
+                    continue
+                if track is not None and rec.track != track:
+                    continue
+                pts = list(rec.points)
+                if rate:
+                    pts = self._rate_points(pts)
+                pts = [(t, v) for (t, v) in pts
+                       if (t_min is None or t >= t_min)
+                       and (t_max is None or t <= t_max)]
+                out.append({
+                    "labels": dict(rec.labels),
+                    "kind": rec.kind,
+                    "track": rec.track,
+                    "stale": rec.stale_at is not None,
+                    "points": [[round(t, 6), round(v, 6)]
+                               for (t, v) in pts],
+                })
+        return out
+
+    def latest(self, name: str, labels: Optional[Dict[str, str]] = None,
+               track: Optional[str] = None
+               ) -> List[Tuple[Dict[str, str], float, float]]:
+        """(labels, t, value) of the last point of each matching LIVE
+        series — the alert engine's instantaneous read."""
+        out: List[Tuple[Dict[str, str], float, float]] = []
+        with self._lock:
+            for key in sorted(self._series):
+                if key[0] != name:
+                    continue
+                rec = self._series[key]
+                if rec.stale_at is not None or not rec.points:
+                    continue
+                if not _match(rec.labels, labels):
+                    continue
+                if track is not None and rec.track != track:
+                    continue
+                t, v = rec.points[-1]
+                out.append((dict(rec.labels), t, v))
+        return out
+
+    def window_rate(self, name: str,
+                    labels: Optional[Dict[str, str]] = None,
+                    track: Optional[str] = None, window_s: float = 60.0,
+                    now: Optional[float] = None
+                    ) -> List[Tuple[Dict[str, str], float]]:
+        """(labels, per-second rate) over the trailing window per live
+        series — the alert engine's rate-of-change read."""
+        t1 = self._clock() if now is None else float(now)
+        t0 = t1 - float(window_s)
+        out: List[Tuple[Dict[str, str], float]] = []
+        with self._lock:
+            for key in sorted(self._series):
+                if key[0] != name:
+                    continue
+                rec = self._series[key]
+                if rec.stale_at is not None or not _match(rec.labels,
+                                                          labels):
+                    continue
+                if track is not None and rec.track != track:
+                    continue
+                pts = [(t, v) for (t, v) in rec.points if t0 <= t <= t1]
+                if len(pts) < 2 or pts[-1][0] <= pts[0][0]:
+                    out.append((dict(rec.labels), 0.0))
+                    continue
+                delta = max(0.0, pts[-1][1] - pts[0][1])
+                out.append((dict(rec.labels),
+                            delta / (pts[-1][0] - pts[0][0])))
+        return out
+
+    def sources(self) -> List[str]:
+        """Sorted sources that have ever answered an ingest."""
+        with self._lock:
+            return sorted(self._by_source)
+
+    def families(self) -> List[str]:
+        """Sorted names with at least one live series."""
+        with self._lock:
+            return sorted({k[0] for k, rec in self._series.items()
+                           if rec.stale_at is None})
+
+    def stats(self) -> Dict[str, int]:
+        """Store shape for tests and debug surfaces."""
+        with self._lock:
+            live, stale = self._counts_locked()
+            return {
+                "series": live,
+                "stale": stale,
+                "tombstoned": len(self._tombstones),
+                "points": sum(len(s.points) for s in self._series.values()),
+                "sources": len(self._by_source),
+            }

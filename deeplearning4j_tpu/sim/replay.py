@@ -318,7 +318,7 @@ class FleetTarget:
     """
 
     def __init__(self, registry, *, input_len: int = 16, vocab: int = 50,
-                 autoscaler=None):
+                 autoscaler=None, alerts=None):
         self.registry = registry
         self.input_len = int(input_len)
         self.vocab = int(vocab)
@@ -328,12 +328,22 @@ class FleetTarget:
         #: registry doesn't scale itself, but the hook lets one replayer
         #: code path serve both fixed and elastic targets.
         self.autoscaler = autoscaler
+        #: Optional AlertEngine-shaped hook (``firings() -> [dict]``);
+        #: when set, the replay's report records which alerts fired and
+        #: when, so the tuner can penalize configs that page humans.
+        self.alerts = alerts
 
     def replica_stats(self) -> Optional[Dict[str, int]]:
         """Fleet-size envelope from the attached autoscaler, if any."""
         if self.autoscaler is None:
             return None
         return self.autoscaler.replica_stats()
+
+    def alert_firings(self) -> Optional[List[dict]]:
+        """Alert firing log from the attached engine, if any."""
+        if self.alerts is None:
+            return None
+        return self.alerts.firings()
 
     def kv_utilization(self) -> Tuple[float, float]:
         """(peak, mean) of serve_kv_block_utilization over resident models."""
@@ -408,19 +418,27 @@ class RouterTarget:
     """
 
     def __init__(self, host: str, port: int, *, input_len: int = 16,
-                 vocab: int = 50, timeout_s: float = 30.0, autoscaler=None):
+                 vocab: int = 50, timeout_s: float = 30.0, autoscaler=None,
+                 alerts=None):
         self.host = str(host)
         self.port = int(port)
         self.input_len = int(input_len)
         self.vocab = int(vocab)
         self.timeout_s = float(timeout_s)
         self.autoscaler = autoscaler
+        self.alerts = alerts
 
     def replica_stats(self) -> Optional[Dict[str, int]]:
         """Fleet-size envelope from the attached autoscaler, if any."""
         if self.autoscaler is None:
             return None
         return self.autoscaler.replica_stats()
+
+    def alert_firings(self) -> Optional[List[dict]]:
+        """Alert firing log from the attached engine, if any."""
+        if self.alerts is None:
+            return None
+        return self.alerts.firings()
 
     def _post(self, path: str, body: dict,
               tenant: str) -> Tuple[int, dict]:
@@ -545,6 +563,13 @@ class LiveReplayer:
             extra["replicas"] = {"min": int(stats["min"]),
                                  "max": int(stats["max"]),
                                  "final": int(stats["final"])}
+        firings = (self.target.alert_firings()
+                   if hasattr(self.target, "alert_firings") else None)
+        if firings is not None:
+            # which alerts would have paged during this replay (rule,
+            # fired_at_s, resolved_at_s) — scored as an operator-toil
+            # penalty so the tuner prefers configs that stay quiet
+            extra["alerts"] = firings
         return summarize(
             self.trace.fingerprint(), outcomes, mode="live",
             kv_peak_utilization=peak, kv_mean_utilization=mean,

@@ -17,10 +17,16 @@ scalar so the tuner can rank configs:
             - 0.05 * min(ttft_p99_s, 2) / 2    # tail first-token latency
             - 0.05 * min(itl_p99_s, 0.5) / 0.5 # tail inter-token latency
             - 0.02 * kv_peak_utilization       # HBM headroom pressure
+            - 0.05 * min(alert_firings, 4) / 4 # pages during the replay
 
 Goodput dominates: a config that sheds half the trace can't win on
 latency. The latency and KV terms break ties between configs with equal
 goodput, which is exactly the regime successive halving operates in.
+The alert term charges operator toil: a replay that stamped ``alerts``
+firings (a live target with an AlertEngine attached) loses up to 0.05
+for paging humans, so between two configs with equal goodput the tuner
+prefers the quiet one. Reports without an ``alerts`` key are scored
+exactly as before.
 """
 
 from __future__ import annotations
@@ -174,8 +180,9 @@ def score(report: dict) -> float:
     ttft_p99 = min(float(report["ttft_ms"]["p99"]) / 1e3, 2.0) / 2.0
     itl_p99 = min(float(report["inter_token_ms"]["p99"]) / 1e3, 0.5) / 0.5
     kv_peak = float(report.get("kv", {}).get("peak_utilization", 0.0))
+    pages = min(len(report.get("alerts") or []), 4) / 4.0
     return (goodput - 0.25 * burn - 0.05 * ttft_p99 - 0.05 * itl_p99
-            - 0.02 * kv_peak)
+            - 0.02 * kv_peak - 0.05 * pages)
 
 
 def report_json(report: dict) -> str:

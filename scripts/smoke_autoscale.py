@@ -37,9 +37,18 @@ skewable clock, so nothing ever waits on wall time):
   floor breach (``below_min`` again) — the fleet is back at two with no
   ghost series for the dead replica.
 
+- **E. forecast-driven pre-spawn** — a standalone fake-clock drill over
+  a 3-day sim workload with a known diurnal ramp: two identical policies
+  watch the same burn curve, one additionally fed Holt-Winters burn
+  forecasts from the telemetry store. The forecast policy must scale out
+  at least one tick BEFORE the reactive burn-threshold policy, and its
+  decision log must be byte-identical across two independent runs — the
+  ISSUE-14 predictive-autoscale acceptance surface.
+
 Artifacts: $CI_ARTIFACTS_DIR/smoke_autoscale_metrics.prom (+ _om.prom,
 both validated by obs.promcheck), smoke_autoscale_decisions.jsonl (the
-controller's canonical decision log), and a flight_NN.json dump.
+controller's canonical decision log), smoke_autoscale_forecast.jsonl
+(the forecast-enabled demo decision log), and a flight_NN.json dump.
 """
 
 import json
@@ -147,6 +156,141 @@ def _tick(ctl, step_s=1.0):
     """One control turn, one second later on the drill clock."""
     CLOCK_SKEW[0] += step_s
     return ctl.tick()
+
+
+def forecast_demo(artifacts):
+    """Phase E: predictive pre-spawn beats reactive scale-out on a ramp.
+
+    Everything runs on an explicit fake clock against a stubbed signal
+    surface — no sockets, no threads — so the decision stream is a pure
+    function of (workload seed, policy knobs) and byte-identity across
+    runs is a hard assertion, not a hope. The burn curve is the sim
+    workload's own diurnal rate over a fixed capacity, the exact shape
+    the ROADMAP's "predictive scale-out from the sim's diurnal
+    fingerprints" names.
+    """
+    from deeplearning4j_tpu.autoscale import AutoscalePolicy
+    from deeplearning4j_tpu.autoscale.signals import SignalReader
+    from deeplearning4j_tpu.obs.forecast import BurnForecaster
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.obs.tsdb import TimeSeriesStore
+    from deeplearning4j_tpu.sim import WorkloadSpec
+
+    day_s = 240.0
+    step_s = 2.0
+    capacity_rps = 8.0  # peak offered rate is 11.4 rps: breaches mid-ramp
+    spec = WorkloadSpec(seed=7, duration_s=day_s, days=3,
+                        base_rate_rps=6.0, diurnal_amplitude=0.9,
+                        diurnal_period_s=day_s, diurnal_phase=-0.25)
+
+    def burn_at(t):
+        return spec.rate_at(t % spec.total_duration_s) / capacity_rps
+
+    class _CurveSlo:
+        """SloBurn-snapshot-shaped view of the diurnal burn curve."""
+
+        def __init__(self):
+            self.burn = 0.0
+
+        def snapshot(self):
+            return {"m": {"gold": {"good": 0, "bad": 0, "target": 0.999,
+                                   "burn": {"1m": self.burn,
+                                            "10m": self.burn}}}}
+
+    class _OneReplica:
+        """Membership-read-shaped stub: one healthy, empty replica."""
+
+        @staticmethod
+        def ids():
+            return ["sim-0"]
+
+        @staticmethod
+        def state(rid):
+            return "alive"
+
+        @staticmethod
+        def payload(rid):
+            return {"queue_depth": 0, "kv_utilization": 0.0}
+
+    def run(with_forecast):
+        """Replay days 1-2 into the store, then decide through day 3's
+        ramp; returns the day-3 decision list."""
+        t_box = [0.0]
+        clock = lambda: t_box[0]  # noqa: E731 — the drill's fake clock
+        reg = MetricsRegistry()
+        store = TimeSeriesStore(clock=clock)
+        forecaster = BurnForecaster(store, season_s=day_s,
+                                    horizon_s=3 * step_s)
+        slo = _CurveSlo()
+        reader = SignalReader(slo=slo, membership=_OneReplica(),
+                              clock=clock)
+        policy = AutoscalePolicy(
+            min_replicas=1, max_replicas=4, burn_out={"gold": 1.0},
+            sustain_out_s=step_s, sustain_in_s=1e9,
+            cooldown_out_s=4 * step_s, cooldown_in_s=1e9,
+            queue_high=1e9, queue_low=0.0, forecast_confidence=0.6)
+
+        def observe(t):
+            t_box[0] = t
+            slo.burn = burn_at(t)
+            reg.gauge("fleet_slo_burn_rate",
+                      {"model": "m", "slo_class": "gold",
+                       "window": "1m"}).set(slo.burn)
+            store.ingest("router", reg.snapshot(), now=t)
+
+        t = 0.0
+        while t < 2 * day_s:  # two warm days teach the seasonal profile
+            observe(t)
+            t += step_s
+        current = 1
+        decisions = []
+        while t < 2 * day_s + day_s / 2:  # day 3: trough -> peak ramp
+            observe(t)
+            reader.sample()
+            forecast = None
+            if with_forecast:
+                forecast = {"gold": forecaster.forecast_burn("gold")}
+            d = policy.decide(reader, current, t, forecast=forecast)
+            decisions.append(d)
+            if d.direction == "out" and d.amount:
+                current += d.amount
+                policy.commit(d, t)
+            t += step_s
+        return decisions
+
+    print("=== phase E: forecast-driven pre-spawn on a diurnal ramp ===",
+          flush=True)
+    reactive = run(with_forecast=False)
+    predictive = run(with_forecast=True)
+    # byte-identity: a second independent run must reproduce the forecast
+    # decision stream exactly (fixed seed + fake clock, 6-dp evidence)
+    log = "\n".join(d.to_json() for d in predictive) + "\n"
+    assert log == "\n".join(d.to_json()
+                            for d in run(with_forecast=True)) + "\n", \
+        "forecast decision log is not reproducible"
+    with open(os.path.join(artifacts, "smoke_autoscale_forecast.jsonl"),
+              "w") as f:
+        f.write(log)
+
+    def first_out(decisions):
+        return next(i for i, d in enumerate(decisions)
+                    if d.direction == "out")
+
+    i_react = first_out(reactive)
+    i_pred = first_out(predictive)
+    assert predictive[i_pred].reason == "forecast", predictive[i_pred]
+    assert i_pred < i_react, \
+        f"forecast scaled at tick {i_pred}, reactive at {i_react}"
+    # the reactive policy only moves once the live threshold actually
+    # trips; the forecast acted while the observed burn was still < 1.0
+    assert reactive[i_react].evidence["burn"]["gold"] >= 1.0
+    assert predictive[i_pred].evidence["burn"]["gold"] < 1.0
+    assert predictive[i_pred].evidence["forecast"]["gold"]["value"] >= 1.0
+    assert predictive[i_pred].evidence["forecast"]["gold"][
+        "confidence"] >= 0.6
+    print(f"forecast pre-spawned at tick {i_pred}, reactive at {i_react} "
+          f"({i_react - i_pred} ticks earlier)", flush=True)
+    return i_react - i_pred
 
 
 def main():
@@ -426,9 +570,12 @@ def main():
             break
         time.sleep(0.1)
     assert not hung, f"threads left hanging: {[t.name for t in hung]}"
+
+    lead = forecast_demo(artifacts)
     print("smoke autoscale OK: floor repaired, scaled out under burn, "
           "burn recovered < 1.0, drain-based scale-in dropped nothing, "
-          "dead replica reaped with no ghost series")
+          "dead replica reaped with no ghost series, forecast pre-spawned "
+          f"{lead} tick(s) ahead of the reactive policy")
 
 
 if __name__ == "__main__":

@@ -21,15 +21,25 @@ never wait on wall time):
   replica's model serves again from its new home.
 - **D. partition the survivor** — a scoped connection fault makes the last
   replica unreachable: requests shed with a typed 503
-  (``upstream_unreachable``) and the gold burn rate spikes above 1.0;
-  healing the partition and aging the window brings
-  ``fleet_slo_burn_rate{slo_class="gold",window="1m"}`` back below 1.0.
+  (``upstream_unreachable``) and the gold burn rate spikes above 1.0.
+  The telemetry plane watches the same outage: federated scrapes mark
+  the partitioned survivor ``error``/stale (never a scrape failure), the
+  ``gold_burn_high`` alert goes pending, holds through its 20 s sustain
+  window (NOT firing at +10 s — sustain semantics), then fires; the
+  firing is visible on ``GET /v1/alerts``, the burn history on
+  ``GET /v1/tsdb``, and the transition in the flight dump. Healing the
+  partition and aging the window brings
+  ``fleet_slo_burn_rate{slo_class="gold",window="1m"}`` back below 1.0
+  and the alert RESOLVES — because the condition cleared, not because
+  the window slid.
 - **E. global tenant bucket** — a tenant capped at the router is refused
   with a typed 429 + Retry-After no matter which replica would serve it.
 
 Artifacts: $CI_ARTIFACTS_DIR/smoke_cluster_metrics.prom (+ _om.prom, both
-validated by obs.promcheck), smoke_cluster_trace.json (Perfetto), and a
-flight_NN.json dump of the drill's last requests.
+validated by obs.promcheck — now carrying the tsdb_*/alert_* families),
+smoke_cluster_tsdb.json (a /v1/tsdb range query of the burn spike),
+smoke_cluster_trace.json (Perfetto), and a flight_NN.json dump of the
+drill's last requests.
 """
 
 import json
@@ -156,6 +166,8 @@ def main():
 
     from deeplearning4j_tpu.chaos import FaultPlane, install, uninstall
     from deeplearning4j_tpu.cluster import ClusterRouter
+    from deeplearning4j_tpu.obs import (AlertEngine, FederatedScraper,
+                                        TimeSeriesStore)
     from deeplearning4j_tpu.obs import flight as flight_mod
     from deeplearning4j_tpu.obs import reqtrace as reqtrace_mod
     from deeplearning4j_tpu.obs.flight import FlightRecorder
@@ -186,6 +198,13 @@ def main():
     router.tenants.register("capped", rate_per_s=0.5, burst=2.0)
     router.start()
     port = router.port
+    # telemetry plane on the same skewable clock: federated scrape of the
+    # router + every replica into the in-process TSDB, with the default
+    # alert ruleset evaluated after each pass (driven manually here — the
+    # drill owns time, so no background scrape thread)
+    tsdb = TimeSeriesStore(clock=_clock, metrics=router.metrics)
+    engine = AlertEngine(tsdb, metrics=router.metrics, clock=_clock)
+    scraper = FederatedScraper(router, tsdb, alerts=engine, clock=_clock)
     try:
         _wait_ready(port)
         router.poll_once()  # first beat round: collect payloads, build plan
@@ -194,6 +213,11 @@ def main():
         plan = json.loads(body)["placement"]
         assert set(plan) == {"d", "g"} and all(len(c) == 2
                                                for c in plan.values()), plan
+        # healthy-cluster baseline scrape: every source answers, nothing
+        # is stale, and no alert in the default ruleset has cause to fire
+        outcomes = scraper.scrape_once()
+        assert outcomes == {"router": "ok", "r1": "ok", "r2": "ok"}, outcomes
+        assert not engine.active(), engine.active()
 
         # ---- A: fault-free reference pass (both tenants, both verbs)
         print("=== phase A: reference pass ===", flush=True)
@@ -281,16 +305,58 @@ def main():
 
         # ---- D: partition the survivor -> typed outage, burn spike, heal
         print("=== phase D: partition, burn spike, recovery ===", flush=True)
+        # renew the survivor's lease so the scrape below meets an ALIVE
+        # member behind a dead wire (the soft-stale "error" path), not a
+        # member already benched as suspect by its aged lease
+        router.poll_once()
         fp = install(FaultPlane(seed=0, metrics=router.metrics))
         fp.inject_spec(
             f"cluster.transport:error:type=connection,scope={survivor},"
             f"times=-1")
+        # first scrape meets an ALIVE member behind a dead wire: the pull
+        # soft-stales it and reports "error" — never a scrape crash. The
+        # dead victim reports "stale" straight from membership state.
+        outcomes = scraper.scrape_once()
+        assert outcomes[survivor] == "error", outcomes
+        assert outcomes[victim] == "stale", outcomes
+        assert outcomes["router"] == "ok", outcomes
+        assert "replica_dead" in engine.active(), engine.active()
         for _ in range(2):
             code, cause, hdrs = _typed_error(
                 port, "/v1/models/d/predict", {"ndarray": X}, tenant="vip")
             assert code == 503 and cause == "upstream_unreachable", \
                 (code, cause)
             assert hdrs.get("Retry-After") is not None
+
+        # the two shed gold requests refreshed the burn gauge above 1.0,
+        # so this scrape pass (which also evaluates the alert ruleset)
+        # sends gold_burn_high to PENDING — not firing: its 20s sustain
+        # has not elapsed.
+        scraper.scrape_once()
+        assert "gold_burn_high" not in engine.active(), \
+            "gold_burn_high fired instantly, ignoring its for_s sustain"
+        CLOCK_SKEW[0] += 10.0
+        scraper.scrape_once()  # +10s: still inside the sustain window
+        assert "gold_burn_high" not in engine.active(), \
+            "gold_burn_high fired at +10s, before its 20s sustain elapsed"
+        CLOCK_SKEW[0] += 11.0
+        scraper.scrape_once()  # +21s: sustained past for_s -> FIRING
+        assert "gold_burn_high" in engine.active(), \
+            engine.snapshot()["rules"]["gold_burn_high"]
+        status, body = _get(port, "/v1/alerts")
+        assert status == 200
+        alerts_view = json.loads(body)
+        assert alerts_view["rules"]["gold_burn_high"]["state"] == "firing", \
+            alerts_view["rules"]["gold_burn_high"]
+        # ...and the burn history that drove the page is queryable over HTTP
+        status, body = _get(
+            port, "/v1/tsdb?name=fleet_slo_burn_rate"
+                  "&label.slo_class=gold&label.window=1m")
+        assert status == 200
+        tsdb_view = json.loads(body)
+        assert tsdb_view["series"] and all(
+            s["points"] for s in tsdb_view["series"]), tsdb_view
+
         uninstall()
         scrape = _get(port, "/metrics")[1].decode()
         burn = _metric(scrape, "fleet_slo_burn_rate", model="d",
@@ -308,6 +374,17 @@ def main():
         burn = _metric(scrape, "fleet_slo_burn_rate", model="d",
                        slo_class="gold", window="1m")
         assert burn < 1.0, f"gold burn did not recover: {burn}"
+        # the alert resolves because the CONDITION cleared (post-heal gold
+        # traffic refreshed the gauge below threshold) — not because the
+        # sustain window slid past the spike
+        scraper.scrape_once()
+        assert "gold_burn_high" not in engine.active(), engine.active()
+        alerts_view = json.loads(_get(port, "/v1/alerts")[1])
+        assert alerts_view["rules"]["gold_burn_high"]["state"] == "ok", \
+            alerts_view["rules"]["gold_burn_high"]
+        fired = [f for f in alerts_view["firings"]
+                 if f["rule"] == "gold_burn_high"]
+        assert fired and fired[-1]["resolved_at_s"] is not None, fired
 
         # ---- E: the router's tenant bucket is global, typed, and bounded
         print("=== phase E: global tenant quota ===", flush=True)
@@ -340,6 +417,19 @@ def main():
         # per-replica burn is exported alongside the per-model burn
         _metric(scrape, "fleet_slo_burn_rate", replica=survivor,
                 slo_class="gold", window="1m")
+        # telemetry-plane self-metrics rode along in the same exposition:
+        # promcheck gates the tsdb_*/alert_* families with everything else
+        assert _metric(scrape, "tsdb_scrapes_total", outcome="ok") >= 4
+        assert _metric(scrape, "tsdb_points_total", source="router") >= 1
+        assert _metric(scrape, "tsdb_series") >= 1
+        assert _metric(scrape, "alert_transitions_total",
+                       rule="gold_burn_high", to="firing") == 1
+        assert _metric(scrape, "alert_transitions_total",
+                       rule="gold_burn_high", to="resolved") == 1
+        assert _metric(scrape, "alert_state", rule="gold_burn_high") == 0
+        with open(os.path.join(artifacts, "smoke_cluster_tsdb.json"),
+                  "w") as f:
+            json.dump(tsdb_view, f, indent=1, sort_keys=True)
         errors = check_text(scrape, openmetrics=False)
         assert not errors, f"invalid /metrics exposition: {errors[:5]}"
         om = urllib.request.urlopen(urllib.request.Request(
@@ -360,6 +450,12 @@ def main():
         assert any(r["trace_id"] == hedge_trace
                    for r in dumped["requests"]), \
             "hedged request's record missing from the flight dump"
+        # the alert lifecycle left its transitions in the same black box
+        alert_evs = [(e.get("name"), e.get("detail"))
+                     for e in dumped.get("events", [])
+                     if e.get("kind") == "alert"]
+        assert ("gold_burn_high", "firing") in alert_evs, alert_evs
+        assert ("gold_burn_high", "resolved") in alert_evs, alert_evs
     finally:
         uninstall()
         router.stop()
@@ -381,7 +477,8 @@ def main():
         time.sleep(0.1)
     assert not hung, f"threads left hanging: {[t.name for t in hung]}"
     print("smoke cluster OK: replica death survived, placement healed, "
-          "hedge stitched, burn recovered, no hung threads")
+          "hedge stitched, burn recovered, alert fired and resolved, "
+          "no hung threads")
 
 
 if __name__ == "__main__":

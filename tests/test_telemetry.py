@@ -1,0 +1,426 @@
+"""Tests for the telemetry plane (ISSUE 14): obs/tsdb.py, obs/alerts.py,
+obs/forecast.py, the SloBurn retire path, the policy's forecast branch,
+and the sim scorer's alert penalty.
+
+The load-bearing properties, each tested directly:
+
+- tsdb: gauges/counters/histogram-quantile tracks materialize per kind;
+  counter rates clamp restart deltas to zero; retention caps by point
+  count and age; soft staleness (unreachable source) hides series from
+  live reads and REVIVES on the next answered ingest, while a series a
+  source deliberately stopped reporting (``remove_series``) is
+  TOMBSTONED — absent from queries, ``latest`` and alert evaluation
+  forever, even when a later snapshot re-reports the same key;
+- alerts: ``for_s`` sustain on a fake clock — a short spike goes
+  pending and cancels without ever firing; firing happens only once the
+  violation held the full horizon; firing -> resolved requires the
+  CONDITION to clear, not evaluation time to pass (a firing alert stays
+  firing for an arbitrarily long quiet stretch while the value holds);
+  rate-of-change and absence kinds; transition counters and state
+  gauges;
+- slo: ``SloBurn.forget`` retires a dead subject's burn gauges so a
+  frozen spike cannot hold an alert hostage, and the deletion flows
+  through ingest's presence diff into a tombstone;
+- forecast: Holt-Winters extrapolates a seasonal series ~a period ahead
+  with high confidence, is deterministic for a given store state, and
+  returns None (never a made-up number) on short series;
+- policy: a confident forecast breach pre-spawns with
+  ``reason="forecast"`` under the usual clamp/cooldown discipline; an
+  unconfident one does not; ``forecast=None`` reproduces the legacy
+  decision event byte for byte;
+- sim scoring: replay reports that carry stamped alert firings lose up
+  to 0.05 score; reports without the key score exactly as before.
+"""
+
+import json
+
+from deeplearning4j_tpu.autoscale import OUT, HOLD, AutoscalePolicy, SignalReader
+from deeplearning4j_tpu.obs.alerts import (ABSENCE, RATE_OF_CHANGE,
+                                           AlertEngine, AlertRule)
+from deeplearning4j_tpu.obs.forecast import BurnForecaster, Forecast
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.obs.slo import SloBurn
+from deeplearning4j_tpu.obs.tsdb import TimeSeriesStore
+from deeplearning4j_tpu.sim.score import Outcome, score, summarize
+
+
+def _gauge_snap(name, value, labels=None):
+    return {name: {"type": "gauge", "help": "",
+                   "series": [{"labels": labels or {}, "value": value}]}}
+
+
+def _counter_snap(name, value, labels=None):
+    return {name: {"type": "counter", "help": "",
+                   "series": [{"labels": labels or {}, "value": value}]}}
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# =============================================================== tsdb
+class TestTimeSeriesStore:
+    def test_kinds_materialize(self):
+        clock = _Clock()
+        store = TimeSeriesStore(clock=clock)
+        snap = {
+            "g": {"type": "gauge", "series": [{"labels": {}, "value": 2.5}]},
+            "c": {"type": "counter",
+                  "series": [{"labels": {}, "value": 10.0}]},
+            "h": {"type": "histogram",
+                  "series": [{"labels": {}, "count": 4, "sum": 1.0,
+                              "quantiles": {"p50": 0.1, "p95": 0.2,
+                                            "p99": 0.3}}]},
+        }
+        assert store.ingest("s", snap, now=1000.0) == 6  # g + c + 3q + count
+        assert store.latest("g") == [({}, 1000.0, 2.5)]
+        p99 = store.query("h", track="p99")
+        assert len(p99) == 1 and p99[0]["points"] == [[1000.0, 0.3]]
+        tracks = {s["track"] for s in store.query("h")}
+        assert tracks == {"p50", "p95", "p99", "count"}
+
+    def test_counter_rate_clamps_restart(self):
+        store = TimeSeriesStore(clock=_Clock())
+        for t, v in ((0.0, 0.0), (10.0, 100.0), (20.0, 5.0), (30.0, 45.0)):
+            store.ingest("s", _counter_snap("c", v), now=t)
+        [series] = store.query("c", rate=True)
+        # 100 over 10s; restart (100 -> 5) clamps to 0; then 40 over 10s
+        assert series["points"] == [[10.0, 10.0], [20.0, 0.0], [30.0, 4.0]]
+
+    def test_retention_by_count_and_age(self):
+        store = TimeSeriesStore(clock=_Clock(), retention_points=4,
+                                retention_s=25.0)
+        for i in range(10):
+            store.ingest("s", _gauge_snap("g", float(i)), now=float(i * 10))
+        [series] = store.query("g")
+        # ring cap 4, then the 25s horizon prunes to the trailing 3 points
+        assert [p[0] for p in series["points"]] == [70.0, 80.0, 90.0]
+
+    def test_soft_stale_revives_on_answer(self):
+        store = TimeSeriesStore(clock=_Clock())
+        store.ingest("s", _gauge_snap("g", 1.0), now=0.0)
+        store.mark_stale("s", now=1.0)
+        assert store.latest("g") == []
+        assert store.query("g") == []
+        [series] = store.query("g", include_stale=True)
+        assert series["stale"] is True
+        store.ingest("s", _gauge_snap("g", 2.0), now=2.0)
+        assert store.latest("g") == [({}, 2.0, 2.0)]
+
+    def test_remove_series_tombstones_never_resurrects(self):
+        """Satellite: registry remove_series -> staleness propagates on the
+        next scrape; the series never resurrects in range queries."""
+        reg = MetricsRegistry()
+        clock = _Clock()
+        store = TimeSeriesStore(clock=clock)
+        reg.gauge("cluster_replica_state", {"replica": "r9"}).set(2.0)
+        store.ingest("router", reg.snapshot(), now=0.0)
+        assert store.latest("cluster_replica_state") != []
+
+        # the source deliberately retires the series, then answers again
+        assert reg.remove_series("cluster_replica_state", {"replica": "r9"})
+        reg.gauge("other", {}).set(1.0)  # keep the snapshot non-trivial
+        store.ingest("router", reg.snapshot(), now=10.0)
+        assert store.latest("cluster_replica_state") == []
+        assert store.query("cluster_replica_state") == []
+        assert store.stats()["tombstoned"] == 1
+
+        # a later snapshot re-reporting the same key must NOT resurrect it
+        reg.gauge("cluster_replica_state", {"replica": "r9"}).set(2.0)
+        store.ingest("router", reg.snapshot(), now=20.0)
+        assert store.latest("cluster_replica_state") == []
+        assert store.query("cluster_replica_state",
+                           include_stale=True)[0]["points"] == [[0.0, 2.0]]
+
+    def test_tombstone_invisible_to_alert_eval(self):
+        """A tombstoned replica-dead gauge cannot keep the alert firing."""
+        reg = MetricsRegistry()
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(clock=clock)
+        engine = AlertEngine(store, clock=clock, rules=(
+            AlertRule("replica_dead", "cluster_replica_state",
+                      op=">", value=1.5, for_s=0.0),))
+        reg.gauge("cluster_replica_state", {"replica": "r9"}).set(2.0)
+        store.ingest("router", reg.snapshot(), now=0.0)
+        engine.evaluate(now=0.0)
+        assert engine.active() == ["replica_dead"]
+
+        reg.remove_series("cluster_replica_state", {"replica": "r9"})
+        reg.gauge("other", {}).set(1.0)
+        store.ingest("router", reg.snapshot(), now=5.0)
+        engine.evaluate(now=5.0)
+        assert engine.active() == []
+        # even a ghost re-report cannot re-fire it through the tombstone
+        reg.gauge("cluster_replica_state", {"replica": "r9"}).set(2.0)
+        store.ingest("router", reg.snapshot(), now=10.0)
+        engine.evaluate(now=10.0)
+        assert engine.active() == []
+
+    def test_extra_labels_do_not_clobber(self):
+        store = TimeSeriesStore(clock=_Clock())
+        store.ingest("r1", _gauge_snap("g", 1.0, {"replica": "own"}),
+                     now=0.0, extra_labels={"replica": "r1", "zone": "a"})
+        [(labels, _, _)] = store.latest("g")
+        assert labels == {"replica": "own", "zone": "a"}
+
+
+# ============================================================== alerts
+class TestAlertSustain:
+    RULE = AlertRule("hot", "m", op=">", value=1.0, for_s=20.0)
+
+    def _rig(self, metrics=None):
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(clock=clock)
+        engine = AlertEngine(store, clock=clock, rules=(self.RULE,),
+                             metrics=metrics)
+        return clock, store, engine
+
+    def _observe(self, store, engine, t, value):
+        store.ingest("s", _gauge_snap("m", value), now=t)
+        return engine.evaluate(now=t)
+
+    def test_short_spike_never_fires(self):
+        reg = MetricsRegistry()
+        clock, store, engine = self._rig(metrics=reg)
+        self._observe(store, engine, 0.0, 5.0)    # violated -> pending
+        assert engine.snapshot()["rules"]["hot"]["state"] == "pending"
+        self._observe(store, engine, 10.0, 5.0)   # +10s: still pending
+        assert engine.active() == []
+        transitions = self._observe(store, engine, 15.0, 0.5)  # spike over
+        assert engine.snapshot()["rules"]["hot"]["state"] == "ok"
+        assert [t["to"] for t in transitions] == ["ok"]
+        assert engine.firings() == []
+        snap = reg.snapshot()
+        tos = {s["labels"]["to"] for s in
+               snap["alert_transitions_total"]["series"]}
+        assert "firing" not in tos and "resolved" not in tos
+
+    def test_fires_only_after_sustain(self):
+        clock, store, engine = self._rig()
+        self._observe(store, engine, 0.0, 5.0)
+        self._observe(store, engine, 19.9, 5.0)
+        assert engine.active() == []              # 19.9 < for_s
+        self._observe(store, engine, 20.0, 5.0)
+        assert engine.active() == ["hot"]
+        [firing] = engine.firings()
+        assert firing["fired_at_s"] == 20.0
+        assert firing["resolved_at_s"] is None
+
+    def test_resolve_needs_condition_clear_not_window_slide(self):
+        clock, store, engine = self._rig()
+        self._observe(store, engine, 0.0, 5.0)
+        self._observe(store, engine, 25.0, 5.0)
+        assert engine.active() == ["hot"]
+        # a very long quiet stretch with the VALUE still violating: every
+        # horizon has slid past, the alert must stay firing
+        for t in (100.0, 1000.0, 10000.0):
+            self._observe(store, engine, t, 5.0)
+            assert engine.active() == ["hot"], t
+        # only the condition clearing resolves it
+        transitions = self._observe(store, engine, 10010.0, 0.2)
+        assert [t["to"] for t in transitions] == ["resolved"]
+        [firing] = engine.firings()
+        assert firing["resolved_at_s"] == 10010.0
+
+    def test_rate_of_change_and_absence(self):
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(clock=clock)
+        engine = AlertEngine(store, clock=clock, rules=(
+            AlertRule("failing", "fails_total", kind=RATE_OF_CHANGE,
+                      op=">", value=0.0, window_s=60.0, for_s=0.0),
+            AlertRule("gone", "heartbeat", kind=ABSENCE, for_s=0.0),
+        ))
+        engine.evaluate(now=0.0)
+        assert engine.active() == ["gone"]        # no heartbeat series yet
+        store.ingest("s", {**_counter_snap("fails_total", 0.0),
+                           **_gauge_snap("heartbeat", 1.0)}, now=0.0)
+        engine.evaluate(now=0.0)
+        assert engine.active() == []
+        store.ingest("s", {**_counter_snap("fails_total", 3.0),
+                           **_gauge_snap("heartbeat", 1.0)}, now=30.0)
+        engine.evaluate(now=30.0)
+        assert engine.active() == ["failing"]
+
+
+# ============================================================ slo.forget
+class TestSloForget:
+    def test_forget_retires_gauges_and_tombstones(self):
+        reg = MetricsRegistry()
+        clock = _Clock(0.0)
+        burn = SloBurn(reg, clock=clock, key_label="replica")
+        burn.record("r2", "gold", good=False)     # burn spikes way past 1
+        store = TimeSeriesStore(clock=clock)
+        store.ingest("router", reg.snapshot(), now=0.0)
+        assert store.latest("fleet_slo_burn_rate",
+                            labels={"replica": "r2", "window": "1m"}) != []
+
+        burn.forget("r2")
+        assert "fleet_slo_burn_rate" not in reg.snapshot()
+        assert burn.snapshot() == {}
+        # counters survive: history is their point
+        assert "fleet_slo_requests_total" in reg.snapshot()
+
+        store.ingest("router", reg.snapshot(), now=10.0)
+        assert store.latest("fleet_slo_burn_rate") == []
+        assert store.stats()["tombstoned"] >= 2   # 1m and 10m windows
+
+
+# ============================================================= forecast
+class TestForecaster:
+    def _seasonal_store(self, clock, days=3, day_s=240.0, step_s=4.0):
+        store = TimeSeriesStore(clock=clock, retention_points=10000,
+                                retention_s=1e9)
+        t = 0.0
+        import math
+        while t < days * day_s:
+            v = 1.0 + 0.8 * math.sin(2.0 * math.pi * t / day_s)
+            store.ingest("s", _gauge_snap("m", v), now=t)
+            t += step_s
+        return store
+
+    def test_seasonal_forecast_accurate_and_deterministic(self):
+        import math
+        day_s, step_s = 240.0, 4.0
+        clock = _Clock(0.0)
+        store = self._seasonal_store(clock, day_s=day_s, step_s=step_s)
+        fc = BurnForecaster(store, season_s=day_s,
+                            horizon_s=60.0).forecast("m")
+        assert fc is not None and fc.confidence > 0.8
+        last_t = 3 * day_s - step_s
+        true = 1.0 + 0.8 * math.sin(2.0 * math.pi * (last_t + 60.0) / day_s)
+        assert abs(fc.value - true) < 0.15
+        # same store state -> byte-identical forecast
+        store2 = self._seasonal_store(_Clock(0.0), day_s=day_s,
+                                      step_s=step_s)
+        fc2 = BurnForecaster(store2, season_s=day_s,
+                             horizon_s=60.0).forecast("m")
+        assert fc == fc2
+
+    def test_short_series_yields_none_not_a_number(self):
+        reg = MetricsRegistry()
+        store = TimeSeriesStore(clock=_Clock())
+        for t in (0.0, 1.0, 2.0):
+            store.ingest("s", _gauge_snap("m", 1.0), now=t)
+        fc = BurnForecaster(store, season_s=60.0, metrics=reg).forecast("m")
+        assert fc is None
+        [series] = reg.snapshot()["forecast_requests_total"]["series"]
+        assert series["labels"] == {"outcome": "insufficient"}
+
+    def test_forecast_burn_exports_gauges(self):
+        import math
+        reg = MetricsRegistry()
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(clock=clock, retention_points=10000)
+        for i in range(180):
+            t = i * 4.0
+            v = 1.0 + 0.8 * math.sin(2.0 * math.pi * t / 240.0)
+            store.ingest("r", _gauge_snap(
+                "fleet_slo_burn_rate", v,
+                {"slo_class": "gold", "window": "1m"}), now=t)
+        fc = BurnForecaster(store, season_s=240.0, horizon_s=30.0,
+                            metrics=reg).forecast_burn("gold")
+        assert fc is not None
+        snap = reg.snapshot()
+        assert snap["forecast_burn"]["series"][0]["value"] == fc.value
+        assert snap["forecast_confidence"]["series"][0]["value"] == \
+            fc.confidence
+
+
+# ======================================================= policy forecast
+class _FakeSlo:
+    def snapshot(self):
+        return {}
+
+
+class _FakeMembership:
+    def ids(self):
+        return []
+
+    def state(self, rid):
+        raise KeyError(rid)
+
+    def payload(self, rid):
+        raise KeyError(rid)
+
+
+class TestPolicyForecast:
+    def _policy(self, **kw):
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 4)
+        kw.setdefault("burn_out", {"gold": 1.0})
+        kw.setdefault("forecast_confidence", 0.6)
+        return AutoscalePolicy(**kw)
+
+    def _signals(self, clock):
+        return SignalReader(slo=_FakeSlo(), membership=_FakeMembership(),
+                            clock=clock)
+
+    def test_confident_breach_prespawns(self):
+        clock = _Clock(100.0)
+        policy = self._policy()
+        d = policy.decide(self._signals(clock), 1, 100.0,
+                          forecast={"gold": Forecast(30.0, 1.4, 0.9)})
+        assert (d.direction, d.reason) == (OUT, "forecast")
+        assert d.evidence["forecast_class"] == "gold"
+        assert d.evidence["forecast"]["gold"]["value"] == 1.4
+
+    def test_unconfident_or_subthreshold_does_not(self):
+        clock = _Clock(100.0)
+        policy = self._policy()
+        for fc in (Forecast(30.0, 1.4, 0.3),     # confident floor unmet
+                   Forecast(30.0, 0.8, 0.95),    # no predicted breach
+                   None):                        # forecaster abstained
+            d = policy.decide(self._signals(clock), 1, 100.0,
+                              forecast={"gold": fc})
+            assert (d.direction, d.reason) == (HOLD, "steady"), fc
+
+    def test_clamp_and_cooldown_gate_prespawn(self):
+        clock = _Clock(100.0)
+        policy = self._policy()
+        breach = {"gold": Forecast(30.0, 1.4, 0.9)}
+        d = policy.decide(self._signals(clock), 4, 100.0, forecast=breach)
+        assert (d.direction, d.reason) == (HOLD, "max_clamp")
+        assert d.evidence["trigger"] == "forecast"
+        out = policy.decide(self._signals(clock), 1, 100.0, forecast=breach)
+        policy.commit(out, 100.0)
+        d = policy.decide(self._signals(clock), 2, 105.0, forecast=breach)
+        assert (d.direction, d.reason) == (HOLD, "cooldown_out")
+
+    def test_none_forecast_is_byte_identical_legacy(self):
+        clock = _Clock(100.0)
+        with_kw = self._policy().decide(self._signals(clock), 1, 100.0,
+                                        forecast=None)
+        legacy = self._policy().decide(self._signals(clock), 1, 100.0)
+        assert json.dumps(with_kw.evidence, sort_keys=True) == \
+            json.dumps(legacy.evidence, sort_keys=True)
+        assert "forecast" not in with_kw.evidence
+
+
+# ============================================================ sim score
+class TestSimAlertPenalty:
+    def _outcomes(self, n=20):
+        return [Outcome(True, None, "standard", "m", "predict",
+                        0.01, None, None, 0) for _ in range(n)]
+
+    def test_alert_firings_penalize_score(self):
+        quiet = summarize("fp", self._outcomes(), mode="virtual")
+        paged = summarize("fp", self._outcomes(), mode="virtual",
+                          extra={"alerts": [
+                              {"rule": "gold_burn_high", "fired_at_s": 1.0,
+                               "resolved_at_s": 2.0}] * 2})
+        assert paged["alerts"] and len(paged["alerts"]) == 2
+        assert abs((quiet["score"] - paged["score"])
+                   - 0.05 * 2 / 4) < 1e-9
+        # the penalty saturates at 4 pages
+        flood = summarize("fp", self._outcomes(), mode="virtual",
+                          extra={"alerts": [{"rule": "r"}] * 50})
+        assert abs((quiet["score"] - flood["score"]) - 0.05) < 1e-9
+
+    def test_reports_without_alerts_key_unchanged(self):
+        report = summarize("fp", self._outcomes(), mode="virtual")
+        assert "alerts" not in report
+        assert score(report) == report["score"]
