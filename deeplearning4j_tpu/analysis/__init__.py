@@ -22,16 +22,21 @@ in ``deeplearning4j_tpu/analysis/README.md``.
 """
 
 from .callgraph import Program, build_program
+from .compilesurface import (check_budget, compute_surface, load_budget,
+                             render_report, site_bound)
 from .engine import (Finding, Rule, analyze_paths, analyze_source,
                      iter_py_files, render_json, render_text)
 from .locks import LockModel, get_lock_model
 from .rules import ALL_RULES, rules_by_name
 from .sarif import (fingerprints, load_baseline, new_findings, render_sarif,
                     to_sarif, write_baseline)
+from .shapes import Interp, function_shapes
 from .typeinfo import Types, get_types
 
 __all__ = ["Finding", "Rule", "ALL_RULES", "rules_by_name", "analyze_paths",
            "analyze_source", "iter_py_files", "render_json", "render_text",
            "Program", "build_program", "to_sarif", "render_sarif",
            "fingerprints", "write_baseline", "load_baseline", "new_findings",
-           "Types", "get_types", "LockModel", "get_lock_model"]
+           "Types", "get_types", "LockModel", "get_lock_model",
+           "Interp", "function_shapes", "compute_surface", "render_report",
+           "site_bound", "check_budget", "load_budget"]

@@ -9,6 +9,12 @@ run writes the current findings, later runs fail only on *new* ones),
 Exit status: 0 when clean (or no finding is new vs. the baseline), 1 when
 any new finding survives suppression, 2 on usage errors — so CI can gate on
 it directly (scripts/ci.sh).
+
+Compile-surface mode (v4): ``--compile-surface FILE`` skips the rule
+pass and instead writes the static executable-cardinality report (one
+entry per jit site, see :mod:`.compilesurface`) to FILE; with
+``--budget FILE`` the report is checked against the committed budget
+and any regression exits 1.
 """
 
 from __future__ import annotations
@@ -46,6 +52,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                          f"({', '.join(DEFAULT_EXCLUDES)})")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
+    ap.add_argument("--compile-surface", metavar="FILE",
+                    help="write the static compile-surface report "
+                         "(executable-cardinality bound per jit site) to "
+                         "FILE instead of running rules")
+    ap.add_argument("--budget", metavar="FILE",
+                    help="with --compile-surface: check the report "
+                         "against this committed budget; regressions "
+                         "exit 1")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -54,6 +68,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if not args.paths:
         ap.error("no paths given (try: deeplearning4j_tpu/)")
+
+    if args.budget and not args.compile_surface:
+        ap.error("--budget requires --compile-surface")
+    if args.compile_surface:
+        import json as _json
+
+        from .compilesurface import check_budget, load_budget, run
+
+        exclude = DEFAULT_EXCLUDES + args.exclude
+        report, _ = run(args.paths, exclude=exclude)
+        with open(args.compile_surface, "w", encoding="utf-8") as fh:
+            _json.dump(report, fh, indent=2)
+            fh.write("\n")
+        n = len(report["sites"])
+        print(f"jaxlint: compile surface — {n} jit site(s) "
+              f"-> {args.compile_surface}")
+        if args.budget:
+            try:
+                budget = load_budget(args.budget)
+            except (ValueError, OSError) as e:
+                ap.error(f"cannot read budget {args.budget}: {e}")
+            violations = check_budget(report, budget)
+            for v in violations:
+                print(f"compile-budget: {v}")
+            if violations:
+                print(f"{len(violations)} budget violation(s)")
+                return 1
+            print("compile budget: ok")
+        return 0
 
     rules = ALL_RULES
     if args.select:

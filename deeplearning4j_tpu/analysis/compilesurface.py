@@ -1,0 +1,532 @@
+"""Static compile-surface analysis: executable-cardinality bounds per
+jit site.
+
+The serving contract this tree documents dynamically
+(``serve_compile_misses_total``, the ``_sigs`` sets) is made checkable
+at lint time: enumerate every jit application in the program, find the
+call sites that feed each one, run the abstract shape interpreter
+(:mod:`.shapes`) over the calling functions, and classify every traced
+argument dimension by provenance. The product of the bounded factors is
+a *static executable-cardinality bound* for the site:
+
+- ``literal`` / ``config`` dims contribute 1 (fixed for a server
+  lifetime);
+- ``bucket`` dims contribute ``|table|`` — numeric when the table is a
+  source literal, symbolic (``|prompt_buckets|``) when the table is a
+  boot-time knob;
+- ``sym`` / ``top`` dims contribute ``?`` (statically unknown — *not*
+  proven unbounded, but not proven bounded either);
+- ``unbounded`` dims make the whole site ``unbounded`` — the
+  recompile-storm shape.
+
+Opaque arguments (weights pytrees, unannotated request objects) carry
+no visible dims; they are listed per call site for human review but
+excluded from the product — the budget file's ``why`` strings are where
+their invariance argument lives.
+
+``scripts/compile_budget.json`` commits the allowed bound per site; CI
+diffs the computed report against it (:func:`check_budget`) and fails
+on any regression: a new jit site without a budget entry, a new factor,
+a numeric bound above budget, or a bounded site going ``?``/unbounded.
+Tightening never fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import shapes as S
+from .callgraph import FuncInfo, ModuleInfo, Program, jit_call_kwargs
+
+_SURFACE_CACHE = "compilesurface:model"
+
+
+# ------------------------------------------------------------- model
+
+class CallSite:
+    """One resolved call into a jit site, with classified arguments."""
+
+    def __init__(self, mi: ModuleInfo, call: ast.Call, caller: Optional[FuncInfo]):
+        self.mi = mi
+        self.call = call
+        self.caller = caller
+        self.args: List[dict] = []       # per-arg report rows
+        self.factors: Dict[str, Optional[int]] = {}
+        self.unbounded_traced: List[str] = []  # unbounded traced dims
+        self.unbounded_static: List[str] = []  # unbounded static_argnums values
+        self.unknown = False             # any ?-classified dim
+
+    @property
+    def unbounded(self) -> List[str]:
+        return self.unbounded_traced + self.unbounded_static
+
+    @property
+    def path(self) -> str:
+        return self.mi.path
+
+    @property
+    def line(self) -> int:
+        return self.call.lineno
+
+
+class JitSite:
+    """One jit application: the wrapped function (when resolvable), its
+    caller-visible binding names, and static/donated positions."""
+
+    def __init__(self, mi: ModuleInfo, fi: Optional[FuncInfo],
+                 expr: ast.AST, line: int):
+        self.mi = mi
+        self.fi = fi
+        self.expr = expr
+        self.line = line
+        self.bindings: Set[str] = set()   # "name" / "Cls.attr"
+        self.static_idx: Set[int] = set()
+        self.static_names: Set[str] = set()
+        self.donate_idx: Set[int] = set()
+        self.callsites: List[CallSite] = []
+
+    @property
+    def site_id(self) -> str:
+        name = self.fi.qual if self.fi is not None else \
+            (sorted(self.bindings)[0] if self.bindings else f"L{self.line}")
+        return f"{self.mi.module}:{name}"
+
+    def param_name(self, i: int) -> str:
+        if self.fi is not None and i < len(self.fi.params):
+            return self.fi.params[i]
+        return f"arg{i}"
+
+    def is_static(self, i: int, name: str) -> bool:
+        return i in self.static_idx or name in self.static_names
+
+
+def _static_spec(expr: ast.AST, resolve) -> Tuple[Set[int], Set[str]]:
+    """Literal static_argnums/static_argnames on a jit transform expr."""
+    idx: Set[int] = set()
+    names: Set[str] = set()
+    if not isinstance(expr, ast.Call):
+        return idx, names
+    for k in expr.keywords:
+        v = k.value
+        if k.arg == "static_argnums":
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                idx.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                idx.update(e.value for e in v.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, int))
+        elif k.arg == "static_argnames":
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names.update(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str))
+    return idx, names
+
+
+def _enclosing_assign(mi: ModuleInfo, node: ast.AST) -> Optional[ast.Assign]:
+    cur = mi.parents.get(node)
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = mi.parents.get(cur)
+    return cur if isinstance(cur, ast.Assign) else None
+
+
+def _binding_of_target(mi: ModuleInfo, t: ast.AST,
+                       node: ast.AST) -> Optional[str]:
+    if isinstance(t, ast.Name):
+        return t.id
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+            and t.value.id == "self":
+        cls = mi.enclosing_class(node)
+        if cls:
+            return f"{cls}.{t.attr}"
+    return None
+
+
+def _chase_local_aliases(mi: ModuleInfo, site: JitSite,
+                         around: ast.AST) -> None:
+    """Within the function enclosing a jit application, follow
+    ``other = name`` / ``self.attr = name`` rebinds of the jitted
+    callable (the ``forward = fwd; self._fwd = forward`` idiom)."""
+    fn = mi.enclosing_function(around)
+    if fn is None:
+        return
+    local = {b for b in site.bindings if "." not in b}
+    if site.fi is not None:
+        local.add(site.fi.name)
+    for _ in range(2):
+        grew = False
+        for stmt in ast.walk(fn):
+            if not (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Name)
+                    and stmt.value.id in local):
+                continue
+            for t in stmt.targets:
+                b = _binding_of_target(mi, t, stmt)
+                if b and b not in site.bindings:
+                    site.bindings.add(b)
+                    if "." not in b:
+                        local.add(b)
+                    grew = True
+        if not grew:
+            break
+
+
+def _collect_sites(program: Program) -> List[JitSite]:
+    sites: List[JitSite] = []
+    seen: Set[Tuple[int, int]] = set()
+    for mi in program.modules.values():
+        resolve = mi.imports.resolve
+        for fi, expr in mi.jit_applications:
+            key = (id(mi), id(expr))
+            if key in seen:
+                continue
+            seen.add(key)
+            site = JitSite(mi, fi, expr, getattr(expr, "lineno", fi.node.lineno))
+            site.static_idx, site.static_names = _static_spec(expr, resolve)
+            site.donate_idx = set(fi.donated_idx)
+            # decorator application: callers use the def's own names
+            site.bindings.add(fi.qual)
+            site.bindings.add(fi.name)
+            # wrap application: the assignment target is the binding
+            assign = _enclosing_assign(mi, expr)
+            if assign is not None:
+                for t in assign.targets:
+                    b = _binding_of_target(mi, t, assign)
+                    if b:
+                        site.bindings.add(b)
+            _chase_local_aliases(mi, site, fi.node)
+            sites.append(site)
+        # jit wraps whose operand is not a bare local Name (lambdas,
+        # attribute chains) never reach jit_applications; surface them
+        # as unresolved sites so the budget file still has to name them
+        for node in ast.walk(mi.tree):
+            if not (isinstance(node, ast.Call)
+                    and jit_call_kwargs(node, resolve) is not None
+                    and node.args):
+                continue
+            if isinstance(node.args[0], ast.Name) and \
+                    mi.local_funcs.get(node.args[0].id) is not None:
+                continue  # already a jit_application
+            key = (id(mi), id(node))
+            if key in seen:
+                continue
+            seen.add(key)
+            site = JitSite(mi, None, node, node.lineno)
+            site.static_idx, site.static_names = _static_spec(node, resolve)
+            assign = _enclosing_assign(mi, node)
+            if assign is not None:
+                for t in assign.targets:
+                    b = _binding_of_target(mi, t, assign)
+                    if b:
+                        site.bindings.add(b)
+            _chase_local_aliases(mi, site, node)
+            sites.append(site)
+    return sites
+
+
+# --------------------------------------------------- argument classify
+
+def _leaf_dims(av: S.AV) -> Tuple[Optional[List[S.Dim]], str]:
+    """(dims, kind) for one traced argument. kind: array|scalar|tuple|
+    opaque. Tuples recurse (pytree leaves concatenated)."""
+    if isinstance(av, S.ArrayVal):
+        return list(av.shape), "array"
+    if isinstance(av, S.ScalarVal):
+        return [], "scalar"
+    if isinstance(av, S.TupleVal):
+        dims: List[S.Dim] = []
+        for it in av.items:
+            d, k = _leaf_dims(it)
+            if d is None:
+                return None, "opaque"
+            dims.extend(d)
+        return dims, "tuple"
+    return None, "opaque"
+
+
+def _value_dim(av: S.AV) -> S.Dim:
+    """Value-cardinality provenance for a static_argnums position."""
+    if isinstance(av, S.ScalarVal):
+        return av.dim
+    if isinstance(av, S.ParamVal):
+        return S.config_dim(av.name) if av.config else S.sym_dim(av.name)
+    return S.top_dim()
+
+
+def _classify_callsite(program: Program, site: JitSite,
+                       cs: CallSite) -> None:
+    interp = S.Interp.get(program)
+    if cs.caller is not None:
+        fs = interp.function_shapes(cs.caller)
+    else:
+        fs = None
+
+    def av_of(node: ast.AST) -> S.AV:
+        return fs.at(node) if fs is not None else S.OPAQUE
+
+    for i, a in enumerate(cs.call.args):
+        if isinstance(a, ast.Starred):
+            break
+        pname = site.param_name(i)
+        av = av_of(a)
+        if site.is_static(i, pname):
+            d = _value_dim(av)
+            row = {"param": pname, "kind": "static",
+                   "value": d.render()}
+            cs.args.append(row)
+            if d.kind == S.UNBOUNDED:
+                cs.unbounded_static.append(f"{pname}={d.render()}")
+            elif d.kind == S.BUCKET:
+                cs.factors.setdefault(f"|{d.table}|", d.size)
+            elif d.kind in (S.SYM, S.TOP):
+                cs.unknown = True
+            continue
+        dims, kind = _leaf_dims(av)
+        row = {"param": pname, "kind": kind}
+        if dims is not None:
+            row["shape"] = [d.render() for d in dims]
+            if isinstance(av, S.ArrayVal):
+                row["dtype"] = av.dtype
+            for d in dims:
+                if d.kind == S.UNBOUNDED:
+                    cs.unbounded_traced.append(f"{pname}:{d.render()}")
+                elif d.kind == S.BUCKET:
+                    key = f"|{d.table}|"
+                    prev = cs.factors.get(key)
+                    cs.factors[key] = d.size if prev is None else prev
+                elif d.kind in (S.SYM, S.TOP):
+                    cs.unknown = True
+        if isinstance(av, S.ScalarVal):
+            row["weak"] = bool(av.weak)
+            row["value"] = av.dim.render()
+            row["dtype"] = av.dtype
+        cs.args.append(row)
+    for k in cs.call.keywords:
+        if k.arg is None:
+            continue
+        av = av_of(k.value)
+        dims, kind = _leaf_dims(av)
+        row = {"param": k.arg, "kind": kind}
+        if dims is not None:
+            row["shape"] = [d.render() for d in dims]
+            for d in dims:
+                if d.kind == S.UNBOUNDED:
+                    cs.unbounded_traced.append(f"{k.arg}:{d.render()}")
+                elif d.kind == S.BUCKET:
+                    cs.factors.setdefault(f"|{d.table}|", d.size)
+                elif d.kind in (S.SYM, S.TOP):
+                    cs.unknown = True
+        cs.args.append(row)
+
+
+def _find_callsites(program: Program, sites: List[JitSite]) -> None:
+    # A binding name can carry several jit sites (`self._decode` is
+    # rebound to the paged or dense executable depending on the boot
+    # path) — a call through that name must count against every site
+    # sharing it, so each site's bound covers the shapes it could see.
+    by_name: Dict[Tuple[int, str], List[JitSite]] = {}
+    by_fi: Dict[int, JitSite] = {}
+    for site in sites:
+        for b in site.bindings:
+            by_name.setdefault((id(site.mi), b), []).append(site)
+        if site.fi is not None:
+            by_fi[id(site.fi)] = site
+    for mi in program.modules.values():
+        interp = S.Interp.get(program)
+        node2fi = interp.node_to_fi(mi)
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hits: List[JitSite] = []
+            f = node.func
+            if isinstance(f, ast.Name):
+                hits = list(by_name.get((id(mi), f.id), ()))
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self":
+                cls = mi.enclosing_class(node)
+                if cls:
+                    hits = list(by_name.get((id(mi), f"{cls}.{f.attr}"), ()))
+            if not hits:
+                callee = program.resolve_call(mi, f, mi.enclosing_class(node))
+                if callee is not None and id(callee) in by_fi:
+                    hits = [by_fi[id(callee)]]
+            for site in hits:
+                # the jit application itself is not a call *into* the site
+                if node is site.expr or (isinstance(site.expr, ast.Call)
+                                         and node in ast.walk(site.expr)):
+                    continue
+                enc = mi.enclosing_function(node)
+                caller = node2fi.get(id(enc)) if enc is not None else None
+                if site.fi is not None and enc is site.fi.node:
+                    continue  # recursive self-reference, not a dispatch
+                cs = CallSite(mi, node, caller)
+                _classify_callsite(program, site, cs)
+                site.callsites.append(cs)
+
+
+def compute_surface(program: Program) -> List[JitSite]:
+    """All jit sites with classified call sites (memoized per program)."""
+    sites = program.cache.get(_SURFACE_CACHE)
+    if sites is None:
+        sites = _collect_sites(program)
+        _find_callsites(program, sites)
+        program.cache[_SURFACE_CACHE] = sites
+    return sites
+
+
+# ------------------------------------------------------------- bounds
+
+def site_bound(site: JitSite) -> Tuple[str, Optional[int], Dict[str, Optional[int]]]:
+    """(canonical bound string, numeric bound or None, factors).
+
+    Bound string grammar: ``"1"``, ``"|a|*|b|"`` (sorted factors, with
+    ``?`` appended when some dim is statically unknown), ``"unbounded"``,
+    bare ``"?"``, or ``"no-callsites"``.
+    """
+    if not site.callsites:
+        return "no-callsites", None, {}
+    factors: Dict[str, Optional[int]] = {}
+    unknown = False
+    for cs in site.callsites:
+        if cs.unbounded:
+            return "unbounded", None, {}
+        unknown = unknown or cs.unknown
+        for k, v in cs.factors.items():
+            prev = factors.get(k)
+            factors[k] = v if prev is None else prev
+    parts = sorted(factors)
+    if unknown:
+        parts.append("?")
+    if not parts:
+        return "1", 1, factors
+    numeric: Optional[int] = 1
+    for k in sorted(factors):
+        v = factors[k]
+        numeric = None if (v is None or numeric is None) else numeric * v
+    if unknown:
+        numeric = None
+    return "*".join(parts), numeric, factors
+
+
+def render_report(program: Program, sites: Sequence[JitSite]) -> dict:
+    out_sites = []
+    for site in sorted(sites, key=lambda s: s.site_id):
+        bound, numeric, factors = site_bound(site)
+        row = {
+            "site": site.site_id,
+            "path": site.mi.path,
+            "line": site.line,
+            "bindings": sorted(site.bindings),
+            "bound": bound,
+            "numeric": numeric,
+        }
+        if site.static_idx or site.static_names:
+            row["static"] = sorted(
+                [str(i) for i in site.static_idx]
+                + sorted(site.static_names))
+        if site.donate_idx:
+            row["donate_argnums"] = sorted(site.donate_idx)
+        row["callsites"] = [
+            {"path": cs.path, "line": cs.line,
+             "caller": (cs.caller.qual if cs.caller is not None else None),
+             "args": cs.args,
+             **({"unbounded": cs.unbounded} if cs.unbounded else {})}
+            for cs in site.callsites]
+        out_sites.append(row)
+    return {"version": 1, "tool": "jaxlint-compile-surface",
+            "sites": out_sites}
+
+
+# ------------------------------------------------------------- budget
+
+def _parse_bound(s: str) -> Tuple[bool, bool, Set[str], Optional[int]]:
+    """bound string -> (unbounded, unknown, symbolic factors, numeric)."""
+    s = (s or "").strip()
+    if s == "unbounded":
+        return True, False, set(), None
+    if s in ("?", "no-callsites"):
+        return False, True, set(), None
+    factors: Set[str] = set()
+    unknown = False
+    numeric: Optional[int] = 1
+    for part in s.split("*"):
+        part = part.strip()
+        if not part:
+            continue
+        if part == "?":
+            unknown = True
+            numeric = None
+        elif part.isdigit():
+            numeric = None if numeric is None else numeric * int(part)
+        else:
+            factors.add(part)
+            numeric = None
+    return False, unknown, factors, numeric
+
+
+def check_budget(report: dict, budget: dict) -> List[str]:
+    """Violations of the committed budget; empty means the gate passes.
+
+    A site regresses when it goes unbounded, introduces a ``?`` or a
+    symbolic factor the budget does not allow, or exceeds a numeric
+    budget (``max``). Tightening is always allowed. New sites must be
+    added to the budget (with a ``why``) before CI passes.
+    """
+    allowed: Dict[str, dict] = budget.get("sites", {})
+    out: List[str] = []
+    for row in report.get("sites", []):
+        site = row["site"]
+        entry = allowed.get(site)
+        if entry is None:
+            out.append(f"{site}: new jit site with no budget entry "
+                       f"(bound {row['bound']}) — add it to the budget "
+                       "with a why:")
+            continue
+        b_unb, b_unk, b_factors, b_num = _parse_bound(
+            entry.get("bound", ""))
+        c_unb, c_unk, c_factors, c_num = _parse_bound(row["bound"])
+        if c_unb and not b_unb:
+            out.append(f"{site}: computed bound is unbounded, budget "
+                       f"allows {entry.get('bound')!r}")
+            continue
+        if b_unb:
+            continue
+        if c_unk and not (b_unk or b_unb):
+            out.append(f"{site}: computed bound {row['bound']!r} has "
+                       f"statically-unknown factors, budget allows "
+                       f"{entry.get('bound')!r}")
+            continue
+        extra = c_factors - b_factors
+        if extra and not b_unk:
+            out.append(f"{site}: computed bound {row['bound']!r} "
+                       f"introduces factor(s) {sorted(extra)} beyond "
+                       f"budget {entry.get('bound')!r}")
+            continue
+        max_n = entry.get("max")
+        if max_n is not None and row.get("numeric") is not None \
+                and row["numeric"] > max_n:
+            out.append(f"{site}: numeric bound {row['numeric']} exceeds "
+                       f"budget max {max_n}")
+    return out
+
+
+def run(paths: Sequence[str], exclude: Sequence[str] = ()) -> Tuple[dict, Program]:
+    """Analyze ``paths`` and return (report dict, program)."""
+    from .engine import read_sources
+
+    sources = read_sources(paths, exclude)
+    program = Program(sources)
+    sites = compute_surface(program)
+    return render_report(program, sites), program
+
+
+def load_budget(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "sites" not in data:
+        raise ValueError("budget file must be {'sites': {...}}")
+    return data

@@ -923,3 +923,6 @@ class MetricLabelCardinalityRule(Rule):
 # v3 concurrency & resource-discipline family registers itself on import.
 # Imported last: it needs `register` and must not win name clashes above.
 from . import rules_concurrency  # noqa: E402,F401  (registration side effect)
+
+# v4 shape/dtype interpreter & compile-surface family, same contract.
+from . import rules_shapes  # noqa: E402,F401  (registration side effect)

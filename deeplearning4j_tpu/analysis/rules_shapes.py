@@ -1,0 +1,246 @@
+"""jaxlint v4 rules — shape/dtype interpreter + compile-surface family.
+
+These rules ride the abstract interpreter (:mod:`.shapes`) and the
+compile-surface model (:mod:`.compilesurface`). All of them are
+*provable-only*: they fire when the interpreter can prove the hazard
+from literals, config knobs, bucket tables, and request-payload
+provenance — never on mere uncertainty — so the serving tree stays at
+zero findings with no baseline.
+
+Why these patterns hurt on TPU: every distinct traced signature is a
+full XLA compile (seconds to minutes) and a new executable in HBM. A
+dimension that tracks request payload turns the compile cache into an
+unbounded leak and the p99 into a compile queue; a Python scalar whose
+weak dtype flips between calls silently doubles the executable set; a
+donated buffer whose shape drifts between calls aliases freed memory.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Tuple
+
+from . import compilesurface as CS
+from . import shapes as S
+from .engine import FileContext, Finding, Rule
+from .rules import register
+
+_FLOATS = ("float", "f16", "bf16", "f32", "f64")
+_INTS = ("int", "i8", "i16", "i32", "i64", "u8", "u16", "u32", "u64")
+
+
+def _dt_kind(dt: str) -> str:
+    if dt in _FLOATS:
+        return "float"
+    if dt in _INTS:
+        return "int"
+    return "?"
+
+
+def _fis_in_file(ctx: FileContext):
+    """Every FuncInfo defined in this file, deduped."""
+    seen = set()
+    for fi in ctx.module_info.functions.values():
+        if id(fi) not in seen:
+            seen.add(id(fi))
+            yield fi
+
+
+def _surface(ctx: FileContext) -> List[CS.JitSite]:
+    return CS.compute_surface(ctx.program)
+
+
+@register
+class ShapeMismatchRule(Rule):
+    """Provable shape errors at jnp call sites.
+
+    A broadcast of two literal dims that are unequal (and neither 1), a
+    matmul whose contraction dims provably differ, or a concatenate
+    whose non-concat dims provably differ will raise at trace time — in
+    serving, that trace happens on the first unlucky request, inside
+    the tick thread, long after CI went green. The interpreter proves
+    these from literal shapes and reports the inferred operand shapes.
+    """
+
+    name = "shape-mismatch"
+    description = ("provable broadcast/matmul/concat shape error, with "
+                   "the inferred shapes in the message")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fi in _fis_in_file(ctx):
+            fs = S.function_shapes(ctx.program, fi)
+            for node, kind, msg in fs.issues:
+                yield self.finding(ctx, node, f"{msg} (in {fi.qual})")
+
+
+@register
+class UnboundedCompileSignatureRule(Rule):
+    """Request-derived dimension reaches a jit boundary.
+
+    A traced argument whose dim provably tracks request payload —
+    ``len()`` of a runtime list, a ``json.loads``/``os.environ`` read,
+    boolean-mask indexing — keys a fresh XLA compile per distinct
+    value: the recompile storm the bucket tables exist to prevent. The
+    fix is to pad to a bucket (``engine.py``/``continuous.py`` idiom)
+    before the jit call, or teach the interpreter the bound with a
+    ``# jaxlint: dim=`` annotation when the bucketing is real but
+    invisible.
+    """
+
+    name = "unbounded-compile-signature"
+    description = ("traced argument reaches a jit call with a "
+                   "request-derived (unbounded) dimension")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for site in _surface(ctx):
+            for cs in site.callsites:
+                if cs.mi is not ctx.module_info or not cs.unbounded_traced:
+                    continue
+                dims = ", ".join(cs.unbounded_traced)
+                yield self.finding(
+                    ctx, cs.call,
+                    f"call into jit site {site.site_id} traces "
+                    f"request-derived dimension(s): {dims} — every "
+                    "distinct value compiles a new executable; pad to a "
+                    "bucket table first")
+
+
+@register
+class StaticArgnumUnboundedRule(Rule):
+    """static_argnums fed a request-derived value.
+
+    ``static_argnums`` keys the compile cache on the argument's
+    *value*, not its shape — feeding it anything request-derived is an
+    unbounded executable set with no padding escape at all. Static
+    arguments must come from config knobs or bucket tables.
+    """
+
+    name = "static-argnum-unbounded"
+    description = ("static_argnums position fed a request-derived value "
+                   "— each distinct value is a silent recompile")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for site in _surface(ctx):
+            for cs in site.callsites:
+                if cs.mi is not ctx.module_info or not cs.unbounded_static:
+                    continue
+                vals = ", ".join(cs.unbounded_static)
+                yield self.finding(
+                    ctx, cs.call,
+                    f"jit site {site.site_id} keys its compile cache on "
+                    f"the VALUE of static argument(s) {vals}; route the "
+                    "value through a config knob or bucket table")
+
+
+@register
+class WeakTypePromotionRule(Rule):
+    """Python-scalar weak-type mixing that flips a traced dtype.
+
+    Python scalars trace as weak-typed 0-d arrays: the signature keys
+    on dtype, not value, so a scalar that is sometimes ``int`` and
+    sometimes ``float`` (or whose dtype follows the request payload)
+    silently doubles the executable set and can flip downstream
+    promotion from f32 to f64. Cast at the boundary
+    (``np.float32(x)``) so the traced dtype is pinned.
+    """
+
+    name = "weak-type-promotion"
+    description = ("weak Python scalar whose dtype can flip between jit "
+                   "calls (int vs float, or payload-derived)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for site in _surface(ctx):
+            # (a) payload-derived weak scalar: dtype follows the request
+            for cs in site.callsites:
+                if cs.mi is not ctx.module_info:
+                    continue
+                for row in cs.args:
+                    if row.get("kind") == "scalar" and row.get("weak") \
+                            and str(row.get("value", "")).startswith("unbounded"):
+                        yield self.finding(
+                            ctx, cs.call,
+                            f"weak scalar {row['param']} passed to jit "
+                            f"site {site.site_id} is request-derived "
+                            f"({row['value']}): its traced dtype follows "
+                            "the payload — pin it with an explicit "
+                            "np.int32/np.float32 cast")
+            # (b) the same param is weak-int at one call site and
+            # weak-float at another: two executables where one was meant
+            kinds: Dict[str, List[Tuple[str, CS.CallSite]]] = {}
+            for cs in site.callsites:
+                for row in cs.args:
+                    if row.get("kind") == "scalar" and row.get("weak"):
+                        k = _dt_kind(str(row.get("dtype", "?")))
+                        if k != "?":
+                            kinds.setdefault(row["param"], []).append((k, cs))
+            for param, seen in kinds.items():
+                if len({k for k, _ in seen}) < 2:
+                    continue
+                for k, cs in seen:
+                    if cs.mi is ctx.module_info:
+                        yield self.finding(
+                            ctx, cs.call,
+                            f"weak scalar {param} of jit site "
+                            f"{site.site_id} is traced as {k} here but as "
+                            "a different scalar kind at another call site "
+                            "— the dtype flip keys a second executable; "
+                            "pin the dtype at every call site")
+                        break
+
+
+@register
+class DonatedShapeDriftRule(Rule):
+    """Donated buffer whose shape is not call-invariant.
+
+    ``donate_argnums`` lets XLA reuse the argument's buffer for the
+    output — sound only while every call donates the same shape. A
+    donated arg with a request-derived dim, or donated with two
+    provably different literal shapes from different call sites, is the
+    exact setup for aliasing a freed buffer (and for a recompile that
+    silently un-donates). Donated buffers must be boot-sized.
+    """
+
+    name = "donated-shape-drift"
+    description = ("donate_argnums argument whose shape provably varies "
+                   "across calls (or tracks request payload)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for site in _surface(ctx):
+            if not site.donate_idx:
+                continue
+            for p in sorted(site.donate_idx):
+                pname = site.param_name(p)
+                lits: List[Tuple[Tuple[str, ...], CS.CallSite]] = []
+                for cs in site.callsites:
+                    row = cs.args[p] if p < len(cs.args) else None
+                    if row is None or row.get("param") != pname:
+                        continue
+                    shape = row.get("shape")
+                    if shape is None:
+                        continue
+                    if any(d.startswith("unbounded") for d in shape):
+                        if cs.mi is ctx.module_info:
+                            yield self.finding(
+                                ctx, cs.call,
+                                f"donated argument {pname} of jit site "
+                                f"{site.site_id} has request-derived "
+                                f"shape ({', '.join(shape)}) — donation "
+                                "requires a call-invariant, boot-sized "
+                                "buffer")
+                        continue
+                    if all(d.isdigit() for d in shape):
+                        lits.append((tuple(shape), cs))
+                distinct = {sh for sh, _ in lits}
+                if len(distinct) > 1:
+                    for sh, cs in lits:
+                        if cs.mi is ctx.module_info:
+                            yield self.finding(
+                                ctx, cs.call,
+                                f"donated argument {pname} of jit site "
+                                f"{site.site_id} is donated with shape "
+                                f"({', '.join(sh)}) here but other call "
+                                f"sites donate "
+                                f"{sorted('(%s)' % ', '.join(s) for s in distinct - {sh})}"
+                                " — shape drift across donations aliases "
+                                "a freed buffer")
+                            break

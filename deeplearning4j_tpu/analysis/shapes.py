@@ -1,0 +1,1682 @@
+"""Abstract shape/dtype interpretation for jaxlint v4.
+
+The serving stack's compile-cardinality contract ("ONE decode executable
+for the server lifetime", prefill bounded by the bucket tables) is a
+statement about *shapes*: a jit site recompiles exactly when a traced
+argument's shape/dtype signature changes. This module gives the linter
+eyes for that — a flow-sensitive abstract interpreter over a small
+shape/dtype lattice, pure stdlib ``ast`` like everything else in
+``analysis/`` (it never imports jax or numpy).
+
+Every dimension carries a *provenance* classification, because what the
+compile-surface analysis needs is not the number but where it came from:
+
+- ``literal`` — a source-literal int (``np.zeros((1, 8))``);
+- ``config`` — a constructor knob / ``self.`` attribute fixed at boot
+  (``self.slots``), cardinality 1 over a server lifetime;
+- ``bucket`` — drawn from a bucket table (``self.prompt_buckets``) via
+  the tree's bucketing idioms (``next((b for b in T if b >= n), T[-1])``,
+  ``for b in T: ... return b``, ``T[i]``) — cardinality ``|T|``;
+- ``sym`` — inherited from the enclosing function's inputs (a parameter
+  value or ``x.shape[i]``), the normal shape-polymorphic jit contract;
+- ``unbounded`` — provably request/runtime-derived: ``len()`` of a
+  runtime list, a read from ``os.environ``/``json.loads`` payloads;
+- ``top`` — unknown, which is *not* the same as unbounded: rules only
+  fire on provable facts, the compile-surface report renders it ``?``.
+
+Interprocedural pieces ride the v2 :class:`~.callgraph.Program`: calls
+to resolvable functions are summarized by evaluating the callee's body
+with the caller's abstract arguments (depth-limited, cycle-guarded), and
+``self.X`` reads go through a per-class attribute model built by
+abstract-executing ``__init__`` with constructor parameters bound as
+``config``.
+
+Where the interpreter needs help (heap-carried values like a prefill
+job's chunk plan), a *teaching annotation* on the binding line or the
+line above pins a name::
+
+    off, true_len, bucket = job.chunks[job.idx]  # jaxlint: dim=bucket:bucket(_chunk_buckets)
+    # jaxlint: shape=x:(bucket(batch_buckets), config)
+    x = np.concatenate([r.x for r in live])
+
+``dim=`` binds a host scalar's provenance; ``shape=`` binds a full array
+shape. Dim tokens: an int literal, ``?``, ``config``/``config(name)``,
+``bucket(table)``, ``sym(name)``, ``unbounded``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .dataflow import ForwardScan, assign_names
+
+# ---------------------------------------------------------------- dims
+
+LITERAL = "literal"
+CONFIG = "config"
+BUCKET = "bucket"
+SYM = "sym"
+UNBOUNDED = "unbounded"
+TOP = "top"
+
+#: lattice severity used by joins (higher = less known / worse)
+_SEV = {LITERAL: 0, CONFIG: 1, BUCKET: 2, SYM: 3, TOP: 4, UNBOUNDED: 5}
+
+
+class Dim:
+    """One abstract dimension: a kind plus provenance payload."""
+
+    __slots__ = ("kind", "value", "name", "table", "size", "origin")
+
+    def __init__(self, kind: str, value: Optional[int] = None, name: str = "",
+                 table: Optional[str] = None, size: Optional[int] = None,
+                 origin: str = ""):
+        self.kind = kind
+        self.value = value          # literal extent
+        self.name = name            # display / provenance ("self.slots")
+        self.table = table          # bucket table attr ("prompt_buckets")
+        self.size = size            # |table| when statically known
+        self.origin = origin        # dedup key for cardinality products
+
+    def render(self) -> str:
+        if self.kind == LITERAL:
+            return str(self.value)
+        if self.kind == CONFIG:
+            return f"config({self.name})" if self.name else "config"
+        if self.kind == BUCKET:
+            return f"bucket({self.table})"
+        if self.kind == SYM:
+            return f"sym({self.name})" if self.name else "sym"
+        if self.kind == UNBOUNDED:
+            return f"unbounded({self.name})" if self.name else "unbounded"
+        return "?"
+
+    def same(self, other: "Dim") -> bool:
+        return (self.kind == other.kind and self.value == other.value
+                and self.name == other.name and self.table == other.table)
+
+    def __repr__(self):
+        return f"<Dim {self.render()}>"
+
+
+def lit(n: int) -> Dim:
+    return Dim(LITERAL, value=int(n))
+
+
+def config_dim(name: str = "") -> Dim:
+    return Dim(CONFIG, name=name, origin=name)
+
+
+def bucket_dim(table: str, size: Optional[int] = None,
+               origin: str = "") -> Dim:
+    return Dim(BUCKET, table=table, size=size, origin=origin or table)
+
+
+def sym_dim(name: str = "") -> Dim:
+    return Dim(SYM, name=name, origin=name)
+
+
+def unbounded_dim(name: str = "") -> Dim:
+    return Dim(UNBOUNDED, name=name, origin=name)
+
+
+def top_dim() -> Dim:
+    return Dim(TOP)
+
+
+def join_dims(a: Dim, b: Dim) -> Dim:
+    if a.same(b):
+        return a
+    if UNBOUNDED in (a.kind, b.kind):
+        which = a if a.kind == UNBOUNDED else b
+        return unbounded_dim(which.name)
+    if a.kind == b.kind:
+        if a.kind == BUCKET and a.table == b.table:
+            return a
+        if a.kind == SYM and a.name == b.name:
+            return a
+    return top_dim()
+
+
+def render_shape(dims: Sequence[Dim]) -> str:
+    return "(" + ", ".join(d.render() for d in dims) + ")"
+
+
+# ------------------------------------------------------------- dtypes
+
+_DTYPE_CANON = {
+    "float32": "f32", "float64": "f64", "float16": "f16",
+    "bfloat16": "bf16", "int32": "i32", "int64": "i64", "int16": "i16",
+    "int8": "i8", "uint8": "u8", "uint32": "u32", "bool_": "bool",
+    "bool": "bool", "float": "f64", "int": "i64", "complex64": "c64",
+}
+
+#: dtype kind + width for promotion ("?" stays "?")
+_DT_KIND = {"bool": ("b", 1), "i8": ("i", 8), "u8": ("i", 8),
+            "i16": ("i", 16), "u32": ("i", 32), "i32": ("i", 32),
+            "i64": ("i", 64), "f16": ("f", 16), "bf16": ("f", 16),
+            "f32": ("f", 32), "f64": ("f", 64), "c64": ("c", 64),
+            "int": ("i", 0), "float": ("f", 0)}
+
+
+def canon_dtype(name: Optional[str]) -> str:
+    if not name:
+        return "?"
+    return _DTYPE_CANON.get(name.rsplit(".", 1)[-1], "?")
+
+
+def promote_dtypes(a: str, b: str, b_weak: bool = False) -> str:
+    """Rough model of jax promotion; weak (python-scalar) operands never
+    promote a strong operand's kind width, matching weak-type semantics."""
+    if a == "?" or b == "?":
+        return "?"
+    if a == b:
+        return a
+    ka, kb = _DT_KIND.get(a), _DT_KIND.get(b)
+    if ka is None or kb is None:
+        return "?"
+    if b_weak:
+        if kb[0] == "f" and ka[0] in ("b", "i"):
+            return "f32"
+        return a
+    order = {"b": 0, "i": 1, "f": 2, "c": 3}
+    if order[ka[0]] != order[kb[0]]:
+        hi = a if order[ka[0]] > order[kb[0]] else b
+        if _DT_KIND[hi][0] == "f" and _DT_KIND[hi][1] == 0:
+            return "f32"
+        return hi
+    return a if ka[1] >= kb[1] else b
+
+
+# ----------------------------------------------------- abstract values
+
+class AV:
+    """Base abstract value."""
+
+
+class OpaqueVal(AV):
+    __slots__ = ("why",)
+
+    def __init__(self, why: str = ""):
+        self.why = why
+
+    def __repr__(self):
+        return f"<Opaque {self.why}>" if self.why else "<Opaque>"
+
+
+OPAQUE = OpaqueVal()
+
+
+class ArrayVal(AV):
+    __slots__ = ("shape", "dtype", "weak")
+
+    def __init__(self, shape: Sequence[Dim], dtype: str = "?",
+                 weak: bool = False):
+        self.shape: Tuple[Dim, ...] = tuple(shape)
+        self.dtype = dtype
+        self.weak = weak
+
+    def __repr__(self):
+        return f"<Array {render_shape(self.shape)} {self.dtype}>"
+
+
+class ScalarVal(AV):
+    """A host Python number; ``dim`` is its provenance when used as an
+    extent, ``weak`` means a bare Python scalar (weak-typed under jit)."""
+
+    __slots__ = ("dim", "dtype", "weak")
+
+    def __init__(self, dim: Dim, dtype: str = "int", weak: bool = True):
+        self.dim = dim
+        self.dtype = dtype
+        self.weak = weak
+
+    def __repr__(self):
+        return f"<Scalar {self.dim.render()} {self.dtype}>"
+
+
+class TupleVal(AV):
+    __slots__ = ("items",)
+
+    def __init__(self, items: Sequence[AV]):
+        self.items: Tuple[AV, ...] = tuple(items)
+
+
+class ListVal(AV):
+    """Homogeneous runtime list: element value + length dimension."""
+
+    __slots__ = ("elem", "length")
+
+    def __init__(self, elem: AV, length: Dim):
+        self.elem = elem
+        self.length = length
+
+
+class TableVal(AV):
+    """A bucket table: tuple of host ints fixed at boot. Drawing an
+    element (iteration, subscript, ``next``/``min``/``max``) yields a
+    ``bucket``-classified scalar."""
+
+    __slots__ = ("name", "size", "values")
+
+    def __init__(self, name: str = "", size: Optional[int] = None,
+                 values: Optional[Tuple[int, ...]] = None):
+        self.name = name
+        self.size = size
+        # the member ints, when the table is a source literal — lets a
+        # tuple that doubled as a table still be read as a shape
+        self.values = values
+
+    def element(self, origin: str = "") -> ScalarVal:
+        return ScalarVal(bucket_dim(self.name or "table", self.size,
+                                    origin=origin), "int")
+
+
+class DictVal(AV):
+    """``runtime=True`` marks payload-shaped dicts (``json.loads``,
+    ``os.environ``): reads used as extents are *unbounded*."""
+
+    __slots__ = ("runtime", "source")
+
+    def __init__(self, runtime: bool = False, source: str = ""):
+        self.runtime = runtime
+        self.source = source
+
+
+class ParamVal(AV):
+    """An unannotated parameter: opaque, but with provenance — used as an
+    extent it is ``config`` in a constructor, ``sym`` elsewhere."""
+
+    __slots__ = ("name", "config")
+
+    def __init__(self, name: str, config: bool = False):
+        self.name = name
+        self.config = config
+
+
+class SelfVal(AV):
+    """``self`` inside a method; attribute reads go through the class
+    attribute model."""
+
+    __slots__ = ("mi", "cls")
+
+    def __init__(self, mi, cls: str):
+        self.mi = mi
+        self.cls = cls
+
+
+def as_dim(av: AV, fallback_name: str = "") -> Dim:
+    """Interpret an abstract value used as a dimension extent."""
+    if isinstance(av, ScalarVal):
+        return av.dim
+    if isinstance(av, ParamVal):
+        return config_dim(av.name) if av.config else sym_dim(av.name)
+    if isinstance(av, ArrayVal) and not av.shape:
+        return top_dim()
+    return Dim(TOP, name=fallback_name)
+
+
+def join_avs(a: Optional[AV], b: Optional[AV]) -> AV:
+    if a is None or b is None:
+        return a or b or OPAQUE
+    if a is b:
+        return a
+    if isinstance(a, ParamVal) and isinstance(b, ParamVal) \
+            and a.name == b.name and a.config == b.config:
+        return a
+    if isinstance(a, ListVal) and isinstance(b, ListVal):
+        return ListVal(join_avs(a.elem, b.elem), join_dims(a.length, b.length))
+    if isinstance(a, DictVal) and isinstance(b, DictVal):
+        if a.runtime == b.runtime:
+            return a
+        return DictVal(True, a.source or b.source)
+    if isinstance(a, ArrayVal) and isinstance(b, ArrayVal):
+        if len(a.shape) != len(b.shape):
+            return OPAQUE
+        return ArrayVal([join_dims(x, y) for x, y in zip(a.shape, b.shape)],
+                        a.dtype if a.dtype == b.dtype else "?",
+                        a.weak or b.weak)
+    if isinstance(a, ScalarVal) and isinstance(b, ScalarVal):
+        return ScalarVal(join_dims(a.dim, b.dim),
+                         a.dtype if a.dtype == b.dtype else "?",
+                         a.weak or b.weak)
+    if isinstance(a, TableVal) and isinstance(b, TableVal):
+        if a.name == b.name:
+            return a
+        return TableVal("", None)
+    if isinstance(a, TableVal) and isinstance(b, TupleVal):
+        return a
+    if isinstance(a, TupleVal) and isinstance(b, TableVal):
+        return b
+    if isinstance(a, TupleVal) and isinstance(b, TupleVal) \
+            and len(a.items) == len(b.items):
+        return TupleVal([join_avs(x, y) for x, y in zip(a.items, b.items)])
+    if isinstance(a, SelfVal) and isinstance(b, SelfVal):
+        return a
+    return OPAQUE
+
+
+# ------------------------------------------------- teaching annotations
+
+_TEACH_RE = re.compile(
+    r"#\s*jaxlint:\s*(shape|dim)=([A-Za-z_][\w.]*):(\(.*\)|[^\s#]+)")
+
+_DIM_TOKEN_RE = re.compile(
+    r"^\s*(?:(\d+)|(\?)|config(?:\(([\w.]+)\))?|bucket\(([\w.]+)\)"
+    r"|sym\(([\w.]+)\)|unbounded)\s*$")
+
+
+def _parse_dim_token(tok: str) -> Optional[Dim]:
+    m = _DIM_TOKEN_RE.match(tok)
+    if not m:
+        return None
+    if m.group(1) is not None:
+        return lit(int(m.group(1)))
+    if m.group(2) is not None:
+        return top_dim()
+    if m.group(4) is not None:
+        return bucket_dim(m.group(4))
+    if m.group(5) is not None:
+        return sym_dim(m.group(5))
+    if "unbounded" in tok:
+        return unbounded_dim("annotated")
+    return config_dim(m.group(3) or "")
+
+
+def parse_teachings(line: str) -> Dict[str, AV]:
+    """Teaching annotations on one physical line -> name (possibly
+    ``self.``-dotted) to abstract value."""
+    out: Dict[str, AV] = {}
+    for kind, name, spec in _TEACH_RE.findall(line or ""):
+        if kind == "dim":
+            d = _parse_dim_token(spec)
+            if d is not None:
+                out[name] = ScalarVal(d, "int")
+        else:
+            if not (spec.startswith("(") and spec.endswith(")")):
+                continue
+            dims = []
+            body = spec[1:-1].strip()
+            toks = [t for t in body.split(",") if t.strip()] if body else []
+            ok = True
+            for tok in toks:
+                d = _parse_dim_token(tok)
+                if d is None:
+                    ok = False
+                    break
+                dims.append(d)
+            if ok:
+                out[name] = ArrayVal(dims)
+    return out
+
+
+# ------------------------------------------------------------ the eval
+
+_NUMPY_PREFIXES = ("numpy.", "jax.numpy.")
+
+#: unary elementwise ops: result has operand 0's shape
+_UNARY_OPS = {
+    "exp", "log", "log1p", "expm1", "sqrt", "square", "abs", "absolute",
+    "tanh", "sin", "cos", "sign", "negative", "floor", "ceil", "clip",
+    "nan_to_num", "logical_not", "copy", "round", "isnan", "isfinite",
+    "cumsum", "cumprod", "sort", "tril", "triu", "relu", "gelu",
+    "softmax", "log_softmax", "sigmoid", "stop_gradient",
+}
+
+_BINARY_OPS = {"maximum", "minimum", "add", "subtract", "multiply",
+               "divide", "true_divide", "power", "mod", "equal",
+               "not_equal", "greater", "greater_equal", "less",
+               "less_equal", "logical_and", "logical_or", "arctan2"}
+
+_REDUCTIONS = {"sum", "mean", "max", "min", "prod", "any", "all", "var",
+               "std", "argmax", "argmin", "count_nonzero", "nanmean",
+               "amax", "amin", "median"}
+
+_SCALAR_CTORS = {"float32", "float64", "float16", "bfloat16", "int32",
+                 "int64", "int16", "int8", "uint8", "uint32", "bool_"}
+
+
+class FnShapes:
+    """The result of abstractly executing one function body."""
+
+    def __init__(self, types: Dict[int, AV], issues: List[Tuple[ast.AST, str, str]],
+                 returns: List[AV]):
+        self._types = types
+        self.issues = issues
+        self.returns = returns
+
+    def at(self, node: ast.AST) -> AV:
+        return self._types.get(id(node), OPAQUE)
+
+    @property
+    def return_value(self) -> AV:
+        out: Optional[AV] = None
+        for r in self.returns:
+            out = r if out is None else join_avs(out, r)
+        return out if out is not None else OPAQUE
+
+
+class Interp:
+    """Program-wide interpreter façade with the caches rules share."""
+
+    MAX_DEPTH = 4
+
+    def __init__(self, program):
+        self.program = program
+        self._in_progress: Set[int] = set()
+        self._depth = 0
+        self._module_envs: Dict[int, Dict[str, AV]] = {}
+        self._node2fi: Dict[int, Dict[int, object]] = {}
+
+    @classmethod
+    def get(cls, program) -> "Interp":
+        interp = program.cache.get("shapes:interp")
+        if interp is None:
+            interp = cls(program)
+            program.cache["shapes:interp"] = interp
+        return interp
+
+    # -- module-level constants ------------------------------------
+    def module_env(self, mi) -> Dict[str, AV]:
+        env = self._module_envs.get(id(mi))
+        if env is not None:
+            return env
+        env = {}
+        for stmt in mi.tree.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            name, v = stmt.targets[0].id, stmt.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                    and not isinstance(v.value, bool):
+                env[name] = ScalarVal(lit(v.value), "int")
+            elif isinstance(v, (ast.Tuple, ast.List)) and v.elts and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, int)
+                    and not isinstance(e.value, bool) for e in v.elts):
+                env[name] = TableVal(name, len(v.elts),
+                                     tuple(e.value for e in v.elts))
+        self._module_envs[id(mi)] = env
+        return env
+
+    def _lookup_alias_const(self, mi, name: str) -> Optional[AV]:
+        tgt = mi.aliases.get(name)
+        if not tgt:
+            return None
+        parts = tgt.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mi2 = self.program.lookup_module(".".join(parts[:cut]))
+            if mi2 is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                return self.module_env(mi2).get(rest[0])
+            return None
+        return None
+
+    # -- class attribute models ------------------------------------
+    def class_model(self, mi, cls: str) -> Dict[str, AV]:
+        key = f"shapes:cls:{mi.module}:{cls}"
+        model = self.program.cache.get(key)
+        if model is not None:
+            return model
+        model = {}
+        # in-progress-visible so recursive self.method() calls during
+        # __init__ see the attrs bound so far instead of looping
+        self.program.cache[key] = model
+        fi = mi.functions.get(f"{cls}.__init__")
+        if fi is not None:
+            env: Dict[str, AV] = {"self": SelfVal(mi, cls)}
+            args = fi.node.args
+            for a in list(args.posonlyargs) + list(args.args) \
+                    + list(args.kwonlyargs):
+                if a.arg not in ("self", "cls"):
+                    env[a.arg] = ParamVal(a.arg, config=True)
+            _Eval(self, fi, env, attr_sink=model)
+        return model
+
+    # -- function evaluation ---------------------------------------
+    def function_shapes(self, fi) -> FnShapes:
+        key = f"shapes:fn:{id(fi)}"
+        fs = self.program.cache.get(key)
+        if fs is not None:
+            return fs
+        env: Dict[str, AV] = {}
+        if fi.cls:
+            env["self"] = SelfVal(fi.module, fi.cls)
+        args = fi.node.args
+        for a in list(args.posonlyargs) + list(args.args) \
+                + list(args.kwonlyargs):
+            if a.arg not in ("self", "cls"):
+                env[a.arg] = ParamVal(a.arg)
+        ev = _Eval(self, fi, env)
+        fs = FnShapes(ev.types, ev.issues, ev.returns)
+        self.program.cache[key] = fs
+        return fs
+
+    def call_summary(self, fi, bound: Dict[str, AV]) -> AV:
+        """Abstract return value of calling ``fi`` with ``bound`` args."""
+        if id(fi) in self._in_progress or self._depth >= self.MAX_DEPTH:
+            return OPAQUE
+        env: Dict[str, AV] = {}
+        if fi.cls:
+            env["self"] = bound.get("self", SelfVal(fi.module, fi.cls))
+        args = fi.node.args
+        for a in list(args.posonlyargs) + list(args.args) \
+                + list(args.kwonlyargs):
+            if a.arg in ("self", "cls"):
+                continue
+            env[a.arg] = bound.get(a.arg, ParamVal(a.arg))
+        self._in_progress.add(id(fi))
+        self._depth += 1
+        try:
+            ev = _Eval(self, fi, env)
+        finally:
+            self._depth -= 1
+            self._in_progress.discard(id(fi))
+        out: Optional[AV] = None
+        for r in ev.returns:
+            out = r if out is None else join_avs(out, r)
+        return out if out is not None else OPAQUE
+
+    def node_to_fi(self, mi) -> Dict[int, object]:
+        m = self._node2fi.get(id(mi))
+        if m is None:
+            m = {id(f.node): f for f in mi.all_funcs}
+            self._node2fi[id(mi)] = m
+        return m
+
+
+def function_shapes(program, fi) -> FnShapes:
+    """Public entry: memoized abstract execution of one function."""
+    return Interp.get(program).function_shapes(fi)
+
+
+class _Eval(ForwardScan):
+    """One function body, executed abstractly. Captures a type per
+    expression node, provable shape issues, and return values."""
+
+    bottom = None
+
+    def __init__(self, interp: Interp, fi, env: Dict[str, AV],
+                 attr_sink: Optional[Dict[str, AV]] = None):
+        super().__init__()
+        self.interp = interp
+        self.program = interp.program
+        self.fi = fi
+        self.mi = fi.module
+        self.resolve = self.mi.imports.resolve
+        self.types: Dict[int, AV] = {}
+        self.issues: List[Tuple[ast.AST, str, str]] = []
+        self.returns: List[AV] = []
+        self.attr_sink = attr_sink
+        self._pending: Dict[str, AV] = {}
+        self._stmt: Optional[ast.stmt] = None
+        self._lines = self.mi.source.splitlines()
+        for _ in self.scan(fi.node.body, env):
+            pass
+
+    # -- driver hooks ----------------------------------------------
+    def scan(self, stmts, state):
+        for stmt in stmts:
+            self._stmt = stmt
+            self._pending = {}
+            yield from super().scan([stmt], state)
+            self._finish(stmt, state)
+
+    def _teachings(self, stmt) -> Dict[str, AV]:
+        out: Dict[str, AV] = {}
+        ln = getattr(stmt, "lineno", 0)
+        for i in (ln - 1, ln):
+            if 1 <= i <= len(self._lines):
+                out.update(parse_teachings(self._lines[i - 1]))
+        return out
+
+    def _finish(self, stmt, state):
+        if isinstance(stmt, ast.Return):
+            self.returns.append(
+                self.types.get(id(stmt.value), OPAQUE)
+                if stmt.value is not None else OPAQUE)
+        if isinstance(stmt, ast.Assign) and self.attr_sink is not None:
+            v = self.types.get(id(stmt.value), OPAQUE)
+            for t in stmt.targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    av = v
+                    if isinstance(av, TableVal):
+                        av = TableVal(t.attr, av.size)
+                    prev = self.attr_sink.get(t.attr)
+                    self.attr_sink[t.attr] = av if prev is None \
+                        else join_avs(prev, av)
+        for name, av in self._teachings(stmt).items():
+            state[name] = av
+
+    def kill(self, name, state):
+        if name in self._pending:
+            state[name] = self._pending[name]
+        else:
+            state.pop(name, None)
+
+    def join_value(self, a, b):
+        if a is None or b is None:
+            return OPAQUE
+        return join_avs(a, b)
+
+    def visit_expr(self, expr, state):
+        v = self.eval(expr, state)
+        stmt = self._stmt
+        if isinstance(stmt, ast.Assign) and expr is stmt.value:
+            for t in stmt.targets:
+                self._destructure(t, v)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)) and expr is stmt.iter:
+            self._destructure(stmt.target, self._element_of(v, stmt))
+        elif isinstance(stmt, ast.AnnAssign) and expr is stmt.value:
+            self._destructure(stmt.target, v)
+        return iter(())
+
+    def _destructure(self, target, v: AV):
+        if isinstance(target, ast.Name):
+            self._pending[target.id] = v
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            n = len(target.elts)
+            items: List[AV] = [OPAQUE] * n
+            if isinstance(v, TupleVal) and len(v.items) == n:
+                items = list(v.items)
+            elif isinstance(v, ListVal):
+                items = [v.elem] * n
+            elif isinstance(v, ArrayVal) and len(v.shape) == 1:
+                items = [ScalarVal(top_dim(), "?")] * n
+            for t, it in zip(target.elts, items):
+                if isinstance(t, ast.Starred):
+                    continue
+                self._destructure(t, it)
+
+    # -- expression evaluation -------------------------------------
+    def eval(self, expr: Optional[ast.AST], state) -> AV:
+        if expr is None:
+            return OPAQUE
+        v = self._eval_inner(expr, state)
+        self.types[id(expr)] = v
+        return v
+
+    def _eval_inner(self, expr, state) -> AV:
+        if isinstance(expr, ast.Constant):
+            return self._const(expr.value)
+        if isinstance(expr, ast.Name):
+            return self._name(expr, state)
+        if isinstance(expr, ast.Attribute):
+            return self._attribute(expr, state)
+        if isinstance(expr, ast.Subscript):
+            return self._subscript(expr, state)
+        if isinstance(expr, ast.Call):
+            return self._call(expr, state)
+        if isinstance(expr, ast.BinOp):
+            return self._binop(expr, state)
+        if isinstance(expr, ast.UnaryOp):
+            v = self.eval(expr.operand, state)
+            if isinstance(expr.op, ast.USub) and isinstance(v, ScalarVal) \
+                    and v.dim.kind == LITERAL and v.dim.value is not None:
+                return ScalarVal(lit(-v.dim.value), v.dtype, v.weak)
+            if isinstance(expr.op, ast.Not):
+                return ScalarVal(top_dim(), "bool")
+            return v
+        if isinstance(expr, ast.BoolOp):
+            vals = [self.eval(v, state) for v in expr.values]
+            out = vals[0]
+            for v in vals[1:]:
+                out = join_avs(out, v)
+            # `x or default`: a table on either side keeps table-ness
+            for v in vals:
+                if isinstance(v, TableVal):
+                    return TableVal(v.name, None)
+            return out
+        if isinstance(expr, ast.Compare):
+            left = self.eval(expr.left, state)
+            rights = [self.eval(c, state) for c in expr.comparators]
+            for other in rights:
+                if isinstance(left, ArrayVal) and isinstance(other, ArrayVal):
+                    return ArrayVal(self._broadcast(left.shape, other.shape,
+                                                    expr), "bool")
+            if isinstance(left, ArrayVal):
+                return ArrayVal(left.shape, "bool")
+            for other in rights:
+                if isinstance(other, ArrayVal):
+                    return ArrayVal(other.shape, "bool")
+            return ScalarVal(top_dim(), "bool")
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            items = [self.eval(e, state) for e in expr.elts]
+            if isinstance(expr, ast.Tuple) and len(items) > 1 \
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)
+                            and not isinstance(e.value, bool)
+                            for e in expr.elts) \
+                    and self._looks_like_table(expr):
+                return TableVal("", len(items),
+                                tuple(e.value for e in expr.elts))
+            return TupleVal(items)
+        if isinstance(expr, ast.Dict):
+            for k in expr.keys:
+                if k is not None:
+                    self.eval(k, state)
+            for v in expr.values:
+                self.eval(v, state)
+            return DictVal(runtime=False)
+        if isinstance(expr, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            return self._comprehension(expr, state)
+        if isinstance(expr, ast.IfExp):
+            self.eval(expr.test, state)
+            return join_avs(self.eval(expr.body, state),
+                            self.eval(expr.orelse, state))
+        if isinstance(expr, ast.Starred):
+            return self.eval(expr.value, state)
+        if isinstance(expr, ast.NamedExpr):
+            v = self.eval(expr.value, state)
+            if isinstance(expr.target, ast.Name):
+                self._pending[expr.target.id] = v
+            return v
+        if isinstance(expr, ast.JoinedStr):
+            return OPAQUE
+        if isinstance(expr, ast.Lambda):
+            return OPAQUE
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self.eval(child, state)
+        return OPAQUE
+
+    @staticmethod
+    def _looks_like_table(expr: ast.Tuple) -> bool:
+        """A literal int tuple reads as a bucket table only when it is
+        plausibly one: ≥2 distinct positive ints."""
+        vals = [e.value for e in expr.elts]
+        return len(set(vals)) >= 2 and all(v > 0 for v in vals)
+
+    def _const(self, v) -> AV:
+        if isinstance(v, bool):
+            return ScalarVal(top_dim(), "bool")
+        if isinstance(v, int):
+            return ScalarVal(lit(v), "int")
+        if isinstance(v, float):
+            return ScalarVal(Dim(LITERAL, value=None, name=repr(v)), "float")
+        return OPAQUE
+
+    def _name(self, expr: ast.Name, state) -> AV:
+        v = state.get(expr.id)
+        if v is not None:
+            return v
+        if expr.id == "self" and self.fi.cls:
+            return SelfVal(self.mi, self.fi.cls)
+        v = self.interp.module_env(self.mi).get(expr.id)
+        if v is not None:
+            return v
+        v = self.interp._lookup_alias_const(self.mi, expr.id)
+        if v is not None:
+            return v
+        return OPAQUE
+
+    def _attribute(self, expr: ast.Attribute, state) -> AV:
+        # flow-sensitive self.X overrides (teaching annotations)
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            dotted = f"self.{expr.attr}"
+            if dotted in state:
+                return state[dotted]
+        base = self.eval(expr.value, state)
+        if isinstance(base, SelfVal):
+            model = self.interp.class_model(base.mi, base.cls)
+            av = model.get(expr.attr)
+            if av is not None:
+                if isinstance(av, ParamVal):
+                    return ScalarVal(config_dim(f"self.{expr.attr}"), "?")
+                return av
+            return OpaqueVal(f"self.{expr.attr}")
+        if isinstance(base, ArrayVal):
+            if expr.attr == "shape":
+                return TupleVal([ScalarVal(d, "int") for d in base.shape])
+            if expr.attr == "T":
+                return ArrayVal(tuple(reversed(base.shape)), base.dtype)
+            if expr.attr == "ndim":
+                return ScalarVal(lit(len(base.shape)), "int")
+            if expr.attr == "size":
+                return ScalarVal(top_dim(), "int")
+            if expr.attr == "dtype":
+                return OpaqueVal(base.dtype)
+        if isinstance(base, ParamVal):
+            if expr.attr == "shape":
+                return TupleVal([])  # rank unknown: handled by subscript
+            return OpaqueVal(f"{base.name}.{expr.attr}")
+        q = self.resolve(expr)
+        if q == "os.environ":
+            return DictVal(runtime=True, source="os.environ")
+        return OPAQUE
+
+    def _subscript(self, expr: ast.Subscript, state) -> AV:
+        base = self.eval(expr.value, state)
+        sl = expr.slice
+        # x.shape[i] on a rank-unknown value -> sym
+        if isinstance(expr.value, ast.Attribute) \
+                and expr.value.attr == "shape" \
+                and isinstance(base, TupleVal) and not base.items:
+            src = ast.unparse(expr.value.value) if hasattr(ast, "unparse") \
+                else "x"
+            idx = self.eval(sl, state)
+            i = idx.dim.value if isinstance(idx, ScalarVal) \
+                and idx.dim.kind == LITERAL else "?"
+            return ScalarVal(sym_dim(f"{src}.shape[{i}]"), "int")
+        if isinstance(base, TupleVal):
+            idx = self.eval(sl, state)
+            if isinstance(idx, ScalarVal) and idx.dim.kind == LITERAL \
+                    and idx.dim.value is not None \
+                    and -len(base.items) <= idx.dim.value < len(base.items):
+                return base.items[idx.dim.value]
+            return OPAQUE
+        if isinstance(base, TableVal):
+            if isinstance(sl, ast.Slice):
+                self.eval(sl.lower, state)
+                self.eval(sl.upper, state)
+                return TableVal(base.name, None)
+            self.eval(sl, state)
+            return base.element(origin=f"{base.name}[]")
+        if isinstance(base, ListVal):
+            if isinstance(sl, ast.Slice):
+                return ListVal(base.elem, top_dim())
+            self.eval(sl, state)
+            return base.elem
+        if isinstance(base, DictVal):
+            self.eval(sl, state) if not isinstance(sl, ast.Slice) else None
+            if base.runtime:
+                src = base.source or "payload"
+                return ScalarVal(unbounded_dim(f"{src}[...]"), "?")
+            return OPAQUE
+        if isinstance(base, ArrayVal):
+            return self._index_array(base, sl, state)
+        if not isinstance(sl, ast.Slice):
+            self.eval(sl, state)
+        return OPAQUE
+
+    def _slice_dim(self, d: Dim, sl: ast.Slice, state) -> Dim:
+        if sl.step is not None:
+            self.eval(sl.step, state)
+            return top_dim()
+        lo, hi = sl.lower, sl.upper
+        if lo is None and hi is None:
+            return d
+        lo_v = self.eval(lo, state) if lo is not None else None
+        hi_v = self.eval(hi, state) if hi is not None else None
+        if lo is not None and hi is not None:
+            if isinstance(lo_v, ScalarVal) and isinstance(hi_v, ScalarVal) \
+                    and lo_v.dim.kind == LITERAL and hi_v.dim.kind == LITERAL:
+                return lit(max(hi_v.dim.value - lo_v.dim.value, 0))
+            # the x[i:i+k] idiom: extent k regardless of i
+            if isinstance(hi, ast.BinOp) and isinstance(hi.op, ast.Add) \
+                    and isinstance(hi.right, ast.Constant) \
+                    and isinstance(hi.right.value, int) \
+                    and ast.dump(hi.left) == ast.dump(lo):
+                return lit(hi.right.value)
+            return top_dim()
+        if lo is None and isinstance(hi_v, ScalarVal):
+            return hi_v.dim if hi_v.dim.kind != LITERAL else \
+                lit(hi_v.dim.value)
+        return top_dim()
+
+    def _index_array(self, base: ArrayVal, sl, state) -> AV:
+        items = list(sl.elts) if isinstance(sl, ast.Tuple) else [sl]
+        dims = list(base.shape)
+        out: List[Dim] = []
+        i = 0
+        for it in items:
+            if isinstance(it, ast.Constant) and it.value is None:
+                out.append(lit(1))
+                continue
+            if isinstance(it, ast.Constant) and it.value is Ellipsis:
+                return OPAQUE
+            if i >= len(dims):
+                return OPAQUE
+            if isinstance(it, ast.Slice):
+                out.append(self._slice_dim(dims[i], it, state))
+                i += 1
+                continue
+            v = self.eval(it, state)
+            if isinstance(v, ArrayVal):
+                if v.dtype == "bool":
+                    out.append(unbounded_dim("boolean mask"))
+                else:
+                    out.extend(v.shape)
+            i += 1
+        out.extend(dims[i:])
+        return ArrayVal(out, base.dtype, base.weak)
+
+    # -- broadcasting ----------------------------------------------
+    def _broadcast(self, sa: Sequence[Dim], sb: Sequence[Dim],
+                   node: ast.AST) -> Tuple[Dim, ...]:
+        la, lb = list(sa), list(sb)
+        n = max(len(la), len(lb))
+        la = [lit(1)] * (n - len(la)) + la
+        lb = [lit(1)] * (n - len(lb)) + lb
+        out: List[Dim] = []
+        for a, b in zip(la, lb):
+            if a.kind == LITERAL and a.value == 1:
+                out.append(b)
+            elif b.kind == LITERAL and b.value == 1:
+                out.append(a)
+            elif a.kind == LITERAL and b.kind == LITERAL:
+                if a.value != b.value:
+                    self.issues.append((
+                        node, "broadcast",
+                        f"provable broadcast mismatch: {render_shape(sa)} vs "
+                        f"{render_shape(sb)} (dim {a.value} != {b.value}, "
+                        "neither is 1)"))
+                    out.append(top_dim())
+                else:
+                    out.append(a)
+            elif a.same(b):
+                out.append(a)
+            else:
+                out.append(join_dims(a, b))
+        return tuple(out)
+
+    def _binop(self, expr: ast.BinOp, state) -> AV:
+        a = self.eval(expr.left, state)
+        b = self.eval(expr.right, state)
+        if isinstance(expr.op, ast.MatMult):
+            return self._matmul(a, b, True, expr)
+        if isinstance(a, ArrayVal) and isinstance(b, ArrayVal):
+            shape = self._broadcast(a.shape, b.shape, expr)
+            return ArrayVal(shape, promote_dtypes(a.dtype, b.dtype, b.weak))
+        if isinstance(a, ArrayVal) and isinstance(b, ScalarVal):
+            return ArrayVal(a.shape, promote_dtypes(a.dtype, b.dtype, b.weak))
+        if isinstance(b, ArrayVal) and isinstance(a, ScalarVal):
+            return ArrayVal(b.shape, promote_dtypes(b.dtype, a.dtype, a.weak))
+        if isinstance(a, ScalarVal) and isinstance(b, ScalarVal):
+            return self._scalar_binop(a, b, expr.op)
+        # list/tuple concatenation feeds bucket-table construction
+        if isinstance(expr.op, ast.Add):
+            for x, y in ((a, b), (b, a)):
+                if isinstance(x, (ListVal, TableVal)) \
+                        and isinstance(y, (ListVal, TupleVal, TableVal)):
+                    return ListVal(
+                        x.elem if isinstance(x, ListVal)
+                        else ScalarVal(top_dim(), "int"), top_dim())
+        return OPAQUE
+
+    @staticmethod
+    def _scalar_binop(a: ScalarVal, b: ScalarVal, op) -> ScalarVal:
+        dtype = "float" if (a.dtype == "float" or b.dtype == "float"
+                            or isinstance(op, ast.Div)) else \
+            (a.dtype if a.dtype == b.dtype else "?")
+        da, db = a.dim, b.dim
+        if da.kind == LITERAL and db.kind == LITERAL \
+                and da.value is not None and db.value is not None:
+            try:
+                fn = {ast.Add: lambda x, y: x + y,
+                      ast.Sub: lambda x, y: x - y,
+                      ast.Mult: lambda x, y: x * y,
+                      ast.FloorDiv: lambda x, y: x // y,
+                      ast.Mod: lambda x, y: x % y,
+                      ast.Pow: lambda x, y: x ** y}.get(type(op))
+                if fn is not None:
+                    return ScalarVal(lit(fn(da.value, db.value)), dtype,
+                                     a.weak and b.weak)
+            except (ZeroDivisionError, OverflowError, ValueError):
+                pass
+        for d, other in ((da, db), (db, da)):
+            if d.kind == UNBOUNDED:
+                return ScalarVal(unbounded_dim(d.name), dtype)
+            if d.kind == BUCKET and other.kind in (LITERAL, CONFIG):
+                # arithmetic on a bucket value stays |table|-valued
+                return ScalarVal(Dim(BUCKET, table=d.table, size=d.size,
+                                     origin=d.origin), dtype)
+        for d, other in ((da, db), (db, da)):
+            if d.kind == CONFIG and other.kind in (LITERAL, CONFIG):
+                return ScalarVal(config_dim(d.name), dtype)
+            if d.kind == SYM and other.kind in (LITERAL, CONFIG, SYM):
+                return ScalarVal(sym_dim(d.name), dtype)
+        return ScalarVal(top_dim(), dtype)
+
+    # -- comprehensions --------------------------------------------
+    def _comprehension(self, expr, state) -> AV:
+        inner = dict(state)
+        length: Dim = top_dim()
+        for k, gen in enumerate(expr.generators):
+            it = self.eval(gen.iter, inner)
+            elem = self._element_of(it, expr)
+            if k == 0:
+                length = self._len_dim(it)
+                if gen.ifs:
+                    length = top_dim()
+            self._pending = {}
+            self._destructure(gen.target, elem)
+            for name, v in self._pending.items():
+                inner[name] = v
+            for cond in gen.ifs:
+                self.eval(cond, inner)
+        self._pending = {}
+        elt = self.eval(expr.elt, inner)
+        return ListVal(elt, length)
+
+    def _element_of(self, av: AV, node) -> AV:
+        if isinstance(av, TableVal):
+            return av.element(origin=f"{av.name}@{getattr(node, 'lineno', 0)}")
+        if isinstance(av, ListVal):
+            return av.elem
+        if isinstance(av, TupleVal):
+            out: Optional[AV] = None
+            for it in av.items:
+                out = it if out is None else join_avs(out, it)
+            return out if out is not None else OPAQUE
+        if isinstance(av, ArrayVal) and av.shape:
+            return ArrayVal(av.shape[1:], av.dtype)
+        if isinstance(av, DictVal) and av.runtime:
+            return ScalarVal(unbounded_dim(av.source or "payload"), "?")
+        return OPAQUE
+
+    def _len_dim(self, av: AV) -> Dim:
+        if isinstance(av, ArrayVal) and av.shape:
+            return av.shape[0]
+        if isinstance(av, TableVal):
+            return lit(av.size) if av.size is not None else \
+                config_dim(f"|{av.name}|")
+        if isinstance(av, ListVal):
+            return av.length
+        if isinstance(av, TupleVal):
+            return lit(len(av.items))
+        if isinstance(av, ParamVal):
+            return config_dim(av.name) if av.config else sym_dim(
+                f"len({av.name})")
+        if isinstance(av, DictVal) and av.runtime:
+            return unbounded_dim(f"len({av.source or 'payload'})")
+        return top_dim()
+
+    # -- calls ------------------------------------------------------
+    def _eval_args(self, node: ast.Call, state) -> Tuple[List[AV],
+                                                         Dict[str, AV]]:
+        pos = [self.eval(a, state) for a in node.args]
+        kw = {k.arg: self.eval(k.value, state) for k in node.keywords
+              if k.arg is not None}
+        for k in node.keywords:
+            if k.arg is None:
+                self.eval(k.value, state)
+        return pos, kw
+
+    def _dtype_from(self, node: Optional[ast.AST],
+                    av: Optional[AV]) -> str:
+        if node is None:
+            return "?"
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return canon_dtype(node.value)
+        q = self.resolve(node)
+        if q:
+            leaf = q.rsplit(".", 1)[-1]
+            if leaf in _DTYPE_CANON:
+                return canon_dtype(leaf)
+        if isinstance(node, ast.Name) and node.id in ("float", "int", "bool"):
+            return canon_dtype(node.id)
+        return "?"
+
+    def _shape_from(self, av: AV) -> Optional[List[Dim]]:
+        if isinstance(av, TupleVal):
+            return [as_dim(it) for it in av.items]
+        if isinstance(av, (ScalarVal, ParamVal)):
+            return [as_dim(av)]
+        if isinstance(av, TableVal):
+            # a literal int tuple doubles as a table; in shape position
+            # its members ARE the literal dims
+            if av.values is not None:
+                return [lit(v) for v in av.values]
+            return None
+        if isinstance(av, ListVal):
+            return None
+        return None
+
+    def _as_array(self, av: AV, jnp: bool) -> AV:
+        if isinstance(av, ArrayVal):
+            return av
+        if isinstance(av, ScalarVal):
+            dt = av.dtype
+            if dt == "int":
+                dt = "i64" if not jnp else "i32"
+            elif dt == "float":
+                dt = "f64" if not jnp else "f32"
+            return ArrayVal([], dt, weak=av.weak)
+        if isinstance(av, ListVal):
+            if isinstance(av.elem, ArrayVal):
+                return ArrayVal([av.length] + list(av.elem.shape),
+                                av.elem.dtype)
+            if isinstance(av.elem, ScalarVal):
+                return ArrayVal([av.length], "?")
+            return OPAQUE
+        if isinstance(av, TupleVal):
+            if av.items and all(isinstance(i, ScalarVal) for i in av.items):
+                return ArrayVal([lit(len(av.items))], "?")
+            if av.items and all(isinstance(i, ArrayVal) for i in av.items):
+                first = av.items[0]
+                return ArrayVal([lit(len(av.items))] + list(first.shape),
+                                first.dtype)
+        if isinstance(av, TableVal):
+            return ArrayVal([self._len_dim(av)], "i64")
+        return OPAQUE
+
+    def _call(self, node: ast.Call, state) -> AV:
+        fn = node.func
+        q = self.resolve(fn) or ""
+        pos, kw = self._eval_args(node, state)
+
+        if isinstance(fn, ast.Name):
+            v = self._builtin_call(fn.id, node, pos, kw, state)
+            if v is not None:
+                return v
+
+        # numpy / jax.numpy / jax.lax / jax.nn namespaces
+        op = None
+        jnp = False
+        for pref in _NUMPY_PREFIXES:
+            if q.startswith(pref):
+                op = q[len(pref):]
+                jnp = pref != "numpy."
+                break
+        if op is None and q.startswith("jax.lax."):
+            op = q[len("jax.lax."):]
+            jnp = True
+        if op is None and q.startswith("jax.nn."):
+            op = q[len("jax.nn."):]
+            jnp = True
+        if op is not None:
+            v = self._numpy_call(op, jnp, node, pos, kw, state)
+            if v is not None:
+                return v
+
+        if q in ("json.loads", "json.load"):
+            return DictVal(runtime=True, source="json.loads")
+        if q in ("os.getenv", "os.environ.get"):
+            return ScalarVal(unbounded_dim("os.environ"), "?")
+
+        # method calls on evaluated receivers
+        if isinstance(fn, ast.Attribute):
+            recv = self.types.get(id(fn.value))
+            if recv is None:
+                recv = self.eval(fn.value, state)
+            v = self._method_call(recv, fn.attr, node, pos, kw)
+            if v is not None:
+                return v
+
+        # user functions through the program call graph
+        callee = self.program.resolve_call(
+            self.mi, fn, self.mi.enclosing_class(node))
+        if callee is not None and callee.node is not self.fi.node:
+            bound: Dict[str, AV] = {}
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Starred):
+                    break
+                if i < len(callee.params):
+                    bound[callee.params[i]] = pos[i]
+            for k in node.keywords:
+                if k.arg and k.arg in kw:
+                    bound[k.arg] = kw[k.arg]
+            if isinstance(fn, ast.Attribute) and isinstance(
+                    fn.value, ast.Name) and fn.value.id == "self":
+                recv = state.get("self")
+                if isinstance(recv, SelfVal):
+                    bound["self"] = recv
+            return self.interp.call_summary(callee, bound)
+        return OPAQUE
+
+    def _builtin_call(self, name: str, node: ast.Call, pos: List[AV],
+                      kw: Dict[str, AV], state) -> Optional[AV]:
+        if name == "len" and pos:
+            return ScalarVal(self._len_dim(pos[0]), "int")
+        if name in ("int", "float", "bool") and pos:
+            a = pos[0]
+            d = a.dim if isinstance(a, ScalarVal) else as_dim(a)
+            return ScalarVal(d, "int" if name == "int" else name, weak=True)
+        if name in ("min", "max"):
+            if len(pos) == 1:
+                a = pos[0]
+                if isinstance(a, TableVal):
+                    return a.element(origin=f"{name}({a.name})")
+                if isinstance(a, ListVal):
+                    return a.elem
+                return OPAQUE
+            out: Optional[AV] = None
+            for a in pos:
+                out = a if out is None else join_avs(out, a)
+            return out or OPAQUE
+        if name == "next" and node.args:
+            first = pos[0]
+            out = first.elem if isinstance(first, ListVal) else OPAQUE
+            if len(pos) > 1:
+                out = join_avs(out, pos[1])
+            return out
+        if name == "range" and pos:
+            n = pos[-1] if len(pos) <= 1 else pos[1]
+            return ListVal(ScalarVal(top_dim(), "int"),
+                           as_dim(n) if len(pos) == 1 else top_dim())
+        if name in ("tuple", "sorted", "list", "set", "frozenset") and pos:
+            a = pos[0]
+            if isinstance(a, TableVal):
+                return a
+            if isinstance(a, ListVal):
+                if isinstance(a.elem, ScalarVal) and a.elem.dtype == "int":
+                    return TableVal("", a.length.value
+                                    if a.length.kind == LITERAL else None)
+                return a
+            if isinstance(a, TupleVal):
+                return a
+            return OPAQUE
+        if name in ("sum", "abs", "round") and pos:
+            a = pos[0]
+            if isinstance(a, (ScalarVal, ArrayVal)):
+                return a
+            return ScalarVal(top_dim(), "?")
+        if name == "enumerate" and pos:
+            return ListVal(TupleVal([ScalarVal(top_dim(), "int"),
+                                     self._element_of(pos[0], node)]),
+                           self._len_dim(pos[0]))
+        if name == "zip" and pos:
+            return ListVal(TupleVal([self._element_of(a, node) for a in pos]),
+                           self._len_dim(pos[0]))
+        if name in ("print", "isinstance", "hasattr", "getattr", "repr",
+                    "str", "format", "id", "iter", "callable", "setattr",
+                    "vars", "dir", "type", "super", "open", "input",
+                    "divmod", "hash", "map", "filter", "all", "any"):
+            return OPAQUE
+        return None
+
+    def _numpy_call(self, op: str, jnp: bool, node: ast.Call,
+                    pos: List[AV], kw: Dict[str, AV],
+                    state) -> Optional[AV]:
+        def dtype_arg(idx: int, kwname: str = "dtype") -> str:
+            for k in node.keywords:
+                if k.arg == kwname:
+                    return self._dtype_from(k.value, kw.get(kwname))
+            if idx < len(node.args):
+                return self._dtype_from(node.args[idx], pos[idx])
+            return "?"
+
+        def axis_arg(idx: int) -> Optional[int]:
+            for k in node.keywords:
+                if k.arg == "axis" and isinstance(k.value, ast.Constant) \
+                        and isinstance(k.value.value, int):
+                    return k.value.value
+            if idx < len(node.args):
+                a = node.args[idx]
+                if isinstance(a, ast.Constant) and isinstance(a.value, int):
+                    return a.value
+            return None
+
+        def keepdims() -> bool:
+            for k in node.keywords:
+                if k.arg == "keepdims" and isinstance(k.value, ast.Constant):
+                    return bool(k.value.value)
+            return False
+
+        if op in ("zeros", "ones", "empty") and pos:
+            shape = self._shape_from(pos[0])
+            if shape is None:
+                return OPAQUE
+            dt = dtype_arg(1)
+            return ArrayVal(shape, dt if dt != "?" else
+                            ("f32" if jnp else "f64"))
+        if op == "full" and pos:
+            shape = self._shape_from(pos[0])
+            if shape is None:
+                return OPAQUE
+            return ArrayVal(shape, dtype_arg(2))
+        if op in ("zeros_like", "ones_like", "full_like",
+                  "empty_like") and pos:
+            a = self._as_array(pos[0], jnp)
+            if isinstance(a, ArrayVal):
+                dt = dtype_arg(2 if op == "full_like" else 1)
+                return ArrayVal(a.shape, dt if dt != "?" else a.dtype)
+            return OPAQUE
+        if op in ("asarray", "array", "ascontiguousarray") and pos:
+            a = self._as_array(pos[0], jnp)
+            if isinstance(a, ArrayVal):
+                dt = dtype_arg(1)
+                return ArrayVal(a.shape, dt if dt != "?" else a.dtype,
+                                a.weak)
+            return OPAQUE
+        if op in _SCALAR_CTORS and pos:
+            a = pos[0]
+            dt = canon_dtype(op)
+            if isinstance(a, ArrayVal):
+                return ArrayVal(a.shape, dt)
+            if isinstance(a, ScalarVal):
+                return ScalarVal(a.dim, dt, weak=False)
+            return ScalarVal(as_dim(a), dt, weak=False)
+        if op == "arange":
+            if len(pos) == 1:
+                return ArrayVal([as_dim(pos[0])],
+                                "i32" if jnp else "i64")
+            return ArrayVal([top_dim()], "?")
+        if op == "linspace" and len(pos) >= 3:
+            return ArrayVal([as_dim(pos[2])], "f32" if jnp else "f64")
+        if op in ("concatenate", "concat", "vstack", "hstack") and pos:
+            return self._concat(pos[0], axis_arg(1) or 0, node)
+        if op == "stack" and pos:
+            a = pos[0]
+            axis = axis_arg(1) or 0
+            if isinstance(a, (TupleVal, ListVal)):
+                elem = self._element_of(a, node)
+                if isinstance(elem, ArrayVal):
+                    dims = list(elem.shape)
+                    if 0 <= axis <= len(dims):
+                        dims.insert(axis, self._len_dim(a))
+                        return ArrayVal(dims, elem.dtype)
+            return OPAQUE
+        if op == "where" and len(pos) == 3:
+            c = self._as_array(pos[0], jnp)
+            a = self._as_array(pos[1], jnp)
+            b = self._as_array(pos[2], jnp)
+            arrs = [x for x in (c, a, b) if isinstance(x, ArrayVal)]
+            if not arrs:
+                return OPAQUE
+            shape = arrs[0].shape
+            for x in arrs[1:]:
+                shape = self._broadcast(shape, x.shape, node)
+            dt = "?"
+            if isinstance(a, ArrayVal) and isinstance(b, ArrayVal):
+                dt = promote_dtypes(a.dtype, b.dtype, b.weak)
+            elif isinstance(a, ArrayVal):
+                dt = a.dtype
+            return ArrayVal(shape, dt)
+        if op == "broadcast_to" and len(pos) >= 2:
+            shape = self._shape_from(pos[1])
+            if shape is None:
+                return OPAQUE
+            a = self._as_array(pos[0], jnp)
+            return ArrayVal(shape,
+                            a.dtype if isinstance(a, ArrayVal) else "?")
+        if op == "reshape" and len(pos) >= 2:
+            shape = self._shape_from(pos[1])
+            a = self._as_array(pos[0], jnp)
+            if shape is None:
+                return OPAQUE
+            shape = [top_dim() if (d.kind == LITERAL and d.value == -1)
+                     else d for d in shape]
+            return ArrayVal(shape,
+                            a.dtype if isinstance(a, ArrayVal) else "?")
+        if op == "pad" and len(pos) >= 2:
+            return self._pad(pos[0], pos[1], jnp)
+        if op == "transpose" and pos:
+            a = self._as_array(pos[0], jnp)
+            if isinstance(a, ArrayVal):
+                return ArrayVal(tuple(reversed(a.shape)), a.dtype)
+            return OPAQUE
+        if op == "swapaxes" and len(pos) == 3:
+            a = self._as_array(pos[0], jnp)
+            i, j = pos[1], pos[2]
+            if isinstance(a, ArrayVal) and isinstance(i, ScalarVal) \
+                    and isinstance(j, ScalarVal) \
+                    and i.dim.kind == LITERAL and j.dim.kind == LITERAL:
+                dims = list(a.shape)
+                try:
+                    dims[i.dim.value], dims[j.dim.value] = \
+                        dims[j.dim.value], dims[i.dim.value]
+                    return ArrayVal(dims, a.dtype)
+                except IndexError:
+                    return OPAQUE
+            return OPAQUE
+        if op == "expand_dims" and len(pos) >= 2:
+            a = self._as_array(pos[0], jnp)
+            ax = axis_arg(1)
+            if isinstance(a, ArrayVal) and ax is not None \
+                    and -len(a.shape) - 1 <= ax <= len(a.shape):
+                dims = list(a.shape)
+                dims.insert(ax if ax >= 0 else len(dims) + 1 + ax, lit(1))
+                return ArrayVal(dims, a.dtype)
+            return OPAQUE
+        if op == "squeeze" and pos:
+            a = self._as_array(pos[0], jnp)
+            ax = axis_arg(1)
+            if isinstance(a, ArrayVal):
+                if ax is not None and -len(a.shape) <= ax < len(a.shape):
+                    dims = list(a.shape)
+                    dims.pop(ax)
+                    return ArrayVal(dims, a.dtype)
+                return ArrayVal([d for d in a.shape
+                                 if not (d.kind == LITERAL and d.value == 1)],
+                                a.dtype)
+            return OPAQUE
+        if op in _REDUCTIONS and pos:
+            a = self._as_array(pos[0], jnp)
+            if not isinstance(a, ArrayVal):
+                return OPAQUE
+            dt = "i32" if op in ("argmax", "argmin", "count_nonzero") \
+                else a.dtype
+            ax = axis_arg(1)
+            if ax is None and not any(k.arg == "axis"
+                                      for k in node.keywords):
+                return ArrayVal([], dt)
+            if ax is not None and -len(a.shape) <= ax < len(a.shape):
+                dims = list(a.shape)
+                if keepdims():
+                    dims[ax] = lit(1)
+                else:
+                    dims.pop(ax)
+                return ArrayVal(dims, dt)
+            return OPAQUE
+        if op in _BINARY_OPS and len(pos) >= 2:
+            a = self._as_array(pos[0], jnp)
+            b = self._as_array(pos[1], jnp)
+            if isinstance(a, ArrayVal) and isinstance(b, ArrayVal):
+                boolish = op in ("equal", "not_equal", "greater", "less",
+                                 "greater_equal", "less_equal",
+                                 "logical_and", "logical_or")
+                return ArrayVal(self._broadcast(a.shape, b.shape, node),
+                                "bool" if boolish
+                                else promote_dtypes(a.dtype, b.dtype,
+                                                    b.weak))
+            return OPAQUE
+        if op in _UNARY_OPS and pos:
+            a = self._as_array(pos[0], jnp)
+            if isinstance(a, ArrayVal):
+                dt = "bool" if op in ("isnan", "isfinite") else a.dtype
+                return ArrayVal(a.shape, dt)
+            if isinstance(pos[0], ScalarVal):
+                return pos[0]
+            return OPAQUE
+        if op in ("matmul", "dot") and len(pos) >= 2:
+            return self._matmul(pos[0], pos[1], jnp, node)
+        if op == "einsum":
+            return OPAQUE
+        if op in ("take",) and len(pos) >= 2:
+            a = self._as_array(pos[0], jnp)
+            idx = self._as_array(pos[1], jnp)
+            ax = axis_arg(2)
+            if isinstance(a, ArrayVal) and isinstance(idx, ArrayVal) \
+                    and ax is not None and -len(a.shape) <= ax < len(a.shape):
+                dims = list(a.shape)
+                dims[ax:ax + 1] = list(idx.shape)
+                return ArrayVal(dims, a.dtype)
+            return OPAQUE
+        if op == "take_along_axis" and len(pos) >= 2:
+            idx = self._as_array(pos[1], jnp)
+            a = self._as_array(pos[0], jnp)
+            if isinstance(idx, ArrayVal):
+                return ArrayVal(idx.shape,
+                                a.dtype if isinstance(a, ArrayVal) else "?")
+            return OPAQUE
+        if op == "repeat" and len(pos) >= 2:
+            a = self._as_array(pos[0], jnp)
+            n = pos[1]
+            ax = axis_arg(2)
+            if isinstance(a, ArrayVal) and ax is not None \
+                    and isinstance(n, ScalarVal) \
+                    and -len(a.shape) <= ax < len(a.shape):
+                dims = list(a.shape)
+                d = dims[ax]
+                if d.kind == LITERAL and n.dim.kind == LITERAL:
+                    dims[ax] = lit(d.value * n.dim.value)
+                else:
+                    dims[ax] = top_dim()
+                return ArrayVal(dims, a.dtype)
+            return OPAQUE
+        if op == "split" and len(pos) >= 2:
+            a = self._as_array(pos[0], jnp)
+            if isinstance(a, ArrayVal):
+                ax = axis_arg(2) or 0
+                dims = list(a.shape)
+                if -len(dims) <= ax < len(dims):
+                    dims[ax] = top_dim()
+                return ListVal(ArrayVal(dims, a.dtype), as_dim(pos[1]))
+            return OPAQUE
+        if op == "dynamic_update_slice" and pos:
+            return self._as_array(pos[0], jnp)
+        if op == "dynamic_slice" and len(pos) >= 3:
+            shape = self._shape_from(pos[2])
+            if shape is not None:
+                a = self._as_array(pos[0], jnp)
+                return ArrayVal(shape, a.dtype
+                                if isinstance(a, ArrayVal) else "?")
+            return OPAQUE
+        if op == "top_k" and len(pos) >= 2:
+            a = self._as_array(pos[0], jnp)
+            if isinstance(a, ArrayVal) and a.shape:
+                dims = list(a.shape)
+                dims[-1] = as_dim(pos[1])
+                return TupleVal([ArrayVal(dims, a.dtype),
+                                 ArrayVal(dims, "i32")])
+            return OPAQUE
+        if op == "one_hot" and len(pos) >= 2:
+            a = self._as_array(pos[0], jnp)
+            if isinstance(a, ArrayVal):
+                return ArrayVal(list(a.shape) + [as_dim(pos[1])], "f32")
+            return OPAQUE
+        if op in ("scan", "while_loop", "cond", "fori_loop", "dot_general",
+                  "conv_general_dilated", "reduce_window", "switch",
+                  "associative_scan", "map"):
+            return OPAQUE
+        return None
+
+    def _concat(self, seq: AV, axis: int, node) -> AV:
+        if isinstance(seq, TupleVal) and seq.items and all(
+                isinstance(i, ArrayVal) for i in seq.items):
+            arrs: List[ArrayVal] = list(seq.items)  # type: ignore
+            rank = len(arrs[0].shape)
+            if any(len(a.shape) != rank for a in arrs) or rank == 0 \
+                    or not (-rank <= axis < rank):
+                return OPAQUE
+            ax = axis if axis >= 0 else rank + axis
+            out: List[Dim] = []
+            for i in range(rank):
+                ds = [a.shape[i] for a in arrs]
+                if i == ax:
+                    if all(d.kind == LITERAL for d in ds):
+                        out.append(lit(sum(d.value for d in ds)))
+                    elif any(d.kind == UNBOUNDED for d in ds):
+                        out.append(unbounded_dim("concat"))
+                    elif len(ds) == 1:
+                        out.append(ds[0])
+                    else:
+                        out.append(top_dim())
+                else:
+                    d0 = ds[0]
+                    for d in ds[1:]:
+                        if d0.kind == LITERAL and d.kind == LITERAL \
+                                and d0.value != d.value:
+                            self.issues.append((
+                                node, "concat-axis",
+                                f"concatenate along axis {ax}: non-concat "
+                                f"dim {i} provably differs "
+                                f"({d0.value} vs {d.value})"))
+                        d0 = join_dims(d0, d)
+                    out.append(d0)
+            return ArrayVal(out, arrs[0].dtype)
+        if isinstance(seq, ListVal):
+            if isinstance(seq.elem, ArrayVal) and seq.elem.shape:
+                dims = list(seq.elem.shape)
+                L, d0 = seq.length, dims[axis] if -len(dims) <= axis \
+                    < len(dims) else top_dim()
+                if L.kind == LITERAL and d0.kind == LITERAL:
+                    dims[axis] = lit(L.value * d0.value)
+                elif L.kind == UNBOUNDED:
+                    dims[axis] = unbounded_dim(L.name or "concat")
+                else:
+                    dims[axis] = top_dim()
+                return ArrayVal(dims, seq.elem.dtype)
+            return OPAQUE
+        return OPAQUE
+
+    def _pad(self, a_av: AV, widths: AV, jnp: bool) -> AV:
+        a = self._as_array(a_av, jnp)
+        if not isinstance(a, ArrayVal):
+            return OPAQUE
+        if isinstance(widths, TupleVal) and len(widths.items) == \
+                len(a.shape):
+            dims: List[Dim] = []
+            for d, w in zip(a.shape, widths.items):
+                total: Optional[int] = None
+                if isinstance(w, TupleVal) and len(w.items) == 2 and all(
+                        isinstance(x, ScalarVal)
+                        and x.dim.kind == LITERAL for x in w.items):
+                    total = sum(x.dim.value for x in w.items)  # type: ignore
+                if total == 0:
+                    dims.append(d)
+                elif total is not None and d.kind == LITERAL:
+                    dims.append(lit(d.value + total))
+                else:
+                    dims.append(top_dim())
+            return ArrayVal(dims, a.dtype)
+        return ArrayVal([top_dim()] * len(a.shape), a.dtype)
+
+    def _matmul(self, a_av: AV, b_av: AV, jnp: bool, node) -> AV:
+        a = self._as_array(a_av, jnp)
+        b = self._as_array(b_av, jnp)
+        if not (isinstance(a, ArrayVal) and isinstance(b, ArrayVal)):
+            return OPAQUE
+        if len(a.shape) < 1 or len(b.shape) < 1:
+            return OPAQUE
+        ka = a.shape[-1]
+        kb = b.shape[-2] if len(b.shape) >= 2 else b.shape[0]
+        if ka.kind == LITERAL and kb.kind == LITERAL and ka.value != kb.value:
+            self.issues.append((
+                node, "dot",
+                f"matmul contraction mismatch: {render_shape(a.shape)} @ "
+                f"{render_shape(b.shape)} (inner {ka.value} != {kb.value})"))
+        if len(a.shape) == 1 and len(b.shape) == 1:
+            return ArrayVal([], promote_dtypes(a.dtype, b.dtype))
+        lead = list(a.shape[:-1]) if len(a.shape) > 1 else []
+        tail = list(b.shape[-1:]) if len(b.shape) > 1 else []
+        return ArrayVal(lead + tail, promote_dtypes(a.dtype, b.dtype))
+
+    def _method_call(self, recv: AV, name: str, node: ast.Call,
+                     pos: List[AV], kw: Dict[str, AV]) -> Optional[AV]:
+        if isinstance(recv, ArrayVal):
+            if name == "astype" and pos:
+                return ArrayVal(recv.shape,
+                                self._dtype_from(node.args[0], pos[0]))
+            if name == "reshape":
+                if len(pos) == 1:
+                    shape = self._shape_from(pos[0])
+                else:
+                    shape = [as_dim(p) for p in pos]
+                if shape is None:
+                    return OPAQUE
+                return ArrayVal([top_dim() if (d.kind == LITERAL
+                                               and d.value == -1) else d
+                                 for d in shape], recv.dtype)
+            if name == "copy":
+                return recv
+            if name in ("item",):
+                return ScalarVal(top_dim(), recv.dtype, weak=True)
+            if name == "tolist":
+                return ListVal(ScalarVal(top_dim(), "?"),
+                               recv.shape[0] if recv.shape else top_dim())
+            if name in ("flatten", "ravel"):
+                return ArrayVal([top_dim()], recv.dtype)
+            if name in ("squeeze", "transpose", "sum", "mean", "max", "min",
+                        "prod", "any", "all", "argmax", "argmin", "clip"):
+                # reuse the function-form transfer
+                return self._numpy_call(
+                    name if name != "clip" else "clip", True, node,
+                    [recv] + pos, kw, None)
+        if isinstance(recv, DictVal) and name == "get":
+            if recv.runtime:
+                return ScalarVal(
+                    unbounded_dim(f"{recv.source or 'payload'}.get"), "?")
+            return OPAQUE
+        if isinstance(recv, TableVal) and name == "index":
+            return ScalarVal(top_dim(), "int")
+        if isinstance(recv, ListVal) and name in ("pop",):
+            return recv.elem
+        return None

@@ -11,7 +11,8 @@ never load jax. Importing this package pulls the full surface (including
 the jax-adjacent ``StepTelemetry`` / ``TelemetryListener``).
 """
 
-from .alerts import AlertEngine, AlertRule, default_rules
+from .alerts import (AlertEngine, AlertRule, default_rules,
+                     rules_from_config)
 from .flight import FlightRecorder
 from .forecast import BurnForecaster, Forecast
 from .listener import TelemetryListener
@@ -31,6 +32,6 @@ __all__ = [
     "RequestContext", "RequestTracer", "FlightRecorder", "SloBurn",
     "parse_traceparent", "format_traceparent",
     "TimeSeriesStore", "FederatedScraper",
-    "AlertEngine", "AlertRule", "default_rules",
+    "AlertEngine", "AlertRule", "default_rules", "rules_from_config",
     "BurnForecaster", "Forecast",
 ]

@@ -1,8 +1,11 @@
 """Declarative alerting over the time-series store.
 
-An :class:`AlertEngine` evaluates a fixed set of :class:`AlertRule`\\ s
-against a :class:`~.tsdb.TimeSeriesStore` on every ``evaluate`` call and
-runs each rule through the classic state machine::
+An :class:`AlertEngine` evaluates a declarative set of
+:class:`AlertRule`\\ s — the shipped :func:`default_rules`, optionally
+overlaid by a tuned config's ``alerts`` group via
+:func:`rules_from_config` — against a :class:`~.tsdb.TimeSeriesStore`
+on every ``evaluate`` call and runs each rule through the classic
+state machine::
 
     ok -> pending -> firing -> (resolved) -> ok
 
@@ -94,6 +97,65 @@ def default_rules() -> Tuple[AlertRule, ...]:
     )
 
 
+def rules_from_config(config: Optional[dict],
+                      base: Optional[Tuple[AlertRule, ...]] = None
+                      ) -> Tuple[AlertRule, ...]:
+    """Overlay an ``alerts`` tuned-config group on the shipped ruleset.
+
+    ``config`` is a resolved tuned config (the dict
+    ``FleetRegistry(tuned_for=...)`` loads); its ``alerts`` group may
+    override per-rule knobs — thresholds (``value``), sustain horizons
+    (``for_s``), rate windows (``window_s``), ``op``, ``severity`` —
+    or disable a rule entirely with ``enable: false``. Two spellings
+    are accepted, nested and flat (the tuner's knob grids are flat)::
+
+        {"alerts": {"kv_pressure": {"value": 0.9, "for_s": 30}}}
+        {"alerts": {"kv_pressure.value": 0.9, "gold_burn_high.enable": 0}}
+
+    With no ``alerts`` group (or no config at all) the ``base`` ruleset
+    is returned *unchanged* — same tuple, byte-identical engine
+    behavior — so fleets without a tuned config lose nothing. Unknown
+    rule names and malformed values are ignored per-knob, never raised:
+    a corrupt tuned config degrades to the shipped pages (the same
+    contract as every other ``tuned_group`` consumer).
+    """
+    from ..aot.tuned import tuned_group
+
+    rules = tuple(base) if base is not None else default_rules()
+    group = tuned_group(config, "alerts")
+    if not group:
+        return rules
+    per: Dict[str, dict] = {}
+    for k, v in group.items():
+        if not isinstance(k, str):
+            continue
+        if isinstance(v, dict):
+            per.setdefault(k, {}).update(v)
+        elif "." in k:
+            rname, _, field = k.partition(".")
+            per.setdefault(rname, {})[field] = v
+    out: List[AlertRule] = []
+    for rule in rules:
+        o = per.get(rule.name)
+        if not o:
+            out.append(rule)
+            continue
+        if "enable" in o and not o["enable"]:
+            continue
+        fields: Dict[str, object] = {}
+        for f in ("value", "for_s", "window_s"):
+            if f in o:
+                try:
+                    fields[f] = float(o[f])
+                except (TypeError, ValueError):
+                    pass
+        for f in ("op", "severity"):
+            if f in o and isinstance(o[f], str) and o[f]:
+                fields[f] = o[f]
+        out.append(rule._replace(**fields) if fields else rule)
+    return tuple(out)
+
+
 class _RuleState:
     __slots__ = ("state", "pending_since", "fired_at", "last_value")
 
@@ -113,13 +175,15 @@ class AlertEngine:
     """
 
     def __init__(self, store, *, rules: Optional[Tuple[AlertRule, ...]] = None,
-                 metrics=None, clock=time.monotonic,
-                 max_firings: int = 256):
+                 config: Optional[dict] = None, metrics=None,
+                 clock=time.monotonic, max_firings: int = 256):
         self._store = store
         self._metrics = metrics
         self._clock = clock
+        # explicit rules win; else the tuned config's `alerts` group
+        # overlays the shipped set (no group -> byte-identical default)
         self.rules: Tuple[AlertRule, ...] = (
-            tuple(rules) if rules is not None else default_rules())
+            tuple(rules) if rules is not None else rules_from_config(config))
         self._lock = threading.Lock()
         self._states: Dict[str, _RuleState] = {
             r.name: _RuleState() for r in self.rules}

@@ -58,6 +58,7 @@ class FederatedScraper:
         self.store = store if store is not None else TimeSeriesStore(
             clock=clock, metrics=self._metrics)
         self.alerts = alerts
+        self._decisions_seen = 0   # consumed prefix of the decision log
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if hasattr(router, "telemetry"):
@@ -74,6 +75,9 @@ class FederatedScraper:
         t = self._clock() if now is None else float(now)
         outcomes: Dict[str, str] = {}
         outcomes["router"] = self._pull_router(t)
+        decisions = self._pull_decisions(t)
+        if decisions is not None:
+            outcomes["autoscale"] = decisions
         members = sorted(self._router.membership.ids())
         for rid in members:
             outcomes[rid] = self._pull_replica(rid, t)
@@ -125,6 +129,48 @@ class FederatedScraper:
             self.store.mark_stale(rid, now=t)
             return "error"
         self.store.ingest(rid, snap, now=t, extra_labels={"replica": rid})
+        return "ok"
+
+    def _pull_decisions(self, t: float) -> Optional[str]:
+        """Ingest the autoscaler's canonical decision log as
+        ``autoscale_decision{direction,reason}`` instants.
+
+        The controller's ``decision_log`` is append-only canonical JSON
+        lines; the scraper consumes the unseen suffix each pass and
+        stamps every actuating (non-hold) decision at its own evidence
+        time — so a dashboard overlays the decision exactly on the burn
+        sample it reacted to, not at scrape time. Instants go through
+        :meth:`~.tsdb.TimeSeriesStore.append_instant`, outside the
+        presence-diff tombstoning a scrape snapshot implies. Returns
+        None (no outcome row) when no autoscaler is attached, keeping
+        the scrape label sets of autoscaler-less fleets unchanged.
+        """
+        ctl = getattr(self._router, "autoscaler", None)
+        log = getattr(ctl, "decision_log", None)
+        if log is None:
+            return None
+        # snapshot the length first: the controller appends under its
+        # own lock and list appends are atomic, so the slice below is a
+        # stable prefix even mid-tick
+        end = len(log)
+        lines = log[self._decisions_seen:end]
+        self._decisions_seen = end
+        for line in lines:
+            try:
+                rec = json.loads(line)
+                decision = rec.get("decision") or {}
+                direction = str(decision.get("direction", "hold"))
+                if direction == "hold":
+                    continue  # holds every tick would drown the overlay
+                labels = {"direction": direction,
+                          "reason": str(decision.get("reason", ""))}
+                at = (decision.get("evidence") or {}).get("t", t)
+                value = rec.get("actuated", decision.get("amount", 0))
+                self.store.append_instant(
+                    "autoscale_decision", labels, float(value or 0),
+                    now=float(at), source="autoscale")
+            except (ValueError, TypeError):
+                continue  # one malformed line must not stall the stream
         return "ok"
 
     # -------------------------------------------------------- background

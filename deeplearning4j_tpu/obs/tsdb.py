@@ -162,6 +162,39 @@ class TimeSeriesStore:
         self._export(source, added, live, stale)
         return added
 
+    def append_instant(self, name: str, labels: Dict[str, str],
+                       value: float, now: Optional[float] = None,
+                       source: str = "instant") -> None:
+        """Append one point to an event-style series, outside the
+        presence-diff contract.
+
+        Snapshot ingest treats a source's answered snapshot as
+        authoritative: series it stops reporting are tombstoned
+        forever. Instants (the autoscaler's decision stream) are the
+        opposite shape — stamped once at event time by a dedicated
+        reader, absent from every scrape snapshot — so they must never
+        enter a source's seen-set, never be tombstone candidates, and
+        may carry timestamps older than the newest scrape (the reader
+        catches up on the log). Ring and horizon retention still apply.
+        """
+        t = self._clock() if now is None else float(now)
+        labels = {str(k): str(v) for k, v in (labels or {}).items()}
+        key = (name, _label_key(labels), "")
+        with self._lock:
+            rec = self._series.get(key)
+            if rec is None:
+                rec = self._series[key] = _Series(
+                    "instant", labels, "", self.retention_points)
+            rec.stale_at = None
+            rec.points.append((t, float(value)))
+            horizon = t - self.retention_s
+            while rec.points and rec.points[0][0] < horizon:
+                rec.points.popleft()
+            self._points_total[source] = (
+                self._points_total.get(source, 0) + 1)
+            live, stale = self._counts_locked()
+        self._export(source, 1, live, stale)
+
     def mark_stale(self, source: str, now: Optional[float] = None) -> int:
         """Soft-stale every series of an unreachable source.
 

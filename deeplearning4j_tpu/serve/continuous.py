@@ -844,7 +844,9 @@ class ContinuousBatcher:
         """Advance one chunk of one prompt (paged mode)."""
         import jax.numpy as jnp
 
-        off, true_len, bucket = job.chunks[job.idx]
+        # chunk widths come from _plan_chunks, which only ever emits
+        # members of self._chunk_buckets (see _bucket_chunk)
+        off, true_len, bucket = job.chunks[job.idx]  # jaxlint: dim=bucket:bucket(_chunk_buckets)
         with self._cond:
             if self._slot_job[job.slot] is not job:
                 return  # aborted (forced shutdown) since this tick was planned

@@ -454,6 +454,9 @@ class ServeEngine:
             if x.shape[0] < bucket:  # ALWAYS pad to the bucket — drain path too
                 pad = np.zeros((bucket - x.shape[0],) + x.shape[1:], x.dtype)
                 x = np.concatenate([x, pad])
+            # the pad above makes rows == bucket an invariant, and every
+            # trailing dim was bucketed at submit time (shape_key)
+            # jaxlint: shape=x:(bucket(batch_buckets), bucket(length_buckets))
             sig = (bucket,) + live[0].shape_key
             with self._cond:
                 if self._epoch != epoch:

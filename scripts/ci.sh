@@ -68,6 +68,18 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/autoscale/
 # ratchet baseline instead: currently empty (they are clean too), so the
 # file exists purely to pin the ratchet — any NEW finding there fails CI,
 # and the baseline may only ever shrink.
+# The v4 compile-surface pass proves the serving tier's compile bound
+# statically: continuous-batcher decode = exactly 1 executable, prefill =
+# the committed bucket products. Any jit site whose executable-cardinality
+# bound widens past scripts/compile_budget.json (new site, new symbolic
+# factor, unbounded dim, numeric regression) fails the build; tightening
+# is always allowed. The report uploads next to the SARIF.
+echo "=== jaxlint: compile-surface budget (serve/ + nn/) ==="
+python -m deeplearning4j_tpu.analysis \
+  deeplearning4j_tpu/serve deeplearning4j_tpu/nn \
+  --compile-surface "$CI_ARTIFACTS_DIR/compile_surface.json" \
+  --budget scripts/compile_budget.json
+
 echo "=== jaxlint: ui/ + knn/ (ratchet baseline) ==="
 python -m deeplearning4j_tpu.analysis \
   deeplearning4j_tpu/ui/ deeplearning4j_tpu/knn/ \

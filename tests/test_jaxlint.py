@@ -1555,3 +1555,492 @@ class TestMetricDocsDrift:
         assert len(fs) == 1
         assert "x_total" in fs[0].message
         assert "not documented" in fs[0].message
+
+
+# ================================================= v4: shape interpreter
+class TestShapeTransfer:
+    """Broadcast/promotion transfer-function unit table: evaluate one
+    expression in a fixed environment and check the inferred
+    (shape, dtype) — the interpreter's contract for the ops the
+    serving tree leans on."""
+
+    ENV = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f():
+            a = jnp.zeros((3, 4))
+            b = jnp.ones((4,))
+            i = jnp.zeros((2,), jnp.int32)
+            return {expr}
+        """
+
+    TABLE = [
+        ("a + b", "(3, 4)", "f32"),            # rank-broadcast
+        ("a * 2", "(3, 4)", "f32"),            # weak int never promotes
+        ("a + 1.5", "(3, 4)", "f32"),
+        ("i + 1", "(2)", "i32"),               # weak int keeps i32
+        ("i + 1.5", "(2)", "f32"),             # weak float flips kind only
+        ("a.T", "(4, 3)", "f32"),
+        ("a.sum(axis=0)", "(4)", "f32"),
+        ("a.sum()", "()", "f32"),
+        ("jnp.sum(a, axis=1, keepdims=True)", "(3, 1)", "f32"),
+        ("jnp.concatenate([a, a], axis=1)", "(3, 8)", "f32"),
+        ("jnp.stack([a, a])", "(2, 3, 4)", "f32"),
+        ("a @ jnp.zeros((4, 7))", "(3, 7)", "f32"),
+        ("jnp.expand_dims(b, 0)", "(1, 4)", "f32"),
+        ("a.reshape(2, 6)", "(2, 6)", "f32"),
+        ("jnp.where(a > 0, a, 0.0)", "(3, 4)", "f32"),
+        ("a.astype(jnp.bfloat16)", "(3, 4)", "bf16"),
+        ("jnp.pad(a, ((1, 1), (0, 2)))", "(5, 6)", "f32"),
+    ]
+
+    def _infer(self, expr):
+        import textwrap
+
+        from deeplearning4j_tpu.analysis import function_shapes
+        from deeplearning4j_tpu.analysis.shapes import ArrayVal, render_shape
+        program = build_program(
+            [("pkg/t.py", textwrap.dedent(self.ENV.format(expr=expr)))])
+        mi = program.lookup_module("pkg.t")
+        fs = function_shapes(program, mi.functions["f"])
+        av = fs.return_value
+        assert isinstance(av, ArrayVal), f"{expr!r} -> {av!r}"
+        return render_shape(av.shape), av.dtype
+
+    @pytest.mark.parametrize("expr,shape,dtype", TABLE,
+                             ids=[t[0] for t in TABLE])
+    def test_transfer(self, expr, shape, dtype):
+        assert self._infer(expr) == (shape, dtype)
+
+
+class TestShapeMismatchRule:
+    def test_provable_broadcast_mismatch_flagged_with_shapes(self):
+        fs = lint("""
+            import jax.numpy as jnp
+
+            def f():
+                a = jnp.zeros((3, 4))
+                b = jnp.ones((5, 4))
+                return a + b
+            """, "shape-mismatch")
+        assert names(fs) == ["shape-mismatch"]
+        assert "(3, 4)" in fs[0].message and "(5, 4)" in fs[0].message
+
+    def test_matmul_contraction_mismatch_flagged(self):
+        fs = lint("""
+            import jax.numpy as jnp
+
+            def f():
+                return jnp.zeros((3, 4)) @ jnp.ones((5, 6))
+            """, "shape-mismatch")
+        assert names(fs) == ["shape-mismatch"]
+        assert "4" in fs[0].message and "5" in fs[0].message
+
+    def test_concat_nonaxis_mismatch_flagged(self):
+        fs = lint("""
+            import jax.numpy as jnp
+
+            def f():
+                a = jnp.zeros((3, 4))
+                b = jnp.zeros((3, 9))
+                return jnp.concatenate([a, b], axis=0)
+            """, "shape-mismatch")
+        assert names(fs) == ["shape-mismatch"]
+
+    def test_broadcastable_and_symbolic_shapes_clean(self):
+        fs = lint("""
+            import jax.numpy as jnp
+
+            def f(x):
+                a = jnp.zeros((3, 4))
+                return a + jnp.ones((1, 4)) + jnp.ones((4,)) + x
+            """, "shape-mismatch")
+        assert fs == []
+
+
+class TestUnboundedCompileSignature:
+    def test_payload_dim_reaching_jit_flagged(self):
+        fs = lint("""
+            import json
+
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                return x * 2
+
+            def handle(payload):
+                req = json.loads(payload)
+                n = req["n"]
+                x = jnp.zeros((n, 4))
+                return step(x)
+            """, "unbounded-compile-signature")
+        assert names(fs) == ["unbounded-compile-signature"]
+        assert "step" in fs[0].message and "unbounded" in fs[0].message
+
+    def test_bucketed_dim_clean(self):
+        fs = lint("""
+            import json
+
+            import jax
+            import jax.numpy as jnp
+
+            BUCKETS = (8, 16, 32)
+
+            @jax.jit
+            def step(x):
+                return x * 2
+
+            def handle(payload):
+                n = len(json.loads(payload))
+                b = next((k for k in BUCKETS if k >= n), BUCKETS[-1])
+                x = jnp.zeros((b, 4))
+                return step(x)
+            """, "unbounded-compile-signature")
+        assert fs == []
+
+    def test_teaching_annotation_bounds_a_dim(self):
+        fs = lint("""
+            import json
+
+            import jax
+            import jax.numpy as jnp
+
+            CHUNKS = (16, 32)
+
+            @jax.jit
+            def step(x):
+                return x * 2
+
+            def handle(job):
+                b = job.next_chunk()  # jaxlint: dim=b:bucket(CHUNKS)
+                x = jnp.zeros((1, b))
+                return step(x)
+            """, "unbounded-compile-signature")
+        assert fs == []
+
+
+class TestStaticArgnumUnbounded:
+    def test_env_value_into_static_argnums_flagged(self):
+        fs = lint("""
+            import functools
+            import os
+
+            import jax
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def step(x, width):
+                return x[:width]
+
+            def handle(x):
+                w = int(os.environ["W"])
+                return step(x, w)
+            """, "static-argnum-unbounded")
+        assert names(fs) == ["static-argnum-unbounded"]
+        assert "width" in fs[0].message
+
+    def test_config_value_into_static_argnums_clean(self):
+        fs = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def step(x, width):
+                return x[:width]
+
+            class Server:
+                def __init__(self, width=64):
+                    self.width = int(width)
+
+                def run(self, x):
+                    return step(x, self.width)
+            """, "static-argnum-unbounded")
+        assert fs == []
+
+
+class TestWeakTypePromotion:
+    def test_int_float_mix_across_callsites_flagged(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def scale(x, alpha):
+                return x * alpha
+
+            def warmup(x):
+                return scale(x, 1)
+
+            def serve(x):
+                return scale(x, 0.5)
+            """, "weak-type-promotion")
+        assert names(fs) == ["weak-type-promotion"]
+        assert "alpha" in fs[0].message
+
+    def test_payload_scalar_flagged(self):
+        fs = lint("""
+            import json
+
+            import jax
+
+            @jax.jit
+            def scale(x, alpha):
+                return x * alpha
+
+            def handle(payload, x):
+                t = json.loads(payload)["temperature"]
+                return scale(x, t)
+            """, "weak-type-promotion")
+        assert names(fs) == ["weak-type-promotion"]
+
+    def test_consistent_kind_and_pinned_dtype_clean(self):
+        fs = lint("""
+            import json
+
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def scale(x, alpha):
+                return x * alpha
+
+            def warmup(x):
+                return scale(x, 1.0)
+
+            def serve(x):
+                return scale(x, 0.5)
+
+            def handle(payload, x):
+                t = np.float32(json.loads(payload)["temperature"])
+                return scale(x, t)
+            """, "weak-type-promotion")
+        assert fs == []
+
+
+class TestDonatedShapeDrift:
+    def test_two_literal_donated_shapes_flagged(self):
+        fs = lint("""
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def update(buf, x):
+                return buf + x
+
+            def warm():
+                return update(jnp.zeros((4, 4)), jnp.ones((4, 4)))
+
+            def serve():
+                return update(jnp.zeros((8, 4)), jnp.ones((8, 4)))
+            """, "donated-shape-drift")
+        assert names(fs) == ["donated-shape-drift"]
+        assert "buf" in fs[0].message
+
+    def test_unbounded_donated_shape_flagged(self):
+        fs = lint("""
+            import functools
+            import json
+
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def update(buf, x):
+                return buf + x
+
+            def handle(payload, x):
+                n = json.loads(payload)["n"]
+                return update(jnp.zeros((n, 4)), x)
+            """, "donated-shape-drift")
+        assert names(fs) == ["donated-shape-drift"]
+        assert "request-derived" in fs[0].message
+
+    def test_invariant_donated_shape_clean(self):
+        fs = lint("""
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def update(buf, x):
+                return buf + x
+
+            def warm():
+                return update(jnp.zeros((4, 4)), jnp.ones((4, 4)))
+
+            def serve():
+                return update(jnp.zeros((4, 4)), jnp.ones((1, 4)))
+            """, "donated-shape-drift")
+        assert fs == []
+
+
+class TestCrossModuleBucket:
+    """A traced dim that is only provably bounded because the bucketing
+    helper lives in ANOTHER module — a per-file pass sees an opaque
+    call and could only report unknown; the program-wide interpreter
+    follows the call into the helper's summary."""
+
+    FILES = {
+        "pkg/buckets.py": """
+            PROMPT_BUCKETS = (16, 32, 64)
+
+            def pick(n):
+                for b in PROMPT_BUCKETS:
+                    if b >= n:
+                        return b
+                return PROMPT_BUCKETS[-1]
+            """,
+        "pkg/srv.py": """
+            import json
+
+            import jax
+            import jax.numpy as jnp
+
+            from pkg.buckets import pick
+
+            @jax.jit
+            def prefill(ids):
+                return ids * 2
+
+            def handle(payload):
+                n = len(json.loads(payload))
+                ids = jnp.zeros((1, pick(n)))
+                return prefill(ids)
+            """,
+    }
+
+    def test_cross_module_bucket_propagation_clean(self):
+        fs = lint_program(self.FILES, "unbounded-compile-signature")
+        assert fs == []
+
+    def test_compile_surface_bound_is_bucket_cardinality(self):
+        import textwrap
+
+        from deeplearning4j_tpu.analysis import compute_surface, site_bound
+        program = build_program(
+            [(p, textwrap.dedent(s)) for p, s in self.FILES.items()])
+        sites = compute_surface(program)
+        (site,) = [s for s in sites if s.site_id.endswith(":prefill")]
+        bound, numeric, _ = site_bound(site)
+        assert bound == "|PROMPT_BUCKETS|"
+        assert numeric == 3   # the table is a source literal
+
+    def test_unbounded_without_the_bucket_helper(self):
+        # the same server module with the helper bypassed IS flagged —
+        # proving the clean result above comes from the propagation
+        files = dict(self.FILES)
+        files["pkg/srv.py"] = files["pkg/srv.py"].replace(
+            "pick(n)", "n", 1).replace("jnp.zeros((1, n))",
+                                       "jnp.zeros((1, n))")
+        fs = lint_program(files, "unbounded-compile-signature")
+        assert names(fs) == ["unbounded-compile-signature"]
+
+
+class TestCompileBudget:
+    """Round-trip through the real CLI: a fixture tree within budget
+    exits 0; widening the compile surface past the committed budget
+    (the regression CI must catch) exits 1."""
+
+    SRC = """
+        import jax
+        import jax.numpy as jnp
+
+        BUCKETS = (8, 16, 32)
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def handle(n):
+            b = next((k for k in BUCKETS if k >= n), BUCKETS[-1])
+            return step(jnp.zeros((b, 4)))
+        """
+
+    def _write_tree(self, tmp_path, src):
+        pkg = tmp_path / "svc"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "srv.py").write_text(textwrap.dedent(src))
+        return pkg
+
+    def _budget(self, tmp_path, bound):
+        b = tmp_path / "compile_budget.json"
+        b.write_text(json.dumps(
+            {"sites": {"svc.srv:step": {"bound": bound, "why": "test"}}}))
+        return b
+
+    def test_within_budget_exits_zero(self, tmp_path, capsys,
+                                      monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        pkg = self._write_tree(tmp_path, self.SRC)
+        out = tmp_path / "compile_surface.json"
+        budget = self._budget(tmp_path, "|BUCKETS|")
+        rc = cli_main(["svc", "--compile-surface", str(out),
+                       "--budget", str(budget)])
+        assert rc == 0
+        assert "compile budget: ok" in capsys.readouterr().out
+        report = json.loads(out.read_text())
+        (site,) = report["sites"]
+        assert site["site"] == "svc.srv:step"
+        assert site["bound"] == "|BUCKETS|"
+        assert site["numeric"] == 3
+
+    def test_cardinality_regression_exits_nonzero(self, tmp_path, capsys,
+                                                  monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        # the bucketing is bypassed: the traced dim is now unbounded,
+        # so the surface widens past the committed |BUCKETS| budget
+        regressed = self.SRC.replace(
+            "b = next((k for k in BUCKETS if k >= n), BUCKETS[-1])",
+            "b = n")
+        pkg = self._write_tree(tmp_path, regressed)
+        out = tmp_path / "compile_surface.json"
+        budget = self._budget(tmp_path, "|BUCKETS|")
+        rc = cli_main(["svc", "--compile-surface", str(out),
+                       "--budget", str(budget)])
+        assert rc == 1
+        assert "compile-budget:" in capsys.readouterr().out
+
+    def test_new_site_without_budget_entry_fails(self, tmp_path, capsys,
+                                                 monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        extra = self.SRC + """
+
+        @jax.jit
+        def extra_step(x):
+            return x + 1
+
+        def more(x):
+            return extra_step(x)
+        """
+        pkg = self._write_tree(tmp_path, extra)
+        out = tmp_path / "compile_surface.json"
+        budget = self._budget(tmp_path, "|BUCKETS|")
+        rc = cli_main(["svc", "--compile-surface", str(out),
+                       "--budget", str(budget)])
+        assert rc == 1
+        assert "extra_step" in capsys.readouterr().out
+
+    def test_tightening_is_always_allowed(self, tmp_path, capsys,
+                                          monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        # actual bound 1 (literal shape) under a |BUCKETS| budget: ok
+        tightened = self.SRC.replace(
+            "b = next((k for k in BUCKETS if k >= n), BUCKETS[-1])",
+            "b = 8")
+        pkg = self._write_tree(tmp_path, tightened)
+        out = tmp_path / "compile_surface.json"
+        budget = self._budget(tmp_path, "|BUCKETS|")
+        rc = cli_main(["svc", "--compile-surface", str(out),
+                       "--budget", str(budget)])
+        assert rc == 0
+
+    def test_budget_requires_surface_flag(self, tmp_path):
+        with pytest.raises(SystemExit):
+            cli_main([".", "--budget",
+                      str(self._budget(tmp_path, "|BUCKETS|"))])

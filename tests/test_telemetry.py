@@ -424,3 +424,180 @@ class TestSimAlertPenalty:
         report = summarize("fp", self._outcomes(), mode="virtual")
         assert "alerts" not in report
         assert score(report) == report["score"]
+
+
+# =========================================== ISSUE 15: alerts tuned config
+class TestAlertRulesFromConfig:
+    """The `alerts` tuned-config group overlays the shipped ruleset;
+    no group (or no config) must be byte-identical to the default."""
+
+    def test_no_config_returns_base_unchanged(self):
+        from deeplearning4j_tpu.obs.alerts import (default_rules,
+                                                   rules_from_config)
+        base = default_rules()
+        assert rules_from_config(None) == base
+        assert rules_from_config({}) == base
+        # an unrelated group is not an alerts group
+        assert rules_from_config({"engine": {"batch_buckets": [1]}}) == base
+
+    def test_nested_and_flat_overrides(self):
+        from deeplearning4j_tpu.obs.alerts import rules_from_config
+        tuned = {"alerts": {
+            "kv_pressure": {"value": 0.9, "for_s": 30},
+            "spawn_failures.window_s": 60,
+            "gold_burn_high.enable": 0,
+        }}
+        d = {r.name: r for r in rules_from_config(tuned)}
+        assert "gold_burn_high" not in d
+        assert d["kv_pressure"].value == 0.9
+        assert d["kv_pressure"].for_s == 30.0
+        assert d["spawn_failures"].window_s == 60.0
+        # untouched rules keep their shipped knobs
+        assert d["breaker_open"].value == 1.5
+
+    def test_malformed_knobs_degrade_per_knob(self):
+        from deeplearning4j_tpu.obs.alerts import rules_from_config
+        tuned = {"alerts": {
+            "breaker_open": {"value": "NaN-ish garbage no float",
+                             "severity": "warn"},
+            "no_such_rule": {"value": 1.0},
+        }}
+        # severity applies, the unparseable threshold is ignored, the
+        # unknown rule name is ignored — nothing raises
+        tuned["alerts"]["breaker_open"]["value"] = "garbage"
+        d = {r.name: r for r in rules_from_config(tuned)}
+        assert d["breaker_open"].value == 1.5
+        assert d["breaker_open"].severity == "warn"
+        assert "no_such_rule" not in d
+
+    def test_engine_config_kwarg(self):
+        from deeplearning4j_tpu.obs.alerts import default_rules
+        clock = _Clock()
+        store = TimeSeriesStore(clock=clock)
+        tuned = {"alerts": {"kv_pressure.value": 0.5}}
+        eng = AlertEngine(store, config=tuned, clock=clock)
+        d = {r.name: r for r in eng.rules}
+        assert d["kv_pressure"].value == 0.5
+        # no config -> exactly the shipped tuple
+        assert AlertEngine(store, config=None, clock=clock).rules \
+            == default_rules()
+        # explicit rules win over config
+        only = (default_rules()[0],)
+        assert AlertEngine(store, rules=only, config=tuned,
+                           clock=clock).rules == only
+
+    def test_tuned_threshold_changes_firing(self):
+        clock = _Clock()
+        store = TimeSeriesStore(clock=clock)
+        store.ingest("r", _gauge_snap("serve_kv_block_utilization", 0.8),
+                     now=clock.t)
+        tuned = {"alerts": {"kv_pressure": {"value": 0.5, "for_s": 0}}}
+        eng = AlertEngine(store, config=tuned, clock=clock)
+        eng.evaluate()
+        assert "kv_pressure" in eng.active()
+        # the shipped 0.95 threshold would not have fired at 0.8
+        quiet = AlertEngine(store, clock=clock)
+        quiet.evaluate()
+        assert "kv_pressure" not in quiet.active()
+
+
+# ========================================= ISSUE 15: decision-log ingest
+class _DecisionMembership:
+    def ids(self):
+        return []
+
+    def state(self, rid):
+        raise KeyError(rid)
+
+
+class _DecisionRouter:
+    """Minimal FederatedScraper target: metrics-only router plus an
+    optional autoscaler carrying a canonical decision log."""
+
+    def __init__(self, autoscaler=None):
+        self.metrics = MetricsRegistry()
+        self.membership = _DecisionMembership()
+        self.telemetry = None
+        self.autoscaler = autoscaler
+
+    def _transport(self, *a):
+        raise AssertionError("no replicas in this fixture")
+
+
+class _FakeAutoscaler:
+    def __init__(self):
+        self.decision_log = []
+
+    def log(self, direction, reason, amount, actuated, t):
+        self.decision_log.append(json.dumps(
+            {"tick": len(self.decision_log), "current": 1, "actual": 1,
+             "actuated": actuated, "retired": [],
+             "decision": {"direction": direction, "amount": amount,
+                          "reason": reason, "evidence": {"t": t}}},
+            sort_keys=True, separators=(",", ":")))
+
+
+class TestDecisionIngest:
+    def _scraper(self, autoscaler):
+        from deeplearning4j_tpu.obs.scrape import FederatedScraper
+        clock = _Clock()
+        router = _DecisionRouter(autoscaler)
+        s = FederatedScraper(router, clock=clock, interval_s=999)
+        return s, clock
+
+    def test_decisions_become_instants_at_evidence_time(self):
+        ctl = _FakeAutoscaler()
+        ctl.log("out", "burn", 2, 2, t=950.0)
+        ctl.log("hold", "in_band", 0, 0, t=960.0)
+        ctl.log("in", "low_burn", 1, 1, t=970.0)
+        s, clock = self._scraper(ctl)
+        out = s.scrape_once()
+        assert out["autoscale"] == "ok"
+        series = s.store.query("autoscale_decision")
+        by_dir = {tuple(sorted(e["labels"].items())): e for e in series}
+        o = by_dir[(("direction", "out"), ("reason", "burn"))]
+        # stamped at the decision's own evidence time, not scrape time
+        assert o["points"] == [[950.0, 2.0]]
+        i = by_dir[(("direction", "in"), ("reason", "low_burn"))]
+        assert i["points"] == [[970.0, 1.0]]
+        # holds are not overlay events
+        assert len(series) == 2
+
+    def test_log_consumed_incrementally_no_duplicates(self):
+        ctl = _FakeAutoscaler()
+        ctl.log("out", "burn", 1, 1, t=950.0)
+        s, clock = self._scraper(ctl)
+        s.scrape_once()
+        s.scrape_once()   # nothing new
+        ctl.log("out", "queue", 1, 1, t=980.0)
+        s.scrape_once()
+        pts = [p for e in s.store.query("autoscale_decision")
+               for p in e["points"]]
+        assert sorted(pts) == [[950.0, 1.0], [980.0, 1.0]]
+
+    def test_instants_survive_snapshot_presence_diff(self):
+        ctl = _FakeAutoscaler()
+        ctl.log("out", "burn", 1, 1, t=950.0)
+        s, clock = self._scraper(ctl)
+        s.scrape_once()
+        # later router snapshots do not mention autoscale_decision;
+        # the presence diff must not tombstone the instant series
+        clock.t += 10
+        s.scrape_once()
+        series = s.store.query("autoscale_decision")
+        assert series and not series[0]["stale"]
+        assert s.store.latest("autoscale_decision")
+
+    def test_malformed_lines_skipped(self):
+        ctl = _FakeAutoscaler()
+        ctl.decision_log.append("{not json")
+        ctl.log("out", "burn", 1, 1, t=950.0)
+        s, clock = self._scraper(ctl)
+        assert s.scrape_once()["autoscale"] == "ok"
+        assert len(s.store.query("autoscale_decision")) == 1
+
+    def test_no_autoscaler_no_outcome_row(self):
+        s, clock = self._scraper(None)
+        out = s.scrape_once()
+        assert "autoscale" not in out
+        assert s.store.query("autoscale_decision") == []
