@@ -471,7 +471,12 @@ def _bench_coldstart():
     the process-level JAX_COMPILATION_CACHE_DIR set at the top of this
     file, which accelerates *re-tracing*; the store win measured here is
     skipping tracing altogether, so both numbers are reported side by
-    side. Writes the next free BENCH_coldstart_rNN.json.
+    side. A third leg re-boots the same stacks in STRICT AOT mode
+    (ISSUE 16): every executable must come from the prebuilt store — a
+    miss would raise a typed AotTraceError instead of tracing — so the
+    strict number is the true production replica boot cost, with the
+    tracer provably out of the path. Writes the next free
+    BENCH_coldstart_rNN.json.
     """
     import tempfile
 
@@ -486,7 +491,7 @@ def _bench_coldstart():
                  or tempfile.mkdtemp(prefix="dl4j_aot_"))
     dev = jax.devices()[0]
 
-    def run():
+    def run(strict=False):
         model = CausalLM(seed=0, input_shape=(32,), num_layers=2, d_model=64,
                          num_heads=4, vocab=256).build()
         model.init()
@@ -494,11 +499,11 @@ def _bench_coldstart():
         store = AotStore(store_dir)
         t0 = time.perf_counter()
         eng = ServeEngine(model, batch_buckets=(1, 2, 4, 8), metrics=m,
-                          aot_store=store)
+                          aot_store=store, strict_aot=strict)
         eng.warm(np.int32)
         cb = ContinuousBatcher(model, slots=4, capacity=32,
                                prompt_buckets=(8, 16), metrics=m,
-                               aot_store=store)
+                               aot_store=store, strict_aot=strict)
         boot_s = time.perf_counter() - t0
         t1 = time.perf_counter()
         handle = cb.submit(np.arange(12, dtype=np.int32) % 256, 8,
@@ -527,12 +532,18 @@ def _bench_coldstart():
 
     cold = run()
     warm = run()
+    # leg 3: the production configuration — strict mode, prebuilt store.
+    # Any miss here would raise (typed AotTraceError), so compile_misses
+    # == 0 is enforced by construction, not just asserted after the fact.
+    strict = run(strict=True)
+    assert strict["compile_misses"] == 0, strict
     headline = {
         "metric": "serve_cold_start_speedup",
         "value": round(cold["boot_seconds"] / max(warm["boot_seconds"], 1e-9),
                        2),
         "unit": "x",
         "detail": {"store": store_dir, "cold": cold, "warm": warm,
+                   "strict_prebuilt": strict,
                    "device": str(dev.device_kind),
                    "captured": time.strftime("%Y-%m-%d")},
     }

@@ -15,6 +15,13 @@ pass and instead writes the static executable-cardinality report (one
 entry per jit site, see :mod:`.compilesurface`) to FILE; with
 ``--budget FILE`` the report is checked against the committed budget
 and any regression exits 1.
+
+Enumeration mode (the prebuild bridge): adding ``--enumerate-manifest
+OUT --serve-config CONFIG`` to a ``--compile-surface --budget`` run
+expands every budgeted site's symbolic bound against CONFIG's concrete
+bucket tables (see :mod:`.enumerate`) and writes the
+``prebuild_manifest.json`` that ``python -m deeplearning4j_tpu.aot
+prebuild --from-surface`` compiles into the store.
 """
 
 from __future__ import annotations
@@ -60,6 +67,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="with --compile-surface: check the report "
                          "against this committed budget; regressions "
                          "exit 1")
+    ap.add_argument("--enumerate-manifest", metavar="FILE",
+                    help="with --compile-surface and --budget: expand the "
+                         "budgeted bounds against --serve-config's bucket "
+                         "tables and write the prebuild manifest to FILE")
+    ap.add_argument("--serve-config", metavar="FILE",
+                    help="concrete serving config (engine/gen knob groups) "
+                         "the enumeration resolves bucket tables from")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -71,6 +85,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.budget and not args.compile_surface:
         ap.error("--budget requires --compile-surface")
+    if args.enumerate_manifest and not (args.budget and args.serve_config):
+        ap.error("--enumerate-manifest requires --compile-surface, "
+                 "--budget and --serve-config")
     if args.compile_surface:
         import json as _json
 
@@ -96,6 +113,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"{len(violations)} budget violation(s)")
                 return 1
             print("compile budget: ok")
+            if args.enumerate_manifest:
+                from .enumerate import (enumerate_surface,
+                                        load_serve_config, write_manifest)
+
+                try:
+                    config = load_serve_config(args.serve_config)
+                except (ValueError, OSError) as e:
+                    ap.error(f"cannot read serve config "
+                             f"{args.serve_config}: {e}")
+                try:
+                    manifest = enumerate_surface(report, budget, config)
+                except ValueError as e:
+                    print(f"enumerate: {e}")
+                    return 1
+                write_manifest(manifest, args.enumerate_manifest)
+                print(f"jaxlint: enumerate — "
+                      f"{len(manifest['sites'])} site(s), "
+                      f"{manifest['total_signatures']} signature(s), "
+                      f"hash {manifest['hash']} "
+                      f"-> {args.enumerate_manifest}")
         return 0
 
     rules = ALL_RULES

@@ -475,11 +475,20 @@ def check_budget(report: dict, budget: dict) -> List[str]:
     symbolic factor the budget does not allow, or exceeds a numeric
     budget (``max``). Tightening is always allowed. New sites must be
     added to the budget (with a ``why``) before CI passes.
+
+    A budget entry naming a site the tree no longer has is ALSO a
+    failure: a stale entry silently stops guarding anything (a renamed
+    site re-enters as "new site" only until someone pastes the old bound
+    under the new name, and the prebuild manifest would enumerate
+    executables nobody can ever serve). Tightening by *deleting* the
+    entry is the allowed fix.
     """
     allowed: Dict[str, dict] = budget.get("sites", {})
     out: List[str] = []
+    seen: Set[str] = set()
     for row in report.get("sites", []):
         site = row["site"]
+        seen.add(site)
         entry = allowed.get(site)
         if entry is None:
             out.append(f"{site}: new jit site with no budget entry "
@@ -511,6 +520,10 @@ def check_budget(report: dict, budget: dict) -> List[str]:
                 and row["numeric"] > max_n:
             out.append(f"{site}: numeric bound {row['numeric']} exceeds "
                        f"budget max {max_n}")
+    for site in sorted(set(allowed) - seen):
+        out.append(f"{site}: stale budget entry — no such jit site in the "
+                   f"analyzed tree (bound {allowed[site].get('bound')!r}); "
+                   "delete the entry (tightening) or fix the site name")
     return out
 
 

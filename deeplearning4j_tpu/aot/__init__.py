@@ -24,11 +24,14 @@ a store from the command line.
 from .compile import AotFunction, deserialize_compiled, serialize_compiled
 from .keys import arch_fingerprint, cache_key, call_signature, \
     runtime_fingerprint
+from .manifest import (load_coverage, load_manifest, missing_signatures,
+                       record_coverage)
 from .store import AotCorruptEntry, AotStore, AotStoreError, AotVersionError
 from .tuned import get_tuned, put_tuned, tuned_group, tuned_key
 
 __all__ = ["AotCorruptEntry", "AotFunction", "AotStore", "AotStoreError",
            "AotVersionError", "arch_fingerprint", "cache_key",
            "call_signature", "deserialize_compiled", "get_tuned",
-           "put_tuned", "runtime_fingerprint", "serialize_compiled",
-           "tuned_group", "tuned_key"]
+           "load_coverage", "load_manifest", "missing_signatures",
+           "put_tuned", "record_coverage", "runtime_fingerprint",
+           "serialize_compiled", "tuned_group", "tuned_key"]

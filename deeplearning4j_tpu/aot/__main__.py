@@ -4,12 +4,15 @@
 
     python -m deeplearning4j_tpu.aot --store DIR list
     python -m deeplearning4j_tpu.aot --store DIR stats
-    python -m deeplearning4j_tpu.aot --store DIR verify
+    python -m deeplearning4j_tpu.aot --store DIR verify \
+        [--manifest prebuild_manifest.json]
     python -m deeplearning4j_tpu.aot --store DIR gc [--max-bytes N]
     python -m deeplearning4j_tpu.aot --store DIR prebuild --model causallm \
         --model-kwargs '{"input_shape":[16],"num_layers":2,"d_model":32,
                          "num_heads":4,"vocab":50}' \
         --slots 4 --capacity 16 --batch-buckets 1,2,4,8
+    python -m deeplearning4j_tpu.aot --store DIR prebuild \
+        --from-surface prebuild_manifest.json
 
 ``prebuild`` boots the real serving stacks (``ServeEngine`` +
 ``ContinuousBatcher``) against the store with warm-at-construction on, so
@@ -17,6 +20,15 @@ the exact executables a replica will need are compiled and persisted
 *now* — a new replica (or the next hot-swap) then boots from disk instead
 of the tracer. Run it on the same jax/jaxlib + device topology the fleet
 serves on; the cache keys make a mismatched prebuild a harmless miss.
+
+``prebuild --from-surface`` is the build-farm mode: the manifest written
+by ``python -m deeplearning4j_tpu.analysis --enumerate-manifest`` carries
+the serving config, so the warm pass compiles exactly the statically
+budgeted signature product (abstract leaves only — nothing executes,
+donation-safe), cross-checks the warmed key count against every site's
+enumerated cardinality, and stamps a coverage record keyed on (runtime
+fingerprint, manifest hash). ``verify --manifest`` then gates shipping:
+exit 1 listing every manifest obligation the store cannot serve.
 """
 
 from __future__ import annotations
@@ -26,6 +38,7 @@ import json
 import os
 import sys
 
+from .manifest import load_manifest, missing_signatures, record_coverage
 from .store import AotStore
 
 
@@ -57,12 +70,25 @@ def _cmd_stats(store: AotStore, _args) -> int:
     return 0
 
 
-def _cmd_verify(store: AotStore, _args) -> int:
+def _cmd_verify(store: AotStore, args) -> int:
     out = store.verify()
     print(f"ok={len(out['ok'])} quarantined={len(out['quarantined'])}")
     for key in out["quarantined"]:
         print(f"quarantined: {key}")
-    return 1 if out["quarantined"] else 0
+    rc = 1 if out["quarantined"] else 0
+    if getattr(args, "manifest", None):
+        manifest = load_manifest(args.manifest)
+        missing = missing_signatures(store, manifest)
+        for line in missing:
+            print(f"missing: {line}")
+        if missing:
+            print(f"manifest {manifest['hash']}: "
+                  f"{len(missing)} obligation(s) unmet")
+            rc = 1
+        else:
+            print(f"manifest {manifest['hash']}: fully covered "
+                  f"({manifest.get('total_signatures')} signature(s))")
+    return rc
 
 
 def _cmd_gc(store: AotStore, args) -> int:
@@ -76,7 +102,108 @@ def _cmd_rebuild_index(store: AotStore, _args) -> int:
     return 0
 
 
+def _prebuild_from_surface(store: AotStore, args) -> int:
+    """Build-farm mode: compile exactly the manifest's signature product
+    into the store (abstract leaves — nothing executes) and stamp the
+    coverage record strict replicas verify against at boot. Exits 1 on
+    *surface drift*: a site whose warmed executable count differs from
+    the enumerated cardinality, i.e. the static analysis and the booted
+    code no longer agree on the compile surface."""
+    import time
+
+    import numpy as np
+
+    from ..models import model_by_name
+    from ..obs.metrics import MetricsRegistry
+    from ..serve import ContinuousBatcher, ServeEngine
+    from ..serve.continuous import gen_opts_from_config
+    from ..serve.engine import ENGINE_KNOBS
+
+    manifest = load_manifest(args.from_surface)
+    config = manifest.get("config") or {}
+    if not config.get("model"):
+        print("prebuild --from-surface: manifest carries no serving "
+              "config (regenerate with --enumerate-manifest "
+              "--serve-config)", file=sys.stderr)
+        return 1
+    model = model_by_name(config["model"], seed=int(config.get("seed", 0)),
+                          **(config.get("model_kwargs") or {})).init()
+    metrics = MetricsRegistry()
+    m_secs = metrics.gauge(
+        "aot_prebuild_seconds",
+        help="wall time of the last prebuild --from-surface warm pass")
+    m_drift = metrics.counter(
+        "aot_prebuild_drift_total",
+        help="manifest sites whose warmed executable count diverged from "
+             "the enumerated cardinality")
+
+    engine_opts = {k: v for k, v in (config.get("engine") or {}).items()
+                   if k in ENGINE_KNOBS}
+    fns: dict = {}
+    t0 = time.perf_counter()
+    eng = ServeEngine(model, aot_store=store, metrics=metrics,
+                      **engine_opts)
+    try:
+        eng.warm(np.dtype(config.get("dtype") or "int32"))
+        fns.update(eng.aot_functions())
+    finally:
+        eng.shutdown()
+    if not config.get("predict_only"):
+        try:
+            cb = ContinuousBatcher(model, aot_store=store, metrics=metrics,
+                                   **gen_opts_from_config(config))
+            fns.update(cb.aot_functions())
+            cb.shutdown()  # warm-at-construction already persisted all
+        except ValueError as e:
+            # non-token model: no generation stack exists to prebuild
+            print(f"prebuild: skipping generation stack ({e})",
+                  file=sys.stderr)
+    elapsed = time.perf_counter() - t0
+    m_secs.set(elapsed)
+
+    tags = {tag: fn.warmed_keys() for tag, fn in fns.items()}
+    drift = []
+    for site in manifest.get("sites", []):
+        tag = site["tag"]
+        got = len(tags.get(tag, []))
+        metrics.counter("aot_prebuild_signatures_total", {"tag": tag},
+                        help="signatures compiled+persisted by prebuild "
+                             "--from-surface").inc(got)
+        if got != site["cardinality"]:
+            m_drift.inc()
+            drift.append(
+                f"{tag}: warmed {got} executable(s) but the manifest "
+                f"enumerates {site['cardinality']} for {site['site']}")
+    if drift:
+        for line in drift:
+            print(f"surface drift: {line}", file=sys.stderr)
+        print("prebuild --from-surface: the booted stacks and the static "
+              "enumeration disagree — re-run the compile-surface pass and "
+              "regenerate the manifest", file=sys.stderr)
+        return 1
+    record = record_coverage(
+        store, manifest, tags,
+        extra={"model": config["model"], "prebuild_seconds": elapsed})
+    print(json.dumps({
+        "manifest": manifest["hash"],
+        "model": config["model"],
+        "sites": {tag: len(keys) for tag, keys in sorted(tags.items())},
+        "total_signatures": sum(len(k) for k in tags.values()),
+        "prebuild_seconds": elapsed,
+        "coverage_record": record,
+        "store": store.stats(),
+    }, indent=1))
+    return 0
+
+
 def _cmd_prebuild(store: AotStore, args) -> int:
+    if getattr(args, "from_surface", None):
+        return _prebuild_from_surface(store, args)
+    if not args.model:
+        print("prebuild: --model (or --from-surface MANIFEST) is required",
+              file=sys.stderr)
+        return 2
+
     import numpy as np
 
     from ..models import model_by_name
@@ -124,13 +251,22 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("list", help="list entries, most recently used first")
     sub.add_parser("stats", help="entry/byte/quarantine totals as JSON")
-    sub.add_parser("verify", help="integrity-check (and quarantine) entries")
+    vf = sub.add_parser("verify",
+                        help="integrity-check (and quarantine) entries")
+    vf.add_argument("--manifest", default=None,
+                    help="also gate on a prebuild manifest: exit 1 listing "
+                         "every enumerated signature the store cannot serve")
     sub.add_parser("rebuild-index", help="regenerate the manifest from disk")
     gc = sub.add_parser("gc", help="LRU-evict down to the size bound")
     gc.add_argument("--max-bytes", type=int, default=None)
     pb = sub.add_parser("prebuild",
                         help="compile + persist a model's serving executables")
-    pb.add_argument("--model", required=True,
+    pb.add_argument("--from-surface", default=None, metavar="MANIFEST",
+                    help="compile the enumerated compile-surface manifest "
+                         "(from analysis --enumerate-manifest) and stamp a "
+                         "coverage record; all other prebuild flags are "
+                         "taken from the manifest's embedded config")
+    pb.add_argument("--model", default=None,
                     help="zoo model name (e.g. causallm)")
     pb.add_argument("--model-kwargs", default="",
                     help="JSON kwargs for the zoo constructor")

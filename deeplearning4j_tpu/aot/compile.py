@@ -83,18 +83,31 @@ class AotFunction:
     aliasing contract is baked into ``fn`` itself); ``compile_counter`` is
     incremented on live traces only, so a warm boot reads as zero compile
     misses on the serving counters.
+
+    ``strict=True`` inverts the degradation rule: a signature the store
+    does not yield a loadable executable for (absent entry, corrupt blob,
+    version skew, store I/O failure) raises a typed
+    :class:`~..serve.errors.AotTraceError` instead of tracing — counted on
+    ``serve_aot_strict_misses_total`` — so a replica deployed against a
+    prebuilt store can never silently compile at request time.
     """
 
     def __init__(self, fn: Callable, *, tag: str,
                  store: Optional[AotStore] = None, metrics=None,
                  arch: str = "", component: str = "serve",
                  donate_argnums: Sequence[int] = (),
-                 compile_counter=None, retry: Optional[RetryPolicy] = None):
+                 compile_counter=None, retry: Optional[RetryPolicy] = None,
+                 strict: bool = False):
         self._fn = fn
         self.tag = tag
         self.store = store if hasattr(fn, "lower") else None
         self.arch = arch
         self.donate = tuple(donate_argnums)
+        self.strict = bool(strict) and self.store is not None
+        if strict and self.store is None:
+            raise ValueError(
+                f"AotFunction(tag={tag!r}): strict mode requires a store "
+                "and a lowerable (jitted) function")
         self._compile_counter = compile_counter
         # transient store-read failures (NFS hiccup, torn page cache) are
         # retried before falling back to a live trace; corrupt entries are
@@ -103,6 +116,7 @@ class AotFunction:
             attempts=3, base_s=0.02, cap_s=0.5, metrics=metrics)
         self._runtime = None  # resolved lazily: jax may not be booted yet
         self._exes: dict = {}
+        self._keys: dict = {}  # signature -> store key, for coverage records
         self._lock = threading.RLock()
         self._acquire_seconds = 0.0
         if metrics is not None and self.store is not None:
@@ -116,6 +130,9 @@ class AotFunction:
             self._m_fallback = lambda cause: metrics.counter(
                 "serve_aot_fallback_total", {**labels, "cause": cause},
                 help="store entries abandoned for live tracing, by cause")
+            self._m_strict = metrics.counter(
+                "serve_aot_strict_misses_total", labels,
+                help="signatures refused (typed 503) by strict AOT mode")
         else:
             from ..obs.metrics import MetricsRegistry
 
@@ -127,6 +144,8 @@ class AotFunction:
             self._m_misses = null.counter("serve_aot_misses_total", labels)
             self._m_fallback = lambda cause: null.counter(
                 "serve_aot_fallback_total", {**labels, "cause": cause})
+            self._m_strict = null.counter(
+                "serve_aot_strict_misses_total", labels)
 
     # ------------------------------------------------------------------ calls
     def __call__(self, *args):
@@ -157,6 +176,13 @@ class AotFunction:
         with self._lock:
             return dict(self._exes)
 
+    def warmed_keys(self) -> list:
+        """Sorted store keys of every executable this wrapper acquired —
+        the concrete coverage a prebuild run stamps into the store's
+        coverage record (``aot/manifest.py``)."""
+        with self._lock:
+            return sorted(set(self._keys.values()))
+
     @property
     def acquire_seconds(self) -> float:
         """Cumulative wall time spent loading/compiling executables — the
@@ -183,12 +209,25 @@ class AotFunction:
             with _rt.span("aot.acquire", tag=self.tag):
                 exe = self._load(key)
                 if exe is None:
+                    if self.strict:
+                        # the deployment contract: every signature was
+                        # prebuilt from the static surface — a miss is a
+                        # typed 503, NEVER a trace
+                        from ..serve.errors import AotTraceError
+
+                        self._m_strict.inc()
+                        raise AotTraceError(
+                            f"strict AOT: no store executable for "
+                            f"tag={self.tag!r} key={key[:16]}… — prebuild "
+                            "the store from the compile-surface manifest "
+                            "(aot prebuild --from-surface)")
                     with _rt.span("aot.trace", tag=self.tag):
                         exe = self._fn.lower(*args).compile()
                     if self._compile_counter is not None:
                         self._compile_counter.inc()  # a real trace happened
                     self._save(key, exe)
             self._exes[sig] = exe
+            self._keys[sig] = key
             self._acquire_seconds += time.perf_counter() - t0
             return exe
 

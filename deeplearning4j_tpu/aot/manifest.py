@@ -1,0 +1,147 @@
+"""Prebuild manifests and store coverage records.
+
+The enumeration pass (``analysis/enumerate.py``) expands the committed
+compile-surface budget against one concrete serving config into
+``prebuild_manifest.json`` — the explicit list of (site, bucket-signature)
+pairs a replica's boot will demand. This module owns the *deployment*
+half of that contract:
+
+- ``aot prebuild --from-surface`` compiles the manifest product into the
+  store and stamps a **coverage record** — the concrete store keys it
+  warmed, keyed on ``(runtime fingerprint, manifest hash)``. Cache keys
+  fold in the jax/jaxlib pair, backend, topology and model architecture,
+  so a record stamped on one runtime is simply *absent* on another — a
+  build host with the wrong jaxlib cannot fake coverage.
+- ``aot verify --manifest`` (and a strict boot) loads the record for the
+  *current* runtime and lists every key the store no longer holds — the
+  gate a build farm ships on and a strict replica refuses to pass
+  readiness without.
+
+Records live under ``<store-root>/coverage/`` — the store's entry scanner
+only descends into two-character fan-out directories, so coverage records
+are never mistaken for executables, never GC'd by the LRU, and ride along
+when a store directory is rsync'd to a replica.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import List, Optional
+
+from ..analysis.enumerate import manifest_hash
+from .keys import runtime_fingerprint
+from .store import AotStore
+
+COVERAGE_SCHEMA = 1
+
+
+def load_manifest(path: str) -> dict:
+    """Read a prebuild manifest and verify its self-hash — a hand-edited
+    manifest must fail loudly, not ship a partial surface."""
+    with open(path, "r", encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    if not isinstance(manifest, dict) or "sites" not in manifest:
+        raise ValueError(f"{path}: not a prebuild manifest")
+    want = manifest.get("hash")
+    got = manifest_hash(manifest)
+    if want != got:
+        raise ValueError(f"{path}: manifest hash mismatch "
+                         f"(stamped {want}, computed {got}) — regenerate "
+                         "it with --enumerate-manifest")
+    return manifest
+
+
+def runtime_hash(runtime: Optional[dict] = None) -> str:
+    """16-hex digest of one runtime fingerprint — the file-name-safe half
+    of the coverage key."""
+    rt = runtime if runtime is not None else runtime_fingerprint()
+    canon = "|".join(f"{k}={rt[k]}" for k in sorted(rt))
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def coverage_path(store: AotStore, manifest: dict,
+                  runtime: Optional[dict] = None) -> str:
+    return os.path.join(
+        store.root, "coverage",
+        f"{runtime_hash(runtime)}-{manifest['hash']}.json")
+
+
+def record_coverage(store: AotStore, manifest: dict, tags: dict, *,
+                    runtime: Optional[dict] = None,
+                    extra: Optional[dict] = None) -> str:
+    """Stamp a coverage record after a prebuild: ``tags`` maps each AOT
+    tag to the list of store keys warmed for it. Written atomically
+    (write-then-rename, same discipline as store entries); returns the
+    record path."""
+    rt = runtime if runtime is not None else runtime_fingerprint()
+    path = coverage_path(store, manifest, rt)
+    record = {
+        "schema": COVERAGE_SCHEMA,
+        "manifest_hash": manifest["hash"],
+        "runtime": rt,
+        "runtime_hash": runtime_hash(rt),
+        "created": time.time(),
+        "tags": {tag: sorted(keys) for tag, keys in sorted(tags.items())},
+        "total_keys": sum(len(keys) for keys in tags.values()),
+        **(extra or {}),
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_coverage(store: AotStore, manifest: dict,
+                  runtime: Optional[dict] = None) -> Optional[dict]:
+    """The coverage record for (current runtime, this manifest), or None
+    when no prebuild ever stamped one — which verify/boot treats exactly
+    like an empty store: nothing is covered."""
+    path = coverage_path(store, manifest, runtime)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            record = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(record, dict) \
+            or record.get("schema") != COVERAGE_SCHEMA:
+        return None
+    return record
+
+
+def missing_signatures(store: AotStore, manifest: dict,
+                       runtime: Optional[dict] = None) -> List[str]:
+    """Every manifest obligation the store cannot currently serve, as
+    human/CI-readable ``tag key…`` lines. Three failure layers, checked
+    in order: no coverage record for this (runtime, manifest) pair at
+    all; a manifest site whose tag the record never warmed; a recorded
+    key whose store entry has since been evicted, deleted, or
+    quarantined."""
+    record = load_coverage(store, manifest, runtime)
+    if record is None:
+        return [f"(no coverage record for runtime "
+                f"{runtime_hash(runtime)} × manifest {manifest['hash']} "
+                "— run `aot prebuild --from-surface` on this runtime)"]
+    out: List[str] = []
+    recorded = record.get("tags", {})
+    on_disk = set(store.keys())
+    for site in manifest.get("sites", []):
+        tag = site["tag"]
+        keys = recorded.get(tag)
+        if not keys:
+            out.append(f"{tag}: never prebuilt "
+                       f"({site['cardinality']} signature(s) of "
+                       f"{site['site']})")
+            continue
+        if len(keys) < site["cardinality"]:
+            out.append(f"{tag}: prebuild warmed {len(keys)} of "
+                       f"{site['cardinality']} signature(s)")
+        for key in keys:
+            if key not in on_disk:
+                out.append(f"{tag}: store entry {key[:16]}… is gone")
+    return out

@@ -86,7 +86,8 @@ class FleetEntry:
 
     def __init__(self, name: str, model, params, state=None, *,
                  version: str = "v0", input_dtype=np.float32, metrics=None,
-                 aot_store=None, engine_opts: Optional[dict] = None,
+                 aot_store=None, strict_aot: bool = False,
+                 engine_opts: Optional[dict] = None,
                  gen_opts: Optional[dict] = None):
         import jax
 
@@ -95,6 +96,13 @@ class FleetEntry:
         self.input_dtype = input_dtype
         self.metrics = metrics
         self.aot_store = aot_store
+        # strict page-ins: activation loads executables from the prebuilt
+        # store or fails typed (AotTraceError) — a paged-in model must
+        # never trace its way back into residency
+        self.strict_aot = bool(strict_aot)
+        if self.strict_aot and aot_store is None:
+            raise ValueError(f"model {name!r}: strict_aot=True requires "
+                             "a shared aot_store")
         self.engine_opts = dict(engine_opts or {})
         self.gen_opts = dict(gen_opts or {})
         self.version = version
@@ -135,10 +143,11 @@ class FleetEntry:
                 model=self.name, start_generation=self._next_generation)
             self._engine = ServeEngine(
                 self.model, registry=self._registry, metrics=self.metrics,
-                aot_store=self.aot_store, model_name=self.name,
-                **self.engine_opts)
+                aot_store=self.aot_store, strict_aot=self.strict_aot,
+                model_name=self.name, **self.engine_opts)
             if self.aot_store is not None:
                 # store hit on every re-activation: page-in never re-traces
+                # (strict: an uncovered signature fails the page-in typed)
                 self._engine.warm(self.input_dtype)
             if self._had_batcher:
                 # the model served generate traffic last residency; rebuild
@@ -188,7 +197,8 @@ class FleetEntry:
     def _build_batcher_locked(self) -> None:
         self._batcher = ContinuousBatcher(
             self.model, registry=self._registry, metrics=self.metrics,
-            aot_store=self.aot_store, model_name=self.name, **self.gen_opts)
+            aot_store=self.aot_store, strict_aot=self.strict_aot,
+            model_name=self.name, **self.gen_opts)
         self._had_batcher = True
 
     def batcher(self) -> ContinuousBatcher:
@@ -286,7 +296,7 @@ class FleetRegistry:
     """
 
     def __init__(self, *, hbm_budget_bytes: Optional[int] = None,
-                 metrics=None, aot_store=None,
+                 metrics=None, aot_store=None, strict_aot: bool = False,
                  tenants: Optional[TenantTable] = None,
                  breaker_failures: Optional[int] = 5,
                  breaker_reset_s: float = 10.0, breaker_clock=None,
@@ -296,6 +306,12 @@ class FleetRegistry:
 
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.aot_store = aot_store
+        # strict_aot applies fleet-wide: every entry's activation (and
+        # every page-in after an eviction) must be served by the prebuilt
+        # store or fail with a typed AotTraceError — never a trace
+        self.strict_aot = bool(strict_aot)
+        if self.strict_aot and aot_store is None:
+            raise ValueError("strict_aot=True requires a shared aot_store")
         # tuned_for: a workload fingerprint (sim/workload.py). When set, the
         # boot resolves the autotuner's winning knob set for (this runtime,
         # that workload) from the AOT store — the same place the compiled
@@ -368,8 +384,8 @@ class FleetRegistry:
             params if params is not None else model.params,
             state if state is not None else model.state,
             version=version, input_dtype=input_dtype, metrics=self.metrics,
-            aot_store=self.aot_store, engine_opts=engine_opts,
-            gen_opts=gen_opts)
+            aot_store=self.aot_store, strict_aot=self.strict_aot,
+            engine_opts=engine_opts, gen_opts=gen_opts)
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered — "

@@ -94,6 +94,10 @@ def default_rules() -> Tuple[AlertRule, ...]:
                   kind=RATE_OF_CHANGE, op=">", value=0.0, window_s=120.0,
                   for_s=0.0, severity="warn",
                   summary="autoscale replica provisions are failing"),
+        AlertRule("compile_miss", "serve_compile_misses_total",
+                  op=">", value=0.0, for_s=0.0, severity="page",
+                  summary="a production replica traced at request time — "
+                          "the AOT prebuild does not cover live traffic"),
     )
 
 

@@ -24,9 +24,9 @@ compatibility shims over these.
 
 from .continuous import ContinuousBatcher
 from .engine import PrefillScheduler, ServeEngine
-from .errors import (CapacityError, DeadlineExceededError, DrainTimeoutError,
-                     PublishError, ServeError, ServerClosingError, ShedError,
-                     WorkerStallError)
+from .errors import (AotTraceError, CapacityError, DeadlineExceededError,
+                     DrainTimeoutError, PublishError, ServeError,
+                     ServerClosingError, ShedError, WorkerStallError)
 from .health import Health
 from .http import (ModelServer, jitter_retry_after, retry_after_s,
                    seed_retry_jitter)
@@ -34,7 +34,8 @@ from .paged import BlockAllocator, SlotPages
 from .registry import ModelRegistry, ModelSnapshot
 from .watchdog import Watchdog
 
-__all__ = ["BlockAllocator", "CapacityError", "ContinuousBatcher",
+__all__ = ["AotTraceError", "BlockAllocator", "CapacityError",
+           "ContinuousBatcher",
            "DeadlineExceededError", "DrainTimeoutError", "Health",
            "ModelRegistry", "ModelServer", "ModelSnapshot",
            "PrefillScheduler", "PublishError", "ServeEngine", "ServeError",

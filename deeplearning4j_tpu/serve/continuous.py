@@ -52,7 +52,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -252,7 +252,8 @@ class ContinuousBatcher:
                  prompt_buckets: Optional[Sequence[int]] = None,
                  queue_limit: int = 64, seed: int = 0, metrics=None,
                  scheduler: Optional[PrefillScheduler] = None,
-                 aot_store=None, model_name: Optional[str] = None):
+                 aot_store=None, strict_aot: bool = False,
+                 model_name: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -524,8 +525,16 @@ class ContinuousBatcher:
 
         # --- persistent AOT store (optional): every generation executable
         # loads from disk before tracing, and is warmed eagerly so the
-        # decode loop never traces in the request path after boot ---
+        # decode loop never traces in the request path after boot.
+        # strict_aot: a store miss raises a typed AotTraceError instead of
+        # tracing — and because _warm_for runs at construction, the FIRST
+        # uncovered signature fails the boot itself, never a request ---
+        self.strict_aot = bool(strict_aot)
+        if self.strict_aot and aot_store is None:
+            raise ValueError("strict_aot=True requires an aot_store — "
+                             "a storeless batcher can only trace")
         self._aot = None
+        self._aot_fns: Dict[str, Any] = {}
         if aot_store is not None:
             from ..aot import AotFunction, arch_fingerprint
 
@@ -533,10 +542,13 @@ class ContinuousBatcher:
             arch = arch_fingerprint(snap0.params, snap0.state)
 
             def _wrap(fn, tag, donate=()):
-                return AotFunction(
+                wrapped = AotFunction(
                     fn, tag=tag, store=aot_store, metrics=m, arch=arch,
                     component="generate", donate_argnums=donate,
-                    compile_counter=self._m_compiles)
+                    compile_counter=self._m_compiles,
+                    strict=self.strict_aot)
+                self._aot_fns[tag] = wrapped
+                return wrapped
 
             self._sample = _wrap(self._sample, "gen_sample")
             if kv == "paged":
@@ -1237,6 +1249,12 @@ class ContinuousBatcher:
             req._finish(err)
         self.registry.release_thread(old.ident if old is not None else None)
         return True
+
+    def aot_functions(self) -> dict:
+        """Tag -> :class:`~..aot.AotFunction` for every store-backed
+        generation executable ({} without a store) — how a prebuild run
+        gathers the concrete keys for the coverage record."""
+        return dict(self._aot_fns)
 
     # -------------------------------------------------------------- lifecycle
     @property

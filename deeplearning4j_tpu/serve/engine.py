@@ -155,7 +155,8 @@ class ServeEngine:
                  queue_limit: int = 256, max_wait_ms: float = 2.0,
                  default_timeout_ms: Optional[float] = None,
                  admission: str = "shed", metrics=None, forward=None,
-                 aot_store=None, model_name: Optional[str] = None):
+                 aot_store=None, strict_aot: bool = False,
+                 model_name: Optional[str] = None):
         from ..obs.metrics import MetricsRegistry
 
         if admission not in ("shed", "block"):
@@ -232,7 +233,14 @@ class ServeEngine:
                                      self._lbl(),
                                      help="requests expired before dispatch")
 
-        # --- persistent AOT store (optional): consult disk before tracing ---
+        # --- persistent AOT store (optional): consult disk before tracing.
+        # strict_aot inverts the degradation rule: a store miss raises a
+        # typed AotTraceError instead of tracing (deployment contract:
+        # the store was prebuilt from the static compile surface) ---
+        self.strict_aot = bool(strict_aot)
+        if self.strict_aot and aot_store is None:
+            raise ValueError("strict_aot=True requires an aot_store — "
+                             "a storeless engine can only trace")
         self._aot = None
         if aot_store is not None:
             from ..aot import AotFunction, arch_fingerprint
@@ -242,7 +250,8 @@ class ServeEngine:
                 self._fwd, tag="engine_forward", store=aot_store,
                 metrics=self.metrics,
                 arch=arch_fingerprint(snap0.params, snap0.state),
-                component="engine", compile_counter=self._m_compiles)
+                component="engine", compile_counter=self._m_compiles,
+                strict=self.strict_aot)
             if wrapped.store is not None:  # plain-callable forwards opt out
                 self._fwd = wrapped
                 self._aot = wrapped
@@ -474,13 +483,17 @@ class ServeEngine:
                 try:
                     y = np.asarray(self._fwd(snap.params, snap.state, x))
                 except Exception as e:  # the dispatcher must outlive any bad batch  # jaxlint: disable=broad-except
-                    err = ServeError(f"{type(e).__name__}: {e}",
-                                     cause="internal")
+                    # typed failures (e.g. a strict-mode AotTraceError from
+                    # the store-backed forward) keep their cause and HTTP
+                    # status; anything else is an internal 500
+                    err = (e if isinstance(e, ServeError) else
+                           ServeError(f"{type(e).__name__}: {e}",
+                                      cause="internal"))
                     for r in live:
                         if not r.event.is_set():
                             r.error = err
                             if r.ctx is not None:
-                                r.ctx.finish_work(error="internal")
+                                r.ctx.finish_work(error=err.cause)
                             r.event.set()
                     return
                 t1 = time.perf_counter()
@@ -649,6 +662,12 @@ class ServeEngine:
             self._aot.warm(params, state,
                            jax.ShapeDtypeStruct((bucket,) + tuple(ex_shape),
                                                 np.dtype(dtype)))
+
+    def aot_functions(self) -> dict:
+        """Tag -> :class:`~..aot.AotFunction` for this engine's store-backed
+        executables ({} without a store) — how a prebuild run gathers the
+        concrete keys it stamps into the coverage record."""
+        return {} if self._aot is None else {"engine_forward": self._aot}
 
     # -------------------------------------------------------------- lifecycle
     @property

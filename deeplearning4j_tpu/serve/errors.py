@@ -78,6 +78,20 @@ class CapacityError(ServeError):
     http_status = 400
 
 
+class AotTraceError(ServeError):
+    """Strict AOT mode hit a signature the persistent store does not
+    cover. A strict replica is deployed on the contract that every
+    executable was prebuilt from the static compile surface
+    (``analysis/enumerate.py`` -> ``aot prebuild --from-surface``);
+    tracing at request time would mean the deployed store diverged from
+    the budgeted surface, so the miss is answered as a typed 503 —
+    counted on ``serve_aot_strict_misses_total`` — and at boot time it
+    fails readiness outright. Never a silent trace (HTTP 503)."""
+
+    cause = "aot_trace"
+    http_status = 503
+
+
 class PublishError(ServeError):
     """A model publish aborted BEFORE the generation flip — e.g.
     precompiling/warming the candidate against the live bucket signatures

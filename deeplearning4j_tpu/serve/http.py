@@ -159,7 +159,8 @@ class ModelServer(JsonHTTPServerMixin):
                  gen_kv_blocks: Optional[int] = None,
                  gen_prefill_chunk: Optional[int] = 64,
                  seed: int = 0, metrics: Optional[MetricsRegistry] = None,
-                 aot_store=None, watchdog_s: Optional[float] = None,
+                 aot_store=None, strict_aot: bool = False,
+                 aot_manifest=None, watchdog_s: Optional[float] = None,
                  chaos_admin: bool = False, jitter_rng=None):
         self.model = model
         # injectable Retry-After jitter source (None = process-global RNG);
@@ -172,7 +173,30 @@ class ModelServer(JsonHTTPServerMixin):
         self.port = port
         self.input_dtype = input_dtype
         self.aot_store = aot_store
+        self.strict_aot = bool(strict_aot)
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if self.strict_aot and aot_store is None:
+            raise ValueError("strict_aot=True requires an aot_store")
+        if aot_manifest is not None:
+            # boot-time coverage gate: the store must hold a prebuild
+            # coverage record for (this runtime, this manifest) with every
+            # key still present — BEFORE any stack is built, so readiness
+            # can never flip on a store that would trace (or, strict,
+            # refuse) at request time
+            from ..aot import load_manifest, missing_signatures
+            from .errors import AotTraceError
+
+            if aot_store is None:
+                raise ValueError("aot_manifest requires an aot_store")
+            manifest = (aot_manifest if isinstance(aot_manifest, dict)
+                        else load_manifest(aot_manifest))
+            missing = missing_signatures(aot_store, manifest)
+            if missing:
+                head = "; ".join(missing[:4])
+                raise AotTraceError(
+                    f"AOT store does not cover prebuild manifest "
+                    f"{manifest.get('hash')}: {len(missing)} obligation(s) "
+                    f"unmet — {head}")
         if registry is None:
             registry = (engine.registry if engine is not None else
                         ModelRegistry(
@@ -184,17 +208,21 @@ class ModelServer(JsonHTTPServerMixin):
             model, registry=registry, batch_buckets=batch_buckets,
             length_buckets=length_buckets, queue_limit=queue_limit,
             max_wait_ms=max_wait_ms, default_timeout_ms=default_timeout_ms,
-            metrics=self.metrics, aot_store=aot_store)
+            metrics=self.metrics, aot_store=aot_store,
+            strict_aot=self.strict_aot)
         if engine is None and aot_store is not None:
             # materialize the predict executables now (store hit or traced
-            # once and persisted) — the first request never waits on XLA
+            # once and persisted) — the first request never waits on XLA.
+            # Strict: an uncovered signature raises AotTraceError HERE, so
+            # a replica missing executables never starts listening
             self.engine.warm(input_dtype)
         self._gen_opts = dict(slots=gen_slots, capacity=gen_capacity,
                               queue_limit=gen_queue_limit, kv=gen_kv,
                               block_size=gen_block_size,
                               kv_blocks=gen_kv_blocks,
                               prefill_chunk=gen_prefill_chunk, seed=seed,
-                              aot_store=aot_store)
+                              aot_store=aot_store,
+                              strict_aot=self.strict_aot)
         if gen_kv == "dense":
             # dense batcher takes no paging knobs
             for k in ("block_size", "kv_blocks", "prefill_chunk"):
@@ -202,6 +230,15 @@ class ModelServer(JsonHTTPServerMixin):
         self._batcher: Optional[ContinuousBatcher] = None
         self._lifecycle_lock = threading.Lock()
         self._accepting = True
+        if self.strict_aot:
+            # strict boots verify the WHOLE surface up front: build the
+            # generation stack now so its warm-at-construction pass raises
+            # AotTraceError at boot on any uncovered signature, instead of
+            # deferring the failure into the first /generate request
+            try:
+                self.batcher()
+            except ValueError:
+                pass  # non-token model: predict-only deployment
         # health state machine replaces the old boolean /health; components
         # (watchdog, breakers) degrade/clear causes as they heal
         self.health = Health(metrics=self.metrics, component="serve")

@@ -72,13 +72,20 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/autoscale/
 # statically: continuous-batcher decode = exactly 1 executable, prefill =
 # the committed bucket products. Any jit site whose executable-cardinality
 # bound widens past scripts/compile_budget.json (new site, new symbolic
-# factor, unbounded dim, numeric regression) fails the build; tightening
-# is always allowed. The report uploads next to the SARIF.
-echo "=== jaxlint: compile-surface budget (serve/ + nn/) ==="
+# factor, unbounded dim, numeric regression, stale budget entry) fails the
+# build; tightening is always allowed. The report uploads next to the
+# SARIF. The enumeration pass then expands the budget's symbolic bounds
+# against the committed scripts/serve_config.json into the concrete
+# prebuild manifest — smoke_serve.py compiles it into a fresh store via
+# `aot prebuild --from-surface` and strict-boots a replica from it, so
+# the static bound and the runtime surface are proven EQUAL every build.
+echo "=== jaxlint: compile-surface budget + prebuild manifest (serve/ + nn/) ==="
 python -m deeplearning4j_tpu.analysis \
   deeplearning4j_tpu/serve deeplearning4j_tpu/nn \
   --compile-surface "$CI_ARTIFACTS_DIR/compile_surface.json" \
-  --budget scripts/compile_budget.json
+  --budget scripts/compile_budget.json \
+  --enumerate-manifest "$CI_ARTIFACTS_DIR/prebuild_manifest.json" \
+  --serve-config scripts/serve_config.json
 
 echo "=== jaxlint: ui/ + knn/ (ratchet baseline) ==="
 python -m deeplearning4j_tpu.analysis \

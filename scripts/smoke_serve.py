@@ -15,10 +15,19 @@ AOT store directory — the second boot must serve identical results with
 ZERO decode-path XLA compiles (``serve_compile_misses_total`` stays 0) and
 ``serve_aot_hits_total > 0`` in its scrape.
 
+ISSUE-16 addition: the full prebuild farm loop — the jaxlint enumeration
+manifest (compile-surface bounds x the committed scripts/serve_config.json)
+is compiled into a fresh store by ``aot prebuild --from-surface``, a STRICT
+replica boots from it and serves mixed bucket traffic with ZERO compile
+misses/fallbacks, and a deliberately incomplete store fails the next strict
+boot with a typed ``AotTraceError`` — never a trace.
+
 Artifacts land in $CI_ARTIFACTS_DIR (default: ./ci-artifacts/):
 smoke_serve_metrics.prom (the final /metrics scrape of the main server),
 smoke_serve_warmboot.prom (the warm second boot's scrape), aot_store/
-(the store both boots shared).
+(the store both boots shared), prebuild_manifest.json + prebuild_coverage.json
+(the enumeration manifest and the store's stamped coverage record),
+smoke_serve_strict.prom (the strict replica's scrape).
 """
 
 import concurrent.futures as cf
@@ -171,6 +180,124 @@ def _aot_warm_boot(out_dir):
     assert fallbacks == 0, f"warm store fell back {fallbacks} time(s)"
     with open(os.path.join(out_dir, "smoke_serve_warmboot.prom"), "w") as f:
         f.write(scrape)
+    return int(hits)
+
+
+def _strict_prebuilt_scenario(out_dir):
+    """ISSUE-16 acceptance: enumerate -> ``aot prebuild --from-surface``
+    -> a strict replica boots from the prebuilt store, serves traffic
+    spanning every batch/prompt bucket with serve_compile_misses_total
+    == 0 and zero fallbacks; then one store entry is deleted and the next
+    strict boot fails with a typed AotTraceError (the 503 family), never
+    a trace."""
+    import glob
+    import shutil
+
+    from deeplearning4j_tpu.analysis.__main__ import main as analysis_main
+    from deeplearning4j_tpu.aot import AotStore
+    from deeplearning4j_tpu.aot.__main__ import main as aot_main
+    from deeplearning4j_tpu.models import model_by_name
+    from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+    from deeplearning4j_tpu.serve import AotTraceError, ModelServer
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config = json.load(open(os.path.join(repo, "scripts",
+                                         "serve_config.json")))
+    manifest_path = os.path.join(out_dir, "prebuild_manifest.json")
+    if not os.path.exists(manifest_path):
+        # ci.sh writes the manifest during its jaxlint step; standalone
+        # runs enumerate here (module ids derive from repo-relative paths)
+        cwd = os.getcwd()
+        os.chdir(repo)
+        try:
+            rc = analysis_main([
+                "deeplearning4j_tpu/serve", "deeplearning4j_tpu/nn",
+                "--compile-surface",
+                os.path.join(out_dir, "compile_surface.json"),
+                "--budget", "scripts/compile_budget.json",
+                "--enumerate-manifest", manifest_path,
+                "--serve-config", "scripts/serve_config.json"])
+        finally:
+            os.chdir(cwd)
+        assert rc == 0, "enumeration pass failed"
+
+    store_dir = os.path.join(out_dir, "prebuild_store")
+    assert aot_main(["--store", store_dir, "prebuild",
+                     "--from-surface", manifest_path]) == 0, \
+        "prebuild --from-surface failed"
+    assert aot_main(["--store", store_dir, "verify",
+                     "--manifest", manifest_path]) == 0, \
+        "freshly prebuilt store failed its own coverage gate"
+    records = glob.glob(os.path.join(store_dir, "coverage", "*.json"))
+    assert records, "prebuild stamped no coverage record"
+    shutil.copy(records[0], os.path.join(out_dir, "prebuild_coverage.json"))
+
+    gen = config["gen"]
+
+    def boot(store_root, metrics=None):
+        model = model_by_name(config["model"], seed=config["seed"],
+                              **config["model_kwargs"]).init()
+        return ModelServer(
+            model, port=0, input_dtype=np.dtype(config["dtype"]),
+            batch_buckets=tuple(config["engine"]["batch_buckets"]),
+            gen_slots=gen["slots"], gen_capacity=gen["capacity"],
+            gen_kv=gen["kv"], gen_block_size=gen["block_size"],
+            gen_prefill_chunk=gen["prefill_chunk"], seed=gen["seed"],
+            metrics=metrics, aot_store=AotStore(store_root),
+            strict_aot=True, aot_manifest=manifest_path)
+
+    srv = boot(store_dir).start()
+    try:
+        rng = np.random.RandomState(7)
+        # every batch bucket (1, 2, 4, 8 rows) at the model's native time
+        # length — with length_buckets unset that IS the enumerated axis
+        for rows in (1, 2, 4, 8):
+            ids = rng.randint(0, 50, (rows, 16)).tolist()
+            out = _post(srv.port, "/predict", {"ndarray": ids})["output"]
+            assert len(out) == rows
+        # ... and prompts spanning both prompt buckets (<=8, <=16)
+        for plen in (3, 8, 12):
+            prompt = rng.randint(0, 50, (plen,)).tolist()
+            toks = _post(srv.port, "/generate?stream=false",
+                         {"prompt": prompt, "max_new_tokens": 3,
+                          "temperature": 0.0})["tokens"]
+            assert len(toks) == 3
+        scrape = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10).read().decode()
+    finally:
+        srv.stop()
+    hits = _prom_total(scrape, "serve_aot_hits_total")
+    compiles = _prom_total(scrape, "serve_compile_misses_total")
+    fallbacks = _prom_total(scrape, "serve_aot_fallback_total")
+    refusals = _prom_total(scrape, "serve_aot_strict_misses_total")
+    assert compiles == 0, \
+        f"strict prebuilt replica traced ({compiles} compile misses)"
+    assert fallbacks == 0, f"strict replica fell back {fallbacks} time(s)"
+    assert refusals == 0, f"strict replica refused {refusals} signature(s)"
+    assert hits > 0, "strict replica took no AOT store hits"
+    with open(os.path.join(out_dir, "smoke_serve_strict.prom"), "w") as f:
+        f.write(scrape)
+
+    # delete ONE executable: the next strict boot must fail with the typed
+    # error at the manifest gate — before any stack is built, never a trace
+    broken = store_dir + "_broken"
+    shutil.rmtree(broken, ignore_errors=True)
+    shutil.copytree(store_dir, broken)
+    victim = glob.glob(os.path.join(broken, "*", "*.aotx"))[0]
+    os.remove(victim)
+    m = MetricsRegistry()
+    try:
+        boot(broken, metrics=m).stop()
+        raise AssertionError("strict boot served from an incomplete store")
+    except AotTraceError as e:
+        assert e.http_status == 503 and e.cause == "aot_trace", e
+    traced = sum(s["value"] for s in m.snapshot().get(
+        "serve_compile_misses_total", {}).get("series", []))
+    assert traced == 0, "the refused boot traced instead of failing"
+    assert aot_main(["--store", broken, "verify",
+                     "--manifest", manifest_path]) == 1, \
+        "verify --manifest passed an incomplete store"
+    shutil.rmtree(broken, ignore_errors=True)
     return int(hits)
 
 
@@ -371,6 +498,13 @@ def main() -> int:
     aot_hits = _aot_warm_boot(out_dir)
     print(f"smoke_serve: warm second boot served from the AOT store "
           f"({aot_hits} executable loads, 0 compiles)")
+
+    # prebuild-farm acceptance: enumerated manifest -> prebuilt store ->
+    # strict replica with zero compile misses; incomplete store = typed
+    # boot failure
+    strict_hits = _strict_prebuilt_scenario(out_dir)
+    print(f"smoke_serve: strict prebuilt replica OK — {strict_hits} store "
+          f"loads, 0 compiles, incomplete store refused with AotTraceError")
 
     # fleet acceptance: two models sharing a one-model budget, two tenants,
     # page-ins under load, quota sheds on the scrape

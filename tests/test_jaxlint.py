@@ -2040,6 +2040,27 @@ class TestCompileBudget:
                        "--budget", str(budget)])
         assert rc == 0
 
+    def test_stale_budget_entry_fails(self, tmp_path, capsys,
+                                      monkeypatch):
+        # a budget entry naming a jit site that no longer exists in the
+        # tree is drift, not slack: the entry would silently re-admit the
+        # site (at its old bound) if anyone recreated it. Deleting the
+        # entry is the fix — and is always allowed (tightening).
+        monkeypatch.chdir(tmp_path)
+        pkg = self._write_tree(tmp_path, self.SRC)
+        out = tmp_path / "compile_surface.json"
+        b = tmp_path / "compile_budget.json"
+        b.write_text(json.dumps({"sites": {
+            "svc.srv:step": {"bound": "|BUCKETS|", "why": "test"},
+            "svc.srv:removed_step": {"bound": "|BUCKETS|", "why": "gone"},
+        }}))
+        rc = cli_main(["svc", "--compile-surface", str(out),
+                       "--budget", str(b)])
+        assert rc == 1
+        got = capsys.readouterr().out
+        assert "svc.srv:removed_step" in got
+        assert "stale budget entry" in got
+
     def test_budget_requires_surface_flag(self, tmp_path):
         with pytest.raises(SystemExit):
             cli_main([".", "--budget",
