@@ -351,6 +351,24 @@ def _stamp(headline: dict, source: str,
     return headline
 
 
+def _profile_summary(cost, sample_rate: int) -> dict:
+    """Round-file digest of a captured CostProfile: the top-3 executables
+    by estimated device time plus the overall padding-waste ratio, so a
+    round answers "which executable is slow / how much padding did we
+    burn" without re-running the bench."""
+    waste = cost.waste_ratio()
+    return {
+        "sample_rate": sample_rate,
+        "waste_ratio": None if waste is None else round(waste, 4),
+        "top_executables": [
+            {"component": e.get("component"), "tag": e.get("tag"),
+             "dispatches": e.get("dispatches"),
+             "us_per_dispatch": round(e.get("us_per_dispatch", 0.0), 1),
+             "device_s_est": round(e.get("device_s_est", 0.0), 6)}
+            for e in cost.top_executables(3)],
+    }
+
+
 def _next_round_path(prefix: str) -> str:
     """Next free ``<prefix>_rNN.json`` in the repo root: scans existing
     rounds and increments, so successive captures never clobber each other
@@ -373,17 +391,25 @@ def _bench_serving():
     ParallelInference/ModelServer hot path minus HTTP framing) plus greedy
     generations at a ContinuousBatcher on a small CausalLM. Then a mixed
     prompt-burst scenario compares chunked vs whole-prompt prefill on the
-    paged batcher (p99 inter-token latency + peak live-KV bytes). Prints
-    ONE JSON line and writes the full record to the next free
-    BENCH_serve_rNN.json. Env: BENCH_SERVE_CLIENTS (8),
+    paged batcher (p99 inter-token latency + peak live-KV bytes). The
+    continuous profiler (obs/profile) rides the timed window at sample
+    rate 1/16 — the configuration whose overhead budget the profiling
+    round asserts — and the captured CostProfile summary (top-3
+    executables, overall padding-waste ratio) is stamped into the round
+    JSON. Prints ONE JSON line and writes the full record to the next
+    free BENCH_serve_rNN.json. Env: BENCH_SERVE_CLIENTS (8),
     BENCH_SERVE_SECONDS (5), BENCH_SERVE_GENERATES (8).
     """
     import concurrent.futures as cf
+    import tempfile
     import threading
 
     import jax
 
+    from deeplearning4j_tpu.aot import AotStore
     from deeplearning4j_tpu.models import CausalLM
+    from deeplearning4j_tpu.obs import profile as prof_mod
+    from deeplearning4j_tpu.obs.costmodel import ProfileAccumulator
     from deeplearning4j_tpu.serve import ContinuousBatcher, ServeEngine
 
     clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
@@ -394,11 +420,16 @@ def _bench_serving():
     model = CausalLM(seed=0, input_shape=(32,), num_layers=2, d_model=64,
                      num_heads=4, vocab=256).build()
     model.init()
+    # store-backed so the dispatch seam carries executable identity —
+    # the profiler keys on (component, tag, signature, AOT cache key)
+    store = AotStore(tempfile.mkdtemp(prefix="dl4j_bench_aot_"))
     eng = ServeEngine(model, batch_buckets=(1, 2, 4, 8, 16),
-                      queue_limit=4 * clients, max_wait_ms=1.0)
+                      queue_limit=4 * clients, max_wait_ms=1.0,
+                      aot_store=store)
     rng = np.random.RandomState(0)
     prompts = rng.randint(0, 256, (64, 1, 16)).astype(np.int32)
     eng.predict(prompts[0])  # warm the compile outside the timed window
+    prof = prof_mod.install(prof_mod.Profiler(sample_rate=16))
 
     lat_ms, stop_at = [], [0.0]
     lock = threading.Lock()
@@ -422,7 +453,8 @@ def _bench_serving():
     eng.shutdown()
 
     cb = ContinuousBatcher(model, slots=4, capacity=32,
-                           prompt_buckets=(8, 16), seed=0)
+                           prompt_buckets=(8, 16), seed=0,
+                           aot_store=store)
     g0 = time.perf_counter()
     with cf.ThreadPoolExecutor(4) as ex:
         toks = sum(len(t) for t in ex.map(
@@ -431,6 +463,9 @@ def _bench_serving():
                 temperature=0.0), range(n_gen)))
     gen_wall = time.perf_counter() - g0
     cb.shutdown()
+    cost = ProfileAccumulator().fold(
+        prof.snapshot(include_pairs=True)).profile()
+    prof_mod.uninstall()
 
     prefill = _bench_chunked_prefill(model, seconds)
 
@@ -447,6 +482,7 @@ def _bench_serving():
             "gen_tokens_per_sec": round(toks / gen_wall, 2),
             "gen_compiles": len(cb.compile_signatures),
             "chunked_prefill": prefill,
+            "cost_profile": _profile_summary(cost, prof.sample_rate),
             "device": str(dev.device_kind),
             "captured": time.strftime("%Y-%m-%d"),
         },
@@ -635,6 +671,10 @@ def _bench_fleet():
             fleet.predict(name, prompts[i % len(prompts)], tenant="gold")
     warm_stats = dict(fleet.pager.stats())
 
+    from deeplearning4j_tpu.obs import profile as prof_mod
+    from deeplearning4j_tpu.obs.costmodel import ProfileAccumulator
+    prof = prof_mod.install(prof_mod.Profiler(sample_rate=16))
+
     lat, lock = {}, threading.Lock()
     counts = {"wrong": 0, "errors": 0, "quota_shed": 0, "knn_queries": 0}
     stop_at = [0.0]
@@ -720,6 +760,9 @@ def _bench_fleet():
     wall = time.perf_counter() - t0
     pager = fleet.pager.stats()
     tenants = fleet.tenants.stats()
+    cost = ProfileAccumulator().fold(
+        prof.snapshot(include_pairs=True)).profile()
+    prof_mod.uninstall()
     fleet.shutdown()
 
     def pct(tenant):
@@ -752,6 +795,7 @@ def _bench_fleet():
             "gold_within_slo":
                 bool(per_tenant["gold"]["p99_ms"] <= gold_slo_ms),
             "gold_slo_ms": gold_slo_ms,
+            "cost_profile": _profile_summary(cost, prof.sample_rate),
             "device": str(dev.device_kind),
             "captured": time.strftime("%Y-%m-%d"),
         },

@@ -32,6 +32,7 @@ import time
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 from ..chaos.retry import RetryPolicy
+from ..obs import profile as _prof
 from ..obs import reqtrace as _rt
 from .keys import arch_fingerprint, cache_key, call_signature, \
     runtime_fingerprint
@@ -102,6 +103,7 @@ class AotFunction:
         self.tag = tag
         self.store = store if hasattr(fn, "lower") else None
         self.arch = arch
+        self.component = component
         self.donate = tuple(donate_argnums)
         self.strict = bool(strict) and self.store is not None
         if strict and self.store is None:
@@ -156,7 +158,12 @@ class AotFunction:
             exe = self._exes.get(sig)
         if exe is None:
             exe = self._acquire(sig, args)
-        return exe(*args)
+        # continuous-profiler seam (obs/profile): one attribute load + a
+        # None check when profiling is off — the hot decode tick's cost
+        prof = _prof.ACTIVE
+        if prof is None:
+            return exe(*args)
+        return prof.dispatch(self, sig, exe, args)
 
     def warm(self, *args) -> bool:
         """Ensure the executable for this signature exists (store hit or
@@ -175,6 +182,12 @@ class AotFunction:
         """Signature -> loaded executable (diagnostic)."""
         with self._lock:
             return dict(self._exes)
+
+    def store_key(self, sig: Tuple[str, ...]) -> str:
+        """The store key of one acquired signature ("" before acquire) —
+        how the profiler stamps its (component, tag, sig, key) identity."""
+        with self._lock:
+            return self._keys.get(sig, "")
 
     def warmed_keys(self) -> list:
         """Sorted store keys of every executable this wrapper acquired —

@@ -43,6 +43,7 @@ import numpy as np
 
 from ..chaos import faults as _faults
 from ..obs import flight as _flight
+from ..obs import profile as _profile
 from ..obs import reqtrace as _rt
 from ..serve.errors import ServeError
 from ..serve.http import (chaos_apply, chaos_status, jitter_retry_after,
@@ -193,6 +194,10 @@ class FleetServer(JsonHTTPServerMixin):
                     # scraper — JSON keeps the histogram quantile tracks
                     # the Prometheus text exposition cannot carry
                     self.reply(200, server.metrics.snapshot())
+                elif path == "/v1/debug/profile":
+                    # executable-level cost attribution for THIS replica;
+                    # {"enabled": false} when no profiler is installed
+                    self.reply(200, _profile.debug_payload())
                 elif path == "/v1/debug/chaos" and server.chaos_admin:
                     self.reply(200, chaos_status())
                 elif path == "/v1/fleet":

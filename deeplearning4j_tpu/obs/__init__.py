@@ -5,19 +5,26 @@ JAX-aware step telemetry.
 
 ``obs.metrics``, ``obs.trace``, ``obs.reqtrace``, ``obs.flight``,
 ``obs.slo``, ``obs.tsdb``, ``obs.scrape``, ``obs.alerts``,
-``obs.forecast`` and ``obs.promcheck`` are stdlib-only and jax-free —
-servers import them directly so ``/metrics`` works in processes that
-never load jax. Importing this package pulls the full surface (including
+``obs.profile``, ``obs.costmodel``, ``obs.forecast`` and
+``obs.promcheck`` are stdlib-only and jax-free at import — servers
+import them directly so ``/metrics`` works in processes that never
+load jax (``obs.profile`` touches jax lazily, only on sampled
+dispatches). Importing this package pulls the full surface (including
 the jax-adjacent ``StepTelemetry`` / ``TelemetryListener``).
 """
 
-from .alerts import (AlertEngine, AlertRule, default_rules,
-                     rules_from_config)
+from .alerts import (AlertEngine, AlertRule, StdoutNotifier,
+                     WebhookNotifier, default_rules, rules_from_config)
+from .costmodel import (CostProfile, ProfileAccumulator, get_profile,
+                        put_profile)
 from .flight import FlightRecorder
 from .forecast import BurnForecaster, Forecast
 from .listener import TelemetryListener
 from .metrics import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                       MetricsRegistry, default_registry)
+from .profile import Profiler
+from .profile import install as install_profiler
+from .profile import uninstall as uninstall_profiler
 from .reqtrace import (RequestContext, RequestTracer, format_traceparent,
                        parse_traceparent)
 from .scrape import FederatedScraper
@@ -33,5 +40,8 @@ __all__ = [
     "parse_traceparent", "format_traceparent",
     "TimeSeriesStore", "FederatedScraper",
     "AlertEngine", "AlertRule", "default_rules", "rules_from_config",
+    "StdoutNotifier", "WebhookNotifier",
+    "Profiler", "install_profiler", "uninstall_profiler",
+    "CostProfile", "ProfileAccumulator", "get_profile", "put_profile",
     "BurnForecaster", "Forecast",
 ]

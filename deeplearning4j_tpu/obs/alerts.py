@@ -34,15 +34,33 @@ Rule kinds:
   ``value`` (for counters — e.g. spawn failures per second);
 - ``absence``: fires when NO live series matches (a scrape target that
   should exist but does not).
+
+**Notifier fan-out.** The engine optionally delivers firing/resolved
+events to a list of :class:`Notifier`\\ s (anything with a ``channel``
+string and a ``notify(event)`` method — :class:`StdoutNotifier` and
+:class:`WebhookNotifier` ship). Delivery is **deduplicated per
+firing**: each distinct firing (rule + ``fired_at``) notifies exactly
+once, later evaluation passes while the rule stays firing are
+suppressed (counted as ``dedup``) until ``renotify_s`` elapses, at
+which point one reminder goes out with the *same* dedup key. Each
+delivery runs through a bounded :class:`~..chaos.retry.RetryPolicy`
+(a flapping webhook gets capped backoff, never an unbounded loop) and
+is counted on ``alert_notifications_total{rule,channel,outcome}``
+with ``outcome`` ∈ ``sent`` / ``dedup`` / ``error``. Notification
+decisions are made under the engine lock; the actual I/O happens
+after release, so a slow webhook never blocks a concurrent scrape.
 """
 
 from __future__ import annotations
 
+import json
+import sys
 import threading
 import time
 from collections import deque
-from typing import Dict, List, NamedTuple, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
+from ..chaos.retry import RetryPolicy
 from . import flight as _flight
 
 OK = "ok"
@@ -160,6 +178,64 @@ def rules_from_config(config: Optional[dict],
     return tuple(out)
 
 
+class StdoutNotifier:
+    """One JSON line per notification to ``stream`` (default stdout).
+
+    The degenerate channel every deployment has: pipe the serving
+    process's stdout into whatever log shipper exists and alerts are
+    already *somewhere*. The stream is injectable so tests capture
+    notifications without patching ``sys.stdout``.
+    """
+
+    channel = "stdout"
+
+    def __init__(self, stream=None):
+        self._stream = stream
+
+    def notify(self, event: dict) -> None:
+        out = self._stream if self._stream is not None else sys.stdout
+        out.write(json.dumps(event, sort_keys=True) + "\n")
+        if hasattr(out, "flush"):
+            out.flush()
+
+
+class WebhookNotifier:
+    """POST each notification as JSON to ``url`` (Slack-webhook shaped).
+
+    Uses stdlib ``urllib.request`` with a hard ``timeout_s`` so a dead
+    endpoint costs one bounded connect attempt per retry, never a hang.
+    Any transport error or non-2xx status raises — the engine's
+    :class:`~..chaos.retry.RetryPolicy` decides how often to re-try and
+    the failure is counted as ``outcome="error"`` when the budget is
+    spent. ``opener`` is injectable for tests (anything callable as
+    ``opener(request, timeout=...)`` returning a response with a
+    ``status``/``getcode()``).
+    """
+
+    channel = "webhook"
+
+    def __init__(self, url: str, *, timeout_s: float = 2.0, opener=None):
+        self.url = str(url)
+        self.timeout_s = float(timeout_s)
+        self._opener = opener
+
+    def notify(self, event: dict) -> None:
+        import urllib.request
+
+        body = json.dumps(event, sort_keys=True).encode("utf-8")
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        opener = (self._opener if self._opener is not None
+                  else urllib.request.urlopen)
+        resp = opener(req, timeout=self.timeout_s)
+        status = getattr(resp, "status", None)
+        if status is None and hasattr(resp, "getcode"):
+            status = resp.getcode()
+        if status is not None and not 200 <= int(status) < 300:
+            raise OSError(f"webhook {self.url}: HTTP {status}")
+
+
 class _RuleState:
     __slots__ = ("state", "pending_since", "fired_at", "last_value")
 
@@ -168,6 +244,14 @@ class _RuleState:
         self.pending_since: Optional[float] = None
         self.fired_at: Optional[float] = None
         self.last_value: Optional[float] = None
+
+
+class _NotifyState:
+    __slots__ = ("key", "last_at")
+
+    def __init__(self):
+        self.key: Optional[str] = None       # dedup key of current firing
+        self.last_at: float = 0.0            # last delivery for that key
 
 
 class AlertEngine:
@@ -180,7 +264,9 @@ class AlertEngine:
 
     def __init__(self, store, *, rules: Optional[Tuple[AlertRule, ...]] = None,
                  config: Optional[dict] = None, metrics=None,
-                 clock=time.monotonic, max_firings: int = 256):
+                 clock=time.monotonic, max_firings: int = 256,
+                 notifiers: Sequence = (), renotify_s: float = 300.0,
+                 retry: Optional[RetryPolicy] = None):
         self._store = store
         self._metrics = metrics
         self._clock = clock
@@ -192,6 +278,12 @@ class AlertEngine:
         self._states: Dict[str, _RuleState] = {
             r.name: _RuleState() for r in self.rules}
         self._firings: deque = deque(maxlen=max(1, int(max_firings)))
+        self._notifiers: Tuple = tuple(notifiers)
+        self._renotify_s = float(renotify_s)
+        self._notify_states: Dict[str, _NotifyState] = {
+            r.name: _NotifyState() for r in self.rules}
+        self._retry = retry if retry is not None else RetryPolicy(
+            attempts=3, base_s=0.05, cap_s=1.0, metrics=metrics)
 
     # ---------------------------------------------------------- condition
     def _worst(self, rule: AlertRule,
@@ -271,8 +363,94 @@ class AlertEngine:
                                   else round(value, 6)),
                     })
                 gauges.append((rule.name, _STATE_N[st.state]))
+            notices, deduped = self._notify_decisions_locked(t)
         self._emit(transitions, gauges)
+        self._deliver(notices, deduped)
         return transitions
+
+    def _notify_decisions_locked(
+            self, t: float) -> Tuple[List[dict], List[str]]:
+        """Decide (under the lock) what to deliver after release.
+
+        One notification per distinct firing — the dedup key is
+        ``rule@fired_at`` — plus one reminder each time ``renotify_s``
+        elapses while the rule keeps firing (same key, ``renotify``
+        flag set), plus one resolution notice when the firing clears.
+        Suppressed passes are returned so delivery can count them.
+        """
+        notices: List[dict] = []
+        deduped: List[str] = []
+        if not self._notifiers:
+            return notices, deduped
+        for rule in self.rules:
+            st = self._states[rule.name]
+            ns = self._notify_states[rule.name]
+            if st.state == FIRING:
+                key = f"{rule.name}@{round(st.fired_at or 0.0, 6)}"
+                if ns.key != key:
+                    ns.key = key
+                    ns.last_at = t
+                    notices.append(self._notice(rule, st, key, t,
+                                                FIRING, renotify=False))
+                elif (self._renotify_s > 0.0
+                        and t - ns.last_at >= self._renotify_s):
+                    ns.last_at = t
+                    notices.append(self._notice(rule, st, key, t,
+                                                FIRING, renotify=True))
+                else:
+                    deduped.append(rule.name)
+            elif ns.key is not None:
+                # the firing this key belonged to has cleared: send the
+                # resolution notice once and forget the key
+                notices.append(self._notice(rule, st, ns.key, t,
+                                            RESOLVED, renotify=False))
+                ns.key = None
+        return notices, deduped
+
+    @staticmethod
+    def _notice(rule: AlertRule, st: _RuleState, key: str, t: float,
+                state: str, *, renotify: bool) -> dict:
+        return {
+            "rule": rule.name, "state": state,
+            "severity": rule.severity, "summary": rule.summary,
+            "value": (None if st.last_value is None
+                      else round(st.last_value, 6)),
+            "at_s": round(t, 6), "dedup_key": key, "renotify": renotify,
+        }
+
+    def _deliver(self, notices: List[dict], deduped: List[str]) -> None:
+        """Fan notifications out to every channel — outside the lock.
+
+        A broken channel is an ``error`` outcome on the counter, never
+        an exception out of ``evaluate``: alert *evaluation* must keep
+        running when the pager is what's down.
+        """
+        if not self._notifiers:
+            return
+        for ev in notices:
+            for n in self._notifiers:
+                ch = str(getattr(n, "channel", type(n).__name__))
+                try:
+                    self._retry.call(
+                        lambda n=n, ev=ev: n.notify(dict(ev)),
+                        op="alert_notify")
+                    outcome = "sent"
+                except Exception:  # jaxlint: disable=broad-except — any channel failure degrades to a counted error, evaluation must survive a dead pager
+                    outcome = "error"
+                self._count_notification(ev["rule"], ch, outcome)
+        for rule_name in deduped:
+            for n in self._notifiers:
+                ch = str(getattr(n, "channel", type(n).__name__))
+                self._count_notification(rule_name, ch, "dedup")
+
+    def _count_notification(self, rule: str, channel: str,
+                            outcome: str) -> None:
+        if self._metrics is not None:
+            self._metrics.counter(
+                "alert_notifications_total",
+                {"rule": rule, "channel": channel, "outcome": outcome},
+                help="Alert notification deliveries by rule/channel/outcome"
+                ).inc()
 
     def _emit(self, transitions: List[dict],
               gauges: List[Tuple[str, int]]) -> None:

@@ -17,6 +17,19 @@ Materialization follows the metric kind:
   ``p99`` plus the cumulative ``count``) — the JSON snapshot carries the
   streaming quantile estimates the text exposition cannot.
 
+Downsampling keeps a full diurnal day (and more) in bounded memory:
+every appended point also feeds per-tier **rollup** accumulators (default
+raw -> 1m -> 10m). A tier's open bucket folds points as they arrive and
+is finalized — appended to the tier's own bounded ring, counted on
+``tsdb_rollup_points_total{tier}`` — when the first point of a *later*
+bucket lands. Counters (and histogram ``count`` tracks) roll up as the
+bucket's **last cumulative value**, so a ``rate=True`` query over a
+rollup tier materializes exactly the count-weighted mean rate of each
+bucket; gauges and quantile tracks roll up as the bucket **max**, so
+spikes survive downsampling. Queries prefer raw points and fall back to
+the finest tier whose retention still covers the requested ``t_min``
+(override with ``tier=``).
+
 Staleness has two deliberately different tiers:
 
 - a source that stops answering (dead/suspect replica, failed scrape) is
@@ -46,10 +59,29 @@ from typing import Dict, List, Optional, Tuple
 _HIST_TRACKS = ("p50", "p95", "p99")
 
 
+class _Tier:
+    """One downsampling tier: bucket width + its own retention knobs."""
+
+    __slots__ = ("name", "bucket_s", "points", "horizon_s")
+
+    def __init__(self, name: str, bucket_s: float, points: int,
+                 horizon_s: float):
+        self.name = str(name)
+        self.bucket_s = float(bucket_s)
+        self.points = max(2, int(points))
+        self.horizon_s = float(horizon_s)
+
+
+# raw (1h at scrape cadence) -> 1m buckets for a day -> 10m for a week
+_DEFAULT_ROLLUPS = (("1m", 60.0, 1440, 86400.0),
+                    ("10m", 600.0, 1008, 604800.0))
+
+
 class _Series:
     """One (name, labels, track) ring of (t, value) points."""
 
-    __slots__ = ("kind", "labels", "track", "points", "stale_at")
+    __slots__ = ("kind", "labels", "track", "points", "stale_at",
+                 "rollups", "open")
 
     def __init__(self, kind: str, labels: Dict[str, str], track: str,
                  maxlen: int):
@@ -58,6 +90,9 @@ class _Series:
         self.track = track
         self.points: deque = deque(maxlen=maxlen)
         self.stale_at: Optional[float] = None   # None == live
+        self.rollups: Dict[str, deque] = {}     # tier name -> finalized ring
+        # tier name -> open bucket [start, count, sum, max, last]
+        self.open: Dict[str, list] = {}
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -86,10 +121,16 @@ class TimeSeriesStore:
     """
 
     def __init__(self, *, clock=time.monotonic, retention_points: int = 720,
-                 retention_s: float = 3600.0, metrics=None):
+                 retention_s: float = 3600.0, metrics=None,
+                 rollups=_DEFAULT_ROLLUPS):
         self._clock = clock
         self.retention_points = max(2, int(retention_points))
         self.retention_s = float(retention_s)
+        # downsampling tiers, finest first: (name, bucket_s,
+        # retention_points, retention_s) tuples; () disables rollups
+        self._rollups: Tuple[_Tier, ...] = tuple(
+            _Tier(*spec) for spec in sorted(
+                (rollups or ()), key=lambda s: float(s[1])))
         self._metrics = metrics
         self._lock = threading.Lock()
         # (name, label_key, track) -> _Series
@@ -115,6 +156,7 @@ class TimeSeriesStore:
         """
         t = self._clock() if now is None else float(now)
         added = 0
+        rolled: Dict[str, int] = {}
         with self._lock:
             prev = self._by_source.get(source, frozenset())
             seen = set()
@@ -143,10 +185,7 @@ class TimeSeriesStore:
                             rec = self._series[key] = _Series(
                                 kind, labels, track, self.retention_points)
                         rec.stale_at = None
-                        rec.points.append((t, float(val)))
-                        horizon = t - self.retention_s
-                        while rec.points and rec.points[0][0] < horizon:
-                            rec.points.popleft()
+                        self._append_locked(rec, t, float(val), rolled)
                         added += 1
             # an answered snapshot is authoritative for its source: keys it
             # used to report and no longer does were removed on purpose
@@ -159,7 +198,7 @@ class TimeSeriesStore:
             self._points_total[source] = (
                 self._points_total.get(source, 0) + added)
             live, stale = self._counts_locked()
-        self._export(source, added, live, stale)
+        self._export(source, added, live, stale, rolled)
         return added
 
     def append_instant(self, name: str, labels: Dict[str, str],
@@ -180,20 +219,18 @@ class TimeSeriesStore:
         t = self._clock() if now is None else float(now)
         labels = {str(k): str(v) for k, v in (labels or {}).items()}
         key = (name, _label_key(labels), "")
+        rolled: Dict[str, int] = {}
         with self._lock:
             rec = self._series.get(key)
             if rec is None:
                 rec = self._series[key] = _Series(
                     "instant", labels, "", self.retention_points)
             rec.stale_at = None
-            rec.points.append((t, float(value)))
-            horizon = t - self.retention_s
-            while rec.points and rec.points[0][0] < horizon:
-                rec.points.popleft()
+            self._append_locked(rec, t, float(value), rolled)
             self._points_total[source] = (
                 self._points_total.get(source, 0) + 1)
             live, stale = self._counts_locked()
-        self._export(source, 1, live, stale)
+        self._export(source, 1, live, stale, rolled)
 
     def mark_stale(self, source: str, now: Optional[float] = None) -> int:
         """Soft-stale every series of an unreachable source.
@@ -218,8 +255,51 @@ class TimeSeriesStore:
                     if s.stale_at is not None)
         return len(self._series) - stale, stale
 
-    def _export(self, source: str, added: int, live: int,
-                stale: int) -> None:
+    # ----------------------------------------------------------- rollups
+    def _append_locked(self, rec: _Series, t: float, v: float,
+                       rolled: Dict[str, int]) -> None:
+        """Append one point and feed every rollup tier's open bucket;
+        finalized-bucket counts accumulate into ``rolled`` (emitted on
+        ``tsdb_rollup_points_total{tier}`` outside the lock)."""
+        rec.points.append((t, v))
+        horizon = t - self.retention_s
+        while rec.points and rec.points[0][0] < horizon:
+            rec.points.popleft()
+        for tier in self._rollups:
+            start = t - (t % tier.bucket_s)
+            ob = rec.open.get(tier.name)
+            if ob is None:
+                rec.open[tier.name] = [start, 1, v, v, v]
+                continue
+            if start > ob[0]:
+                self._finalize_locked(rec, tier, ob, horizon_from=t)
+                rolled[tier.name] = rolled.get(tier.name, 0) + 1
+                rec.open[tier.name] = [start, 1, v, v, v]
+            else:
+                # same bucket — or a late out-of-order instant: fold in
+                ob[1] += 1
+                ob[2] += v
+                ob[3] = max(ob[3], v)
+                ob[4] = v
+
+    def _finalize_locked(self, rec: _Series, tier: _Tier, ob: list,
+                         horizon_from: float) -> None:
+        """Close one bucket into the tier's ring. Counters (and histogram
+        ``count`` tracks) keep the last cumulative value — a rate query
+        over the rollup yields the bucket's count-weighted mean rate;
+        everything else keeps the max so spikes survive downsampling."""
+        counter_like = rec.kind == "counter" or rec.track == "count"
+        val = ob[4] if counter_like else ob[3]
+        ring = rec.rollups.get(tier.name)
+        if ring is None:
+            ring = rec.rollups[tier.name] = deque(maxlen=tier.points)
+        ring.append((ob[0] + tier.bucket_s, val))
+        horizon = horizon_from - tier.horizon_s
+        while ring and ring[0][0] < horizon:
+            ring.popleft()
+
+    def _export(self, source: str, added: int, live: int, stale: int,
+                rolled: Optional[Dict[str, int]] = None) -> None:
         """Self-metrics — called outside the store lock by design."""
         m = self._metrics
         if m is None:
@@ -228,6 +308,10 @@ class TimeSeriesStore:
             m.counter("tsdb_points_total", {"source": source},
                       help="Samples appended to the time-series store"
                       ).inc(added)
+        for tier_name in sorted(rolled or ()):
+            m.counter("tsdb_rollup_points_total", {"tier": tier_name},
+                      help="Finalized downsampled points, by rollup tier"
+                      ).inc(rolled[tier_name])
         m.gauge("tsdb_series", help="Live (non-stale) stored series"
                 ).set(float(live))
         m.gauge("tsdb_stale_series",
@@ -249,16 +333,47 @@ class TimeSeriesStore:
             out.append((t1, max(0.0, v1 - v0) / dt))
         return out
 
+    def _tier_points_locked(self, rec: _Series, t_min: Optional[float],
+                            tier: Optional[str]
+                            ) -> Tuple[List[Tuple[float, float]], str]:
+        """(points, tier name) for one series honoring tier precedence:
+        an explicit ``tier`` wins; otherwise raw points serve the query
+        unless they no longer reach back to ``t_min``, in which case the
+        finest rollup tier that does (or the deepest-reaching one when
+        none fully covers) takes over."""
+        if tier is not None and tier != "raw":
+            return list(rec.rollups.get(tier) or ()), tier
+        raw = list(rec.points)
+        if tier == "raw" or t_min is None:
+            return raw, "raw"
+        if raw and raw[0][0] <= t_min:
+            return raw, "raw"
+        best: Tuple[List[Tuple[float, float]], str] = (raw, "raw")
+        best_reach = raw[0][0] if raw else float("inf")
+        for tr in self._rollups:  # finest first
+            ring = rec.rollups.get(tr.name)
+            if not ring:
+                continue
+            if ring[0][0] <= t_min:
+                return list(ring), tr.name
+            if ring[0][0] < best_reach:
+                best, best_reach = (list(ring), tr.name), ring[0][0]
+        return best
+
     def query(self, name: str, labels: Optional[Dict[str, str]] = None,
               track: Optional[str] = None, t_min: Optional[float] = None,
               t_max: Optional[float] = None, rate: bool = False,
-              include_stale: bool = False) -> List[dict]:
+              include_stale: bool = False,
+              tier: Optional[str] = None) -> List[dict]:
         """JSON-ready range query: list of matching series with points.
 
         ``labels`` is a subset match; ``track`` of None matches every
         track. ``rate=True`` materializes per-second deltas (meaningful
-        for counters and histogram ``count`` tracks). Floats are rounded
-        to 6 dp so serialized query results are byte-stable.
+        for counters and histogram ``count`` tracks). ``tier`` pins one
+        resolution ("raw", "1m", "10m"); None applies precedence — raw
+        while it covers ``t_min``, else the finest covering rollup. The
+        answering tier rides in each series' ``tier`` field. Floats are
+        rounded to 6 dp so serialized query results are byte-stable.
         """
         out: List[dict] = []
         with self._lock:
@@ -272,7 +387,7 @@ class TimeSeriesStore:
                     continue
                 if track is not None and rec.track != track:
                     continue
-                pts = list(rec.points)
+                pts, served_by = self._tier_points_locked(rec, t_min, tier)
                 if rate:
                     pts = self._rate_points(pts)
                 pts = [(t, v) for (t, v) in pts
@@ -282,6 +397,7 @@ class TimeSeriesStore:
                     "labels": dict(rec.labels),
                     "kind": rec.kind,
                     "track": rec.track,
+                    "tier": served_by,
                     "stale": rec.stale_at is not None,
                     "points": [[round(t, 6), round(v, 6)]
                                for (t, v) in pts],
@@ -358,5 +474,8 @@ class TimeSeriesStore:
                 "stale": stale,
                 "tombstoned": len(self._tombstones),
                 "points": sum(len(s.points) for s in self._series.values()),
+                "rollup_points": sum(
+                    len(ring) for s in self._series.values()
+                    for ring in s.rollups.values()),
                 "sources": len(self._by_source),
             }

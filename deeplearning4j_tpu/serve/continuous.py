@@ -57,6 +57,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..chaos import faults as _faults
+from ..obs import profile as _prof
 from .engine import PrefillScheduler
 from .errors import (CapacityError, DeadlineExceededError, DrainTimeoutError,
                      ServeError, ServerClosingError, ShedError,
@@ -868,6 +869,9 @@ class ContinuousBatcher:
             self._update_kv_gauges()
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :true_len] = job.req.prompt[off:off + true_len]
+        if _prof.ACTIVE is not None:
+            # live prompt tokens vs the chunk bucket they padded to
+            _prof.ACTIVE.hint("generate", true_len, bucket)
         t0 = time.perf_counter()
         last, self._pools = self._prefill_paged(
             snap.params, snap.state, jnp.asarray(ids), self._pools,
@@ -949,6 +953,9 @@ class ContinuousBatcher:
         bucket = self._bucket(tp)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :tp] = req.prompt
+        if _prof.ACTIVE is not None:
+            # live prompt tokens vs the prompt bucket they padded to
+            _prof.ACTIVE.hint("generate", tp, bucket)
         t0 = time.perf_counter()
         last, cache = self._prefill(snap.params, snap.state,
                                     jnp.asarray(ids), np.int32(tp))
@@ -1050,6 +1057,9 @@ class ContinuousBatcher:
             temps = np.array(self._temps)
             topks = np.array(self._topks)
             keys = np.array(self._keys)
+        if _prof.ACTIVE is not None:
+            # live slots vs the fixed slot axis the decode step pads to
+            _prof.ACTIVE.hint("generate", len(active), self.slots)
         t0 = time.perf_counter()
         if self.kv == "paged":
             nxt, self._pools, new_keys = self._decode(

@@ -43,6 +43,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..chaos import faults as _faults
+from ..obs import profile as _prof
 from .errors import (CapacityError, DeadlineExceededError, DrainTimeoutError,
                      ServeError, ServerClosingError, ShedError,
                      WorkerStallError)
@@ -479,6 +480,9 @@ class ServeEngine:
                 self._batch_count += 1
                 seq = self._batch_count
             with self.registry.lease(tag="engine_batch") as snap:  # ONE generation per batch
+                if _prof.ACTIVE is not None:
+                    # annotate the dispatch with its padding economics
+                    _prof.ACTIVE.hint("engine", rows, bucket)
                 t0 = time.perf_counter()
                 try:
                     y = np.asarray(self._fwd(snap.params, snap.state, x))

@@ -41,6 +41,7 @@ import numpy as np
 
 from ..chaos import faults as _faults
 from ..obs import flight as _flight
+from ..obs import profile as _profile
 from ..obs import reqtrace as _rt
 from ..obs.metrics import MetricsRegistry
 from ..utils.httpd import JsonHTTPServerMixin, JsonRequestHandler
@@ -137,7 +138,8 @@ class ModelServer(JsonHTTPServerMixin):
 
     _ROUTES = frozenset((
         "/predict", "/generate", "/health", "/ready", "/models", "/metrics",
-        "/v1/debug/requests", "/v1/debug/flight", "/v1/debug/chaos"))
+        "/v1/debug/requests", "/v1/debug/flight", "/v1/debug/chaos",
+        "/v1/debug/profile"))
 
     @classmethod
     def _metric_route(cls, path: str) -> str:
@@ -364,6 +366,11 @@ class ModelServer(JsonHTTPServerMixin):
                                   {"error": "flight recorder not installed"})
                     else:
                         self.reply(200, _flight.ACTIVE.snapshot())
+                elif self.path == "/v1/debug/profile":
+                    # top-N executables by estimated device time, waste
+                    # ratios, page-in costs — {"enabled": false} when no
+                    # profiler is installed
+                    self.reply(200, _profile.debug_payload())
                 elif self.path == "/v1/debug/chaos" and server.chaos_admin:
                     self.reply(200, chaos_status())
                 else:

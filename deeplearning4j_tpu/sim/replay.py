@@ -80,7 +80,9 @@ def set_flat(knobs: dict, dotted: str, value) -> None:
 
 class CostModel(NamedTuple):
     """Virtual-time costs. Defaults are CPU-smoke-ish (PERF.md): they rank
-    configs the way the live CPU stack does; recalibrate on real TPUs."""
+    configs the way the live CPU stack does; recalibrate on real TPUs —
+    :meth:`from_profile` does exactly that from a measured
+    :class:`~deeplearning4j_tpu.obs.costmodel.CostProfile`."""
 
     predict_row_s: float = 2e-4       # per padded batch row
     predict_dispatch_s: float = 1.5e-3  # per device dispatch
@@ -89,6 +91,21 @@ class CostModel(NamedTuple):
     decode_base_s: float = 4e-3       # decode step, empty batch
     decode_slot_s: float = 1e-3       # decode step marginal cost per slot
     page_in_s: float = 0.5            # weight page-in (host -> device + warm)
+
+    @classmethod
+    def from_profile(cls, profile,
+                     base: Optional["CostModel"] = None) -> "CostModel":
+        """Calibrate from a measured cost profile: each field the profiler
+        actually observed replaces the hand-set value; everything the run
+        never exercised keeps ``base`` (default: the class defaults) — so
+        calibration degrades per-field, never whole-model."""
+        cm = base if base is not None else cls()
+        repl = {}
+        for field in cm._fields:
+            v = profile.cost(field)
+            if v is not None:
+                repl[field] = v
+        return cm._replace(**repl) if repl else cm
 
 
 def _blocks_needed(tokens: int, block_size: int) -> int:

@@ -78,6 +78,25 @@ class Tuner:
         self.cost_model = cost_model
         self.seed = int(seed)
 
+    @classmethod
+    def from_store(cls, trace: Trace, store, model_fp: str, *,
+                   runtime: Optional[dict] = None, metrics=None,
+                   **kwargs) -> "Tuner":
+        """Boot with a **measured** cost model when the AOT store holds a
+        profiler-captured :class:`~deeplearning4j_tpu.obs.costmodel
+        .CostProfile` for (current runtime fingerprint, ``model_fp``) —
+        resolution is counted on ``profile_store_hits_total`` /
+        ``_misses_total``. A miss boots ``cost_model=None`` (the hand-set
+        defaults), so virtual reports without a profile stay byte-identical
+        to a plain :class:`Tuner`."""
+        from ..obs.costmodel import get_profile
+
+        profile = get_profile(store, model_fp, runtime=runtime,
+                              metrics=metrics)
+        if profile is not None:
+            kwargs.setdefault("cost_model", CostModel.from_profile(profile))
+        return cls(trace, **kwargs)
+
     def _sample(self, rng: random.Random) -> dict:
         cand = copy.deepcopy(self.base)
         for key in sorted(self.space):

@@ -203,7 +203,21 @@ def main():
     # alert ruleset evaluated after each pass (driven manually here — the
     # drill owns time, so no background scrape thread)
     tsdb = TimeSeriesStore(clock=_clock, metrics=router.metrics)
-    engine = AlertEngine(tsdb, metrics=router.metrics, clock=_clock)
+
+    # notifier fan-out under test: a capture channel records every
+    # delivered notification so phase D can assert the dedup contract —
+    # exactly ONE notification per distinct firing, however many
+    # evaluation passes happen while the rule stays firing
+    notifications = []
+
+    class _CaptureNotifier:
+        channel = "capture"
+
+        def notify(self, event):
+            notifications.append(event)
+
+    engine = AlertEngine(tsdb, metrics=router.metrics, clock=_clock,
+                         notifiers=(_CaptureNotifier(),), renotify_s=3600.0)
     scraper = FederatedScraper(router, tsdb, alerts=engine, clock=_clock)
     try:
         _wait_ready(port)
@@ -348,6 +362,16 @@ def main():
         alerts_view = json.loads(body)
         assert alerts_view["rules"]["gold_burn_high"]["state"] == "firing", \
             alerts_view["rules"]["gold_burn_high"]
+        # the firing paged the capture channel exactly once; a further
+        # evaluation pass while still firing is deduplicated (same
+        # dedup key, renotify_s not yet elapsed)
+        engine.evaluate()
+        gb_fired = [n for n in notifications
+                    if n["rule"] == "gold_burn_high"
+                    and n["state"] == "firing"]
+        assert len(gb_fired) == 1, gb_fired
+        assert gb_fired[0]["dedup_key"].startswith("gold_burn_high@"), \
+            gb_fired
         # ...and the burn history that drove the page is queryable over HTTP
         status, body = _get(
             port, "/v1/tsdb?name=fleet_slo_burn_rate"
@@ -385,6 +409,13 @@ def main():
         fired = [f for f in alerts_view["firings"]
                  if f["rule"] == "gold_burn_high"]
         assert fired and fired[-1]["resolved_at_s"] is not None, fired
+        # ...and the resolution notice went out exactly once, closing the
+        # dedup key the firing opened
+        gb_res = [n for n in notifications if n["rule"] == "gold_burn_high"
+                  and n["state"] == "resolved"]
+        assert len(gb_res) == 1, gb_res
+        assert gb_res[0]["dedup_key"] == gb_fired[0]["dedup_key"], \
+            (gb_fired, gb_res)
 
         # ---- E: the router's tenant bucket is global, typed, and bounded
         print("=== phase E: global tenant quota ===", flush=True)
@@ -403,6 +434,14 @@ def main():
             f.write(scrape)
         assert _metric(scrape, "cluster_replica_transitions_total",
                        to="dead") >= 1
+        # exactly two deliveries for the burn page: the firing notice and
+        # its resolution — the extra evaluate() while firing was deduped
+        assert _metric(scrape, "alert_notifications_total",
+                       rule="gold_burn_high", channel="capture",
+                       outcome="sent") == 2.0
+        assert _metric(scrape, "alert_notifications_total",
+                       rule="gold_burn_high", channel="capture",
+                       outcome="dedup") >= 1.0
         assert _metric(scrape, "cluster_heartbeats_total",
                        outcome="miss") >= 1
         assert _metric(scrape, "cluster_failover_total") >= 1

@@ -27,7 +27,10 @@ smoke_serve_metrics.prom (the final /metrics scrape of the main server),
 smoke_serve_warmboot.prom (the warm second boot's scrape), aot_store/
 (the store both boots shared), prebuild_manifest.json + prebuild_coverage.json
 (the enumeration manifest and the store's stamped coverage record),
-smoke_serve_strict.prom (the strict replica's scrape).
+smoke_serve_strict.prom (the strict replica's scrape — carries the
+``profile_*`` and ``serve_padding_waste_ratio`` families), and
+cost_profile.json (the continuous profiler's measured CostProfile,
+also persisted into the prebuilt store for tuner-boot calibration).
 """
 
 import concurrent.futures as cf
@@ -189,7 +192,15 @@ def _strict_prebuilt_scenario(out_dir):
     spanning every batch/prompt bucket with serve_compile_misses_total
     == 0 and zero fallbacks; then one store entry is deleted and the next
     strict boot fails with a typed AotTraceError (the 503 family), never
-    a trace."""
+    a trace.
+
+    ISSUE-17 addition: the continuous profiler (obs/profile) rides the
+    strict replica's mixed traffic — every budgeted decode/prefill
+    executable must appear in the capture with nonzero dispatches,
+    ``serve_padding_waste_ratio`` must be on the scrape, the derived
+    CostProfile lands in $CI_ARTIFACTS_DIR/cost_profile.json AND in the
+    prebuilt store (resolved back as a counted profile_store hit — the
+    artifact the sim tuner calibrates from at boot)."""
     import glob
     import shutil
 
@@ -246,8 +257,16 @@ def _strict_prebuilt_scenario(out_dir):
             metrics=metrics, aot_store=AotStore(store_root),
             strict_aot=True, aot_manifest=manifest_path)
 
-    srv = boot(store_dir).start()
+    from deeplearning4j_tpu.aot import arch_fingerprint
+    from deeplearning4j_tpu.obs import profile as prof_mod
+
+    m = MetricsRegistry()
+    srv = boot(store_dir, metrics=m).start()
+    # the profiler shares the server's registry so profile_* families and
+    # the padding-waste gauge ride the same scrape artifact
+    prof = prof_mod.install(prof_mod.Profiler(sample_rate=4, metrics=m))
     try:
+        model_fp = arch_fingerprint(srv.model.params, srv.model.state)
         rng = np.random.RandomState(7)
         # every batch bucket (1, 2, 4, 8 rows) at the model's native time
         # length — with length_buckets unset that IS the enumerated axis
@@ -262,10 +281,42 @@ def _strict_prebuilt_scenario(out_dir):
                          {"prompt": prompt, "max_new_tokens": 3,
                           "temperature": 0.0})["tokens"]
             assert len(toks) == 3
+        debug = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/debug/profile",
+            timeout=10).read())
+        assert debug.get("enabled") and debug.get("executables"), debug
         scrape = urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/metrics", timeout=10).read().decode()
     finally:
+        snap = prof.snapshot(include_pairs=True)
+        prof_mod.uninstall()
         srv.stop()
+
+    # every budgeted decode/prefill executable family took live traffic
+    tags = {e["tag"] for e in snap["executables"] if e["dispatches"] > 0}
+    assert "engine_forward" in tags, tags
+    assert any("prefill" in t for t in tags), tags
+    assert any("decode" in t for t in tags), tags
+    assert "serve_padding_waste_ratio{" in scrape, \
+        "padding-waste gauge missing from strict scrape"
+    assert "profile_dispatch_device_seconds" in scrape, \
+        "profile histograms missing from strict scrape"
+
+    # the CostProfile artifact: CI upload + AOT-store roundtrip (the
+    # tuner-boot path resolves it exactly like this, counted as a hit)
+    from deeplearning4j_tpu.obs.costmodel import (ProfileAccumulator,
+                                                  get_profile, put_profile)
+    cost = ProfileAccumulator().fold(snap).profile()
+    with open(os.path.join(out_dir, "cost_profile.json"), "w") as f:
+        f.write(cost.to_json())
+    assert put_profile(AotStore(store_dir), model_fp, cost) is not None
+    m2 = MetricsRegistry()
+    got = get_profile(AotStore(store_dir), model_fp, metrics=m2)
+    assert got is not None and got.executables, "profile did not roundtrip"
+    phits = sum(s["value"] for s in m2.snapshot().get(
+        "profile_store_hits_total", {}).get("series", []))
+    assert phits == 1, f"profile resolution not counted as a hit: {phits}"
+
     hits = _prom_total(scrape, "serve_aot_hits_total")
     compiles = _prom_total(scrape, "serve_compile_misses_total")
     fallbacks = _prom_total(scrape, "serve_aot_fallback_total")
@@ -504,7 +555,8 @@ def main() -> int:
     # boot failure
     strict_hits = _strict_prebuilt_scenario(out_dir)
     print(f"smoke_serve: strict prebuilt replica OK — {strict_hits} store "
-          f"loads, 0 compiles, incomplete store refused with AotTraceError")
+          f"loads, 0 compiles, incomplete store refused with AotTraceError; "
+          f"cost profile captured -> cost_profile.json (+ store roundtrip)")
 
     # fleet acceptance: two models sharing a one-model budget, two tenants,
     # page-ins under load, quota sheds on the scrape

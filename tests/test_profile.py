@@ -1,0 +1,661 @@
+"""Tests for the continuous profiler and measured cost model (ISSUE 17):
+obs/profile, obs/costmodel, TSDB downsampling tiers, alert notifier
+fan-out, and tuner-boot calibration.
+
+The load-bearing properties:
+
+- sampling is exact-count extrapolation: every dispatch bumps the exact
+  counter, 1-in-N pay the fence, and ``device_s_est`` reconstructs the
+  true total exactly when per-dispatch cost is constant on a fake clock;
+- **disabled profiling is a strict no-op on the decode path** — booby-trap
+  every Profiler entry point and run real store-backed ServeEngine +
+  ContinuousBatcher traffic through the AOT dispatch seam;
+- padding-waste arithmetic matches known (live, padded) shapes and rides
+  the ``serve_padding_waste_ratio`` gauge;
+- CostProfile persists into the AOT store with the same
+  corrupt-entry-degrades-to-miss discipline as tuned configs, counted on
+  ``profile_store_hits_total``/``_misses_total``;
+- ``CostModel.from_profile`` substitutes only measured fields, and a
+  calibrated replay reproduces a measured-truth replay byte-identically
+  where the hand-set defaults cannot;
+- TSDB rollup tiers: counter buckets keep the last cumulative value (rate
+  over a rollup = count-weighted mean rate), gauges keep the max, and
+  query tier precedence serves raw while it covers ``t_min``;
+- notifier fan-out: one notification per distinct firing, re-notify after
+  ``renotify_s`` with the same dedup key, bounded retry, and failures
+  degrade to counted errors — never an exception out of ``evaluate``;
+- ``Tuner.from_store`` resolves a stored profile as a counted hit; a miss
+  boots the hand-set defaults and replays byte-identically to a plain
+  ``Tuner``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.aot import AotStore
+from deeplearning4j_tpu.obs import profile as profile_mod
+from deeplearning4j_tpu.obs.alerts import (AlertEngine, AlertRule,
+                                           StdoutNotifier, WebhookNotifier)
+from deeplearning4j_tpu.obs.costmodel import (CostProfile,
+                                              ProfileAccumulator, _fit,
+                                              get_profile, profile_key,
+                                              put_profile)
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.obs.profile import Profiler
+from deeplearning4j_tpu.obs.tsdb import TimeSeriesStore
+from deeplearning4j_tpu.sim import (DEFAULT_KNOBS, Tuner, VirtualReplayer,
+                                    generate_trace, report_json, smoke_spec)
+from deeplearning4j_tpu.sim.replay import CostModel
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+class _FakeAot:
+    """Stands in for AotFunction at the profiler's dispatch seam."""
+
+    def __init__(self, tag, component="engine", key="k0"):
+        self.tag = tag
+        self.component = component
+        self._key = key
+
+    def store_key(self, sig):
+        return self._key
+
+
+def _counter_total(registry, name):
+    return sum(s["value"] for s in registry.snapshot().get(
+        name, {}).get("series", []))
+
+
+# ------------------------------------------------------------- sampling
+class TestSampling:
+    def _run(self, sample_rate, dispatches, dt=0.01):
+        clk = _FakeClock()
+        prof = Profiler(sample_rate=sample_rate, clock=clk,
+                        fence=lambda v: None, hbm_probe=lambda: 0)
+        fn = _FakeAot("engine_forward")
+
+        def exe(*args):
+            clk.t += dt
+            return "out"
+
+        for _ in range(dispatches):
+            assert prof.dispatch(fn, ("f32[4,8]",), exe, ()) == "out"
+        (st,) = prof.snapshot()["executables"]
+        return st
+
+    def test_extrapolation_is_exact_on_constant_cost(self):
+        """16 dispatches at 10ms each, sampled 1-in-4: the estimate must
+        reconstruct the true total exactly (0.16s), not the sampled sum."""
+        st = self._run(4, 16)
+        assert st["dispatches"] == 16
+        assert st["sampled"] == 4          # dispatches 1, 5, 9, 13
+        assert st["device_s_sampled"] == pytest.approx(0.04)
+        assert st["device_s_est"] == pytest.approx(0.16)
+
+    def test_sample_rate_one_samples_everything(self):
+        st = self._run(1, 7)
+        assert st["sampled"] == 7
+        assert st["device_s_est"] == pytest.approx(0.07)
+
+    def test_first_dispatch_always_sampled(self):
+        """A short run (fewer dispatches than the sample period) still
+        attributes the executable — the first dispatch pays the fence."""
+        st = self._run(100, 3)
+        assert st["sampled"] == 1
+        assert st["device_s_est"] == pytest.approx(0.03)
+
+    def test_rejects_nonpositive_rate(self):
+        with pytest.raises(ValueError):
+            Profiler(sample_rate=0)
+
+    def test_debug_payload_disabled(self):
+        assert profile_mod.ACTIVE is None
+        assert profile_mod.debug_payload() == {"enabled": False}
+
+
+# -------------------------------------------------------- padding waste
+class TestPaddingWaste:
+    def test_waste_arithmetic_vs_known_shapes(self):
+        """3 live rows padded to 8, then 5 to 8: cumulative waste is
+        1 - 8/16 = 0.5, exact — hints are never sampled."""
+        m = MetricsRegistry()
+        prof = Profiler(sample_rate=1, clock=_FakeClock(), metrics=m,
+                        fence=lambda v: None, hbm_probe=lambda: 0)
+        prof.hint("engine", 3, 8)
+        prof.hint("engine", 5, 8)
+        pad = prof.snapshot()["padding"]["engine/8"]
+        assert pad["dispatches"] == 2
+        assert pad["live"] == 8 and pad["padded"] == 16
+        assert pad["waste_ratio"] == pytest.approx(0.5)
+        series = m.snapshot()["serve_padding_waste_ratio"]["series"]
+        (s,) = series
+        assert s["labels"] == {"component": "engine", "bucket": "8"}
+        assert s["value"] == pytest.approx(0.5)
+
+    def test_hint_attributes_next_dispatch(self):
+        clk = _FakeClock()
+        prof = Profiler(sample_rate=1, clock=clk, fence=lambda v: None,
+                        hbm_probe=lambda: 0)
+        fn = _FakeAot("engine_forward")
+
+        def exe(*args):
+            clk.t += 0.01
+            return "y"
+
+        prof.hint("engine", 2, 4)
+        prof.dispatch(fn, ("f32[4,8]",), exe, ())
+        prof.dispatch(fn, ("f32[4,8]",), exe, ())  # no hint: not attributed
+        (st,) = prof.snapshot(include_pairs=True)["executables"]
+        assert st["live_per_dispatch"] == pytest.approx(2.0)
+        assert st["padded_per_dispatch"] == pytest.approx(4.0)
+        assert st["pairs"] == [[2, pytest.approx(0.01)]]
+
+    def test_hbm_high_water_mark(self):
+        peaks = iter([100, 700, 300])
+        prof = Profiler(sample_rate=1, clock=_FakeClock(),
+                        fence=lambda v: None,
+                        hbm_probe=lambda: next(peaks))
+        fn = _FakeAot("engine_forward")
+        for _ in range(3):
+            prof.dispatch(fn, ("f32[1,8]",), lambda: "z", ())
+        assert prof.snapshot()["hbm_peak_bytes"] == {"engine": 700}
+
+
+# ------------------------------------------- zero overhead when disabled
+class TestZeroOverheadWhenDisabled:
+    def test_no_profiler_calls_on_serving_hot_paths(self, monkeypatch,
+                                                    tmp_path):
+        """With no profiler installed, store-backed serving must never
+        touch a Profiler — booby-trap every entry point and run real
+        predict + generate traffic through the AOT dispatch seam."""
+        from deeplearning4j_tpu.models import CausalLM
+        from deeplearning4j_tpu.nn.layers import Dense, Output
+        from deeplearning4j_tpu.nn.model import NetConfig, Sequential
+        from deeplearning4j_tpu.serve import ContinuousBatcher, ServeEngine
+
+        def boom(*a, **k):
+            raise AssertionError("profiler touched while disabled")
+
+        for meth in ("hint", "dispatch", "page_in", "snapshot", "_observe"):
+            monkeypatch.setattr(profile_mod.Profiler, meth, boom)
+        assert profile_mod.ACTIVE is None
+
+        store = AotStore(str(tmp_path))
+        dense = Sequential(
+            NetConfig(seed=0),
+            [Dense(n_out=6, activation="tanh"),
+             Output(n_out=3, loss="mcxent", activation="softmax")], (4,))
+        dense.init()
+        eng = ServeEngine(dense, batch_buckets=(1, 2), max_wait_ms=1.0,
+                          aot_store=store)
+        try:
+            y = eng.predict(np.zeros((4,), np.float32))
+            assert np.asarray(y).shape[-1] == 3
+        finally:
+            eng.shutdown(drain=True)
+
+        lm = CausalLM(seed=0, input_shape=(16,), num_layers=2, d_model=32,
+                      num_heads=4, vocab=50).build()
+        lm.init()
+        cb = ContinuousBatcher(lm, slots=2, capacity=8, seed=0,
+                               aot_store=store)
+        try:
+            toks = cb.generate(np.arange(4, dtype=np.int32), 4,
+                               temperature=0.0)
+            assert len(toks) == 4
+        finally:
+            cb.shutdown()
+
+
+# ------------------------------------------------------ cost derivation
+class TestCostDerivation:
+    def test_ols_fit_recovers_exact_line(self):
+        pairs = [(1.0, 1e-3 + 2e-4), (2.0, 1e-3 + 4e-4),
+                 (4.0, 1e-3 + 8e-4)]
+        intercept, slope = _fit(pairs)
+        assert intercept == pytest.approx(1e-3)
+        assert slope == pytest.approx(2e-4)
+
+    def test_single_x_is_mean_without_slope(self):
+        intercept, slope = _fit([(4.0, 0.002), (4.0, 0.004)])
+        assert intercept == pytest.approx(0.003)
+        assert slope is None
+
+    def test_accumulator_derives_costs_by_tag(self):
+        snap = {
+            "sample_rate": 4,
+            "executables": [
+                {"component": "engine", "tag": "engine_forward",
+                 "signature": ["f32[2,8]"], "key": "a", "dispatches": 8,
+                 "sampled": 2, "device_s_sampled": 0.004,
+                 "pairs": [[1, 1.2e-3], [2, 1.4e-3], [4, 1.8e-3]]},
+                {"component": "generate", "tag": "gen_prefill_chunk",
+                 "signature": ["i32[2,8]"], "key": "b", "dispatches": 4,
+                 "sampled": 4, "device_s_sampled": 0.008,
+                 "pairs": [[8, 0.002], [8, 0.002]]},
+                {"component": "generate", "tag": "gen_decode_paged",
+                 "signature": ["i32[2,1]"], "key": "c", "dispatches": 6,
+                 "sampled": 3, "device_s_sampled": 0.006,
+                 "pairs": [[1, 3e-3], [2, 4e-3]]},
+            ],
+            "padding": {"engine/8": {"component": "engine", "bucket": 8,
+                                     "dispatches": 2, "live": 8,
+                                     "padded": 16}},
+            "hbm_peak_bytes": {"engine": 512},
+            "page_in": {"count": 4, "total_s": 2.0},
+        }
+        prof = ProfileAccumulator().fold(snap).profile()
+        assert prof.cost("predict_row_s") == pytest.approx(2e-4)
+        assert prof.cost("predict_dispatch_s") == pytest.approx(1e-3)
+        # one prefill bucket only: amortized tokens/second fallback
+        assert prof.cost("prefill_tok_s") == pytest.approx(16 / 0.004)
+        assert prof.cost("chunk_dispatch_s") is None
+        assert prof.cost("decode_slot_s") == pytest.approx(1e-3)
+        assert prof.cost("decode_base_s") == pytest.approx(2e-3)
+        assert prof.cost("page_in_s") == pytest.approx(0.5)
+        assert prof.waste_ratio() == pytest.approx(0.5)
+        # extrapolated estimate rides into the frozen executables
+        eng = next(e for e in prof.executables
+                   if e["tag"] == "engine_forward")
+        assert eng["device_s_est"] == pytest.approx(0.004 * 8 / 2)
+
+    def test_fold_merges_repeated_snapshots(self):
+        snap = {"sample_rate": 2, "executables": [
+            {"component": "engine", "tag": "engine_forward",
+             "signature": ["f32[1,8]"], "key": "a", "dispatches": 3,
+             "sampled": 1, "device_s_sampled": 0.002, "pairs": [[1, 2e-3]]}],
+            "padding": {}, "hbm_peak_bytes": {}, "page_in": {}}
+        prof = ProfileAccumulator().fold(snap).fold(snap).profile()
+        (e,) = prof.executables
+        assert e["dispatches"] == 6 and e["sampled"] == 2
+
+
+# ---------------------------------------------------- store persistence
+class TestProfileStore:
+    def _profile(self):
+        return CostProfile(
+            executables=({"component": "engine", "tag": "engine_forward",
+                          "signature": ["f32[2,8]"], "key": "a",
+                          "dispatches": 8, "sampled": 2,
+                          "device_s_sampled": 0.004, "device_s_est": 0.016,
+                          "us_per_dispatch": 2000.0},),
+            padding={"engine/8": {"component": "engine", "bucket": 8,
+                                  "dispatches": 2, "live": 8, "padded": 16,
+                                  "waste_ratio": 0.5}},
+            hbm_peak_bytes={"engine": 512},
+            costs={"predict_row_s": 3e-4, "predict_dispatch_s": 2e-3,
+                   "prefill_tok_s": None, "chunk_dispatch_s": None,
+                   "decode_base_s": None, "decode_slot_s": None,
+                   "page_in_s": 0.25},
+            sample_rate=16)
+
+    def test_roundtrip_counted_hit(self, tmp_path):
+        store = AotStore(str(tmp_path))
+        assert put_profile(store, "fp", self._profile()) is not None
+        m = MetricsRegistry()
+        got = get_profile(store, "fp", metrics=m)
+        assert got is not None
+        assert got.cost("predict_row_s") == pytest.approx(3e-4)
+        assert got.cost("prefill_tok_s") is None
+        assert got.sample_rate == 16
+        assert got.executables[0]["tag"] == "engine_forward"
+        assert _counter_total(m, "profile_store_hits_total") == 1
+        assert _counter_total(m, "profile_store_misses_total") == 0
+
+    def test_absent_entry_counted_miss(self, tmp_path):
+        m = MetricsRegistry()
+        assert get_profile(AotStore(str(tmp_path)), "fp", metrics=m) is None
+        assert _counter_total(m, "profile_store_misses_total") == 1
+
+    def test_none_store_is_miss(self):
+        m = MetricsRegistry()
+        assert get_profile(None, "fp", metrics=m) is None
+        assert _counter_total(m, "profile_store_misses_total") == 1
+
+    def test_corrupt_entry_degrades_to_miss(self, tmp_path):
+        store = AotStore(str(tmp_path))
+        put_profile(store, "fp", self._profile())
+        with open(store._entry_path(profile_key("fp")), "r+b") as f:
+            f.seek(10)
+            f.write(b"\xff\xff\xff\xff")
+        m = MetricsRegistry()
+        assert get_profile(store, "fp", metrics=m) is None
+        assert _counter_total(m, "profile_store_misses_total") == 1
+
+    def test_runtime_fingerprint_skew_is_miss(self, tmp_path):
+        """A CPU smoke box's microseconds must not calibrate a TPU boot:
+        the key carries the runtime fingerprint."""
+        store = AotStore(str(tmp_path))
+        put_profile(store, "fp", self._profile(),
+                    runtime={"platform": "cpu"})
+        m = MetricsRegistry()
+        assert get_profile(store, "fp", runtime={"platform": "tpu"},
+                           metrics=m) is None
+        assert get_profile(store, "fp", runtime={"platform": "cpu"},
+                           metrics=m) is not None
+
+
+# --------------------------------------------------- simulator coupling
+class TestCostModelFromProfile:
+    def test_substitutes_only_measured_fields(self):
+        prof = CostProfile(costs={"decode_base_s": 9e-3,
+                                  "page_in_s": 0.125})
+        cm = CostModel.from_profile(prof)
+        assert cm.decode_base_s == pytest.approx(9e-3)
+        assert cm.page_in_s == pytest.approx(0.125)
+        # unmeasured fields keep the hand-set defaults
+        assert cm.predict_row_s == CostModel().predict_row_s
+        assert cm.prefill_tok_s == CostModel().prefill_tok_s
+
+    def test_empty_profile_is_identity(self):
+        assert CostModel.from_profile(CostProfile()) == CostModel()
+
+    def test_calibrated_replay_matches_measured_truth(self):
+        """Replay a trace under a 'true' cost model, then calibrate from a
+        profile carrying those measured numbers: the calibrated replay is
+        byte-identical to truth, the hand-set defaults are not — measured
+        calibration strictly beats the defaults."""
+        trace = generate_trace(smoke_spec(seed=3, duration_s=10.0,
+                                          base_rate_rps=6.0))
+        truth = CostModel(predict_row_s=5e-4, predict_dispatch_s=3e-3,
+                          decode_base_s=8e-3, decode_slot_s=2e-3)
+        prof = CostProfile(costs={"predict_row_s": 5e-4,
+                                  "predict_dispatch_s": 3e-3,
+                                  "decode_base_s": 8e-3,
+                                  "decode_slot_s": 2e-3})
+        calibrated = CostModel.from_profile(prof)
+        assert calibrated == truth
+        want = report_json(VirtualReplayer(trace, cost_model=truth).run())
+        got = report_json(VirtualReplayer(trace,
+                                          cost_model=calibrated).run())
+        base = report_json(VirtualReplayer(trace).run())
+        assert got == want
+        assert base != want
+
+    def test_tuner_from_store_counted_hit(self, tmp_path):
+        trace = generate_trace(smoke_spec(seed=1, duration_s=8.0,
+                                          base_rate_rps=5.0))
+        store = AotStore(str(tmp_path))
+        prof = CostProfile(costs={"decode_base_s": 9e-3})
+        put_profile(store, "mfp", prof)
+        m = MetricsRegistry()
+        tuner = Tuner.from_store(trace, store, "mfp", metrics=m)
+        assert tuner.cost_model is not None
+        assert tuner.cost_model.decode_base_s == pytest.approx(9e-3)
+        assert _counter_total(m, "profile_store_hits_total") == 1
+
+    def test_tuner_from_store_miss_is_byte_identical(self, tmp_path):
+        """No stored profile: the booted tuner replays exactly like a
+        plain Tuner on the hand-set defaults."""
+        trace = generate_trace(smoke_spec(seed=1, duration_s=8.0,
+                                          base_rate_rps=5.0))
+        m = MetricsRegistry()
+        tuner = Tuner.from_store(trace, AotStore(str(tmp_path)), "mfp",
+                                 metrics=m)
+        assert tuner.cost_model is None
+        assert _counter_total(m, "profile_store_misses_total") == 1
+        knobs = json.loads(json.dumps(DEFAULT_KNOBS))
+        assert (report_json(tuner.evaluate(knobs, 64))
+                == report_json(Tuner(trace).evaluate(knobs, 64)))
+
+
+# -------------------------------------------------------- TSDB rollups
+class TestTsdbRollups:
+    def _store(self, m=None, **kw):
+        kw.setdefault("rollups", (("1m", 60.0, 100, 100000.0),))
+        return TimeSeriesStore(clock=_FakeClock(), metrics=m, **kw)
+
+    @staticmethod
+    def _counter_snap(value):
+        return {"c_total": {"type": "counter",
+                            "series": [{"labels": {}, "value": value}]}}
+
+    @staticmethod
+    def _gauge_snap(value):
+        return {"g": {"type": "gauge",
+                      "series": [{"labels": {}, "value": value}]}}
+
+    def test_counter_rollup_keeps_last_cumulative(self):
+        """A rate query over the 1m tier materializes the bucket's
+        count-weighted mean rate: 60 increments over 60s -> 1.0/s."""
+        ts = self._store()
+        for i in range(0, 130, 10):
+            ts.ingest("src", self._counter_snap(float(i)), now=float(i))
+        (series,) = ts.query("c_total", tier="1m")
+        # buckets [0,60) and [60,120) finalized, stamped at bucket end
+        assert series["points"] == [[60.0, 50.0], [120.0, 110.0]]
+        (rates,) = ts.query("c_total", tier="1m", rate=True)
+        assert rates["points"] == [[120.0, 1.0]]
+
+    def test_gauge_rollup_keeps_max(self):
+        """Spikes survive downsampling: the 1m point is the bucket max."""
+        ts = self._store()
+        for t, v in ((0.0, 1.0), (20.0, 9.0), (40.0, 2.0), (70.0, 3.0)):
+            ts.ingest("src", self._gauge_snap(v), now=t)
+        (series,) = ts.query("g", tier="1m")
+        assert series["points"] == [[60.0, 9.0]]
+
+    def test_query_precedence_raw_while_it_covers(self):
+        """Raw serves while it reaches t_min; once the horizon prunes raw
+        past t_min the finest covering rollup takes over, and an explicit
+        tier pin always wins."""
+        ts = self._store(retention_points=5, retention_s=50.0)
+        for i in range(0, 310, 10):
+            ts.ingest("src", self._gauge_snap(float(i)), now=float(i))
+        (recent,) = ts.query("g", t_min=280.0)
+        assert recent["tier"] == "raw"
+        (old,) = ts.query("g", t_min=60.0)
+        assert old["tier"] == "1m"
+        assert old["points"][0][0] == 60.0
+        (pinned,) = ts.query("g", t_min=280.0, tier="1m")
+        assert pinned["tier"] == "1m"
+        (pinned_raw,) = ts.query("g", t_min=60.0, tier="raw")
+        assert pinned_raw["tier"] == "raw"
+
+    def test_rollup_self_metric_and_stats(self):
+        m = MetricsRegistry()
+        ts = self._store(m)
+        for i in range(0, 130, 10):
+            ts.ingest("src", self._gauge_snap(1.0), now=float(i))
+        snap = m.snapshot()["tsdb_rollup_points_total"]["series"]
+        (s,) = snap
+        assert s["labels"] == {"tier": "1m"} and s["value"] == 2
+        assert ts.stats()["rollup_points"] == 2
+
+    def test_rollups_disabled_with_empty_spec(self):
+        ts = TimeSeriesStore(clock=_FakeClock(), rollups=())
+        for i in range(0, 130, 10):
+            ts.ingest("src", self._gauge_snap(1.0), now=float(i))
+        (series,) = ts.query("g", t_min=0.0)
+        assert series["tier"] == "raw"
+
+    def test_per_tier_retention(self):
+        """Each tier prunes by its own horizon and ring size."""
+        ts = TimeSeriesStore(clock=_FakeClock(),
+                             rollups=(("1m", 60.0, 2, 100000.0),))
+        for i in range(0, 310, 10):
+            ts.ingest("src", self._gauge_snap(float(i)), now=float(i))
+        (series,) = ts.query("g", tier="1m")
+        assert len(series["points"]) == 2  # ring maxlen, oldest dropped
+        assert series["points"][-1][0] == 300.0
+
+
+# ----------------------------------------------------------- notifiers
+class _Capture:
+    channel = "capture"
+
+    def __init__(self, fail_times=0):
+        self.events = []
+        self.fail_times = fail_times
+
+    def notify(self, event):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise OSError("channel down")
+        self.events.append(event)
+
+
+class TestNotifiers:
+    RULE = AlertRule("hot", "temp", op=">", value=1.0, for_s=0.0,
+                     severity="page", summary="too hot")
+
+    def _engine(self, notifiers, clk, m=None, renotify_s=100.0, retry=None):
+        ts = TimeSeriesStore(clock=clk)
+        from deeplearning4j_tpu.chaos.retry import RetryPolicy
+        eng = AlertEngine(
+            ts, rules=(self.RULE,), metrics=m, clock=clk,
+            notifiers=notifiers, renotify_s=renotify_s,
+            retry=retry or RetryPolicy(attempts=2, base_s=0.0,
+                                       sleep=lambda s: None, metrics=m))
+        return ts, eng
+
+    def test_dedup_one_notification_per_firing(self):
+        clk = _FakeClock()
+        cap = _Capture()
+        m = MetricsRegistry()
+        ts, eng = self._engine([cap], clk, m)
+        ts.append_instant("temp", {}, 2.0, now=0.0)
+        for _ in range(3):
+            eng.evaluate()
+        assert len(cap.events) == 1
+        ev = cap.events[0]
+        assert ev["state"] == "firing" and not ev["renotify"]
+        assert ev["dedup_key"].startswith("hot@")
+        snap = m.snapshot()["alert_notifications_total"]["series"]
+        by_outcome = {s["labels"]["outcome"]: s["value"] for s in snap}
+        assert by_outcome == {"sent": 1, "dedup": 2}
+
+    def test_renotify_after_interval_same_key(self):
+        clk = _FakeClock()
+        cap = _Capture()
+        ts, eng = self._engine([cap], clk, renotify_s=100.0)
+        ts.append_instant("temp", {}, 2.0, now=0.0)
+        eng.evaluate()
+        clk.t = 50.0
+        eng.evaluate()          # inside the interval: suppressed
+        clk.t = 120.0
+        eng.evaluate()          # past it: one reminder, same dedup key
+        assert len(cap.events) == 2
+        assert cap.events[1]["renotify"] is True
+        assert cap.events[1]["dedup_key"] == cap.events[0]["dedup_key"]
+
+    def test_resolution_notice_and_fresh_firing_key(self):
+        clk = _FakeClock()
+        cap = _Capture()
+        ts, eng = self._engine([cap], clk)
+        ts.append_instant("temp", {}, 2.0, now=0.0)
+        eng.evaluate()
+        clk.t = 10.0
+        ts.append_instant("temp", {}, 0.5, now=10.0)
+        eng.evaluate()
+        clk.t = 20.0
+        ts.append_instant("temp", {}, 3.0, now=20.0)
+        eng.evaluate()
+        states = [(e["state"], e["dedup_key"]) for e in cap.events]
+        assert [s for s, _ in states] == ["firing", "resolved", "firing"]
+        assert states[1][1] == states[0][1]      # resolve closes the key
+        assert states[2][1] != states[0][1]      # a NEW firing, new key
+
+    def test_broken_channel_counts_error_never_raises(self):
+        clk = _FakeClock()
+        bad = _Capture(fail_times=99)
+        m = MetricsRegistry()
+        ts, eng = self._engine([bad], clk, m)
+        ts.append_instant("temp", {}, 2.0, now=0.0)
+        eng.evaluate()  # must not raise
+        snap = m.snapshot()["alert_notifications_total"]["series"]
+        (s,) = [x for x in snap if x["labels"]["outcome"] == "error"]
+        assert s["labels"]["rule"] == "hot"
+        assert s["labels"]["channel"] == "capture"
+
+    def test_bounded_retry_recovers_transient_failure(self):
+        clk = _FakeClock()
+        flaky = _Capture(fail_times=1)  # first attempt fails, retry lands
+        m = MetricsRegistry()
+        ts, eng = self._engine([flaky], clk, m)
+        ts.append_instant("temp", {}, 2.0, now=0.0)
+        eng.evaluate()
+        assert len(flaky.events) == 1
+        snap = m.snapshot()["alert_notifications_total"]["series"]
+        by_outcome = {s["labels"]["outcome"]: s["value"] for s in snap}
+        assert by_outcome == {"sent": 1}
+        assert _counter_total(m, "fleet_retry_total") >= 1
+
+    def test_stdout_notifier_writes_json_lines(self):
+        import io
+
+        buf = io.StringIO()
+        StdoutNotifier(stream=buf).notify({"rule": "hot", "state": "firing"})
+        (line,) = buf.getvalue().splitlines()
+        assert json.loads(line) == {"rule": "hot", "state": "firing"}
+
+    def test_webhook_notifier_posts_json(self):
+        sent = {}
+
+        class _Resp:
+            status = 200
+
+        def opener(req, timeout=None):
+            sent["url"] = req.full_url
+            sent["body"] = json.loads(req.data.decode())
+            sent["timeout"] = timeout
+            return _Resp()
+
+        n = WebhookNotifier("http://hook.example/alerts", timeout_s=1.5,
+                            opener=opener)
+        n.notify({"rule": "hot", "state": "firing"})
+        assert sent["url"] == "http://hook.example/alerts"
+        assert sent["body"]["rule"] == "hot"
+        assert sent["timeout"] == pytest.approx(1.5)
+
+    def test_webhook_non_2xx_raises(self):
+        class _Resp:
+            status = 500
+
+        n = WebhookNotifier("http://hook.example/alerts",
+                            opener=lambda req, timeout=None: _Resp())
+        with pytest.raises(OSError):
+            n.notify({"rule": "hot"})
+
+    def test_no_notifiers_is_byte_identical_noop(self):
+        """Without notifiers the engine takes the pre-notifier path: no
+        notification state, no counter families, transitions unchanged."""
+        clk = _FakeClock()
+        m = MetricsRegistry()
+        ts = TimeSeriesStore(clock=clk)
+        eng = AlertEngine(ts, rules=(self.RULE,), metrics=m, clock=clk)
+        ts.append_instant("temp", {}, 2.0, now=0.0)
+        trs = eng.evaluate()
+        assert [t["to"] for t in trs] == ["firing"]
+        assert "alert_notifications_total" not in m.snapshot()
+
+
+# ------------------------------------------------------------------ CLI
+class TestCli:
+    def test_report_over_cost_profile_artifact(self, tmp_path, capsys):
+        prof = CostProfile(
+            executables=({"component": "engine", "tag": "engine_forward",
+                          "signature": ["f32[2,8]"], "key": "a",
+                          "dispatches": 8, "sampled": 2,
+                          "device_s_sampled": 0.004, "device_s_est": 0.016,
+                          "us_per_dispatch": 2000.0},),
+            padding={"engine/8": {"component": "engine", "bucket": 8,
+                                  "dispatches": 2, "live": 8, "padded": 16,
+                                  "waste_ratio": 0.5}},
+            costs={"predict_row_s": 3e-4}, sample_rate=16)
+        path = tmp_path / "cost_profile.json"
+        path.write_text(prof.to_json())
+        assert profile_mod.main([str(path), "--top", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "engine_forward" in out
+        assert "predict_row_s" in out
+        assert "engine/8" in out
