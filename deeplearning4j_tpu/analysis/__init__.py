@@ -26,6 +26,10 @@ from .compilesurface import (check_budget, compute_surface, load_budget,
                              render_report, site_bound)
 from .engine import (Finding, Rule, analyze_paths, analyze_source,
                      iter_py_files, render_json, render_text)
+from .errorflow import ErrorModel, get_error_model
+from .errorsurface import check_budget as check_error_budget
+from .errorsurface import compute_surface as compute_error_surface
+from .errorsurface import load_budget as load_error_budget
 from .locks import LockModel, get_lock_model
 from .rules import ALL_RULES, rules_by_name
 from .sarif import (fingerprints, load_baseline, new_findings, render_sarif,
@@ -39,4 +43,6 @@ __all__ = ["Finding", "Rule", "ALL_RULES", "rules_by_name", "analyze_paths",
            "fingerprints", "write_baseline", "load_baseline", "new_findings",
            "Types", "get_types", "LockModel", "get_lock_model",
            "Interp", "function_shapes", "compute_surface", "render_report",
-           "site_bound", "check_budget", "load_budget"]
+           "site_bound", "check_budget", "load_budget",
+           "ErrorModel", "get_error_model", "compute_error_surface",
+           "check_error_budget", "load_error_budget"]

@@ -22,6 +22,13 @@ expands every budgeted site's symbolic bound against CONFIG's concrete
 bucket tables (see :mod:`.enumerate`) and writes the
 ``prebuild_manifest.json`` that ``python -m deeplearning4j_tpu.aot
 prebuild --from-surface`` compiles into the store.
+
+Error-surface mode (v5): ``--error-surface FILE`` writes the static
+per-endpoint error report (exception -> status/Retry-After/counter per
+``do_*`` boundary, see :mod:`.errorsurface`) to FILE; with
+``--error-budget FILE`` the report is checked against the committed
+budget and any new untyped escape, mapping drift, or stale endpoint
+exits 1.
 """
 
 from __future__ import annotations
@@ -74,6 +81,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--serve-config", metavar="FILE",
                     help="concrete serving config (engine/gen knob groups) "
                          "the enumeration resolves bucket tables from")
+    ap.add_argument("--error-surface", metavar="FILE",
+                    help="write the static per-endpoint error-surface "
+                         "report (exception -> status/Retry-After/counter "
+                         "per do_* boundary) to FILE instead of running "
+                         "rules")
+    ap.add_argument("--error-budget", metavar="FILE",
+                    help="with --error-surface: check the report against "
+                         "this committed budget; any untyped escape, "
+                         "mapping drift or stale endpoint exits 1")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -85,6 +101,38 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.budget and not args.compile_surface:
         ap.error("--budget requires --compile-surface")
+    if args.error_budget and not args.error_surface:
+        ap.error("--error-budget requires --error-surface")
+    if args.error_surface:
+        import json as _json
+
+        from .errorsurface import check_budget as _eb_check
+        from .errorsurface import load_budget as _eb_load
+        from .errorsurface import run as _es_run
+
+        exclude = DEFAULT_EXCLUDES + args.exclude
+        report, _ = _es_run(args.paths, exclude=exclude)
+        with open(args.error_surface, "w", encoding="utf-8") as fh:
+            _json.dump(report, fh, indent=2)
+            fh.write("\n")
+        n = len(report["endpoints"])
+        e = sum(len(ep["errors"]) for ep in report["endpoints"])
+        print(f"jaxlint: error surface — {n} endpoint(s), {e} "
+              f"(endpoint, exception) pair(s) -> {args.error_surface}")
+        if args.error_budget:
+            try:
+                budget = _eb_load(args.error_budget)
+            except (ValueError, OSError) as e:
+                ap.error(f"cannot read error budget "
+                         f"{args.error_budget}: {e}")
+            violations = _eb_check(report, budget)
+            for v in violations:
+                print(f"error-budget: {v}")
+            if violations:
+                print(f"{len(violations)} budget violation(s)")
+                return 1
+            print("error budget: ok")
+        return 0
     if args.enumerate_manifest and not (args.budget and args.serve_config):
         ap.error("--enumerate-manifest requires --compile-surface, "
                  "--budget and --serve-config")

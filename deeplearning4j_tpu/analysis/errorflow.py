@@ -1,0 +1,747 @@
+"""Interprocedural exception-propagation model (jaxlint v5).
+
+The serving contract says every failure that reaches a client is a typed
+:class:`~deeplearning4j_tpu.serve.errors.ServeError` mapped to exactly one
+HTTP status, counted on a ``{cause}`` label, and SSE-safe after the
+streaming commit point. PR 16 found the contract broken at runtime — the
+engine dispatcher silently wrapped typed ``AotTraceError``s into generic
+500s — a bug shape no per-file rule can see. This module makes the
+contract statically checkable: a per-function *raise-set* fixpoint over
+the v2 ``Program`` call graph, in the style of the v3 lock model.
+
+Per function the model computes ``escapes``: the set of exception classes
+that may propagate out of it, each with a witness chain
+("f calls g (line n); g raises ShedError (path:line)"). Direct ``raise``
+sites seed the set; ``try/except`` ladders narrow it with subclass-aware
+matching over a nominal exception-class table (program classes + the
+builtin hierarchy + a few known externals such as
+``json.JSONDecodeError``); call edges — resolved through
+:mod:`.typeinfo` so ``self._pager.ensure(...)`` counts — propagate callee
+escapes through the caller's enclosing handlers. Re-raise (bare
+``raise``), ``raise e`` of the bound exception, and ``raise X from e``
+wrap edges are modeled; ``raise`` of a value whose class is not
+statically nameable (``raise self.error``) is *untracked* — the model
+reports only provable escapes, never guesses. ``NotImplementedError``
+and ``AssertionError`` raises are deliberately untracked too: they are
+contract markers ("subclass must override", "cannot happen"), not
+error-surface citizens.
+
+On top of the fixpoint, :meth:`ErrorModel.boundary_flows` answers the
+question the v5 rules and :mod:`.errorsurface` need: for an HTTP handler
+entry (a ``do_*`` method), where does each reachable exception *land* —
+a specific ``except`` clause (a deliberate status mapping), the generic
+catch-all (an untyped 500), or nowhere (it escapes the boundary and the
+client gets a reset instead of an answer)?
+
+A function whose escape is a designed contract opts out per rule with a
+sanction comment on its ``def`` line, same grammar as the lock model::
+
+    def free(self, blocks):  # jaxlint: sanction=untyped-escape-to-http
+
+Sanctions mute the named rule for findings whose witness chain starts or
+ends at the sanctioned function; the model itself — and the committed
+error-surface budget — always reflect the unsanctioned truth.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+from .typeinfo import dotted_expr, get_types
+
+_ERRORS_CACHE = "errorflow:model"
+
+_SANCTION_RE = re.compile(r"#\s*jaxlint:\s*sanction=([A-Za-z0-9_\-, ]+)")
+
+#: chain length cap, matching the lock model's witness chains
+_MAX_CHAIN = 6
+
+#: raises of these are contract markers, not error-surface citizens
+_UNTRACKED = {"NotImplementedError", "AssertionError"}
+
+#: builtin exception -> immediate base (enough of the CPython hierarchy
+#: for subclass-aware handler matching; no imports, ever)
+BUILTIN_EXC_BASES: Dict[str, Optional[str]] = {
+    "BaseException": None,
+    "Exception": "BaseException",
+    "KeyboardInterrupt": "BaseException",
+    "SystemExit": "BaseException",
+    "GeneratorExit": "BaseException",
+    "ArithmeticError": "Exception",
+    "ZeroDivisionError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "FloatingPointError": "ArithmeticError",
+    "AssertionError": "Exception",
+    "AttributeError": "Exception",
+    "BufferError": "Exception",
+    "EOFError": "Exception",
+    "ImportError": "Exception",
+    "ModuleNotFoundError": "ImportError",
+    "LookupError": "Exception",
+    "IndexError": "LookupError",
+    "KeyError": "LookupError",
+    "MemoryError": "Exception",
+    "NameError": "Exception",
+    "UnboundLocalError": "NameError",
+    "OSError": "Exception",
+    "IOError": "OSError",
+    "FileNotFoundError": "OSError",
+    "FileExistsError": "OSError",
+    "IsADirectoryError": "OSError",
+    "NotADirectoryError": "OSError",
+    "PermissionError": "OSError",
+    "InterruptedError": "OSError",
+    "TimeoutError": "OSError",
+    "ConnectionError": "OSError",
+    "BrokenPipeError": "ConnectionError",
+    "ConnectionAbortedError": "ConnectionError",
+    "ConnectionRefusedError": "ConnectionError",
+    "ConnectionResetError": "ConnectionError",
+    "ReferenceError": "Exception",
+    "RuntimeError": "Exception",
+    "NotImplementedError": "RuntimeError",
+    "RecursionError": "RuntimeError",
+    "StopIteration": "Exception",
+    "StopAsyncIteration": "Exception",
+    "SyntaxError": "Exception",
+    "SystemError": "Exception",
+    "TypeError": "Exception",
+    "ValueError": "Exception",
+    "UnicodeError": "ValueError",
+}
+
+#: known external exception classes -> base (dotted, alias-resolved)
+EXTERNAL_EXC_BASES: Dict[str, str] = {
+    "json.JSONDecodeError": "ValueError",
+    "json.decoder.JSONDecodeError": "ValueError",
+    "http.client.HTTPException": "Exception",
+    "http.client.BadStatusLine": "http.client.HTTPException",
+    "http.client.RemoteDisconnected": "ConnectionResetError",
+    "socket.timeout": "TimeoutError",
+    "socket.gaierror": "OSError",
+    "queue.Empty": "Exception",
+    "queue.Full": "Exception",
+}
+
+#: "the client is gone" family: nothing in-band can be said to them
+CLIENT_GONE = ("ConnectionError", "BrokenPipeError", "ConnectionResetError",
+               "ConnectionAbortedError")
+
+
+def short(qual: str) -> str:
+    """Last component of an exception qual, for human-facing messages."""
+    return qual.rsplit(".", 1)[-1]
+
+
+class Clause(NamedTuple):
+    """One ``except`` clause: resolved type quals (None = bare except,
+    '?' entries = unresolvable, treated as catch-all) + its AST node."""
+
+    types: Optional[Tuple[str, ...]]
+    node: ast.excepthandler
+
+    @property
+    def generic(self) -> bool:
+        """Catches everything: bare ``except``, ``except Exception`` /
+        ``BaseException``, or a clause type the model cannot resolve."""
+        if self.types is None:
+            return True
+        return any(t in ("Exception", "BaseException", "?")
+                   for t in self.types)
+
+
+class Escape(NamedTuple):
+    """One exception class escaping a function, with provenance."""
+
+    chain: Tuple[str, ...]
+    origin: object  # FuncInfo of the raise site
+
+
+class Flow(NamedTuple):
+    """One exception reaching a boundary function: where it lands."""
+
+    qual: str
+    escape: Escape
+    clause: Optional[Clause]  # None -> escapes the boundary entirely
+    fn: object  # the boundary FuncInfo
+
+
+class ErrorModel:
+    """Program-wide exception-flow facts. Build via :func:`get_error_model`."""
+
+    def __init__(self, program):
+        self.program = program
+        self.types = get_types(program)
+        #: program class qual -> tuple of resolved base quals
+        self.class_bases: Dict[str, Tuple[str, ...]] = {}
+        #: program class qual -> {attr: literal value} (class-body Assigns)
+        self.class_attrs: Dict[str, Dict[str, object]] = {}
+        #: module qual -> {NAME: tuple of exc quals} for module-level
+        #: ``_BAD_REQUEST = (KeyError, ValueError, ...)`` constants
+        self.module_exc_tuples: Dict[str, Dict[str, Tuple[str, ...]]] = {}
+        #: FuncInfo -> rule names sanctioned on its def line
+        self.sanctions: Dict[object, Set[str]] = {}
+        #: FuncInfo -> escaping exception qual -> Escape
+        self.escapes: Dict[object, Dict[str, Escape]] = {}
+        self._events: Dict[object, list] = {}
+        self._catch_cache: Dict[Tuple[Tuple[str, ...], str], bool] = {}
+        self._families: Dict[object, Set[str]] = {}
+
+        self._collect_classes()
+        self._collect_module_tuples()
+        self._collect_sanctions()
+        self._all_funcs = sorted(
+            (fi for mi in program.modules.values() for fi in mi.all_funcs),
+            key=lambda fi: (fi.module.module, fi.qual, fi.node.lineno))
+        #: quals of every class named ServeError / ShedError in the program
+        self.serve_error_roots = frozenset(
+            q for q in self.class_bases if short(q) == "ServeError")
+        self.shed_error_roots = frozenset(
+            q for q in self.class_bases if short(q) == "ShedError")
+        self._fixpoint()
+
+    # -- nominal exception table -----------------------------------------
+    def _collect_classes(self):
+        for mi in self.program.modules.values():
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                qual = f"{mi.module}.{node.name}"
+                bases = []
+                for b in node.bases:
+                    q = self._resolve_class_name(mi, b)
+                    if q:
+                        bases.append(q)
+                self.class_bases.setdefault(qual, tuple(bases))
+                attrs: Dict[str, object] = {}
+                for child in node.body:
+                    if isinstance(child, ast.Assign) \
+                            and len(child.targets) == 1 \
+                            and isinstance(child.targets[0], ast.Name) \
+                            and isinstance(child.value, ast.Constant):
+                        attrs[child.targets[0].id] = child.value.value
+                    elif isinstance(child, ast.AnnAssign) \
+                            and isinstance(child.target, ast.Name) \
+                            and isinstance(child.value, ast.Constant):
+                        attrs[child.target.id] = child.value.value
+                self.class_attrs.setdefault(qual, attrs)
+
+    def _collect_module_tuples(self):
+        for mi in self.program.modules.values():
+            table: Dict[str, Tuple[str, ...]] = {}
+            for stmt in mi.tree.body:
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    continue
+                elts = stmt.value.elts \
+                    if isinstance(stmt.value, ast.Tuple) else [stmt.value]
+                quals = [self._resolve_class_name(mi, e) for e in elts]
+                if quals and all(q and self._is_exceptionish(q)
+                                 for q in quals):
+                    table[stmt.targets[0].id] = tuple(quals)
+            self.module_exc_tuples[mi.module] = table
+
+    def _resolve_class_name(self, mi, expr: ast.AST) -> Optional[str]:
+        """Exception class qual an expression names: a program class's
+        ``<module>.<Class>``, a builtin exception name, or a known
+        external's dotted path. None when not statically nameable."""
+        d = dotted_expr(mi, expr)
+        if d is None:
+            return None
+        q = self.types.resolve_class_dotted(mi, d)
+        if q in self.class_bases:
+            return q
+        name = q or d
+        if name.startswith("builtins."):
+            name = name[len("builtins."):]
+        if name in EXTERNAL_EXC_BASES:
+            return name
+        if name in BUILTIN_EXC_BASES:
+            return name
+        return None
+
+    def _is_exceptionish(self, qual: str) -> bool:
+        """Does the qual (transitively) derive from BaseException — or at
+        least from nothing that disproves it? Program classes with fully
+        unresolved bases count (single-file fixtures)."""
+        seen: Set[str] = set()
+        stack = [qual]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            if q in BUILTIN_EXC_BASES:
+                return True
+            if q in EXTERNAL_EXC_BASES:
+                stack.append(EXTERNAL_EXC_BASES[q])
+            stack.extend(self.class_bases.get(q, ()))
+        return bool(self.class_bases.get(qual) is not None
+                    and not self.class_bases.get(qual))
+
+    def is_subtype(self, qual: str, base: str) -> bool:
+        """Subclass-aware handler matching: would ``except <base>`` catch
+        an instance of ``qual``?"""
+        if base in ("BaseException", "?"):
+            return True
+        seen: Set[str] = set()
+        stack = [qual]
+        while stack:
+            q = stack.pop()
+            if q == base:
+                return True
+            if q in seen:
+                continue
+            seen.add(q)
+            b = BUILTIN_EXC_BASES.get(q)
+            if b:
+                stack.append(b)
+            b = EXTERNAL_EXC_BASES.get(q)
+            if b:
+                stack.append(b)
+            stack.extend(self.class_bases.get(q, ()))
+        return False
+
+    def is_serve_error(self, qual: str) -> bool:
+        return any(self.is_subtype(qual, r) for r in self.serve_error_roots)
+
+    def is_shed_error(self, qual: str) -> bool:
+        return any(self.is_subtype(qual, r) for r in self.shed_error_roots)
+
+    def is_client_gone(self, qual: str) -> bool:
+        return any(self.is_subtype(qual, b) for b in CLIENT_GONE)
+
+    def class_attr(self, qual: str, name: str):
+        """Class-body constant resolved through the base chain
+        (``http_status`` / ``cause`` on the typed error hierarchy)."""
+        seen: Set[str] = set()
+        stack = [qual]
+        while stack:
+            q = stack.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            attrs = self.class_attrs.get(q)
+            if attrs and name in attrs:
+                return attrs[name]
+            stack.extend(self.class_bases.get(q, ()))
+        return None
+
+    # -- sanctions --------------------------------------------------------
+    def _collect_sanctions(self):
+        for mi in self.program.modules.values():
+            lines = mi.source.splitlines()
+            for fi in mi.all_funcs:
+                start = min([fi.node.lineno]
+                            + [d.lineno for d in fi.node.decorator_list])
+                rules: Set[str] = set()
+                for ln in range(start, fi.node.lineno + 1):
+                    if 0 < ln <= len(lines):
+                        m = _SANCTION_RE.search(lines[ln - 1])
+                        if m:
+                            rules.update(r.strip()
+                                         for r in m.group(1).split(",")
+                                         if r.strip())
+                if rules:
+                    self.sanctions[fi] = rules
+
+    def sanctioned(self, fi, rule: str) -> bool:
+        return rule in self.sanctions.get(fi, ())
+
+    def flow_sanctioned(self, flow_or_escape, boundary_fi, rule: str) -> bool:
+        """A finding is muted when either end of its witness chain — the
+        boundary/raising function or the origin of the raise — carries the
+        rule's sanction."""
+        esc = flow_or_escape.escape \
+            if isinstance(flow_or_escape, Flow) else flow_or_escape
+        return (self.sanctioned(boundary_fi, rule)
+                or self.sanctioned(esc.origin, rule))
+
+    # -- per-function event streams ---------------------------------------
+    def clause_types(self, mi, handler: ast.excepthandler
+                     ) -> Optional[Tuple[str, ...]]:
+        """Resolved type quals one ``except`` clause catches. None = bare
+        ``except:``; unresolvable entries become '?' (treated catch-all —
+        the model never claims an escape it cannot prove)."""
+        t = handler.type
+        if t is None:
+            return None
+        exprs = list(t.elts) if isinstance(t, ast.Tuple) else [t]
+        out: List[str] = []
+        for e in exprs:
+            q = self._resolve_class_name(mi, e)
+            if q is not None:
+                out.append(q)
+                continue
+            quals = self._exc_tuple(mi, e)
+            if quals:
+                out.extend(quals)
+            else:
+                out.append("?")
+        return tuple(out)
+
+    def _exc_tuple(self, mi, expr: ast.AST) -> Optional[Tuple[str, ...]]:
+        """Resolve a Name/Attribute naming a module-level tuple constant
+        of exception classes (the ``_BAD_REQUEST`` idiom)."""
+        d = dotted_expr(mi, expr)
+        if d is None:
+            return None
+        head, _, name = d.rpartition(".")
+        if not head:
+            return self.module_exc_tuples.get(mi.module, {}).get(d)
+        mod = self.program.lookup_module(head)
+        if mod is None:
+            return None
+        return self.module_exc_tuples.get(mod.module, {}).get(name)
+
+    def events(self, fi) -> list:
+        """Structural event stream for ``fi``:
+
+        - ``("raise", (quals,), node, frames)`` — a ``raise`` whose
+          exception class(es) are statically nameable;
+        - ``("call", node, callee, frames)`` — a resolvable call.
+
+        ``frames`` is the tuple of enclosing try-ladders (outermost
+        first), each a tuple of :class:`Clause`. Handler bodies run under
+        the *outer* frames (their own try no longer catches); bare
+        ``raise`` re-raises the handling clause's types; ``raise e`` of
+        the bound name resolves to the clause's types."""
+        cached = self._events.get(fi)
+        if cached is not None:
+            return cached
+        mi = fi.module
+        out: list = []
+
+        def expr_calls(e: Optional[ast.AST], frames):
+            if e is None:
+                return
+            for n in ast.walk(e):
+                if isinstance(n, ast.Call):
+                    callee = self.types.method_callee(fi, n)
+                    if callee is not None and callee is not fi:
+                        out.append(("call", n, callee, frames))
+
+        def isinstance_narrow(test, bindings):
+            """``if isinstance(e, (A, B)): raise`` — the guarded branch
+            narrows the bound exception's types (the router's
+            client-gone re-raise idiom)."""
+            if isinstance(test, ast.Call) \
+                    and isinstance(test.func, ast.Name) \
+                    and test.func.id == "isinstance" \
+                    and len(test.args) == 2 \
+                    and isinstance(test.args[0], ast.Name) \
+                    and test.args[0].id in bindings:
+                t = test.args[1]
+                exprs = list(t.elts) if isinstance(t, ast.Tuple) else [t]
+                quals = [self._resolve_class_name(mi, e) for e in exprs]
+                if quals and all(quals):
+                    return test.args[0].id, tuple(quals)
+            return None
+
+        def do_raise(st: ast.Raise, frames, bindings, clause_ctx):
+            if st.exc is None:
+                quals = clause_ctx or ()
+            else:
+                target = st.exc.func if isinstance(st.exc, ast.Call) \
+                    else st.exc
+                # nested calls building the message still run
+                if isinstance(st.exc, ast.Call):
+                    for a in list(st.exc.args) + [k.value for k
+                                                  in st.exc.keywords]:
+                        expr_calls(a, frames)
+                if isinstance(target, ast.Name) and target.id in bindings:
+                    quals = bindings[target.id]
+                else:
+                    q = self._resolve_class_name(mi, target)
+                    quals = (q,) if q else ()
+            quals = tuple(q for q in quals
+                          if q not in _UNTRACKED and q != "?")
+            if quals:
+                out.append(("raise", quals, st, frames))
+
+        def walk(stmts, frames, bindings, clause_ctx):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue  # separate scope
+                if isinstance(st, ast.Raise):
+                    do_raise(st, frames, bindings, clause_ctx)
+                elif isinstance(st, ast.Try):
+                    frame = tuple(Clause(self.clause_types(mi, h), h)
+                                  for h in st.handlers)
+                    walk(st.body, frames + (frame,), bindings, clause_ctx)
+                    for clause in frame:
+                        b2 = bindings
+                        if clause.node.name:
+                            b2 = dict(bindings)
+                            b2[clause.node.name] = \
+                                clause.types or ("Exception",)
+                        walk(clause.node.body, frames, b2,
+                             clause.types or ("Exception",))
+                    # orelse/finally exceptions are NOT caught by this try
+                    walk(st.orelse, frames, bindings, clause_ctx)
+                    walk(st.finalbody, frames, bindings, clause_ctx)
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    for item in st.items:
+                        expr_calls(item.context_expr, frames)
+                    walk(st.body, frames, bindings, clause_ctx)
+                elif isinstance(st, ast.If):
+                    expr_calls(st.test, frames)
+                    narrowed = isinstance_narrow(st.test, bindings)
+                    if narrowed is not None:
+                        name, quals = narrowed
+                        b2 = dict(bindings)
+                        cc2 = quals if bindings.get(name) == clause_ctx \
+                            else clause_ctx
+                        b2[name] = quals
+                        walk(st.body, frames, b2, cc2)
+                    else:
+                        walk(st.body, frames, bindings, clause_ctx)
+                    walk(st.orelse, frames, bindings, clause_ctx)
+                elif isinstance(st, (ast.For, ast.AsyncFor)):
+                    expr_calls(st.iter, frames)
+                    walk(st.body, frames, bindings, clause_ctx)
+                    walk(st.orelse, frames, bindings, clause_ctx)
+                elif isinstance(st, ast.While):
+                    expr_calls(st.test, frames)
+                    walk(st.body, frames, bindings, clause_ctx)
+                    walk(st.orelse, frames, bindings, clause_ctx)
+                else:
+                    for e in ast.iter_child_nodes(st):
+                        if isinstance(e, ast.expr):
+                            expr_calls(e, frames)
+
+        walk(fi.node.body, (), {}, None)
+        self._events[fi] = out
+        return out
+
+    # -- escape fixpoint ---------------------------------------------------
+    def _catches(self, clause: Clause, qual: str) -> bool:
+        if clause.types is None:
+            return True
+        key = (clause.types, qual)
+        hit = self._catch_cache.get(key)
+        if hit is None:
+            hit = any(self.is_subtype(qual, t) for t in clause.types)
+            self._catch_cache[key] = hit
+        return hit
+
+    def land(self, qual: str, frames) -> Optional[Clause]:
+        """First clause that catches ``qual`` (innermost try first, clause
+        order within a ladder respected). None = escapes every frame."""
+        for frame in reversed(frames):
+            for clause in frame:
+                if self._catches(clause, qual):
+                    return clause
+        return None
+
+    def _escapes_once(self, fi) -> Dict[str, Escape]:
+        mi = fi.module
+        out: Dict[str, Escape] = {}
+        for ev in self.events(fi):
+            if ev[0] == "raise":
+                _, quals, node, frames = ev
+                for q in quals:
+                    if self.land(q, frames) is None:
+                        out.setdefault(q, Escape(
+                            (f"{fi.qual} raises {short(q)} "
+                             f"({mi.path}:{node.lineno})",), fi))
+            else:
+                _, node, callee, frames = ev
+                for q, esc in self.escapes.get(callee, {}).items():
+                    if len(esc.chain) >= _MAX_CHAIN:
+                        continue
+                    if self.land(q, frames) is None:
+                        out.setdefault(q, Escape(
+                            (f"{fi.qual} calls {callee.qual} "
+                             f"(line {node.lineno})",) + esc.chain,
+                            esc.origin))
+        return out
+
+    def _fixpoint(self):
+        for fi in self._all_funcs:
+            self.escapes[fi] = {}
+        changed = True
+        while changed:
+            changed = False
+            for fi in self._all_funcs:
+                new = self._escapes_once(fi)
+                if set(new) != set(self.escapes[fi]):
+                    self.escapes[fi] = new
+                    changed = True
+
+    # -- boundary queries --------------------------------------------------
+    def boundaries(self) -> List[object]:
+        """Every HTTP handler entry: a ``do_*`` method of any class."""
+        return [fi for fi in self._all_funcs
+                if fi.cls and fi.name.startswith("do_")]
+
+    def boundary_flows(self, fi) -> List[Flow]:
+        """Every tracked exception reaching boundary ``fi``, with the
+        clause it lands in (None = escapes the boundary)."""
+        mi = fi.module
+        flows: Dict[str, Flow] = {}
+        for ev in self.events(fi):
+            if ev[0] == "raise":
+                _, quals, node, frames = ev
+                for q in quals:
+                    if q in flows:
+                        continue
+                    esc = Escape((f"{fi.qual} raises {short(q)} "
+                                  f"({mi.path}:{node.lineno})",), fi)
+                    flows[q] = Flow(q, esc, self.land(q, frames), fi)
+            else:
+                _, node, callee, frames = ev
+                for q, esc in self.escapes.get(callee, {}).items():
+                    if q in flows or len(esc.chain) >= _MAX_CHAIN:
+                        continue
+                    chain = (f"{fi.qual} calls {callee.qual} "
+                             f"(line {node.lineno})",) + esc.chain
+                    flows[q] = Flow(q, Escape(chain, esc.origin),
+                                    self.land(q, frames), fi)
+        return [flows[q] for q in sorted(flows)]
+
+    def clause_arrivals(self, fi) -> List[Tuple[Clause, str, Escape]]:
+        """(clause, exception qual, escape) for every tracked exception
+        that lands in an ``except`` clause *inside* ``fi`` — the swallow
+        rule's input."""
+        mi = fi.module
+        out: List[Tuple[Clause, str, Escape]] = []
+        seen: Set[Tuple[int, str]] = set()
+        for ev in self.events(fi):
+            if ev[0] == "raise":
+                _, quals, node, frames = ev
+                pairs = [(q, Escape((f"{fi.qual} raises {short(q)} "
+                                     f"({mi.path}:{node.lineno})",), fi))
+                         for q in quals]
+            else:
+                _, node, callee, frames = ev
+                pairs = [(q, Escape((f"{fi.qual} calls {callee.qual} "
+                                     f"(line {node.lineno})",) + esc.chain,
+                                    esc.origin))
+                         for q, esc in self.escapes.get(callee, {}).items()
+                         if len(esc.chain) < _MAX_CHAIN]
+            for q, esc in pairs:
+                clause = self.land(q, frames)
+                if clause is None:
+                    continue
+                key = (id(clause.node), q)
+                if key not in seen:
+                    seen.add(key)
+                    out.append((clause, q, esc))
+        return out
+
+    # -- clause/function helpers for rules & the surface -------------------
+    def commit_line(self, fi) -> Optional[int]:
+        """Line of the SSE streaming commit point — the first
+        ``<receiver>.send_response(200)`` call — or None."""
+        best: Optional[int] = None
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "send_response" \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value == 200:
+                if best is None or node.lineno < best:
+                    best = node.lineno
+        return best
+
+    def metric_families(self, fi, hops: int = 1) -> Set[str]:
+        """Metric family literals a function touches —
+        ``*.counter("family", ...)`` calls — following resolvable call
+        edges ``hops`` levels deep (counters often live one helper away:
+        ``self._err(...)`` / ``route_err(...)``)."""
+        fams = self._families.get(fi)
+        if fams is None:
+            fams = set()
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("counter", "histogram",
+                                               "gauge") \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    fams.add(node.args[0].value)
+            self._families[fi] = fams
+        if hops <= 0:
+            return fams
+        out = set(fams)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                callee = self.types.method_callee(fi, node)
+                if callee is not None and callee is not fi:
+                    out |= self.metric_families(callee, hops - 1)
+        return out
+
+    def node_metric_families(self, fi, root: ast.AST) -> Set[str]:
+        """Metric families touched within one subtree (an ``except``
+        clause body), resolving one helper hop."""
+        out: Set[str] = set()
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("counter", "histogram", "gauge") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                out.add(node.args[0].value)
+                continue
+            callee = self.types.method_callee(fi, node)
+            if callee is not None and callee is not fi:
+                out |= self.metric_families(callee, hops=0)
+        return out
+
+    def clause_statuses(self, fi, clause: Clause) -> Set[object]:
+        """Literal HTTP statuses a clause body answers with (first int
+        argument of reply/_err/route_err/send_error/send_response), plus
+        the marker ``"dynamic"`` when it defers to ``e.http_status``."""
+        out: Set[object] = set()
+        for node in ast.walk(clause.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else (node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+            if name not in ("reply", "_err", "route_err", "send_error",
+                            "send_response", "err"):
+                continue
+            if not node.args:
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, int):
+                out.add(a0.value)
+            elif isinstance(a0, ast.Attribute) \
+                    and a0.attr == "http_status":
+                out.add("dynamic")
+        return out
+
+    def clause_retry_after(self, fi, clause: Clause) -> bool:
+        """Does the clause body witness a Retry-After header — the string
+        literal or one of the jitter helpers?"""
+        for node in ast.walk(clause.node):
+            if isinstance(node, ast.Constant) \
+                    and node.value == "Retry-After":
+                return True
+            if isinstance(node, ast.Call):
+                name = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) \
+                    else (node.func.id if isinstance(node.func, ast.Name)
+                          else None)
+                if name in ("jitter_retry_after", "retry_after_s",
+                            "_retry_after"):
+                    return True
+        return False
+
+
+def get_error_model(program) -> ErrorModel:
+    m = program.cache.get(_ERRORS_CACHE)
+    if m is None:
+        m = ErrorModel(program)
+        program.cache[_ERRORS_CACHE] = m
+    return m

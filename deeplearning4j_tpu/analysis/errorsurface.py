@@ -1,0 +1,208 @@
+"""Static per-endpoint error surface + committed budget (jaxlint v5).
+
+The v4 compile surface proved a runtime property of the serving tier —
+"how many executables can this jit site ever produce" — can be computed
+statically, committed as a budget, and diffed in CI. This module makes
+the same move for the *error* surface: for every HTTP handler entry
+(``do_*`` method) the :mod:`.errorflow` fixpoint yields the set of
+exception classes that can reach the boundary, and this walker resolves
+where each one lands:
+
+- a **typed** :class:`ServeError` caught by an explicitly-typed
+  ``except`` entry answers with its class-attribute ``http_status``;
+- an untyped exception caught by a *specific* clause (the
+  ``_BAD_REQUEST`` ladder) answers with that clause's literal status —
+  a deliberate mapping;
+- anything landing in the generic catch-all is an untyped 500;
+- anything landing nowhere **escapes** — the client gets a connection
+  reset instead of an answer.
+
+Each (endpoint, exception) pair carries the status, whether the landing
+clause witnesses a ``Retry-After`` header, and which metric families the
+clause counts. The report is written to ``error_surface.json`` and
+checked against the committed budget (``scripts/error_budget.json``)
+exactly like the compile budget: a new endpoint, a new untyped escape,
+a typed error losing its status mapping, a lost Retry-After/counter, or
+a stale budget endpoint fails CI; tightening always passes.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import Program
+from .errorflow import Clause, ErrorModel, Flow, get_error_model, short
+
+GENERIC_STATUS = 500
+
+
+def typed_entry(model: ErrorModel, clause: Clause, qual: str) -> bool:
+    """Did the clause catch ``qual`` via an explicitly-typed entry (not
+    the bare/Exception catch-all)? Only then does the typed error keep
+    its own ``http_status`` mapping."""
+    if clause.types is None:
+        return False
+    return any(t not in ("Exception", "BaseException", "?")
+               and model.is_subtype(qual, t)
+               for t in clause.types)
+
+
+def flow_status(model: ErrorModel, fi, flow: Flow):
+    """HTTP status a flow actually answers with: an int, ``"escape"``
+    (no answer at all), or ``"mapped"`` (a specific clause with no
+    literal status the model can read)."""
+    clause = flow.clause
+    if clause is None:
+        return "escape"
+    if model.is_serve_error(flow.qual) and typed_entry(model, clause,
+                                                      flow.qual):
+        st = model.class_attr(flow.qual, "http_status")
+        return int(st) if isinstance(st, int) else GENERIC_STATUS
+    if clause.generic and not typed_entry(model, clause, flow.qual):
+        return GENERIC_STATUS
+    lits = sorted(s for s in model.clause_statuses(fi, clause)
+                  if isinstance(s, int) and s >= 400)
+    if lits:
+        return lits[0]
+    return "mapped"
+
+
+def _routes(fi) -> List[str]:
+    """Route literals the handler compares ``self.path`` against —
+    informational only; the budget keys on the boundary method."""
+    out: Set[str] = set()
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value.startswith("/") and len(node.value) > 1 \
+                and " " not in node.value:
+            out.add(node.value)
+    return sorted(out)
+
+
+def _via(model: ErrorModel, flow: Flow) -> str:
+    if flow.clause is None:
+        return "escapes the handler"
+    node = flow.clause.node
+    if flow.clause.types is None:
+        return f"bare except (line {node.lineno})"
+    names = ", ".join(short(t) for t in flow.clause.types)
+    return f"except ({names}) (line {node.lineno})"
+
+
+def compute_surface(program: Program) -> dict:
+    """The error-surface report: one entry per ``do_*`` boundary, one row
+    per exception class reachable at it."""
+    model = get_error_model(program)
+    endpoints = []
+    for fi in model.boundaries():
+        mi = fi.module
+        rows = []
+        for flow in model.boundary_flows(fi):
+            clause = flow.clause
+            status = flow_status(model, fi, flow)
+            counted = sorted(model.node_metric_families(fi, clause.node)) \
+                if clause is not None else []
+            rows.append({
+                "exception": flow.qual,
+                "class": short(flow.qual),
+                "typed": model.is_serve_error(flow.qual),
+                "status": status,
+                "retry_after": bool(
+                    clause is not None
+                    and model.clause_retry_after(fi, clause)),
+                "counted": counted,
+                "via": _via(model, flow),
+                "chain": list(flow.escape.chain),
+            })
+        endpoints.append({
+            "endpoint": f"{mi.module}:{fi.qual}",
+            "path": mi.path,
+            "line": fi.node.lineno,
+            "routes": _routes(fi),
+            "errors": sorted(rows, key=lambda r: r["exception"]),
+        })
+    endpoints.sort(key=lambda e: e["endpoint"])
+    return {"version": 1, "tool": "jaxlint-error-surface",
+            "endpoints": endpoints}
+
+
+# ------------------------------------------------------------- budget
+
+def check_budget(report: dict, budget: dict) -> List[str]:
+    """Violations of the committed error budget; empty = gate passes.
+
+    Fails on: a new endpoint the budget does not know; a new exception
+    at a budgeted endpoint (worded as an *untyped escape* when it is
+    one); a status mapping drifting from the budget — including a typed
+    error degrading to the generic 500 or to a boundary escape; a
+    Retry-After witness or a budgeted counter family going missing; and
+    a stale budget endpoint (the boundary no longer exists — a stale
+    entry guards nothing; delete it, that is tightening). An error class
+    the budget allows but the tree no longer raises passes: tightening
+    is always allowed.
+    """
+    allowed: Dict[str, dict] = budget.get("endpoints", {})
+    out: List[str] = []
+    seen: Set[str] = set()
+    for ep in report.get("endpoints", []):
+        eid = ep["endpoint"]
+        seen.add(eid)
+        entry = allowed.get(eid)
+        if entry is None:
+            out.append(f"{eid}: new HTTP endpoint with no budget entry "
+                       f"({len(ep['errors'])} reachable error class(es)) "
+                       "— add it to the budget with a why:")
+            continue
+        b_errors: Dict[str, dict] = entry.get("errors", {})
+        for row in ep["errors"]:
+            q = row["exception"]
+            b = b_errors.get(q)
+            if b is None:
+                if not row["typed"] and row["status"] in ("escape",
+                                                          GENERIC_STATUS):
+                    out.append(
+                        f"{eid}: new untyped escape {row['class']} "
+                        f"({'no answer' if row['status'] == 'escape' else 'generic 500'}) "
+                        f"— {' ; '.join(row['chain'][:3])}")
+                else:
+                    out.append(f"{eid}: new error class {row['class']} "
+                               f"(status {row['status']}) with no budget "
+                               "entry — add it with a why:")
+                continue
+            if row["status"] != b.get("status"):
+                out.append(f"{eid}: {row['class']} status mapping drifted "
+                           f"— computed {row['status']!r}, budget "
+                           f"{b.get('status')!r}")
+            if b.get("retry_after") and not row["retry_after"]:
+                out.append(f"{eid}: {row['class']} lost its Retry-After "
+                           "witness (budget requires one)")
+            missing = sorted(set(b.get("counted", []))
+                             - set(row["counted"]))
+            if missing:
+                out.append(f"{eid}: {row['class']} no longer counts "
+                           f"{missing} (budget requires them)")
+    for eid in sorted(set(allowed) - seen):
+        out.append(f"{eid}: stale budget endpoint — no such handler in "
+                   "the analyzed tree; delete the entry (tightening) or "
+                   "fix the endpoint id")
+    return out
+
+
+def run(paths: Sequence[str], exclude: Sequence[str] = ()
+        ) -> Tuple[dict, Program]:
+    """Analyze ``paths`` and return (error-surface report, program)."""
+    from .engine import read_sources
+
+    sources = read_sources(paths, exclude)
+    program = Program(sources)
+    return compute_surface(program), program
+
+
+def load_budget(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "endpoints" not in data:
+        raise ValueError("error budget file must be {'endpoints': {...}}")
+    return data

@@ -926,3 +926,6 @@ from . import rules_concurrency  # noqa: E402,F401  (registration side effect)
 
 # v4 shape/dtype interpreter & compile-surface family, same contract.
 from . import rules_shapes  # noqa: E402,F401  (registration side effect)
+
+# v5 interprocedural error-flow family, same contract.
+from . import rules_errorflow  # noqa: E402,F401  (registration side effect)

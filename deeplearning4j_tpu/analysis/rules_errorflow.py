@@ -1,0 +1,371 @@
+"""jaxlint error-flow rules (v5).
+
+The serving contract — every client-visible failure is a typed
+``ServeError`` with exactly one status, a counted ``{cause}``, and an
+in-band error event after the SSE commit — is enforced statically over
+the :mod:`.errorflow` fixpoint:
+
+- ``untyped-escape-to-http`` — a non-``ServeError`` exception reaches a
+  ``do_*`` boundary and either escapes it (connection reset, no answer)
+  or lands in the generic catch-all (an anonymous 500);
+- ``swallowed-typed-error`` — an ``except`` clause that receives a typed
+  ``ServeError`` re-raises an untyped exception, destroying the
+  status/cause mapping (the PR 16 dispatcher bug, found statically);
+- ``error-status-drift`` — a typed error class mapped to a literal
+  status that contradicts its ``http_status`` attribute or another
+  tier's mapping, or a handler clause answering 503 with no
+  ``Retry-After`` witness;
+- ``uncounted-shed`` — a shed-class raise (``ShedError`` subtree) in a
+  function with no ``serve_shed_total``/``fleet_*``/``cluster_*``
+  counter witness nearby (itself, a helper one hop down, or a direct
+  caller);
+- ``sse-post-commit-error`` — an exception that can escape a streaming
+  function *after* its ``send_response(200)`` commit point, where the
+  only correct channel left is the in-band error event.
+
+Findings ride the normal engine (suppressible, SARIF'd, baselined). A
+function whose escape/raise is a designed contract opts out with
+``# jaxlint: sanction=<rule>`` on its ``def`` line plus a written
+justification — same grammar as the v3 lock model; sanctions mute the
+rule at either end of the witness chain, never the error-surface budget.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .engine import FileContext, Finding, Rule
+from .errorflow import Clause, get_error_model, short
+from .errorsurface import typed_entry
+from .rules import register
+
+_CALLERS_CACHE = "errorflow:callers"
+
+#: counter families that witness a counted shed
+_SHED_FAMILY_EXACT = {"serve_shed_total"}
+_SHED_FAMILY_PREFIX = ("fleet_", "cluster_")
+
+
+def _boundaries_in_file(ctx: FileContext, model) -> list:
+    return [fi for fi in ctx.module_info.all_funcs
+            if fi.cls and fi.name.startswith("do_")]
+
+
+def _chain_text(chain) -> str:
+    return "; ".join(chain)
+
+
+@register
+class UntypedEscapeToHttpRule(Rule):
+    """An untyped exception reaching an HTTP handler boundary.
+
+    Whatever is not a ``ServeError`` caught by a *specific* clause has no
+    contract: if it lands in the generic catch-all the client gets an
+    anonymous 500 with no machine-readable cause; if it escapes the
+    ``do_*`` method entirely the socket server eats it and the client
+    gets a connection reset instead of an answer. Both shapes are
+    invisible to per-file lint — the raise is usually modules away — so
+    the check runs over the interprocedural raise-set fixpoint and
+    reports the witness chain. Fix by mapping the exception to a typed
+    ``ServeError`` (or an explicit except clause); a deliberate
+    programming-error-to-500 path opts out with
+    ``# jaxlint: sanction=untyped-escape-to-http`` + a justification.
+    """
+
+    name = "untyped-escape-to-http"
+    description = ("non-ServeError exception reachable uncaught (or "
+                   "catch-all-only) at an HTTP handler boundary")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        model = get_error_model(ctx.program)
+        for fi in _boundaries_in_file(ctx, model):
+            for flow in model.boundary_flows(fi):
+                if model.is_serve_error(flow.qual):
+                    continue
+                if model.flow_sanctioned(flow, fi, self.name):
+                    continue
+                if flow.clause is None:
+                    how = ("ESCAPES the boundary — the client gets a "
+                           "connection reset, not an HTTP answer")
+                elif flow.clause.generic \
+                        and not typed_entry(model, flow.clause, flow.qual):
+                    how = (f"only the generic catch-all (line "
+                           f"{flow.clause.node.lineno}) stops it — an "
+                           f"anonymous 500 with no typed cause")
+                else:
+                    continue  # a specific clause: deliberate mapping
+                yield self.finding(
+                    ctx, fi.node,
+                    f"untyped {short(flow.qual)} reaches handler "
+                    f"{fi.qual} and {how}. Witness: "
+                    f"{_chain_text(flow.escape.chain)}. Map it to a "
+                    f"typed ServeError or a specific except clause")
+
+
+@register
+class SwallowedTypedErrorRule(Rule):
+    """A typed ``ServeError`` re-wrapped into an untyped exception.
+
+    The PR 16 dispatcher bug: a broad handler caught typed
+    ``AotTraceError``s and re-raised them as generic failures, so the
+    front door answered 500/"internal" instead of 503/"aot_trace" and
+    the shed counters lost the cause. The check finds every ``except``
+    clause that *receives* a ServeError — either named in the clause or
+    proven to arrive by the fixpoint — and raises a non-ServeError from
+    its body. Re-raising (bare ``raise``/``raise e``) and wrapping into
+    another ServeError are fine.
+    """
+
+    name = "swallowed-typed-error"
+    description = ("except clause receives a typed ServeError but "
+                   "re-raises an untyped exception (mapping lost)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        model = get_error_model(ctx.program)
+        mi = ctx.module_info
+        for fi in mi.all_funcs:
+            if model.sanctioned(fi, self.name):
+                continue
+            arrivals: Dict[int, List[str]] = {}
+            for clause, q, esc in model.clause_arrivals(fi):
+                if model.is_serve_error(q):
+                    arrivals.setdefault(id(clause.node), []).append(q)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Try):
+                    continue
+                if mi.enclosing_function(node) is not fi.node:
+                    continue
+                for h in node.handlers:
+                    clause = Clause(model.clause_types(mi, h), h)
+                    typed = sorted(set(arrivals.get(id(h), [])))
+                    if not typed and clause.types:
+                        typed = sorted(
+                            t for t in clause.types
+                            if t not in ("?",) and model.is_serve_error(t))
+                    if not typed:
+                        continue
+                    yield from self._wraps(ctx, model, mi, h,
+                                           clause, typed)
+
+    def _wraps(self, ctx, model, mi, handler, clause, typed):
+        bound = handler.name
+        for node in ast.walk(handler):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            target = node.exc.func if isinstance(node.exc, ast.Call) \
+                else node.exc
+            if isinstance(target, ast.Name) and target.id == bound:
+                continue  # re-raising the caught exception: fine
+            q = model._resolve_class_name(mi, target)
+            if q is None or model.is_serve_error(q):
+                continue
+            names = ", ".join(short(t) for t in typed)
+            yield self.finding(
+                ctx, node,
+                f"typed {names} caught at line {handler.lineno} is "
+                f"re-wrapped into untyped {short(q)} — the "
+                f"status/cause mapping is destroyed and the front door "
+                f"answers an anonymous 500 (the PR 16 dispatcher bug "
+                f"shape). Re-raise it, or wrap into a ServeError")
+
+
+@register
+class ErrorStatusDriftRule(Rule):
+    """One typed error class, two different HTTP statuses — or a 503
+    with no ``Retry-After``.
+
+    The three HTTP tiers (serve, fleet, cluster router) answer typed
+    errors via ``e.http_status``; a clause that hard-codes a literal for
+    a typed class can silently drift from the class attribute (or from
+    another tier). And every 503 is a retry invitation: a clause that
+    answers 503 without a ``Retry-After`` witness (the header literal or
+    a ``jitter_retry_after``-family helper) invites synchronized retry
+    storms — the jittered header is the contract everywhere else.
+    """
+
+    name = "error-status-drift"
+    description = ("typed error mapped to a status contradicting its "
+                   "http_status (or another tier), or a 503 clause "
+                   "without Retry-After")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        model = get_error_model(ctx.program)
+        mi = ctx.module_info
+        handler_classes = {(fi.module, fi.cls)
+                           for fi in model.boundaries()}
+        for fi in mi.all_funcs:
+            if model.sanctioned(fi, self.name):
+                continue
+            in_handler = (fi.module, fi.cls) in handler_classes \
+                or (fi.cls and fi.name.startswith("do_"))
+            arrives_503: Dict[int, List[str]] = {}
+            for clause, q, esc in model.clause_arrivals(fi):
+                if model.is_serve_error(q) \
+                        and model.class_attr(q, "http_status") == 503:
+                    arrives_503.setdefault(id(clause.node), []).append(q)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Try):
+                    continue
+                if mi.enclosing_function(node) is not fi.node:
+                    continue
+                for h in node.handlers:
+                    clause = Clause(model.clause_types(mi, h), h)
+                    statuses = model.clause_statuses(fi, clause)
+                    lits = sorted(s for s in statuses
+                                  if isinstance(s, int) and s >= 400)
+                    # (a) literal contradicts the class's http_status
+                    for t in (clause.types or ()):
+                        if t == "?" or not model.is_serve_error(t):
+                            continue
+                        attr = model.class_attr(t, "http_status")
+                        for s in lits:
+                            if isinstance(attr, int) and s != attr:
+                                yield self.finding(
+                                    ctx, h,
+                                    f"{short(t)} is answered with "
+                                    f"literal {s} here but declares "
+                                    f"http_status={attr} — one typed "
+                                    f"error class must map to one "
+                                    f"status on every tier; use "
+                                    f"e.http_status or fix the class")
+                    # (b) a 503 answer with no Retry-After witness
+                    if not in_handler:
+                        continue
+                    answers_503 = 503 in lits or (
+                        "dynamic" in statuses
+                        and arrives_503.get(id(h)))
+                    if answers_503 \
+                            and not model.clause_retry_after(fi, clause):
+                        via = sorted(short(q) for q in
+                                     arrives_503.get(id(h), [])) or ["503"]
+                        yield self.finding(
+                            ctx, h,
+                            f"this clause answers 503 "
+                            f"({', '.join(via)}) without a Retry-After "
+                            f"witness — a 503 with no backoff hint "
+                            f"invites synchronized retry storms; add "
+                            f"the jittered Retry-After header like the "
+                            f"other tiers")
+
+
+@register
+class UncountedShedRule(Rule):
+    """A shed-class raise with no counter witness.
+
+    Every admission refusal (the ``ShedError`` subtree: queue_full,
+    shutting_down, quota, breaker_open, no_replica…) must land on a
+    ``serve_shed_total{cause=...}`` / ``fleet_*`` / ``cluster_*``
+    counter — sheds that are invisible to the burn-rate pipeline are how
+    overload turns into a silent SLO breach. The witness may live in the
+    raising function itself, a helper one call away, or a direct caller
+    (the count-then-raise split); beyond that, the raise is reported.
+    """
+
+    name = "uncounted-shed"
+    description = ("raise of a shed-class (ShedError subtree) error on "
+                   "a path with no shed/fleet/cluster counter witness")
+
+    @staticmethod
+    def _has_family(fams: Set[str]) -> bool:
+        return bool(fams & _SHED_FAMILY_EXACT
+                    or any(f.startswith(_SHED_FAMILY_PREFIX)
+                           for f in fams))
+
+    def _callers(self, model) -> Dict[object, List[object]]:
+        rev = model.program.cache.get(_CALLERS_CACHE)
+        if rev is None:
+            rev = {}
+            for fi in model._all_funcs:
+                for ev in model.events(fi):
+                    if ev[0] == "call":
+                        rev.setdefault(ev[2], []).append(fi)
+            model.program.cache[_CALLERS_CACHE] = rev
+        return rev
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        model = get_error_model(ctx.program)
+        callers = self._callers(model)
+        for fi in ctx.module_info.all_funcs:
+            if model.sanctioned(fi, self.name):
+                continue
+            sheds = [(ev[1], ev[2]) for ev in model.events(fi)
+                     if ev[0] == "raise"
+                     and any(model.is_shed_error(q) for q in ev[1])]
+            if not sheds:
+                continue
+            if self._has_family(model.metric_families(fi, hops=1)):
+                continue
+            if any(self._has_family(model.metric_families(c, hops=1))
+                   for c in callers.get(fi, ())):
+                continue
+            for quals, node in sheds:
+                names = ", ".join(short(q) for q in quals
+                                  if model.is_shed_error(q))
+                yield self.finding(
+                    ctx, node,
+                    f"{names} raised here but neither {fi.qual}, its "
+                    f"helpers, nor any direct caller touches a "
+                    f"serve_shed_total/fleet_*/cluster_* counter — an "
+                    f"uncounted shed is invisible to the burn-rate "
+                    f"pipeline; count the cause where it is decided")
+
+
+@register
+class SsePostCommitErrorRule(Rule):
+    """An exception escaping a streaming function after the SSE commit.
+
+    Once ``send_response(200)`` + headers are on the wire, the HTTP
+    status is spent: an exception that escapes the function after that
+    point makes the outer handler write a second status line into a
+    committed stream (garbage mid-stream) — or kills the socket with no
+    in-band signal. Everything raisable past the commit point must be
+    caught locally and routed through the in-band error event
+    (``data: {"error": ..., "cause": ...}``); only the client-gone
+    family (``BrokenPipeError``/``ConnectionResetError``) may escape, as
+    there is no client left to tell.
+    """
+
+    name = "sse-post-commit-error"
+    description = ("exception raisable after the send_response(200) "
+                   "commit point escapes the streaming function")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        model = get_error_model(ctx.program)
+        for fi in ctx.module_info.all_funcs:
+            if model.sanctioned(fi, self.name):
+                continue
+            commit = model.commit_line(fi)
+            if commit is None:
+                continue
+            seen: Set[str] = set()
+            for ev in model.events(fi):
+                node = ev[2] if ev[0] == "raise" else ev[1]
+                if getattr(node, "lineno", 0) <= commit:
+                    continue
+                if ev[0] == "raise":
+                    _, quals, node, frames = ev
+                    pairs = [(q, None) for q in quals]
+                else:
+                    _, node, callee, frames = ev
+                    pairs = list(model.escapes.get(callee, {}).items())
+                for q, esc in pairs:
+                    if q in seen or model.is_client_gone(q):
+                        continue
+                    if esc is not None \
+                            and model.sanctioned(esc.origin, self.name):
+                        continue
+                    if model.land(q, frames) is not None:
+                        continue
+                    seen.add(q)
+                    chain = esc.chain if esc is not None else (
+                        f"{fi.qual} raises {short(q)} "
+                        f"(line {node.lineno})",)
+                    yield self.finding(
+                        ctx, node,
+                        f"{short(q)} can escape {fi.qual} after the SSE "
+                        f"commit point (send_response(200) at line "
+                        f"{commit}) — the outer handler would write a "
+                        f"second status line into a committed stream. "
+                        f"Witness: {_chain_text(chain)}. Catch it and "
+                        f"emit the in-band error event instead")

@@ -105,6 +105,10 @@ class BurnForecaster:
     latency plus a policy tick or two.
     """
 
+    #: ``autoscale.*`` tuned-group keys this class resolves (prefixed
+    #: ``forecast_`` in the group; see :meth:`from_config`).
+    KNOBS = frozenset({"forecast_season_s", "forecast_horizon_s"})
+
     def __init__(self, store, *, season_s: float, horizon_s: float = 60.0,
                  alpha: float = 0.5, beta: float = 0.1, gamma: float = 0.3,
                  metrics=None):
@@ -115,6 +119,22 @@ class BurnForecaster:
         self.beta = float(beta)
         self.gamma = float(gamma)
         self._metrics = metrics
+
+    @classmethod
+    def from_config(cls, store, config, **overrides) -> "BurnForecaster":
+        """Build from a tuned config's ``autoscale`` knob group — the same
+        group :meth:`AutoscalePolicy.from_config` reads its confidence
+        floor from, so one recorded winner configures the whole predictive
+        path. Group keys are prefixed (``forecast_season_s`` ->
+        ``season_s``); unknown keys are ignored and explicit keyword
+        overrides win."""
+        from ..aot.tuned import tuned_group
+        group = tuned_group(config, "autoscale")
+        opts = {k[len("forecast_"):]: v for k, v in group.items()
+                if k in cls.KNOBS}
+        opts.update(overrides)
+        opts.setdefault("season_s", 86400.0)  # one diurnal day
+        return cls(store, **opts)
 
     # ------------------------------------------------------------ generic
     def forecast(self, name: str, labels: Optional[Dict[str, str]] = None,

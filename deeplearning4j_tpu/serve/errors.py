@@ -7,6 +7,14 @@ maps it to. Clients — and tests — branch on type/cause, never on message
 text, and overload NEVER manifests as a hang: admission control raises
 :class:`ShedError` immediately, expiry raises
 :class:`DeadlineExceededError` at dispatch time.
+
+The ``cause``/``http_status`` class attributes are also the *statically
+checked* contract: jaxlint's v5 error-flow pass resolves them through the
+class hierarchy and diffs every HTTP boundary's (exception → status)
+mapping against the committed ``scripts/error_budget.json`` — changing a
+status here (or answering a typed error with a contradicting literal at a
+handler) fails CI until the budget is re-reviewed. See
+``analysis/README.md``, "Error-flow model (v5)".
 """
 
 from __future__ import annotations

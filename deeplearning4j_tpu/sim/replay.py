@@ -53,6 +53,11 @@ DEFAULT_KNOBS: Dict[str, dict] = {
     # recorded pass-through for the router tier; the virtual model does not
     # differentiate them (documented in sim/README.md)
     "cluster": {"hedge_ms": 30.0, "retry_budget_per_s": 2.0},
+    # predictive-autoscaler knobs: the confidence floor gates pre-spawn
+    # (AutoscalePolicy.from_config), season/horizon shape the forecaster
+    # (BurnForecaster.from_config) — one recorded winner configures both
+    "autoscale": {"forecast_season_s": 86400.0, "forecast_horizon_s": 60.0,
+                  "forecast_confidence": 0.5},
 }
 
 

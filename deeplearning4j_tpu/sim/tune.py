@@ -39,7 +39,10 @@ from .workload import Trace
 
 # Searched knobs and their candidate values. cluster.* knobs ride along in
 # the recorded config but are NOT searched: the virtual cost model does not
-# differentiate hedging/retry behavior (sim/README.md).
+# differentiate hedging/retry behavior (sim/README.md). autoscale.* knobs
+# (forecast season/horizon/confidence floor) are searchable the same way —
+# pass a space with `autoscale.forecast_*` keys; the winner's group feeds
+# AutoscalePolicy.from_config and BurnForecaster.from_config.
 DEFAULT_SPACE: Dict[str, Sequence] = {
     "engine.max_wait_ms": (0.5, 1.0, 2.0, 4.0, 8.0),
     "engine.queue_limit": (64, 128, 256, 512),

@@ -69,7 +69,13 @@ def _instrumented(fn, verb: str):
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
     """Quiet handler with JSON helpers; subclasses implement do_GET/do_POST
-    and reach their server object via ``self.owner``."""
+    and reach their server object via ``self.owner``.
+
+    Every ``do_*`` method is an *error-surface boundary* for jaxlint's v5
+    error-flow pass: exceptions provably reaching it must land in a typed
+    or deliberately-mapped ``except`` clause, and the per-endpoint
+    (exception → status) map is diffed against the committed
+    ``scripts/error_budget.json`` in CI."""
 
     owner = None  # set by the subclass closure
 

@@ -87,6 +87,20 @@ python -m deeplearning4j_tpu.analysis \
   --enumerate-manifest "$CI_ARTIFACTS_DIR/prebuild_manifest.json" \
   --serve-config scripts/serve_config.json
 
+# The v5 error-surface pass proves the serving tier's error behaviour
+# statically: every exception that can reach a serve/fleet/cluster HTTP
+# boundary is walked interprocedurally (analysis/errorflow.py) and its
+# (exception -> status / Retry-After / counted-metric) triple is diffed
+# against scripts/error_budget.json. A new untyped escape, a new
+# endpoint, or a typed error losing its status mapping fails the build;
+# tightening always passes. The report uploads next to the SARIF.
+echo "=== jaxlint: error-surface budget (serve/ + fleet/ + cluster/ + utils/) ==="
+python -m deeplearning4j_tpu.analysis \
+  deeplearning4j_tpu/serve deeplearning4j_tpu/fleet \
+  deeplearning4j_tpu/cluster deeplearning4j_tpu/utils \
+  --error-surface "$CI_ARTIFACTS_DIR/error_surface.json" \
+  --error-budget scripts/error_budget.json
+
 echo "=== jaxlint: ui/ + knn/ (ratchet baseline) ==="
 python -m deeplearning4j_tpu.analysis \
   deeplearning4j_tpu/ui/ deeplearning4j_tpu/knn/ \

@@ -2065,3 +2065,535 @@ class TestCompileBudget:
         with pytest.raises(SystemExit):
             cli_main([".", "--budget",
                       str(self._budget(tmp_path, "|BUCKETS|"))])
+
+# ------------------------------------------------------------ error flow (v5)
+# Fixtures carry their own typed hierarchy: the model roots on any program
+# class *named* ServeError/ShedError, so the fixtures stay self-contained.
+ERRORS_MOD = """
+class ServeError(RuntimeError):
+    cause = "internal"
+    http_status = 500
+
+
+class ShedError(ServeError):
+    cause = "queue_full"
+    http_status = 503
+
+
+class QuotaError(ShedError):
+    cause = "quota"
+    http_status = 429
+"""
+
+
+def eflint(files, rule):
+    """lint_program with the fixture error hierarchy alongside."""
+    merged = {"pkg/errors.py": ERRORS_MOD}
+    merged.update(files)
+    return lint_program(merged, rule)
+
+
+class TestErrorFlowModel:
+    def test_cross_module_chain_and_hierarchy(self):
+        from deeplearning4j_tpu.analysis.errorflow import get_error_model
+        files = {
+            "pkg/errors.py": ERRORS_MOD,
+            "pkg/deep.py": """
+                def inner():
+                    raise KeyError("k")
+
+                def mid():
+                    return inner()
+            """,
+            "pkg/top.py": """
+                from . import deep
+
+                def outer():
+                    return deep.mid()
+            """,
+        }
+        srcs = [(p, textwrap.dedent(s)) for p, s in files.items()]
+        program = build_program(srcs)
+        model = get_error_model(program)
+        mi = program.lookup_module("pkg.top")
+        fi = next(f for f in mi.all_funcs if f.name == "outer")
+        esc = model.escapes[fi]["KeyError"]
+        # three-hop witness chain, origin pinned at the raise site
+        assert len(esc.chain) == 3
+        assert esc.chain[0].startswith("outer calls mid")
+        assert "inner raises KeyError" in esc.chain[-1]
+        assert esc.origin.name == "inner"
+        # nominal hierarchy: program classes + builtins, attr inheritance
+        assert model.is_serve_error("pkg.errors.QuotaError")
+        assert model.is_shed_error("pkg.errors.QuotaError")
+        assert not model.is_serve_error("RuntimeError")
+        assert model.class_attr("pkg.errors.QuotaError", "http_status") == 429
+        assert model.class_attr("pkg.errors.ShedError", "cause") == "queue_full"
+
+
+class TestUntypedEscapeToHttp:
+    def test_cross_module_escape_flagged(self):
+        fs = eflint({
+            "pkg/work.py": """
+                def fetch(d):
+                    raise KeyError("missing")
+            """,
+            "pkg/httpd.py": """
+                from . import work
+
+                class Handler:
+                    def do_POST(self):
+                        work.fetch({})
+            """,
+        }, rule="untyped-escape-to-http")
+        assert names(fs) == ["untyped-escape-to-http"]
+        assert "ESCAPES" in fs[0].message
+        assert "KeyError" in fs[0].message
+        assert "fetch raises KeyError" in fs[0].message  # witness chain
+
+    def test_generic_catchall_flagged(self):
+        fs = eflint({
+            "pkg/work.py": """
+                def fetch(d):
+                    raise KeyError("missing")
+            """,
+            "pkg/httpd.py": """
+                from . import work
+
+                class Handler:
+                    def do_POST(self):
+                        try:
+                            work.fetch({})
+                        except Exception:  # jaxlint: disable=broad-except
+                            self.send_response(500)
+            """,
+        }, rule="untyped-escape-to-http")
+        assert names(fs) == ["untyped-escape-to-http"]
+        assert "catch-all" in fs[0].message
+
+    def test_specific_clause_is_deliberate_mapping(self):
+        fs = eflint({
+            "pkg/work.py": """
+                def fetch(d):
+                    raise KeyError("missing")
+            """,
+            "pkg/httpd.py": """
+                from . import work
+
+                class Handler:
+                    def do_POST(self):
+                        try:
+                            work.fetch({})
+                        except KeyError:
+                            self.send_response(400)
+            """,
+        }, rule="untyped-escape-to-http")
+        assert fs == []
+
+    def test_module_tuple_clause_resolves(self):
+        # the _BAD_REQUEST idiom: a module-level tuple constant in the
+        # except clause is a specific mapping, not an unresolvable "?"
+        fs = eflint({
+            "pkg/httpd.py": """
+                _BAD_REQUEST = (KeyError, ValueError)
+
+                class Handler:
+                    def do_POST(self):
+                        try:
+                            self._parse()
+                        except _BAD_REQUEST:
+                            self.send_response(400)
+
+                    def _parse(self):
+                        raise ValueError("bad json")
+            """,
+        }, rule="untyped-escape-to-http")
+        assert fs == []
+
+    def test_typed_serve_error_not_flagged(self):
+        fs = eflint({
+            "pkg/httpd.py": """
+                from .errors import ShedError
+
+                class Handler:
+                    def do_POST(self):
+                        self._admit()
+
+                    def _admit(self):
+                        raise ShedError("full")
+            """,
+        }, rule="untyped-escape-to-http")
+        assert fs == []
+
+    def test_sanction_on_boundary_mutes(self):
+        fs = eflint({
+            "pkg/httpd.py": """
+                class Handler:
+                    # debug-only endpoint: programming errors 500 on purpose
+                    def do_POST(self):  # jaxlint: sanction=untyped-escape-to-http
+                        raise KeyError("missing")
+            """,
+        }, rule="untyped-escape-to-http")
+        assert fs == []
+
+
+class TestSwallowedTypedError:
+    def test_wrap_into_untyped_flagged(self):
+        fs = eflint({
+            "pkg/disp.py": """
+                from .errors import ShedError
+
+                def submit(q):
+                    raise ShedError("full")
+
+                def dispatch(q):
+                    try:
+                        submit(q)
+                    except ShedError as e:
+                        raise RuntimeError("dispatch failed")
+            """,
+        }, rule="swallowed-typed-error")
+        assert names(fs) == ["swallowed-typed-error"]
+        assert "ShedError" in fs[0].message
+        assert "RuntimeError" in fs[0].message
+
+    def test_reraise_and_typed_wrap_clean(self):
+        fs = eflint({
+            "pkg/disp.py": """
+                from .errors import QuotaError, ShedError
+
+                def submit(q):
+                    raise ShedError("full")
+
+                def reraises(q):
+                    try:
+                        submit(q)
+                    except ShedError as e:
+                        raise e
+
+                def wraps_typed(q):
+                    try:
+                        submit(q)
+                    except ShedError as e:
+                        raise QuotaError("over") from e
+            """,
+        }, rule="swallowed-typed-error")
+        assert fs == []
+
+
+class TestErrorStatusDrift:
+    def test_literal_contradicts_http_status(self):
+        fs = eflint({
+            "pkg/worker.py": """
+                from .errors import ShedError
+
+                class Worker:
+                    def run(self):
+                        try:
+                            self.admit()
+                        except ShedError as e:
+                            self._err(500, str(e))
+
+                    def admit(self):
+                        raise ShedError("full")
+
+                    def _err(self, code, body):
+                        pass
+            """,
+        }, rule="error-status-drift")
+        assert names(fs) == ["error-status-drift"]
+        assert "http_status=503" in fs[0].message
+
+    def test_503_without_retry_after_flagged(self):
+        fs = eflint({
+            "pkg/httpd.py": """
+                from .errors import ShedError
+
+                class Handler:
+                    def do_POST(self):
+                        try:
+                            self._admit()
+                        except ShedError as e:
+                            self.send_response(503)
+
+                    def _admit(self):
+                        raise ShedError("full")
+            """,
+        }, rule="error-status-drift")
+        assert names(fs) == ["error-status-drift"]
+        assert "Retry-After" in fs[0].message
+
+    def test_503_with_retry_after_clean(self):
+        fs = eflint({
+            "pkg/httpd.py": """
+                from .errors import ShedError
+
+                class Handler:
+                    def do_POST(self):
+                        try:
+                            self._admit()
+                        except ShedError as e:
+                            self.send_response(503)
+                            self.send_header("Retry-After", "3")
+
+                    def _admit(self):
+                        raise ShedError("full")
+            """,
+        }, rule="error-status-drift")
+        assert fs == []
+
+
+class TestUncountedShed:
+    def test_uncounted_raise_flagged(self):
+        fs = eflint({
+            "pkg/q.py": """
+                from .errors import ShedError
+
+                class Q:
+                    def admit(self, n):
+                        if n > 8:
+                            raise ShedError("queue full")
+            """,
+        }, rule="uncounted-shed")
+        assert names(fs) == ["uncounted-shed"]
+        assert "ShedError" in fs[0].message
+
+    def test_self_count_clean(self):
+        fs = eflint({
+            "pkg/q.py": """
+                from .errors import ShedError
+
+                class Q:
+                    def admit(self, n):
+                        if n > 8:
+                            self.metrics.counter(
+                                "serve_shed_total", cause="queue_full").inc()
+                            raise ShedError("queue full")
+            """,
+        }, rule="uncounted-shed")
+        assert fs == []
+
+    def test_direct_caller_count_clean(self):
+        # the count-then-raise split: the caller owns the counter
+        fs = eflint({
+            "pkg/q.py": """
+                from .errors import ShedError
+
+                class Q:
+                    def admit(self, n):
+                        if n > 8:
+                            raise ShedError("queue full")
+
+                    def offer(self, n):
+                        self.metrics.counter(
+                            "fleet_shed_total", cause="q").inc()
+                        self.admit(n)
+            """,
+        }, rule="uncounted-shed")
+        assert fs == []
+
+    def test_sanction_mutes(self):
+        fs = eflint({
+            "pkg/q.py": """
+                from .errors import ShedError
+
+                class Q:
+                    # internal retry signal, counted at the boundary
+                    def admit(self, n):  # jaxlint: sanction=uncounted-shed
+                        if n > 8:
+                            raise ShedError("queue full")
+            """,
+        }, rule="uncounted-shed")
+        assert fs == []
+
+
+class TestSsePostCommitError:
+    def test_escape_after_commit_flagged(self):
+        fs = eflint({
+            "pkg/stream.py": """
+                class Streamer:
+                    def step(self):
+                        raise ValueError("bad chunk")
+
+                    def pump(self, handler):
+                        handler.send_response(200)
+                        self.step()
+            """,
+        }, rule="sse-post-commit-error")
+        assert names(fs) == ["sse-post-commit-error"]
+        assert "commit" in fs[0].message
+        assert "ValueError" in fs[0].message
+
+    def test_caught_locally_clean(self):
+        fs = eflint({
+            "pkg/stream.py": """
+                class Streamer:
+                    def step(self):
+                        raise ValueError("bad chunk")
+
+                    def pump(self, handler):
+                        handler.send_response(200)
+                        try:
+                            self.step()
+                        except ValueError:
+                            pass  # in-band error event
+            """,
+        }, rule="sse-post-commit-error")
+        assert fs == []
+
+    def test_client_gone_may_escape(self):
+        fs = eflint({
+            "pkg/stream.py": """
+                class Streamer:
+                    def pump(self, handler):
+                        handler.send_response(200)
+                        raise BrokenPipeError()
+            """,
+        }, rule="sse-post-commit-error")
+        assert fs == []
+
+    def test_isinstance_narrowed_reraise_clean(self):
+        # the router's client-gone idiom: the bare raise under the
+        # isinstance guard re-raises ONLY the narrowed family, not the
+        # whole clause tuple
+        fs = eflint({
+            "pkg/stream.py": """
+                class Streamer:
+                    def step(self):
+                        raise ValueError("bad chunk")
+
+                    def pump(self, handler):
+                        handler.send_response(200)
+                        try:
+                            self.step()
+                        except (ValueError, OSError) as e:
+                            if isinstance(e, BrokenPipeError):
+                                raise
+            """,
+        }, rule="sse-post-commit-error")
+        assert fs == []
+
+
+# ------------------------------------------------- error-surface budget (v5)
+class TestErrorSurfaceCli:
+    SRC_HTTP = """
+    from .errors import ServeError, ShedError
+
+
+    class Handler:
+        def do_POST(self):
+            try:
+                self._work()
+            except ServeError as e:
+                self.send_response(e.http_status)
+
+        def do_GET(self):
+            self._parse()
+
+        def _work(self):
+            raise ShedError("full")
+
+        def _parse(self):
+            raise ValueError("bad query")
+    """
+
+    def _write_tree(self, tmp_path):
+        pkg = tmp_path / "svc"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "errors.py").write_text(textwrap.dedent(ERRORS_MOD))
+        (pkg / "httpd.py").write_text(textwrap.dedent(self.SRC_HTTP))
+        return pkg
+
+    def _gen(self, tmp_path, monkeypatch):
+        """Generate the surface once; derive a budget that matches it."""
+        monkeypatch.chdir(tmp_path)
+        self._write_tree(tmp_path)
+        out = tmp_path / "error_surface.json"
+        rc = cli_main(["svc", "--error-surface", str(out)])
+        assert rc == 0
+        report = json.loads(out.read_text())
+        budget = {"endpoints": {
+            ep["endpoint"]: {
+                "why": "test",
+                "errors": {e["exception"]: {
+                    "status": e["status"],
+                    "retry_after": e["retry_after"],
+                    "counted": e["counted"],
+                } for e in ep["errors"]},
+            } for ep in report["endpoints"]}}
+        return out, report, budget
+
+    def test_surface_contents(self, tmp_path, monkeypatch):
+        _, report, _ = self._gen(tmp_path, monkeypatch)
+        eps = {ep["endpoint"]: ep for ep in report["endpoints"]}
+        assert set(eps) == {"svc.httpd:Handler.do_GET",
+                            "svc.httpd:Handler.do_POST"}
+        post = eps["svc.httpd:Handler.do_POST"]["errors"]
+        # typed ShedError keeps its class http_status through the
+        # explicitly-typed except ServeError entry
+        assert [(r["class"], r["typed"], r["status"]) for r in post] \
+            == [("ShedError", True, 503)]
+        get = eps["svc.httpd:Handler.do_GET"]["errors"]
+        assert [(r["class"], r["typed"], r["status"]) for r in get] \
+            == [("ValueError", False, "escape")]
+
+    def test_within_budget_passes(self, tmp_path, capsys, monkeypatch):
+        out, _, budget = self._gen(tmp_path, monkeypatch)
+        b = tmp_path / "error_budget.json"
+        b.write_text(json.dumps(budget))
+        rc = cli_main(["svc", "--error-surface", str(out),
+                       "--error-budget", str(b)])
+        assert rc == 0
+        assert "error budget: ok" in capsys.readouterr().out
+
+    def test_new_untyped_escape_fails(self, tmp_path, capsys, monkeypatch):
+        out, _, budget = self._gen(tmp_path, monkeypatch)
+        del budget["endpoints"]["svc.httpd:Handler.do_GET"][
+            "errors"]["ValueError"]
+        b = tmp_path / "error_budget.json"
+        b.write_text(json.dumps(budget))
+        rc = cli_main(["svc", "--error-surface", str(out),
+                       "--error-budget", str(b)])
+        assert rc == 1
+        assert "new untyped escape" in capsys.readouterr().out
+
+    def test_tightening_passes(self, tmp_path, capsys, monkeypatch):
+        # an error class the budget allows but the tree no longer raises
+        out, _, budget = self._gen(tmp_path, monkeypatch)
+        budget["endpoints"]["svc.httpd:Handler.do_POST"]["errors"][
+            "svc.errors.QuotaError"] = {
+                "status": 429, "retry_after": False, "counted": []}
+        b = tmp_path / "error_budget.json"
+        b.write_text(json.dumps(budget))
+        rc = cli_main(["svc", "--error-surface", str(out),
+                       "--error-budget", str(b)])
+        assert rc == 0
+
+    def test_stale_endpoint_fails(self, tmp_path, capsys, monkeypatch):
+        out, _, budget = self._gen(tmp_path, monkeypatch)
+        budget["endpoints"]["svc.httpd:Handler.do_DELETE"] = {
+            "why": "gone", "errors": {}}
+        b = tmp_path / "error_budget.json"
+        b.write_text(json.dumps(budget))
+        rc = cli_main(["svc", "--error-surface", str(out),
+                       "--error-budget", str(b)])
+        assert rc == 1
+        got = capsys.readouterr().out
+        assert "stale budget endpoint" in got
+        assert "do_DELETE" in got
+
+    def test_status_drift_fails(self, tmp_path, capsys, monkeypatch):
+        out, _, budget = self._gen(tmp_path, monkeypatch)
+        budget["endpoints"]["svc.httpd:Handler.do_POST"]["errors"][
+            "svc.errors.ShedError"]["status"] = 429
+        b = tmp_path / "error_budget.json"
+        b.write_text(json.dumps(budget))
+        rc = cli_main(["svc", "--error-surface", str(out),
+                       "--error-budget", str(b)])
+        assert rc == 1
+        assert "status mapping drifted" in capsys.readouterr().out
+
+    def test_error_budget_requires_surface_flag(self, tmp_path):
+        with pytest.raises(SystemExit):
+            cli_main([".", "--error-budget", "nope.json"])

@@ -212,6 +212,40 @@ class TestTuner:
         res = Tuner(t, seed=9).search(candidates=6, eta=3, min_events=64)
         assert res.winner_score >= res.default_score
 
+    def test_autoscale_forecast_knobs_are_searchable(self):
+        # the autoscale.* group rides the same search machinery: samples
+        # draw from the space, the winner records the group, and both
+        # consumers — the policy's confidence floor and the forecaster's
+        # season/horizon — resolve it via from_config
+        from deeplearning4j_tpu.autoscale.policy import AutoscalePolicy
+        from deeplearning4j_tpu.obs.forecast import BurnForecaster
+        from deeplearning4j_tpu.obs.tsdb import TimeSeriesStore
+
+        t = generate_trace(_spec(rate=30.0, duration_s=10.0))
+        space = {"gen.slots": (2, 4),
+                 "autoscale.forecast_confidence": (0.3, 0.9),
+                 "autoscale.forecast_horizon_s": (30.0, 120.0),
+                 "autoscale.forecast_season_s": (3600.0, 86400.0)}
+        tuner = Tuner(t, seed=3, space=space)
+        cand = tuner._sample(random.Random(3))
+        assert cand["autoscale"]["forecast_confidence"] in (0.3, 0.9)
+        assert cand["autoscale"]["forecast_horizon_s"] in (30.0, 120.0)
+        assert cand["autoscale"]["forecast_season_s"] in (3600.0, 86400.0)
+
+        res = tuner.search(candidates=4, eta=2, min_events=64)
+        grp = res.winner["autoscale"]
+        assert set(grp) >= {"forecast_confidence", "forecast_horizon_s",
+                            "forecast_season_s"}
+        pol = AutoscalePolicy.from_config(res.winner)
+        assert pol.forecast_confidence == grp["forecast_confidence"]
+        fc = BurnForecaster.from_config(TimeSeriesStore(), res.winner)
+        assert fc.season_s == grp["forecast_season_s"]
+        assert fc.horizon_s == grp["forecast_horizon_s"]
+        # an empty config degrades to defaults, overrides win
+        fc2 = BurnForecaster.from_config(TimeSeriesStore(), None,
+                                         horizon_s=45.0)
+        assert fc2.season_s == 86400.0 and fc2.horizon_s == 45.0
+
 
 # ----------------------------------------------------------- tuned-cfg store
 class TestTunedStore:
