@@ -911,6 +911,113 @@ def main():
               f"{json.dumps(breadth)[:800]}", file=sys.stderr)
 
 
+def _bench_elastic():
+    """``python bench.py --elastic``: what elasticity costs.
+
+    Three numbers (ISSUE 19): the elastic trainer's steady-state step
+    time against a plain ``Trainer.fit`` on the same model and batch
+    stream (the price of membership supervision + ZeRO sharding +
+    logical-clock bookkeeping per step); the wall latency of one
+    chaos-triggered resize (checkpoint + planned reshard + checkpoint);
+    and the redistribution planner's moved bytes against the naive
+    full re-gather it replaces. Writes the next free
+    BENCH_elastic_rNN.json. Env: BENCH_ELASTIC_STEPS (30).
+    """
+    import shutil
+    import statistics
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.chaos import FaultPlane, install, uninstall
+    from deeplearning4j_tpu.data import ArrayIterator
+    from deeplearning4j_tpu.elastic import ElasticTrainer
+    from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.train import Trainer
+
+    steps = int(os.environ.get("BENCH_ELASTIC_STEPS", 30))
+    batch, feat = 24, 64
+
+    def build():
+        return (SequentialBuilder(
+            NetConfig(seed=0, updater={"type": "adam",
+                                       "learning_rate": 1e-2}))
+            .input_shape(feat)
+            .layer(L.Dense(n_out=256, activation="relu"))
+            .layer(L.Output(n_out=12, activation="softmax", loss="mcxent"))
+            .build())
+
+    def batch_fn(step):
+        rng = np.random.RandomState(1000 + step)
+        x = rng.randn(batch, feat).astype(np.float32)
+        y = np.eye(12, dtype=np.float32)[rng.randint(0, 12, batch)]
+        return x, y
+
+    # plain baseline: same model/optimizer, single-process Trainer.fit on
+    # the identical batch stream (one epoch = `steps` minibatches)
+    xs = np.concatenate([batch_fn(i)[0] for i in range(steps)])
+    ys = np.concatenate([batch_fn(i)[1] for i in range(steps)])
+    tr = Trainer(build())
+    tr.fit(ArrayIterator(xs, ys, batch, shuffle=False), epochs=1,
+           prefetch=False)  # warm the jit
+    t0 = time.perf_counter()
+    tr.fit(ArrayIterator(xs, ys, batch, shuffle=False), epochs=1,
+           prefetch=False)
+    plain_step_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    wd = tempfile.mkdtemp(prefix="bench_elastic_")
+    try:
+        et = ElasticTrainer(build(), workdir=wd, dp=4, dp_min=2, seed=0)
+        et.fit(batch_fn, 5)  # warm every ladder width, settle the jit
+        times = []
+        mark = et.iteration
+        t0 = time.perf_counter()
+        et.fit(batch_fn, mark + steps)
+        times.append((time.perf_counter() - t0) / steps * 1e3)
+        elastic_step_ms = statistics.median(times)
+
+        # one chaos-triggered resize 4 -> 3, timed end to end
+        fp = FaultPlane(seed=0).inject_spec(
+            "elastic.step:error:scope=w1,times=1")
+        install(fp)
+        try:
+            et.fit(batch_fn, et.iteration + 4)
+        finally:
+            uninstall()
+        assert et.dp == 3 and et.resizes, "bench drill failed to resize"
+        rec = et.resizes[0]
+        post_traces = et.trace_count()
+        et.fit(batch_fn, et.iteration + 2)
+        assert et.trace_count() == post_traces, "post-resize compile miss"
+    finally:
+        shutil.rmtree(wd, ignore_errors=True)
+
+    headline = {
+        "metric": "elastic_step_overhead",
+        "value": round(elastic_step_ms / max(plain_step_ms, 1e-9), 2),
+        "unit": "x",
+        "detail": {
+            "steps": steps,
+            "plain_step_ms": round(plain_step_ms, 3),
+            "elastic_step_ms": round(elastic_step_ms, 3),
+            "resize_seconds": round(rec["seconds"], 4),
+            "resize": {k: rec[k] for k in ("step", "from", "to", "cause")},
+            "reshard_bytes_moved": rec["bytes_moved"],
+            "reshard_bytes_naive": rec["bytes_naive"],
+            "reshard_savings": round(
+                1.0 - rec["bytes_moved"] / max(rec["bytes_naive"], 1), 4),
+            "device": str(jax.devices()[0].device_kind),
+        },
+    }
+    _stamp(headline, "bench.py --elastic")
+    print(json.dumps(headline), flush=True)
+    out_path = _next_round_path("BENCH_elastic")
+    with open(out_path, "w") as f:
+        json.dump(headline, f, indent=1)
+    print(f"bench elastic -> {out_path}", file=sys.stderr)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv[1:]:
         _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
@@ -921,5 +1028,12 @@ if __name__ == "__main__":
     elif "--fleet" in sys.argv[1:]:
         _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
         _bench_fleet()
+    elif "--elastic" in sys.argv[1:]:
+        # the elastic ladder needs >= 4 devices; on a CPU box fan out the
+        # host platform before jax initializes (no-op on a real slice)
+        os.environ.setdefault("XLA_FLAGS",
+                              "--xla_force_host_platform_device_count=8")
+        _probe_devices(float(os.environ.get("BENCH_DEVICE_TIMEOUT", 180)))
+        _bench_elastic()
     else:
         main()

@@ -13,7 +13,7 @@ trace + seed + fake clock ⇒ byte-identical decision log.
 from .controller import AutoscaleController
 from .policy import (DEFAULT_BURN_OUT, HOLD, IN, OUT, AutoscalePolicy,
                      ScaleDecision)
-from .signals import Sample, SignalReader
+from .signals import Sample, SignalReader, StepTimeSignalReader
 
 __all__ = [
     "AutoscaleController",
@@ -25,4 +25,5 @@ __all__ = [
     "Sample",
     "ScaleDecision",
     "SignalReader",
+    "StepTimeSignalReader",
 ]

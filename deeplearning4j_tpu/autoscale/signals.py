@@ -45,7 +45,48 @@ class Sample(NamedTuple):
     alive: int                      # non-dead replicas in membership
 
 
-class SignalReader:
+class _SampleWindow:
+    """The rolling window + sustain predicate every signal reader shares.
+
+    Subclasses produce :class:`Sample`\\ s however they like (fleet scrape,
+    training step times) and push them through :meth:`_push`; the policy
+    only ever consumes :meth:`window` / :meth:`sustained`, so one reader
+    is substitutable for another by construction."""
+
+    def __init__(self, window_s: float):
+        if window_s <= 0:
+            raise ValueError("need window_s > 0")
+        self.window_s = float(window_s)
+        self._samples: Deque[Sample] = deque()
+
+    def _push(self, s: Sample) -> Sample:
+        """Append one sample and age out everything past the window."""
+        self._samples.append(s)
+        horizon = s.t - self.window_s
+        while self._samples and self._samples[0].t < horizon:
+            self._samples.popleft()
+        return s
+
+    def window(self) -> List[Sample]:
+        """The retained samples, oldest first."""
+        return list(self._samples)
+
+    def sustained(self, pred: Callable[[Sample], bool], for_s: float,
+                  now: float) -> bool:
+        """True iff the window reaches back at least ``for_s`` seconds AND
+        every sample inside the trailing ``for_s`` satisfies ``pred`` —
+        one spiky sample can never trigger, and neither can a window too
+        young to know what "sustained" means yet."""
+        if not self._samples:
+            return False
+        horizon = now - float(for_s)
+        if self._samples[0].t > horizon:
+            return False  # not enough history to call anything sustained
+        inside = [s for s in self._samples if s.t >= horizon]
+        return bool(inside) and all(pred(s) for s in inside)
+
+
+class SignalReader(_SampleWindow):
     """Samples the autoscaler's inputs into a rolling window.
 
     ``slo`` is any object with the :class:`~..obs.slo.SloBurn` snapshot
@@ -57,14 +98,11 @@ class SignalReader:
 
     def __init__(self, *, slo, membership, clock: Callable[[], float],
                  burn_window: str = "1m", window_s: float = 120.0):
-        if window_s <= 0:
-            raise ValueError("need window_s > 0")
+        super().__init__(window_s)
         self._slo = slo
         self._membership = membership
         self._clock = clock
         self.burn_window = str(burn_window)
-        self.window_s = float(window_s)
-        self._samples: Deque[Sample] = deque()
 
     def sample(self) -> Sample:
         """Take one observation, append it, and age out old ones."""
@@ -88,27 +126,37 @@ class SignalReader:
             queue_depth += int(p.get("queue_depth") or 0)
             kv = max(kv, float(p.get("kv_utilization") or 0.0))
             alive += 1
-        s = Sample(now, burn, burn_detail, queue_depth, kv, alive)
-        self._samples.append(s)
-        horizon = now - self.window_s
-        while self._samples and self._samples[0].t < horizon:
-            self._samples.popleft()
-        return s
+        return self._push(Sample(now, burn, burn_detail, queue_depth, kv,
+                                 alive))
 
-    def window(self) -> List[Sample]:
-        """The retained samples, oldest first."""
-        return list(self._samples)
 
-    def sustained(self, pred: Callable[[Sample], bool], for_s: float,
-                  now: float) -> bool:
-        """True iff the window reaches back at least ``for_s`` seconds AND
-        every sample inside the trailing ``for_s`` satisfies ``pred`` —
-        one spiky sample can never trigger, and neither can a window too
-        young to know what "sustained" means yet."""
-        if not self._samples:
-            return False
-        horizon = now - float(for_s)
-        if self._samples[0].t > horizon:
-            return False  # not enough history to call anything sustained
-        inside = [s for s in self._samples if s.t >= horizon]
-        return bool(inside) and all(pred(s) for s in inside)
+class StepTimeSignalReader(_SampleWindow):
+    """Step-time regression as SLO burn — the training-side signal source.
+
+    The elastic trainer has no request SLO; its contract is a **step-time
+    budget**. Each observed step maps to burn ``step_time / budget_s``
+    under the single ``"train"`` class, so the stock
+    :class:`~.policy.AutoscalePolicy` applies UNCHANGED: burn >= 1.0
+    (steps slower than budget) sustained over the out-window scales the
+    mesh out; burn deep inside the hysteresis band (steps comfortably
+    under budget) sustained over the in-window scales it in. Timestamps
+    come from the injected clock — the elastic trainer passes its logical
+    step clock, so sustain/cooldown windows are measured in *steps* and
+    the whole loop stays deterministic under test.
+    """
+
+    def __init__(self, *, budget_s: float, clock: Callable[[], float],
+                 window_s: float = 120.0):
+        super().__init__(window_s)
+        if budget_s <= 0:
+            raise ValueError("need budget_s > 0")
+        self.budget_s = float(budget_s)
+        self._clock = clock
+
+    def observe(self, step_time_s: float, *, alive: int = 1) -> Sample:
+        """Record one training step's duration as a burn sample."""
+        now = float(self._clock())
+        burn = float(step_time_s) / self.budget_s
+        return self._push(Sample(now, {"train": burn},
+                                 {"train/train": burn}, 0, 0.0,
+                                 int(alive)))

@@ -21,6 +21,15 @@ never corrupt donated buffers:
 - ``autoscale.spawn``       — the autoscale controller, just before it
   provisions a scale-out replica (a fired fault = a failed provision;
   the controller must survive it and retry on a later tick)
+- ``elastic.step``          — the elastic trainer's per-worker
+  supervision round (scope = worker id; a fired error = that worker
+  crashed mid-step and stops heartbeating)
+- ``elastic.resize``        — between the pre-resize checkpoint and the
+  redistribution (a fired error = the coordinator died mid-resize; the
+  run must resume from the just-published checkpoint)
+- ``train.segment``         — a fault-tolerant fit's segment boundary,
+  before the segment checkpoint lands (a fired error = preemption; the
+  relaunched fit must pick up from the last durable segment)
 
 Multi-instance seams (one router talking to N in-process replicas) can be
 targeted individually: a site passes ``scope="replica-0"`` to :meth:`hit`
@@ -59,6 +68,9 @@ POINTS = (
     "http.handler",
     "cluster.transport",
     "autoscale.spawn",
+    "elastic.step",
+    "elastic.resize",
+    "train.segment",
 )
 
 #: The installed plane, or None (the zero-overhead default). Injection

@@ -1,0 +1,183 @@
+"""Training-time failure recovery — divergence rollback and segmented
+checkpoint-resume, folded into the chaos fault plane.
+
+These tools lived in ``train/faults.py`` as a second, orphaned
+fault-handling path; they now sit next to the injector they are tested
+against, and the segmented fit exercises the ``train.segment`` injection
+point so preemption-between-segments is a seeded CI scenario rather than
+a hope. ``train.faults`` remains as an import shim.
+
+Module-level imports stay stdlib-only (the chaos base-layer rule —
+``chaos/__init__.py``): numpy/jax/optax and the train serialization
+helpers load inside the methods that need them, so arming a fault plane
+never drags the training stack into the process.
+
+- :class:`DivergenceListener` — NaN/inf loss detection with configurable
+  action: raise (fail fast), or restore the last good snapshot and
+  continue with a reduced learning-rate scale.
+- :class:`FaultTolerantFit` — checkpoint-resume wrapper: runs
+  ``Trainer.fit`` in segments, persisting params/opt-state every
+  segment, so a preempted process restarted with the same directory
+  continues where it left off.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+from . import faults as _faults
+
+
+class TrainingDivergedException(RuntimeError):
+    pass
+
+
+class RecoveryListener:
+    """Minimal training-listener surface (duck-typed — the fit loops only
+    ever call these and read the two class flags, so the chaos layer does
+    not need to import ``train.listeners`` at module scope)."""
+
+    requires_sync = False
+    snapshots_state = False
+
+    def on_epoch_start(self, trainer, epoch):
+        pass
+
+    def on_epoch_end(self, trainer, epoch):
+        pass
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        pass
+
+
+class DivergenceListener(RecoveryListener):
+    """Watches the per-iteration loss; on NaN/inf either raises
+    ``TrainingDivergedException`` (action='raise') or rolls the trainer back
+    to the last finite-loss snapshot (action='rollback')."""
+
+    # steers the loop from iteration_done (rollback must act before the next
+    # dispatch), so the fit loops must not defer this listener's reporting
+    requires_sync = True
+
+    def __init__(self, action: str = "raise", snapshot_every: int = 10,
+                 max_rollbacks: int = 3, lr_backoff: float = 0.5):
+        assert action in ("raise", "rollback")
+        self.action = action
+        self.snapshot_every = max(snapshot_every, 1)
+        self.max_rollbacks = max_rollbacks
+        self.lr_backoff = lr_backoff
+        self.lr_scale = 1.0
+        self.rollbacks = 0
+        # two-stage snapshot: the loss reported at iteration k was computed
+        # from the params BEFORE that step's update, so the params captured at
+        # iteration k are unvalidated until a LATER finite loss confirms them.
+        # _pending holds the newest (unvalidated) capture; _snap only ever
+        # holds a capture whose params a later step scored finite.
+        self._pending = None
+        self._snap = None
+
+    def iteration_done(self, trainer, iteration, epoch, loss):
+        import jax
+        import numpy as np
+
+        if math.isfinite(loss):
+            if self._pending is not None:
+                self._snap = self._pending  # validated by this finite loss
+                self._pending = None
+            if iteration % self.snapshot_every == 0:
+                # host copies: the jitted step donates the device buffers.
+                # Record whether the opt state was captured in the chained
+                # (post-rollback) structure so a later restore can re-wrap.
+                self._pending = (jax.tree.map(np.asarray, trainer.params),
+                                 jax.tree.map(np.asarray, trainer.opt_state),
+                                 getattr(trainer, "_base_tx", None) is not None)
+            return
+        self._pending = None  # produced this non-finite loss: poison
+        if self.action == "raise" or self._snap is None:
+            raise TrainingDivergedException(
+                f"loss {loss} at iteration {iteration} (epoch {epoch})")
+        if self.rollbacks >= self.max_rollbacks:
+            raise TrainingDivergedException(
+                f"diverged {self.rollbacks + 1}x despite rollbacks")
+        self.rollbacks += 1
+        params, opt_state, snap_chained = self._snap
+        trainer.params = jax.tree.map(lambda a: a, params)
+        trainer.opt_state = jax.tree.map(lambda a: a, opt_state)
+        # shrink the learning rate so a deterministic replay of the same data
+        # order doesn't re-diverge identically: chain a (stateless) scale
+        # stage onto the optimizer and rebuild the jitted step
+        import optax
+
+        self.lr_scale *= self.lr_backoff
+        if not snap_chained:
+            # opt-state gains the scale stage's EmptyState; snapshots taken
+            # after the first rollback already carry the chained structure
+            trainer.opt_state = (trainer.opt_state,
+                                 optax.scale(1.0).init(trainer.params))
+        if getattr(trainer, "_base_tx", None) is None:
+            trainer._base_tx = trainer.tx
+        trainer.tx = optax.chain(trainer._base_tx, optax.scale(self.lr_scale))
+        trainer._step_fn = None
+        trainer._multi_step_fn = None
+        trainer._accum_step_fn = None
+        trainer._tbptt_step_fn = None
+
+
+class FaultTolerantFit:
+    """Segmented fit with durable progress: every ``segment_epochs`` the
+    model + optimizer state land in ``directory``; a relaunched process picks
+    up from the recorded epoch (orbax-style resume semantics on the simple
+    zip checkpoint format). Each segment boundary passes through the
+    ``train.segment`` chaos seam *before* its checkpoint lands, so a seeded
+    scenario can preempt the process with the previous segment still the
+    durable truth — exactly the window a real preemption hits."""
+
+    def __init__(self, trainer, directory: str, segment_epochs: int = 1):
+        self.trainer = trainer
+        self.directory = directory
+        self.segment_epochs = max(segment_epochs, 1)
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def _meta_path(self) -> str:
+        return os.path.join(self.directory, "progress.json")
+
+    @property
+    def _ckpt_path(self) -> str:
+        return os.path.join(self.directory, "fault_tolerant.zip")
+
+    def completed_epochs(self) -> int:
+        if not os.path.exists(self._meta_path):
+            return 0
+        with open(self._meta_path) as f:
+            return int(json.load(f).get("completed_epochs", 0))
+
+    def fit(self, iterator, epochs: int, listeners=(), prefetch: bool = True):
+        from ..train.serialization import load_model, save_model
+
+        done = self.completed_epochs()
+        if done > 0 and os.path.exists(self._ckpt_path):
+            _, params, state, opt_state, _ = load_model(
+                self._ckpt_path, opt_state_template=self.trainer.opt_state)
+            self.trainer.params = params
+            self.trainer.state = state
+            if opt_state is not None:
+                self.trainer.opt_state = opt_state
+            self.trainer.epoch = done
+        while done < epochs:
+            seg = min(self.segment_epochs, epochs - done)
+            self.trainer.fit(iterator, epochs=seg, listeners=listeners,
+                             prefetch=prefetch)
+            done += seg
+            if _faults.ACTIVE is not None:
+                # preemption window: the segment ran but its checkpoint has
+                # not landed — a relaunch must redo exactly this segment
+                _faults.ACTIVE.hit("train.segment", scope=str(done))
+            save_model(self._ckpt_path, self.trainer.model,
+                       params=self.trainer.params, state=self.trainer.state,
+                       opt_state=self.trainer.opt_state)
+            with open(self._meta_path, "w") as f:
+                json.dump({"completed_epochs": done}, f)
+        return self.trainer

@@ -1,0 +1,32 @@
+"""elastic/ — fault-tolerant elastic training on the serving control plane.
+
+:class:`ElasticTrainer` runs a ZeRO-1 weight-update-sharded train step
+(arXiv 2004.13336) under membership supervision, resizes its
+data-parallel mesh on chaos-injected worker death or an autoscale
+step-time-burn decision, redistributes optimizer state with the
+minimal-traffic planner (arXiv 2112.01075), and publishes an atomic
+checkpoint at every resize boundary. See ``elastic/README.md`` for the
+failure-mode table.
+"""
+
+from .checkpoint import CheckpointInfo, latest, save_atomic
+from .reshard import (LeafLayout, LeafMove, ReshardPlan, leaf_layout,
+                      plan_leaf, plan_reshard)
+from .trainer import (ElasticError, ElasticTrainer, NoCheckpointError,
+                      QuorumLostError)
+
+__all__ = [
+    "CheckpointInfo",
+    "ElasticError",
+    "ElasticTrainer",
+    "LeafLayout",
+    "LeafMove",
+    "NoCheckpointError",
+    "QuorumLostError",
+    "ReshardPlan",
+    "latest",
+    "leaf_layout",
+    "plan_leaf",
+    "plan_reshard",
+    "save_atomic",
+]

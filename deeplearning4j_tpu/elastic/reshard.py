@@ -1,0 +1,164 @@
+"""Optimizer-state redistribution planning for mesh resizes.
+
+When the elastic trainer resizes its data-parallel mesh (dp_from ->
+dp_to), every ZeRO-sharded optimizer leaf must move from its old block
+layout to the new one. The planner here follows the memory-efficient
+array-redistribution discipline (PAPERS.md arXiv 2112.01075): describe
+both layouts as per-device index blocks, intersect them, and count only
+the **non-resident** bytes as traffic — a device keeps whatever slice of
+the leaf it already holds, and fetches only the set difference. The
+naive comparator is the full re-gather every portable implementation
+starts from: replicate the whole leaf to every participant, then slice
+locally.
+
+The plan is pure bookkeeping (shapes + the shared
+:func:`~..parallel.sharding.zero_shard_dim` layout rule — no device
+traffic); the actual movement is one ``jax.device_put`` onto the new
+``NamedSharding``s, where XLA's D2D transfers realize exactly the
+resident-block reuse the plan counted. Keeping the accounting host-side
+means the resize path adds zero traced code.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..parallel.sharding import zero_shard_dim
+
+
+class LeafLayout(NamedTuple):
+    """One pytree leaf's block layout at a given dp width.
+
+    ``dim`` is the sharded dimension (None = replicated on every
+    participant). Blocks are the contiguous equal slices jax places for a
+    1-axis ``PartitionSpec`` over ``dp`` devices.
+    """
+
+    path: str
+    shape: Tuple[int, ...]
+    itemsize: int
+    dim: Optional[int]
+    dp: int
+
+
+class LeafMove(NamedTuple):
+    """Planned traffic for one leaf: bytes fetched under the overlap plan
+    vs. the naive full re-gather."""
+
+    path: str
+    bytes_moved: int
+    bytes_naive: int
+
+
+class ReshardPlan(NamedTuple):
+    """The full redistribution bill for one dp_from -> dp_to resize."""
+
+    dp_from: int
+    dp_to: int
+    moves: Tuple[LeafMove, ...]
+    bytes_moved: int
+    bytes_naive: int
+    bytes_total: int  # size of everything being redistributed
+
+    def summary(self) -> dict:
+        """JSON-safe headline (what the bench round and flight record)."""
+        return {"dp_from": self.dp_from, "dp_to": self.dp_to,
+                "bytes_moved": self.bytes_moved,
+                "bytes_naive": self.bytes_naive,
+                "bytes_total": self.bytes_total,
+                "leaves": len(self.moves)}
+
+
+def leaf_layout(path: str, shape: Sequence[int], itemsize: int,
+                dp: int) -> LeafLayout:
+    """The layout of one optimizer-state leaf at dp width ``dp`` under the
+    shared ZeRO rule (largest dp-divisible dim, else replicated)."""
+    shape = tuple(int(s) for s in shape)
+    return LeafLayout(path, shape, int(itemsize),
+                      zero_shard_dim(shape, dp), int(dp))
+
+
+def _block(shape: Tuple[int, ...], dim: Optional[int], dp: int,
+           device: int) -> Optional[List[Tuple[int, int]]]:
+    """Half-open index intervals per dimension held by ``device``, or None
+    when this device holds nothing (device index past the mesh)."""
+    if device >= dp:
+        return None
+    ivs = [(0, s) for s in shape]
+    if dim is not None:
+        per = shape[dim] // dp
+        ivs[dim] = (device * per, (device + 1) * per)
+    return ivs
+
+
+def _elems(ivs: Optional[List[Tuple[int, int]]]) -> int:
+    if ivs is None:
+        return 0
+    n = 1
+    for lo, hi in ivs:
+        n *= max(0, hi - lo)
+    return n
+
+
+def _overlap(a: Optional[List[Tuple[int, int]]],
+             b: Optional[List[Tuple[int, int]]]) -> int:
+    """Elements in the intersection of two axis-aligned blocks."""
+    if a is None or b is None:
+        return 0
+    n = 1
+    for (alo, ahi), (blo, bhi) in zip(a, b):
+        n *= max(0, min(ahi, bhi) - max(alo, blo))
+    return n
+
+
+def plan_leaf(old: LeafLayout, new: LeafLayout) -> LeafMove:
+    """Traffic for one leaf: for every device in the NEW layout, the bytes
+    of its needed block not already resident from the OLD layout. The
+    naive comparator re-gathers the full leaf to every new participant
+    that does not already hold all of it."""
+    if old.shape != new.shape:
+        raise ValueError(f"leaf {old.path!r}: shape changed across resize "
+                         f"({old.shape} -> {new.shape})")
+    moved = 0
+    naive = 0
+    total = _elems([(0, s) for s in new.shape])
+    for dev in range(new.dp):
+        need = _block(new.shape, new.dim, new.dp, dev)
+        have = _block(old.shape, old.dim, old.dp, dev)
+        moved += _elems(need) - _overlap(need, have)
+        naive += total - _elems(have)
+    return LeafMove(new.path, moved * new.itemsize, naive * new.itemsize)
+
+
+def _tree_leaves(tree, prefix="") -> List[Tuple[str, object]]:
+    out: List[Tuple[str, object]] = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.extend(_tree_leaves(tree[k], f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)) and not hasattr(tree, "shape"):
+        for i, v in enumerate(tree):
+            out.extend(_tree_leaves(v, f"{prefix}{i}/"))
+    else:
+        out.append((prefix.rstrip("/"), tree))
+    return out
+
+
+def plan_reshard(opt_state, dp_from: int, dp_to: int) -> ReshardPlan:
+    """Plan redistributing ``opt_state`` (any pytree of arrays) from a
+    dp_from-wide ZeRO layout to dp_to. Pure host-side accounting."""
+    if dp_from < 1 or dp_to < 1:
+        raise ValueError("dp widths must be >= 1")
+    moves: List[LeafMove] = []
+    total = 0
+    for path, leaf in _tree_leaves(opt_state):
+        shape = tuple(np.shape(leaf))
+        itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        total += int(np.prod(shape, dtype=np.int64)) * itemsize if shape \
+            else itemsize
+        moves.append(plan_leaf(leaf_layout(path, shape, itemsize, dp_from),
+                               leaf_layout(path, shape, itemsize, dp_to)))
+    return ReshardPlan(int(dp_from), int(dp_to), tuple(moves),
+                       sum(m.bytes_moved for m in moves),
+                       sum(m.bytes_naive for m in moves), total)

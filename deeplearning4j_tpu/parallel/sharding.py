@@ -51,6 +51,33 @@ DENSE_RULES: Rules = (
 )
 
 
+def zero_shard_dim(shape: Sequence[int], n: int) -> Optional[int]:
+    """The dimension a ZeRO-1 optimizer-state leaf shards over ``n``
+    data-parallel replicas, or None (replicated). The rule — largest dim
+    divisible by ``n`` — is the ONE layout contract shared by
+    :class:`~.wrapper.ParallelWrapper` (mode='zero_sharded') and the
+    elastic trainer's redistribution planner: planner and placement can
+    never disagree about where a shard boundary sits."""
+    n = int(n)
+    if n <= 1 or not shape:
+        return None
+    divisible = [(d, shape[d]) for d in range(len(shape))
+                 if shape[d] % n == 0 and shape[d] >= n]
+    if not divisible:
+        return None
+    return max(divisible, key=lambda t: t[1])[0]
+
+
+def zero_opt_spec(shape: Sequence[int], n: int) -> P:
+    """:func:`zero_shard_dim` as a ``PartitionSpec`` over the data axis."""
+    d = zero_shard_dim(shape, n)
+    if d is None:
+        return P()
+    spec: List[Optional[str]] = [None] * len(shape)
+    spec[d] = DATA_AXIS
+    return P(*spec)
+
+
 def _tree_paths(tree, prefix=""):
     out = []
     if isinstance(tree, dict):

@@ -157,18 +157,12 @@ class ParallelWrapper:
         self.state = jax.device_put(model.state, repl)
         opt0 = tx.init(self.params)
         n = mesh.shape[DATA_AXIS]
+        # the ZeRO-1 layout rule lives in parallel/sharding.py so the elastic
+        # trainer's redistribution planner shards along the SAME dims
+        from .sharding import zero_opt_spec
 
         def opt_spec(a):
-            if getattr(a, "ndim", 0) == 0:
-                return P()
-            divisible = [(d, a.shape[d]) for d in range(a.ndim)
-                         if a.shape[d] % n == 0 and a.shape[d] >= n]
-            if not divisible:
-                return P()
-            d = max(divisible, key=lambda t: t[1])[0]
-            spec = [None] * a.ndim
-            spec[d] = DATA_AXIS
-            return P(*spec)
+            return zero_opt_spec(np.shape(a), n)
 
         if self.rules:
             # moments inherited the params' tp/sp shardings from eager init —

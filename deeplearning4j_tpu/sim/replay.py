@@ -50,8 +50,10 @@ DEFAULT_KNOBS: Dict[str, dict] = {
     # resident_models: how many models fit the pager's HBM budget at once
     # (None = all of them — paging never evicts)
     "fleet": {"resident_models": None},
-    # recorded pass-through for the router tier; the virtual model does not
-    # differentiate them (documented in sim/README.md)
+    # router-tier knobs, modeled by the virtual hop stage when the cost
+    # model carries nonzero hop costs (hop_rtt_s / hop_loss_p) — with the
+    # default all-zero hop costs the stage is skipped and reports stay
+    # byte-identical to the pre-hop model (documented in sim/README.md)
     "cluster": {"hedge_ms": 30.0, "retry_budget_per_s": 2.0},
     # predictive-autoscaler knobs: the confidence floor gates pre-spawn
     # (AutoscalePolicy.from_config), season/horizon shape the forecaster
@@ -96,6 +98,12 @@ class CostModel(NamedTuple):
     decode_base_s: float = 4e-3       # decode step, empty batch
     decode_slot_s: float = 1e-3       # decode step marginal cost per slot
     page_in_s: float = 0.5            # weight page-in (host -> device + warm)
+    # router-hop costs (zero = in-process deployment, hop stage skipped —
+    # reports stay byte-identical to the hop-free model). Nonzero values
+    # activate the ``cluster.*`` knobs: hedge_ms bounds lost-attempt
+    # recovery, retry_budget_per_s bounds un-hedged retries.
+    hop_rtt_s: float = 0.0            # router <-> replica round trip
+    hop_loss_p: float = 0.0           # P(first attempt lost in transit)
 
     @classmethod
     def from_profile(cls, profile,
@@ -115,6 +123,16 @@ class CostModel(NamedTuple):
 
 def _blocks_needed(tokens: int, block_size: int) -> int:
     return -(-max(1, tokens) // max(1, block_size))
+
+
+def _unit_hash(seq: int) -> float:
+    """Deterministic per-event uniform in [0, 1) — splitmix64 of the
+    event's trace sequence number (NEVER Python's salted ``hash``), so
+    the same trace loses the same attempts in every process."""
+    z = (int(seq) + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return ((z ^ (z >> 31)) & 0xFFFFFFFFFFFFFFFF) / 2.0 ** 64
 
 
 def _shed(ev: Event, cause: str) -> Outcome:
@@ -163,6 +181,48 @@ class VirtualReplayer:
             resident[ev.model] = ready
             out.append((ready, ev))
         return out
+
+    # ------------------------------------------------------------ router hop
+    def _router_adjusted(
+            self, arrivals: List[Tuple[float, Event]],
+            out: List[Outcome]) -> List[Tuple[float, Event]]:
+        """The ``cluster.*`` knob model: each request pays half a hop RTT
+        to reach its replica; a transit-lost first attempt (seeded by the
+        event's sequence number) is recovered by the hedge after
+        ``hedge_ms`` when one is armed, else by a retry one more RTT
+        later IF the retry token bucket (refilled at
+        ``retry_budget_per_s`` of virtual time, capped at one second of
+        budget) still holds a token — a drained bucket sheds the request
+        as ``upstream_unreachable``, exactly the router's storm-control
+        tradeoff. Skipped entirely (and byte-identical) while both hop
+        cost-model fields are zero."""
+        cm = self.cm
+        if cm.hop_rtt_s <= 0.0 and cm.hop_loss_p <= 0.0:
+            return arrivals
+        cl = self.knobs.get("cluster") or {}
+        hedge_s = max(0.0, float(cl.get("hedge_ms") or 0.0)) / 1e3
+        rate = max(0.0, float(cl.get("retry_budget_per_s") or 0.0))
+        cap = max(1.0, rate)
+        tokens, last_t = cap, 0.0
+        kept: List[Tuple[float, Event]] = []
+        for eff, ev in arrivals:
+            delay = cm.hop_rtt_s / 2.0
+            if cm.hop_loss_p > 0.0 and _unit_hash(ev.seq) < cm.hop_loss_p:
+                if hedge_s > 0.0:
+                    # the hedged duplicate (no budget spend) lands after
+                    # the hedge timer plus its own half-hop
+                    delay += hedge_s
+                else:
+                    tokens = min(cap, tokens + max(0.0, eff - last_t) * rate)
+                    last_t = eff
+                    if tokens >= 1.0:
+                        tokens -= 1.0
+                        delay += cm.hop_rtt_s  # full extra round trip
+                    else:
+                        out.append(_shed(ev, "upstream_unreachable"))
+                        continue
+            kept.append((eff + delay, ev))
+        return kept
 
     # --------------------------------------------------------------- predict
     def _sim_predict(self, items: List[Tuple[float, Event]],
@@ -310,11 +370,12 @@ class VirtualReplayer:
 
     # ------------------------------------------------------------------- run
     def run(self) -> dict:
-        arrivals = self._residency_adjusted()
+        outcomes: List[Outcome] = []
+        arrivals = self._router_adjusted(self._residency_adjusted(),
+                                         outcomes)
         by_mk: Dict[Tuple[str, str], List[Tuple[float, Event]]] = {}
         for eff, ev in arrivals:
             by_mk.setdefault((ev.model, ev.kind), []).append((eff, ev))
-        outcomes: List[Outcome] = []
         util: List[float] = []
         for key in sorted(by_mk):
             items = sorted(by_mk[key], key=lambda p: (p[0], p[1].seq))

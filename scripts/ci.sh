@@ -60,6 +60,12 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/sim/
 # zero-suppression bar.
 echo "=== jaxlint: deeplearning4j_tpu/autoscale/ (no baseline permitted) ==="
 python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/autoscale/
+# elastic/ resizes the training mesh and rewrites optimizer-state layouts
+# while a job is live: a lint-dirty trainer (host sync in the step loop,
+# swallowed checkpoint errors) would corrupt the one artifact a crashed
+# job resumes from, so it holds the same zero-suppression bar.
+echo "=== jaxlint: deeplearning4j_tpu/elastic/ (no baseline permitted) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/elastic/
 
 # The v3 concurrency family (lock-order-cycle, blocking-call-under-lock,
 # acquire-release, property-vs-call, metric-docs-drift) rides every run
@@ -87,6 +93,17 @@ python -m deeplearning4j_tpu.analysis \
   --enumerate-manifest "$CI_ARTIFACTS_DIR/prebuild_manifest.json" \
   --serve-config scripts/serve_config.json
 
+# elastic/ gets its own compile-surface gate: its one jit site (the
+# ZeRO-1 pstep) dispatches through AotFunction indirection, so the
+# static bound is "?" by construction and the budget's why documents
+# the runtime ledger (elastic_pstep_traces_total, pinned flat after
+# warm() by smoke_elastic) as the enforcing side. No prebuild manifest:
+# the trainer warms its own ladder at boot.
+echo "=== jaxlint: compile-surface budget (elastic/) ==="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/elastic \
+  --compile-surface "$CI_ARTIFACTS_DIR/elastic_compile_surface.json" \
+  --budget scripts/elastic_compile_budget.json
+
 # The v5 error-surface pass proves the serving tier's error behaviour
 # statically: every exception that can reach a serve/fleet/cluster HTTP
 # boundary is walked interprocedurally (analysis/errorflow.py) and its
@@ -94,10 +111,14 @@ python -m deeplearning4j_tpu.analysis \
 # against scripts/error_budget.json. A new untyped escape, a new
 # endpoint, or a typed error losing its status mapping fails the build;
 # tightening always passes. The report uploads next to the SARIF.
-echo "=== jaxlint: error-surface budget (serve/ + fleet/ + cluster/ + utils/) ==="
+# elastic/ rides along: it exposes no HTTP endpoints (its failures are
+# typed ElasticError/chaos exceptions surfaced to the driver), so its
+# presence must never widen the budget — the walk proves that.
+echo "=== jaxlint: error-surface budget (serve/ + fleet/ + cluster/ + utils/ + elastic/) ==="
 python -m deeplearning4j_tpu.analysis \
   deeplearning4j_tpu/serve deeplearning4j_tpu/fleet \
   deeplearning4j_tpu/cluster deeplearning4j_tpu/utils \
+  deeplearning4j_tpu/elastic \
   --error-surface "$CI_ARTIFACTS_DIR/error_surface.json" \
   --error-budget scripts/error_budget.json
 
@@ -124,6 +145,9 @@ CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_sim.py
 echo "=== smoke autoscale: burn-driven scale-out, drain-based scale-in ==="
 CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_autoscale.py
 
+echo "=== smoke elastic: chaos-kill -> reap -> reshard -> bit-identical resume ==="
+CI_ARTIFACTS_DIR="$CI_ARTIFACTS_DIR" python scripts/smoke_elastic.py
+
 # every scrape artifact the smokes wrote must be an exposition a real
 # Prometheus would accept — promcheck is the gate, not just a warning
 echo "=== promcheck: validate every scraped .prom artifact ==="
@@ -132,7 +156,9 @@ python -m deeplearning4j_tpu.obs.promcheck "$CI_ARTIFACTS_DIR"/*.prom
 echo "=== tier-1 tests ==="
 set -o pipefail
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+# 1500s: the suite has grown past the old 870s budget (a pre-elastic run
+# already logged 878s; ~1360 tests now) — keep headroom over measured time
+timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -1,0 +1,222 @@
+"""elastic/ — membership-supervised elastic training (ISSUE 19 acceptance).
+
+Covers the three layers separately and then the whole drill:
+
+- the redistribution planner's interval math (arXiv 2112.01075 — moved
+  bytes are exactly the non-resident portion of each new block, always
+  <= the naive full re-gather),
+- atomic checkpoint publish (temp + fsync + os.replace; a torn staging
+  directory is invisible to ``latest``),
+- the acceptance drill: chaos-kill a worker mid-epoch, watch membership
+  reap it, the mesh reshard dp=4 -> 3 with zero live traces, and the
+  finished run match — bit-identically — a second trainer resumed from
+  the published checkpoint at the post-resize width.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.chaos.faults import FaultPlane, install, uninstall
+from deeplearning4j_tpu.elastic import (ElasticTrainer, NoCheckpointError,
+                                        QuorumLostError, latest, leaf_layout,
+                                        plan_leaf, plan_reshard, save_atomic)
+from deeplearning4j_tpu.nn import NetConfig, SequentialBuilder
+from deeplearning4j_tpu.nn import layers as L
+
+
+def _net():
+    # hidden 24 / output 12: every weight dim divides by each ladder
+    # width in 2..4, so optimizer leaves actually shard at every rung
+    return (SequentialBuilder(NetConfig(seed=0, updater={"type": "adam",
+                                                         "learning_rate": 1e-2}))
+            .input_shape(8)
+            .layer(L.Dense(n_out=24, activation="relu"))
+            .layer(L.Output(n_out=12, activation="softmax", loss="mcxent"))
+            .build())
+
+
+def _batch(step):
+    # pure function of the step index — the replay contract fit() relies on
+    rng = np.random.RandomState(1000 + step)
+    x = rng.randn(12, 8).astype(np.float32)
+    y = np.eye(12, dtype=np.float32)[rng.randint(0, 12, 12)]
+    return x, y
+
+
+def _params_equal(a, b):
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree_util.tree_leaves_with_path(b)
+    assert len(la) == len(lb)
+    for (pa, va), (pb, vb) in zip(la, lb):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+class TestReshardPlanner:
+    def test_hand_computed_shrink(self):
+        # (24,) f32 over dp=4 holds 6-elem blocks; dp=2 needs 12-elem
+        # blocks. dev0 keeps [0,6) -> moves 6 elems, dev1 held [6,12)
+        # but needs [12,24) -> moves 12. 18 elems * 4 B = 72 moved vs a
+        # naive re-gather of (24-6)*2 = 36 elems = 144 B.
+        mv = plan_leaf(leaf_layout("m/w", (24,), 4, 4),
+                       leaf_layout("m/w", (24,), 4, 2))
+        assert (mv.bytes_moved, mv.bytes_naive) == (72, 144)
+
+    def test_hand_computed_uneven_shrink(self):
+        # 4 -> 3: new 8-elem blocks overlap the old 6-elem blocks by
+        # 6/4/2 elems on devices 0/1/2 -> (2+4+6)*4 = 48 B moved
+        mv = plan_leaf(leaf_layout("m/w", (24,), 4, 4),
+                       leaf_layout("m/w", (24,), 4, 3))
+        assert (mv.bytes_moved, mv.bytes_naive) == (48, 216)
+
+    def test_replicated_leaf_never_moves(self):
+        # a scalar (adam count) can't shard on any width: fully resident
+        # everywhere, so the planner charges zero bytes either way
+        mv = plan_leaf(leaf_layout("count", (), 8, 4),
+                       leaf_layout("count", (), 8, 2))
+        assert mv.bytes_moved == 0 and mv.bytes_naive == 0
+
+    def test_shape_change_is_typed_error(self):
+        with pytest.raises(ValueError, match="shape changed"):
+            plan_leaf(leaf_layout("m/w", (24,), 4, 4),
+                      leaf_layout("m/w", (25,), 4, 2))
+
+    def test_plan_beats_naive_on_real_opt_state(self):
+        import optax
+
+        model = _net()
+        model.init()
+        opt = optax.adam(1e-2).init(model.params)
+        for dp_to in (2, 3):
+            plan = plan_reshard(opt, 4, dp_to)
+            assert plan.dp_from == 4 and plan.dp_to == dp_to
+            assert 0 < plan.bytes_moved < plan.bytes_naive
+            assert plan.bytes_moved <= plan.bytes_total
+            assert plan.summary()["leaves"] == len(plan.moves)
+
+
+class TestAtomicCheckpoint:
+    def test_publish_and_latest_roundtrip(self, tmp_path):
+        wd = str(tmp_path)
+        t = ElasticTrainer(_net(), workdir=wd, dp=2, dp_min=2, seed=0)
+        info = t.checkpoint_now(cause="manual")
+        got = latest(wd)
+        assert got is not None
+        assert (got.step, got.dp, got.cause) == (0, 2, "manual")
+        assert os.path.isdir(got.path) and got.path == info.path
+        assert got.mesh_shape == (("data", 2),)
+
+    def test_torn_staging_is_invisible(self, tmp_path):
+        wd = str(tmp_path)
+        t = ElasticTrainer(_net(), workdir=wd, dp=2, dp_min=2, seed=0)
+        t.checkpoint_now(cause="manual")
+        before = latest(wd)
+        # simulate a writer dying mid-save: garbage under staging/ and a
+        # half-written pointer temp file must not change what latest() sees
+        os.makedirs(os.path.join(wd, "staging", "step00000099_dp2.777"))
+        with open(os.path.join(wd, "LATEST.json.tmp.777"), "w") as f:
+            f.write('{"truncat')
+        assert latest(wd) == before
+
+    def test_no_pointer_means_none_and_typed_resume_error(self, tmp_path):
+        assert latest(str(tmp_path)) is None
+        with pytest.raises(NoCheckpointError):
+            ElasticTrainer.resume(str(tmp_path))
+
+
+class TestElasticDrill:
+    def test_kill_reap_reshard_resume_bit_identical(self, tmp_path):
+        """The ISSUE acceptance drill: a chaos-killed worker mid-epoch is
+        reaped, the mesh reshards dp=4 -> 3 through an atomic checkpoint
+        with zero live traces, and the finished run is bit-identical to a
+        comparator resumed from that checkpoint at the post-resize width."""
+        wd = str(tmp_path)
+        t = ElasticTrainer(_net(), workdir=wd, dp=4, dp_min=2, seed=0)
+        t.fit(_batch, 3)
+        boot_traces = t.trace_count()
+
+        fp = FaultPlane(seed=0).inject_spec(
+            "elastic.step:error:scope=w1,times=1")
+        install(fp)
+        try:
+            t.fit(_batch, 8)
+        finally:
+            uninstall()
+        assert t.dp == 3
+        assert [r["cause"] for r in t.resizes] == ["worker_death"]
+        plan = t.resizes[0]
+        assert 0 < plan["bytes_moved"] < plan["bytes_naive"]
+        # the resize published a consistent (step, mesh, layout) triple
+        info = latest(wd)
+        assert info is not None
+        assert info.dp == 3 and info.mesh_shape == (("data", 3),)
+        assert info.cause.startswith("post_resize")
+
+        t.fit(_batch, 10)
+        final_a = t.final_loss()
+        # zero post-resize compile misses: every trace happened at warm()
+        assert t.trace_count() == boot_traces
+
+        t2 = ElasticTrainer.resume(wd, dp=3, seed=0)
+        assert t2.iteration == info.step and t2.dp == 3
+        t2.fit(_batch, 10)
+        assert t2.final_loss() == final_a
+        _params_equal(t.params, t2.params)
+        _params_equal(t.opt_state, t2.opt_state)
+
+    def test_mid_resize_death_resumes_pre_resize(self, tmp_path):
+        """A coordinator death on the ``elastic.resize`` seam surfaces
+        typed, and the pre-resize checkpoint published just before it is
+        the consistent resume point (still at the OLD width)."""
+        wd = str(tmp_path)
+        t = ElasticTrainer(_net(), workdir=wd, dp=4, dp_min=2, seed=0)
+        t.fit(_batch, 3)
+        fp = (FaultPlane(seed=0)
+              .inject_spec("elastic.step:error:scope=w2,times=1")
+              .inject_spec("elastic.resize:error:times=1"))
+        install(fp)
+        try:
+            with pytest.raises(RuntimeError, match="elastic.resize"):
+                t.fit(_batch, 8)
+        finally:
+            uninstall()
+        info = latest(wd)
+        assert info is not None
+        assert info.cause.startswith("pre_resize") and info.dp == 4
+        # the replacement coordinator comes back at the post-resize width;
+        # restore redistributes the dp=4 checkpoint onto the dp=3 layout
+        t2 = ElasticTrainer.resume(wd, dp=3, seed=0)
+        assert t2.dp == 3 and t2.iteration == info.step
+        assert t2.resizes and t2.resizes[-1]["cause"] == "resume"
+        t2.fit(_batch, 8)
+        assert t2.iteration == 8
+
+    def test_quorum_loss_is_typed(self, tmp_path):
+        t = ElasticTrainer(_net(), workdir=str(tmp_path), dp=2, dp_min=2,
+                           seed=0)
+        t.fit(_batch, 1)
+        fp = FaultPlane(seed=0).inject_spec(
+            "elastic.step:error:scope=w0,times=1")
+        install(fp)
+        try:
+            with pytest.raises(QuorumLostError):
+                t.fit(_batch, 8)
+        finally:
+            uninstall()
+
+    def test_autoscale_regression_grows_mesh(self, tmp_path):
+        """A sustained step-time regression against the budget drives the
+        unchanged AutoscalePolicy to scale OUT up the ladder (and the
+        resize is cause-tagged ``autoscale``)."""
+        t = ElasticTrainer(_net(), workdir=str(tmp_path), dp=2, dp_min=2,
+                           dp_max=3, seed=0, step_time_budget_s=0.05)
+        # injected step times: burn = 4x budget, sustained from step 0
+        t.fit(_batch, 8, step_time_fn=lambda i: 0.2)
+        assert t.dp == 3
+        causes = {r["cause"] for r in t.resizes}
+        assert causes == {"autoscale"}
