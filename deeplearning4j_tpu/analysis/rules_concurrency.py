@@ -145,7 +145,7 @@ class BlockingUnderLockRule(Rule):
 #: are resolved nominally, so look-alike ``ensure``/``alloc`` methods on
 #: unrelated classes never match.
 _ACQ_PROTOCOLS: Dict[Tuple[str, str], Tuple[str, ...]] = {
-    ("BlockAllocator", "alloc"): ("free",),
+    ("BlockAllocator", "alloc"): ("free", "release"),
     ("SlotPages", "ensure"): ("release", "free"),
 }
 

@@ -282,7 +282,7 @@ class FleetEntry:
     def info(self) -> dict:
         with self._lock:
             resident = self._engine is not None
-            return {
+            out = {
                 "resident": resident,
                 "version": self.version,
                 "generation": (self._registry.generation if resident
@@ -290,6 +290,13 @@ class FleetEntry:
                 "weight_bytes": int(self.weight_bytes),
                 "generate_ready": self._batcher is not None,
             }
+            batcher = self._batcher
+        if batcher is not None and batcher.kv == "paged":
+            # sharing picture per tenant-facing model: block usage,
+            # prefix-cache hit rates, CoW/fork counts (router placement
+            # and dashboards read this off the heartbeat)
+            out["kv"] = batcher.kv_block_stats()
+        return out
 
 
 class FleetRegistry:

@@ -37,6 +37,25 @@ Two KV layouts (``kv=`` constructor arg; contract in ``nn/generation.py``):
   :class:`~.engine.PrefillScheduler`, so a prompt burst cannot stall
   in-flight decodes for its whole prefill.
 
+  Paged mode shares KV across requests (``prefix_cache=True``): whole
+  prompt blocks are inserted into a :class:`~.paged.PrefixCache` keyed on
+  ``(params generation, rolling sha256 of block token runs)`` as prefills
+  complete, and admission adopts the longest cached run — refcount++ on
+  the shared physical blocks, prefill computes only the non-shared
+  suffix, and the worst-case commitment charges only non-shared blocks.
+  Cached-but-idle runs form an LRU the allocator reclaims under capacity
+  pressure before anything sheds; a registry generation flip invalidates
+  the cache wholesale so stale-params KV is never adopted. Decode writes
+  always land in a slot's private tail block, so copy-on-write triggers
+  exactly when a slot must write a block someone else still references
+  (a forked tail): the batcher copies that one block eagerly (host-side
+  dispatch, never a new jit site), swaps the table row, refcount--.
+  :meth:`ContinuousBatcher.fork` clones a decoding slot by duplicating
+  its table row with refcount++ on every block — one int32 row copy,
+  never KV bytes. All sharing is host-side bookkeeping: the decode step
+  stays ONE executable for the server lifetime, enforced by the
+  committed compile-surface budget.
+
 ``kv="dense"`` — the original slot-major ``(slots, 1, capacity, ...)``
   buffers written with ``lax.dynamic_update_slice`` and a vmapped decode;
   kept as the bit-exact baseline and for models where one big
@@ -62,7 +81,8 @@ from .engine import PrefillScheduler
 from .errors import (CapacityError, DeadlineExceededError, DrainTimeoutError,
                      ServeError, ServerClosingError, ShedError,
                      WorkerStallError)
-from .paged import BlockAllocator, SlotPages, block_bytes, blocks_needed
+from .paged import (BlockAllocator, PrefixCache, SlotPages, block_bytes,
+                    blocks_needed, prefix_hashes)
 from .registry import ModelRegistry
 
 
@@ -80,7 +100,7 @@ def _default_prompt_buckets(capacity: int) -> tuple:
 # newer tuner never break an older binary at boot.
 GEN_KNOBS = frozenset({"slots", "capacity", "kv", "block_size", "kv_blocks",
                        "prefill_chunk", "prompt_buckets", "queue_limit",
-                       "seed"})
+                       "seed", "prefix_cache", "prefix_cache_blocks"})
 
 
 def gen_opts_from_config(config: Optional[dict]) -> dict:
@@ -207,17 +227,22 @@ class _GenRequest:
 class _PrefillJob:
     """One prompt mid-prefill: its slot, block pages, and chunk cursor."""
 
-    __slots__ = ("req", "slot", "pages", "chunks", "idx", "worst", "last")
+    __slots__ = ("req", "slot", "pages", "chunks", "idx", "worst", "last",
+                 "shared", "hashes", "gens")
 
     def __init__(self, req: _GenRequest, slot: int, pages: SlotPages,
-                 chunks: List[tuple], worst: int):
+                 chunks: List[tuple], worst: int, shared: int = 0,
+                 hashes: Optional[List[bytes]] = None):
         self.req = req
         self.slot = slot
         self.pages = pages
         self.chunks = chunks    # [(offset, true_len, padded_bucket), ...]
         self.idx = 0
-        self.worst = worst      # committed worst-case blocks
+        self.worst = worst      # committed worst-case blocks (non-shared)
         self.last = None        # logits at the last REAL token so far
+        self.shared = shared    # prefix blocks adopted from the cache
+        self.hashes = hashes or []  # rolling block-run hashes of the prompt
+        self.gens: set = set()  # params generations its chunks ran under
 
     @property
     def deadline(self):
@@ -249,6 +274,8 @@ class ContinuousBatcher:
                  params=None, state=None, *, slots: int = 4,
                  capacity: int = 256, kv: str = "paged",
                  block_size: int = 16, kv_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefix_cache_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = 64,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  queue_limit: int = 64, seed: int = 0, metrics=None,
@@ -359,6 +386,21 @@ class ContinuousBatcher:
             else:
                 self._chunk_buckets = self.prompt_buckets
             self._alloc = BlockAllocator(self.kv_blocks)
+            self._prefix: Optional[PrefixCache] = None
+            if prefix_cache:
+                self._prefix = PrefixCache(self._alloc, self.block_size,
+                                           prefix_cache_blocks)
+                # cached-but-idle runs are reclaimed before anyone sheds
+                self._alloc.set_reclaimer(self._prefix.reclaim)
+            # distinct physical blocks slots hold via retain (adopted prefix
+            # runs, fork rows) — these sit OUTSIDE every worst-case
+            # commitment, so admission subtracts them from the pool
+            self._shared_ledger: Dict[int, int] = {}
+            self._cow_copies = 0
+            self._forks = 0
+            self._fork_salt = 0  # every attempt, successful or not
+            self._px_hits = 0
+            self._px_misses = 0
             self._pools = build_pools(mdl, self.kv_blocks, self.block_size,
                                       mdl.dtype)
             self._lks = [lk for lk, _, _ in cache_spec(mdl)]
@@ -417,6 +459,7 @@ class ContinuousBatcher:
             self.kv_blocks = None
             self.prefill_chunk = None
             self._committed = 0
+            self._prefix = None
 
             def _prefill(params, state, ids, true_len):
                 """ids (1, Tb) right-padded prompt; logits are gathered at
@@ -522,6 +565,26 @@ class ContinuousBatcher:
             self._m_pf_chunks = m.counter(
                 "serve_prefill_chunks_total", self._lbl(),
                 help="prefill chunks executed")
+            self._m_px_hits = m.counter(
+                "serve_prefix_cache_hits_total", self._lbl(),
+                help="admissions that adopted >= 1 cached prefix block")
+            self._m_px_miss = m.counter(
+                "serve_prefix_cache_misses_total", self._lbl(),
+                help="admissions that found no cached prefix run")
+            self._m_px_saved = m.counter(
+                "serve_prefill_tokens_saved_total", self._lbl(),
+                help="prompt tokens skipped by adopting cached prefix blocks")
+            self._m_px_shared = m.gauge(
+                "serve_prefix_blocks_shared", self._lbl(),
+                help="distinct KV blocks slots hold via sharing "
+                     "(adopted prefix runs + fork rows)")
+            self._m_cow = m.counter(
+                "serve_kv_cow_copies_total", self._lbl(),
+                help="copy-on-write block copies (a still-shared block "
+                     "was about to be written)")
+            self._m_forks = m.counter(
+                "serve_gen_forks_total", self._lbl(),
+                help="slots forked by block-table row copy")
             self._update_kv_gauges()
 
         # --- persistent AOT store (optional): every generation executable
@@ -770,6 +833,103 @@ class ContinuousBatcher:
             req._finish(err)
         return True
 
+    def fork(self, req: _GenRequest, *, max_new_tokens: Optional[int] = None,
+             temperature: Optional[float] = None,
+             top_k: Optional[int] = None) -> _GenRequest:
+        """Clone a decoding request into a free slot by duplicating its
+        block-table row with refcount++ on every block — one int32 row
+        copy, never KV bytes. The primitive under best-of-n sampling and
+        the speculative draft/verify follow-on.
+
+        The child resumes from the parent's exact decode state (same
+        pending token and position; its first decoded token lands at the
+        same position as the parent's next one) and returns only tokens
+        generated AFTER the fork point. It gets a fresh PRNG key, so
+        sampled continuations diverge; at ``temperature=0`` both chains
+        stay greedy and identical. Whole shared blocks are never written
+        again; the partial tail block is copied on first write
+        (copy-on-write), so forking is O(blocks) host work. ``kv="paged"``
+        only. Raises :class:`ShedError` when no free slot or insufficient
+        block headroom exists, :class:`ServeError` when ``req`` is not
+        currently decoding in a slot."""
+        import jax
+
+        if self.kv != "paged":
+            raise ServeError("fork() requires kv='paged' (block-table rows "
+                             "are what make forking copy-free)")
+        with self._cond:
+            self._fork_salt += 1
+            salt = self._fork_salt
+        # disjoint salt space from admission's fold_in(n): forks fold twice
+        key = jax.random.fold_in(
+            jax.random.fold_in(self._base_key, 0x666f726b), salt)
+        key_np = np.asarray(key, np.uint32)
+        with self._cond:
+            s = req.slot
+            if s is None or self._slot_req[s] is not req \
+                    or req.event.is_set():
+                raise ServeError("fork() needs a request currently decoding "
+                                 "in a slot (not queued, prefilling, or "
+                                 "finished)")
+            t = next((i for i in range(self.slots)
+                      if self._slot_req[i] is None
+                      and self._slot_job[i] is None), None)
+            if t is None:
+                self._shed_counter("fork_no_slot").inc()
+                raise ShedError("fork(): no free decode slot")
+            parent_pages = self._slot_pages[s]
+            pos = int(self._pos[s])
+            max_new = int(max_new_tokens if max_new_tokens is not None
+                          else max(1, req.max_new - len(req.out)))
+            if max_new < 1:
+                raise ValueError("fork max_new_tokens must be >= 1")
+            if pos + max_new > self.capacity:
+                raise CapacityError(
+                    f"fork at position {pos} + max_new_tokens {max_new} "
+                    f"exceeds cache capacity {self.capacity}")
+            # charge only what the child can ever privately allocate: its
+            # growth blocks plus one CoW copy of the partial tail; whole
+            # shared blocks stay shared forever and ride the ledger instead
+            worst = blocks_needed(pos + max_new, self.block_size) \
+                - pos // self.block_size
+            blocks = list(parent_pages.blocks)
+            fresh = sum(1 for b in blocks if b not in self._shared_ledger)
+            if self._committed + worst + len(self._shared_ledger) + fresh \
+                    > self._alloc.usable:
+                self._shed_counter("fork_capacity").inc()
+                raise ShedError(
+                    f"fork(): insufficient KV block headroom (need {worst} "
+                    f"committed + {fresh} shared)")
+            child = _GenRequest(req.prompt, max_new,
+                                float(temperature if temperature is not None
+                                      else req.temperature),
+                                top_k if top_k is not None else req.top_k,
+                                req.eos_id, req.deadline)
+            self._alloc.retain(blocks)
+            pages = SlotPages(self._alloc, self.block_size)
+            pages.adopt(blocks)
+            self._ledger_add(blocks)
+            self._committed += worst
+            self._slot_pages[t] = pages
+            self._slot_worst[t] = worst
+            self._slot_req[t] = child
+            child.slot = t
+            self._tables_np[t] = self._tables_np[s]
+            self._next_tok[t] = self._next_tok[s]
+            self._pos[t] = pos
+            self._temps[t] = child.temperature
+            self._topks[t] = child.top_k if child.top_k else self.vocab
+            self._keys[t] = key_np
+            self._forks += 1
+            self._m_forks.inc()
+            self._m_admitted.inc()
+            active = sum(1 for r in self._slot_req if r is not None)
+            self._peak_active = max(self._peak_active, active)
+            self._m_active.set(active)
+            self._update_kv_gauges()
+            self._cond.notify_all()
+        return child
+
     # ---------------------------------------------------------------- serving
     def _bucket(self, t: int) -> int:
         for b in self.prompt_buckets:
@@ -784,14 +944,16 @@ class ContinuousBatcher:
                 return b
         return self._chunk_buckets[-1]
 
-    def _plan_chunks(self, tp: int) -> List[tuple]:
+    def _plan_chunks(self, tp: int, start: int = 0) -> List[tuple]:
         """Split a prompt into (offset, true_len, padded_bucket) chunks.
-        Full chunks run at exactly ``prefill_chunk``; the tail pads to the
-        smallest chunk bucket that covers it. ``prefill_chunk=None`` is one
-        whole-prompt chunk (the un-chunked baseline)."""
+        ``start`` (block-aligned, < tp) skips the prefix already covered by
+        adopted cache blocks. Full chunks run at exactly ``prefill_chunk``;
+        the tail pads to the smallest chunk bucket that covers it.
+        ``prefill_chunk=None`` is one whole-prompt chunk (the un-chunked
+        baseline)."""
         if self.prefill_chunk is None:
-            return [(0, tp, self._bucket(tp))]
-        chunks, off = [], 0
+            return [(start, tp - start, self._bucket(tp - start))]
+        chunks, off = [], start
         while tp - off > self.prefill_chunk:
             chunks.append((off, self.prefill_chunk, self.prefill_chunk))
             off += self.prefill_chunk
@@ -804,6 +966,29 @@ class ContinuousBatcher:
         self._m_kv_used.set(used)
         self._m_kv_util.set(used / self._alloc.usable)
         self._m_kv_bytes.set(used * self._block_bytes)
+        self._m_px_shared.set(len(self._shared_ledger))
+
+    # --- shared-block ledger: blocks held via retain (adoption/forks) sit
+    # outside every commitment, so admission must subtract them from the
+    # pool; counted per (block, holding slot) and sized by distinct block ---
+    def _ledger_add(self, blocks) -> None:
+        for b in blocks:
+            self._shared_ledger[b] = self._shared_ledger.get(b, 0) + 1
+
+    def _ledger_drop(self, blocks) -> None:
+        for b in blocks:
+            c = self._shared_ledger.get(b, 0)
+            if c <= 1:
+                self._shared_ledger.pop(b, None)
+            else:
+                self._shared_ledger[b] = c - 1
+
+    def _release_pages(self, pages: SlotPages) -> None:
+        """Retire a slot's pages, dropping its shared refs from the ledger
+        first (refcounts make the release itself uniform)."""
+        if pages.shared:
+            self._ledger_drop(pages.shared)
+        pages.release()
 
     def _write_table_row(self, s: int, blocks: List[int]) -> None:
         row = np.zeros(self._maxb, np.int32)
@@ -811,12 +996,19 @@ class ContinuousBatcher:
         self._tables_np[s] = row
 
     # --- paged admission: commit worst-case blocks, start a prefill job ---
-    def _admit_locked(self) -> List[tuple]:
+    def _admit_locked(self, generation: int = 0) -> List[tuple]:
         """Under ``self._cond``: hand free slots to queued requests. Dense
         mode returns (slot, req) pairs to prefill under the caller's lease;
         paged mode creates :class:`_PrefillJob` state machines (FIFO — a
         head request waiting on blocks holds the line, so big requests
-        cannot be starved by a stream of small ones)."""
+        cannot be starved by a stream of small ones).
+
+        Paged admission charges only NON-shared blocks: the longest cached
+        prefix run is matched first (``generation`` is the registry
+        generation read by the caller — a flip flushes the cache before
+        any stale block can match), the gate subtracts both the charge and
+        every shared block outside any commitment, and only then are the
+        cached blocks adopted (refcount++) and the suffix planned."""
         admits = []
         for s in range(self.slots):
             if not self._queue:
@@ -827,14 +1019,38 @@ class ContinuousBatcher:
                 admits.append((s, self._queue.pop(0)))
                 continue
             req = self._queue[0]
-            worst = blocks_needed(req.prompt.shape[0] + req.max_new,
-                                  self.block_size)
-            if self._committed + worst > self._alloc.usable:
+            tp = req.prompt.shape[0]
+            hashes: List[bytes] = []
+            run: List[int] = []
+            if self._prefix is not None:
+                hashes = prefix_hashes(req.prompt, self.block_size)
+                # never adopt the whole prompt: at least one real token
+                # must prefill so the first sample has logits to read
+                run = self._prefix.match(hashes, generation,
+                                         (tp - 1) // self.block_size)
+            shared = len(run)
+            worst = blocks_needed(tp + req.max_new, self.block_size) - shared
+            fresh = sum(1 for b in run if b not in self._shared_ledger)
+            if self._committed + worst + len(self._shared_ledger) + fresh \
+                    > self._alloc.usable:
                 break  # wait for in-flight sequences to release blocks
             self._queue.pop(0)
             self._committed += worst
-            job = _PrefillJob(req, s, SlotPages(self._alloc, self.block_size),
-                              self._plan_chunks(req.prompt.shape[0]), worst)
+            pages = SlotPages(self._alloc, self.block_size)
+            if shared:
+                self._prefix.adopt(hashes, run)
+                pages.adopt(run)
+                self._ledger_add(run)
+                self._px_hits += 1
+                self._m_px_hits.inc()
+                self._m_px_saved.inc(shared * self.block_size)
+            elif self._prefix is not None:
+                self._px_misses += 1
+                self._m_px_miss.inc()
+            job = _PrefillJob(
+                req, s, pages,
+                self._plan_chunks(tp, shared * self.block_size), worst,
+                shared=shared, hashes=hashes)
             self._slot_job[s] = job
             self._jobs.append(job)
         if self.kv == "paged":
@@ -846,7 +1062,7 @@ class ContinuousBatcher:
             if job in self._jobs:
                 self._jobs.remove(job)
             self._slot_job[job.slot] = None
-            job.pages.release()
+            self._release_pages(job.pages)
             self._committed -= job.worst
             self._write_table_row(job.slot, [])
             self._update_kv_gauges()
@@ -883,12 +1099,14 @@ class ContinuousBatcher:
             self._m_prefill_s.observe(t1 - t0)
         else:
             self._m_prefill_s.observe(t1 - t0, trace_id=ctx.trace_id)
-            if off == 0:  # first chunk closes the queue-wait stage
+            if job.idx == 0:  # first chunk closes the queue-wait stage
+                # (its offset is nonzero when a cached prefix was adopted)
                 ctx.add_stage("queue", int(job.req.enq_t * 1e9),
                               int(t0 * 1e9))
             ctx.add_stage("prefill_chunk", int(t0 * 1e9), int(t1 * 1e9),
                           offset=off, bucket=bucket)
         self._m_pf_chunks.inc()
+        job.gens.add(snap.generation)
         job.last = last
         job.idx += 1
         with self._cond:
@@ -907,11 +1125,22 @@ class ContinuousBatcher:
         import numpy as _np
 
         req, s = job.req, job.slot
+        gen_now = (self.registry.generation
+                   if self._prefix is not None else None)
         with self._cond:
             if self._slot_job[s] is not job:
                 return  # aborted (forced shutdown) mid-prefill
             self._admitted += 1
             n = self._admitted
+            if self._prefix is not None and job.hashes \
+                    and job.gens == {gen_now}:
+                # cache this prompt's full blocks for the next request that
+                # shares the prefix; skipped if a publish flipped the params
+                # mid-prefill — that KV mixes generations and must retire
+                # with its slot, never be adopted
+                nfull = req.prompt.shape[0] // self.block_size
+                self._prefix.insert(job.hashes[:nfull],
+                                    job.pages.blocks[:nfull], gen_now)
         if req.ctx is not None:
             # decode starts with the token-0 sample, not the first tick — a
             # request wedged before any tick completes still shows the stage
@@ -1010,9 +1239,10 @@ class ContinuousBatcher:
                 return
             self._slot_req[s] = None
             if self.kv == "paged" and self._slot_pages[s] is not None:
-                # copy-free retirement: blocks go back to the free list and
-                # the table row zeroes (points at trash) — no device work
-                self._slot_pages[s].release()
+                # copy-free retirement: blocks drop one reference (cached/
+                # shared ones survive in their other holders) and the table
+                # row zeroes (points at trash) — no device work
+                self._release_pages(self._slot_pages[s])
                 self._slot_pages[s] = None
                 self._committed -= int(self._slot_worst[s])
                 self._slot_worst[s] = 0
@@ -1021,6 +1251,23 @@ class ContinuousBatcher:
             self._m_completed.inc()
             self._m_active.set(sum(1 for r in self._slot_req if r is not None))
         req._finish(req.cancelled)
+
+    def _copy_blocks(self, pairs: List[tuple]) -> None:
+        """Copy-on-write device work: duplicate each ``(src, dst)`` block
+        row in every layer's K/V pool. Eager indexed updates — deliberately
+        NOT a jit site, so the committed compile-surface budget (decode ==
+        one executable) is untouched; the indices ride as device operands,
+        so XLA's eager cache reuses one executable per pool shape."""
+        import jax.numpy as jnp
+
+        src = jnp.asarray(np.fromiter((p[0] for p in pairs), np.int32,
+                                      len(pairs)))
+        dst = jnp.asarray(np.fromiter((p[1] for p in pairs), np.int32,
+                                      len(pairs)))
+        for lk in self._lks:
+            pool = self._pools[lk]
+            pool["k"] = pool["k"].at[dst].set(pool["k"][src])
+            pool["v"] = pool["v"].at[dst].set(pool["v"][src])
 
     def _tick(self, snap, epoch: int) -> None:
         """Decode one token for every slot; bookkeep the active ones."""
@@ -1041,9 +1288,24 @@ class ContinuousBatcher:
             if self.kv == "paged":
                 # grow lazily to cover the token this tick writes; the
                 # admission-time worst-case commitment guarantees success
+                cow: List[tuple] = []
                 for s in active:
                     pages = self._slot_pages[s]
                     pages.ensure(int(self._pos[s]) + 1)
+                    wb = int(self._pos[s]) // self.block_size
+                    blk = pages.blocks[wb]
+                    if self._alloc.refcount(blk) > 1:
+                        # copy-on-write: someone else (a fork peer) still
+                        # references the block this tick writes — swap in a
+                        # private copy first. Only ever the partial tail:
+                        # whole shared blocks are never write targets.
+                        new = self._alloc.alloc(1)[0]
+                        if blk in pages.shared:
+                            self._ledger_drop([blk])
+                        pages.swap(wb, new)
+                        cow.append((blk, new))
+                        self._cow_copies += 1
+                        self._m_cow.inc()
                     self._write_table_row(s, pages.blocks)
                 self._update_kv_gauges()
                 mask = np.zeros(self.slots, bool)
@@ -1061,6 +1323,10 @@ class ContinuousBatcher:
             # live slots vs the fixed slot axis the decode step pads to
             _prof.ACTIVE.hint("generate", len(active), self.slots)
         t0 = time.perf_counter()
+        if self.kv == "paged" and cow:
+            # device-side CoW copies, outside the lock (pools are only ever
+            # touched by this worker thread), before the decode dispatch
+            self._copy_blocks(cow)
         if self.kv == "paged":
             nxt, self._pools, new_keys = self._decode(
                 snap.params, snap.state, jnp.asarray(toks), self._pools,
@@ -1128,6 +1394,11 @@ class ContinuousBatcher:
 
     def _run_loop(self, epoch: int) -> None:
         while True:
+            # registry generation, read OUTSIDE self._cond (the registry
+            # has its own lock): keys prefix-cache adoption, so a publish
+            # flushes stale runs at the next admission
+            gen = (self.registry.generation
+                   if self.kv == "paged" and self._prefix is not None else 0)
             with self._cond:
                 if self._epoch != epoch:
                     return  # staled by a crash-only restart
@@ -1140,7 +1411,7 @@ class ContinuousBatcher:
                 if not self._queue and not has_active and not has_jobs:
                     self._cond.wait(0.05)
                     continue
-                admits = self._admit_locked()
+                admits = self._admit_locked(gen)
                 # dense admits are popped from the queue but not yet in a
                 # slot: track them so a restart can still answer them
                 self._admitting = [r for _, r in admits]
@@ -1214,7 +1485,7 @@ class ContinuousBatcher:
             finish.extend(self._queue)
             self._queue.clear()
         for job in list(self._jobs):
-            job.pages.release()
+            self._release_pages(job.pages)
             self._slot_job[job.slot] = None
             self._committed -= job.worst
             finish.append(job.req)
@@ -1224,7 +1495,7 @@ class ContinuousBatcher:
                 finish.append(req)
                 self._slot_req[s] = None
             if self.kv == "paged" and self._slot_pages[s] is not None:
-                self._slot_pages[s].release()
+                self._release_pages(self._slot_pages[s])
                 self._slot_pages[s] = None
                 self._committed -= int(self._slot_worst[s])
                 self._slot_worst[s] = 0
@@ -1278,16 +1549,38 @@ class ContinuousBatcher:
             return self._peak_active
 
     def kv_block_stats(self) -> dict:
-        """Allocator snapshot (paged mode): totals, usage, live bytes."""
+        """Allocator snapshot (paged mode): totals, usage, live bytes, and
+        the sharing picture (prefix cache + shared blocks + CoW/forks)."""
         if self.kv != "paged":
             return {}
         with self._cond:
             used = self._alloc.used
-            return {"block_size": self.block_size,
-                    "blocks_total": self._alloc.usable,
-                    "blocks_used": used,
-                    "blocks_committed": self._committed,
-                    "live_bytes": used * self._block_bytes}
+            out = {"block_size": self.block_size,
+                   "blocks_total": self._alloc.usable,
+                   "blocks_used": used,
+                   "blocks_committed": self._committed,
+                   "live_bytes": used * self._block_bytes,
+                   "blocks_shared": len(self._shared_ledger),
+                   "cow_copies": self._cow_copies,
+                   "forks": self._forks}
+            if self._prefix is not None:
+                px = self._prefix.stats()
+                px["hits"] = self._px_hits
+                px["misses"] = self._px_misses
+                out["blocks_cached"] = px["entries"]
+                out["prefix_cache"] = px
+            return out
+
+    def flush_prefix_cache(self) -> int:
+        """Release every cached prefix run (admin/testing: proves cached
+        blocks are the only thing keeping ``blocks_used`` nonzero after a
+        drain). Returns the number of entries dropped."""
+        if self.kv != "paged" or self._prefix is None:
+            return 0
+        with self._cond:
+            n = self._prefix.flush()
+            self._update_kv_gauges()
+            return n
 
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = None) -> bool:

@@ -108,6 +108,13 @@ class PrefillScheduler:
     when no decode is running (idle device: drain the prefill backlog
     faster). Among runnable jobs, earliest-deadline-first, then FIFO — a
     deadline-carrying request cannot be starved by deadline-less bulk work.
+
+    Prefix-cache interaction: a job admitted with an adopted cached run
+    enters with only its SUFFIX chunks (the shared whole blocks were never
+    planned), so a 90%-shared prompt consumes a 10%-sized slice of the
+    per-tick chunk budget. No special casing here — the batcher's
+    admission already charged and planned only the non-shared remainder,
+    and EDF/FIFO ordering applies to whatever chunks exist.
     """
 
     def __init__(self, decode_chunks: int = 1, idle_chunks: int = 4):

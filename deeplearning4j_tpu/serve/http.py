@@ -159,6 +159,8 @@ class ModelServer(JsonHTTPServerMixin):
                  gen_capacity: int = 256, gen_queue_limit: int = 64,
                  gen_kv: str = "paged", gen_block_size: int = 16,
                  gen_kv_blocks: Optional[int] = None,
+                 gen_prefix_cache: bool = True,
+                 gen_prefix_cache_blocks: Optional[int] = None,
                  gen_prefill_chunk: Optional[int] = 64,
                  seed: int = 0, metrics: Optional[MetricsRegistry] = None,
                  aot_store=None, strict_aot: bool = False,
@@ -222,12 +224,15 @@ class ModelServer(JsonHTTPServerMixin):
                               queue_limit=gen_queue_limit, kv=gen_kv,
                               block_size=gen_block_size,
                               kv_blocks=gen_kv_blocks,
+                              prefix_cache=gen_prefix_cache,
+                              prefix_cache_blocks=gen_prefix_cache_blocks,
                               prefill_chunk=gen_prefill_chunk, seed=seed,
                               aot_store=aot_store,
                               strict_aot=self.strict_aot)
         if gen_kv == "dense":
             # dense batcher takes no paging knobs
-            for k in ("block_size", "kv_blocks", "prefill_chunk"):
+            for k in ("block_size", "kv_blocks", "prefill_chunk",
+                      "prefix_cache", "prefix_cache_blocks"):
                 self._gen_opts.pop(k)
         self._batcher: Optional[ContinuousBatcher] = None
         self._lifecycle_lock = threading.Lock()
@@ -355,6 +360,12 @@ class ModelServer(JsonHTTPServerMixin):
                                     for g, v in server.registry.history()]}
                     if server.aot_store is not None:
                         body["aot_store"] = server.aot_store.stats()
+                    # KV sharing picture (paged batcher, once built):
+                    # block usage + prefix-cache hits/entries + CoW/forks
+                    with server._lifecycle_lock:
+                        b = server._batcher
+                    if b is not None and b.kv == "paged":
+                        body["kv"] = b.kv_block_stats()
                     self.reply(200, body)
                 elif self.path == "/v1/debug/requests":
                     recs = (_flight.ACTIVE.requests()

@@ -51,6 +51,13 @@ DEFAULT_SPACE: Dict[str, Sequence] = {
     "gen.prefill_chunk": (16, 32, 64, 128),
     "gen.decode_chunks": (1, 2, 4),
     "gen.queue_limit": (32, 64, 128),
+    # prefix caching: whether to share whole-block prompt prefixes, and
+    # how many pool blocks the cache may pin (None = bounded only by
+    # capacity pressure via the reclaimer). Only differentiating when the
+    # trace carries shared-prefix traffic (WorkloadSpec prefix_reuse > 0);
+    # on legacy traces every candidate scores identically here.
+    "gen.prefix_cache": (True, False),
+    "gen.prefix_cache_blocks": (None, 16, 64),
 }
 
 

@@ -80,7 +80,16 @@ class LengthDist(NamedTuple):
 
 
 class Event(NamedTuple):
-    """One scheduled request. ``t_us`` is microseconds from trace start."""
+    """One scheduled request. ``t_us`` is microseconds from trace start.
+
+    ``prefix_len``/``prefix_seed`` describe a shared-prefix head: the
+    first ``prefix_len`` prompt tokens are regenerated from
+    ``prefix_seed`` (a per-tenant-pool seed shared by every event drawn
+    from the same pool entry), the rest from the event's own ``seed``.
+    ``prefix_len == 0`` (the default) is the legacy fully-private prompt
+    and serializes to the legacy 9-field line, so traces without prefix
+    pools stay byte-identical.
+    """
 
     t_us: int
     seq: int
@@ -91,6 +100,8 @@ class Event(NamedTuple):
     prompt_len: int
     max_new_tokens: int
     seed: int           # per-event content seed (sha256-derived)
+    prefix_len: int = 0
+    prefix_seed: int = 0
 
     @property
     def t_s(self) -> float:
@@ -102,17 +113,22 @@ class Event(NamedTuple):
         return None if ms is None else self.t_s + ms / 1e3
 
     def to_line(self) -> str:
-        return (f"{self.t_us} {self.seq} {self.tenant} {self.slo} "
+        base = (f"{self.t_us} {self.seq} {self.tenant} {self.slo} "
                 f"{self.model} {self.kind} {self.prompt_len} "
                 f"{self.max_new_tokens} {self.seed}")
+        if self.prefix_len > 0:
+            return f"{base} {self.prefix_len} {self.prefix_seed}"
+        return base
 
     @classmethod
     def from_line(cls, line: str) -> "Event":
         p = line.split()
-        if len(p) != 9:
+        if len(p) not in (9, 11):
             raise ValueError(f"bad trace line: {line!r}")
         return cls(int(p[0]), int(p[1]), p[2], p[3], p[4], p[5],
-                   int(p[6]), int(p[7]), int(p[8]))
+                   int(p[6]), int(p[7]), int(p[8]),
+                   int(p[9]) if len(p) == 11 else 0,
+                   int(p[10]) if len(p) == 11 else 0)
 
 
 class WorkloadSpec:
@@ -134,6 +150,18 @@ class WorkloadSpec:
     legacy single-day expansion and is omitted from the canonical spec,
     so every existing fingerprint (and every tuned config keyed by one)
     survives unchanged.
+
+    ``prefix_reuse``/``prefix_len``/``prefix_pool`` model shared-prefix
+    traffic (system prompts, few-shot templates): each tenant owns
+    ``prefix_pool`` prefix entries whose lengths are drawn from the
+    ``prefix_len`` distribution and whose content seeds derive from the
+    spec fingerprint — stable across processes, like per-event seeds.
+    With probability ``prefix_reuse`` an event's prompt starts with one
+    of its tenant's pool prefixes (uniformly chosen), which is exactly
+    the traffic shape the serving prefix cache exists for. The default
+    ``prefix_reuse=0`` draws nothing from the RNG stream and is omitted
+    from the canonical spec, so legacy fingerprints AND trace bytes stay
+    identical.
     """
 
     def __init__(self, *, seed: int = 0, duration_s: float = 60.0,
@@ -147,6 +175,9 @@ class WorkloadSpec:
                  burst_mean_off_s: float = 0.0,
                  prompt_len: LengthDist = LengthDist("lognormal", 8.0, 0.7, 48),
                  output_len: LengthDist = LengthDist("pareto", 2.0, 1.6, 16),
+                 prefix_len: Optional[LengthDist] = None,
+                 prefix_reuse: float = 0.0,
+                 prefix_pool: int = 4,
                  vocab: int = 50,
                  time_scale: float = 1.0,
                  tenants: Optional[Dict[str, dict]] = None,
@@ -166,6 +197,12 @@ class WorkloadSpec:
         self.burst_mean_off_s = max(0.0, float(burst_mean_off_s))
         self.prompt_len = prompt_len
         self.output_len = output_len
+        self.prefix_len = prefix_len
+        self.prefix_reuse = min(1.0, max(0.0, float(prefix_reuse)))
+        self.prefix_pool = max(1, int(prefix_pool))
+        if self.prefix_reuse > 0.0 and self.prefix_len is None:
+            raise ValueError("prefix_reuse > 0 needs a prefix_len "
+                             "distribution")
         self.vocab = int(vocab)
         self.time_scale = float(time_scale)
         self.tenants = tenants or {"default": {"weight": 1.0,
@@ -184,6 +221,12 @@ class WorkloadSpec:
             # a single-day spec's canonical form predates `days`: omitting
             # the default keeps every legacy fingerprint byte-stable
             d["days"] = self.days
+        if self.prefix_reuse > 0.0:
+            # same discipline as `days`: prefix pools predate nothing a
+            # legacy fingerprint covers, so the OFF default stays absent
+            d["prefix_len"] = self.prefix_len.to_dict()
+            d["prefix_reuse"] = self.prefix_reuse
+            d["prefix_pool"] = self.prefix_pool
         return d
 
     def _to_dict(self) -> dict:
@@ -212,6 +255,8 @@ class WorkloadSpec:
         d.pop("schema", None)
         d["prompt_len"] = LengthDist.from_dict(d["prompt_len"])
         d["output_len"] = LengthDist.from_dict(d["output_len"])
+        if d.get("prefix_len") is not None:
+            d["prefix_len"] = LengthDist.from_dict(d["prefix_len"])
         return cls(**d)
 
     def canonical(self) -> bytes:
@@ -349,6 +394,22 @@ def _day_seed(seed: int, day: int) -> int:
     return int.from_bytes(digest[:4], "big")
 
 
+def _prefix_entry(spec: WorkloadSpec, spec_fp: str, tenant: str,
+                  pid: int) -> Tuple[int, int]:
+    """One tenant-pool prefix entry: ``(length, content seed)``.
+
+    Both are pure functions of ``(spec fingerprint, tenant, pool id)`` —
+    the length is a single draw from the ``prefix_len`` distribution
+    under a dedicated sha256-derived RNG, so every event adopting this
+    entry sees the same prefix regardless of arrival order or process.
+    """
+    digest = hashlib.sha256(
+        f"{spec_fp}:prefix:{tenant}:{pid}".encode("utf-8")).digest()
+    seed = int.from_bytes(digest[:4], "big")
+    length = spec.prefix_len.sample(random.Random(seed))
+    return length, seed
+
+
 def generate_trace(spec: WorkloadSpec) -> Trace:
     """Expand a spec into a trace via Lewis thinning.
 
@@ -398,21 +459,47 @@ def generate_trace(spec: WorkloadSpec) -> Trace:
         kind = "generate" if rng.random() < gen_frac else "predict"
         plen = spec.prompt_len.sample(rng)
         ntok = spec.output_len.sample(rng) if kind == "generate" else 0
+        pfx_len, pfx_seed = 0, 0
+        if spec.prefix_reuse > 0.0:
+            # two extra draws per candidate, but ONLY when the feature is
+            # on: the legacy (prefix_reuse=0) stream stays byte-identical
+            reuse = rng.random() < spec.prefix_reuse
+            pid = rng.randrange(spec.prefix_pool)
+            if reuse:
+                pool_len, pfx_seed = _prefix_entry(spec, spec_fp, tenant, pid)
+                # at least one private token stays: a fully-shared prompt
+                # has nothing for the server to prefill
+                pfx_len = min(pool_len, plen - 1)
+                if pfx_len <= 0:
+                    pfx_len, pfx_seed = 0, 0
         if not keep:
             continue
         events.append(Event(
             t_us=int(round(t * 1e6)), seq=seq, tenant=tenant,
             slo=str(spec.tenants[tenant].get("slo", "standard")),
             model=model, kind=kind, prompt_len=plen, max_new_tokens=ntok,
-            seed=_event_seed(spec_fp, seq)))
+            seed=_event_seed(spec_fp, seq),
+            prefix_len=pfx_len, prefix_seed=pfx_seed))
         seq += 1
     return Trace(spec, events)
 
 
 def prompt_tokens(event: Event, vocab: int) -> List[int]:
-    """Regenerate the event's prompt content from its embedded seed."""
+    """Regenerate the event's prompt content from its embedded seed(s).
+
+    A shared-prefix event regenerates its head from the tenant-pool
+    ``prefix_seed`` — every adopter of the same pool entry produces the
+    SAME head tokens, so replaying the trace against a real server
+    exercises the prefix cache exactly as the spec intended."""
+    v = max(2, int(vocab))
+    out: List[int] = []
+    if event.prefix_len > 0:
+        rp = random.Random(event.prefix_seed)
+        out = [rp.randrange(v) for _ in range(event.prefix_len)]
     r = random.Random(event.seed)
-    return [r.randrange(max(2, int(vocab))) for _ in range(event.prompt_len)]
+    out.extend(r.randrange(v)
+               for _ in range(event.prompt_len - event.prefix_len))
+    return out
 
 
 def smoke_spec(seed: int = 0, duration_s: float = 60.0,

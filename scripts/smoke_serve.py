@@ -58,6 +58,10 @@ REQUIRED_METRICS = (
     "serve_kv_blocks_total", "serve_kv_blocks_used",
     "serve_kv_block_utilization", "serve_kv_live_bytes",
     "serve_prefill_chunks_total", "serve_lease_total",
+    # prefix-cache / CoW / fork surface (ISSUE 20)
+    "serve_prefix_cache_hits_total", "serve_prefix_cache_misses_total",
+    "serve_prefill_tokens_saved_total", "serve_prefix_blocks_shared",
+    "serve_kv_cow_copies_total", "serve_gen_forks_total",
 )
 
 
@@ -113,6 +117,7 @@ def _overcommit_burst(model):
         for p, o in zip(prompts, outs):
             want = generate(model, p[None], 4, temperature=0.0)[0]
             assert o.tolist() == want.tolist(), "overcommit corrupted decode"
+        cb.flush_prefix_cache()  # cache-retained blocks count as used
         stats = cb.kv_block_stats()
         assert stats["blocks_used"] == 0, stats  # everything retired
         try:
@@ -121,6 +126,58 @@ def _overcommit_burst(model):
         except CapacityError:
             pass
         return stats["blocks_total"]
+    finally:
+        cb.shutdown()
+
+
+def _prefix_cache_scenario(model):
+    """ISSUE-20 acceptance: N concurrent requests share one system prompt.
+    A primer request populates the prefix cache; the burst must take cache
+    hits (counters move), decode bit-identically to whole-batch dense
+    ``generate``, compile NOTHING new (adoption changes block-table
+    contents, never shapes), and after drain + flush every refcount is
+    back to zero (``blocks_used == 0``, nothing cached or shared)."""
+    import concurrent.futures as cf
+
+    from deeplearning4j_tpu.nn.generation import generate
+    from deeplearning4j_tpu.serve import ContinuousBatcher
+
+    cb = ContinuousBatcher(model, slots=2, capacity=16, block_size=4,
+                           kv_blocks=16, prefill_chunk=4,
+                           prompt_buckets=(4, 8, 12, 16), queue_limit=16,
+                           seed=0)
+    try:
+        rng = np.random.RandomState(11)
+        sys_prompt = rng.randint(0, 50, (8,)).astype(np.int32)  # 2 blocks
+        prompts = [np.concatenate(
+            [sys_prompt, rng.randint(0, 50, (3,)).astype(np.int32)])
+            for _ in range(6)]
+        # primer: warms every executable and inserts the shared blocks
+        cb.generate(np.concatenate(
+            [sys_prompt, rng.randint(0, 50, (3,)).astype(np.int32)]),
+            4, temperature=0.0)
+        sigs_before = set(cb.compile_signatures)
+        with cf.ThreadPoolExecutor(6) as ex:
+            outs = list(ex.map(
+                lambda p: cb.generate(p, 4, temperature=0.0), prompts))
+        for p, o in zip(prompts, outs):
+            want = generate(model, p[None], 4, temperature=0.0)[0]
+            assert o.tolist() == want.tolist(), \
+                "cached decode diverged from dense"
+        assert set(cb.compile_signatures) == sigs_before, \
+            "prefix-cache burst compiled a new executable"
+        stats = cb.kv_block_stats()
+        px = stats["prefix_cache"]
+        assert px["hits"] >= len(prompts), px  # every burst request hit
+        saved = cb.metrics.counter("serve_prefill_tokens_saved_total").value
+        assert saved >= len(prompts) * 8, saved  # 2 whole blocks each
+        assert stats["blocks_cached"] > 0, stats  # cache is live pre-flush
+        cb.flush_prefix_cache()
+        stats = cb.kv_block_stats()
+        assert stats["blocks_used"] == 0, stats  # every refcount back to 0
+        assert stats["blocks_cached"] == 0 and stats["blocks_shared"] == 0, \
+            stats
+        return int(px["hits"]), int(saved)
     finally:
         cb.shutdown()
 
@@ -516,6 +573,10 @@ def main() -> int:
         # the server's own pool sizing is untouched)
         pool_blocks = _overcommit_burst(model)
 
+        # shared-system-prompt burst: cache hits, zero new compiles,
+        # bit-identical decode, refcounts drain to zero after flush
+        px_hits, px_saved = _prefix_cache_scenario(model)
+
         health = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{srv.port}/health", timeout=10).read())
         assert health["status"] == "ok"
@@ -538,9 +599,10 @@ def main() -> int:
                   "w") as f:
             f.write(om)
         print(f"smoke_serve: {PREDICTS} predicts + {GENERATES} generates "
-              f"+ SSE + overcommit burst ({pool_blocks}-block pool), "
-              f"{n_eng} engine compile(s), {n_gen} generate compile(s), "
-              f"generation {health['generation']} -> {prom_path}")
+              f"+ SSE + overcommit burst ({pool_blocks}-block pool) "
+              f"+ prefix-cache burst ({px_hits} hits, {px_saved} prompt "
+              f"tokens saved), {n_eng} engine compile(s), {n_gen} generate "
+              f"compile(s), generation {health['generation']} -> {prom_path}")
     finally:
         srv.stop()
 

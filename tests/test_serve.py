@@ -741,6 +741,7 @@ class TestPagedKV:
             for p, o in zip(prompts, outs):
                 want = generate(lm, p[None], 4, temperature=0.0)[0]
                 assert np.array_equal(o, want)
+            cb.flush_prefix_cache()  # drop cache-retained blocks
             stats = cb.kv_block_stats()
             assert stats["blocks_used"] == 0  # every block retired
             assert stats["blocks_committed"] == 0
@@ -780,6 +781,7 @@ class TestPagedKV:
             # mid-flight usage covered at least the prompt's blocks and
             # live bytes scale with the allocator, not slots x capacity
             assert peak >= 2, peak
+            cb.flush_prefix_cache()  # cache-held blocks count as used
             assert cb.kv_block_stats()["blocks_used"] == 0
             assert cb.kv_block_stats()["live_bytes"] == 0
             per_block = block_bytes(lm, 4, np.float32)

@@ -169,6 +169,97 @@ class TestMultiDay:
         assert len(set(per_day)) > 1, per_day
 
 
+# -------------------------------------------------------------- prefix pools
+class TestPrefixPools:
+    def _prefix_spec(self, reuse=0.9, seed=2):
+        from deeplearning4j_tpu.sim.workload import LengthDist
+
+        return WorkloadSpec(
+            seed=seed, duration_s=20.0, base_rate_rps=6.0,
+            prompt_len=LengthDist("fixed", 40.0, 0.0, 40),
+            output_len=LengthDist("fixed", 8.0, 0.0, 8),
+            prefix_len=LengthDist("fixed", 32.0, 0.0, 32),
+            prefix_reuse=reuse, prefix_pool=2,
+            models={"m": {"weight": 1.0, "generate_frac": 1.0}})
+
+    def test_off_default_keeps_legacy_canonical_form(self):
+        """``prefix_reuse=0`` is omitted from the canonical dict — the
+        `days` discipline — so every legacy fingerprint, tuned-config key
+        and trace byte stream survives this feature unchanged."""
+        spec = _spec()
+        d = spec.to_dict()
+        assert "prefix_reuse" not in d and "prefix_len" not in d
+        t = generate_trace(spec)
+        assert all(len(ev.to_line().split()) == 9 for ev in t)
+        assert all(ev.prefix_len == 0 for ev in t)
+
+    def test_pool_entries_share_prefix_content(self):
+        from deeplearning4j_tpu.sim.workload import prompt_tokens
+
+        t = generate_trace(self._prefix_spec())
+        with_p = [ev for ev in t if ev.prefix_len > 0]
+        assert with_p, "reuse=0.9 produced no prefixed events"
+        groups = {}
+        for ev in with_p:
+            groups.setdefault((ev.tenant, ev.prefix_seed), []).append(ev)
+        shared = [g for g in groups.values() if len(g) > 1]
+        assert shared, "no pool entry was reused"
+        for g in shared:
+            n = min(ev.prefix_len for ev in g)
+            heads = {tuple(prompt_tokens(ev, 50)[:n]) for ev in g}
+            assert len(heads) == 1  # same pool entry => same head tokens
+        # suffixes stay private: full prompts within a group still differ
+        g = max(shared, key=len)
+        assert len({tuple(prompt_tokens(ev, 50)) for ev in g}) > 1
+
+    def test_prefixed_trace_roundtrips_and_is_deterministic(self, tmp_path):
+        spec = self._prefix_spec()
+        a, b = generate_trace(spec), generate_trace(spec)
+        assert a.to_bytes() == b.to_bytes()
+        path = str(tmp_path / "px.txt")
+        a.save(path)
+        loaded = Trace.load(path)
+        assert loaded.to_bytes() == a.to_bytes()
+        ev = next(e for e in loaded if e.prefix_len > 0)
+        assert len(ev.to_line().split()) == 11  # extended line format
+
+    def test_virtual_replay_models_prefix_hits(self):
+        """Cached whole blocks skip prefill work and block charges: with
+        shared-prefix traffic, prefix_cache=True strictly improves TTFT;
+        on a legacy trace the knob is inert (byte-identical outcomes)."""
+        t = generate_trace(self._prefix_spec())
+        on = VirtualReplayer(t, {"gen": {"prefix_cache": True}}).run()
+        off = VirtualReplayer(t, {"gen": {"prefix_cache": False}}).run()
+        assert on["ttft_ms"]["p50"] < off["ttft_ms"]["p50"]
+        assert on["latency_ms"]["p99"] < off["latency_ms"]["p99"]
+        legacy = generate_trace(_spec())
+        a = VirtualReplayer(legacy, {"gen": {"prefix_cache": True}}).run()
+        b = VirtualReplayer(legacy, {"gen": {"prefix_cache": False}}).run()
+        a.pop("knobs"), b.pop("knobs")
+        assert a == b
+
+    def test_cache_size_knob_bounds_the_model(self):
+        t = generate_trace(self._prefix_spec())
+        small = VirtualReplayer(
+            t, {"gen": {"prefix_cache_blocks": 2}}).run()
+        assert small["completed"] == len(t)  # bounded cache still completes
+
+    def test_knobs_ride_default_space_and_gen_group(self):
+        from deeplearning4j_tpu.serve.continuous import GEN_KNOBS
+        from deeplearning4j_tpu.sim.tune import DEFAULT_SPACE
+
+        assert "gen.prefix_cache" in DEFAULT_SPACE
+        assert "gen.prefix_cache_blocks" in DEFAULT_SPACE
+        assert "prefix_cache" in DEFAULT_KNOBS["gen"]
+        # a tuner winner's gen group must resolve at batcher boot
+        assert "prefix_cache" in GEN_KNOBS
+        assert "prefix_cache_blocks" in GEN_KNOBS
+
+    def test_reuse_without_length_dist_rejected(self):
+        with pytest.raises(ValueError, match="prefix_len"):
+            WorkloadSpec(prefix_reuse=0.5)
+
+
 # ------------------------------------------------------------- virtual replay
 class TestVirtualReplay:
     def test_report_byte_identical(self):
